@@ -1,0 +1,31 @@
+"""
+gordo-tpu: a TPU-native framework for building, serving and monitoring
+thousands of small time-series anomaly-detection models.
+
+Capability-parity rebuild of the reference framework (tommyod/gordo) with the
+ML engine replaced by JAX/Flax under XLA: per-sensor-group autoencoders are
+Flax modules, fleets of models train inside one ``jit``-compiled program
+``vmap``-ed over a stacked machine axis and sharded across a
+``jax.sharding.Mesh``, and the prediction server scores anomalies from
+device-resident parameters.
+
+Layer map (mirrors reference SURVEY.md §1):
+
+- ``gordo_tpu.utils``       — capture_args, disk_registry, pandas-compat shims
+- ``gordo_tpu.serializer``  — YAML-dict <-> live pipeline config language
+- ``gordo_tpu.machine``     — Machine config unit, validators, metadata
+- ``gordo_tpu.data``        — datasets, providers, resample/join, filters
+- ``gordo_tpu.models``      — Flax estimators behind an sklearn-style API
+- ``gordo_tpu.ops``         — low-level JAX/Pallas ops (windowing, kernels)
+- ``gordo_tpu.parallel``    — mesh handling + fleet-vmap batch training
+- ``gordo_tpu.builder``     — ModelBuilder: data -> CV -> fit -> artifact
+- ``gordo_tpu.server``      — REST model server (stdlib WSGI)
+- ``gordo_tpu.client``      — batch prediction client
+- ``gordo_tpu.workflow``    — YAML project config -> Argo workflow generator
+- ``gordo_tpu.reporters``   — build result reporters (sqlite/postgres/mlflow)
+- ``gordo_tpu.cli``         — command-line interface
+"""
+
+__version__ = "0.1.0"
+
+MAJOR_VERSION, MINOR_VERSION = (int(x) for x in __version__.split(".")[:2])

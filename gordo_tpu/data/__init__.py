@@ -1,0 +1,32 @@
+"""
+Data layer: datasets, providers, resample/join engine, filters
+(reference parity: gordo/machine/dataset/).
+"""
+
+from .base import GordoBaseDataset, InsufficientDataError
+from .datasets import (
+    InsufficientDataAfterGlobalFilteringError,
+    InsufficientDataAfterRowFilteringError,
+    RandomDataset,
+    TimeSeriesDataset,
+)
+from .sensor_tag import SensorTag, normalize_sensor_tags, to_list_of_strings
+
+
+def _get_dataset(config: dict) -> GordoBaseDataset:
+    """Type-dispatch dataset factory (reference: dataset/dataset.py:6-16)."""
+    return GordoBaseDataset.from_dict(dict(config))
+
+
+__all__ = [
+    "GordoBaseDataset",
+    "InsufficientDataError",
+    "InsufficientDataAfterRowFilteringError",
+    "InsufficientDataAfterGlobalFilteringError",
+    "TimeSeriesDataset",
+    "RandomDataset",
+    "SensorTag",
+    "normalize_sensor_tags",
+    "to_list_of_strings",
+    "_get_dataset",
+]

@@ -1,0 +1,215 @@
+"""
+Abstract dataset + the resample/join engine
+(reference parity: gordo/machine/dataset/base.py).
+
+The resample/join path stays pandas-on-host — it is I/O bound — but the
+output contract adds :func:`GordoBaseDataset.as_device_arrays` so the builder
+can materialize ``(X, y)`` directly into device memory for the JAX train loop.
+"""
+
+import abc
+import logging
+from copy import copy
+from datetime import datetime
+from typing import Any, Callable, Dict, Iterable, List, Tuple, Union
+
+import numpy as np
+import pandas as pd
+
+from gordo_tpu.utils.compat import normalize_frequency
+
+logger = logging.getLogger(__name__)
+
+
+class InsufficientDataError(ValueError):
+    pass
+
+
+class GordoBaseDataset(abc.ABC):
+
+    _params: Dict[Any, Any] = dict()
+    _metadata: Dict[Any, Any] = dict()
+
+    @abc.abstractmethod
+    def get_data(
+        self,
+    ) -> Tuple[Union[np.ndarray, pd.DataFrame], Union[np.ndarray, pd.DataFrame]]:
+        """Return X, y given the current state."""
+
+    def to_dict(self) -> dict:
+        """
+        Serialize into a dict which can re-create this dataset via
+        :func:`from_dict` (requires ``capture_args`` on ``__init__``).
+        """
+        if not hasattr(self, "_params"):
+            raise AttributeError(
+                "Failed to lookup init parameters; ensure __init__ is "
+                "decorated with 'capture_args'"
+            )
+        params = dict(self._params)
+        params["type"] = self.__class__.__name__
+        for key, value in params.items():
+            if hasattr(value, "to_dict"):
+                params[key] = value.to_dict()
+        return params
+
+    @classmethod
+    def from_dict(cls, config: Dict[str, Any]) -> "GordoBaseDataset":
+        from gordo_tpu.data import datasets
+
+        config = copy(config)
+        type_name = config.pop("type", "TimeSeriesDataset")
+        Dataset = getattr(datasets, type_name, None)
+        if Dataset is None:
+            raise TypeError(f"No dataset of type '{type_name}'")
+        if "tags" in config:
+            config["tag_list"] = config.pop("tags")
+        config.setdefault("target_tag_list", config["tag_list"])
+        return Dataset(**config)
+
+    @abc.abstractmethod
+    def get_metadata(self):
+        """Metadata about the current state of the dataset."""
+
+    @staticmethod
+    def as_device_arrays(
+        X: Union[pd.DataFrame, np.ndarray],
+        y: Union[pd.DataFrame, np.ndarray, None],
+        dtype: str = "float32",
+    ):
+        """
+        Materialize (X, y) as device-committed ``jax.numpy`` arrays — the
+        terminal step feeding the resample/join output into the XLA train
+        loop without further host round-trips.
+        """
+        import jax.numpy as jnp
+
+        Xv = X.to_numpy() if isinstance(X, pd.DataFrame) else np.asarray(X)
+        Xd = jnp.asarray(Xv, dtype=dtype)
+        if y is None:
+            return Xd, None
+        yv = y.to_numpy() if isinstance(y, pd.DataFrame) else np.asarray(y)
+        return Xd, jnp.asarray(yv, dtype=dtype)
+
+    def join_timeseries(
+        self,
+        series_iterable: Iterable[pd.Series],
+        resampling_startpoint: datetime,
+        resampling_endpoint: datetime,
+        resolution: str,
+        aggregation_methods: Union[str, List[str], Callable] = "mean",
+        interpolation_method: str = "linear_interpolation",
+        interpolation_limit: str = "8H",
+    ) -> pd.DataFrame:
+        """
+        Resample each series onto a common grid and inner-join them into one
+        NaN-free frame (reference: base.py:81-174): each series is padded with
+        NaN at the resampling start/end points so every resampled index is
+        identical, resampled with ``label="left"``, aggregated, interpolated
+        up to a limit, joined, and NaN rows dropped.
+        """
+        resampled_series = []
+        missing_data_series = []
+
+        key = "tag_loading_metadata"
+        self._metadata[key] = dict()
+
+        for series in series_iterable:
+            self._metadata[key][series.name] = dict(original_length=len(series))
+            try:
+                resampled = self._resample(
+                    series,
+                    resampling_startpoint=resampling_startpoint,
+                    resampling_endpoint=resampling_endpoint,
+                    resolution=resolution,
+                    aggregation_methods=aggregation_methods,
+                    interpolation_method=interpolation_method,
+                    interpolation_limit=interpolation_limit,
+                )
+            except IndexError:
+                missing_data_series.append(series.name)
+            else:
+                resampled_series.append(resampled)
+                self._metadata[key][series.name]["resampled_length"] = len(resampled)
+
+        if missing_data_series:
+            raise InsufficientDataError(
+                f"The following features are missing data: {missing_data_series}"
+            )
+
+        joined_df = pd.concat(resampled_series, axis=1, join="inner")
+        dropped_na = joined_df.dropna()
+
+        self._metadata[key]["aggregate_metadata"] = dict(
+            joined_length=len(joined_df), dropped_na_length=len(dropped_na)
+        )
+        return dropped_na
+
+    @staticmethod
+    def _resample(
+        series: pd.Series,
+        resampling_startpoint: datetime,
+        resampling_endpoint: datetime,
+        resolution: str,
+        aggregation_methods: Union[str, List[str], Callable] = "mean",
+        interpolation_method: str = "linear_interpolation",
+        interpolation_limit: str = "8H",
+    ):
+        """
+        Resample one series (reference: base.py:176-269). Legacy frequency
+        aliases ("10T", "8H") are normalized for modern pandas.
+        """
+        if len(series) == 0:
+            raise IndexError("Cannot resample an empty series")
+
+        resolution = normalize_frequency(resolution)
+
+        startpoint_sametz = resampling_startpoint.astimezone(tz=series.index[0].tzinfo)
+        endpoint_sametz = resampling_endpoint.astimezone(tz=series.index[0].tzinfo)
+
+        if series.index[0] > startpoint_sametz:
+            # Pad a NaN at the startpoint so all resampled indexes line up;
+            # the padding-induced NaNs are dropped after the join.
+            startpoint = pd.Series([np.nan], index=[startpoint_sametz], name=series.name)
+            series = pd.concat([startpoint, series])
+        elif series.index[0] < startpoint_sametz:
+            raise RuntimeError(
+                f"For {series.name}, first timestamp {series.index[0]} is before "
+                f"the resampling start point {startpoint_sametz}"
+            )
+
+        if series.index[-1] < endpoint_sametz:
+            endpoint = pd.Series([np.nan], index=[endpoint_sametz], name=series.name)
+            series = pd.concat([series, endpoint])
+        elif series.index[-1] > endpoint_sametz:
+            raise RuntimeError(
+                f"For {series.name}, last timestamp {series.index[-1]} is later "
+                f"than the resampling end point {endpoint_sametz}"
+            )
+
+        resampled = series.resample(resolution, label="left").agg(aggregation_methods)
+        if isinstance(resampled, pd.DataFrame):
+            # several aggregation methods -> (tag, aggregation_method) columns
+            resampled.columns = pd.MultiIndex.from_product(
+                [[series.name], resampled.columns],
+                names=["tag", "aggregation_method"],
+            )
+
+        if interpolation_method not in ("linear_interpolation", "ffill"):
+            raise ValueError(
+                "Interpolation method should be either linear_interpolation or ffill"
+            )
+
+        if interpolation_limit is not None:
+            limit = int(
+                pd.Timedelta(normalize_frequency(interpolation_limit)).total_seconds()
+                / pd.Timedelta(resolution).total_seconds()
+            )
+            if limit <= 0:
+                raise ValueError("Interpolation limit must be larger than resolution")
+        else:
+            limit = None
+
+        if interpolation_method == "linear_interpolation":
+            return resampled.interpolate(limit=limit).dropna()
+        return resampled.ffill(limit=limit).dropna()

@@ -1,0 +1,269 @@
+"""
+Concrete datasets (reference parity: gordo/machine/dataset/datasets.py).
+
+``TimeSeriesDataset``: fetch tags -> resample/join -> row filter -> global
+min/max threshold filter -> noisy-period filter -> X/y split by tag lists,
+collecting rich metadata along the way. ``RandomDataset`` forces the
+deterministic random provider.
+"""
+
+import json
+import logging
+from datetime import datetime
+from functools import wraps
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+import pandas as pd
+from dateutil.parser import isoparse
+
+from gordo_tpu.data.base import GordoBaseDataset, InsufficientDataError
+from gordo_tpu.data.filter_periods import FilterPeriods
+from gordo_tpu.data.filter_rows import pandas_filter_rows
+from gordo_tpu.data.providers.base import GordoBaseDataProvider
+from gordo_tpu.data.providers.random_provider import RandomDataProvider
+from gordo_tpu.data.sensor_tag import SensorTag, normalize_sensor_tags
+from gordo_tpu.machine.validators import (
+    ValidDataProvider,
+    ValidDatasetKwargs,
+    ValidDatetime,
+    ValidTagList,
+)
+from gordo_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+
+class InsufficientDataAfterRowFilteringError(InsufficientDataError):
+    pass
+
+
+class InsufficientDataAfterGlobalFilteringError(InsufficientDataError):
+    pass
+
+
+def compat(init):
+    """
+    Rename legacy config keys onto current kwargs
+    (reference: datasets.py:41-63): ``from_ts``/``to_ts``/``tags`` ->
+    ``train_start_date``/``train_end_date``/``tag_list``.
+    """
+
+    @wraps(init)
+    def wrapper(*args, **kwargs):
+        renamings = {
+            "from_ts": "train_start_date",
+            "to_ts": "train_end_date",
+            "tags": "tag_list",
+        }
+        for old, new in renamings.items():
+            if old in kwargs:
+                kwargs[new] = kwargs.pop(old)
+        return init(*args, **kwargs)
+
+    return wrapper
+
+
+class TimeSeriesDataset(GordoBaseDataset):
+
+    train_start_date = ValidDatetime()
+    train_end_date = ValidDatetime()
+    tag_list = ValidTagList()
+    target_tag_list = ValidTagList()
+    data_provider = ValidDataProvider()
+    kwargs = ValidDatasetKwargs()
+
+    @compat
+    @capture_args
+    def __init__(
+        self,
+        train_start_date: Union[datetime, str],
+        train_end_date: Union[datetime, str],
+        tag_list: Sequence[Union[str, Dict, SensorTag]],
+        target_tag_list: Optional[Sequence[Union[str, Dict, SensorTag]]] = None,
+        data_provider: Union[GordoBaseDataProvider, dict, None] = None,
+        resolution: Optional[str] = "10T",
+        row_filter: str = "",
+        aggregation_methods: Union[str, List[str], Callable] = "mean",
+        row_filter_buffer_size: int = 0,
+        asset: Optional[str] = None,
+        default_asset: Optional[str] = None,
+        n_samples_threshold: int = 0,
+        low_threshold=-1000,
+        high_threshold=50000,
+        interpolation_method: str = "linear_interpolation",
+        interpolation_limit: str = "8H",
+        filter_periods={},
+    ):
+        self._metadata = {}
+        self.train_start_date = self._validate_dt(train_start_date)
+        self.train_end_date = self._validate_dt(train_end_date)
+
+        if self.train_start_date >= self.train_end_date:
+            raise ValueError(
+                f"train_end_date ({self.train_end_date}) must be after "
+                f"train_start_date ({self.train_start_date})"
+            )
+
+        self.tag_list = normalize_sensor_tags(list(tag_list), asset, default_asset)
+        self.target_tag_list = (
+            normalize_sensor_tags(list(target_tag_list), asset, default_asset)
+            if target_tag_list
+            else self.tag_list.copy()
+        )
+        self.resolution = resolution
+        if data_provider is None:
+            from gordo_tpu.data.providers.compound import DataLakeProvider
+
+            data_provider = DataLakeProvider()
+        self.data_provider = (
+            data_provider
+            if not isinstance(data_provider, dict)
+            else GordoBaseDataProvider.from_dict(data_provider)
+        )
+        self.row_filter = row_filter
+        self.aggregation_methods = aggregation_methods
+        self.row_filter_buffer_size = row_filter_buffer_size
+        self.asset = asset
+        self.n_samples_threshold = n_samples_threshold
+        self.low_threshold = low_threshold
+        self.high_threshold = high_threshold
+        self.interpolation_method = interpolation_method
+        self.interpolation_limit = interpolation_limit
+        self.filter_periods = (
+            FilterPeriods(granularity=self.resolution, **filter_periods)
+            if filter_periods
+            else None
+        )
+
+    def to_dict(self):
+        params = super().to_dict()
+        for key in ("train_start_date", "train_end_date"):
+            value = params.get(key)
+            params[key] = value.isoformat() if hasattr(value, "isoformat") else str(value)
+        return params
+
+    @staticmethod
+    def _validate_dt(dt: Union[str, datetime]) -> datetime:
+        dt = dt if isinstance(dt, datetime) else isoparse(dt)
+        if dt.tzinfo is None:
+            raise ValueError(
+                "Must provide an ISO formatted datetime string with timezone information"
+            )
+        return dt
+
+    def get_data(self) -> Tuple[pd.DataFrame, Optional[pd.DataFrame]]:
+        all_tags = list(dict.fromkeys(self.tag_list + self.target_tag_list))
+        series_iter: Iterable[pd.Series] = self.data_provider.load_series(
+            train_start_date=self.train_start_date,
+            train_end_date=self.train_end_date,
+            tag_list=all_tags,
+        )
+
+        if self.resolution:
+            data = self.join_timeseries(
+                series_iter,
+                self.train_start_date,
+                self.train_end_date,
+                self.resolution,
+                aggregation_methods=self.aggregation_methods,
+                interpolation_method=self.interpolation_method,
+                interpolation_limit=self.interpolation_limit,
+            )
+        else:
+            data = pd.concat(series_iter, axis=1, join="inner")
+
+        if len(data) <= self.n_samples_threshold:
+            raise InsufficientDataError(
+                f"The length of the generated DataFrame ({len(data)}) does not "
+                f"exceed the required threshold ({self.n_samples_threshold})."
+            )
+
+        if self.row_filter:
+            data = pandas_filter_rows(
+                data, self.row_filter, buffer_size=self.row_filter_buffer_size
+            )
+            if len(data) <= self.n_samples_threshold:
+                raise InsufficientDataAfterRowFilteringError(
+                    f"The length of the DataFrame ({len(data)}) does not exceed "
+                    f"the required threshold ({self.n_samples_threshold}) after "
+                    "row filtering."
+                )
+
+        if self.low_threshold is not None and self.high_threshold is not None:
+            mask = ((data > self.low_threshold) & (data < self.high_threshold)).all(axis=1)
+            data = data[mask]
+            if len(data) <= self.n_samples_threshold:
+                raise InsufficientDataAfterGlobalFilteringError(
+                    f"The length of the DataFrame ({len(data)}) does not exceed "
+                    f"the required threshold ({self.n_samples_threshold}) after "
+                    "global min/max filtering."
+                )
+
+        if self.filter_periods:
+            data, drop_periods, _ = self.filter_periods.filter_data(data)
+            self._metadata["filtered_periods"] = drop_periods
+            if len(data) <= self.n_samples_threshold:
+                raise InsufficientDataError(
+                    f"The length of the DataFrame ({len(data)}) does not exceed "
+                    f"the required threshold ({self.n_samples_threshold}) after "
+                    "noisy-period filtering."
+                )
+
+        x_tag_names = [tag.name for tag in self.tag_list]
+        y_tag_names = [tag.name for tag in self.target_tag_list]
+
+        X = data[x_tag_names]
+        y = data[y_tag_names] if self.target_tag_list else None
+
+        if len(X):
+            self._metadata["train_start_date_actual"] = X.index[0]
+            self._metadata["train_end_date_actual"] = X.index[-1]
+
+        self._metadata["summary_statistics"] = X.describe().to_dict()
+        self._metadata["x_hist"] = self._histograms(X)
+        return X, y
+
+    @staticmethod
+    def _histograms(X: pd.DataFrame, bins: int = 100) -> Dict[str, str]:
+        """Per-tag histograms as JSON strings (reference: datasets.py:277-292)."""
+        hists: Dict[str, str] = {}
+        for tag in X.columns:
+            col = X[tag].to_numpy(dtype="float64")
+            finite = col[np.isfinite(col)]
+            if len(finite) == 0 or float(finite.max() - finite.min()) < 1e-6:
+                hists[str(tag)] = "{}"
+                continue
+            counts, edges = np.histogram(finite, bins=bins)
+            hists[str(tag)] = json.dumps(
+                {
+                    f"({edges[i]:.6f}, {edges[i + 1]:.6f}]": int(counts[i])
+                    for i in range(len(counts))
+                }
+            )
+        return hists
+
+    def get_metadata(self):
+        return self._metadata.copy()
+
+
+class RandomDataset(TimeSeriesDataset):
+    """TimeSeriesDataset always backed by RandomDataProvider."""
+
+    @compat
+    @capture_args
+    def __init__(
+        self,
+        train_start_date: Union[datetime, str],
+        train_end_date: Union[datetime, str],
+        tag_list: list,
+        **kwargs,
+    ):
+        kwargs.pop("data_provider", None)
+        super().__init__(
+            data_provider=RandomDataProvider(),
+            train_start_date=train_start_date,
+            train_end_date=train_end_date,
+            tag_list=tag_list,
+            **kwargs,
+        )

@@ -1,0 +1,163 @@
+"""
+Noisy-period detection and dropping
+(reference parity: gordo/machine/dataset/filter_periods.py).
+
+Two detectors, selected via ``filter_method``: a rolling-median + IQR band
+("median"), an IsolationForest over (optionally EWM-smoothed) data
+("iforest"), or both ("all"). Detected anomalous timestamps are grouped into
+contiguous drop periods (gap > granularity starts a new period) which are
+then masked out of the data.
+"""
+
+import logging
+from pprint import pformat
+from typing import Dict, List, Tuple
+
+import numpy as np
+import pandas as pd
+from sklearn.ensemble import IsolationForest
+from sklearn.preprocessing import MinMaxScaler
+
+from gordo_tpu.utils.compat import normalize_frequency
+
+logger = logging.getLogger(__name__)
+
+
+class WrongFilterMethodType(TypeError):
+    pass
+
+
+class FilterPeriods:
+    def __init__(
+        self,
+        granularity: str,
+        filter_method: str = "median",
+        window: int = 144,
+        n_iqr: int = 5,
+        iforest_smooth: bool = False,
+        contamination: float = 0.03,
+    ):
+        self.granularity = normalize_frequency(granularity)
+        self.filter_method = filter_method
+        if self.filter_method not in ("median", "iforest", "all"):
+            raise WrongFilterMethodType(
+                f"filter_method must be 'median', 'iforest' or 'all', "
+                f"got {filter_method!r}"
+            )
+        self._window = window
+        self._n_iqr = n_iqr
+        self._iforest_smooth = iforest_smooth
+        self._contamination = contamination
+
+    def filter_data(
+        self, data: pd.DataFrame
+    ) -> Tuple[pd.DataFrame, Dict[str, List[dict]], Dict[str, pd.DataFrame]]:
+        """
+        Returns (filtered data, drop periods per method, raw predictions per
+        method) — reference: filter_periods.py:61-76.
+        """
+        predictions: Dict[str, pd.DataFrame] = {}
+        if self.filter_method in ("median", "all"):
+            predictions["median"] = self._rolling_median(data)
+        if self.filter_method in ("iforest", "all"):
+            self._train(data)
+            predictions["iforest"] = self._predict(data)
+
+        drop_periods = self._drop_periods(predictions)
+        data = self._apply_drop_periods(data, drop_periods)
+        return data, drop_periods, predictions
+
+    def _train(self, data: pd.DataFrame):
+        fit_data = data.ewm(halflife=6).mean() if self._iforest_smooth else data
+        self.isolationforest = IsolationForest(
+            n_estimators=300,
+            max_samples=min(1000, fit_data.shape[0]),
+            contamination=self._contamination,
+            max_features=1.0,
+            bootstrap=False,
+            n_jobs=-1,
+            random_state=42,
+        )
+        self.minmaxscaler = MinMaxScaler()
+        self.model = self.isolationforest.fit(fit_data)
+
+    def _predict(self, data: pd.DataFrame) -> pd.DataFrame:
+        score = -self.model.decision_function(data)
+        self.iforest_scores = pformat(pd.Series(score).describe().round(3).to_dict())
+        score = self.minmaxscaler.fit_transform(score.reshape(-1, 1)).squeeze()
+        self.iforest_scores_transformed = pformat(
+            pd.Series(score).describe().round(3).to_dict()
+        )
+        pred = self.model.predict(data)
+        return pd.DataFrame(
+            {"pred": pred, "score": score, "timestamp": data.index}
+        )
+
+    def _rolling_median(self, data: pd.DataFrame) -> pd.DataFrame:
+        roll = data.rolling(self._window, center=True)
+        r_md = roll.median()
+        r_iqr = roll.quantile(0.75) - roll.quantile(0.25)
+        high = r_md + self._n_iqr * r_iqr
+        low = r_md - self._n_iqr * r_iqr
+        outlier = ((data < low) | (data > high)).any(axis=1)
+        pred = pd.DataFrame(
+            {"pred": outlier.astype(int) * -1, "timestamp": data.index}
+        )
+        return pred.reset_index(drop=True)
+
+    def _drop_periods(
+        self, predictions: Dict[str, pd.DataFrame]
+    ) -> Dict[str, List[dict]]:
+        """
+        Group anomaly-flagged timestamps into contiguous periods: consecutive
+        flags (time gap <= granularity) extend a period; a larger gap starts a
+        new one (reference: filter_periods.py:145-196).
+        """
+        granularity_min = pd.Timedelta(self.granularity).total_seconds() / 60
+        drop_periods: Dict[str, List[dict]] = {}
+
+        for pred_type, pred in predictions.items():
+            flagged = pred.loc[pred["pred"] == -1, "timestamp"].reset_index(drop=True)
+            periods: List[dict] = []
+            if len(flagged):
+                delta_min = (
+                    flagged.diff().fillna(pd.Timedelta(0)).dt.total_seconds() / 60
+                )
+                start_idx = 0
+                for i in range(len(flagged)):
+                    if i > 0 and delta_min[i] > granularity_min:
+                        periods.append(
+                            {
+                                "drop_start": str(flagged[start_idx]),
+                                "drop_end": str(flagged[i - 1]),
+                            }
+                        )
+                        start_idx = i
+                periods.append(
+                    {
+                        "drop_start": str(flagged[start_idx]),
+                        "drop_end": str(flagged[len(flagged) - 1]),
+                    }
+                )
+            drop_periods[pred_type] = periods
+
+        return drop_periods
+
+    @staticmethod
+    def _apply_drop_periods(
+        data: pd.DataFrame, drop_periods: Dict[str, List[dict]]
+    ) -> pd.DataFrame:
+        keep = np.ones(len(data), dtype=bool)
+        index = data.index
+        n_prior = len(data)
+        for periods in drop_periods.values():
+            for period in periods:
+                start = pd.Timestamp(period["drop_start"])
+                end = pd.Timestamp(period["drop_end"])
+                keep &= ~((index >= start) & (index <= end))
+        if keep.all():
+            logger.info("No rows dropped")
+            return data
+        filtered = data[keep]
+        logger.info("Dropped %d rows", n_prior - len(filtered))
+        return filtered

@@ -1,0 +1,64 @@
+"""
+Row filtering with pandas-eval expressions
+(reference parity: gordo/machine/dataset/filter_rows.py).
+
+Filters are strings like ``"`Tag A` > 5"`` (or lists of such strings, ANDed
+together) evaluated against the dataframe. Rows *removed* by the filter can
+additionally knock out a symmetric buffer of neighbouring rows.
+"""
+
+import logging
+from typing import List, Union
+
+import numpy as np
+import pandas as pd
+
+logger = logging.getLogger(__name__)
+
+
+def apply_buffer(mask: pd.Series, buffer_size: int = 0) -> pd.Series:
+    """
+    Expand the False (filtered-out) regions of a boolean mask by
+    ``buffer_size`` elements fore and aft (reference: filter_rows.py:8-42).
+    """
+    if buffer_size == 0:
+        return mask
+    values = mask.to_numpy(dtype=bool)
+    removed = ~values
+    # dilate the removed-region indicator with a (2*buffer+1)-wide window
+    kernel = np.ones(2 * buffer_size + 1, dtype=int)
+    dilated = np.convolve(removed.astype(int), kernel, mode="same") > 0
+    return pd.Series(~dilated, index=mask.index)
+
+
+def pandas_filter_rows(
+    df: pd.DataFrame,
+    filter_str: Union[str, List[str]],
+    buffer_size: int = 0,
+) -> pd.DataFrame:
+    """
+    Keep only rows satisfying the filter expression(s)
+    (reference: filter_rows.py:45-141).
+
+    Examples
+    --------
+    >>> df = pd.DataFrame({"a": [1, 2, 3], "b": [3, 2, 1]})
+    >>> pandas_filter_rows(df, "a > b")["a"].tolist()
+    [3]
+    >>> pandas_filter_rows(df, ["a > 1", "b > 1"])["a"].tolist()
+    [2]
+    """
+    if isinstance(filter_str, str):
+        expressions = [filter_str]
+    else:
+        expressions = list(filter_str)
+
+    mask = pd.Series(True, index=df.index)
+    for expression in expressions:
+        result = df.eval(expression)
+        if isinstance(result, pd.DataFrame):
+            result = result.all(axis=1)
+        mask &= result.astype(bool)
+
+    mask = apply_buffer(mask, buffer_size)
+    return df[mask]

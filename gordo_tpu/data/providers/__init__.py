@@ -1,0 +1,31 @@
+"""
+Data providers: sources of raw tag series.
+
+- RandomDataProvider — deterministic random series (test backbone)
+- FileSystemProvider — local/NFS/FUSE-mounted lake reader (parquet/csv)
+- InfluxDataProvider — InfluxDB reader (requires the ``influxdb`` package)
+- DataLakeProvider  — compat alias accepted in legacy configs; resolves to
+  FileSystemProvider semantics against a mounted lake path
+"""
+
+from .base import GordoBaseDataProvider
+from .random_provider import RandomDataProvider
+from .filesystem import FileSystemProvider
+from .compound import DataLakeProvider, providers_for_tags
+
+try:  # influxdb client is optional
+    from .influx import InfluxDataProvider  # noqa: F401
+
+    _HAS_INFLUX = True
+except ImportError:  # pragma: no cover
+    _HAS_INFLUX = False
+
+__all__ = [
+    "GordoBaseDataProvider",
+    "RandomDataProvider",
+    "FileSystemProvider",
+    "DataLakeProvider",
+    "providers_for_tags",
+]
+if _HAS_INFLUX:
+    __all__.append("InfluxDataProvider")

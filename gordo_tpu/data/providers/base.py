@@ -1,0 +1,61 @@
+"""
+Data provider ABC
+(reference parity: gordo/machine/dataset/data_provider/base.py:13-89).
+"""
+
+import abc
+from copy import copy
+from datetime import datetime
+from typing import Any, Dict, Iterable, List
+
+import pandas as pd
+
+from gordo_tpu.data.sensor_tag import SensorTag
+
+
+class GordoBaseDataProvider(abc.ABC):
+
+    _params: Dict[Any, Any] = dict()
+
+    @abc.abstractmethod
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: List[SensorTag],
+        dry_run: bool = False,
+    ) -> Iterable[pd.Series]:
+        """
+        Yield one time-indexed series per tag covering
+        [train_start_date, train_end_date).
+        """
+
+    @abc.abstractmethod
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        """Whether this provider can serve data for ``tag``."""
+
+    def to_dict(self) -> dict:
+        """
+        Serialize to a config dict (requires ``capture_args`` on __init__).
+        """
+        if not hasattr(self, "_params"):
+            raise AttributeError(
+                "Failed to lookup init parameters; ensure __init__ is "
+                "decorated with 'capture_args'"
+            )
+        params = dict(self._params)
+        params["type"] = f"{self.__class__.__module__}.{self.__class__.__name__}"
+        return params
+
+    @classmethod
+    def from_dict(cls, config: dict) -> "GordoBaseDataProvider":
+        from gordo_tpu.serializer import resolve_import_path
+
+        config = copy(config)
+        type_path = config.pop("type", "RandomDataProvider")
+        Provider = resolve_import_path(type_path)
+        if Provider is None and "." not in type_path:
+            Provider = resolve_import_path(f"gordo_tpu.data.providers.{type_path}")
+        if Provider is None:
+            raise TypeError(f"No data provider of type '{type_path}'")
+        return Provider(**config)

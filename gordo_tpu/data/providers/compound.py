@@ -1,0 +1,100 @@
+"""
+Multi-provider dispatch: the first sub-provider that ``can_handle_tag`` wins
+(reference parity: gordo/machine/dataset/data_provider/providers.py:32-83,
+DataLakeProvider :86-178).
+
+``DataLakeProvider`` keeps the legacy config name so reference configs load
+unchanged; on this framework it reads from a mounted lake directory
+(``GORDO_TPU_LAKE_DIR`` or the ``base_dir`` kwarg) via FileSystemProvider,
+falling back to random data in interactive/dev mode when no lake is mounted.
+"""
+
+import logging
+import os
+import typing
+from datetime import datetime
+
+import pandas as pd
+
+from gordo_tpu.data.providers.base import GordoBaseDataProvider
+from gordo_tpu.data.providers.filesystem import FileSystemProvider
+from gordo_tpu.data.providers.random_provider import RandomDataProvider
+from gordo_tpu.data.sensor_tag import SensorTag
+from gordo_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+LAKE_DIR_ENV_VAR = "GORDO_TPU_LAKE_DIR"
+
+
+def providers_for_tags(
+    providers: typing.List[GordoBaseDataProvider],
+    tag_list: typing.List[SensorTag],
+) -> typing.Dict[GordoBaseDataProvider, typing.List[SensorTag]]:
+    """Partition tags onto the first provider able to handle each."""
+    assignment: typing.Dict[GordoBaseDataProvider, typing.List[SensorTag]] = {}
+    for tag in tag_list:
+        for provider in providers:
+            if provider.can_handle_tag(tag):
+                assignment.setdefault(provider, []).append(tag)
+                break
+        else:
+            raise ValueError(f"No provider can handle tag {tag}")
+    return assignment
+
+
+class CompoundProvider(GordoBaseDataProvider):
+    """Compose sub-providers; dispatch per tag."""
+
+    @capture_args
+    def __init__(self, providers: typing.List = None, **kwargs):
+        self.providers = [
+            p if isinstance(p, GordoBaseDataProvider) else GordoBaseDataProvider.from_dict(p)
+            for p in (providers or [])
+        ]
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return any(p.can_handle_tag(tag) for p in self.providers)
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: typing.List[SensorTag],
+        dry_run: typing.Optional[bool] = False,
+    ) -> typing.Iterable[pd.Series]:
+        assignment = providers_for_tags(self.providers, tag_list)
+        for provider, tags in assignment.items():
+            yield from provider.load_series(
+                train_start_date, train_end_date, tags, dry_run=dry_run
+            )
+
+
+class DataLakeProvider(CompoundProvider):
+    """
+    Legacy-config-compatible lake provider. ``storename``/``interactive``/
+    ``dl_service_auth_str`` kwargs from reference configs are accepted and
+    ignored (cloud SDK auth is irrelevant against a mounted lake).
+    """
+
+    @capture_args
+    def __init__(
+        self,
+        base_dir: typing.Optional[str] = None,
+        threads: int = 10,
+        **kwargs,
+    ):
+        base_dir = base_dir or os.environ.get(LAKE_DIR_ENV_VAR)
+        subs: typing.List[GordoBaseDataProvider] = []
+        if base_dir:
+            subs.append(FileSystemProvider(base_dir=base_dir, threads=threads))
+        else:
+            logger.warning(
+                "DataLakeProvider: no lake directory configured (set %s or "
+                "base_dir); falling back to RandomDataProvider",
+                LAKE_DIR_ENV_VAR,
+            )
+            subs.append(RandomDataProvider())
+        super().__init__(providers=subs)
+        # keep the originally captured args for to_dict round-trips
+        self._params = {"base_dir": base_dir, "threads": threads, **kwargs}

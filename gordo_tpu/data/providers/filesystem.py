@@ -1,0 +1,160 @@
+"""
+Filesystem-backed tag reader — the TPU-native stand-in for the reference's
+cloud lake readers (gordo/machine/dataset/data_provider/ncs_reader.py,
+iroc_reader.py). Same responsibilities — per-tag per-year files, parquet
+preferred over CSV, thread-pool fan-out per tag, status-code row dropping,
+keep-last timestamp dedup — against a local/NFS/gcsfuse-mounted directory
+(the natural layout on GKE TPU node pools where the lake is FUSE-mounted).
+
+Expected layout::
+
+    <base_dir>/<asset>/<tag_name>/<tag_name>_<year>.parquet   (or .csv)
+    <base_dir>/<asset>/<tag_name>.parquet                     (single-file)
+
+Parquet/CSV schema: columns (Time, Value[, Status]) or a 2-column
+(timestamp, value) file.
+"""
+
+import logging
+import typing
+from concurrent.futures import ThreadPoolExecutor
+from datetime import datetime
+from pathlib import Path
+
+import pandas as pd
+
+from gordo_tpu.data.providers.base import GordoBaseDataProvider
+from gordo_tpu.data.sensor_tag import SensorTag
+from gordo_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+# Status codes considered good measurements (reference: ncs_reader.py:174)
+GOOD_STATUS_CODES = frozenset([0, 192])
+
+
+class FileSystemProvider(GordoBaseDataProvider):
+    @capture_args
+    def __init__(
+        self,
+        base_dir: str,
+        threads: int = 10,
+        remove_status_codes: typing.Optional[list] = None,
+        dry_run: bool = False,
+        **kwargs,
+    ):
+        self.base_dir = Path(base_dir)
+        self.threads = threads
+        # rows whose Status is in this list are dropped; None -> keep rows
+        # whose status is "good" when a Status column exists
+        self.remove_status_codes = remove_status_codes
+        self.dry_run = dry_run
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return self._tag_dir(tag) is not None
+
+    def _tag_dir(self, tag: SensorTag) -> typing.Optional[Path]:
+        candidates = []
+        if tag.asset:
+            candidates.append(self.base_dir / tag.asset)
+        candidates.append(self.base_dir)
+        for root in candidates:
+            tag_dir = root / tag.name
+            if tag_dir.is_dir():
+                return tag_dir
+            for suffix in (".parquet", ".csv"):
+                if (root / (tag.name + suffix)).is_file():
+                    return root
+        return None
+
+    def _tag_files(
+        self, tag: SensorTag, years: typing.Iterable[int]
+    ) -> typing.List[Path]:
+        root = self._tag_dir(tag)
+        if root is None:
+            raise FileNotFoundError(
+                f"No files found for tag {tag.name} under {self.base_dir}"
+            )
+        files: typing.List[Path] = []
+        tag_dir = root / tag.name
+        if tag_dir.is_dir():
+            for year in years:
+                # parquet preferred over csv (reference: ncs_reader.py:151-153)
+                for suffix in (".parquet", ".csv"):
+                    candidate = tag_dir / f"{tag.name}_{year}{suffix}"
+                    if candidate.is_file():
+                        files.append(candidate)
+                        break
+        else:
+            for suffix in (".parquet", ".csv"):
+                candidate = root / (tag.name + suffix)
+                if candidate.is_file():
+                    files.append(candidate)
+                    break
+        return files
+
+    def _read_file(self, path: Path, tag_name: str) -> pd.DataFrame:
+        if path.suffix == ".parquet":
+            df = pd.read_parquet(path)
+        else:
+            df = pd.read_csv(path)
+        # normalize column names: (Time, Value[, Status]) or first-two-columns
+        cols = {c.lower(): c for c in df.columns}
+        time_col = cols.get("time", df.columns[0])
+        value_col = cols.get("value", df.columns[1] if len(df.columns) > 1 else None)
+        status_col = cols.get("status")
+        if value_col is None:
+            raise ValueError(f"File {path} has no value column")
+        if status_col is not None:
+            if self.remove_status_codes is not None:
+                df = df[~df[status_col].isin(self.remove_status_codes)]
+            else:
+                df = df[df[status_col].isin(GOOD_STATUS_CODES)]
+        out = pd.DataFrame(
+            {
+                "Time": pd.to_datetime(df[time_col], utc=True),
+                "Value": pd.to_numeric(df[value_col], errors="coerce"),
+            }
+        ).dropna()
+        out = out.set_index("Time").sort_index()
+        return out
+
+    def _read_tag(
+        self,
+        tag: SensorTag,
+        train_start_date: datetime,
+        train_end_date: datetime,
+    ) -> pd.Series:
+        years = range(train_start_date.year, train_end_date.year + 1)
+        frames = [self._read_file(p, tag.name) for p in self._tag_files(tag, years)]
+        if not frames:
+            return pd.Series(name=tag.name, dtype="float64")
+        df = pd.concat(frames).sort_index()
+        # dedup timestamps keep-last (reference: ncs_reader.py:371-372)
+        df = df[~df.index.duplicated(keep="last")]
+        series = df["Value"]
+        series.name = tag.name
+        start = pd.Timestamp(train_start_date)
+        end = pd.Timestamp(train_end_date)
+        return series[(series.index >= start) & (series.index < end)]
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: typing.List[SensorTag],
+        dry_run: typing.Optional[bool] = False,
+    ) -> typing.Iterable[pd.Series]:
+        if train_start_date >= train_end_date:
+            raise ValueError(
+                f"start date {train_start_date} is not before end {train_end_date}"
+            )
+        with ThreadPoolExecutor(max_workers=self.threads) as executor:
+            fetched = executor.map(
+                lambda tag: self._read_tag(tag, train_start_date, train_end_date),
+                tag_list,
+            )
+            for series in fetched:
+                if dry_run:
+                    logger.info("Dry run: %s (%d rows)", series.name, len(series))
+                yield series

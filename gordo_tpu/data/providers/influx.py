@@ -1,0 +1,116 @@
+"""
+InfluxDB data provider
+(reference parity: gordo/machine/dataset/data_provider/providers.py:179-342).
+
+Requires the optional ``influxdb`` package; importing this module without it
+raises ImportError (the package __init__ gates on that).
+"""
+
+import typing
+from datetime import datetime
+
+import pandas as pd
+from influxdb import DataFrameClient  # noqa: F401  (hard requirement here)
+
+from gordo_tpu.data.providers.base import GordoBaseDataProvider
+from gordo_tpu.data.sensor_tag import SensorTag
+from gordo_tpu.utils import capture_args
+
+
+def influx_client_from_uri(
+    uri: str,
+    api_key: typing.Optional[str] = None,
+    api_key_header: typing.Optional[str] = None,
+    recreate: bool = False,
+    dataframe_client: bool = True,
+):
+    """
+    Create an influx client from a URI of the form
+    ``<username>:<password>@<host>:<port>/<optional-path>/<db_name>``.
+    """
+    username, password, host, port, *path, db_name = (
+        uri.replace("/", ":").replace("@", ":").split(":")
+    )
+    cls = DataFrameClient
+    client = cls(
+        host=host,
+        port=int(port),
+        username=username,
+        password=password,
+        database=db_name,
+        path="/".join(path),
+    )
+    if api_key:
+        client._headers[api_key_header or "Ocp-Apim-Subscription-Key"] = api_key
+    if recreate:
+        client.drop_database(db_name)
+        client.create_database(db_name)
+    return client
+
+
+class InfluxDataProvider(GordoBaseDataProvider):
+    @capture_args
+    def __init__(
+        self,
+        measurement: str,
+        value_name: str = "Value",
+        api_key: typing.Optional[str] = None,
+        api_key_header: typing.Optional[str] = None,
+        client=None,
+        uri: typing.Optional[str] = None,
+        **kwargs,
+    ):
+        self.measurement = measurement
+        self.value_name = value_name
+        self.influx_client = client
+        if self.influx_client is None and uri:
+            self.influx_client = influx_client_from_uri(
+                uri, api_key=api_key, api_key_header=api_key_header
+            )
+        self._tags: typing.Optional[typing.List[str]] = None
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: typing.List[SensorTag],
+        dry_run: typing.Optional[bool] = False,
+    ) -> typing.Iterable[pd.Series]:
+        if dry_run:
+            raise NotImplementedError("Dry run for InfluxDataProvider is not implemented")
+        return (
+            self.read_single_sensor(
+                train_start_date, train_end_date, tag.name, self.measurement
+            )
+            for tag in tag_list
+        )
+
+    def read_single_sensor(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag: str,
+        measurement: str,
+    ) -> pd.Series:
+        query = f"""
+            SELECT "{self.value_name}" as "{tag}"
+            FROM "{measurement}"
+            WHERE("tag" =~ /^{tag}$/)
+                AND time >= {int(train_start_date.timestamp())}s
+                AND time <= {int(train_end_date.timestamp())}s
+        """
+        result = self.influx_client.query(query)
+        if not result:
+            raise ValueError(f"Influx query returned no data for tag {tag}: {query}")
+        df = result[measurement]
+        return df[tag]
+
+    def get_list_of_tags(self) -> typing.List[str]:
+        if self._tags is None:
+            query = f'SHOW TAG VALUES ON "{self.influx_client._database}" WITH KEY = "tag"'
+            points = self.influx_client.query(query).get_points()
+            self._tags = [p["value"] for p in points]
+        return self._tags
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return tag.name in self.get_list_of_tags()

@@ -1,0 +1,63 @@
+"""
+Deterministic random data provider — the universal fake backend for tests and
+local dev (reference parity:
+gordo/machine/dataset/data_provider/providers.py:344-392).
+
+Unlike the reference (which leans on global ``np.random.seed(0)`` state),
+randomness here is a pure function of (seed, tag name, date range), so series
+are reproducible regardless of call order — the same discipline JAX's
+splittable PRNG imposes on the model layer.
+"""
+
+import hashlib
+import typing
+from datetime import datetime
+
+import numpy as np
+import pandas as pd
+
+from gordo_tpu.data.providers.base import GordoBaseDataProvider
+from gordo_tpu.data.sensor_tag import SensorTag
+from gordo_tpu.utils import capture_args
+
+
+class RandomDataProvider(GordoBaseDataProvider):
+    """Provides random series for any tag; same inputs -> same outputs."""
+
+    @capture_args
+    def __init__(self, min_size: int = 100, max_size: int = 300, seed: int = 0, **kwargs):
+        self.min_size = min_size
+        self.max_size = max_size
+        self.seed = seed
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        return True
+
+    def _rng_for(self, tag_name: str, start: datetime, end: datetime) -> np.random.Generator:
+        digest = hashlib.sha256(
+            f"{self.seed}|{tag_name}|{start.isoformat()}|{end.isoformat()}".encode()
+        ).digest()
+        return np.random.default_rng(int.from_bytes(digest[:8], "little"))
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: typing.List[SensorTag],
+        dry_run: typing.Optional[bool] = False,
+    ) -> typing.Iterable[pd.Series]:
+        if dry_run:
+            raise NotImplementedError("Dry run for RandomDataProvider is not implemented")
+        start = pd.to_datetime(train_start_date, utc=True)
+        end = pd.to_datetime(train_end_date, utc=True)
+        start_u = start.value // 10 ** 9
+        end_u = end.value // 10 ** 9
+        for tag in tag_list:
+            rng = self._rng_for(tag.name, train_start_date, train_end_date)
+            n = int(rng.integers(self.min_size, self.max_size + 1))
+            index = sorted(
+                pd.to_datetime(rng.integers(start_u, end_u, n), unit="s", utc=True)
+            )
+            yield pd.Series(
+                index=index, name=tag.name, data=rng.random(size=len(index))
+            )

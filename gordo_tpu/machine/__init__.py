@@ -1,0 +1,29 @@
+"""
+Machine config unit, validators and build metadata
+(reference parity: gordo/machine/).
+"""
+
+from . import validators  # noqa: F401
+
+try:
+    from .machine import Machine, MachineEncoder  # noqa: F401
+    from .metadata import (  # noqa: F401
+        BuildMetadata,
+        CrossValidationMetaData,
+        DatasetBuildMetadata,
+        Metadata,
+        ModelBuildMetadata,
+    )
+
+    __all__ = [
+        "Machine",
+        "MachineEncoder",
+        "Metadata",
+        "BuildMetadata",
+        "ModelBuildMetadata",
+        "DatasetBuildMetadata",
+        "CrossValidationMetaData",
+        "validators",
+    ]
+except ImportError:  # during partial builds of the package
+    __all__ = ["validators"]

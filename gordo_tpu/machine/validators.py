@@ -1,0 +1,186 @@
+"""
+Descriptor validators applied at attribute assignment on Machine / dataset
+config objects (reference parity: gordo/machine/validators.py).
+"""
+
+import datetime
+import logging
+import re
+from typing import Any
+
+from dateutil.parser import isoparse
+
+logger = logging.getLogger(__name__)
+
+
+class BaseDescriptor:
+    """Attribute descriptor that validates on ``__set__``."""
+
+    def __set_name__(self, owner, name):
+        self.name = name
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        return instance.__dict__.get(self.name)
+
+    def __set__(self, instance, value):
+        self.validate(value)
+        instance.__dict__[self.name] = value
+
+    def validate(self, value):
+        raise NotImplementedError()
+
+
+class ValidDatetime(BaseDescriptor):
+    """Requires a timezone-aware datetime (or ISO string parsing to one)."""
+
+    def validate(self, value):
+        if isinstance(value, str):
+            value = isoparse(value)
+        if not isinstance(value, datetime.datetime):
+            raise ValueError(f"'{value}' is not a valid datetime")
+        if value.tzinfo is None:
+            raise ValueError(f"Datetime '{value}' needs timezone information")
+
+    def __set__(self, instance, value):
+        if isinstance(value, str):
+            value = isoparse(value)
+        self.validate(value)
+        instance.__dict__[self.name] = value
+
+
+class ValidTagList(BaseDescriptor):
+    """A non-empty list of str / dict / SensorTag elements."""
+
+    def validate(self, value):
+        from gordo_tpu.data.sensor_tag import SensorTag
+
+        if (
+            not isinstance(value, (list, tuple))
+            or len(value) == 0
+            or not all(isinstance(v, (str, dict, SensorTag, list)) for v in value)
+        ):
+            raise ValueError(f"Requires a non-empty list of tags, got {value!r}")
+
+
+class ValidDataset(BaseDescriptor):
+    """Must be a GordoBaseDataset or a dataset config dict."""
+
+    def validate(self, value):
+        from gordo_tpu.data.base import GordoBaseDataset
+
+        if isinstance(value, GordoBaseDataset):
+            return
+        if isinstance(value, dict):
+            return
+        raise ValueError(f"'{value}' is not a valid dataset config or dataset object")
+
+
+class ValidDataProvider(BaseDescriptor):
+    def validate(self, value):
+        from gordo_tpu.data.providers.base import GordoBaseDataProvider
+
+        if not isinstance(value, (GordoBaseDataProvider, dict)):
+            raise ValueError(f"'{value}' is not a valid data provider")
+
+
+class ValidDatasetKwargs(BaseDescriptor):
+    def validate(self, value):
+        if not isinstance(value, dict):
+            raise ValueError(f"'{value}' is not a valid dict")
+
+
+class ValidModel(BaseDescriptor):
+    """
+    Model config must round-trip through the serializer: a dry-run
+    ``from_definition`` must succeed (reference: validators.py:80-91).
+    """
+
+    def validate(self, value):
+        if not isinstance(value, dict):
+            raise ValueError(f"Model config must be a dict, got {value!r}")
+        from gordo_tpu.serializer import from_definition
+
+        try:
+            from_definition(value)
+        except Exception as exc:
+            raise ValueError(f"Invalid model config: {exc}") from exc
+
+
+class ValidMetadata(BaseDescriptor):
+    def validate(self, value):
+        from gordo_tpu.machine.metadata import Metadata
+
+        if value is None or isinstance(value, (dict, Metadata)):
+            return
+        raise ValueError(f"'{value}' is not a valid metadata")
+
+
+def fix_resource_limits(resources: dict) -> dict:
+    """
+    Ensure limits >= requests for cpu/memory in a k8s-style resources dict;
+    bump limits up to the request where violated
+    (reference: validators.py:172-231).
+    """
+    requests = resources.get("requests", {}) or {}
+    limits = resources.get("limits", {}) or {}
+    for key in ("cpu", "memory"):
+        req, lim = requests.get(key), limits.get(key)
+        if req is not None and not isinstance(req, int):
+            try:
+                requests[key] = req = int(req)
+            except (TypeError, ValueError):
+                raise ValueError(f"Resource request {key}={req!r} is not an integer")
+        if lim is not None and not isinstance(lim, int):
+            try:
+                limits[key] = lim = int(lim)
+            except (TypeError, ValueError):
+                raise ValueError(f"Resource limit {key}={lim!r} is not an integer")
+        if req is not None and lim is not None and lim < req:
+            logger.warning(
+                "Resource %s limit %s is below request %s; lifting limit to request",
+                key, lim, req,
+            )
+            limits[key] = req
+    out = dict(resources)
+    if requests:
+        out["requests"] = requests
+    if limits:
+        out["limits"] = limits
+    return out
+
+
+class ValidMachineRuntime(BaseDescriptor):
+    def validate(self, value):
+        if not isinstance(value, dict):
+            raise ValueError(f"'{value}' is not a valid runtime config dict")
+
+    def __set__(self, instance, value):
+        self.validate(value)
+        for section in ("builder", "server", "client", "influx", "prometheus"):
+            cfg = value.get(section)
+            if isinstance(cfg, dict) and isinstance(cfg.get("resources"), dict):
+                cfg["resources"] = fix_resource_limits(cfg["resources"])
+        instance.__dict__[self.name] = value
+
+
+_URL_RE = re.compile(r"^[a-z0-9]([a-z0-9\-]{0,61}[a-z0-9])?$")
+
+
+class ValidUrlString(BaseDescriptor):
+    """
+    Kubernetes DNS-label rules: lowercase alphanumerics and '-', no leading/
+    trailing '-', max 63 chars (reference: validators.py:271-322).
+    """
+
+    def validate(self, value):
+        if not isinstance(value, str) or not self.valid_url_string(value):
+            raise ValueError(
+                f"'{value}' is not a valid name: must be a lowercase DNS-1123 "
+                "label (a-z, 0-9, '-'), max 63 chars, not starting/ending with '-'"
+            )
+
+    @staticmethod
+    def valid_url_string(value: str) -> bool:
+        return len(value) <= 63 and bool(_URL_RE.match(value))

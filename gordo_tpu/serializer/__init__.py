@@ -1,0 +1,27 @@
+"""
+The config language of the framework: YAML dicts <-> live estimator pipelines,
+plus model persistence (reference parity: gordo/serializer/__init__.py:1-3).
+"""
+
+from .from_definition import from_definition, resolve_import_path
+from .into_definition import into_definition
+from .serializer import (
+    dump,
+    dumps,
+    load,
+    loads,
+    load_metadata,
+    metadata_path,
+)
+
+__all__ = [
+    "from_definition",
+    "into_definition",
+    "resolve_import_path",
+    "dump",
+    "dumps",
+    "load",
+    "loads",
+    "load_metadata",
+    "metadata_path",
+]

@@ -1,0 +1,199 @@
+"""
+Build live estimator pipelines from config dicts.
+
+Reference parity: gordo/serializer/from_definition.py — a recursive
+"dotted-import-path + kwargs" object language:
+
+- ``"sklearn.preprocessing.MinMaxScaler"`` -> instance with defaults
+- ``{"sklearn.decomposition.PCA": {"n_components": 4}}`` -> instance w/ kwargs
+- a top-level *list* is an implicit ``sklearn.pipeline.Pipeline``
+- ``Pipeline.steps`` / ``FeatureUnion.transformer_list`` entries are
+  themselves definitions
+- param values that are single-key dicts whose key is an import path are
+  instantiated recursively; strings that resolve to *callables* are replaced
+  by the callable (for e.g. ``FunctionTransformer(func=...)``); strings that
+  resolve to *classes* inside params are instantiated with defaults
+- a class may provide a ``from_definition`` classmethod hook to take over its
+  own construction
+
+Legacy compatibility: import paths under ``gordo.`` (the reference package)
+are transparently rewritten onto their ``gordo_tpu`` equivalents so existing
+YAML configs run unchanged (e.g.
+``gordo.machine.model.models.KerasAutoEncoder`` ->
+``gordo_tpu.models.AutoEncoder``).
+"""
+
+import copy
+import logging
+import pydoc
+from typing import Any, Dict, List, Union
+
+logger = logging.getLogger(__name__)
+
+# Exact legacy-path -> new-path translations (checked before prefix rules).
+LEGACY_PATH_MAP: Dict[str, str] = {
+    "gordo.machine.model.models.KerasAutoEncoder": "gordo_tpu.models.AutoEncoder",
+    "gordo.machine.model.models.KerasLSTMAutoEncoder": "gordo_tpu.models.LSTMAutoEncoder",
+    "gordo.machine.model.models.KerasLSTMForecast": "gordo_tpu.models.LSTMForecast",
+    "gordo.machine.model.models.KerasRawModelRegressor": "gordo_tpu.models.RawModelRegressor",
+    "gordo.machine.model.models.KerasBaseEstimator": "gordo_tpu.models.BaseJaxEstimator",
+}
+
+# Ordered (prefix, replacement) rules applied when no exact entry matches.
+LEGACY_PREFIX_RULES = [
+    ("gordo.machine.dataset.data_provider.", "gordo_tpu.data.providers."),
+    ("gordo.machine.dataset.", "gordo_tpu.data."),
+    ("gordo.machine.model.anomaly.", "gordo_tpu.models.anomaly."),
+    ("gordo.machine.model.transformer_funcs.", "gordo_tpu.models.transformer_funcs."),
+    ("gordo.machine.model.transformers.", "gordo_tpu.models.transformers."),
+    ("gordo.machine.model.factories.", "gordo_tpu.models.factories."),
+    ("gordo.machine.model.", "gordo_tpu.models."),
+    ("gordo.machine.", "gordo_tpu.machine."),
+    ("gordo.", "gordo_tpu."),
+]
+
+
+def _translate_legacy_path(path: str) -> str:
+    if path in LEGACY_PATH_MAP:
+        return LEGACY_PATH_MAP[path]
+    for prefix, replacement in LEGACY_PREFIX_RULES:
+        if path.startswith(prefix):
+            return replacement + path[len(prefix):]
+    return path
+
+
+def resolve_import_path(path: str) -> Any:
+    """
+    Locate the object named by a dotted import path, translating reference
+    (``gordo.``) paths to their ``gordo_tpu`` equivalents. Returns None when
+    nothing is found (mirroring ``pydoc.locate``).
+    """
+    obj = pydoc.locate(_translate_legacy_path(path))
+    if obj is None and "." in path:
+        obj = pydoc.locate(path)
+    return obj
+
+
+def _locate_or_raise(path: str) -> Any:
+    obj = resolve_import_path(path)
+    if obj is None:
+        raise ValueError(
+            f"Could not locate object for import path: {path!r} "
+            f"(translated: {_translate_legacy_path(path)!r})"
+        )
+    return obj
+
+
+def _looks_like_import_path(value: str) -> bool:
+    return "." in value and not value.startswith(".") and " " not in value
+
+
+def _is_definition_dict(value: dict) -> bool:
+    """A single-key dict whose key is a dotted import path naming a class."""
+    if len(value) != 1:
+        return False
+    key = next(iter(value))
+    if not isinstance(key, str) or not _looks_like_import_path(key):
+        return False
+    return isinstance(resolve_import_path(key), type)
+
+
+def _instantiate(cls: type, params: Dict[str, Any]) -> Any:
+    params = _prepare_params(cls, params)
+    if hasattr(cls, "from_definition") and callable(getattr(cls, "from_definition")):
+        try:
+            return cls.from_definition(params)
+        except TypeError:
+            # hooks with a (cls, config) signature vs plain classmethods
+            pass
+    return cls(**params)
+
+
+def _prepare_params(cls: type, params: Dict[str, Any]) -> Dict[str, Any]:
+    """Recursively materialize param values that are themselves definitions."""
+    prepared: Dict[str, Any] = {}
+    for key, value in params.items():
+        if key in ("steps",):
+            prepared[key] = [_build_pipeline_step(s) for s in value]
+        elif key in ("transformer_list", "transformers"):
+            prepared[key] = [_build_union_entry(e) for e in value]
+        elif key == "callbacks" and isinstance(value, list):
+            prepared[key] = [_build_param_value(v) for v in value]
+        else:
+            prepared[key] = _build_param_value(value)
+    return prepared
+
+
+def _build_param_value(value: Any) -> Any:
+    if isinstance(value, dict) and _is_definition_dict(value):
+        return _build_step(value)
+    if isinstance(value, dict):
+        return {k: _build_param_value(v) for k, v in value.items()}
+    if isinstance(value, list):
+        return [_build_param_value(v) for v in value]
+    if isinstance(value, str) and _looks_like_import_path(value):
+        located = resolve_import_path(value)
+        if isinstance(located, type):
+            # class path as a param -> instance with defaults
+            return located()
+        if callable(located):
+            return located
+    return value
+
+
+def _build_step(definition: Union[str, Dict[str, Any]]) -> Any:
+    """Turn one definition node (str or single-key dict) into a live object."""
+    if isinstance(definition, str):
+        obj = _locate_or_raise(definition)
+        return _instantiate(obj, {}) if isinstance(obj, type) else obj
+    if isinstance(definition, dict):
+        if not _is_definition_dict(definition) and len(definition) != 1:
+            raise ValueError(
+                f"Step definition must be a single-key dict, got: {definition!r}"
+            )
+        path, params = next(iter(definition.items()))
+        obj = _locate_or_raise(path)
+        if params is None:
+            params = {}
+        if not isinstance(params, dict):
+            raise ValueError(
+                f"Parameters for {path!r} must be a mapping, got: {params!r}"
+            )
+        if not isinstance(obj, type):
+            raise ValueError(f"{path!r} does not name a class")
+        return _instantiate(obj, params)
+    raise ValueError(f"Cannot build step from definition: {definition!r}")
+
+
+def _build_pipeline_step(step: Union[str, Dict[str, Any], tuple, list]) -> tuple:
+    """Pipeline steps become (name, estimator) tuples; name = class name."""
+    if isinstance(step, (tuple, list)) and len(step) == 2:
+        name, definition = step
+        return (name, _build_step(definition))
+    obj = _build_step(step)
+    return (f"step_{type(obj).__name__}", obj)
+
+
+def _build_union_entry(entry: Union[str, Dict[str, Any], tuple, list]):
+    if isinstance(entry, (tuple, list)) and len(entry) in (2, 3):
+        parts = list(entry)
+        parts[1] = _build_step(parts[1])
+        return tuple(parts)
+    obj = _build_step(entry)
+    return (f"step_{type(obj).__name__}", obj)
+
+
+def from_definition(pipe_definition: Union[str, List, Dict[str, Any]]) -> Any:
+    """
+    Construct a live object (usually an estimator / Pipeline) from a config
+    definition (reference: gordo/serializer/from_definition.py:16-60).
+
+    A top-level list is treated as an implicit ``sklearn.pipeline.Pipeline``.
+    """
+    definition = copy.deepcopy(pipe_definition)
+    if isinstance(definition, list):
+        from sklearn.pipeline import Pipeline
+
+        steps = [_build_pipeline_step(s) for s in definition]
+        return Pipeline(steps)
+    return _build_step(definition)

@@ -1,0 +1,107 @@
+"""
+The inverse of ``from_definition``: decompose a live pipeline back into the
+primitive dict config language (reference: gordo/serializer/into_definition.py).
+"""
+
+import logging
+from typing import Any, Dict
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _import_path(obj: Any) -> str:
+    cls = obj if isinstance(obj, type) else type(obj)
+    return f"{cls.__module__}.{cls.__name__}"
+
+
+def _is_primitive(value: Any) -> bool:
+    return isinstance(value, (str, int, float, bool, type(None)))
+
+
+def _decompose_value(value: Any, prune_default_params: bool) -> Any:
+    if _is_primitive(value):
+        return value
+    if isinstance(value, (np.integer,)):
+        return int(value)
+    if isinstance(value, (np.floating,)):
+        return float(value)
+    if isinstance(value, np.ndarray):
+        return value.tolist()
+    if isinstance(value, dict):
+        return {k: _decompose_value(v, prune_default_params) for k, v in value.items()}
+    if isinstance(value, (list, tuple)):
+        return [_decompose_value(v, prune_default_params) for v in value]
+    if callable(value) and not hasattr(value, "get_params"):
+        # plain function (e.g. FunctionTransformer func) -> import path string
+        module = getattr(value, "__module__", None)
+        name = getattr(value, "__qualname__", getattr(value, "__name__", None))
+        if module and name:
+            return f"{module}.{name}"
+        return str(value)
+    return _decompose_node(value, prune_default_params)
+
+
+def _default_params(obj: Any) -> Dict[str, Any]:
+    try:
+        return {
+            k: v.default
+            for k, v in __import__("inspect").signature(type(obj)).parameters.items()
+        }
+    except (ValueError, TypeError):
+        return {}
+
+
+def _decompose_node(step: Any, prune_default_params: bool = False) -> Dict[str, Any]:
+    """
+    One estimator -> ``{import.path.Class: {param: value, ...}}`` using
+    ``get_params(deep=False)`` recursively
+    (reference: gordo/serializer/into_definition.py:62-126).
+    """
+    if hasattr(step, "into_definition") and callable(step.into_definition):
+        return step.into_definition()
+
+    import inspect
+
+    if not hasattr(step, "get_params"):
+        raise ValueError(f"Cannot decompose object without get_params: {step!r}")
+
+    params = step.get_params(deep=False)
+
+    # Pipeline steps / FeatureUnion entries carry (name, est) tuples — strip
+    # the names, matching the from_definition list form.
+    decomposed: Dict[str, Any] = {}
+    for key, value in params.items():
+        if key == "steps" and isinstance(value, list):
+            decomposed[key] = [
+                _decompose_node(est, prune_default_params) for _, est in value
+            ]
+        elif key in ("transformer_list", "transformers") and isinstance(value, list):
+            decomposed[key] = [
+                _decompose_node(entry[1], prune_default_params) for entry in value
+            ]
+        else:
+            decomposed[key] = _decompose_value(value, prune_default_params)
+
+    if prune_default_params:
+        try:
+            defaults = {
+                k: v.default for k, v in inspect.signature(type(step)).parameters.items()
+            }
+        except (ValueError, TypeError):
+            defaults = {}
+        decomposed = {
+            k: v for k, v in decomposed.items() if defaults.get(k, object()) != v
+        }
+
+    return {_import_path(step): decomposed}
+
+
+def into_definition(pipeline: Any, prune_default_params: bool = False) -> Dict[str, Any]:
+    """
+    Convert a live estimator/pipeline into its primitive config dict, such
+    that ``from_definition(into_definition(obj))`` reconstructs an equivalent
+    object (reference: gordo/serializer/into_definition.py:12-59).
+    """
+    return _decompose_node(pipeline, prune_default_params)

@@ -1,0 +1,82 @@
+"""
+Model persistence: artifact dir = ``model.pkl`` + ``metadata.json``
+(reference: gordo/serializer/serializer.py:22-170).
+
+Estimators whose parameters live on device (JAX arrays) are expected to
+host-materialize them in ``__getstate__`` so pickling stays portable —
+see gordo_tpu.models.core.BaseJaxEstimator.
+"""
+
+import bz2
+import logging
+import os
+import pickle
+from pathlib import Path
+from typing import Any, Optional, Union
+
+import simplejson
+
+logger = logging.getLogger(__name__)
+
+MODEL_FILENAME = "model.pkl"
+METADATA_FILENAME = "metadata.json"
+
+
+def dumps(model: Any) -> bytes:
+    """Serialize a model to bytes (used by the download-model endpoint)."""
+    return bz2.compress(pickle.dumps(model))
+
+
+def loads(bytes_object: bytes) -> Any:
+    """Inverse of :func:`dumps`."""
+    try:
+        return pickle.loads(bz2.decompress(bytes_object))
+    except OSError:
+        # uncompressed payloads (older artifacts) load directly
+        return pickle.loads(bytes_object)
+
+
+def dump(obj: Any, dest_dir: Union[os.PathLike, str], metadata: Optional[dict] = None):
+    """
+    Serialize ``obj`` into ``dest_dir`` as ``model.pkl`` (+ ``metadata.json``
+    if metadata given).
+    """
+    dest_dir = Path(dest_dir)
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    with open(dest_dir / MODEL_FILENAME, "wb") as f:
+        pickle.dump(obj, f)
+    if metadata is not None:
+        with open(dest_dir / METADATA_FILENAME, "w") as f:
+            simplejson.dump(metadata, f, default=str, ignore_nan=True)
+
+
+def load(source_dir: Union[os.PathLike, str]) -> Any:
+    """Load the model pickled under ``source_dir``."""
+    source_dir = Path(source_dir)
+    model_file = source_dir / MODEL_FILENAME
+    if not model_file.is_file():
+        raise FileNotFoundError(f"No {MODEL_FILENAME} found in {source_dir}")
+    with open(model_file, "rb") as f:
+        return pickle.load(f)
+
+
+def metadata_path(source_dir: Union[os.PathLike, str]) -> Optional[Path]:
+    """
+    Locate ``metadata.json`` for an artifact dir, checking the dir itself then
+    its parent (reference: gordo/serializer/serializer.py:69-103).
+    """
+    source_dir = Path(source_dir)
+    for candidate in (source_dir / METADATA_FILENAME, source_dir.parent / METADATA_FILENAME):
+        if candidate.is_file():
+            return candidate
+    return None
+
+
+def load_metadata(source_dir: Union[os.PathLike, str]) -> dict:
+    """Load an artifact's metadata dict; {} when no metadata file exists."""
+    path = metadata_path(source_dir)
+    if path is None:
+        logger.warning("No metadata found in %s", source_dir)
+        return {}
+    with open(path) as f:
+        return simplejson.load(f)

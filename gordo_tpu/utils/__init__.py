@@ -1,0 +1,14 @@
+"""
+Small shared utilities (reference parity: gordo/util/__init__.py:1-3).
+"""
+
+from .utils import capture_args, replace_all_non_ascii_chars_with_default
+from . import disk_registry
+from .compat import normalize_frequency
+
+__all__ = [
+    "capture_args",
+    "replace_all_non_ascii_chars_with_default",
+    "disk_registry",
+    "normalize_frequency",
+]

@@ -1,0 +1,56 @@
+"""
+Compatibility shims so reference-era configs run unchanged on modern pandas.
+
+The reference uses pandas<2 frequency aliases ("10T", "8H", "1S") throughout
+its configs and defaults (e.g. gordo/machine/dataset/datasets.py:84
+``resolution="10T"``). pandas 3 removed the single-letter aliases for
+minute/hour/second; this module maps legacy spellings onto their modern
+equivalents so YAML configs written for the reference keep working.
+"""
+
+import re
+
+# legacy single/upper-case alias -> modern lower-case alias
+_LEGACY_ALIASES = {
+    "T": "min",
+    "MIN": "min",
+    "H": "h",
+    "S": "s",
+    "L": "ms",
+    "U": "us",
+    "N": "ns",
+}
+
+_FREQ_RE = re.compile(r"^\s*(\d*\.?\d*)\s*([a-zA-Z]+)\s*$")
+
+
+def normalize_frequency(freq: str) -> str:
+    """
+    Normalize a pandas frequency/offset alias: "10T" -> "10min", "8H" -> "8h".
+
+    Strings that are not simple <number><alias> offsets (or use aliases we
+    don't recognise) are returned unchanged so modern spellings pass through.
+
+    Examples
+    --------
+    >>> normalize_frequency("10T")
+    '10min'
+    >>> normalize_frequency("8H")
+    '8h'
+    >>> normalize_frequency("1min")
+    '1min'
+    """
+    if not isinstance(freq, str):
+        return freq
+    m = _FREQ_RE.match(freq)
+    if not m:
+        return freq
+    num, alias = m.groups()
+    replacement = _LEGACY_ALIASES.get(alias.upper() if len(alias) == 1 else alias.upper())
+    if replacement is None:
+        return freq
+    # Only single-letter uppercase aliases (and "MIN") are legacy; a modern
+    # alias like "ms"/"min"/"h" is already fine but normalizing is harmless.
+    if alias in ("ms", "us", "ns", "min", "h", "s"):
+        return f"{num}{alias}"
+    return f"{num}{replacement}"

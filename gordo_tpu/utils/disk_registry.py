@@ -1,0 +1,55 @@
+"""
+A file-per-key registry on disk, used as the model build cache index.
+
+Reference parity: gordo/util/disk_registry.py:17-117 — ``write_key`` /
+``get_value`` / ``delete_value`` with keys as filenames under a registry dir.
+"""
+
+import logging
+import os
+import re
+from pathlib import Path
+from typing import Optional, Union
+
+logger = logging.getLogger(__name__)
+
+_VALID_KEY = re.compile(r"^[A-Za-z0-9_.\-]+$")
+
+
+def _key_path(registry_dir: Union[os.PathLike, str], key: str) -> Path:
+    if not _VALID_KEY.match(key):
+        raise ValueError(
+            f"Key {key!r} is not a valid registry key "
+            "(allowed: letters, digits, '_', '.', '-')"
+        )
+    return Path(registry_dir) / key
+
+
+def write_key(registry_dir: Union[os.PathLike, str], key: str, val: str):
+    """
+    Write ``val`` under ``key`` in the registry, creating the registry dir
+    if needed. Overwrites any existing value (with a warning, like the
+    reference).
+    """
+    path = _key_path(registry_dir, key)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    if path.exists():
+        logger.warning("Overwriting existing registry key %s", key)
+    path.write_text(str(val))
+
+
+def get_value(registry_dir: Union[os.PathLike, str], key: str) -> Optional[str]:
+    """Read the value stored under ``key``; None if the key does not exist."""
+    path = _key_path(registry_dir, key)
+    if not path.is_file():
+        return None
+    return path.read_text()
+
+
+def delete_value(registry_dir: Union[os.PathLike, str], key: str) -> bool:
+    """Delete ``key`` from the registry. Returns True if something was deleted."""
+    path = _key_path(registry_dir, key)
+    if path.is_file():
+        path.unlink()
+        return True
+    return False

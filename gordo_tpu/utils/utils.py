@@ -1,0 +1,43 @@
+"""
+Reference parity: gordo/util/utils.py (capture_args) and
+gordo/util/__init__.py (replace_all_non_ascii_chars).
+"""
+
+import functools
+import inspect
+import re
+
+
+def capture_args(init):
+    """
+    Decorator for ``__init__`` that records the call's arguments on
+    ``self._params`` so objects can round-trip through ``to_dict`` /
+    ``from_dict`` (reference: gordo/util/utils.py:6-49).
+
+    Positional args are resolved to their parameter names via the signature;
+    defaults for parameters not passed are captured too, so the stored dict is
+    the *effective* configuration.
+    """
+
+    @functools.wraps(init)
+    def wrapper(self, *args, **kwargs):
+        sig = inspect.signature(init)
+        bound = sig.bind(self, *args, **kwargs)
+        bound.apply_defaults()
+        params = dict(bound.arguments)
+        params.pop("self", None)
+        # flatten a trailing **kwargs capture into the params dict itself
+        for name, p in sig.parameters.items():
+            if p.kind is inspect.Parameter.VAR_KEYWORD and name in params:
+                params.update(params.pop(name))
+            if p.kind is inspect.Parameter.VAR_POSITIONAL and name in params:
+                params[name] = list(params[name])
+        self._params = params
+        return init(self, *args, **kwargs)
+
+    return wrapper
+
+
+def replace_all_non_ascii_chars_with_default(value: str, default: str = "-") -> str:
+    """Replace every non-ASCII character in ``value`` with ``default``."""
+    return re.sub(r"[^\x00-\x7F]", default, value)

@@ -1,0 +1,24 @@
+"""
+Test configuration.
+
+TPU twist on the reference's fixture spine (SURVEY.md §4): XLA-on-CPU is the
+"fake backend" — tests force the CPU platform with 8 virtual devices so
+multi-chip sharding logic is exercised without TPU hardware.
+"""
+
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def tmp_dir_session(tmp_path_factory):
+    return tmp_path_factory.mktemp("gordo-tpu-session")

@@ -1,0 +1,172 @@
+"""Data layer tests (reference test model: tests/gordo/machine/dataset/)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.data import (
+    InsufficientDataError,
+    RandomDataset,
+    TimeSeriesDataset,
+    _get_dataset,
+)
+from gordo_tpu.data.filter_rows import apply_buffer, pandas_filter_rows
+from gordo_tpu.data.providers.random_provider import RandomDataProvider
+from gordo_tpu.data.sensor_tag import (
+    SensorTag,
+    SensorTagNormalizationError,
+    normalize_sensor_tags,
+)
+
+TAGS = ["Tag 1", "Tag 2", "Tag 3"]
+START, END = "2018-01-01T00:00:00+00:00", "2018-01-03T00:00:00+00:00"
+
+
+def make_dataset(**kwargs):
+    defaults = dict(
+        train_start_date=START,
+        train_end_date=END,
+        tag_list=TAGS,
+        asset="asset",
+        resolution="10T",
+    )
+    defaults.update(kwargs)
+    return RandomDataset(**defaults)
+
+
+def test_random_dataset_get_data():
+    X, y = make_dataset().get_data()
+    assert isinstance(X, pd.DataFrame)
+    assert list(X.columns) == TAGS
+    assert y is not None and list(y.columns) == TAGS
+    assert len(X) > 0
+    assert not X.isna().any().any()
+
+
+def test_random_provider_deterministic():
+    from dateutil.parser import isoparse
+
+    p = RandomDataProvider()
+    tags = [SensorTag("Tag 1", "a")]
+    s1 = list(p.load_series(isoparse(START), isoparse(END), tags))[0]
+    s2 = list(p.load_series(isoparse(START), isoparse(END), tags))[0]
+    pd.testing.assert_series_equal(s1, s2)
+
+
+def test_dataset_to_dict_roundtrip():
+    ds = make_dataset()
+    config = ds.to_dict()
+    assert config["type"] == "RandomDataset"
+    rebuilt = _get_dataset(config)
+    X1, _ = ds.get_data()
+    X2, _ = rebuilt.get_data()
+    pd.testing.assert_frame_equal(X1, X2)
+
+
+def test_dataset_requires_tz():
+    with pytest.raises(ValueError):
+        make_dataset(train_start_date="2018-01-01T00:00:00")
+
+
+def test_dataset_start_after_end():
+    with pytest.raises(ValueError):
+        make_dataset(train_start_date=END, train_end_date=START)
+
+
+def test_insufficient_data_threshold():
+    with pytest.raises(InsufficientDataError):
+        make_dataset(n_samples_threshold=100000).get_data()
+
+
+def test_legacy_compat_keys():
+    ds = RandomDataset(
+        from_ts=START, to_ts=END, tags=TAGS, asset="asset"
+    )
+    assert ds.train_start_date.isoformat().startswith("2018-01-01")
+
+
+def test_target_tag_list_subset():
+    ds = make_dataset(target_tag_list=TAGS[:2])
+    X, y = ds.get_data()
+    assert list(X.columns) == TAGS
+    assert list(y.columns) == TAGS[:2]
+
+
+def test_metadata_collected():
+    ds = make_dataset()
+    ds.get_data()
+    meta = ds.get_metadata()
+    assert "summary_statistics" in meta
+    assert "x_hist" in meta
+    assert "tag_loading_metadata" in meta
+
+
+def test_as_device_arrays():
+    ds = make_dataset()
+    X, y = ds.get_data()
+    Xd, yd = ds.as_device_arrays(X, y)
+    import jax.numpy as jnp
+
+    assert isinstance(Xd, jnp.ndarray)
+    assert Xd.shape == X.shape
+    assert yd.shape == y.shape
+
+
+def test_normalize_sensor_tags_forms():
+    tags = normalize_sensor_tags(
+        ["GRA-FOO 123", {"name": "t2", "asset": "a2"}, ["t3", "a3"], SensorTag("t4", "a4")]
+    )
+    assert tags[0] == SensorTag("GRA-FOO 123", "1755-gra")
+    assert tags[1] == SensorTag("t2", "a2")
+    assert tags[2] == SensorTag("t3", "a3")
+    assert tags[3] == SensorTag("t4", "a4")
+
+
+def test_normalize_unresolvable_raises():
+    with pytest.raises(SensorTagNormalizationError):
+        normalize_sensor_tags(["zzz-unknown-tag"])
+
+
+def test_normalize_with_default_asset():
+    tags = normalize_sensor_tags(["zzz-unknown-tag"], default_asset="fallback")
+    assert tags[0].asset == "fallback"
+
+
+def test_filter_rows():
+    df = pd.DataFrame({"A": range(10), "B": range(10)})
+    out = pandas_filter_rows(df, "`A` > 3")
+    assert len(out) == 6
+    out = pandas_filter_rows(df, ["A > 3", "B < 8"])
+    assert len(out) == 4
+
+
+def test_apply_buffer():
+    mask = pd.Series([True] * 10)
+    mask.iloc[5] = False
+    out = apply_buffer(mask, buffer_size=2)
+    assert out.tolist() == [True, True, True, False, False, False, False, False, True, True]
+
+
+def test_row_filter_in_dataset():
+    ds = make_dataset(row_filter="`Tag 1` > 0.2")
+    X, _ = ds.get_data()
+    assert (X["Tag 1"] > 0.2).all()
+
+
+def test_resample_join_alignment():
+    # two series at different raw timestamps land on one aligned grid
+    ds = make_dataset(resolution="1H")
+    X, _ = ds.get_data()
+    deltas = X.index.to_series().diff().dropna().unique()
+    assert len(deltas) == 1
+    assert deltas[0] == pd.Timedelta("1h")
+
+
+def test_legacy_frequency_normalization():
+    from gordo_tpu.utils.compat import normalize_frequency
+
+    assert normalize_frequency("10T") == "10min"
+    assert normalize_frequency("8H") == "8h"
+    assert normalize_frequency("1S") == "1s"
+    assert normalize_frequency("3min") == "3min"
+    assert normalize_frequency("not-a-freq") == "not-a-freq"

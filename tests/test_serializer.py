@@ -1,0 +1,84 @@
+"""Serializer round-trip tests (reference test model: tests/gordo/serializer/)."""
+
+import pytest
+from sklearn.decomposition import PCA
+from sklearn.pipeline import Pipeline
+from sklearn.preprocessing import MinMaxScaler
+
+from gordo_tpu.serializer import from_definition, into_definition
+
+
+def test_from_definition_simple_string():
+    obj = from_definition("sklearn.preprocessing.MinMaxScaler")
+    assert isinstance(obj, MinMaxScaler)
+
+
+def test_from_definition_with_params():
+    obj = from_definition({"sklearn.decomposition.PCA": {"n_components": 3}})
+    assert isinstance(obj, PCA)
+    assert obj.n_components == 3
+
+
+def test_from_definition_pipeline_list():
+    obj = from_definition(
+        [
+            "sklearn.preprocessing.MinMaxScaler",
+            {"sklearn.decomposition.PCA": {"n_components": 2}},
+        ]
+    )
+    assert isinstance(obj, Pipeline)
+    assert isinstance(obj.steps[0][1], MinMaxScaler)
+    assert isinstance(obj.steps[1][1], PCA)
+
+
+def test_from_definition_nested_pipeline():
+    definition = {
+        "sklearn.pipeline.Pipeline": {
+            "steps": [
+                "sklearn.preprocessing.MinMaxScaler",
+                {"sklearn.decomposition.PCA": {"n_components": 2}},
+            ]
+        }
+    }
+    obj = from_definition(definition)
+    assert isinstance(obj, Pipeline)
+    assert obj.steps[1][1].n_components == 2
+
+
+def test_roundtrip_into_from():
+    pipe = Pipeline(
+        [("scale", MinMaxScaler()), ("pca", PCA(n_components=2))]
+    )
+    definition = into_definition(pipe)
+    rebuilt = from_definition(definition)
+    assert isinstance(rebuilt, Pipeline)
+    assert isinstance(rebuilt.steps[0][1], MinMaxScaler)
+    assert rebuilt.steps[1][1].n_components == 2
+
+
+def test_from_definition_param_class_path_string():
+    # a param that's a dotted path to a callable resolves to the callable
+    obj = from_definition(
+        {
+            "sklearn.preprocessing.FunctionTransformer": {
+                "func": "numpy.log1p",
+            }
+        }
+    )
+    import numpy as np
+
+    assert obj.func is np.log1p
+
+
+def test_from_definition_unknown_path_raises():
+    with pytest.raises(ValueError):
+        from_definition("no.such.module.Klass")
+
+
+def test_legacy_gordo_paths_translate():
+    from gordo_tpu.serializer import resolve_import_path
+
+    located = resolve_import_path("gordo.machine.dataset.datasets.TimeSeriesDataset")
+    from gordo_tpu.data import TimeSeriesDataset
+
+    assert located is TimeSeriesDataset
