@@ -64,6 +64,11 @@ class GordoBaseDataset(abc.ABC):
             raise TypeError(f"No dataset of type '{type_name}'")
         if "tags" in config:
             config["tag_list"] = config.pop("tags")
+        if "tag_list" not in config:
+            raise ValueError(
+                "Dataset config requires a 'tags' (or 'tag_list') key naming "
+                "the sensor tags to load"
+            )
         config.setdefault("target_tag_list", config["tag_list"])
         return Dataset(**config)
 

@@ -121,8 +121,11 @@ def fix_resource_limits(resources: dict) -> dict:
     """
     Ensure limits >= requests for cpu/memory in a k8s-style resources dict;
     bump limits up to the request where violated
-    (reference: validators.py:172-231).
+    (reference: validators.py:172-231). The input dict is not mutated.
     """
+    import copy as _copy
+
+    resources = _copy.deepcopy(resources)
     requests = resources.get("requests", {}) or {}
     limits = resources.get("limits", {}) or {}
     for key in ("cpu", "memory"):

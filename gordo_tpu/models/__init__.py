@@ -1,0 +1,36 @@
+"""
+Model layer: Flax estimators behind an sklearn-style API
+(reference parity: gordo/machine/model/).
+"""
+
+from .base import GordoBase
+from .core import BaseJaxEstimator
+from .models import (
+    AutoEncoder,
+    KerasAutoEncoder,
+    KerasLSTMAutoEncoder,
+    KerasLSTMForecast,
+    KerasRawModelRegressor,
+    LSTMAutoEncoder,
+    LSTMBaseEstimator,
+    LSTMForecast,
+    RawModelRegressor,
+)
+from .register import register_model_builder
+from .specs import ModelSpec
+
+__all__ = [
+    "GordoBase",
+    "BaseJaxEstimator",
+    "AutoEncoder",
+    "LSTMAutoEncoder",
+    "LSTMForecast",
+    "LSTMBaseEstimator",
+    "RawModelRegressor",
+    "KerasAutoEncoder",
+    "KerasLSTMAutoEncoder",
+    "KerasLSTMForecast",
+    "KerasRawModelRegressor",
+    "register_model_builder",
+    "ModelSpec",
+]

@@ -1,0 +1,23 @@
+"""
+Anomaly detector ABC (reference parity: gordo/machine/model/anomaly/base.py).
+"""
+
+import abc
+from datetime import timedelta
+from typing import Optional
+
+import pandas as pd
+from sklearn.base import BaseEstimator
+
+from gordo_tpu.models.base import GordoBase
+
+
+class AnomalyDetectorBase(BaseEstimator, GordoBase, metaclass=abc.ABCMeta):
+    @abc.abstractmethod
+    def anomaly(
+        self, X: pd.DataFrame, y: pd.DataFrame, frequency: Optional[timedelta] = None
+    ) -> pd.DataFrame:
+        """
+        Take (X, y) and return a superset DataFrame with anomaly-specific
+        features added.
+        """

@@ -1,0 +1,326 @@
+"""
+DiffBasedAnomalyDetector — the product's core anomaly algorithm
+(reference parity: gordo/machine/model/anomaly/diff.py).
+
+Wraps any base estimator + scaler. Thresholds come from cross-validation:
+per fold, per-timestep errors between predictions and scaled targets are
+rolled with a min-then-max (``rolling(6).min().max()``) to produce aggregate
+(scaled-MSE) and per-tag (MAE) thresholds; the final thresholds are the last
+fold's. ``anomaly()`` emits the canonical MultiIndex frame with
+tag/total anomalies (scaled + unscaled), optional smoothed variants, and
+confidence = anomaly / threshold.
+"""
+
+import logging
+from datetime import timedelta
+from typing import Optional, Union
+
+import numpy as np
+import pandas as pd
+from sklearn.base import BaseEstimator, TransformerMixin
+from sklearn.model_selection import TimeSeriesSplit, cross_validate
+
+from gordo_tpu.models import utils as model_utils
+from gordo_tpu.models.anomaly.base import AnomalyDetectorBase
+from gordo_tpu.models.base import GordoBase
+
+logger = logging.getLogger(__name__)
+
+
+def _default_base_estimator():
+    from gordo_tpu.models.models import AutoEncoder
+
+    return AutoEncoder(kind="feedforward_hourglass")
+
+
+class DiffBasedAnomalyDetector(AnomalyDetectorBase):
+    def __init__(
+        self,
+        base_estimator: BaseEstimator = None,
+        scaler: TransformerMixin = None,
+        require_thresholds: bool = True,
+        window: Optional[int] = None,
+    ):
+        """
+        Parameters
+        ----------
+        base_estimator
+            Model with normal fit/predict; defaults to
+            ``AutoEncoder(kind="feedforward_hourglass")``.
+        scaler
+            Defaults to ``sklearn.preprocessing.RobustScaler``; fitted on
+            the *target* after training, used purely for error scaling.
+        require_thresholds
+            If True (default), calling ``anomaly()`` without a prior
+            ``cross_validate()`` raises AttributeError.
+        window
+            Rolling window size for smoothed anomalies/thresholds.
+        """
+        from sklearn.preprocessing import RobustScaler
+
+        self.base_estimator = (
+            base_estimator if base_estimator is not None else _default_base_estimator()
+        )
+        self.scaler = scaler if scaler is not None else RobustScaler()
+        self.require_thresholds = require_thresholds
+        self.window = window
+
+    def __getattr__(self, item):
+        # transparent delegation into base_estimator for anything not ours
+        if item in self.__dict__:
+            return getattr(self, item)
+        base = self.__dict__.get("base_estimator")
+        if base is None:
+            raise AttributeError(item)
+        return getattr(base, item)
+
+    def get_params(self, deep=True):
+        params = {"base_estimator": self.base_estimator, "scaler": self.scaler}
+        if self.window is not None:
+            params["window"] = self.window
+        return params
+
+    def get_metadata(self):
+        metadata = {}
+        if hasattr(self, "feature_thresholds_"):
+            metadata["feature-thresholds"] = self.feature_thresholds_.tolist()
+        if hasattr(self, "aggregate_threshold_"):
+            metadata["aggregate-threshold"] = self.aggregate_threshold_
+        if hasattr(self, "feature_thresholds_per_fold_"):
+            metadata["feature-thresholds-per-fold"] = (
+                self.feature_thresholds_per_fold_.to_dict()
+            )
+        if hasattr(self, "aggregate_thresholds_per_fold_"):
+            metadata["aggregate-thresholds-per-fold"] = (
+                self.aggregate_thresholds_per_fold_
+            )
+        if hasattr(self, "window") and self.window is not None:
+            metadata["window"] = self.window
+        if (
+            getattr(self, "smooth_feature_thresholds_", None) is not None
+        ):
+            metadata["smooth-feature-thresholds"] = (
+                self.smooth_feature_thresholds_.tolist()
+            )
+        if getattr(self, "smooth_aggregate_threshold_", None) is not None:
+            metadata["smooth-aggregate-threshold"] = self.smooth_aggregate_threshold_
+        if hasattr(self, "smooth_feature_thresholds_per_fold_"):
+            metadata["smooth-feature-thresholds-per-fold"] = (
+                self.smooth_feature_thresholds_per_fold_.to_dict()
+            )
+        if hasattr(self, "smooth_aggregate_thresholds_per_fold_"):
+            metadata["smooth-aggregate-thresholds-per-fold"] = (
+                self.smooth_aggregate_thresholds_per_fold_
+            )
+
+        if isinstance(self.base_estimator, GordoBase):
+            metadata.update(self.base_estimator.get_metadata())
+        else:
+            metadata.update(
+                {"scaler": str(self.scaler), "base_estimator": str(self.base_estimator)}
+            )
+        return metadata
+
+    def score(self, X, y, sample_weight=None):
+        return self.base_estimator.score(X, y)
+
+    def fit(self, X, y):
+        self.base_estimator.fit(X, y)
+        self.scaler.fit(y)  # used for error scaling in .anomaly()
+        return self
+
+    def cross_validate(
+        self,
+        *,
+        X: Union[pd.DataFrame, np.ndarray],
+        y: Union[pd.DataFrame, np.ndarray],
+        cv=None,
+        **kwargs,
+    ):
+        """
+        Run sklearn cross-validation, deriving anomaly thresholds from the
+        per-fold models (reference: diff.py:134-224). Returns the raw
+        ``cross_validate`` output.
+        """
+        if cv is None:
+            cv = TimeSeriesSplit(n_splits=3)
+        kwargs.update(dict(return_estimator=True, cv=cv))
+
+        cv_output = cross_validate(self, X=X, y=y, **kwargs)
+
+        self.feature_thresholds_per_fold_ = pd.DataFrame()
+        self.aggregate_thresholds_per_fold_ = {}
+        self.smooth_feature_thresholds_per_fold_ = pd.DataFrame()
+        self.smooth_aggregate_thresholds_per_fold_ = {}
+        smooth_aggregate_threshold_fold = None
+        smooth_tag_thresholds_fold = None
+        tag_thresholds_fold = None
+        aggregate_threshold_fold = None
+
+        for i, ((_, test_idxs), split_model) in enumerate(
+            zip(cv.split(X, y), cv_output["estimator"])
+        ):
+            y_pred = split_model.predict(
+                X.iloc[test_idxs] if isinstance(X, pd.DataFrame) else X[test_idxs]
+            )
+            # account for any model output offset (windowed models)
+            test_idxs = test_idxs[-len(y_pred):]
+            y_true = y.iloc[test_idxs] if isinstance(y, pd.DataFrame) else y[test_idxs]
+
+            scaled_mse = self._scaled_mse_per_timestep(split_model, y_true, y_pred)
+            mae = pd.DataFrame(np.abs(np.asarray(y_pred) - np.asarray(y_true)))
+
+            aggregate_threshold_fold = scaled_mse.rolling(6).min().max()
+            self.aggregate_thresholds_per_fold_[f"fold-{i}"] = aggregate_threshold_fold
+
+            tag_thresholds_fold = mae.rolling(6).min().max()
+            tag_thresholds_fold.name = f"fold-{i}"
+            self.feature_thresholds_per_fold_ = pd.concat(
+                [self.feature_thresholds_per_fold_, tag_thresholds_fold.to_frame().T]
+            )
+
+            if self.window is not None:
+                smooth_aggregate_threshold_fold = (
+                    scaled_mse.rolling(self.window).min().max()
+                )
+                self.smooth_aggregate_thresholds_per_fold_[f"fold-{i}"] = (
+                    smooth_aggregate_threshold_fold
+                )
+                smooth_tag_thresholds_fold = mae.rolling(self.window).min().max()
+                smooth_tag_thresholds_fold.name = f"fold-{i}"
+                self.smooth_feature_thresholds_per_fold_ = pd.concat(
+                    [
+                        self.smooth_feature_thresholds_per_fold_,
+                        smooth_tag_thresholds_fold.to_frame().T,
+                    ]
+                )
+
+        # final thresholds = last fold's (reference: diff.py:214-222)
+        self.feature_thresholds_ = tag_thresholds_fold
+        self.aggregate_threshold_ = aggregate_threshold_fold
+        self.smooth_aggregate_threshold_ = smooth_aggregate_threshold_fold
+        self.smooth_feature_thresholds_ = smooth_tag_thresholds_fold
+        return cv_output
+
+    @staticmethod
+    def _scaled_mse_per_timestep(model, y_true, y_pred) -> pd.Series:
+        scaled_y_true = model.scaler.transform(y_true)
+        scaled_y_pred = model.scaler.transform(
+            np.asarray(y_pred)
+            if not isinstance(y_pred, pd.DataFrame)
+            else y_pred
+        )
+        mse = ((np.asarray(scaled_y_pred) - np.asarray(scaled_y_true)) ** 2).mean(axis=1)
+        return pd.Series(mse)
+
+    def anomaly(
+        self, X: pd.DataFrame, y: pd.DataFrame, frequency: Optional[timedelta] = None
+    ) -> pd.DataFrame:
+        """
+        Full anomaly frame for (X, y) (reference: diff.py:252-405).
+        """
+        model_output = (
+            self.predict(X) if hasattr(self, "predict") else self.transform(X)
+        )
+
+        data = model_utils.make_base_dataframe(
+            tags=X.columns,
+            model_input=getattr(X, "values", X),
+            model_output=model_output,
+            target_tag_list=y.columns,
+            index=getattr(X, "index", None),
+            frequency=frequency,
+        )
+
+        model_out_scaled = pd.DataFrame(
+            self.scaler.transform(data["model-output"]),
+            columns=data["model-output"].columns,
+            index=data.index,
+        )
+
+        # scaled per-tag anomaly, y offset to match (possibly shorter) output
+        scaled_y = self.scaler.transform(y)
+        tag_anomaly_scaled = np.abs(model_out_scaled - scaled_y[-len(data):, :])
+        tag_anomaly_scaled.columns = pd.MultiIndex.from_product(
+            (("tag-anomaly-scaled",), tag_anomaly_scaled.columns)
+        )
+        data = data.join(tag_anomaly_scaled)
+        data["total-anomaly-scaled"] = np.square(data["tag-anomaly-scaled"]).mean(axis=1)
+
+        unscaled_abs_diff = pd.DataFrame(
+            data=np.abs(
+                data["model-output"].to_numpy() - y.to_numpy()[-len(data):, :]
+            ),
+            index=data.index,
+            columns=pd.MultiIndex.from_product(
+                (("tag-anomaly-unscaled",), list(y.columns))
+            ),
+        )
+        data = data.join(unscaled_abs_diff)
+        data["total-anomaly-unscaled"] = np.square(data["tag-anomaly-unscaled"]).mean(
+            axis=1
+        )
+
+        if self.window is not None:
+            smooth_tag = tag_anomaly_scaled.rolling(self.window).median()
+            smooth_tag.columns = smooth_tag.columns.set_levels(
+                ["smooth-tag-anomaly-scaled"], level=0
+            )
+            data = data.join(smooth_tag)
+            data["smooth-total-anomaly-scaled"] = (
+                data["total-anomaly-scaled"].rolling(self.window).median()
+            )
+            smooth_unscaled = unscaled_abs_diff.rolling(self.window).median()
+            smooth_unscaled.columns = smooth_unscaled.columns.set_levels(
+                ["smooth-tag-anomaly-unscaled"], level=0
+            )
+            data = data.join(smooth_unscaled)
+            data["smooth-total-anomaly-unscaled"] = (
+                data["total-anomaly-unscaled"].rolling(self.window).median()
+            )
+
+        # anomaly confidence = anomaly / threshold
+        confidence, index = None, None
+        if getattr(self, "smooth_feature_thresholds_", None) is not None:
+            confidence = (
+                data["smooth-tag-anomaly-scaled"].to_numpy()
+                / self.smooth_feature_thresholds_.to_numpy()
+            )
+            index = data["smooth-tag-anomaly-scaled"].index
+        elif hasattr(self, "feature_thresholds_"):
+            confidence = tag_anomaly_scaled.values / self.feature_thresholds_.values
+            index = tag_anomaly_scaled.index
+
+        if confidence is not None and index is not None:
+            anomaly_confidence_scores = pd.DataFrame(
+                confidence,
+                index=index,
+                columns=pd.MultiIndex.from_product(
+                    (("anomaly-confidence",), data["model-output"].columns)
+                ),
+            )
+            data = data.join(anomaly_confidence_scores)
+
+        total_anomaly_confidence = None
+        if getattr(self, "smooth_aggregate_threshold_", None) is not None:
+            total_anomaly_confidence = (
+                data["smooth-total-anomaly-scaled"] / self.smooth_aggregate_threshold_
+            )
+        elif hasattr(self, "aggregate_threshold_"):
+            total_anomaly_confidence = (
+                data["total-anomaly-scaled"] / self.aggregate_threshold_
+            )
+        if total_anomaly_confidence is not None:
+            data["total-anomaly-confidence"] = total_anomaly_confidence
+
+        if self.require_thresholds and not any(
+            hasattr(self, attr)
+            for attr in ("feature_thresholds_", "aggregate_threshold_")
+        ):
+            raise AttributeError(
+                f"`require_thresholds={self.require_thresholds}` however "
+                "`.cross_validate` needs to be called in order to calculate "
+                "these thresholds before calling `.anomaly`"
+            )
+
+        return data

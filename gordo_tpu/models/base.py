@@ -1,0 +1,28 @@
+"""
+GordoBase ABC (reference parity: gordo/machine/model/base.py:10-36).
+"""
+
+import abc
+from typing import Optional, Union
+
+import numpy as np
+import pandas as pd
+
+
+class GordoBase(abc.ABC):
+    @abc.abstractmethod
+    def get_params(self, deep=False):
+        """Return model parameters."""
+
+    @abc.abstractmethod
+    def score(
+        self,
+        X: Union[np.ndarray, pd.DataFrame],
+        y: Union[np.ndarray, pd.DataFrame],
+        sample_weight: Optional[np.ndarray] = None,
+    ):
+        """Score the model; should return higher-is-better."""
+
+    @abc.abstractmethod
+    def get_metadata(self):
+        """Get model metadata (history, thresholds, ...)."""

@@ -1,0 +1,321 @@
+"""
+The sklearn-API <-> JAX bridge: BaseJaxEstimator.
+
+Reference parity: gordo/machine/model/models.py:35-291 (KerasBaseEstimator) —
+same contract (``kind``-selected factory, sklearn fit/predict/score/
+get_params, from_definition/into_definition hooks, pickling, history
+metadata) with the engine swapped for Flax + optax under ``jax.jit``:
+
+- training runs as one jitted epoch program: in-jit shuffle
+  (``jax.random.permutation``), ``lax.scan`` over fixed-size minibatches,
+  masked loss for the ragged tail — static shapes, no recompilation between
+  epochs, data stays device-resident for the whole fit;
+- sequence models window via device-side gathers (gordo_tpu.ops.windowing)
+  instead of Keras TimeseriesGenerator;
+- pickling host-materializes the param pytree (``jax.device_get``) the way
+  the reference round-trips Keras weights through in-memory HDF5
+  (models.py:158-185).
+"""
+
+import logging
+import math
+from copy import copy
+from typing import Any, Callable, Dict, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pandas as pd
+from sklearn.base import BaseEstimator
+from sklearn.exceptions import NotFittedError
+from sklearn.metrics import explained_variance_score
+
+from gordo_tpu.models.base import GordoBase
+from gordo_tpu.models.register import register_model_builder
+from gordo_tpu.models.specs import ModelSpec, per_sample_loss
+
+logger = logging.getLogger(__name__)
+
+# attributes never pickled (compiled/jitted/device state)
+_EPHEMERAL_ATTRS = ("_apply_fn", "_train_epoch_fn", "_device_params")
+
+
+class BaseJaxEstimator(GordoBase, BaseEstimator):
+
+    supported_fit_args = [
+        "batch_size",
+        "epochs",
+        "verbose",
+        "callbacks",
+        "validation_split",
+        "shuffle",
+        "class_weight",
+        "initial_epoch",
+        "steps_per_epoch",
+        "validation_batch_size",
+        "max_queue_size",
+        "workers",
+        "use_multiprocessing",
+    ]
+
+    # window geometry defaults; sequence subclasses override
+    lookback_window: int = 1
+
+    @property
+    def lookahead(self) -> int:
+        return 0
+
+    @property
+    def _windowed(self) -> bool:
+        return False
+
+    def __init__(self, kind: Union[str, Callable], **kwargs) -> None:
+        self.kind = self.load_kind(kind)
+        self.kwargs = kwargs
+
+    # -- registry / serializer protocol ----------------------------------
+    @property
+    def registry_type(self) -> str:
+        return self.__class__.__name__
+
+    def load_kind(self, kind):
+        if callable(kind):
+            register_model_builder(type=self.registry_type)(kind)
+            return kind.__name__
+        if kind not in register_model_builder.factories.get(self.registry_type, {}):
+            raise ValueError(
+                f"kind: {kind} is not an available model for type: "
+                f"{self.registry_type}!"
+            )
+        return kind
+
+    @classmethod
+    def from_definition(cls, definition: dict):
+        definition = copy(definition)
+        kind = definition.pop("kind")
+        return cls(kind, **definition)
+
+    def into_definition(self) -> dict:
+        definition = copy(self.kwargs)
+        definition["kind"] = self.kind
+        return {f"{type(self).__module__}.{type(self).__name__}": definition}
+
+    @classmethod
+    def extract_supported_fit_args(cls, kwargs):
+        return {k: kwargs[k] for k in cls.supported_fit_args if k in kwargs}
+
+    def get_params(self, deep=False):
+        params = {"kind": self.kind}
+        params.update(self.kwargs)
+        return params
+
+    def set_params(self, **params):
+        if "kind" in params:
+            self.kind = self.load_kind(params.pop("kind"))
+        self.kwargs.update(params)
+        return self
+
+    # -- spec / factory ---------------------------------------------------
+    def _build_spec(self) -> ModelSpec:
+        build_fn = register_model_builder.factories[self.registry_type][self.kind]
+        factory_kwargs = {
+            k: v for k, v in self.kwargs.items() if k not in self.supported_fit_args
+        }
+        spec = build_fn(**factory_kwargs)
+        if not isinstance(spec, ModelSpec):
+            raise TypeError(
+                f"Factory {self.kind!r} returned {type(spec)}, expected ModelSpec"
+            )
+        return spec
+
+    # -- fit --------------------------------------------------------------
+    def fit(self, X: np.ndarray, y: np.ndarray, **kwargs):
+        X = X.values if hasattr(X, "values") else np.asarray(X)
+        y = y.values if hasattr(y, "values") else np.asarray(y)
+        if X.ndim == 1:
+            X = X.reshape(-1, 1)
+        if y.ndim == 1:
+            y = y.reshape(-1, 1)
+
+        self.kwargs.update({"n_features": X.shape[-1], "n_features_out": y.shape[-1]})
+
+        fit_args = dict(self.extract_supported_fit_args(self.kwargs))
+        fit_args.update(kwargs)
+        epochs = int(fit_args.get("epochs", 1))
+        batch_size = int(fit_args.get("batch_size", 32))
+        shuffle = bool(fit_args.get("shuffle", not self._windowed))
+        seed = int(self.kwargs.get("seed", 0))
+
+        spec = self._build_spec()
+        self.spec_ = spec
+
+        lb = spec.lookback_window if spec.windowed else 1
+        la = self.lookahead if spec.windowed else 0
+        n = len(X)
+        n_samples = n - lb + 1 - la if spec.windowed else n
+        if n_samples <= 0:
+            raise ValueError(
+                f"Not enough samples ({n}) for lookback_window={lb}, lookahead={la}"
+            )
+
+        Xd = jnp.asarray(X, dtype=jnp.float32)
+        yd = jnp.asarray(y, dtype=jnp.float32)
+
+        key = jax.random.PRNGKey(seed)
+        key, init_key = jax.random.split(key)
+        if spec.windowed:
+            example = Xd[:1][:, None, :].repeat(lb, axis=1)  # (1, lb, f)
+        else:
+            example = Xd[:1]
+        params = spec.module.init(init_key, example)
+
+        optimizer = spec.make_optimizer()
+        opt_state = optimizer.init(params)
+
+        n_batches = max(1, math.ceil(n_samples / batch_size))
+        n_pad = n_batches * batch_size
+        sample_ids = np.zeros(n_pad, dtype=np.int32)
+        sample_ids[:n_samples] = np.arange(n_samples, dtype=np.int32)
+        weights = np.zeros(n_pad, dtype=np.float32)
+        weights[:n_samples] = 1.0
+        ids_d = jnp.asarray(sample_ids)
+        w_d = jnp.asarray(weights)
+
+        windowed = spec.windowed
+        loss_name = spec.loss
+        module = spec.module
+
+        def gather_batch(Xfull, yfull, sel):
+            if windowed:
+                rows = sel[:, None] + jnp.arange(lb, dtype=jnp.int32)[None, :]
+                xb = Xfull[rows]  # (batch, lb, f)
+            else:
+                xb = Xfull[sel]
+            yb = yfull[sel + (lb - 1 + la)] if windowed else yfull[sel]
+            return xb, yb
+
+        def loss_fn(p, xb, yb, wb, dropout_key):
+            out, penalty = module.apply(
+                p, xb, deterministic=False, rngs={"dropout": dropout_key}
+            )
+            per = per_sample_loss(loss_name, out, yb)
+            total_w = jnp.maximum(jnp.sum(wb), 1.0)
+            return jnp.sum(per * wb) / total_w + penalty, jnp.sum(per * wb)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        # NB: gather from function args, not closures, so jit doesn't embed
+        # the dataset as a compile-time constant.
+        def train_epoch(p, o, epoch_key, Xfull, yfull, ids, w):
+            if shuffle:
+                perm = jax.random.permutation(epoch_key, n_pad)
+                sel_all = ids[perm].reshape(n_batches, batch_size)
+                w_all = w[perm].reshape(n_batches, batch_size)
+            else:
+                sel_all = ids.reshape(n_batches, batch_size)
+                w_all = w.reshape(n_batches, batch_size)
+
+            def step(carry, batch):
+                pp, oo = carry
+                sel, wb, step_idx = batch
+                xb, yb = gather_batch(Xfull, yfull, sel)
+                dropout_key = jax.random.fold_in(epoch_key, step_idx)
+                (_, loss_sum), grads = grad_fn(pp, xb, yb, wb, dropout_key)
+                updates, oo = optimizer.update(grads, oo, pp)
+                pp = optax.apply_updates(pp, updates)
+                return (pp, oo), loss_sum
+
+            step_ids = jnp.arange(n_batches, dtype=jnp.int32)
+            (p, o), loss_sums = jax.lax.scan(step, (p, o), (sel_all, w_all, step_ids))
+            epoch_loss = jnp.sum(loss_sums) / n_samples
+            return p, o, epoch_loss
+
+        train_epoch_jit = jax.jit(train_epoch, donate_argnums=(0, 1))
+
+        losses = []
+        for _ in range(epochs):
+            key, epoch_key = jax.random.split(key)
+            params, opt_state, epoch_loss = train_epoch_jit(
+                params, opt_state, epoch_key, Xd, yd, ids_d, w_d
+            )
+            losses.append(float(epoch_loss))
+
+        self.params_ = params
+        self.history_ = {
+            "loss": losses,
+            "params": {
+                "epochs": epochs,
+                "steps": n_batches,
+                "batch_size": batch_size,
+                "samples": n_samples,
+                "metrics": ["loss"],
+            },
+        }
+        self.n_features_ = X.shape[-1]
+        self.n_features_out_ = y.shape[-1]
+        self._apply_fn = None  # rebuilt lazily
+        return self
+
+    # -- predict ----------------------------------------------------------
+    def _ensure_apply_fn(self):
+        if not hasattr(self, "params_"):
+            raise NotFittedError(
+                f"This {self.__class__.__name__} has not been fitted yet."
+            )
+        if getattr(self, "_apply_fn", None) is None:
+            module = self.spec_.module
+            self._apply_fn = jax.jit(lambda p, x: module.apply(p, x)[0])
+            self._device_params = jax.device_put(self.params_)
+        return self._apply_fn
+
+    def _forward(self, X: np.ndarray, batch_size: int = 10000) -> np.ndarray:
+        """Apply the model to prepared model-inputs (already windowed if needed)."""
+        apply_fn = self._ensure_apply_fn()
+        params = getattr(self, "_device_params", self.params_)
+        if len(X) == 0:
+            n_out = getattr(self, "n_features_out_", 0)
+            return np.empty((0, n_out), dtype=np.float32)
+        outs = []
+        for start in range(0, len(X), batch_size):
+            xb = jnp.asarray(X[start : start + batch_size], dtype=jnp.float32)
+            outs.append(np.asarray(apply_fn(params, xb)))
+        return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
+
+    def predict(self, X: np.ndarray, **kwargs) -> np.ndarray:
+        X = X.values if hasattr(X, "values") else np.asarray(X)
+        if X.ndim == 1:
+            X = X.reshape(-1, 1)
+        return self._forward(X)
+
+    def score(
+        self,
+        X: Union[np.ndarray, pd.DataFrame],
+        y: Union[np.ndarray, pd.DataFrame],
+        sample_weight: Optional[np.ndarray] = None,
+    ) -> float:
+        out = self.predict(X)
+        yv = y.values if hasattr(y, "values") else np.asarray(y)
+        return explained_variance_score(yv[-len(out):], out)
+
+    # -- metadata / persistence ------------------------------------------
+    def get_metadata(self):
+        if hasattr(self, "history_"):
+            history = dict(self.history_)
+            return {"history": history}
+        return {}
+
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        for attr in _EPHEMERAL_ATTRS:
+            state.pop(attr, None)
+        if "params_" in state:
+            state["params_"] = jax.device_get(state["params_"])
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__ = state
+        return self
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(kind={self.kind!r})"

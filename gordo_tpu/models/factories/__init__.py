@@ -1,0 +1,11 @@
+"""
+Model architecture factories, registered by kind under each model type.
+"""
+
+from .feedforward import (  # noqa: F401
+    feedforward_hourglass,
+    feedforward_model,
+    feedforward_symmetric,
+)
+from .lstm import lstm_hourglass, lstm_model, lstm_symmetric  # noqa: F401
+from .utils import check_dim_func_len, hourglass_calc_dims  # noqa: F401

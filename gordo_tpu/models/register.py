@@ -1,0 +1,58 @@
+"""
+Factory registry: decorator registering model-architecture builders under a
+model type (reference parity: gordo/machine/model/register.py:10-75).
+
+A registered builder takes ``n_features`` (plus kwargs) and returns a
+:class:`gordo_tpu.models.specs.ModelSpec`. Legacy type names used in
+reference configs ("KerasAutoEncoder", ...) alias onto the new type names so
+``kind`` lookup works for both.
+"""
+
+import inspect
+from typing import Any, Callable, Dict
+
+# legacy reference type name -> gordo_tpu type name
+TYPE_ALIASES = {
+    "KerasAutoEncoder": "AutoEncoder",
+    "KerasLSTMAutoEncoder": "LSTMAutoEncoder",
+    "KerasLSTMForecast": "LSTMForecast",
+    "KerasRawModelRegressor": "RawModelRegressor",
+}
+
+
+def canonical_type(type_name: str) -> str:
+    return TYPE_ALIASES.get(type_name, type_name)
+
+
+class register_model_builder:
+    """
+    Decorator::
+
+        @register_model_builder(type="AutoEncoder")
+        def my_architecture(n_features: int, **kwargs) -> ModelSpec: ...
+
+    making ``AutoEncoder(kind="my_architecture")`` resolvable from configs.
+    """
+
+    factories: Dict[str, Dict[str, Callable[..., Any]]] = dict()
+
+    def __init__(self, type: str):
+        self.type = canonical_type(type)
+
+    def __call__(self, build_fn: Callable[..., Any]):
+        self._register(self.type, build_fn)
+        return build_fn
+
+    @classmethod
+    def _register(cls, type: str, build_fn: Callable[..., Any]):
+        cls._validate_func(build_fn)
+        cls.factories.setdefault(type, dict())[build_fn.__name__] = build_fn
+
+    @staticmethod
+    def _validate_func(func):
+        params = inspect.signature(func).parameters
+        if "n_features" not in params:
+            raise ValueError(
+                f"Build function: {func.__name__} does not have "
+                "'n_features' as an argument; it should."
+            )

@@ -1,0 +1,216 @@
+"""
+Model architecture specs: what a factory returns and the Flax modules
+implementing the reference's network shapes.
+
+Where the reference's factories return *compiled Keras models*
+(gordo/machine/model/factories/*.py), ours return a :class:`ModelSpec` —
+a Flax module plus optimizer/loss config — which the estimator compiles
+under ``jax.jit``. Modules return ``(output, activity_penalty)`` so l1
+activity regularization (reference: feedforward_autoencoder.py:82) folds
+into the jitted loss without Keras-style layer-attached losses.
+
+TPU notes: Dense/LSTM matmuls run through the MXU; ``dtype="bfloat16"``
+switches compute (not params) to bf16, the MXU-native format. Params stay
+float32 for stable optimizer math.
+"""
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+import optax
+
+from gordo_tpu.ops.activations import resolve_activation
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+    "float64": jnp.float64,
+}
+
+
+def resolve_dtype(dtype) -> Any:
+    if dtype is None:
+        return jnp.float32
+    if isinstance(dtype, str):
+        try:
+            return _DTYPES[dtype]
+        except KeyError:
+            raise ValueError(f"Unknown dtype {dtype!r}") from None
+    return dtype
+
+
+_OPTIMIZERS: Dict[str, Callable[..., optax.GradientTransformation]] = {
+    "adam": optax.adam,
+    "adamw": optax.adamw,
+    "sgd": optax.sgd,
+    "rmsprop": optax.rmsprop,
+    "adagrad": optax.adagrad,
+    "adadelta": optax.adadelta,
+    "adamax": optax.adamax,
+    "nadam": optax.nadam,
+    "lamb": optax.lamb,
+    "lion": optax.lion,
+}
+
+# Keras optimizer-kwarg spellings -> optax spellings
+_OPT_KWARG_ALIASES = {"lr": "learning_rate", "decay": "weight_decay"}
+
+
+def make_optimizer(
+    name: str, optimizer_kwargs: Optional[Dict[str, Any]] = None
+) -> optax.GradientTransformation:
+    """Build an optax optimizer from a Keras-style name + kwargs."""
+    kwargs = dict(optimizer_kwargs or {})
+    for old, new in _OPT_KWARG_ALIASES.items():
+        if old in kwargs:
+            kwargs[new] = kwargs.pop(old)
+    kwargs.setdefault("learning_rate", 1e-3)
+    try:
+        ctor = _OPTIMIZERS[name.lower()]
+    except KeyError:
+        raise ValueError(
+            f"Unknown optimizer {name!r}; available: {sorted(_OPTIMIZERS)}"
+        ) from None
+    return ctor(**kwargs)
+
+
+_LOSSES = {
+    "mse": lambda err: err ** 2,
+    "mean_squared_error": lambda err: err ** 2,
+    "mae": lambda err: jnp.abs(err),
+    "mean_absolute_error": lambda err: jnp.abs(err),
+    "huber": lambda err: optax.losses.huber_loss(err, jnp.zeros_like(err)),
+}
+
+
+def per_sample_loss(loss: str, y_pred: jnp.ndarray, y_true: jnp.ndarray) -> jnp.ndarray:
+    """(batch, features) prediction error -> (batch,) per-sample loss."""
+    try:
+        elementwise = _LOSSES[loss]
+    except KeyError:
+        raise ValueError(f"Unknown loss {loss!r}; available: {sorted(_LOSSES)}") from None
+    return jnp.mean(elementwise(y_pred - y_true), axis=-1)
+
+
+@dataclasses.dataclass
+class ModelSpec:
+    """What a factory returns: architecture + training configuration."""
+
+    module: nn.Module
+    optimizer: str = "Adam"
+    optimizer_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    loss: str = "mse"
+    # sequence-model window geometry; windowed=False means samples are rows
+    windowed: bool = False
+    lookback_window: int = 1
+
+    def make_optimizer(self) -> optax.GradientTransformation:
+        return make_optimizer(self.optimizer, self.optimizer_kwargs)
+
+
+class FeedForwardNet(nn.Module):
+    """
+    Dense encoder/decoder stack (reference shape:
+    factories/feedforward_autoencoder.py:16-104). ``l1_flags[i]`` marks layers
+    whose *activations* incur an l1 penalty — the reference applies it to all
+    encoder layers except the first.
+    """
+
+    layer_dims: Tuple[int, ...]
+    layer_funcs: Tuple[str, ...]
+    l1_flags: Tuple[bool, ...]
+    out_dim: int
+    out_func: str = "linear"
+    l1: float = 1e-4
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        penalty = jnp.asarray(0.0, dtype=jnp.float32)
+        for dim, func, l1_flag in zip(self.layer_dims, self.layer_funcs, self.l1_flags):
+            x = nn.Dense(dim, dtype=self.dtype)(x)
+            x = resolve_activation(func)(x)
+            if l1_flag:
+                penalty = penalty + self.l1 * jnp.sum(
+                    jnp.abs(x.astype(jnp.float32))
+                ) / x.shape[0]
+        x = nn.Dense(self.out_dim, dtype=self.dtype)(x)
+        return resolve_activation(self.out_func)(x).astype(jnp.float32), penalty
+
+
+class LSTMNet(nn.Module):
+    """
+    Stacked LSTM -> Dense head (reference shape:
+    factories/lstm_autoencoder.py:17-103): every LSTM layer emits its full
+    sequence to the next; the Dense head reads the final layer's last
+    timestep — identical math to Keras' return_sequences=False on the last
+    recurrent layer.
+    """
+
+    layer_dims: Tuple[int, ...]
+    layer_funcs: Tuple[str, ...]
+    out_dim: int
+    out_func: str = "linear"
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):  # x: (batch, time, features)
+        for dim, func in zip(self.layer_dims, self.layer_funcs):
+            cell = nn.OptimizedLSTMCell(
+                dim,
+                activation_fn=resolve_activation(func),
+                dtype=self.dtype,
+            )
+            x = nn.RNN(cell)(x)
+        x = x[:, -1, :]
+        x = nn.Dense(self.out_dim, dtype=self.dtype)(x)
+        return resolve_activation(self.out_func)(x).astype(jnp.float32), jnp.asarray(
+            0.0, dtype=jnp.float32
+        )
+
+
+class SequentialNet(nn.Module):
+    """
+    Generic layer stack built from a raw layer-spec list — backing for
+    RawModelRegressor (reference: models.py:332-388). Each entry:
+    ``("dense", {units, activation})``, ``("lstm", {units, activation})``,
+    ``("dropout", {rate})`` or ``("activation", {activation})``.
+    """
+
+    layers: Tuple[Tuple[str, Tuple[Tuple[str, Any], ...]], ...]
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, deterministic: bool = True):
+        seen_recurrent = False
+        for kind, frozen_kwargs in self.layers:
+            kwargs = dict(frozen_kwargs)
+            if kind == "dense":
+                if x.ndim == 3 and not seen_recurrent:
+                    pass  # dense over last axis of sequences is fine
+                x = nn.Dense(int(kwargs["units"]), dtype=self.dtype)(x)
+                x = resolve_activation(kwargs.get("activation", "linear"))(x)
+            elif kind == "lstm":
+                seen_recurrent = True
+                cell = nn.OptimizedLSTMCell(
+                    int(kwargs["units"]),
+                    activation_fn=resolve_activation(kwargs.get("activation", "tanh")),
+                    dtype=self.dtype,
+                )
+                x = nn.RNN(cell)(x)
+                if not kwargs.get("return_sequences", False):
+                    x = x[:, -1, :]
+            elif kind == "dropout":
+                x = nn.Dropout(rate=float(kwargs.get("rate", 0.5)))(
+                    x, deterministic=deterministic
+                )
+            elif kind == "activation":
+                x = resolve_activation(kwargs.get("activation", "linear"))(x)
+            elif kind == "flatten":
+                x = x.reshape((x.shape[0], -1))
+            else:
+                raise ValueError(f"Unknown raw layer type {kind!r}")
+        return x.astype(jnp.float32), jnp.asarray(0.0, dtype=jnp.float32)

@@ -1,0 +1,1 @@
+from . import general  # noqa: F401

@@ -1,0 +1,15 @@
+"""
+Functions usable with ``sklearn.preprocessing.FunctionTransformer`` in YAML
+configs (reference parity: gordo/machine/model/transformer_funcs/general.py).
+
+Example definition::
+
+    sklearn.preprocessing.FunctionTransformer:
+      func: gordo_tpu.models.transformer_funcs.general.multiply_by
+      kw_args: {factor: 2}
+"""
+
+
+def multiply_by(X, factor):
+    """Multiply the input by a constant factor."""
+    return X * factor

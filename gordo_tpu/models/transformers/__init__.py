@@ -1,0 +1,3 @@
+from .imputer import InfImputer
+
+__all__ = ["InfImputer"]

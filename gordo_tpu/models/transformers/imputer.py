@@ -1,0 +1,58 @@
+"""
+InfImputer: replace +/-inf values (reference parity:
+gordo/machine/model/transformers/imputer.py:12-123).
+"""
+
+from typing import Optional
+
+import numpy as np
+import pandas as pd
+from sklearn.base import BaseEstimator, TransformerMixin
+
+
+class InfImputer(BaseEstimator, TransformerMixin):
+    def __init__(
+        self,
+        inf_fill_value: Optional[float] = None,
+        neg_inf_fill_value: Optional[float] = None,
+        strategy: str = "minmax",
+        delta: float = 2.0,
+    ):
+        """
+        Fill +inf with per-feature max + ``delta`` (or dtype max) and -inf
+        with per-feature min - ``delta`` (or dtype min).
+
+        strategy: "minmax" uses observed per-feature extremes +/- delta;
+        "extremes" uses the dtype's extremes.
+        """
+        self.inf_fill_value = inf_fill_value
+        self.neg_inf_fill_value = neg_inf_fill_value
+        self.strategy = strategy
+        self.delta = delta
+
+    def fit(self, X, y=None):
+        X = X.values if isinstance(X, pd.DataFrame) else np.asarray(X)
+        if self.strategy == "extremes":
+            info = np.finfo(X.dtype) if np.issubdtype(X.dtype, np.floating) else np.iinfo(X.dtype)
+            self._posinf_fill_values = np.repeat(info.max, X.shape[1])
+            self._neginf_fill_values = np.repeat(info.min, X.shape[1])
+        elif self.strategy == "minmax":
+            masked = np.ma.masked_invalid(X)
+            self._posinf_fill_values = masked.max(axis=0).filled(0) + self.delta
+            self._neginf_fill_values = masked.min(axis=0).filled(0) - self.delta
+        else:
+            raise ValueError(f"Unknown strategy: {self.strategy}")
+        return self
+
+    def transform(self, X, y=None):
+        X = X.values if isinstance(X, pd.DataFrame) else np.asarray(X)
+        X = X.copy().astype(np.float64)
+        if self.inf_fill_value is not None:
+            X[np.isposinf(X)] = self.inf_fill_value
+        if self.neg_inf_fill_value is not None:
+            X[np.isneginf(X)] = self.neg_inf_fill_value
+        for i in range(X.shape[1]):
+            col = X[:, i]
+            col[np.isposinf(col)] = self._posinf_fill_values[i]
+            col[np.isneginf(col)] = self._neginf_fill_values[i]
+        return X

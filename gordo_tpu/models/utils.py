@@ -1,0 +1,97 @@
+"""
+Model-layer helpers (reference parity: gordo/machine/model/utils.py).
+"""
+
+import functools
+import logging
+from datetime import datetime, timedelta
+from typing import List, Optional, Union
+
+import numpy as np
+import pandas as pd
+from sklearn.base import TransformerMixin
+
+from gordo_tpu.data.sensor_tag import SensorTag
+
+logger = logging.getLogger(__name__)
+
+
+def metric_wrapper(metric, scaler: Optional[TransformerMixin] = None):
+    """
+    Adapt a metric to models whose output is shorter than the target
+    (window offset), optionally scaling y/y_pred first
+    (reference: model/utils.py:18-46).
+    """
+
+    @functools.wraps(metric)
+    def _wrapper(y_true, y_pred, *args, **kwargs):
+        if scaler:
+            y_true = scaler.transform(y_true)
+            y_pred = scaler.transform(y_pred)
+        return metric(y_true[-len(y_pred):], y_pred, *args, **kwargs)
+
+    return _wrapper
+
+
+def make_base_dataframe(
+    tags: Union[List[SensorTag], List[str]],
+    model_input: np.ndarray,
+    model_output: np.ndarray,
+    target_tag_list: Optional[Union[List[SensorTag], List[str]]] = None,
+    index: Optional[np.ndarray] = None,
+    frequency: Optional[timedelta] = None,
+) -> pd.DataFrame:
+    """
+    Assemble the canonical MultiIndex output frame with top-level columns
+    ``start``/``end``/``model-input``/``model-output``, aligning input/index
+    to the (possibly shorter, offset) model output
+    (reference: model/utils.py:49-156).
+    """
+    target_tag_list = target_tag_list if target_tag_list is not None else tags
+
+    model_input = getattr(model_input, "values", model_input)[-len(model_output):, :]
+    model_output = getattr(model_output, "values", model_output)
+
+    index = (
+        index[-len(model_output):] if index is not None else range(len(model_output))
+    )
+
+    start_series = pd.Series(
+        index if isinstance(index, pd.DatetimeIndex) else [None] * len(index),
+        index=index,
+    )
+    end_series = start_series.map(
+        lambda start: (start + frequency).isoformat()
+        if isinstance(start, datetime) and frequency is not None
+        else None
+    )
+    start_series = start_series.map(
+        lambda start: start.isoformat() if hasattr(start, "isoformat") else None
+    )
+
+    columns = pd.MultiIndex.from_product((("start", "end"), ("",)))
+    data = pd.DataFrame(
+        {("start", ""): start_series, ("end", ""): end_series},
+        columns=columns,
+        index=index,
+    )
+
+    for name, values in (("model-input", model_input), ("model-output", model_output)):
+        if values is None:
+            continue
+        _tags = tags if name == "model-input" else target_tag_list
+        if values.shape[1] == len(_tags):
+            second_lvl_names = [
+                str(tag.name if isinstance(tag, SensorTag) else tag) for tag in _tags
+            ]
+        else:
+            second_lvl_names = [str(i) for i in range(values.shape[1])]
+        sub_columns = pd.MultiIndex.from_tuples(
+            (name, sub_name) for sub_name in second_lvl_names
+        )
+        other = pd.DataFrame(
+            values[-len(model_output):], columns=sub_columns, index=index
+        )
+        data = data.join(other)
+
+    return data

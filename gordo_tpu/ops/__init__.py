@@ -1,0 +1,15 @@
+"""
+Low-level JAX ops: windowing index math, activation registry, and (as the
+framework grows) Pallas kernels for the hot paths.
+"""
+
+from .windowing import num_windows, window_sample_indices, target_indices
+from .activations import ACTIVATIONS, resolve_activation
+
+__all__ = [
+    "num_windows",
+    "window_sample_indices",
+    "target_indices",
+    "ACTIVATIONS",
+    "resolve_activation",
+]

@@ -1,0 +1,43 @@
+"""
+Keras-style activation names -> jax functions, so YAML configs written with
+string activations ("tanh", "linear", ...) work unchanged.
+"""
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _linear(x):
+    return x
+
+
+ACTIVATIONS = {
+    "linear": _linear,
+    "tanh": jnp.tanh,
+    "relu": jax.nn.relu,
+    "sigmoid": jax.nn.sigmoid,
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "softplus": jax.nn.softplus,
+    "softsign": jax.nn.soft_sign,
+    "leaky_relu": jax.nn.leaky_relu,
+    "gelu": jax.nn.gelu,
+    "swish": jax.nn.swish,
+    "silu": jax.nn.silu,
+    "softmax": jax.nn.softmax,
+    "exponential": jnp.exp,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+}
+
+
+def resolve_activation(func: Union[str, Callable]) -> Callable:
+    if callable(func):
+        return func
+    try:
+        return ACTIVATIONS[func]
+    except KeyError:
+        raise ValueError(
+            f"Unknown activation {func!r}; available: {sorted(ACTIVATIONS)}"
+        ) from None

@@ -1,0 +1,56 @@
+"""
+Sliding-window index math for sequence models.
+
+Replaces the reference's Keras ``TimeseriesGenerator`` + padding construction
+(gordo/machine/model/models.py:645-726) with pure index arithmetic: windows
+are *gathers* on device, so the same (static-shape) compiled program serves
+training and inference without materializing padded copies of the data.
+
+Semantics parity with ``create_keras_timeseriesgenerator`` (verified against
+its doctest): for data of length ``n``, lookback ``lb`` and lookahead ``la``:
+
+- number of samples  = ``n - lb + 1 - la``
+- sample ``i`` sees rows ``[i, i + lb)`` of X
+- sample ``i`` targets row ``i + lb - 1 + la`` of y
+
+so ``la=0`` targets the window's last element (autoencoder), ``la=1`` targets
+one step past the window (forecast), matching the reference's pre/post-padding
+trick exactly.
+"""
+
+import numpy as np
+
+
+def num_windows(n: int, lookback_window: int, lookahead: int) -> int:
+    """Number of (window, target) samples derivable from n timesteps."""
+    if lookahead < 0:
+        raise ValueError(f"Value of `lookahead` can not be negative, is {lookahead}")
+    return n - lookback_window + 1 - lookahead
+
+
+def window_sample_indices(n: int, lookback_window: int, lookahead: int) -> np.ndarray:
+    """
+    (n_samples, lookback) int32 matrix: row i holds the X row-indices of
+    sample i's window. Use as a device gather: ``X[idx]`` -> (n_samples,
+    lookback, n_features).
+    """
+    n_samples = num_windows(n, lookback_window, lookahead)
+    if n_samples <= 0:
+        raise ValueError(
+            f"Not enough timesteps ({n}) for lookback_window={lookback_window}, "
+            f"lookahead={lookahead}"
+        )
+    starts = np.arange(n_samples, dtype=np.int32)[:, None]
+    offsets = np.arange(lookback_window, dtype=np.int32)[None, :]
+    return starts + offsets
+
+
+def target_indices(n: int, lookback_window: int, lookahead: int) -> np.ndarray:
+    """(n_samples,) int32 vector of y row-indices, aligned with the windows."""
+    n_samples = num_windows(n, lookback_window, lookahead)
+    if n_samples <= 0:
+        raise ValueError(
+            f"Not enough timesteps ({n}) for lookback_window={lookback_window}, "
+            f"lookahead={lookahead}"
+        )
+    return np.arange(n_samples, dtype=np.int32) + (lookback_window - 1 + lookahead)

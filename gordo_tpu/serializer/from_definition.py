@@ -100,12 +100,9 @@ def _is_definition_dict(value: dict) -> bool:
 
 def _instantiate(cls: type, params: Dict[str, Any]) -> Any:
     params = _prepare_params(cls, params)
-    if hasattr(cls, "from_definition") and callable(getattr(cls, "from_definition")):
-        try:
-            return cls.from_definition(params)
-        except TypeError:
-            # hooks with a (cls, config) signature vs plain classmethods
-            pass
+    hook = getattr(cls, "from_definition", None)
+    if callable(hook):
+        return hook(params)
     return cls(**params)
 
 

@@ -43,12 +43,11 @@ def _decompose_value(value: Any, prune_default_params: bool) -> Any:
     return _decompose_node(value, prune_default_params)
 
 
-def _default_params(obj: Any) -> Dict[str, Any]:
+def _default_params(cls: type) -> Dict[str, Any]:
+    import inspect
+
     try:
-        return {
-            k: v.default
-            for k, v in __import__("inspect").signature(type(obj)).parameters.items()
-        }
+        return {k: v.default for k, v in inspect.signature(cls).parameters.items()}
     except (ValueError, TypeError):
         return {}
 
@@ -61,8 +60,6 @@ def _decompose_node(step: Any, prune_default_params: bool = False) -> Dict[str, 
     """
     if hasattr(step, "into_definition") and callable(step.into_definition):
         return step.into_definition()
-
-    import inspect
 
     if not hasattr(step, "get_params"):
         raise ValueError(f"Cannot decompose object without get_params: {step!r}")
@@ -78,19 +75,19 @@ def _decompose_node(step: Any, prune_default_params: bool = False) -> Dict[str, 
                 _decompose_node(est, prune_default_params) for _, est in value
             ]
         elif key in ("transformer_list", "transformers") and isinstance(value, list):
+            # FeatureUnion entries are (name, est); ColumnTransformer entries
+            # are (name, est, columns) — preserve the column selector so the
+            # round-trip through from_definition._build_union_entry survives.
             decomposed[key] = [
-                _decompose_node(entry[1], prune_default_params) for entry in value
+                [entry[0], _decompose_node(entry[1], prune_default_params)]
+                + ([_decompose_value(entry[2], prune_default_params)] if len(entry) > 2 else [])
+                for entry in value
             ]
         else:
             decomposed[key] = _decompose_value(value, prune_default_params)
 
     if prune_default_params:
-        try:
-            defaults = {
-                k: v.default for k, v in inspect.signature(type(step)).parameters.items()
-            }
-        except (ValueError, TypeError):
-            defaults = {}
+        defaults = _default_params(type(step))
         decomposed = {
             k: v for k, v in decomposed.items() if defaults.get(k, object()) != v
         }

@@ -46,11 +46,9 @@ def normalize_frequency(freq: str) -> str:
     if not m:
         return freq
     num, alias = m.groups()
-    replacement = _LEGACY_ALIASES.get(alias.upper() if len(alias) == 1 else alias.upper())
+    if alias in ("ms", "us", "ns", "min", "h", "s"):  # already modern
+        return freq
+    replacement = _LEGACY_ALIASES.get(alias.upper())
     if replacement is None:
         return freq
-    # Only single-letter uppercase aliases (and "MIN") are legacy; a modern
-    # alias like "ms"/"min"/"h" is already fine but normalizing is harmless.
-    if alias in ("ms", "us", "ns", "min", "h", "s"):
-        return f"{num}{alias}"
     return f"{num}{replacement}"

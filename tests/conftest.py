@@ -4,17 +4,25 @@ Test configuration.
 TPU twist on the reference's fixture spine (SURVEY.md §4): XLA-on-CPU is the
 "fake backend" — tests force the CPU platform with 8 virtual devices so
 multi-chip sharding logic is exercised without TPU hardware.
+
+Note: the ambient environment pins JAX to the real TPU tunnel (axon plugin,
+which sets jax_platforms at interpreter start via sitecustomize), so setting
+JAX_PLATFORMS alone is not enough — we must override jax.config too, before
+any backend initializes.
 """
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

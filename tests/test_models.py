@@ -1,0 +1,212 @@
+"""Model layer tests (reference test model: tests/gordo/machine/model/)."""
+
+import pickle
+
+import numpy as np
+import pytest
+
+from gordo_tpu.models import (
+    AutoEncoder,
+    LSTMAutoEncoder,
+    LSTMForecast,
+    RawModelRegressor,
+)
+from gordo_tpu.models.factories.utils import hourglass_calc_dims
+from gordo_tpu.ops.windowing import num_windows, target_indices, window_sample_indices
+
+RNG = np.random.default_rng(42)
+
+
+def make_data(n=120, f=4):
+    X = RNG.random((n, f)).astype("float32")
+    return X, X.copy()
+
+
+# -- windowing index math (parity with create_keras_timeseriesgenerator) ----
+def test_window_counts_match_reference_doctest():
+    # reference models.py doctest: X of len 100, lookback 20, lookahead 0
+    # -> 81 samples (9 batches of 10 with (100-20+1))
+    assert num_windows(100, 20, 0) == 81
+    assert num_windows(100, 20, 1) == 80
+    # KerasLSTMForecast.predict doctest: len 4, lookback 2, lookahead 1 -> 2
+    assert num_windows(4, 2, 1) == 2
+
+
+def test_window_and_target_indices():
+    idx = window_sample_indices(10, 3, 0)
+    tgt = target_indices(10, 3, 0)
+    assert idx.shape == (8, 3)
+    assert list(idx[0]) == [0, 1, 2]
+    assert tgt[0] == 2  # lookahead 0 -> target = window end
+    tgt1 = target_indices(10, 3, 1)
+    assert tgt1[0] == 3  # lookahead 1 -> one past window end
+    assert len(tgt1) == 7
+
+
+def test_hourglass_dims_match_reference_doctests():
+    assert hourglass_calc_dims(0.5, 3, 10) == (8, 7, 5)
+    assert hourglass_calc_dims(0.2, 3, 10) == (7, 5, 2)
+    assert hourglass_calc_dims(0.5, 1, 10) == (5,)
+    assert hourglass_calc_dims(0.3, 3, 10) == (8, 5, 3)
+
+
+# -- feedforward autoencoder ------------------------------------------------
+@pytest.mark.parametrize(
+    "kind", ["feedforward_model", "feedforward_symmetric", "feedforward_hourglass"]
+)
+def test_autoencoder_fit_predict(kind):
+    X, y = make_data()
+    model = AutoEncoder(kind=kind, epochs=2, batch_size=16)
+    assert model.fit(X, y) is model
+    out = model.predict(X)
+    assert out.shape == X.shape
+    score = model.score(X, y)
+    assert isinstance(score, float)
+
+
+def test_autoencoder_unknown_kind():
+    with pytest.raises(ValueError):
+        AutoEncoder(kind="no_such_kind")
+
+
+def test_autoencoder_learns():
+    # training should reduce the loss on a learnable signal
+    t = np.linspace(0, 20, 400)
+    X = np.stack([np.sin(t), np.cos(t), np.sin(2 * t)], axis=1).astype("float32")
+    model = AutoEncoder(kind="feedforward_hourglass", epochs=40, batch_size=32)
+    model.fit(X, X)
+    losses = model.get_metadata()["history"]["loss"]
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_autoencoder_history_metadata():
+    X, y = make_data()
+    model = AutoEncoder(kind="feedforward_model", epochs=3)
+    model.fit(X, y)
+    meta = model.get_metadata()
+    assert len(meta["history"]["loss"]) == 3
+    assert meta["history"]["params"]["epochs"] == 3
+
+
+def test_autoencoder_pickle_roundtrip():
+    X, y = make_data()
+    model = AutoEncoder(kind="feedforward_model", epochs=1)
+    model.fit(X, y)
+    before = model.predict(X)
+    blob = pickle.dumps(model)
+    restored = pickle.loads(blob)
+    after = restored.predict(X)
+    np.testing.assert_allclose(before, after, rtol=1e-5)
+
+
+def test_autoencoder_sklearn_clone():
+    from sklearn.base import clone
+
+    model = AutoEncoder(kind="feedforward_hourglass", epochs=2, compression_factor=0.3)
+    cloned = clone(model)
+    assert cloned.kind == "feedforward_hourglass"
+    assert cloned.kwargs["compression_factor"] == 0.3
+
+
+def test_autoencoder_from_definition_hook():
+    model = AutoEncoder.from_definition(
+        {"kind": "feedforward_hourglass", "epochs": 5, "compression_factor": 0.4}
+    )
+    assert model.kind == "feedforward_hourglass"
+    assert model.kwargs["epochs"] == 5
+    definition = model.into_definition()
+    path, params = next(iter(definition.items()))
+    assert path.endswith("AutoEncoder")
+    assert params["kind"] == "feedforward_hourglass"
+
+
+# -- LSTM models ------------------------------------------------------------
+@pytest.mark.parametrize("kind", ["lstm_model", "lstm_symmetric", "lstm_hourglass"])
+def test_lstm_autoencoder_fit_predict(kind):
+    X, y = make_data(n=60, f=3)
+    model = LSTMAutoEncoder(kind=kind, lookback_window=5, epochs=1, batch_size=16)
+    model.fit(X, y)
+    out = model.predict(X)
+    # lookahead=0: n - lb + 1 rows
+    assert out.shape == (60 - 5 + 1, 3)
+
+
+def test_lstm_forecast_output_shape():
+    # parity with reference KerasLSTMForecast.predict doctest
+    X_train = np.array([[1, 1], [2, 3], [0.5, 0.6], [0.3, 1], [0.6, 0.7]], dtype="float32")
+    X_test = np.array([[2, 3], [1, 1], [0.1, 1], [0.5, 2]], dtype="float32")
+    model = LSTMForecast(kind="lstm_model", lookback_window=2, epochs=1)
+    model.fit(X_train, X_train.copy())
+    out = model.predict(X_test)
+    assert out.shape == (2, 2)
+
+
+def test_lstm_too_few_samples():
+    X = np.random.random((3, 2)).astype("float32")
+    model = LSTMAutoEncoder(kind="lstm_model", lookback_window=10)
+    with pytest.raises(ValueError):
+        model.fit(X, X)
+
+
+def test_lstm_metadata_forecast_steps():
+    X, _ = make_data(n=30, f=2)
+    model = LSTMForecast(kind="lstm_model", lookback_window=3, epochs=1)
+    model.fit(X, X)
+    assert model.get_metadata()["forecast_steps"] == 1
+
+
+def test_lstm_pickle_roundtrip():
+    X, _ = make_data(n=40, f=2)
+    model = LSTMAutoEncoder(kind="lstm_symmetric", lookback_window=4, epochs=1)
+    model.fit(X, X)
+    restored = pickle.loads(pickle.dumps(model))
+    np.testing.assert_allclose(model.predict(X), restored.predict(X), rtol=1e-5)
+
+
+# -- raw model regressor ----------------------------------------------------
+def test_raw_model_regressor():
+    config = {
+        "compile": {"loss": "mse", "optimizer": "adam"},
+        "spec": {"layers": [{"Dense": {"units": 8, "activation": "tanh"}}, {"Dense": {"units": 1}}]},
+    }
+    X = np.random.random((30, 4)).astype("float32")
+    y = np.random.random((30, 1)).astype("float32")
+    model = RawModelRegressor(kind=config, epochs=2)
+    model.fit(X, y)
+    assert model.predict(X).shape == (30, 1)
+
+
+def test_raw_model_regressor_legacy_keras_spec():
+    # reference-style spec with tensorflow.keras paths parses by class name
+    config = {
+        "compile": {"loss": "mse", "optimizer": "adam"},
+        "spec": {
+            "tensorflow.keras.models.Sequential": {
+                "layers": [
+                    {"tensorflow.keras.layers.Dense": {"units": 4}},
+                    {"tensorflow.keras.layers.Dense": {"units": 1}},
+                ]
+            }
+        },
+    }
+    X = np.random.random((10, 4)).astype("float32")
+    y = np.random.random((10, 1)).astype("float32")
+    model = RawModelRegressor(kind=config)
+    model.fit(X, y)
+    assert model.predict(X).shape == (10, 1)
+
+
+# -- serializer integration -------------------------------------------------
+def test_model_from_yaml_definition_legacy_path():
+    from gordo_tpu.serializer import from_definition
+
+    model = from_definition(
+        {
+            "gordo.machine.model.models.KerasAutoEncoder": {
+                "kind": "feedforward_hourglass",
+                "epochs": 2,
+            }
+        }
+    )
+    assert isinstance(model, AutoEncoder)
+    assert model.kwargs["epochs"] == 2
