@@ -1,0 +1,436 @@
+"""
+ModelBuilder: the train-one-Machine pipeline
+(reference parity: gordo/builder/build_model.py).
+
+data fetch -> model from definition -> cross-validation (with per-tag and
+aggregate scorers) -> full fit -> BuildMetadata assembly -> artifact dump,
+with a content-hash build cache via the disk registry.
+
+TPU notes: seeding goes through JAX's splittable PRNG discipline — the
+evaluation seed becomes the default ``jax.random.PRNGKey`` for estimator
+fits (set_seed), alongside numpy/python seeds for the sklearn edges.
+"""
+
+import hashlib
+import json
+import logging
+import os
+import random
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+from sklearn import metrics
+from sklearn.base import BaseEstimator, TransformerMixin
+from sklearn.model_selection import cross_validate
+from sklearn.pipeline import Pipeline
+
+from gordo_tpu import MAJOR_VERSION, MINOR_VERSION, __version__, serializer
+from gordo_tpu.data import _get_dataset
+from gordo_tpu.machine import Machine
+from gordo_tpu.machine.metadata import (
+    BuildMetadata,
+    CrossValidationMetaData,
+    DatasetBuildMetadata,
+    ModelBuildMetadata,
+)
+from gordo_tpu.models.base import GordoBase
+from gordo_tpu.models.utils import metric_wrapper
+from gordo_tpu.utils import disk_registry
+
+logger = logging.getLogger(__name__)
+
+
+class ModelBuilder:
+    def __init__(self, machine: Machine):
+        """
+        Build a model for a given Machine.
+
+        Example
+        -------
+        >>> from gordo_tpu.machine import Machine
+        >>> machine = Machine(
+        ...     name="special-model-name",
+        ...     model={"sklearn.decomposition.PCA": {"svd_solver": "auto"}},
+        ...     dataset={
+        ...         "type": "RandomDataset",
+        ...         "train_start_date": "2017-12-25 06:00:00Z",
+        ...         "train_end_date": "2017-12-30 06:00:00Z",
+        ...         "tag_list": [["Tag 1", None], ["Tag 2", None]],
+        ...     },
+        ...     project_name='test-proj',
+        ... )
+        >>> builder = ModelBuilder(machine=machine)
+        >>> len(builder.cache_key)
+        128
+        """
+        # copy via dict round-trip so we never mutate the caller's machine
+        self.machine = Machine(**machine.to_dict())
+        self._cached_model_path: Optional[Union[os.PathLike, str]] = None
+
+    @property
+    def cached_model_path(self) -> Union[os.PathLike, str, None]:
+        return self._cached_model_path
+
+    @cached_model_path.setter
+    def cached_model_path(self, value):
+        self._cached_model_path = value
+
+    def build(
+        self,
+        output_dir: Optional[Union[os.PathLike, str]] = None,
+        model_register_dir: Optional[Union[os.PathLike, str]] = None,
+        replace_cache: bool = False,
+    ) -> Tuple[BaseEstimator, Machine]:
+        """
+        Return (model, machine-with-build-metadata); optionally persisting to
+        ``output_dir`` and caching via ``model_register_dir``
+        (reference: build_model.py:83-158).
+        """
+        if not model_register_dir:
+            model, machine = self._build()
+        else:
+            self.cached_model_path = self.check_cache(model_register_dir)
+            if replace_cache:
+                logger.info("replace_cache=True, deleting any existing cache entry")
+                disk_registry.delete_value(model_register_dir, self.cache_key)
+                self.cached_model_path = None
+
+            machine = None
+            if self.cached_model_path:
+                metadata = serializer.load_metadata(self.cached_model_path)
+                if "metadata" in metadata:
+                    model = serializer.load(self.cached_model_path)
+                    metadata["metadata"]["user_defined"] = (
+                        self.machine.metadata.user_defined
+                    )
+                    metadata["runtime"] = self.machine.runtime
+                    machine = Machine(**metadata)
+                else:
+                    # artifact lost its metadata -> invalidate and rebuild
+                    logger.warning(
+                        "Cached artifact at %s has no metadata; rebuilding",
+                        self.cached_model_path,
+                    )
+                    disk_registry.delete_value(model_register_dir, self.cache_key)
+                    self.cached_model_path = None
+
+            if machine is None:
+                model, machine = self._build()
+                if output_dir:
+                    self.cached_model_path = self._save_model(
+                        model=model, machine=machine, output_dir=output_dir
+                    )
+                    logger.info("Built model, deposited at %s", self.cached_model_path)
+                    disk_registry.write_key(
+                        model_register_dir, self.cache_key, str(self.cached_model_path)
+                    )
+
+        if (
+            output_dir
+            and str(self.cached_model_path or "") != str(output_dir)
+            and (self.machine.evaluation.get("cv_mode") != "cross_val_only")
+        ):
+            self.cached_model_path = self._save_model(
+                model=model, machine=machine, output_dir=output_dir
+            )
+        return model, machine
+
+    def _build(self) -> Tuple[BaseEstimator, Machine]:
+        """Run the actual build (reference: build_model.py:160-303)."""
+        self.set_seed(seed=self.machine.evaluation.get("seed", 0))
+
+        dataset = _get_dataset(self.machine.dataset.to_dict())
+
+        start = time.time()
+        X, y = dataset.get_data()
+        time_elapsed_data = time.time() - start
+
+        model = serializer.from_definition(self.machine.model)
+        self._inject_seed(model, self.machine.evaluation.get("seed", 0))
+
+        cv_duration_sec = None
+        machine = Machine(
+            name=self.machine.name,
+            dataset=self.machine.dataset.to_dict(),
+            metadata=self.machine.metadata,
+            model=self.machine.model,
+            project_name=self.machine.project_name,
+            evaluation=self.machine.evaluation,
+            runtime=self.machine.runtime,
+        )
+
+        split_metadata: Dict[str, Any] = dict()
+        scores: Dict[str, Any] = dict()
+        if self.machine.evaluation["cv_mode"].lower() in (
+            "cross_val_only",
+            "full_build",
+        ):
+            metrics_list = self.metrics_from_list(
+                self.machine.evaluation.get("metrics")
+            )
+
+            if hasattr(model, "predict"):
+                start = time.time()
+                scaler = self.machine.evaluation.get("scoring_scaler")
+                metrics_dict = self.build_metrics_dict(metrics_list, y, scaler=scaler)
+
+                split_obj = serializer.from_definition(
+                    self.machine.evaluation.get(
+                        "cv",
+                        {"sklearn.model_selection.TimeSeriesSplit": {"n_splits": 3}},
+                    )
+                )
+                split_metadata = self.build_split_dict(X, split_obj)
+
+                cv_kwargs = dict(
+                    X=X, y=y, scoring=metrics_dict, return_estimator=True, cv=split_obj
+                )
+                if hasattr(model, "cross_validate"):
+                    cv = model.cross_validate(**cv_kwargs)
+                else:
+                    cv = cross_validate(model, **cv_kwargs)
+
+                for metric, test_metric in map(lambda k: (k, f"test_{k}"), metrics_dict):
+                    val = {
+                        "fold-mean": cv[test_metric].mean(),
+                        "fold-std": cv[test_metric].std(),
+                        "fold-max": cv[test_metric].max(),
+                        "fold-min": cv[test_metric].min(),
+                    }
+                    val.update(
+                        {
+                            f"fold-{i + 1}": raw_value
+                            for i, raw_value in enumerate(cv[test_metric].tolist())
+                        }
+                    )
+                    scores.update({metric: val})
+                cv_duration_sec = time.time() - start
+            else:
+                logger.debug("Unable to score model; it has no 'predict' attribute")
+
+            if self.machine.evaluation["cv_mode"] == "cross_val_only":
+                machine.metadata.build_metadata = BuildMetadata(
+                    model=ModelBuildMetadata(
+                        cross_validation=CrossValidationMetaData(
+                            cv_duration_sec=cv_duration_sec,
+                            scores=scores,
+                            splits=split_metadata,
+                        )
+                    ),
+                    dataset=DatasetBuildMetadata(
+                        query_duration_sec=time_elapsed_data,
+                        dataset_meta=dataset.get_metadata(),
+                    ),
+                )
+                return model, machine
+
+        start = time.time()
+        model.fit(X, y)
+        time_elapsed_model = time.time() - start
+
+        machine.metadata.build_metadata = BuildMetadata(
+            model=ModelBuildMetadata(
+                model_offset=self._determine_offset(model, X),
+                model_creation_date=str(datetime.now(timezone.utc).astimezone()),
+                model_builder_version=__version__,
+                model_training_duration_sec=time_elapsed_model,
+                cross_validation=CrossValidationMetaData(
+                    cv_duration_sec=cv_duration_sec,
+                    scores=scores,
+                    splits=split_metadata,
+                ),
+                model_meta=self._extract_metadata_from_model(model),
+            ),
+            dataset=DatasetBuildMetadata(
+                query_duration_sec=time_elapsed_data,
+                dataset_meta=dataset.get_metadata(),
+            ),
+        )
+        return model, machine
+
+    @staticmethod
+    def set_seed(seed: int):
+        """
+        Seed the host-side RNG domains the sklearn edges use
+        (reference seeds tf/np/random: build_model.py:305-309). JAX fits are
+        seeded explicitly per estimator via :meth:`_inject_seed` — no global
+        device-RNG state exists to set.
+        """
+        logger.info("Setting random seed: %r", seed)
+        np.random.seed(seed)
+        random.seed(seed)
+
+    @staticmethod
+    def _inject_seed(model: BaseEstimator, seed: int):
+        """
+        Give every JAX estimator in the model tree an explicit PRNG seed
+        (unless its config already pins one) — the splittable-PRNG analogue
+        of the reference's global tf seeding.
+        """
+        from gordo_tpu.models.core import BaseJaxEstimator
+
+        if isinstance(model, BaseJaxEstimator):
+            model.kwargs.setdefault("seed", seed)
+        if isinstance(model, Pipeline):
+            for _, step in model.steps:
+                ModelBuilder._inject_seed(step, seed)
+            return
+        for val in getattr(model, "__dict__", {}).values():
+            if isinstance(val, (Pipeline, BaseEstimator)):
+                ModelBuilder._inject_seed(val, seed)
+
+    @staticmethod
+    def build_split_dict(X: pd.DataFrame, split_obj) -> dict:
+        """Cross-validation train/test split metadata (reference: :310-339)."""
+        split_metadata: Dict[str, Any] = dict()
+        for i, (train_ind, test_ind) in enumerate(split_obj.split(X)):
+            split_metadata.update(
+                {
+                    f"fold-{i + 1}-train-start": X.index[train_ind[0]],
+                    f"fold-{i + 1}-train-end": X.index[train_ind[-1]],
+                    f"fold-{i + 1}-test-start": X.index[test_ind[0]],
+                    f"fold-{i + 1}-test-end": X.index[test_ind[-1]],
+                    f"fold-{i + 1}-n-train": len(train_ind),
+                    f"fold-{i + 1}-n-test": len(test_ind),
+                }
+            )
+        return split_metadata
+
+    @staticmethod
+    def build_metrics_dict(
+        metrics_list: list,
+        y: pd.DataFrame,
+        scaler: Optional[Union[TransformerMixin, str, dict]] = None,
+    ) -> dict:
+        """
+        Per-tag ('{score}-{tag}') and aggregate ('{score}') scorers for
+        sklearn cross_validate (reference: :341-411).
+        """
+        if scaler:
+            if isinstance(scaler, (str, dict)):
+                scaler = serializer.from_definition(scaler)
+            scaler.fit(y)
+
+        def _score_factory(metric_func, col_index):
+            def _score_per_tag(y_true, y_pred):
+                y_true = getattr(y_true, "values", y_true)
+                y_pred = getattr(y_pred, "values", y_pred)
+                return metric_func(y_true[:, col_index], y_pred[:, col_index])
+
+            return _score_per_tag
+
+        metrics_dict = {}
+        for metric in metrics_list:
+            metric_str = metric.__name__.replace("_", "-")
+            for index, col in enumerate(y.columns):
+                metrics_dict[
+                    f"{metric_str}-{str(col).replace(' ', '-')}"
+                ] = metrics.make_scorer(
+                    metric_wrapper(
+                        _score_factory(metric, index),
+                        scaler=scaler if scaler else None,
+                    )
+                )
+            metrics_dict[metric_str] = metrics.make_scorer(
+                metric_wrapper(metric, scaler=scaler if scaler else None)
+            )
+        return metrics_dict
+
+    @staticmethod
+    def metrics_from_list(metric_list: Optional[List[str]] = None) -> List[Callable]:
+        """Resolve metric function paths (or bare sklearn.metrics names)."""
+        from gordo_tpu.workflow.config_elements.normalized_config import (
+            NormalizedConfig,
+        )
+
+        import pydoc
+
+        defaults = NormalizedConfig.DEFAULT_CONFIG_GLOBALS["evaluation"]["metrics"]
+        funcs = []
+        for func_path in metric_list or defaults:
+            func = pydoc.locate(func_path)
+            funcs.append(func if func is not None else getattr(metrics, func_path))
+        return funcs
+
+    @staticmethod
+    def _determine_offset(model: BaseEstimator, X) -> int:
+        """len(X) - len(model output): the model's output offset."""
+        out = model.predict(X) if hasattr(model, "predict") else model.transform(X)
+        return len(X) - len(out)
+
+    @staticmethod
+    def _save_model(model, machine, output_dir):
+        os.makedirs(output_dir, exist_ok=True)
+        serializer.dump(
+            model,
+            output_dir,
+            metadata=machine.to_dict() if isinstance(machine, Machine) else machine,
+        )
+        return output_dir
+
+    @staticmethod
+    def _extract_metadata_from_model(
+        model: BaseEstimator, metadata: Optional[dict] = None
+    ) -> dict:
+        """
+        Recursively harvest GordoBase.get_metadata() from a (possibly nested)
+        estimator (reference: :468-519).
+        """
+        metadata = dict(metadata or {})
+        if isinstance(model, Pipeline):
+            metadata.update(
+                ModelBuilder._extract_metadata_from_model(model.steps[-1][1])
+            )
+            return metadata
+        if isinstance(model, GordoBase):
+            metadata.update(model.get_metadata())
+        for val in model.__dict__.values():
+            if isinstance(val, Pipeline):
+                metadata.update(
+                    ModelBuilder._extract_metadata_from_model(val.steps[-1][1])
+                )
+            elif isinstance(val, (GordoBase, BaseEstimator)):
+                metadata.update(ModelBuilder._extract_metadata_from_model(val))
+        return metadata
+
+    @property
+    def cache_key(self) -> str:
+        return self.calculate_cache_key(self.machine)
+
+    @staticmethod
+    def calculate_cache_key(machine: Machine) -> str:
+        """
+        sha3_512 over (name, model config, dataset config, evaluation config,
+        framework major.minor) (reference: :525-578).
+        """
+        json_rep = json.dumps(
+            {
+                "name": machine.name,
+                "model_config": machine.model,
+                "data_config": machine.dataset.to_dict(),
+                "evaluation_config": machine.evaluation,
+                "gordo-tpu-major-version": MAJOR_VERSION,
+                "gordo-tpu-minor-version": MINOR_VERSION,
+            },
+            sort_keys=True,
+            default=str,
+        )
+        return hashlib.sha3_512(json_rep.encode("ascii")).hexdigest()
+
+    def check_cache(
+        self, model_register_dir: Union[os.PathLike, str]
+    ) -> Optional[str]:
+        """Return the cached artifact path for this build, if present."""
+        existing = disk_registry.get_value(model_register_dir, self.cache_key)
+        if existing and Path(existing).exists():
+            logger.debug("Found existing model at %s", existing)
+            return existing
+        if existing:
+            logger.warning(
+                "Registry entry %s points at a missing path %s", self.cache_key, existing
+            )
+        return None

@@ -1,0 +1,45 @@
+"""
+Local dev build loop (reference parity: gordo/builder/local_build.py:14-71).
+"""
+
+import io
+from typing import Iterable, Tuple, Union
+
+from sklearn.base import BaseEstimator
+
+from gordo_tpu.builder.build_model import ModelBuilder
+from gordo_tpu.machine import Machine
+from gordo_tpu.workflow.config_elements.normalized_config import NormalizedConfig
+from gordo_tpu.workflow.workflow_generator import get_dict_from_yaml
+
+
+def local_build(
+    config_str: str,
+) -> Iterable[Tuple[Union[BaseEstimator, None], Machine]]:
+    """
+    Build model(s) from a raw YAML project config string — the same path a
+    deployed build takes, minus the cluster.
+
+    Example
+    -------
+    >>> config = '''
+    ... machines:
+    ...   - name: crazy-sweet-name
+    ...     dataset:
+    ...       type: RandomDataset
+    ...       tags: [TAG-1, TAG-2]
+    ...       target_tag_list: [TAG-1, TAG-2]
+    ...       train_start_date: '2019-01-01T00:00:00+00:00'
+    ...       train_end_date: '2019-03-01T00:00:00+00:00'
+    ...       asset: gra
+    ...     model:
+    ...       sklearn.decomposition.PCA: {n_components: 2}
+    ... '''
+    >>> models_n_metadata = list(local_build(config))
+    >>> len(models_n_metadata)
+    1
+    """
+    config = get_dict_from_yaml(io.StringIO(config_str))
+    normed = NormalizedConfig(config, project_name="local-build")
+    for machine in normed.machines:
+        yield ModelBuilder(machine=machine).build()

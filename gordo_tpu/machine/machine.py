@@ -1,0 +1,171 @@
+"""
+The Machine config unit (reference parity: gordo/machine/machine.py:25-202):
+a validated (name, model, dataset, runtime, evaluation, metadata) bundle —
+the atom the whole framework schedules, builds, serves, and reports on.
+"""
+
+import json
+import logging
+from datetime import datetime
+from typing import Any, Dict, Optional, Union
+
+import numpy as np
+import yaml
+
+from gordo_tpu.data.base import GordoBaseDataset
+from gordo_tpu.machine.metadata import Metadata
+from gordo_tpu.machine.validators import (
+    ValidDataset,
+    ValidMachineRuntime,
+    ValidMetadata,
+    ValidModel,
+    ValidUrlString,
+)
+from gordo_tpu.workflow.helpers import patch_dict
+
+logger = logging.getLogger(__name__)
+
+
+class Machine:
+
+    name = ValidUrlString()
+    project_name = ValidUrlString()
+    host = ValidUrlString()
+    model = ValidModel()
+    dataset = ValidDataset()
+    metadata = ValidMetadata()
+    runtime = ValidMachineRuntime()
+    _strict = True
+
+    def __init__(
+        self,
+        name: str,
+        model: dict,
+        dataset: Union[GordoBaseDataset, dict],
+        project_name: str,
+        evaluation: Optional[dict] = None,
+        metadata: Optional[Union[dict, Metadata]] = None,
+        runtime: Optional[dict] = None,
+    ):
+        if runtime is None:
+            runtime = dict()
+        if not evaluation:  # None or {} -> default CV mode
+            evaluation = dict(cv_mode="full_build")
+        if metadata is None:
+            metadata = dict()
+        self.name = name
+        self.model = model
+        self.dataset = (
+            dataset
+            if isinstance(dataset, GordoBaseDataset)
+            else GordoBaseDataset.from_dict(dataset)
+        )
+        self.runtime = runtime
+        self.evaluation = evaluation
+        self.metadata = (
+            metadata if isinstance(metadata, Metadata) else Metadata.from_dict(metadata)
+        )
+        self.project_name = project_name
+        self.host = f"gordoserver-{self.project_name}-{self.name}"
+
+    @classmethod
+    def from_config(
+        cls,
+        config: Dict[str, Any],
+        project_name: str,
+        config_globals: Optional[dict] = None,
+    ) -> "Machine":
+        """
+        Build a Machine from one YAML machine block, overlaying project
+        globals (reference: machine.py:74-126): runtime and evaluation are
+        globals patched by the machine's locals; dataset is the machine's
+        dataset patched *onto* by globals (global dataset keys win, matching
+        the reference's argument order).
+        """
+        if config_globals is None:
+            config_globals = dict()
+
+        name = config["name"]
+        model = config.get("model") or config_globals.get("model")
+
+        runtime = patch_dict(
+            config_globals.get("runtime", dict()), config.get("runtime", dict())
+        )
+        dataset_config = patch_dict(
+            config.get("dataset", dict()), config_globals.get("dataset", dict())
+        )
+        dataset = GordoBaseDataset.from_dict(dataset_config)
+        evaluation = patch_dict(
+            config_globals.get("evaluation", dict()), config.get("evaluation", dict())
+        )
+        metadata = Metadata(
+            user_defined={
+                "global-metadata": config_globals.get("metadata", dict()),
+                "machine-metadata": config.get("metadata", dict()),
+            }
+        )
+        return cls(
+            name,
+            model,
+            dataset,
+            metadata=metadata,
+            runtime=runtime,
+            project_name=project_name,
+            evaluation=evaluation,
+        )
+
+    def __str__(self):
+        return yaml.dump(self.to_dict())
+
+    def __eq__(self, other):
+        if not isinstance(other, Machine):
+            return NotImplemented
+        return self.to_dict() == other.to_dict()
+
+    def __hash__(self):
+        return hash((self.project_name, self.name))
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Machine":
+        return cls(**d)
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "dataset": self.dataset.to_dict(),
+            "model": self.model,
+            "metadata": self.metadata.to_dict(),
+            "runtime": self.runtime,
+            "project_name": self.project_name,
+            "evaluation": self.evaluation,
+        }
+
+    def report(self):
+        """
+        Run every reporter configured under ``runtime.reporters``
+        (reference: machine.py:157-177)::
+
+            runtime:
+              reporters:
+                - gordo_tpu.reporters.postgres.PostgresReporter:
+                    host: my-special-host
+        """
+        from gordo_tpu.reporters.base import BaseReporter
+
+        for reporter_config in self.runtime.get("reporters", []):
+            reporter = BaseReporter.from_dict(reporter_config)
+            logger.debug("Using reporter: %r", reporter)
+            reporter.report(self)
+
+
+class MachineEncoder(json.JSONEncoder):
+    """JSON encoder handling datetimes and numpy scalars in Machine dicts."""
+
+    def default(self, obj):
+        if isinstance(obj, datetime):
+            return obj.strftime("%Y-%m-%d %H:%M:%S.%f%z")
+        if np.issubdtype(type(obj), np.floating):
+            return float(obj)
+        if np.issubdtype(type(obj), np.integer):
+            return int(obj)
+        return json.JSONEncoder.default(self, obj)

@@ -95,17 +95,24 @@ class ValidModel(BaseDescriptor):
     """
     Model config must round-trip through the serializer: a dry-run
     ``from_definition`` must succeed (reference: validators.py:80-91).
+    The dry-run is skipped when the owning object sets ``_strict = False``.
     """
 
-    def validate(self, value):
+    def validate(self, value, strict: bool = True):
         if not isinstance(value, dict):
             raise ValueError(f"Model config must be a dict, got {value!r}")
+        if not strict:
+            return
         from gordo_tpu.serializer import from_definition
 
         try:
             from_definition(value)
         except Exception as exc:
             raise ValueError(f"Invalid model config: {exc}") from exc
+
+    def __set__(self, instance, value):
+        self.validate(value, strict=getattr(instance, "_strict", True))
+        instance.__dict__[self.name] = value
 
 
 class ValidMetadata(BaseDescriptor):
@@ -154,6 +161,23 @@ def fix_resource_limits(resources: dict) -> dict:
     return out
 
 
+def fix_runtime(runtime: dict) -> dict:
+    """
+    Apply :func:`fix_resource_limits` to every runtime section that carries a
+    ``resources`` block (reference: validators.py fix_runtime). Returns a new
+    dict; the input is not mutated.
+    """
+    import copy as _copy
+
+    runtime = _copy.deepcopy(runtime)
+    for section_cfg in runtime.values():
+        if isinstance(section_cfg, dict) and isinstance(
+            section_cfg.get("resources"), dict
+        ):
+            section_cfg["resources"] = fix_resource_limits(section_cfg["resources"])
+    return runtime
+
+
 class ValidMachineRuntime(BaseDescriptor):
     def validate(self, value):
         if not isinstance(value, dict):
@@ -161,11 +185,7 @@ class ValidMachineRuntime(BaseDescriptor):
 
     def __set__(self, instance, value):
         self.validate(value)
-        for section in ("builder", "server", "client", "influx", "prometheus"):
-            cfg = value.get(section)
-            if isinstance(cfg, dict) and isinstance(cfg.get("resources"), dict):
-                cfg["resources"] = fix_resource_limits(cfg["resources"])
-        instance.__dict__[self.name] = value
+        instance.__dict__[self.name] = fix_runtime(value)
 
 
 _URL_RE = re.compile(r"^[a-z0-9]([a-z0-9\-]{0,61}[a-z0-9])?$")

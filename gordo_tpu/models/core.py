@@ -40,6 +40,10 @@ logger = logging.getLogger(__name__)
 # attributes never pickled (compiled/jitted/device state)
 _EPHEMERAL_ATTRS = ("_apply_fn", "_train_epoch_fn", "_device_params")
 
+# Default PRNG seed for fits without an explicit ``seed`` kwarg (the builder
+# injects the Machine's evaluation seed into each estimator's kwargs).
+DEFAULT_SEED = 0
+
 
 class BaseJaxEstimator(GordoBase, BaseEstimator):
 
@@ -145,7 +149,7 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         epochs = int(fit_args.get("epochs", 1))
         batch_size = int(fit_args.get("batch_size", 32))
         shuffle = bool(fit_args.get("shuffle", not self._windowed))
-        seed = int(self.kwargs.get("seed", 0))
+        seed = int(self.kwargs.get("seed", DEFAULT_SEED))
 
         spec = self._build_spec()
         self.spec_ = spec

@@ -1,0 +1,7 @@
+"""
+Build-result reporters (reference parity: gordo/reporters/).
+"""
+
+from .base import BaseReporter, ReporterException
+
+__all__ = ["BaseReporter", "ReporterException"]

@@ -1,0 +1,38 @@
+"""
+Reporter ABC (reference parity: gordo/reporters/base.py:9-12).
+"""
+
+import abc
+from copy import copy
+
+
+class ReporterException(Exception):
+    pass
+
+
+class BaseReporter(abc.ABC):
+    @abc.abstractmethod
+    def report(self, machine):
+        """Report a built Machine (config + build metadata) to a backend."""
+
+    def to_dict(self) -> dict:
+        params = dict(getattr(self, "_params", {}))
+        return {f"{type(self).__module__}.{type(self).__name__}": params}
+
+    @classmethod
+    def from_dict(cls, config) -> "BaseReporter":
+        """
+        Build a reporter from a definition like::
+
+            gordo_tpu.reporters.postgres.PostgresReporter:
+              host: my-host
+        """
+        from gordo_tpu.serializer import from_definition
+
+        config = copy(config)
+        reporter = from_definition(config)
+        if not isinstance(reporter, BaseReporter):
+            raise ReporterException(
+                f"Config {config!r} did not build a BaseReporter, got {type(reporter)}"
+            )
+        return reporter

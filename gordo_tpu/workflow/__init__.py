@@ -1,0 +1,8 @@
+"""
+Orchestration layer: project config normalization and Argo workflow
+generation (reference parity: gordo/workflow/).
+"""
+
+from .helpers import patch_dict
+
+__all__ = ["patch_dict"]

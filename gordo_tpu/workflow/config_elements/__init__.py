@@ -1,0 +1,3 @@
+from .normalized_config import NormalizedConfig
+
+__all__ = ["NormalizedConfig"]

@@ -1,0 +1,34 @@
+"""
+Config-overlay helper
+(reference parity: gordo/workflow/workflow_generator/helpers.py:4-34).
+"""
+
+from copy import deepcopy
+
+
+def patch_dict(original_dict: dict, patch_dictionary: dict) -> dict:
+    """
+    Overlay ``patch_dictionary`` onto ``original_dict``: every path in the
+    patch is added or replaces the existing value; nothing is removed.
+    Returns a new dict; inputs are not mutated.
+
+    Examples
+    --------
+    >>> patch_dict({"highKey":{"lowkey1":1, "lowkey2":2}}, {"highKey":{"lowkey1":10}})
+    {'highKey': {'lowkey1': 10, 'lowkey2': 2}}
+    >>> patch_dict({"highKey":{"lowkey1":1, "lowkey2":2}}, {"highKey":{"lowkey3":3}})
+    {'highKey': {'lowkey1': 1, 'lowkey2': 2, 'lowkey3': 3}}
+    >>> patch_dict({"highKey":{"lowkey1":1, "lowkey2":2}}, {"highKey2":4})
+    {'highKey': {'lowkey1': 1, 'lowkey2': 2}, 'highKey2': 4}
+    """
+    result = deepcopy(original_dict)
+
+    def _merge(base: dict, patch: dict):
+        for key, value in patch.items():
+            if isinstance(value, dict) and isinstance(base.get(key), dict):
+                _merge(base[key], value)
+            else:
+                base[key] = deepcopy(value)
+
+    _merge(result, patch_dictionary)
+    return result

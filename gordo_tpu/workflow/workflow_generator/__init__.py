@@ -1,0 +1,11 @@
+from .workflow_generator import (
+    default_image_pull_policy,
+    get_dict_from_yaml,
+    load_workflow_template,
+)
+
+__all__ = [
+    "get_dict_from_yaml",
+    "load_workflow_template",
+    "default_image_pull_policy",
+]

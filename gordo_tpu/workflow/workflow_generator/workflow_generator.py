@@ -1,0 +1,68 @@
+"""
+Workflow-config loading and template handling (reference parity:
+gordo/workflow/workflow_generator/workflow_generator.py).
+"""
+
+import io
+import os
+from typing import Union
+
+import dateutil.parser
+import jinja2
+import yaml
+
+
+def _timestamp_constructor(_loader, node):
+    """YAML timestamps must carry a timezone (reference: :59-70)."""
+    parsed_date = dateutil.parser.isoparse(node.value)
+    if parsed_date.tzinfo is None:
+        raise ValueError(
+            f"Provide timezone to timestamp {node.value}. Example: for UTC "
+            f"timezone use {node.value + 'Z'} or {node.value + '+00:00'}"
+        )
+    return parsed_date
+
+
+class _TzEnforcingLoader(yaml.SafeLoader):
+    """SafeLoader with tz-required timestamps."""
+
+
+_TzEnforcingLoader.add_constructor(
+    "tag:yaml.org,2002:timestamp", _timestamp_constructor
+)
+
+
+def get_dict_from_yaml(config_file: Union[str, io.StringIO]) -> dict:
+    """
+    Read a config file (path or file-like) into a dict, unwrapping the k8s
+    CRD ``spec.config`` nesting when present (reference: :71-95).
+    """
+    if hasattr(config_file, "read"):
+        yaml_content = yaml.load(config_file, Loader=_TzEnforcingLoader)
+    else:
+        path_to_config_file = os.path.abspath(config_file)
+        try:
+            with open(path_to_config_file, "r") as yamlfile:
+                yaml_content = yaml.load(yamlfile, Loader=_TzEnforcingLoader)
+        except FileNotFoundError:
+            raise FileNotFoundError(
+                f"Unable to find config file <{path_to_config_file}>"
+            )
+    if isinstance(yaml_content, dict) and "spec" in yaml_content:
+        yaml_content = yaml_content["spec"]["config"]
+    return yaml_content
+
+
+def load_workflow_template(workflow_template: str) -> jinja2.Template:
+    """Load a Jinja2 workflow template with strict-undefined semantics."""
+    path = os.path.abspath(workflow_template)
+    env = jinja2.Environment(
+        loader=jinja2.FileSystemLoader(os.path.dirname(path)),
+        undefined=jinja2.StrictUndefined,
+    )
+    return env.get_template(os.path.basename(path))
+
+
+def default_image_pull_policy(tag: str) -> str:
+    """latest-style tags re-pull; pinned tags don't."""
+    return "Always" if tag in ("latest", "master", "main") else "IfNotPresent"

@@ -1,0 +1,167 @@
+"""Builder tests (reference test model: tests/gordo/builder/test_builder.py)."""
+
+import numpy as np
+import pytest
+
+from gordo_tpu import serializer
+from gordo_tpu.builder import ModelBuilder, local_build
+from gordo_tpu.machine import Machine
+from gordo_tpu.machine.metadata import Metadata
+
+ANOMALY_CONFIG = """
+machines:
+  - name: machine-1
+    dataset:
+      type: RandomDataset
+      tags: [TAG-1, TAG-2, TAG-3]
+      asset: gra
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-03T00:00:00+00:00'
+    model:
+      gordo_tpu.models.anomaly.DiffBasedAnomalyDetector:
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+              - sklearn.preprocessing.MinMaxScaler
+              - gordo_tpu.models.AutoEncoder:
+                  kind: feedforward_hourglass
+                  epochs: 2
+"""
+
+
+def make_machine(model=None, evaluation=None):
+    return Machine(
+        name="test-machine",
+        model=model
+        or {
+            "gordo_tpu.models.AutoEncoder": {
+                "kind": "feedforward_hourglass",
+                "epochs": 2,
+            }
+        },
+        dataset={
+            "type": "RandomDataset",
+            "train_start_date": "2017-12-25 06:00:00Z",
+            "train_end_date": "2017-12-27 06:00:00Z",
+            "tags": [["Tag 1", None], ["Tag 2", None]],
+        },
+        project_name="test-proj",
+        evaluation=evaluation,
+    )
+
+
+def machine_check(machine: Machine, expect_cv: bool = True):
+    """Assert build metadata shape (reference: test_builder.py:37-62)."""
+    build_meta = machine.metadata.build_metadata
+    assert build_meta.dataset.query_duration_sec is not None
+    assert build_meta.dataset.dataset_meta
+    if expect_cv:
+        assert build_meta.model.cross_validation.cv_duration_sec is not None
+        assert build_meta.model.cross_validation.scores
+        assert build_meta.model.cross_validation.splits
+
+
+def test_build_full():
+    model, machine = ModelBuilder(make_machine()).build()
+    assert hasattr(model, "predict")
+    machine_check(machine)
+    assert machine.metadata.build_metadata.model.model_training_duration_sec is not None
+    # history metadata harvested from the estimator
+    assert "history" in machine.metadata.build_metadata.model.model_meta
+
+
+def test_build_cross_val_only():
+    evaluation = {"cv_mode": "cross_val_only"}
+    model, machine = ModelBuilder(make_machine(evaluation=evaluation)).build()
+    machine_check(machine)
+    assert machine.metadata.build_metadata.model.model_training_duration_sec is None
+
+
+def test_build_scores_shape():
+    _, machine = ModelBuilder(make_machine()).build()
+    scores = machine.metadata.build_metadata.model.cross_validation.scores
+    # aggregate + per-tag keys for each default metric
+    assert "explained-variance-score" in scores
+    assert "explained-variance-score-Tag-1" in scores
+    assert set(scores["r2-score"]) >= {"fold-mean", "fold-1", "fold-2", "fold-3"}
+
+
+def test_build_sklearn_model_offset_zero():
+    model, machine = ModelBuilder(
+        make_machine(model={"sklearn.decomposition.PCA": {}})
+    ).build()
+    assert machine.metadata.build_metadata.model.model_offset == 0
+
+
+def test_build_lstm_model_offset():
+    model, machine = ModelBuilder(
+        make_machine(
+            model={
+                "gordo_tpu.models.LSTMAutoEncoder": {
+                    "kind": "lstm_model",
+                    "lookback_window": 5,
+                    "epochs": 1,
+                }
+            }
+        )
+    ).build()
+    # lookahead=0 -> offset = lookback - 1
+    assert machine.metadata.build_metadata.model.model_offset == 4
+
+
+def test_build_cache(tmp_path):
+    machine = make_machine()
+    output_dir = tmp_path / "model"
+    register = tmp_path / "register"
+    builder = ModelBuilder(machine)
+    builder.build(output_dir=output_dir, model_register_dir=register)
+    first_path = builder.cached_model_path
+
+    # second build resolves from cache
+    builder2 = ModelBuilder(make_machine())
+    builder2.build(output_dir=tmp_path / "model2", model_register_dir=register)
+    assert str(builder2.check_cache(register)) == str(first_path)
+
+    # replace_cache forces a rebuild
+    builder3 = ModelBuilder(make_machine())
+    builder3.build(
+        output_dir=tmp_path / "model3", model_register_dir=register, replace_cache=True
+    )
+    assert str(builder3.cached_model_path) != str(first_path)
+
+
+def test_cache_key_stability():
+    key1 = ModelBuilder(make_machine()).cache_key
+    key2 = ModelBuilder(make_machine()).cache_key
+    assert key1 == key2
+    assert len(key1) == 128
+    other = make_machine(model={"sklearn.decomposition.PCA": {}})
+    assert ModelBuilder(other).cache_key != key1
+
+
+def test_determinism_same_seed():
+    m1, _ = ModelBuilder(make_machine()).build()
+    m2, _ = ModelBuilder(make_machine()).build()
+    X = np.random.default_rng(1).random((10, 2)).astype("float32")
+    np.testing.assert_allclose(m1.predict(X), m2.predict(X), rtol=1e-5)
+
+
+def test_saved_artifact_loads(tmp_path):
+    machine = make_machine()
+    ModelBuilder(machine).build(output_dir=tmp_path)
+    model = serializer.load(tmp_path)
+    metadata = serializer.load_metadata(tmp_path)
+    assert hasattr(model, "predict")
+    assert metadata["name"] == "test-machine"
+    meta = Metadata.from_dict(metadata["metadata"])
+    assert meta.build_metadata.model.model_builder_version
+
+
+def test_local_build_anomaly_pipeline():
+    results = list(local_build(ANOMALY_CONFIG))
+    assert len(results) == 1
+    model, machine = results[0]
+    # anomaly model went through its custom cross_validate -> has thresholds
+    assert hasattr(model, "feature_thresholds_")
+    assert hasattr(model, "aggregate_threshold_")
+    machine_check(machine)

@@ -1,0 +1,89 @@
+"""Machine & metadata tests (reference test model: tests/gordo/machine/)."""
+
+import pytest
+
+from gordo_tpu.machine import Machine, Metadata
+from gordo_tpu.machine.validators import ValidUrlString, fix_resource_limits
+from gordo_tpu.workflow.helpers import patch_dict
+
+MACHINE_CONFIG = {
+    "name": "special-model-name",
+    "model": {"sklearn.decomposition.PCA": {"svd_solver": "auto"}},
+    "dataset": {
+        "type": "RandomDataset",
+        "train_start_date": "2017-12-25 06:00:00Z",
+        "train_end_date": "2017-12-30 06:00:00Z",
+        "tags": [["Tag 1", None], ["Tag 2", None]],
+    },
+}
+
+
+def test_machine_from_config():
+    machine = Machine.from_config(MACHINE_CONFIG, project_name="test-proj")
+    assert machine.name == "special-model-name"
+    assert machine.project_name == "test-proj"
+    assert machine.host == "gordoserver-test-proj-special-model-name"
+    assert machine.evaluation == {"cv_mode": "full_build"}
+
+
+def test_machine_dict_roundtrip():
+    machine = Machine.from_config(MACHINE_CONFIG, project_name="test-proj")
+    rebuilt = Machine.from_dict(machine.to_dict())
+    assert machine == rebuilt
+
+
+def test_machine_invalid_name():
+    config = dict(MACHINE_CONFIG, name="Invalid Name!")
+    with pytest.raises(ValueError):
+        Machine.from_config(config, project_name="test-proj")
+
+
+def test_machine_invalid_model():
+    config = dict(MACHINE_CONFIG, model={"no.such.Model": {}})
+    with pytest.raises(ValueError):
+        Machine.from_config(config, project_name="test-proj")
+
+
+def test_machine_globals_overlay():
+    config_globals = {
+        "runtime": {"server": {"resources": {"requests": {"memory": 1}}}},
+        "evaluation": {"cv_mode": "cross_val_only"},
+        "model": MACHINE_CONFIG["model"],
+    }
+    config = {k: v for k, v in MACHINE_CONFIG.items() if k != "model"}
+    machine = Machine.from_config(
+        config, project_name="test-proj", config_globals=config_globals
+    )
+    assert machine.model == MACHINE_CONFIG["model"]
+    assert machine.evaluation["cv_mode"] == "cross_val_only"
+    assert machine.runtime["server"]["resources"]["requests"]["memory"] == 1
+
+
+def test_valid_url_string():
+    assert ValidUrlString.valid_url_string("my-model-name")
+    assert not ValidUrlString.valid_url_string("My-Model")
+    assert not ValidUrlString.valid_url_string("-leading-dash")
+    assert not ValidUrlString.valid_url_string("a" * 64)
+
+
+def test_fix_resource_limits():
+    resources = {"requests": {"memory": 4000}, "limits": {"memory": 3000}}
+    fixed = fix_resource_limits(resources)
+    assert fixed["limits"]["memory"] == 4000
+    # input not mutated
+    assert resources["limits"]["memory"] == 3000
+
+
+def test_patch_dict_never_removes():
+    base = {"a": {"b": 1, "c": 2}, "d": 3}
+    out = patch_dict(base, {"a": {"b": 10}, "e": 4})
+    assert out == {"a": {"b": 10, "c": 2}, "d": 3, "e": 4}
+    assert base["a"]["b"] == 1  # input untouched
+
+
+def test_metadata_roundtrip():
+    meta = Metadata(user_defined={"x": 1})
+    d = meta.to_dict()
+    rebuilt = Metadata.from_dict(d)
+    assert rebuilt.user_defined == {"x": 1}
+    assert rebuilt.build_metadata.model.model_offset == 0
