@@ -1,0 +1,155 @@
+"""
+Headline benchmark: LSTM-AE training throughput on TPU.
+
+Metric (BASELINE.json north star): sensor-timesteps/sec/chip for the
+LSTM autoencoder — how many (timestep x sensor) readings the training loop
+consumes per second: windows x lookback x n_sensors x epochs / wall_time.
+
+vs_baseline: the same architecture/workload trained with torch CPU (the
+closest runnable stand-in for the reference's TF/Keras-per-pod engine —
+TF is not installed and no GPU exists in this image; the reference ships no
+published numbers, see BASELINE.md). Measured on a scaled-down copy of the
+workload and compared per-step.
+
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+# workload: "50-tag plant" LSTM-AE (BASELINE.json config #2/#3 shape)
+N_SENSORS = 50
+LOOKBACK = 64
+N_TIMESTEPS = 16384
+BATCH = 512
+EPOCHS = 3
+ENC = (128, 64)
+DEC = (64, 128)
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def bench_jax() -> dict:
+    import jax
+
+    from gordo_tpu.models.factories.lstm import lstm_model
+    from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
+
+    dev = jax.devices()[0]
+    log(f"jax device: {dev.device_kind} ({dev.platform})")
+    on_tpu = dev.platform != "cpu"
+
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((N_TIMESTEPS, N_SENSORS)).astype("float32")
+    data = StackedData.from_ragged([X], [X.copy()])
+
+    spec = lstm_model(
+        n_features=N_SENSORS,
+        lookback_window=LOOKBACK,
+        encoding_dim=ENC,
+        encoding_func=("tanh",) * len(ENC),
+        decoding_dim=DEC,
+        decoding_func=("tanh",) * len(DEC),
+        dtype="bfloat16" if on_tpu else "float32",
+    )
+    trainer = FleetTrainer(spec, lookahead=0, donate=False)
+    keys = trainer.machine_keys(1)
+
+    # compile + warmup
+    t0 = time.time()
+    params, _ = trainer.fit(data, keys, epochs=1, batch_size=BATCH)
+    compile_time = time.time() - t0
+    log(f"warmup epoch (incl. compile): {compile_time:.1f}s")
+
+    t0 = time.time()
+    params, losses = trainer.fit(
+        data, keys, epochs=EPOCHS, batch_size=BATCH, params=params
+    )
+    jax.block_until_ready(params)
+    train_time = time.time() - t0
+
+    n_windows = N_TIMESTEPS - LOOKBACK + 1
+    sensor_timesteps = n_windows * LOOKBACK * N_SENSORS * EPOCHS
+    rate = sensor_timesteps / train_time
+    log(
+        f"jax: {EPOCHS} epochs x {n_windows} windows in {train_time:.2f}s "
+        f"-> {rate:,.0f} sensor-timesteps/s"
+    )
+    return {"rate": rate, "train_time": train_time, "platform": dev.platform}
+
+
+def bench_torch_cpu(step_budget: int = 6) -> float:
+    """Per-step-extrapolated torch-CPU rate on the identical workload."""
+    import torch
+
+    torch.manual_seed(0)
+    torch.set_num_threads(max(1, torch.get_num_threads()))
+
+    class RefLSTMAE(torch.nn.Module):
+        def __init__(self):
+            super().__init__()
+            dims = [N_SENSORS, *ENC, *DEC]
+            self.layers = torch.nn.ModuleList(
+                [torch.nn.LSTM(dims[i], dims[i + 1], batch_first=True)
+                 for i in range(len(dims) - 1)]
+            )
+            self.head = torch.nn.Linear(dims[-1], N_SENSORS)
+
+        def forward(self, x):
+            for lstm in self.layers:
+                x, _ = lstm(x)
+            return self.head(x[:, -1, :])
+
+    model = RefLSTMAE()
+    opt = torch.optim.Adam(model.parameters(), lr=1e-3)
+    loss_fn = torch.nn.MSELoss()
+
+    xb = torch.randn(BATCH, LOOKBACK, N_SENSORS)
+    yb = torch.randn(BATCH, N_SENSORS)
+
+    # warmup
+    loss = loss_fn(model(xb), yb)
+    loss.backward()
+    opt.step()
+    opt.zero_grad()
+
+    t0 = time.time()
+    for _ in range(step_budget):
+        loss = loss_fn(model(xb), yb)
+        loss.backward()
+        opt.step()
+        opt.zero_grad()
+    per_step = (time.time() - t0) / step_budget
+    rate = (BATCH * LOOKBACK * N_SENSORS) / per_step
+    log(f"torch-cpu: {per_step * 1000:.0f} ms/step -> {rate:,.0f} sensor-timesteps/s")
+    return rate
+
+
+def main():
+    jax_result = bench_jax()
+    try:
+        baseline_rate = bench_torch_cpu()
+        vs_baseline = jax_result["rate"] / baseline_rate
+    except Exception as exc:  # torch missing/broken should not kill the bench
+        log(f"baseline failed: {exc}")
+        vs_baseline = None
+
+    print(
+        json.dumps(
+            {
+                "metric": "LSTM-AE training throughput (sensor-timesteps/sec/chip)",
+                "value": round(jax_result["rate"], 1),
+                "unit": "sensor-timesteps/s",
+                "vs_baseline": round(vs_baseline, 2) if vs_baseline else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -3,6 +3,7 @@ Builder layer (reference parity: gordo/builder/).
 """
 
 from .build_model import ModelBuilder
+from .fleet_build import FleetModelBuilder
 from .local_build import local_build
 
-__all__ = ["ModelBuilder", "local_build"]
+__all__ = ["ModelBuilder", "FleetModelBuilder", "local_build"]

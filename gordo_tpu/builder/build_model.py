@@ -67,8 +67,9 @@ class ModelBuilder:
         >>> len(builder.cache_key)
         128
         """
-        # copy via dict round-trip so we never mutate the caller's machine
-        self.machine = Machine(**machine.to_dict())
+        # copy via dict round-trip so we never mutate the caller's machine;
+        # skip re-validation (the caller's Machine already passed it)
+        self.machine = Machine.unvalidated(**machine.to_dict())
         self._cached_model_path: Optional[Union[os.PathLike, str]] = None
 
     @property
@@ -90,6 +91,10 @@ class ModelBuilder:
         ``output_dir`` and caching via ``model_register_dir``
         (reference: build_model.py:83-158).
         """
+        cv_only = (
+            str(self.machine.evaluation.get("cv_mode", "")).lower()
+            == "cross_val_only"
+        )
         if not model_register_dir:
             model, machine = self._build()
         else:
@@ -108,7 +113,7 @@ class ModelBuilder:
                         self.machine.metadata.user_defined
                     )
                     metadata["runtime"] = self.machine.runtime
-                    machine = Machine(**metadata)
+                    machine = Machine.unvalidated(**metadata)
                 else:
                     # artifact lost its metadata -> invalidate and rebuild
                     logger.warning(
@@ -120,7 +125,9 @@ class ModelBuilder:
 
             if machine is None:
                 model, machine = self._build()
-                if output_dir:
+                # never cache/persist a cross_val_only result: the model is
+                # unfitted and a later cache hit would serve it as trained
+                if output_dir and not cv_only:
                     self.cached_model_path = self._save_model(
                         model=model, machine=machine, output_dir=output_dir
                     )
@@ -132,7 +139,7 @@ class ModelBuilder:
         if (
             output_dir
             and str(self.cached_model_path or "") != str(output_dir)
-            and (self.machine.evaluation.get("cv_mode") != "cross_val_only")
+            and not cv_only
         ):
             self.cached_model_path = self._save_model(
                 model=model, machine=machine, output_dir=output_dir
@@ -153,7 +160,7 @@ class ModelBuilder:
         self._inject_seed(model, self.machine.evaluation.get("seed", 0))
 
         cv_duration_sec = None
-        machine = Machine(
+        machine = Machine.unvalidated(
             name=self.machine.name,
             dataset=self.machine.dataset.to_dict(),
             metadata=self.machine.metadata,
@@ -165,10 +172,8 @@ class ModelBuilder:
 
         split_metadata: Dict[str, Any] = dict()
         scores: Dict[str, Any] = dict()
-        if self.machine.evaluation["cv_mode"].lower() in (
-            "cross_val_only",
-            "full_build",
-        ):
+        cv_mode = str(self.machine.evaluation.get("cv_mode", "full_build")).lower()
+        if cv_mode in ("cross_val_only", "full_build"):
             metrics_list = self.metrics_from_list(
                 self.machine.evaluation.get("metrics")
             )
@@ -212,7 +217,7 @@ class ModelBuilder:
             else:
                 logger.debug("Unable to score model; it has no 'predict' attribute")
 
-            if self.machine.evaluation["cv_mode"] == "cross_val_only":
+            if cv_mode == "cross_val_only":
                 machine.metadata.build_metadata = BuildMetadata(
                     model=ModelBuildMetadata(
                         cross_validation=CrossValidationMetaData(

@@ -1,0 +1,433 @@
+"""
+FleetModelBuilder: build MANY Machines in one XLA program per bucket.
+
+The reference trains each Machine in its own Argo pod (one container, one
+Keras fit — SURVEY.md §3.1). Here the fleet is the unit: Machines are
+bucketed by architecture/shape (gordo_tpu.parallel.bucketing), each bucket's
+data is stacked and padded onto a common grid, and a single vmapped,
+mesh-sharded program trains every model in the bucket simultaneously —
+including the cross-validation folds used for anomaly-threshold calibration,
+which run as additional fleet fits with per-machine fold masks instead of
+per-machine sklearn loops.
+
+Supported model shapes (the reference's flagship configs):
+
+- a bare JAX estimator definition (AutoEncoder / LSTM*),
+- sklearn Pipeline(prefix transformers... , JAX estimator) — prefix
+  transformers are fitted per machine on host (they are tiny) and applied
+  before stacking,
+- DiffBasedAnomalyDetector wrapping either of the above.
+
+Anything else falls back to the per-machine ModelBuilder path, so the fleet
+builder never rejects a config — it just loses the batching speedup.
+
+Outputs are per-machine (model, Machine) pairs with the same artifact layout
+and metadata as ModelBuilder, so serving and clients are oblivious to how
+the model was trained.
+"""
+
+import logging
+import time
+from concurrent.futures import ThreadPoolExecutor
+from datetime import datetime, timezone
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import numpy as np
+import pandas as pd
+from sklearn.base import BaseEstimator, TransformerMixin
+from sklearn.model_selection import TimeSeriesSplit
+from sklearn.pipeline import Pipeline
+
+from gordo_tpu import __version__, serializer
+from gordo_tpu.builder.build_model import ModelBuilder
+from gordo_tpu.data import _get_dataset
+from gordo_tpu.machine import Machine
+from gordo_tpu.machine.metadata import (
+    BuildMetadata,
+    CrossValidationMetaData,
+    DatasetBuildMetadata,
+    ModelBuildMetadata,
+)
+from gordo_tpu.models.anomaly.diff import DiffBasedAnomalyDetector
+from gordo_tpu.models.core import BaseJaxEstimator
+from gordo_tpu.parallel.bucketing import bucket_machines, timestep_bucket
+from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
+from gordo_tpu.parallel.mesh import get_device_mesh
+
+logger = logging.getLogger(__name__)
+
+
+def _find_jax_estimator(model) -> Optional[BaseJaxEstimator]:
+    """Terminal JAX estimator inside (possibly nested) model, or None."""
+    if isinstance(model, BaseJaxEstimator):
+        return model
+    if isinstance(model, DiffBasedAnomalyDetector):
+        return _find_jax_estimator(model.base_estimator)
+    if isinstance(model, Pipeline):
+        return _find_jax_estimator(model.steps[-1][1])
+    return None
+
+
+def _prefix_transformers(model) -> List[TransformerMixin]:
+    """Host-side transformer steps applied before the JAX estimator."""
+    if isinstance(model, DiffBasedAnomalyDetector):
+        return _prefix_transformers(model.base_estimator)
+    if isinstance(model, Pipeline):
+        return [step for _, step in model.steps[:-1]]
+    return []
+
+
+class FleetModelBuilder:
+    """
+    Parameters
+    ----------
+    machines
+        The Machines to build (possibly heterogeneous; they are bucketed).
+    mesh
+        Device mesh to shard fleets over; None = single default device.
+    data_threads
+        Thread-pool width for the I/O-bound data-fetch phase.
+    """
+
+    def __init__(
+        self,
+        machines: List[Machine],
+        mesh=None,
+        data_threads: int = 8,
+        auto_mesh: bool = False,
+    ):
+        self.machines = machines
+        if mesh is None and auto_mesh:
+            import jax
+
+            if len(jax.devices()) > 1:
+                mesh = get_device_mesh()
+        self.mesh = mesh
+        self.data_threads = data_threads
+
+    # -- data ------------------------------------------------------------
+    def _fetch_one(self, machine: Machine):
+        dataset = _get_dataset(machine.dataset.to_dict())
+        start = time.time()
+        X, y = dataset.get_data()
+        return {
+            "machine": machine,
+            "dataset": dataset,
+            "X": X,
+            "y": y if y is not None else X,
+            "query_duration": time.time() - start,
+        }
+
+    def fetch_data(self, machines: List[Machine]) -> List[dict]:
+        with ThreadPoolExecutor(max_workers=self.data_threads) as pool:
+            return list(pool.map(self._fetch_one, machines))
+
+    # -- build -----------------------------------------------------------
+    def build(
+        self,
+        output_dir_base: Optional[Union[str, Path]] = None,
+    ) -> List[Tuple[BaseEstimator, Machine]]:
+        """
+        Build every machine; returns per-machine (model, machine) pairs in
+        the original order. Artifacts land at
+        ``<output_dir_base>/<machine.name>`` when a base dir is given.
+        """
+        results: Dict[str, Tuple[BaseEstimator, Machine]] = {}
+        buckets = bucket_machines(self.machines)
+        logger.info(
+            "Fleet build: %d machines in %d buckets", len(self.machines), len(buckets)
+        )
+        for (model_key, n_feat, n_feat_out), bucket in buckets.items():
+            prototype = serializer.from_definition(bucket[0].model)
+            if _find_jax_estimator(prototype) is None:
+                logger.info(
+                    "Bucket %r has no JAX estimator; falling back to "
+                    "per-machine builds (%d machines)",
+                    model_key[:60],
+                    len(bucket),
+                )
+                for machine in bucket:
+                    results[machine.name] = ModelBuilder(machine).build()
+                continue
+            for name, built in self._build_bucket(bucket).items():
+                results[name] = built
+
+        ordered = [results[m.name] for m in self.machines]
+        if output_dir_base is not None:
+            base = Path(output_dir_base)
+            for model, machine in ordered:
+                ModelBuilder._save_model(
+                    model=model, machine=machine, output_dir=base / machine.name
+                )
+        return ordered
+
+    def _build_bucket(
+        self, bucket: List[Machine]
+    ) -> Dict[str, Tuple[BaseEstimator, Machine]]:
+        fetched = self.fetch_data(bucket)
+
+        # Per-machine host-side prep: build the model object, fit prefix
+        # transformers, transform X.
+        models = [serializer.from_definition(item["machine"].model) for item in fetched]
+        for model, item in zip(models, fetched):
+            seed = item["machine"].evaluation.get("seed", 0)
+            ModelBuilder._inject_seed(model, seed)
+        estimators = [_find_jax_estimator(m) for m in models]
+        Xs_t: List[np.ndarray] = []
+        ys_np: List[np.ndarray] = []
+        for model, item in zip(models, fetched):
+            X_t = np.asarray(item["X"], dtype=np.float32)
+            for transformer in _prefix_transformers(model):
+                X_t = np.asarray(transformer.fit_transform(X_t), dtype=np.float32)
+            Xs_t.append(X_t)
+            ys_np.append(np.asarray(item["y"], dtype=np.float32))
+
+        # Stack to a common power-of-two grid (so ragged buckets share one
+        # compiled program geometry), pad fleet to mesh multiple.
+        n_grid = timestep_bucket(max(len(x) for x in Xs_t))
+        m_padded = FleetTrainer.pad_fleet_size(len(bucket), self.mesh)
+        Xs_grid = Xs_t
+        ys_grid = ys_np
+        data = StackedData.from_ragged(
+            Xs_grid, ys_grid, n_machines_padded=m_padded, n_timesteps=n_grid
+        )
+
+        # Architecture spec from the first estimator (identical across the
+        # bucket by construction).
+        proto_est = estimators[0]
+        proto_est.kwargs.update(
+            {"n_features": Xs_grid[0].shape[1], "n_features_out": ys_grid[0].shape[1]}
+        )
+        spec = proto_est._build_spec()
+        lookahead = proto_est.lookahead if spec.windowed else 0
+        fit_args = proto_est.extract_supported_fit_args(proto_est.kwargs)
+        epochs = int(fit_args.get("epochs", 1))
+        batch_size = int(fit_args.get("batch_size", 32))
+
+        trainer = FleetTrainer(spec, lookahead=lookahead, mesh=self.mesh)
+        # Per-machine PRNG streams are a pure function of (evaluation seed,
+        # machine name) — independent of fleet composition and identical to a
+        # re-build of the same machine in any bucket.
+        import zlib
+
+        import jax as _jax
+
+        def machine_key(seed: int, name: str):
+            return np.asarray(
+                _jax.random.fold_in(
+                    _jax.random.PRNGKey(seed), zlib.crc32(name.encode()) & 0x7FFFFFFF
+                )
+            )
+
+        keys = np.stack(
+            [
+                machine_key(
+                    item["machine"].evaluation.get("seed", 0), item["machine"].name
+                )
+                for item in fetched
+            ]
+            + [machine_key(0, f"__pad_{i}") for i in range(m_padded - len(bucket))]
+        )
+
+        # -- CV folds as masks: threshold calibration + scores ------------
+        start_cv = time.time()
+        fold_records = self._run_cv_folds(
+            trainer, data, keys, bucket, Xs_grid, ys_grid, models,
+            epochs=epochs, batch_size=batch_size,
+        )
+        cv_duration = time.time() - start_cv
+
+        # -- final full fit ----------------------------------------------
+        start_fit = time.time()
+        params, losses = trainer.fit(
+            data, keys, epochs=epochs, batch_size=batch_size
+        )
+        fit_duration = time.time() - start_fit
+
+        # -- unstack into per-machine models + metadata -------------------
+        out: Dict[str, Tuple[BaseEstimator, Machine]] = {}
+        for i, (model, est, item) in enumerate(zip(models, estimators, fetched)):
+            machine: Machine = item["machine"]
+            est.spec_ = spec
+            est.params_ = trainer.unstack_params(params, i)
+            est.n_features_ = Xs_grid[i].shape[1]
+            est.n_features_out_ = ys_grid[i].shape[1]
+            est.history_ = {
+                "loss": [float(l[i]) for l in losses],
+                "params": {
+                    "epochs": epochs,
+                    "batch_size": batch_size,
+                    "samples": int(len(Xs_grid[i])),
+                    "metrics": ["loss"],
+                    "fleet_size": len(bucket),
+                },
+            }
+            if isinstance(model, DiffBasedAnomalyDetector):
+                model.scaler.fit(item["y"])
+                self._apply_thresholds(model, fold_records, i)
+
+            offset = ModelBuilder._determine_offset(model, item["X"])
+            scores = {
+                metric: folds for metric, folds in fold_records["scores"][i].items()
+            }
+            machine_out = Machine.unvalidated(**machine.to_dict())
+            machine_out.metadata.build_metadata = BuildMetadata(
+                model=ModelBuildMetadata(
+                    model_offset=offset,
+                    model_creation_date=str(datetime.now(timezone.utc).astimezone()),
+                    model_builder_version=__version__,
+                    model_training_duration_sec=fit_duration,
+                    cross_validation=CrossValidationMetaData(
+                        cv_duration_sec=cv_duration,
+                        scores=scores,
+                        splits=fold_records["splits"][i],
+                    ),
+                    model_meta=ModelBuilder._extract_metadata_from_model(model),
+                ),
+                dataset=DatasetBuildMetadata(
+                    query_duration_sec=item["query_duration"],
+                    dataset_meta=item["dataset"].get_metadata(),
+                ),
+            )
+            out[machine.name] = (model, machine_out)
+        return out
+
+    def _run_cv_folds(
+        self,
+        trainer: FleetTrainer,
+        data: StackedData,
+        keys: np.ndarray,
+        bucket: List[Machine],
+        Xs_grid: List[np.ndarray],
+        ys_grid: List[np.ndarray],
+        models: List[BaseEstimator],
+        epochs: int,
+        batch_size: int,
+        n_splits: int = 3,
+    ) -> dict:
+        """
+        TimeSeriesSplit folds, trained fleet-wide with per-machine train
+        masks; returns per-machine thresholds and scores (the reference
+        computes these per machine in anomaly/diff.py:134-224).
+        """
+        from sklearn import metrics as skmetrics
+
+        M, n_grid = data.sample_weight.shape
+        splitter = TimeSeriesSplit(n_splits=n_splits)
+        spec = trainer.spec
+        lb = spec.lookback_window if spec.windowed else 1
+        la = trainer.lookahead
+
+        per_machine_folds: List[List[dict]] = [
+            list(splitter.split(np.zeros((len(x), 1)))) for x in Xs_grid
+        ]
+
+        scores: List[Dict[str, dict]] = [dict() for _ in bucket]
+        splits: List[dict] = [dict() for _ in bucket]
+        tag_thresholds: List[Optional[pd.Series]] = [None] * len(bucket)
+        agg_thresholds: List[Optional[float]] = [None] * len(bucket)
+        tag_thr_per_fold: List[dict] = [dict() for _ in bucket]
+        agg_thr_per_fold: List[dict] = [dict() for _ in bucket]
+        metric_funcs = {
+            "explained-variance-score": skmetrics.explained_variance_score,
+            "r2-score": skmetrics.r2_score,
+            "mean-squared-error": skmetrics.mean_squared_error,
+            "mean-absolute-error": skmetrics.mean_absolute_error,
+        }
+        raw_scores: List[Dict[str, list]] = [
+            {m: [] for m in metric_funcs} for _ in bucket
+        ]
+
+        for fold in range(n_splits):
+            train_mask = np.zeros((M, n_grid), dtype=np.float32)
+            for i in range(len(bucket)):
+                train_idx, test_idx = per_machine_folds[i][fold]
+                train_mask[i, train_idx] = 1.0
+                splits[i].update(
+                    {
+                        f"fold-{fold + 1}-n-train": int(len(train_idx)),
+                        f"fold-{fold + 1}-n-test": int(len(test_idx)),
+                    }
+                )
+            fold_params, _ = trainer.fit(
+                data,
+                keys,
+                epochs=epochs,
+                batch_size=batch_size,
+                extra_weight=train_mask,
+            )
+            preds = trainer.predict(fold_params, data.X)  # (M, n_out, f_out)
+
+            for i, model in enumerate(models):
+                _, test_idx = per_machine_folds[i][fold]
+                # model output row j corresponds to input row j + lb - 1 + la
+                out_offset = lb - 1 + la
+                test_out_rows = test_idx - out_offset
+                valid = test_out_rows >= 0
+                test_out_rows = test_out_rows[valid]
+                rows_in = test_idx[valid]
+                y_pred = preds[i][test_out_rows]
+                y_true = ys_grid[i][rows_in]
+
+                for metric_name, func in metric_funcs.items():
+                    raw_scores[i][metric_name].append(float(func(y_true, y_pred)))
+
+                if isinstance(model, DiffBasedAnomalyDetector):
+                    from sklearn.base import clone as sk_clone
+
+                    # same scaler config as the model, fitted on fold-train
+                    # targets only (parity with diff.py: the fold model's
+                    # scaler is fitted during the fold fit, pre-test)
+                    train_idx_i, _ = per_machine_folds[i][fold]
+                    scaler = sk_clone(model.scaler).fit(ys_grid[i][train_idx_i])
+                    scaled_true = scaler.transform(y_true)
+                    scaled_pred = scaler.transform(y_pred)
+                    scaled_mse = pd.Series(
+                        ((scaled_pred - scaled_true) ** 2).mean(axis=1)
+                    )
+                    mae = pd.DataFrame(np.abs(y_pred - y_true))
+                    agg_thr = scaled_mse.rolling(6).min().max()
+                    tag_thr = mae.rolling(6).min().max()
+                    tag_thr.name = f"fold-{fold}"
+                    agg_thr_per_fold[i][f"fold-{fold}"] = (
+                        float(agg_thr) if np.isfinite(agg_thr) else None
+                    )
+                    tag_thr_per_fold[i][f"fold-{fold}"] = tag_thr
+                    tag_thresholds[i] = tag_thr
+                    agg_thresholds[i] = agg_thr
+
+        for i in range(len(bucket)):
+            for metric_name, folds in raw_scores[i].items():
+                arr = np.asarray(folds)
+                entry = {
+                    "fold-mean": float(arr.mean()),
+                    "fold-std": float(arr.std()),
+                    "fold-max": float(arr.max()),
+                    "fold-min": float(arr.min()),
+                }
+                entry.update(
+                    {f"fold-{k + 1}": float(v) for k, v in enumerate(folds)}
+                )
+                scores[i][metric_name] = entry
+
+        return {
+            "scores": scores,
+            "splits": splits,
+            "tag_thresholds": tag_thresholds,
+            "agg_thresholds": agg_thresholds,
+            "tag_thr_per_fold": tag_thr_per_fold,
+            "agg_thr_per_fold": agg_thr_per_fold,
+        }
+
+    @staticmethod
+    def _apply_thresholds(model: DiffBasedAnomalyDetector, fold_records: dict, i: int):
+        model.feature_thresholds_ = fold_records["tag_thresholds"][i]
+        agg = fold_records["agg_thresholds"][i]
+        model.aggregate_threshold_ = float(agg) if agg is not None else None
+        model.feature_thresholds_per_fold_ = pd.DataFrame(
+            {k: v for k, v in fold_records["tag_thr_per_fold"][i].items()}
+        ).T
+        model.aggregate_thresholds_per_fold_ = fold_records["agg_thr_per_fold"][i]
+        model.smooth_aggregate_threshold_ = None
+        model.smooth_feature_thresholds_ = None

@@ -129,6 +129,19 @@ class Machine:
     def from_dict(cls, d: dict) -> "Machine":
         return cls(**d)
 
+    @classmethod
+    def unvalidated(cls, **kwargs) -> "Machine":
+        """
+        Internal fast path: construct without the expensive model-config
+        dry-run (``_strict=False``). For trusted round-trips of an
+        already-validated Machine (e.g. the builder's working copies) —
+        user-facing construction should use the normal constructor.
+        """
+        instance = cls.__new__(cls)
+        instance.__dict__["_strict"] = False
+        cls.__init__(instance, **kwargs)
+        return instance
+
     def to_dict(self) -> dict:
         return {
             "name": self.name,

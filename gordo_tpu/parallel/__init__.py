@@ -1,0 +1,29 @@
+"""
+Parallelism layer — the TPU-native replacement for the reference's
+one-Argo-pod-per-model fan-out (SURVEY.md §2.10).
+
+The reference scales by scheduling thousands of single-model containers;
+here the *fleet axis itself* is a device-mesh axis: same-architecture
+Machines' parameters are stacked on a leading axis, trained by one
+``vmap``-ed, ``jit``-compiled program whose stacked tensors are sharded
+across a ``jax.sharding.Mesh`` — collectives ride ICI, scheduling is XLA's
+problem, and one compiled program serves the whole bucket.
+
+- ``mesh``      — device-mesh construction + sharding helpers
+- ``fleet``     — FleetTrainer: stacked/vmapped train + predict
+- ``bucketing`` — grouping Machines into shape-compatible buckets
+- ``distributed`` — multi-host initialization (jax.distributed)
+"""
+
+from .mesh import fleet_sharding, get_device_mesh, replicated_sharding
+from .fleet import FleetTrainer, StackedData
+from .bucketing import bucket_machines
+
+__all__ = [
+    "get_device_mesh",
+    "fleet_sharding",
+    "replicated_sharding",
+    "FleetTrainer",
+    "StackedData",
+    "bucket_machines",
+]

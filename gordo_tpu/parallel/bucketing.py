@@ -1,0 +1,49 @@
+"""
+Bucketing: group Machines into fleets that can share one compiled program.
+
+XLA compiles one program per (architecture, tensor-geometry); a thousand
+tiny models must not mean a thousand compiles (SURVEY.md §7 "hard parts").
+Machines bucket by:
+
+- canonical model config (minus name-level noise) — same architecture,
+- n_features / n_features_out — same parameter shapes,
+- a padded-timestep bucket — data lengths round up to powers of two so a
+  fleet with slightly ragged histories still shares one program.
+"""
+
+import json
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+from gordo_tpu.machine import Machine
+
+
+def _canonical_model_key(model_config: dict) -> str:
+    return json.dumps(model_config, sort_keys=True, default=str)
+
+
+def timestep_bucket(n: int, min_bucket: int = 256) -> int:
+    """Round a data length up to the next power-of-two bucket."""
+    bucket = min_bucket
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+def bucket_machines(
+    machines: List[Machine],
+) -> Dict[Tuple[str, int, int], List[Machine]]:
+    """
+    Group machines by (canonical model config, n_features, n_features_out).
+    Data-length bucketing happens later, once data is fetched (lengths
+    aren't known at config time).
+    """
+    buckets: Dict[Tuple[str, int, int], List[Machine]] = defaultdict(list)
+    for machine in machines:
+        key = (
+            _canonical_model_key(machine.model),
+            len(machine.dataset.tag_list),
+            len(machine.dataset.target_tag_list),
+        )
+        buckets[key].append(machine)
+    return dict(buckets)

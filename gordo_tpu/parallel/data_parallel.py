@@ -1,0 +1,86 @@
+"""
+Within-model data parallelism: one (large) model, batch sharded over the
+mesh's ``data`` axis.
+
+The fleet axis covers gordo's primary scale dimension (thousands of small
+models); this module covers the orthogonal one — a single model too
+slow/big for one chip's batch throughput (e.g. the Transformer/TCN backend,
+BASELINE.json config #5). Idiomatically: params replicated, batch sharded
+with ``NamedSharding``; XLA inserts the gradient all-reduce over ICI on its
+own — no hand-written collectives.
+"""
+
+import logging
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from gordo_tpu.models.specs import ModelSpec, per_sample_loss
+from gordo_tpu.parallel.mesh import DATA_AXIS
+
+logger = logging.getLogger(__name__)
+
+
+class DataParallelTrainer:
+    """Single-model trainer with the batch axis sharded over ``axis``."""
+
+    def __init__(self, spec: ModelSpec, mesh: Mesh, axis: str = DATA_AXIS):
+        self.spec = spec
+        self.mesh = mesh
+        self.axis = axis
+        self._optimizer = spec.make_optimizer()
+        self._step_fn = None
+
+    @property
+    def batch_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec(self.axis))
+
+    @property
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    def init(self, key, example_batch) -> Tuple[Any, Any]:
+        params = self.spec.module.init(key, example_batch[:1])
+        params = jax.device_put(params, self.replicated)
+        opt_state = jax.device_put(self._optimizer.init(params), self.replicated)
+        return params, opt_state
+
+    def shard_batch(self, x):
+        return jax.device_put(jnp.asarray(x), self.batch_sharding)
+
+    def _build_step(self):
+        spec = self.spec
+        optimizer = self._optimizer
+        loss_name = spec.loss
+        module = spec.module
+
+        def loss_fn(p, xb, yb):
+            out, penalty = module.apply(p, xb)
+            return jnp.mean(per_sample_loss(loss_name, out, yb)) + penalty
+
+        def step(params, opt_state, xb, yb):
+            loss, grads = jax.value_and_grad(loss_fn)(params, xb, yb)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        rep, bsh = self.replicated, self.batch_sharding
+        return jax.jit(
+            step,
+            in_shardings=(rep, rep, bsh, bsh),
+            out_shardings=(rep, rep, rep),
+            donate_argnums=(0, 1),
+        )
+
+    def train_step(self, params, opt_state, xb, yb):
+        """
+        One optimizer step. With the batch sharded over the data axis and
+        params replicated, XLA's SPMD partitioner emits the gradient
+        all-reduce automatically.
+        """
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        return self._step_fn(params, opt_state, xb, yb)

@@ -1,0 +1,89 @@
+"""
+Multi-host initialization — the framework's distributed communication
+backend (SURVEY.md §2.10: the reference has none; coordination there is
+Argo DAG + shared PV + HTTP. Here, scaling past one host is in-process:
+``jax.distributed`` + XLA collectives over ICI within a slice and DCN
+across slices).
+
+Usage (one call per host process, before any jax computation)::
+
+    from gordo_tpu.parallel import distributed
+    distributed.initialize()          # env-driven (GKE JobSet / TPU VMs)
+    mesh = distributed.global_mesh()  # spans all hosts' devices
+
+Collectives note: fleet training needs none between machines (independent
+models); within-model data parallelism psums gradients over the mesh's
+``data`` axis, and XLA routes those over ICI automatically when the axis is
+laid out inside a slice.
+"""
+
+import logging
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+
+from gordo_tpu.parallel.mesh import FLEET_AXIS, get_device_mesh
+
+logger = logging.getLogger(__name__)
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """
+    Initialize jax.distributed for multi-host execution. With no arguments,
+    jax auto-detects from the environment (TPU metadata / GKE JobSet env
+    vars); explicit args override for bare-metal or test setups.
+
+    Safe to call when single-host: if no coordinator can be determined and
+    no multi-host env is present, this is a no-op.
+    """
+    global _initialized
+    if _initialized:
+        return
+    multi_host_env = any(
+        var in os.environ
+        for var in (
+            "COORDINATOR_ADDRESS",
+            "JAX_COORDINATOR_ADDRESS",
+            "MEGASCALE_COORDINATOR_ADDRESS",
+            "TPU_WORKER_HOSTNAMES",
+        )
+    )
+    if coordinator_address is None and num_processes is None and not multi_host_env:
+        logger.info("Single-host environment; skipping jax.distributed.initialize")
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    logger.info(
+        "jax.distributed initialized: process %d of %d",
+        jax.process_index(),
+        jax.process_count(),
+    )
+
+
+def global_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = (FLEET_AXIS,),
+):
+    """Mesh spanning all global devices (all hosts after initialize())."""
+    return get_device_mesh(shape=shape, axis_names=axis_names, devices=jax.devices())
+
+
+def process_info() -> dict:
+    """Host/process topology snapshot for logs and build metadata."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }

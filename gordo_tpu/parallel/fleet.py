@@ -1,0 +1,344 @@
+"""
+FleetTrainer: train a whole bucket of same-architecture Machines as ONE
+compiled XLA program.
+
+This is the framework's performance core — the TPU-native replacement for
+the reference's one-pod-per-model Argo fan-out (SURVEY.md §2.10, §7 stage 6):
+
+- Machines' parameters are stacked on a leading ``fleet`` axis via
+  ``vmap``-ed init; training vmaps a single-machine epoch over that axis.
+- All stacked tensors (params, opt state, data, PRNG keys) are sharded over
+  a ``jax.sharding.Mesh`` fleet axis with ``NamedSharding`` — XLA places
+  each machine's slice on a device; no collectives are needed between
+  machines (they are independent), so the program scales linearly over ICI.
+- Ragged fleets (different data lengths) are handled by padding to a common
+  grid and per-sample weight masks; ragged *epochs* by loss masking; CV
+  folds are just more masks (train-range masks), so the threshold
+  calibration runs as extra fleet fits, not per-machine loops.
+- The fleet size is padded to a multiple of the mesh size with zero-weight
+  dummy machines so shardings stay even.
+
+Within one machine the epoch runs exactly like the single-model path
+(gordo_tpu.models.core): in-jit shuffle, ``lax.scan`` over fixed-size
+minibatches, windowed gathers for sequence models.
+"""
+
+import dataclasses
+import logging
+import math
+from functools import partial
+from typing import Any, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from gordo_tpu.models.specs import ModelSpec, per_sample_loss
+from gordo_tpu.parallel.mesh import fleet_sharding, pad_to_multiple, replicated_sharding
+
+logger = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class StackedData:
+    """
+    A fleet bucket's training data, stacked and padded to a common grid.
+
+    X: (M, n, f) float32; y: (M, n, f_out); sample_weight: (M, n) in {0,1}
+    marking real (vs padding) rows per machine.
+    """
+
+    X: jnp.ndarray
+    y: jnp.ndarray
+    sample_weight: jnp.ndarray
+
+    @classmethod
+    def from_ragged(
+        cls,
+        Xs: List[np.ndarray],
+        ys: List[np.ndarray],
+        n_machines_padded: Optional[int] = None,
+        n_timesteps: Optional[int] = None,
+    ) -> "StackedData":
+        """
+        Stack per-machine (n_i, f) arrays, zero-padding rows up to the
+        longest machine (or an explicit ``n_timesteps`` grid, so slightly
+        ragged buckets share one compiled program geometry) and optionally
+        padding the fleet axis with dummy machines (all-zero weights).
+        """
+        assert len(Xs) == len(ys) and len(Xs) > 0
+        f = Xs[0].shape[1]
+        f_out = ys[0].shape[1]
+        n_max = max(max(len(x) for x in Xs), n_timesteps or 0)
+        m_total = n_machines_padded or len(Xs)
+        X = np.zeros((m_total, n_max, f), dtype=np.float32)
+        y = np.zeros((m_total, n_max, f_out), dtype=np.float32)
+        w = np.zeros((m_total, n_max), dtype=np.float32)
+        for i, (xi, yi) in enumerate(zip(Xs, ys)):
+            X[i, : len(xi)] = xi
+            y[i, : len(yi)] = yi
+            w[i, : len(xi)] = 1.0
+        return cls(jnp.asarray(X), jnp.asarray(y), jnp.asarray(w))
+
+    @property
+    def n_machines(self) -> int:
+        return self.X.shape[0]
+
+    @property
+    def n_timesteps(self) -> int:
+        return self.X.shape[1]
+
+
+class FleetTrainer:
+    """
+    Train/predict a fleet of identical-architecture models in one program.
+
+    Parameters
+    ----------
+    spec
+        The shared architecture (a factory's ModelSpec).
+    lookahead
+        Target offset for windowed (sequence) models.
+    mesh
+        Device mesh; None trains unsharded on the default device.
+    donate
+        Donate param/opt buffers across epoch calls (halves HBM traffic).
+    """
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        lookahead: int = 0,
+        mesh: Optional[Mesh] = None,
+        donate: bool = True,
+    ):
+        self.spec = spec
+        self.lookahead = int(lookahead) if spec.windowed else 0
+        self.mesh = mesh
+        self.donate = donate
+        self._optimizer = spec.make_optimizer()
+        self._epoch_fn_cache: dict = {}
+
+    # -- setup -----------------------------------------------------------
+    def machine_keys(self, n_machines: int, seed: int = 0) -> jnp.ndarray:
+        """(M,) stacked PRNG keys — one independent stream per machine."""
+        return jax.random.split(jax.random.PRNGKey(seed), n_machines)
+
+    def init_params(self, keys: jnp.ndarray, n_features: int) -> Any:
+        """vmap-ed init -> param pytree with leading fleet axis."""
+        lb = self.spec.lookback_window if self.spec.windowed else 1
+        if self.spec.windowed:
+            example = jnp.zeros((1, lb, n_features), dtype=jnp.float32)
+        else:
+            example = jnp.zeros((1, n_features), dtype=jnp.float32)
+        init_one = lambda k: self.spec.module.init(k, example)
+        params = jax.vmap(init_one)(keys)
+        return self._shard(params)
+
+    def init_opt_state(self, params: Any) -> Any:
+        opt_state = jax.vmap(self._optimizer.init)(params)
+        return self._shard(opt_state)
+
+    def _shard(self, tree: Any) -> Any:
+        if self.mesh is None:
+            return tree
+        sharding = fleet_sharding(self.mesh)
+        return jax.device_put(tree, sharding)
+
+    def shard_data(self, data: StackedData) -> StackedData:
+        if self.mesh is None:
+            return data
+        sharding = fleet_sharding(self.mesh)
+        return StackedData(
+            X=jax.device_put(data.X, sharding),
+            y=jax.device_put(data.y, sharding),
+            sample_weight=jax.device_put(data.sample_weight, sharding),
+        )
+
+    # -- the compiled epoch ---------------------------------------------
+    def _epoch_fn(self, n: int, batch_size: int, shuffle: bool):
+        """
+        Build (and cache) the jitted fleet-epoch function for a given
+        (timesteps, batch_size) geometry. One compiled program per geometry,
+        reused across the whole fleet and all epochs/folds.
+        """
+        cache_key = (n, batch_size, shuffle)
+        if cache_key in self._epoch_fn_cache:
+            return self._epoch_fn_cache[cache_key]
+
+        spec = self.spec
+        optimizer = self._optimizer
+        lb = spec.lookback_window if spec.windowed else 1
+        la = self.lookahead
+        n_samples = (n - lb + 1 - la) if spec.windowed else n
+        if n_samples <= 0:
+            raise ValueError(
+                f"Not enough timesteps ({n}) for lookback={lb}, lookahead={la}"
+            )
+        n_batches = max(1, math.ceil(n_samples / batch_size))
+        n_pad = n_batches * batch_size
+
+        sample_ids = np.zeros(n_pad, dtype=np.int32)
+        sample_ids[:n_samples] = np.arange(n_samples, dtype=np.int32)
+        pad_mask = np.zeros(n_pad, dtype=np.float32)
+        pad_mask[:n_samples] = 1.0
+
+        loss_name = spec.loss
+        module = spec.module
+        windowed = spec.windowed
+
+        def gather(Xi, yi, wi, sel):
+            # Xi: (n, f); sel: (batch,) window starts / row ids
+            if windowed:
+                rows = sel[:, None] + jnp.arange(lb, dtype=jnp.int32)[None, :]
+                xb = Xi[rows]                      # (batch, lb, f)
+                tgt = sel + (lb - 1 + la)
+                yb = yi[tgt]
+                # a sample is valid only if its whole window + target is real
+                wb = jnp.min(wi[rows], axis=1) * wi[tgt]
+            else:
+                xb = Xi[sel]
+                yb = yi[sel]
+                wb = wi[sel]
+            return xb, yb, wb
+
+        def machine_epoch(params, opt_state, key, Xi, yi, wi):
+            """One epoch for ONE machine; vmapped over the fleet axis."""
+            ids = jnp.asarray(sample_ids)
+            pmask = jnp.asarray(pad_mask)
+            if shuffle:
+                perm = jax.random.permutation(key, n_pad)
+                ids = ids[perm]
+                pmask = pmask[perm]
+            sel_all = ids.reshape(n_batches, batch_size)
+            pm_all = pmask.reshape(n_batches, batch_size)
+
+            def loss_fn(p, xb, yb, wb, dropout_key):
+                out, penalty = module.apply(
+                    p, xb, deterministic=False, rngs={"dropout": dropout_key}
+                )
+                per = per_sample_loss(loss_name, out, yb)
+                total_w = jnp.maximum(jnp.sum(wb), 1.0)
+                return jnp.sum(per * wb) / total_w + penalty, jnp.sum(per * wb)
+
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+            def step(carry, batch):
+                p, o = carry
+                sel, pm, idx = batch
+                xb, yb, wb = gather(Xi, yi, wi, sel)
+                wb = wb * pm
+                dkey = jax.random.fold_in(key, idx)
+                (_, loss_sum), grads = grad_fn(p, xb, yb, wb, dkey)
+                updates, o = optimizer.update(grads, o, p)
+                p = jax.tree.map(lambda a, u: a + u, p, updates)
+                return (p, o), (loss_sum, jnp.sum(wb))
+
+            step_ids = jnp.arange(n_batches, dtype=jnp.int32)
+            (params, opt_state), (loss_sums, w_sums) = jax.lax.scan(
+                step, (params, opt_state), (sel_all, pm_all, step_ids)
+            )
+            epoch_loss = jnp.sum(loss_sums) / jnp.maximum(jnp.sum(w_sums), 1.0)
+            return params, opt_state, epoch_loss
+
+        fleet_epoch = jax.vmap(machine_epoch)
+
+        jit_kwargs: dict = {}
+        if self.mesh is not None:
+            fs = fleet_sharding(self.mesh)
+            jit_kwargs["in_shardings"] = (fs, fs, fs, fs, fs, fs)
+            jit_kwargs["out_shardings"] = (fs, fs, fs)
+        if self.donate:
+            jit_kwargs["donate_argnums"] = (0, 1)
+
+        fn = jax.jit(fleet_epoch, **jit_kwargs)
+        self._epoch_fn_cache[cache_key] = fn
+        return fn
+
+    # -- public API ------------------------------------------------------
+    def fit(
+        self,
+        data: StackedData,
+        keys: jnp.ndarray,
+        epochs: int = 1,
+        batch_size: int = 32,
+        shuffle: Optional[bool] = None,
+        params: Any = None,
+        extra_weight: Optional[jnp.ndarray] = None,
+    ) -> Tuple[Any, np.ndarray]:
+        """
+        Train the fleet. Returns (stacked params, losses (epochs, M)).
+
+        ``extra_weight`` ((M, n), e.g. a CV-fold train mask) multiplies the
+        base sample weights — this is how fold training reuses the same
+        compiled program.
+        """
+        if shuffle is None:
+            shuffle = not self.spec.windowed
+        data = self.shard_data(data)
+        w = data.sample_weight
+        if extra_weight is not None:
+            w = w * self._shard(jnp.asarray(extra_weight))
+
+        if params is None:
+            params = self.init_params(keys, data.X.shape[-1])
+        opt_state = self.init_opt_state(params)
+        keys = self._shard(jnp.asarray(keys))
+
+        epoch_fn = self._epoch_fn(data.n_timesteps, batch_size, shuffle)
+        losses = []
+        for epoch in range(epochs):
+            epoch_keys = jax.vmap(lambda k: jax.random.fold_in(k, epoch))(keys)
+            params, opt_state, epoch_loss = epoch_fn(
+                params, opt_state, epoch_keys, data.X, data.y, w
+            )
+            losses.append(np.asarray(epoch_loss))
+        return params, np.stack(losses) if losses else np.zeros((0, data.n_machines))
+
+    def predict(self, params: Any, X: jnp.ndarray, batch_size: int = 8192) -> np.ndarray:
+        """
+        Fleet forward pass. X: (M, n, f) ->
+        (M, n_out, f_out) where n_out = n - lookback + 1 - lookahead for
+        windowed models, else n.
+        """
+        spec = self.spec
+        lb = spec.lookback_window if spec.windowed else 1
+        la = self.lookahead
+        n = X.shape[1]
+
+        if spec.windowed:
+            n_out = n - lb + 1 - la
+            starts = jnp.arange(n_out, dtype=jnp.int32)
+            rows = starts[:, None] + jnp.arange(lb, dtype=jnp.int32)[None, :]
+
+            def one(p, Xi):
+                windows = Xi[rows]  # (n_out, lb, f)
+                out, _ = spec.module.apply(p, windows)
+                return out
+
+        else:
+            def one(p, Xi):
+                out, _ = spec.module.apply(p, Xi)
+                return out
+
+        fleet_apply = jax.vmap(one)
+        if self.mesh is not None:
+            fs = fleet_sharding(self.mesh)
+            fleet_apply = jax.jit(
+                fleet_apply, in_shardings=(fs, fs), out_shardings=fs
+            )
+        else:
+            fleet_apply = jax.jit(fleet_apply)
+        return np.asarray(fleet_apply(params, jnp.asarray(X)))
+
+    @staticmethod
+    def unstack_params(params: Any, index: int) -> Any:
+        """Extract machine ``index``'s param pytree from the stacked fleet."""
+        return jax.tree.map(lambda a: np.asarray(a[index]), params)
+
+    @staticmethod
+    def pad_fleet_size(n_machines: int, mesh: Optional[Mesh]) -> int:
+        if mesh is None:
+            return n_machines
+        return pad_to_multiple(n_machines, mesh.devices.size)

@@ -1,0 +1,64 @@
+"""
+Device-mesh construction and sharding helpers.
+
+Axis convention:
+
+- ``fleet`` — the machine axis: independent models, embarrassingly parallel,
+  sharded so each device (or device group) trains a slice of the fleet.
+- ``data``  — optional within-model data parallelism for big single models
+  (gradients psum across this axis).
+
+On a v5e-16 slice the default is a 1-D ``fleet=16`` mesh; multi-host
+deployments initialize ``jax.distributed`` first (see
+gordo_tpu.parallel.distributed) and the mesh spans all global devices, with
+the fleet axis laid out over ICI.
+"""
+
+import logging
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+logger = logging.getLogger(__name__)
+
+FLEET_AXIS = "fleet"
+DATA_AXIS = "data"
+
+
+def get_device_mesh(
+    shape: Optional[Tuple[int, ...]] = None,
+    axis_names: Sequence[str] = (FLEET_AXIS,),
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """
+    Build a Mesh over the available devices. Default: 1-D mesh over all
+    devices named ``fleet``.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if shape is None:
+        shape = (len(devices),) + (1,) * (len(axis_names) - 1)
+    n_needed = int(np.prod(shape))
+    if n_needed > len(devices):
+        raise ValueError(
+            f"Mesh shape {shape} needs {n_needed} devices; only "
+            f"{len(devices)} available"
+        )
+    device_array = np.array(devices[:n_needed]).reshape(shape)
+    return Mesh(device_array, axis_names=tuple(axis_names))
+
+
+def fleet_sharding(mesh: Mesh, axis: str = FLEET_AXIS) -> NamedSharding:
+    """Shard an array's leading (machine) dimension over the fleet axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    """Fully replicated across the mesh."""
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    """Smallest value >= n divisible by ``multiple``."""
+    return ((n + multiple - 1) // multiple) * multiple
