@@ -1,0 +1,1 @@
+from gordo_tpu.server.app import build_app, run_server  # noqa: F401

@@ -1,0 +1,482 @@
+"""
+The model server (reference parity: gordo/server/server.py + views/).
+
+Built directly on werkzeug (no Flask in this stack): a single
+:class:`GordoApp` WSGI callable owns the URL map, the revision-resolving
+middleware, response stamping (``revision`` + ``Server-Timing``), the
+Envoy/Ambassador prefix adapter, and optional Prometheus instrumentation.
+
+Route surface (reference: gordo/server/views/base.py:271-280,
+views/anomaly.py:150-152, server.py:204-209):
+
+- ``GET  /healthcheck``
+- ``GET  /server-version``
+- ``GET  /gordo/v0/<project>/models``
+- ``GET  /gordo/v0/<project>/revisions``
+- ``GET  /gordo/v0/<project>/expected-models``
+- ``GET  /gordo/v0/<project>/<name>/metadata`` (also ``…/healthcheck``)
+- ``GET  /gordo/v0/<project>/<name>/download-model``
+- ``POST /gordo/v0/<project>/<name>/prediction``
+- ``POST /gordo/v0/<project>/<name>/anomaly/prediction``
+
+Revision semantics (reference: server.py:164-195): the env var named by
+``MODEL_COLLECTION_DIR_ENV_VAR`` points at the *latest* revision directory;
+``?revision=``/``revision`` header selects a sibling directory, responding
+410 when it does not exist; every JSON body and response carries the
+revision served.
+"""
+
+import io
+import json
+import logging
+import os
+import timeit
+import traceback
+import typing
+
+import pandas as pd
+from werkzeug.exceptions import HTTPException, NotFound
+from werkzeug.routing import Map, Rule
+from werkzeug.wrappers import Request, Response
+
+from gordo_tpu import __version__, serializer
+from gordo_tpu.data.sensor_tag import normalize_sensor_tags
+from gordo_tpu.models import utils as model_utils
+from gordo_tpu.server import model_io
+from gordo_tpu.server import utils as server_utils
+from gordo_tpu.server.utils import ApiError
+from gordo_tpu.utils.compat import normalize_frequency
+
+logger = logging.getLogger(__name__)
+
+
+class Config:
+    """Default app config (reference: gordo/server/config.py)."""
+
+    MODEL_COLLECTION_DIR_ENV_VAR = "MODEL_COLLECTION_DIR"
+    EXPECTED_MODELS_ENV_VAR = "EXPECTED_MODELS"
+    ENABLE_PROMETHEUS = False
+    PROJECT: typing.Optional[str] = None
+
+    def to_dict(self) -> dict:
+        return {
+            k: getattr(self, k) for k in dir(self) if k.isupper()
+        }
+
+
+class RequestContext:
+    """Per-request state — the werkzeug-native stand-in for ``flask.g``."""
+
+    def __init__(self):
+        self.start_time = timeit.default_timer()
+        self.collection_dir: str = ""
+        self.current_revision: str = ""
+        self.revision: str = ""
+        self.X: typing.Optional[pd.DataFrame] = None
+        self.y: typing.Optional[pd.DataFrame] = None
+        self.model = None
+        self.metadata: typing.Optional[dict] = None
+
+
+def _json_response(payload: dict, status: int = 200) -> Response:
+    return Response(
+        json.dumps(payload, default=str),
+        status=status,
+        mimetype="application/json",
+    )
+
+
+class GordoApp:
+    """WSGI application serving a collection of built model artifacts."""
+
+    def __init__(self, config: typing.Optional[dict] = None):
+        self.config = Config().to_dict()
+        if config:
+            self.config.update(config)
+
+        self.url_map = Map(
+            [
+                Rule("/healthcheck", endpoint="healthcheck", methods=["GET"]),
+                Rule("/server-version", endpoint="server_version", methods=["GET"]),
+                Rule(
+                    "/gordo/v0/<gordo_project>/models",
+                    endpoint="models",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/revisions",
+                    endpoint="revisions",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/expected-models",
+                    endpoint="expected_models",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/<gordo_name>/metadata",
+                    endpoint="metadata",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/<gordo_name>/healthcheck",
+                    endpoint="metadata",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/<gordo_name>/download-model",
+                    endpoint="download_model",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/<gordo_name>/prediction",
+                    endpoint="prediction",
+                    methods=["POST"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/<gordo_name>/anomaly/prediction",
+                    endpoint="anomaly_prediction",
+                    methods=["POST"],
+                ),
+            ],
+            strict_slashes=False,
+        )
+        self.prometheus_metrics = None
+        if self.config.get("ENABLE_PROMETHEUS"):
+            from gordo_tpu.server.prometheus.metrics import (
+                GordoServerPrometheusMetrics,
+            )
+
+            self.prometheus_metrics = GordoServerPrometheusMetrics.create(
+                project=self.config.get("PROJECT"),
+                registry=self.config.get("PROMETHEUS_REGISTRY"),
+            )
+
+    # -- WSGI plumbing -----------------------------------------------------
+
+    def __call__(self, environ, start_response):
+        adapt_proxy_deployment(environ)
+        request = Request(environ)
+        response = self.dispatch(request)
+        return response(environ, start_response)
+
+    def dispatch(self, request: Request) -> Response:
+        ctx = RequestContext()
+        adapter = self.url_map.bind_to_environ(request.environ)
+        endpoint = None
+        try:
+            endpoint, url_args = adapter.match()
+            resolution = self._resolve_revision(ctx, request)
+            if resolution is not None:
+                response = resolution  # 410: revision gone
+            else:
+                handler = getattr(self, f"view_{endpoint}")
+                response = handler(ctx, request, **url_args)
+        except ApiError as exc:
+            response = _json_response(exc.payload, exc.status)
+        except HTTPException as exc:
+            response = exc.get_response(request.environ)
+        except Exception:
+            logger.error("Unhandled server error:\n%s", traceback.format_exc())
+            response = _json_response(
+                {"error": "Something unexpected happened; check your input data"},
+                500,
+            )
+        return self._finalize(ctx, request, response, endpoint)
+
+    def _resolve_revision(
+        self, ctx: RequestContext, request: Request
+    ) -> typing.Optional[Response]:
+        """Reference: server/server.py:164-186."""
+        ctx.collection_dir = os.environ[self.config["MODEL_COLLECTION_DIR_ENV_VAR"]]
+        ctx.current_revision = os.path.basename(ctx.collection_dir)
+        requested = request.args.get("revision") or request.headers.get("revision")
+        if requested:
+            ctx.revision = requested
+            ctx.collection_dir = os.path.join(ctx.collection_dir, "..", requested)
+            try:
+                os.listdir(ctx.collection_dir)
+            except FileNotFoundError:
+                return _json_response(
+                    {"error": f"Revision '{requested}' not found."}, 410
+                )
+        else:
+            ctx.revision = ctx.current_revision
+        return None
+
+    def _finalize(
+        self,
+        ctx: RequestContext,
+        request: Request,
+        response: Response,
+        endpoint: typing.Optional[str],
+    ) -> Response:
+        """Stamp revision + Server-Timing (reference: server.py:188-202)."""
+        if ctx.revision:
+            if response.mimetype == "application/json":
+                try:
+                    data = json.loads(response.get_data())
+                    if isinstance(data, dict):
+                        data["revision"] = ctx.revision
+                        response.set_data(json.dumps(data).encode())
+                except ValueError:
+                    pass
+            response.headers["revision"] = ctx.revision
+        runtime_s = timeit.default_timer() - ctx.start_time
+        response.headers["Server-Timing"] = f"request_walltime_s;dur={runtime_s}"
+        if self.prometheus_metrics is not None and request.path != "/healthcheck":
+            self.prometheus_metrics.observe(
+                request=request,
+                endpoint=endpoint or "unmatched",
+                status=response.status_code,
+                duration=runtime_s,
+            )
+        return response
+
+    # -- model/metadata loading --------------------------------------------
+
+    def _get_model(self, ctx: RequestContext, name: str):
+        try:
+            ctx.model = server_utils.load_model(ctx.collection_dir, name)
+        except FileNotFoundError:
+            raise NotFound(f"Model '{name}' not found in revision {ctx.revision}")
+        return ctx.model
+
+    def _get_metadata(self, ctx: RequestContext, name: str) -> dict:
+        try:
+            ctx.metadata = server_utils.load_metadata(ctx.collection_dir, name)
+        except FileNotFoundError:
+            raise NotFound(f"Metadata for '{name}' not found")
+        return ctx.metadata
+
+    @staticmethod
+    def _tags(metadata: dict) -> typing.List:
+        dataset = metadata["dataset"]
+        return normalize_sensor_tags(
+            dataset["tag_list"],
+            asset=dataset.get("asset"),
+            default_asset=dataset.get("default_asset"),
+        )
+
+    @staticmethod
+    def _target_tags(metadata: dict) -> typing.List:
+        dataset = metadata["dataset"]
+        if dataset.get("target_tag_list"):
+            return normalize_sensor_tags(
+                dataset["target_tag_list"],
+                asset=dataset.get("asset"),
+                default_asset=dataset.get("default_asset"),
+            )
+        return []
+
+    # -- views -------------------------------------------------------------
+
+    def view_healthcheck(self, ctx, request) -> Response:
+        return Response("", 200)
+
+    def view_server_version(self, ctx, request) -> Response:
+        return _json_response({"version": __version__})
+
+    def view_models(self, ctx, request, gordo_project: str) -> Response:
+        try:
+            available = os.listdir(ctx.collection_dir)
+        except FileNotFoundError:
+            available = []
+        return _json_response({"models": available})
+
+    def view_revisions(self, ctx, request, gordo_project: str) -> Response:
+        try:
+            available = os.listdir(os.path.join(ctx.collection_dir, ".."))
+        except FileNotFoundError:
+            logger.error(
+                "Attempted to list directories above %s but failed with: %s",
+                ctx.collection_dir,
+                traceback.format_exc(),
+            )
+            available = [ctx.current_revision]
+        return _json_response(
+            {"latest": ctx.current_revision, "available-revisions": available}
+        )
+
+    def view_expected_models(self, ctx, request, gordo_project: str) -> Response:
+        expected = self.config.get("EXPECTED_MODELS") or json.loads(
+            os.environ.get(self.config["EXPECTED_MODELS_ENV_VAR"], "[]")
+        )
+        return _json_response({"expected-models": expected})
+
+    def view_metadata(
+        self, ctx, request, gordo_project: str, gordo_name: str
+    ) -> Response:
+        metadata = self._get_metadata(ctx, gordo_name)
+        env_var = self.config["MODEL_COLLECTION_DIR_ENV_VAR"]
+        return _json_response(
+            {
+                "gordo-server-version": __version__,
+                "metadata": metadata,
+                "env": {env_var: os.environ.get(env_var)},
+            }
+        )
+
+    def view_download_model(
+        self, ctx, request, gordo_project: str, gordo_name: str
+    ) -> Response:
+        model = self._get_model(ctx, gordo_name)
+        serialized = serializer.dumps(model)
+        return Response(
+            serialized,
+            200,
+            mimetype="application/octet-stream",
+            headers={"Content-Disposition": "attachment; filename=model.tar.gz"},
+        )
+
+    def view_prediction(
+        self, ctx, request, gordo_project: str, gordo_name: str
+    ) -> Response:
+        """Reference: views/base.py:107-187."""
+        model = self._get_model(ctx, gordo_name)
+        metadata = self._get_metadata(ctx, gordo_name)
+        tags = self._tags(metadata)
+        target_tags = self._target_tags(metadata) or tags
+        ctx.X, ctx.y = server_utils.extract_X_y(
+            request, [t.name for t in tags], [t.name for t in target_tags]
+        )
+
+        start = timeit.default_timer()
+        try:
+            output = model_io.get_model_output(model=model, X=ctx.X)
+        except ValueError as err:
+            logger.error(
+                "Failed to predict or transform; error: %s - \nTraceback: %s",
+                err,
+                traceback.format_exc(),
+            )
+            return _json_response({"error": f"ValueError: {err}"}, 400)
+        except Exception as exc:
+            logger.error(
+                "Failed to predict or transform; error: %s - \nTraceback: %s",
+                exc,
+                traceback.format_exc(),
+            )
+            return _json_response(
+                {"error": "Something unexpected happened; check your input data"},
+                400,
+            )
+        logger.debug(
+            "Calculating model output took %.4fs", timeit.default_timer() - start
+        )
+
+        data = model_utils.make_base_dataframe(
+            tags=tags,
+            model_input=ctx.X.values if isinstance(ctx.X, pd.DataFrame) else ctx.X,
+            model_output=output,
+            target_tag_list=target_tags,
+            index=ctx.X.index,
+        )
+        if request.args.get("format") == "parquet":
+            return Response(
+                server_utils.dataframe_into_parquet_bytes(data),
+                200,
+                mimetype="application/octet-stream",
+            )
+        context = {
+            "data": server_utils.dataframe_to_dict(data),
+            "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
+        }
+        return _json_response(context, 200)
+
+    def view_anomaly_prediction(
+        self, ctx, request, gordo_project: str, gordo_name: str
+    ) -> Response:
+        """Reference: views/anomaly.py:99-147."""
+        model = self._get_model(ctx, gordo_name)
+        metadata = self._get_metadata(ctx, gordo_name)
+        tags = self._tags(metadata)
+        target_tags = self._target_tags(metadata) or tags
+        ctx.X, ctx.y = server_utils.extract_X_y(
+            request, [t.name for t in tags], [t.name for t in target_tags]
+        )
+
+        if ctx.y is None:
+            return _json_response(
+                {"message": "Cannot perform anomaly without 'y' to compare against."},
+                400,
+            )
+
+        frequency = pd.tseries.frequencies.to_offset(
+            normalize_frequency(metadata["dataset"].get("resolution", "10min"))
+        )
+        try:
+            anomaly_df = model.anomaly(ctx.X, ctx.y, frequency=frequency)
+        except AttributeError:
+            return _json_response(
+                {
+                    "message": "Model is not an AnomalyDetector, it is of type: "
+                    f"{type(model)}"
+                },
+                422,
+            )
+
+        if request.args.get("format") == "parquet":
+            return Response(
+                server_utils.dataframe_into_parquet_bytes(anomaly_df),
+                200,
+                mimetype="application/octet-stream",
+            )
+        context = {
+            "data": server_utils.dataframe_to_dict(anomaly_df),
+            "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
+        }
+        return _json_response(context, 200)
+
+
+def adapt_proxy_deployment(environ: dict) -> None:
+    """
+    Rewrite ``SCRIPT_NAME``/``PATH_INFO`` from ``X-Envoy-Original-Path`` so
+    apps served behind an Ambassador/Envoy path prefix build correct URLs
+    (reference: server/server.py:45-118).
+    """
+    original = environ.get("HTTP_X_ENVOY_ORIGINAL_PATH")
+    if not original:
+        return
+    original = original.split("?")[0]
+    path = environ.get("PATH_INFO", "")
+    if original.endswith(path) and original != path:
+        environ["SCRIPT_NAME"] = original[: len(original) - len(path)]
+
+
+def build_app(
+    config: typing.Optional[dict] = None,
+    prometheus_registry=None,
+) -> GordoApp:
+    """Build the WSGI app (reference: server/server.py:138-212)."""
+    config = dict(config or {})
+    if prometheus_registry is not None:
+        if config.get("ENABLE_PROMETHEUS"):
+            config["PROMETHEUS_REGISTRY"] = prometheus_registry
+        else:
+            logger.warning("Ignoring non empty prometheus_registry argument")
+    return GordoApp(config)
+
+
+def run_server(
+    host: str,
+    port: int,
+    workers: int = 2,
+    log_level: str = "debug",
+    config: typing.Optional[dict] = None,
+    threads: typing.Optional[int] = None,
+    worker_connections: typing.Optional[int] = None,
+    server_app: str = "gordo_tpu.server.app:build_app()",
+):
+    """
+    Run the server (reference: server/server.py:230-294, which shells out
+    to gunicorn). This stack serves with werkzeug's threaded WSGI server —
+    TPU work is dispatch-bound, so one process with many threads keeps a
+    single device context hot; scale-out is by replica, as in the
+    reference's HPA deployment.
+    """
+    from werkzeug.serving import run_simple
+
+    logging.getLogger("werkzeug").setLevel(log_level.upper())
+    app = build_app(config)
+    run_simple(host, port, app, threaded=True, use_reloader=False)

@@ -1,0 +1,19 @@
+"""
+Model output dispatch (reference parity: gordo/server/model_io.py:16-41).
+"""
+
+import logging
+from typing import Any
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def get_model_output(model: Any, X) -> np.ndarray:
+    """``model.predict(X)``, falling back to ``model.transform(X)``."""
+    try:
+        return np.asarray(model.predict(X))
+    except AttributeError:
+        logger.debug("Model has no predict method; trying transform")
+        return np.asarray(model.transform(X))

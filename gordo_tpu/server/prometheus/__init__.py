@@ -1,0 +1,3 @@
+from gordo_tpu.server.prometheus.metrics import (  # noqa: F401
+    GordoServerPrometheusMetrics,
+)

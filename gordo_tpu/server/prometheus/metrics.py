@@ -1,0 +1,97 @@
+"""
+Prometheus instrumentation (reference parity:
+gordo/server/prometheus/metrics.py:33-141): request-duration histogram +
+request counter, labeled (method, path, status, model, project), plus a
+version/project Info metric.
+"""
+
+import logging
+import typing
+
+from prometheus_client import (
+    REGISTRY,
+    CollectorRegistry,
+    Counter,
+    Histogram,
+    Info,
+)
+
+from gordo_tpu import __version__
+
+logger = logging.getLogger(__name__)
+
+
+class GordoServerPrometheusMetrics:
+    """Observes every request dispatched by :class:`gordo_tpu.server.app.GordoApp`."""
+
+    def __init__(
+        self,
+        info: typing.Optional[dict] = None,
+        registry: typing.Optional[CollectorRegistry] = None,
+        label_project: bool = True,
+    ):
+        self.registry = registry if registry is not None else REGISTRY
+        self.label_project = label_project
+        labels = ["method", "path", "status_code", "gordo_name"]
+        if label_project:
+            labels.append("gordo_project")
+
+        self.info = Info(
+            "gordo_server", "Gordo TPU server info", registry=self.registry
+        )
+        self.info.info(info or {"version": __version__})
+        self.request_duration_seconds = Histogram(
+            "gordo_server_request_duration_seconds",
+            "HTTP request duration, in seconds",
+            labels,
+            registry=self.registry,
+        )
+        self.requests_total = Counter(
+            "gordo_server_requests_total",
+            "Total HTTP requests",
+            labels,
+            registry=self.registry,
+        )
+
+    @classmethod
+    def create(
+        cls,
+        project: typing.Optional[str] = None,
+        registry: typing.Optional[CollectorRegistry] = None,
+    ) -> "GordoServerPrometheusMetrics":
+        """Reference: server/server.py:120-135."""
+        info = {"version": __version__}
+        if project is not None:
+            info["project"] = project
+        return cls(info=info, registry=registry, label_project=project is None)
+
+    def observe(self, request, endpoint: str, status: int, duration: float):
+        view_args = getattr(request, "view_args", None) or {}
+        # fall back to parsing the matched path for model/project labels
+        parts = request.path.strip("/").split("/")
+        model = view_args.get("gordo_name", "")
+        project = view_args.get("gordo_project", "")
+        if not project and len(parts) >= 3 and parts[0] == "gordo":
+            project = parts[2]
+            if len(parts) >= 5:
+                model = parts[3]
+        labels = {
+            "method": request.method,
+            "path": endpoint,
+            "status_code": str(status),
+            "gordo_name": model,
+        }
+        if self.label_project:
+            labels["gordo_project"] = project
+        self.request_duration_seconds.labels(**labels).observe(duration)
+        self.requests_total.labels(**labels).inc()
+
+
+def metrics_app(registry: typing.Optional[CollectorRegistry] = None):
+    """
+    Standalone WSGI app exposing ``/metrics``
+    (reference: gordo/server/prometheus/server.py:7-25).
+    """
+    from prometheus_client import make_wsgi_app
+
+    return make_wsgi_app(registry if registry is not None else REGISTRY)

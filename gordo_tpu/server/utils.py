@@ -1,0 +1,228 @@
+"""
+Server request/response helpers (reference parity: gordo/server/utils.py):
+MultiIndex-aware dataframe ⇄ dict and ⇄ parquet bridges, input verification,
+X/y extraction from JSON or multipart-parquet bodies, and the model /
+metadata caches.
+
+TPU note: models are loaded once per (revision, name) and kept hot — the
+wrapped estimators hold their parameters on device, so the lru-cached load
+here is what keeps the fleet TPU-resident between requests.
+"""
+
+import io
+import logging
+import os
+import pickle
+import timeit
+import zlib
+from datetime import datetime
+from functools import lru_cache
+from typing import Any, List, Optional, Tuple, Union
+
+import pandas as pd
+import pyarrow as pa
+import pyarrow.parquet as pq
+from dateutil import parser as dateutil_parser
+
+from gordo_tpu import serializer
+
+logger = logging.getLogger(__name__)
+
+
+class ApiError(Exception):
+    """An error that maps straight to a JSON error response."""
+
+    def __init__(self, payload: dict, status: int = 400):
+        super().__init__(str(payload))
+        self.payload = payload
+        self.status = status
+
+
+def dataframe_to_dict(df: pd.DataFrame) -> dict:
+    """
+    JSON-serializable dict from a (possibly 2-level MultiIndex-columned)
+    dataframe: top-level column name -> nested ``DataFrame.to_dict()``
+    (reference: server/utils.py:78-134).
+
+    Examples
+    --------
+    >>> import pprint
+    >>> import numpy as np
+    >>> columns = pd.MultiIndex.from_tuples(
+    ...     (f"feature{i}", f"sub-feature-{ii}") for i in range(2) for ii in range(2))
+    >>> index = pd.date_range('2019-01-01', '2019-02-01', periods=2)
+    >>> df = pd.DataFrame(np.arange(8).reshape((2, 4)), columns=columns, index=index)
+    >>> pprint.pprint(dataframe_to_dict(df))
+    {'feature0': {'sub-feature-0': {'2019-01-01 00:00:00': 0,
+                                    '2019-02-01 00:00:00': 4},
+                  'sub-feature-1': {'2019-01-01 00:00:00': 1,
+                                    '2019-02-01 00:00:00': 5}},
+     'feature1': {'sub-feature-0': {'2019-01-01 00:00:00': 2,
+                                    '2019-02-01 00:00:00': 6},
+                  'sub-feature-1': {'2019-01-01 00:00:00': 3,
+                                    '2019-02-01 00:00:00': 7}}}
+    """
+    data = df.copy()
+    if isinstance(data.index, pd.DatetimeIndex):
+        data.index = data.index.astype(str)
+    if isinstance(df.columns, pd.MultiIndex):
+        return {
+            col: (
+                data[col].to_dict()
+                if isinstance(data[col], pd.DataFrame)
+                else pd.DataFrame(data[col]).to_dict()
+            )
+            for col in data.columns.get_level_values(0)
+        }
+    return data.to_dict()
+
+
+def dataframe_from_dict(data: dict) -> pd.DataFrame:
+    """
+    Inverse of :func:`dataframe_to_dict`; index parsed back to datetimes
+    when possible, else ints (reference: server/utils.py:137-185).
+    """
+    if isinstance(data, dict) and any(isinstance(v, dict) for v in data.values()):
+        try:
+            keys = data.keys()
+            df: pd.DataFrame = pd.concat(
+                (pd.DataFrame.from_dict(data[key]) for key in keys), axis=1, keys=keys
+            )
+        except (ValueError, AttributeError):
+            df = pd.DataFrame.from_dict(data)
+    else:
+        df = pd.DataFrame.from_dict(data)
+
+    try:
+        df.index = df.index.map(dateutil_parser.isoparse)
+    except (TypeError, ValueError):
+        df.index = df.index.map(int)
+    df.sort_index(inplace=True)
+    return df
+
+
+def dataframe_into_parquet_bytes(
+    df: pd.DataFrame, compression: str = "snappy"
+) -> bytes:
+    """DataFrame -> parquet bytes (reference: server/utils.py:37-55)."""
+    table = pa.Table.from_pandas(df)
+    buf = pa.BufferOutputStream()
+    pq.write_table(table, buf, compression=compression)
+    return buf.getvalue().to_pybytes()
+
+
+def dataframe_from_parquet_bytes(buf: bytes) -> pd.DataFrame:
+    """Parquet bytes -> DataFrame (reference: server/utils.py:58-75)."""
+    return pq.read_table(io.BytesIO(buf)).to_pandas()
+
+
+def parse_iso_datetime(datetime_str: str) -> datetime:
+    parsed_date = dateutil_parser.isoparse(datetime_str)
+    if parsed_date.tzinfo is None:
+        raise ValueError(
+            f"Provide timezone to timestamp {datetime_str}."
+            f" Example: for UTC timezone use {datetime_str + 'Z'} or "
+            f"{datetime_str + '+00:00'} "
+        )
+    return parsed_date
+
+
+def verify_dataframe(
+    df: pd.DataFrame, expected_columns: List[str]
+) -> pd.DataFrame:
+    """
+    Column-verify client data against the model's tags: unlabeled frames of
+    the right width get the expected names; labeled frames are re-ordered and
+    pruned; mismatches raise a 400 ``ApiError``
+    (reference: server/utils.py:200-246).
+    """
+    if isinstance(df.columns, pd.MultiIndex):
+        raise ApiError(
+            {
+                "message": "Server does not support multi-level dataframes "
+                f"at this time: {df.columns.tolist()}"
+            }
+        )
+    if not all(col in df.columns for col in expected_columns):
+        if len(df.columns) != len(expected_columns):
+            raise ApiError(
+                {
+                    "message": f"Unexpected features: "
+                    f"was expecting {expected_columns} length of "
+                    f"{len(expected_columns)}, but got {df.columns} length of "
+                    f"{len(df.columns)}"
+                }
+            )
+        df.columns = expected_columns
+    else:
+        df = df[expected_columns]
+    return df
+
+
+def extract_X_y(
+    request,
+    tags: List[str],
+    target_tags: List[str],
+) -> Tuple[pd.DataFrame, Optional[pd.DataFrame]]:
+    """
+    Pull ``X`` (required) and ``y`` (optional) out of a POST request —
+    either a JSON body ``{"X": ..., "y": ...}`` or multipart parquet files
+    named ``X``/``y`` (reference: server/utils.py:249-320). Raises 400
+    ``ApiError`` when absent or malformed.
+    """
+    json_body = request.get_json(silent=True) if request.is_json else None
+    if ("X" not in (json_body or {})) and ("X" not in request.files):
+        raise ApiError({"message": 'Cannot predict without "X"'})
+
+    if json_body is not None:
+        X = dataframe_from_dict(json_body["X"])
+        y = json_body.get("y")
+        if y is not None:
+            y = dataframe_from_dict(y)
+    else:
+        X = dataframe_from_parquet_bytes(request.files["X"].read())
+        y = request.files.get("y")
+        if y is not None:
+            y = dataframe_from_parquet_bytes(y.read())
+
+    X = verify_dataframe(X, tags)
+    if y is not None:
+        y = verify_dataframe(y, target_tags)
+    return X, y
+
+
+@lru_cache(maxsize=int(os.getenv("N_CACHED_MODELS", 2)))
+def load_model(directory: str, name: str) -> Any:
+    """
+    Load (and cache) a model artifact from ``<directory>/<name>``
+    (reference: server/utils.py:323-343). 404-mapping is the caller's job.
+    """
+    start = timeit.default_timer()
+    model = serializer.load(os.path.join(directory, name))
+    logger.debug(
+        "Model '%s' loaded in %.3fs", name, timeit.default_timer() - start
+    )
+    return model
+
+
+@lru_cache(maxsize=int(os.getenv("N_CACHED_METADATA", 25000)))
+def _load_compressed_metadata(directory: str, name: str) -> bytes:
+    """
+    Metadata cached zlib-compressed-pickled so thousands of entries stay
+    cheap in RAM (reference: server/utils.py:346-397).
+    """
+    target = os.path.join(directory, name)
+    if not os.path.isdir(target):
+        raise FileNotFoundError(f"No model directory at {target}")
+    metadata = serializer.load_metadata(target)
+    return zlib.compress(pickle.dumps(metadata))
+
+
+def load_metadata(directory: str, name: str) -> dict:
+    return pickle.loads(zlib.decompress(_load_compressed_metadata(directory, name)))
+
+
+def clear_caches():
+    """Drop the model/metadata caches (tests and revision rollover)."""
+    load_model.cache_clear()
+    _load_compressed_metadata.cache_clear()

@@ -30,3 +30,86 @@ import pytest  # noqa: E402
 @pytest.fixture(scope="session")
 def tmp_dir_session(tmp_path_factory):
     return tmp_path_factory.mktemp("gordo-tpu-session")
+
+
+# --- the one-real-trained-artifact fixture spine (SURVEY.md §4) -------------
+
+GORDO_PROJECT = "gordo-test"
+GORDO_TARGETS = ["gordo-test-model"]
+GORDO_SINGLE_TARGET = GORDO_TARGETS[0]
+GORDO_BASE_TARGETS = ["gordo-base-model"]
+GORDO_REVISION = "1573740000000"
+
+SENSORS = [f"tag-{i}" for i in range(4)]
+
+CONFIG_STR = f"""
+machines:
+  - name: {GORDO_SINGLE_TARGET}
+    dataset:
+      type: RandomDataset
+      tags: {SENSORS}
+      target_tag_list: {SENSORS}
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-03T00:00:00+00:00'
+      asset: gra
+    model:
+      gordo_tpu.models.anomaly.DiffBasedAnomalyDetector:
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+              - sklearn.preprocessing.MinMaxScaler
+              - gordo_tpu.models.AutoEncoder:
+                  kind: feedforward_hourglass
+                  epochs: 2
+  - name: {GORDO_BASE_TARGETS[0]}
+    dataset:
+      type: RandomDataset
+      tags: {SENSORS}
+      target_tag_list: {SENSORS}
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-03T00:00:00+00:00'
+      asset: gra
+    model:
+      gordo_tpu.models.AutoEncoder:
+        kind: feedforward_hourglass
+        epochs: 1
+"""
+
+
+@pytest.fixture(scope="session")
+def trained_model_collection(tmp_path_factory):
+    """
+    Train the real artifacts once per session via ``local_build`` on random
+    data and lay them out the way a deployment does:
+    ``<root>/<project>/models/<revision>/<machine>/{model.pkl,metadata.json}``
+    (reference: tests/conftest.py:141-194; layout from
+    argo-workflow.yml.template:669-671).
+    """
+    from gordo_tpu import serializer
+    from gordo_tpu.builder import local_build
+
+    root = tmp_path_factory.mktemp("collection")
+    collection_dir = root / GORDO_PROJECT / "models" / GORDO_REVISION
+    for model, machine in local_build(CONFIG_STR):
+        out = collection_dir / machine.name
+        serializer.dump(model, out, metadata=machine.to_dict())
+    return collection_dir
+
+
+@pytest.fixture
+def model_collection_env(trained_model_collection, monkeypatch):
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(trained_model_collection))
+    return str(trained_model_collection)
+
+
+@pytest.fixture
+def gordo_ml_server_client(model_collection_env):
+    """werkzeug test client against the real app (reference: conftest.py:202-214)."""
+    from werkzeug.test import Client
+
+    from gordo_tpu.server import build_app
+
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.clear_caches()
+    return Client(build_app())

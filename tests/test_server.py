@@ -1,0 +1,252 @@
+"""
+Server route tests (reference test model: tests/gordo/server/test_gordo_server.py).
+"""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu import __version__, serializer
+from gordo_tpu.server import utils as server_utils
+from tests.conftest import (
+    GORDO_BASE_TARGETS,
+    GORDO_PROJECT,
+    GORDO_REVISION,
+    GORDO_SINGLE_TARGET,
+    SENSORS,
+)
+
+N_SAMPLES = 10
+
+
+def _url(*parts):
+    return "/gordo/v0/" + "/".join(parts)
+
+
+@pytest.fixture
+def sensor_frame():
+    rng = np.random.default_rng(1)
+    index = pd.date_range("2019-01-01", periods=N_SAMPLES, freq="10min", tz="UTC")
+    return pd.DataFrame(
+        rng.random((N_SAMPLES, len(SENSORS))), columns=SENSORS, index=index
+    )
+
+
+def test_healthcheck(gordo_ml_server_client):
+    resp = gordo_ml_server_client.get("/healthcheck")
+    assert resp.status_code == 200
+
+
+def test_server_version(gordo_ml_server_client):
+    resp = gordo_ml_server_client.get("/server-version")
+    assert resp.status_code == 200
+    assert json.loads(resp.get_data())["version"] == __version__
+
+
+def test_models_listing(gordo_ml_server_client):
+    resp = gordo_ml_server_client.get(_url(GORDO_PROJECT, "models"))
+    assert resp.status_code == 200
+    models = json.loads(resp.get_data())["models"]
+    assert set(models) >= {GORDO_SINGLE_TARGET, *GORDO_BASE_TARGETS}
+
+
+def test_revisions(gordo_ml_server_client):
+    resp = gordo_ml_server_client.get(_url(GORDO_PROJECT, "revisions"))
+    body = json.loads(resp.get_data())
+    assert body["latest"] == GORDO_REVISION
+    assert GORDO_REVISION in body["available-revisions"]
+    # every JSON response is stamped with the served revision
+    assert body["revision"] == GORDO_REVISION
+    assert resp.headers["revision"] == GORDO_REVISION
+
+
+def test_revision_gone(gordo_ml_server_client):
+    resp = gordo_ml_server_client.get(
+        _url(GORDO_PROJECT, "models"), query_string={"revision": "no-such-rev"}
+    )
+    assert resp.status_code == 410
+
+
+def test_revision_header_selects(gordo_ml_server_client):
+    resp = gordo_ml_server_client.get(
+        _url(GORDO_PROJECT, "models"), headers={"revision": GORDO_REVISION}
+    )
+    assert resp.status_code == 200
+    assert json.loads(resp.get_data())["revision"] == GORDO_REVISION
+
+
+def test_metadata(gordo_ml_server_client):
+    resp = gordo_ml_server_client.get(
+        _url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "metadata")
+    )
+    assert resp.status_code == 200
+    body = json.loads(resp.get_data())
+    assert body["gordo-server-version"] == __version__
+    meta = body["metadata"]
+    assert meta["name"] == GORDO_SINGLE_TARGET
+    assert meta["dataset"]["tag_list"]
+    assert "MODEL_COLLECTION_DIR" in body["env"]
+
+
+def test_download_model_roundtrip(gordo_ml_server_client, sensor_frame):
+    resp = gordo_ml_server_client.get(
+        _url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "download-model")
+    )
+    assert resp.status_code == 200
+    model = serializer.loads(resp.get_data())
+    assert hasattr(model, "anomaly")
+    out = model.predict(sensor_frame.values)
+    assert out.shape == (N_SAMPLES, len(SENSORS))
+
+
+def test_prediction_json(gordo_ml_server_client, sensor_frame):
+    payload = {
+        "X": server_utils.dataframe_to_dict(sensor_frame),
+        "y": server_utils.dataframe_to_dict(sensor_frame),
+    }
+    resp = gordo_ml_server_client.post(
+        _url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "prediction"), json=payload
+    )
+    assert resp.status_code == 200
+    body = json.loads(resp.get_data())
+    data = server_utils.dataframe_from_dict(body["data"])
+    assert "model-output" in data.columns.get_level_values(0)
+    assert "model-input" in data.columns.get_level_values(0)
+    assert len(data) == N_SAMPLES
+
+
+def test_prediction_unlabeled_matrix(gordo_ml_server_client, sensor_frame):
+    """Clients may POST bare arrays; column names are assumed from the model."""
+    X = pd.DataFrame(sensor_frame.values)  # integer columns
+    resp = gordo_ml_server_client.post(
+        _url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "prediction"),
+        json={"X": X.to_dict()},
+    )
+    assert resp.status_code == 200
+
+
+def test_prediction_wrong_width(gordo_ml_server_client):
+    X = pd.DataFrame(np.random.random((5, len(SENSORS) + 2)))
+    resp = gordo_ml_server_client.post(
+        _url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "prediction"),
+        json={"X": X.to_dict()},
+    )
+    assert resp.status_code == 400
+
+
+def test_prediction_without_x(gordo_ml_server_client):
+    resp = gordo_ml_server_client.post(
+        _url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "prediction"), json={}
+    )
+    assert resp.status_code == 400
+    assert "Cannot predict" in json.loads(resp.get_data())["message"]
+
+
+def test_prediction_parquet(gordo_ml_server_client, sensor_frame):
+    import io
+
+    files = {
+        "X": (io.BytesIO(server_utils.dataframe_into_parquet_bytes(sensor_frame)), "X"),
+    }
+    resp = gordo_ml_server_client.post(
+        _url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "prediction"),
+        query_string={"format": "parquet"},
+        data=files,
+    )
+    assert resp.status_code == 200
+    df = server_utils.dataframe_from_parquet_bytes(resp.get_data())
+    assert "model-output" in df.columns.get_level_values(0)
+
+
+def test_anomaly_prediction(gordo_ml_server_client, sensor_frame):
+    payload = {
+        "X": server_utils.dataframe_to_dict(sensor_frame),
+        "y": server_utils.dataframe_to_dict(sensor_frame),
+    }
+    resp = gordo_ml_server_client.post(
+        _url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "anomaly", "prediction"),
+        json=payload,
+    )
+    assert resp.status_code == 200
+    body = json.loads(resp.get_data())
+    data = server_utils.dataframe_from_dict(body["data"])
+    top = set(data.columns.get_level_values(0))
+    assert {
+        "model-input",
+        "model-output",
+        "tag-anomaly-scaled",
+        "total-anomaly-scaled",
+    } <= top
+    assert body["revision"] == GORDO_REVISION
+
+
+def test_anomaly_requires_y(gordo_ml_server_client, sensor_frame):
+    resp = gordo_ml_server_client.post(
+        _url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "anomaly", "prediction"),
+        json={"X": server_utils.dataframe_to_dict(sensor_frame)},
+    )
+    assert resp.status_code == 400
+
+
+def test_anomaly_on_plain_model_is_422(gordo_ml_server_client, sensor_frame):
+    payload = {
+        "X": server_utils.dataframe_to_dict(sensor_frame),
+        "y": server_utils.dataframe_to_dict(sensor_frame),
+    }
+    resp = gordo_ml_server_client.post(
+        _url(GORDO_PROJECT, GORDO_BASE_TARGETS[0], "anomaly", "prediction"),
+        json=payload,
+    )
+    assert resp.status_code == 422
+
+
+def test_model_not_found_404(gordo_ml_server_client, sensor_frame):
+    resp = gordo_ml_server_client.get(
+        _url(GORDO_PROJECT, "no-such-model", "metadata")
+    )
+    assert resp.status_code == 404
+
+
+def test_expected_models_env(model_collection_env, monkeypatch):
+    from werkzeug.test import Client
+
+    from gordo_tpu.server import build_app
+
+    monkeypatch.setenv("EXPECTED_MODELS", json.dumps([GORDO_SINGLE_TARGET]))
+    client = Client(build_app())
+    resp = client.get(_url(GORDO_PROJECT, "expected-models"))
+    assert json.loads(resp.get_data())["expected-models"] == [GORDO_SINGLE_TARGET]
+
+
+def test_prometheus_metrics(model_collection_env):
+    from prometheus_client import CollectorRegistry
+    from werkzeug.test import Client
+
+    from gordo_tpu.server import build_app
+
+    registry = CollectorRegistry()
+    client = Client(
+        build_app(
+            config={"ENABLE_PROMETHEUS": True, "PROJECT": GORDO_PROJECT},
+            prometheus_registry=registry,
+        )
+    )
+    assert client.get(_url(GORDO_PROJECT, "models")).status_code == 200
+    count = registry.get_sample_value(
+        "gordo_server_requests_total",
+        {"method": "GET", "path": "models", "status_code": "200", "gordo_name": ""},
+    )
+    assert count == 1.0
+
+
+def test_envoy_prefix_rewrite(gordo_ml_server_client):
+    resp = gordo_ml_server_client.get(
+        _url(GORDO_PROJECT, "models"),
+        headers={
+            "X-Envoy-Original-Path": f"/prefix/path{_url(GORDO_PROJECT, 'models')}"
+        },
+    )
+    assert resp.status_code == 200
