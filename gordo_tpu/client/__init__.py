@@ -1,0 +1,7 @@
+"""
+Batch prediction client (reference parity: gordo/client/).
+"""
+
+from gordo_tpu.client.client import Client, make_date_ranges
+
+__all__ = ["Client", "make_date_ranges"]

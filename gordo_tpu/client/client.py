@@ -1,0 +1,465 @@
+"""
+Batch prediction driver against a deployed model server
+(reference parity: gordo/client/client.py:32-637).
+
+The client is the offline data plane: it discovers revisions and models,
+re-creates each machine's dataset with its *own* data provider over the
+requested date range (left-padded by the model offset), slices the rows
+into batches, and POSTs them to ``/anomaly/prediction`` — falling back to
+``/prediction`` on 422 — with exponential-backoff retries. Successful
+frames stream to an optional forwarder.
+
+TPU note: the server holds the accelerator; this layer is pure host-side
+I/O (requests + pandas), so it stays framework-agnostic by design.
+"""
+
+import itertools
+import logging
+import typing
+from concurrent.futures import ThreadPoolExecutor
+from datetime import datetime
+from time import sleep
+from typing import Any, Callable, Dict, List, Optional
+
+import pandas as pd
+import requests
+
+from gordo_tpu import serializer
+from gordo_tpu.client.io import (
+    BadGordoRequest,
+    HttpUnprocessableEntity,
+    NotFound,
+    ResourceGone,
+    handle_response,
+)
+from gordo_tpu.client.utils import PredictionResult, backoff_seconds, cached_method
+from gordo_tpu.data.providers.base import GordoBaseDataProvider
+from gordo_tpu.machine import Machine
+from gordo_tpu.machine.metadata import Metadata
+from gordo_tpu.server import utils as server_utils
+from gordo_tpu.utils.compat import normalize_frequency
+
+logger = logging.getLogger(__name__)
+
+
+class Client:
+    """
+    Client for predicting against a deployed project
+    (reference: gordo/client/client.py:32-110).
+
+    Parameters
+    ----------
+    project
+        Project name; routes become ``/gordo/v0/<project>/...``.
+    host, port, scheme
+        Where the server (or its ingress) lives.
+    metadata
+        Arbitrary key/values handed to the forwarder with each frame.
+    data_provider
+        Provider used to re-fetch raw sensor data for prediction ranges.
+    prediction_forwarder
+        Callable ``(predictions=..., machine=..., metadata=...,
+        resampled_sensor_data=...)`` invoked per successful batch.
+    batch_size
+        Rows per POST (reference default 100000).
+    parallelism
+        Thread fan-out across machines and batches (reference default 10).
+    forward_resampled_sensors
+        Also forward the resampled input data.
+    n_retries
+        Retries per batch on IO errors, exponential backoff capped 300s.
+    use_parquet
+        Ship frames as parquet multipart instead of JSON.
+    session
+        Optional pre-configured ``requests.Session`` (the loopback test
+        harness injects one that routes into an in-process WSGI app).
+    """
+
+    def __init__(
+        self,
+        project: str,
+        host: str = "localhost",
+        port: int = 443,
+        scheme: str = "https",
+        metadata: Optional[dict] = None,
+        data_provider: Optional[GordoBaseDataProvider] = None,
+        prediction_forwarder: Optional[
+            Callable[[pd.DataFrame, Machine, dict, pd.DataFrame], None]
+        ] = None,
+        batch_size: int = 100000,
+        parallelism: int = 10,
+        forward_resampled_sensors: bool = False,
+        n_retries: int = 5,
+        use_parquet: bool = False,
+        session: Optional[requests.Session] = None,
+    ):
+        self.base_url = f"{scheme}://{host}:{port}"
+        self.server_endpoint = f"{self.base_url}/gordo/v0/{project}"
+        self.metadata = metadata if metadata is not None else dict()
+        self.prediction_forwarder = prediction_forwarder
+        self.data_provider = data_provider
+        self.use_parquet = use_parquet
+        self.project_name = project
+        # Default path; a machine that 422s on /anomaly/prediction is
+        # remembered and subsequently POSTed to /prediction. Scoped
+        # per-machine (the reference flips one shared attribute,
+        # client.py:106-107,450-459, which lets a single plain model
+        # downgrade anomaly machines under thread fan-out).
+        self.prediction_path = "/anomaly/prediction"
+        self._fallback_machines: set = set()
+        self.batch_size = batch_size
+        self.parallelism = parallelism
+        self.forward_resampled_sensors = forward_resampled_sensors
+        self.n_retries = n_retries
+        self.format = "parquet" if use_parquet else "json"
+        self.session = session or requests.Session()
+
+    # -- discovery ---------------------------------------------------------
+
+    @cached_method(maxsize=1, ttl=5)
+    def get_revisions(self) -> dict:
+        """
+        ``{"latest": ..., "available-revisions": [...]}`` from the server
+        (reference: client.py:115-135).
+        """
+        resp = self.session.get(f"{self.server_endpoint}/revisions")
+        return handle_response(
+            resp, resource_name="List of available revisions from server"
+        )
+
+    def _get_latest_revision(self) -> str:
+        return self.get_revisions()["latest"]
+
+    @cached_method(maxsize=64, ttl=30)
+    def _get_available_machines(self, revision: str) -> dict:
+        resp = self.session.get(
+            f"{self.server_endpoint}/models", params={"revision": revision}
+        )
+        model_response = handle_response(
+            resp, resource_name=f"Model name listing for revision {revision}"
+        )
+        if "models" not in model_response:
+            raise ValueError(
+                f"Invalid response from server, key 'models' not found in: "
+                f"{model_response}"
+            )
+        model_response["revision"] = model_response.get("revision", revision)
+        return model_response
+
+    def get_available_machines(self, revision: Optional[str] = None) -> dict:
+        """The /models payload for ``revision`` (default: latest)."""
+        return self._get_available_machines(
+            revision or self._get_latest_revision()
+        )
+
+    def get_machine_names(self, revision: Optional[str] = None) -> List[str]:
+        """Model names served under ``revision`` (default: latest)."""
+        return self.get_available_machines(revision=revision).get("models")
+
+    def _get_machines(
+        self,
+        revision: Optional[str] = None,
+        machine_names: Optional[List[str]] = None,
+    ) -> List[Machine]:
+        """
+        Fetch ``Machine`` objects (metadata endpoint) concurrently
+        (reference: client.py:178-224).
+        """
+        _revision = revision or self._get_latest_revision()
+        names: List[str] = machine_names or self.get_machine_names(
+            revision=_revision
+        )
+        with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
+            return list(
+                executor.map(
+                    lambda name: self._machine_from_server(name, _revision), names
+                )
+            )
+
+    @cached_method(maxsize=25000)
+    def _machine_from_server(self, name: str, revision: str) -> Machine:
+        resp = self.session.get(
+            f"{self.server_endpoint}/{name}/metadata",
+            params={"revision": revision},
+        )
+        metadata = handle_response(
+            resp, resource_name=f"Machine metadata for {name}"
+        )
+        if isinstance(metadata, dict) and metadata.get("metadata"):
+            return Machine.unvalidated(**metadata["metadata"])
+        raise NotFound(f"Machine {name} not found")
+
+    # -- model download ----------------------------------------------------
+
+    def download_model(
+        self, revision: Optional[str] = None, targets: Optional[List[str]] = None
+    ) -> typing.Dict[str, Any]:
+        """
+        Pull serialized models via /download-model and revive them
+        (reference: client.py:226-252).
+        """
+        models = dict()
+        for machine_name in targets or self.get_machine_names(revision=revision):
+            resp = self.session.get(
+                f"{self.server_endpoint}/{machine_name}/download-model"
+            )
+            content = handle_response(
+                resp, resource_name=f"Model download for model {machine_name}"
+            )
+            if not isinstance(content, bytes):
+                raise ValueError(
+                    f"Unexpected return type {type(content)} downloading model "
+                    f"{machine_name}"
+                )
+            models[machine_name] = serializer.loads(content)
+        return models
+
+    def get_metadata(
+        self, revision: Optional[str] = None, targets: Optional[List[str]] = None
+    ) -> typing.Dict[str, Metadata]:
+        """Mapping machine name → its Metadata (reference: client.py:254-277)."""
+        machines = self._get_machines(revision=revision, machine_names=targets)
+        return {machine.name: machine.metadata for machine in machines}
+
+    # -- prediction --------------------------------------------------------
+
+    def predict(
+        self,
+        start: datetime,
+        end: datetime,
+        targets: Optional[List[str]] = None,
+        revision: Optional[str] = None,
+    ) -> typing.List[typing.Tuple[str, pd.DataFrame, typing.List[str]]]:
+        """
+        Run predictions for [start, end] over all (or ``targets``) machines,
+        fanned out over a thread pool (reference: client.py:279-323).
+
+        Returns a list of ``(name, predictions-frame, error-messages)``.
+        """
+        _revision = revision or self._get_latest_revision()
+        machines = self._get_machines(revision=_revision, machine_names=targets)
+        with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
+            jobs = executor.map(
+                lambda machine: self.predict_single_machine(
+                    machine=machine, start=start, end=end, revision=_revision
+                ),
+                machines,
+            )
+            return [(j.name, j.predictions, j.error_messages) for j in jobs]
+
+    def predict_single_machine(
+        self, machine: Machine, start: datetime, end: datetime, revision: str
+    ) -> PredictionResult:
+        """
+        Fetch raw data for one machine and POST it batch-wise
+        (reference: client.py:325-389).
+        """
+        X, y = self._raw_data(machine, start, end)
+
+        if self.prediction_forwarder is not None and self.forward_resampled_sensors:
+            self.prediction_forwarder(resampled_sensor_data=X)
+
+        max_idx = len(X.index) - 1
+        with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
+            jobs = executor.map(
+                lambda i: self._send_prediction_request(
+                    X,
+                    y,
+                    chunk=slice(i, i + self.batch_size),
+                    machine=machine,
+                    start=X.index[i],
+                    end=X.index[min(i + self.batch_size - 1, max_idx)],
+                    revision=revision,
+                ),
+                range(0, X.shape[0], self.batch_size),
+            )
+            prediction_dfs = []
+            error_messages: List[str] = []
+            for result in jobs:
+                if result.predictions is not None:
+                    prediction_dfs.append(result.predictions)
+                error_messages.extend(result.error_messages)
+            predictions = (
+                pd.concat(prediction_dfs).sort_index()
+                if prediction_dfs
+                else pd.DataFrame()
+            )
+        return PredictionResult(
+            name=machine.name, predictions=predictions, error_messages=error_messages
+        )
+
+    def _send_prediction_request(
+        self,
+        X: pd.DataFrame,
+        y: Optional[pd.DataFrame],
+        chunk: slice,
+        machine: Machine,
+        start: datetime,
+        end: datetime,
+        revision: str,
+    ) -> PredictionResult:
+        """
+        POST one batch; 422 → permanent fallback to /prediction; IO errors →
+        exponential backoff (2^(attempt+2) capped 300s); 4xx → give up on the
+        batch; 410 → propagate (reference: client.py:391-510).
+        """
+        path = (
+            "/prediction"
+            if machine.name in self._fallback_machines
+            else self.prediction_path
+        )
+        kwargs: Dict[str, Any] = dict(
+            url=f"{self.server_endpoint}/{machine.name}{path}",
+            params={"format": self.format, "revision": revision},
+        )
+        if self.use_parquet:
+            kwargs["files"] = {
+                "X": server_utils.dataframe_into_parquet_bytes(X.iloc[chunk]),
+                "y": (
+                    server_utils.dataframe_into_parquet_bytes(y.iloc[chunk])
+                    if y is not None
+                    else None
+                ),
+            }
+        else:
+            kwargs["json"] = {
+                "X": server_utils.dataframe_to_dict(X.iloc[chunk]),
+                "y": (
+                    server_utils.dataframe_to_dict(y.iloc[chunk])
+                    if y is not None
+                    else None
+                ),
+            }
+
+        for current_attempt in itertools.count(start=1):
+            try:
+                try:
+                    resp = handle_response(self.session.post(**kwargs))
+                except HttpUnprocessableEntity:
+                    self._fallback_machines.add(machine.name)
+                    kwargs["url"] = (
+                        f"{self.server_endpoint}/{machine.name}/prediction"
+                    )
+                    resp = handle_response(self.session.post(**kwargs))
+            except (
+                IOError,
+                TimeoutError,
+                requests.ConnectionError,
+                requests.HTTPError,
+            ) as exc:
+                if current_attempt <= self.n_retries:
+                    time_to_sleep = backoff_seconds(current_attempt)
+                    logger.warning(
+                        "Failed attempt %d of %d; retrying in %ds",
+                        current_attempt,
+                        self.n_retries,
+                        time_to_sleep,
+                    )
+                    sleep(time_to_sleep)
+                    continue
+                msg = (
+                    f"Failed to get predictions for dates {start} -> {end} "
+                    f"for target: '{machine.name}' Error: {exc}"
+                )
+                logger.error(msg)
+                return PredictionResult(
+                    name=machine.name, predictions=None, error_messages=[msg]
+                )
+            except (BadGordoRequest, NotFound) as exc:
+                msg = (
+                    f"Failed with bad request or not found for dates "
+                    f"{start} -> {end} for target: '{machine.name}' Error: {exc}"
+                )
+                logger.error(msg)
+                return PredictionResult(
+                    name=machine.name, predictions=None, error_messages=[msg]
+                )
+            except ResourceGone:
+                raise
+            else:
+                predictions = self.dataframe_from_response(resp)
+                if self.prediction_forwarder is not None:
+                    self.prediction_forwarder(
+                        predictions=predictions,
+                        machine=machine,
+                        metadata=self.metadata,
+                    )
+                return PredictionResult(
+                    name=machine.name, predictions=predictions, error_messages=[]
+                )
+
+    # -- data --------------------------------------------------------------
+
+    def _raw_data(
+        self, machine: Machine, start: datetime, end: datetime
+    ) -> typing.Tuple[pd.DataFrame, Optional[pd.DataFrame]]:
+        """
+        Re-create the machine's dataset with the client's data provider,
+        left-padding ``start`` by (model_offset + 5) resolution intervals so
+        offset models still cover the requested range
+        (reference: client.py:512-552).
+        """
+        resolution = machine.dataset.resolution
+        n_intervals = machine.metadata.build_metadata.model.model_offset + 5
+        start = self._adjust_for_offset(
+            dt=start, resolution=resolution, n_intervals=n_intervals
+        )
+        config = machine.dataset.to_dict()
+        config.update(
+            dict(
+                data_provider=self.data_provider,
+                train_start_date=start,
+                train_end_date=end,
+            )
+        )
+        dataset = machine.dataset.from_dict(config)
+        return dataset.get_data()
+
+    @staticmethod
+    def _adjust_for_offset(
+        dt: datetime, resolution: str, n_intervals: int = 100
+    ) -> datetime:
+        """
+        ``dt - n_intervals * resolution`` (reference: client.py:554-583).
+
+        Examples
+        --------
+        >>> import dateutil.parser
+        >>> date = dateutil.parser.isoparse("2019-01-01T12:00:00+00:00")
+        >>> str(Client._adjust_for_offset(date, resolution='15min', n_intervals=5))
+        '2019-01-01 10:45:00+00:00'
+        """
+        return dt - (pd.Timedelta(normalize_frequency(resolution)) * n_intervals)
+
+    @staticmethod
+    def dataframe_from_response(
+        response: typing.Union[dict, bytes]
+    ) -> pd.DataFrame:
+        """
+        Parse a prediction response: JSON dict → ``data`` key frame;
+        bytes → parquet (reference: client.py:585-605).
+        """
+        if isinstance(response, dict):
+            return server_utils.dataframe_from_dict(response["data"])
+        return server_utils.dataframe_from_parquet_bytes(response)
+
+
+def make_date_ranges(
+    start: datetime, end: datetime, max_interval_days: int, freq: str = "h"
+) -> List[typing.Tuple[datetime, datetime]]:
+    """
+    Split [start, end] into consecutive intervals of ``freq`` when the span
+    reaches ``max_interval_days``; otherwise return the original pair
+    (reference: client.py:607-637 — which silently drops any trailing
+    partial interval when ``end`` is not freq-aligned; fixed here by
+    appending the remainder).
+    """
+    if (end - start).days >= max_interval_days:
+        date_range = pd.date_range(start, end, freq=freq)
+        ranges = [
+            (date_range[i], date_range[i + 1]) for i in range(len(date_range) - 1)
+        ]
+        if len(date_range) and date_range[-1] < end:
+            ranges.append((date_range[-1], end))
+        return ranges
+    return [(start, end)]

@@ -1,0 +1,71 @@
+"""
+HTTP response handling for the client: map status codes onto typed
+exceptions so callers can branch on failure mode rather than parse
+status ints (reference parity: gordo/client/io.py:8-101).
+"""
+
+from typing import Optional, Union
+
+import requests
+
+
+class HttpUnprocessableEntity(Exception):
+    """
+    HTTP 422 — in practice: POSTing ``/anomaly/prediction`` to a model
+    that is not an anomaly detector (reference: gordo/client/io.py:8-15).
+    """
+
+
+class ResourceGone(Exception):
+    """
+    HTTP 410 — the requested revision directory no longer exists on the
+    server and never will again (reference: gordo/client/io.py:18-27).
+    """
+
+
+class BadGordoRequest(Exception):
+    """Any other 4xx (reference: gordo/client/io.py:30-34)."""
+
+
+class NotFound(Exception):
+    """HTTP 404 (reference: gordo/client/io.py:37-42)."""
+
+
+def handle_response(
+    resp: requests.Response, resource_name: Optional[str] = None
+) -> Union[dict, bytes]:
+    """
+    Return parsed JSON for JSON responses, raw bytes otherwise; raise the
+    typed exception matching the status code on failure
+    (reference: gordo/client/io.py:46-101).
+
+    Raises
+    ------
+    HttpUnprocessableEntity, ResourceGone, NotFound, BadGordoRequest
+        For 422 / 410 / 404 / other 4xx respectively.
+    IOError
+        For any 5xx or other unexpected status.
+    """
+    if 200 <= resp.status_code <= 299:
+        content_type = resp.headers.get("content-type", "")
+        if content_type.split(";")[0].strip() == "application/json":
+            return resp.json()
+        return resp.content
+
+    if resource_name:
+        msg = (
+            f"Failed to fetch resource: {resource_name}. "
+            f"Status: {resp.status_code}. Content: {resp.content!r}"
+        )
+    else:
+        msg = f"Failed to get response: {resp.status_code}: {resp.content!r}"
+
+    if resp.status_code == 422:
+        raise HttpUnprocessableEntity(msg)
+    if resp.status_code == 410:
+        raise ResourceGone(msg)
+    if resp.status_code == 404:
+        raise NotFound(msg)
+    if 400 <= resp.status_code <= 499:
+        raise BadGordoRequest(msg)
+    raise IOError(msg)

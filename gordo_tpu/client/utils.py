@@ -1,0 +1,148 @@
+"""
+Client support types: the per-machine result record, small thread-safe
+caches (standing in for cachetools, which this stack does not ship), and
+the gated influx client factory (reference parity: gordo/client/utils.py).
+"""
+
+import threading
+import time
+from collections import OrderedDict, namedtuple
+from functools import wraps
+from typing import Dict, Optional, Tuple
+
+#: Per-machine prediction outcome (reference: gordo/client/utils.py:10).
+PredictionResult = namedtuple("PredictionResult", "name predictions error_messages")
+
+
+class _BoundedCache:
+    """LRU cache with optional per-entry TTL, guarded by a lock."""
+
+    def __init__(self, maxsize: int, ttl: Optional[float] = None):
+        self.maxsize = maxsize
+        self.ttl = ttl
+        self._data: "OrderedDict" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key, default=None):
+        with self._lock:
+            if key not in self._data:
+                return default
+            value, stamp = self._data[key]
+            if self.ttl is not None and time.monotonic() - stamp > self.ttl:
+                del self._data[key]
+                return default
+            self._data.move_to_end(key)
+            return value
+
+    def put(self, key, value):
+        with self._lock:
+            self._data[key] = (value, time.monotonic())
+            self._data.move_to_end(key)
+            while len(self._data) > self.maxsize:
+                self._data.popitem(last=False)
+
+    def clear(self):
+        with self._lock:
+            self._data.clear()
+
+
+_CACHE_MISS = object()
+
+
+def backoff_seconds(attempt: int, cap: int = 300) -> int:
+    """
+    Shared retry policy: exponential backoff starting at 8s, capped
+    (reference: gordo/client/client.py:460-473, forwarders.py:177-215).
+
+    >>> [backoff_seconds(n) for n in (1, 2, 3, 7)]
+    [8, 16, 32, 300]
+    """
+    return min(2 ** (attempt + 2), cap)
+
+
+def cached_method(maxsize: int = 128, ttl: Optional[float] = None):
+    """
+    Decorator: per-instance memoization of a method on its positional/keyword
+    args (the client's TTL'd revision/model listings and LRU'd metadata —
+    reference: gordo/client/client.py:115-157,211-224 with cachetools).
+    """
+
+    def decorator(fn):
+        attr = f"_cache_{fn.__name__}"
+
+        @wraps(fn)
+        def wrapper(self, *args, **kwargs):
+            cache = getattr(self, attr, None)
+            if cache is None:
+                cache = _BoundedCache(maxsize=maxsize, ttl=ttl)
+                setattr(self, attr, cache)
+            key = (args, tuple(sorted(kwargs.items())))
+            value = cache.get(key, _CACHE_MISS)
+            if value is _CACHE_MISS:
+                value = fn(self, *args, **kwargs)
+                cache.put(key, value)
+            return value
+
+        return wrapper
+
+    return decorator
+
+
+def parse_influx_uri(uri: str) -> Tuple[str, str, str, str, str, str]:
+    """
+    ``<username>:<password>@<host>:<port>/<optional-path>/<db_name>`` →
+    (username, password, host, port, path, db_name)
+    (reference: gordo/client/utils.py:13-31).
+
+    Examples
+    --------
+    >>> parse_influx_uri("admin:pw@localhost:8086/gordo")
+    ('admin', 'pw', 'localhost', '8086', '', 'gordo')
+    >>> parse_influx_uri("u:p@h:80/api/v1/db")
+    ('u', 'p', 'h', '80', 'api/v1', 'db')
+    """
+    username, password, host, port, *path, db_name = (
+        uri.replace("/", ":").replace("@", ":").split(":")
+    )
+    return username, password, host, port, "/".join(path), db_name
+
+
+def influx_client_from_uri(
+    uri: str,
+    api_key: Optional[str] = None,
+    api_key_header: Optional[str] = "Ocp-Apim-Subscription-Key",
+    recreate: bool = False,
+    dataframe_client: bool = False,
+    proxies: Dict[str, str] = {"https": "", "http": ""},
+):
+    """
+    Build an InfluxDBClient / DataFrameClient from a URI (reference:
+    gordo/client/utils.py:34-84). The ``influxdb`` package is optional in
+    this image; importing lazily keeps the client importable without it.
+    """
+    try:
+        from influxdb import DataFrameClient, InfluxDBClient
+    except ImportError as exc:  # pragma: no cover - env without influxdb
+        raise ImportError(
+            "The 'influxdb' package is required for influx forwarding; "
+            "it is not installed in this environment."
+        ) from exc
+
+    username, password, host, port, path, db_name = parse_influx_uri(uri)
+    cls = DataFrameClient if dataframe_client else InfluxDBClient
+    client = cls(
+        host=host,
+        port=port,
+        database=db_name,
+        username=username,
+        password=password,
+        path=path,
+        ssl=bool(api_key),
+        proxies=proxies,
+    )
+    if api_key:
+        client._headers[api_key_header] = api_key
+    if recreate:
+        client.drop_database(db_name)
+        client.create_database(db_name)
+    return client

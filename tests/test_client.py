@@ -1,0 +1,283 @@
+"""
+Client tests against the loopback fake cluster: the real Client drives the
+real server app in-process (reference: tests/gordo/client/test_client.py,
+with the responses-based `ml_server` fixture replaced by a requests
+adapter).
+"""
+
+import dateutil.parser
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.client import Client, make_date_ranges
+from gordo_tpu.client.forwarders import ForwardPredictionsIntoInflux
+from gordo_tpu.client.io import (
+    BadGordoRequest,
+    HttpUnprocessableEntity,
+    NotFound,
+    ResourceGone,
+    handle_response,
+)
+from gordo_tpu.client.utils import PredictionResult, parse_influx_uri
+from gordo_tpu.data.providers import RandomDataProvider
+from tests.conftest import (
+    GORDO_BASE_TARGETS,
+    GORDO_PROJECT,
+    GORDO_REVISION,
+    GORDO_SINGLE_TARGET,
+    GORDO_TARGETS,
+)
+from tests.utils import loopback_session
+
+START = dateutil.parser.isoparse("2019-01-01T00:00:00+00:00")
+END = dateutil.parser.isoparse("2019-01-01T08:00:00+00:00")
+
+
+@pytest.fixture
+def ml_server(model_collection_env):
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.clear_caches()
+    return build_app()
+
+
+@pytest.fixture
+def client(ml_server):
+    return Client(
+        project=GORDO_PROJECT,
+        host="localhost",
+        port=8888,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(ml_server),
+        parallelism=2,
+    )
+
+
+def test_get_revisions_and_machine_names(client):
+    revisions = client.get_revisions()
+    assert revisions["latest"] == GORDO_REVISION
+    assert GORDO_REVISION in revisions["available-revisions"]
+
+    names = client.get_machine_names()
+    assert set(GORDO_TARGETS + GORDO_BASE_TARGETS) <= set(names)
+
+
+def test_get_metadata(client):
+    metadata = client.get_metadata(targets=GORDO_TARGETS)
+    assert set(metadata.keys()) == set(GORDO_TARGETS)
+    md = metadata[GORDO_SINGLE_TARGET]
+    # A real build stamped this
+    assert md.build_metadata.model.model_builder_version
+
+
+def test_download_model(client):
+    models = client.download_model(targets=GORDO_TARGETS)
+    model = models[GORDO_SINGLE_TARGET]
+    X = np.random.default_rng(0).random((10, 4))
+    out = model.predict(X)
+    assert out.shape[0] == 10
+
+
+@pytest.mark.parametrize("use_parquet", [False, True])
+def test_predict_end_to_end_anomaly(ml_server, use_parquet):
+    forwarded = []
+
+    def forwarder(predictions=None, machine=None, metadata=dict(), **kwargs):
+        forwarded.append((machine.name, predictions))
+
+    client = Client(
+        project=GORDO_PROJECT,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(ml_server),
+        use_parquet=use_parquet,
+        prediction_forwarder=forwarder,
+        parallelism=2,
+    )
+    results = client.predict(START, END, targets=GORDO_TARGETS)
+    assert len(results) == 1
+    name, predictions, errors = results[0]
+    assert name == GORDO_SINGLE_TARGET
+    assert errors == []
+    assert len(predictions) > 0
+    top = set(predictions.columns.get_level_values(0))
+    assert "total-anomaly-scaled" in top
+    assert "model-output" in top
+    # forwarder saw every batch
+    assert forwarded and forwarded[0][0] == GORDO_SINGLE_TARGET
+
+
+def test_predict_fallback_on_non_anomaly_model(ml_server):
+    """A plain model 422s on /anomaly/prediction; client falls back."""
+    client = Client(
+        project=GORDO_PROJECT,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(ml_server),
+        parallelism=2,
+    )
+    results = client.predict(START, END, targets=GORDO_BASE_TARGETS)
+    (name, predictions, errors) = results[0]
+    assert errors == []
+    assert len(predictions) > 0
+    # fallback is remembered per-machine, not globally
+    assert GORDO_BASE_TARGETS[0] in client._fallback_machines
+    assert client.prediction_path == "/anomaly/prediction"
+
+
+def test_fallback_does_not_downgrade_other_machines(ml_server):
+    """A plain model's 422 must not reroute the anomaly machine's batches."""
+    client = Client(
+        project=GORDO_PROJECT,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(ml_server),
+        parallelism=2,
+    )
+    results = dict(
+        (name, (frame, errors))
+        for name, frame, errors in client.predict(
+            START, END, targets=GORDO_BASE_TARGETS + GORDO_TARGETS
+        )
+    )
+    anomaly_frame, anomaly_errors = results[GORDO_SINGLE_TARGET]
+    assert anomaly_errors == []
+    assert "total-anomaly-scaled" in set(anomaly_frame.columns.get_level_values(0))
+
+
+def test_predict_bad_revision(client):
+    with pytest.raises(ResourceGone):
+        client.predict(START, END, targets=GORDO_TARGETS, revision="does-not-exist")
+
+
+def test_predict_batching(ml_server):
+    """Small batch_size → multiple POSTs concatenated and sorted."""
+    client = Client(
+        project=GORDO_PROJECT,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(ml_server),
+        batch_size=10,
+        parallelism=2,
+    )
+    (name, predictions, errors) = client.predict(
+        START, END, targets=GORDO_TARGETS
+    )[0]
+    assert errors == []
+    assert predictions.index.is_monotonic_increasing
+
+
+def test_handle_response_typed_exceptions():
+    def fake(status, content=b"x", content_type="text/plain"):
+        resp = __import__("requests").Response()
+        resp.status_code = status
+        resp._content = content
+        resp.headers["content-type"] = content_type
+        return resp
+
+    assert handle_response(fake(200, b'{"a": 1}', "application/json")) == {"a": 1}
+    assert handle_response(fake(200, b"raw")) == b"raw"
+    with pytest.raises(HttpUnprocessableEntity):
+        handle_response(fake(422))
+    with pytest.raises(ResourceGone):
+        handle_response(fake(410))
+    with pytest.raises(NotFound):
+        handle_response(fake(404))
+    with pytest.raises(BadGordoRequest):
+        handle_response(fake(400))
+    with pytest.raises(IOError):
+        handle_response(fake(500))
+
+
+def test_make_date_ranges():
+    ranges = make_date_ranges(START, END, max_interval_days=7)
+    assert ranges == [(START, END)]
+    long_end = dateutil.parser.isoparse("2019-01-10T00:00:00+00:00")
+    ranges = make_date_ranges(START, long_end, max_interval_days=7, freq="D")
+    assert len(ranges) == 9
+    assert ranges[0][0] == START
+    # unaligned end keeps the trailing partial interval
+    ragged_end = dateutil.parser.isoparse("2019-01-10T00:30:00+00:00")
+    ranges = make_date_ranges(START, ragged_end, max_interval_days=7, freq="D")
+    assert ranges[-1][1] == ragged_end
+
+
+def test_forwarder_requires_a_sink():
+    with pytest.raises(ValueError):
+        ForwardPredictionsIntoInflux()
+
+
+def test_adjust_for_offset():
+    adjusted = Client._adjust_for_offset(START, resolution="10min", n_intervals=6)
+    assert (START - adjusted) == pd.Timedelta("1h")
+
+
+def test_parse_influx_uri():
+    assert parse_influx_uri("u:p@h:8086/db") == ("u", "p", "h", "8086", "", "db")
+    assert parse_influx_uri("u:p@h:80/api/v1/db") == (
+        "u", "p", "h", "80", "api/v1", "db",
+    )
+
+
+class _FakeInfluxWriter:
+    def __init__(self):
+        self.calls = []
+
+    def write_points(self, dataframe, measurement, tags, **kwargs):
+        self.calls.append((dataframe, measurement, tags))
+
+
+def test_influx_forwarder_shapes_points(trained_model_collection):
+    """Full shaping path against an injected fake write client."""
+    from gordo_tpu import serializer
+    from gordo_tpu.machine import Machine
+
+    meta = serializer.load_metadata(
+        str(trained_model_collection / GORDO_SINGLE_TARGET)
+    )
+    machine = Machine.unvalidated(**meta)
+    index = pd.date_range("2019-01-01", periods=4, freq="10min", tz="UTC")
+    n_tags = len(machine.dataset.tag_list)
+    predictions = pd.DataFrame(
+        np.random.default_rng(1).random((4, n_tags + 1)),
+        columns=pd.MultiIndex.from_tuples(
+            [("model-output", str(i)) for i in range(n_tags)]
+            + [("total-anomaly-scaled", "0")]
+        ),
+        index=index,
+    )
+    writer = _FakeInfluxWriter()
+    forwarder = ForwardPredictionsIntoInflux(dataframe_client=writer, n_retries=1)
+    forwarder(predictions=predictions, machine=machine, metadata={"env": "test"})
+
+    measurements = {m for _, m, _ in writer.calls}
+    assert measurements == {"model-output", "total-anomaly-scaled"}
+    df, _, tags = writer.calls[0]
+    assert set(df.columns) == {"sensor_name", "sensor_value"}
+    assert tags["machine"] == machine.name
+    assert tags["env"] == "test"
+    # model-output columns got renamed to tag names
+    sensor_names = set(df["sensor_name"].unique())
+    assert sensor_names == {t.name for t in machine.dataset.tag_list}
+
+
+def test_influx_forwarder_sensor_data():
+    writer = _FakeInfluxWriter()
+    forwarder = ForwardPredictionsIntoInflux(dataframe_client=writer, n_retries=1)
+    index = pd.date_range("2019-01-01", periods=3, freq="10min", tz="UTC")
+    sensors = pd.DataFrame(
+        {"tag-0": [1.0, np.inf, 2.0], "tag-1": [0.5, 1.5, np.nan]}, index=index
+    )
+    forwarder(resampled_sensor_data=sensors)
+    df, measurement, _ = writer.calls[0]
+    assert measurement == "resampled"
+    # inf/nan rows dropped before stacking
+    assert len(df) == 2  # one clean row x two sensors
+
+
+def test_prediction_result_namedtuple():
+    pr = PredictionResult("m", None, ["err"])
+    assert pr.name == "m" and pr.predictions is None and pr.error_messages == ["err"]

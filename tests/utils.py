@@ -1,0 +1,68 @@
+"""
+Test helpers: the loopback "fake deployed cluster" (SURVEY.md §4) — a
+``requests`` transport adapter that routes HTTP calls into the in-process
+WSGI server app, so the *real* Client exercises the *real* server with no
+network (reference pattern: tests/conftest.py:303-383, built there on the
+`responses` library; rebuilt here as a requests BaseAdapter since
+`responses` is not in this image).
+"""
+
+import io
+import threading
+from urllib.parse import urlsplit
+
+import requests
+from requests.adapters import BaseAdapter
+from werkzeug.test import EnvironBuilder, run_wsgi_app
+
+
+class WSGIAdapter(BaseAdapter):
+    """Route prepared requests into a WSGI app, serialized by a mutex."""
+
+    def __init__(self, wsgi_app):
+        super().__init__()
+        self.wsgi_app = wsgi_app
+        self._lock = threading.Lock()
+
+    def send(
+        self, request, stream=False, timeout=None, verify=True, cert=None, proxies=None
+    ):
+        parts = urlsplit(request.url)
+        body = request.body
+        if isinstance(body, str):
+            body = body.encode("utf-8")
+        builder = EnvironBuilder(
+            path=parts.path,
+            query_string=parts.query,
+            method=request.method,
+            headers=dict(request.headers),
+            input_stream=io.BytesIO(body) if body else None,
+        )
+        environ = builder.get_environ()
+        with self._lock:
+            app_iter, status, headers = run_wsgi_app(self.wsgi_app, environ)
+            content = b"".join(app_iter)
+            if hasattr(app_iter, "close"):
+                app_iter.close()
+
+        response = requests.Response()
+        response.status_code = int(status.split(" ", 1)[0])
+        response.headers = requests.structures.CaseInsensitiveDict(headers)
+        response.raw = io.BytesIO(content)
+        response._content = content
+        response.url = request.url
+        response.request = request
+        response.connection = self
+        return response
+
+    def close(self):
+        pass
+
+
+def loopback_session(wsgi_app, prefix: str = "http://") -> requests.Session:
+    """A requests.Session whose http(s) traffic hits ``wsgi_app`` in-process."""
+    session = requests.Session()
+    adapter = WSGIAdapter(wsgi_app)
+    session.mount("http://", adapter)
+    session.mount("https://", adapter)
+    return session
