@@ -3,5 +3,13 @@ Build-result reporters (reference parity: gordo/reporters/).
 """
 
 from .base import BaseReporter, ReporterException
+from .mlflow import MlFlowReporter
+from .postgres import PostgresReporter, SqliteReporter
 
-__all__ = ["BaseReporter", "ReporterException"]
+__all__ = [
+    "BaseReporter",
+    "ReporterException",
+    "MlFlowReporter",
+    "PostgresReporter",
+    "SqliteReporter",
+]

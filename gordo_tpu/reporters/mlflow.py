@@ -1,0 +1,323 @@
+"""
+MLflow / AzureML reporter (reference parity: gordo/reporters/mlflow.py).
+
+The metadata→(Metric, Param) flattening and the AzureML batch-limit
+splitter are pure Python and fully tested here; the actual MLflow client
+traffic is gated behind an optional import (mlflow is not in this image).
+"""
+
+import logging
+from collections import namedtuple
+from datetime import datetime
+from typing import Dict, List, Tuple, Union
+
+from gordo_tpu.machine import Machine
+from gordo_tpu.reporters.base import BaseReporter, ReporterException
+from gordo_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+try:  # pragma: no cover - only on images with mlflow
+    from mlflow.entities import Metric, Param
+except ImportError:
+    #: Drop-in stand-ins matching mlflow.entities signatures.
+    Metric = namedtuple("Metric", "key value timestamp step")
+    Param = namedtuple("Param", "key value")
+
+
+class MlflowLoggingError(ReporterException):
+    pass
+
+
+def _datetime_to_ms_since_epoch(dt: datetime) -> int:
+    """
+    Milliseconds since epoch for an (aware or naive) datetime
+    (reference: mlflow.py:151-174).
+
+    Examples
+    --------
+    >>> from datetime import timezone
+    >>> _datetime_to_ms_since_epoch(
+    ...     datetime(1970, 1, 1, 0, 0, 1, tzinfo=timezone.utc))
+    1000
+    """
+    if dt.tzinfo is not None:
+        epoch = datetime(1970, 1, 1, tzinfo=dt.tzinfo)
+    else:
+        epoch = datetime(1970, 1, 1)
+    return round((dt - epoch).total_seconds() * 1000.0)
+
+
+def epoch_now() -> int:
+    """Current ms since epoch (reference: mlflow.py:176-186)."""
+    from datetime import timezone
+
+    return _datetime_to_ms_since_epoch(datetime.now(timezone.utc))
+
+
+def get_machine_log_items(machine: Machine) -> Tuple[List[Metric], List[Param]]:
+    """
+    Flatten a built Machine into MLflow metrics and params
+    (reference: mlflow.py:188-279): project/dataset/model params, CV split
+    boundaries as params, per-fold and summary CV scores as step'd metrics
+    (per-tag scores skipped — too many for MLflow), and epoch-series
+    metrics from the training history.
+    """
+    now = epoch_now()
+    build_metadata = machine.metadata.build_metadata
+
+    params = [Param("project_name", machine.project_name), Param("name", machine.name)]
+    dataset_keys = [
+        "train_start_date",
+        "train_end_date",
+        "resolution",
+        "row_filter",
+        "row_filter_buffer_size",
+    ]
+    params.extend(
+        Param(k, str(getattr(machine.dataset, k))) for k in dataset_keys
+    )
+    model_keys = ["model_creation_date", "model_builder_version", "model_offset"]
+    params.extend(
+        Param(k, str(getattr(build_metadata.model, k))) for k in model_keys
+    )
+    splits = build_metadata.model.cross_validation.splits
+    params.extend(Param(k, str(v)) for k, v in splits.items())
+
+    metrics: List[Metric] = []
+    tag_names = {t.name for t in machine.dataset.tag_list}
+    scores = build_metadata.model.cross_validation.scores
+    if scores:
+        keys = sorted(scores.keys())
+        subkeys = ["mean", "max", "min", "std"]
+        n_folds = len(scores[keys[0]]) - len(subkeys)
+        for k in keys:
+            # Per-tag scores would blow AzureML's item limits
+            # (reference: mlflow.py:241-244).
+            if any(tag in k for tag in tag_names):
+                continue
+            for sk in subkeys:
+                metrics.append(Metric(f"{k}-{sk}", scores[k][f"fold-{sk}"], now, 0))
+            metrics.extend(
+                Metric(k, scores[k][f"fold-{i + 1}"], now, i) for i in range(n_folds)
+            )
+
+    # Epoch series from the training history
+    # (reference: mlflow.py:256-277 reads Keras history; here the JAX
+    # trainers record the same shape under model_meta["history"]).
+    history = build_metadata.model.model_meta.get("history", {})
+    meta_params = history.get("params")
+    if meta_params:
+        if build_metadata.model.model_training_duration_sec is not None:
+            metrics.append(
+                Metric(
+                    "model_training_duration_sec",
+                    float(build_metadata.model.model_training_duration_sec),
+                    now,
+                    0,
+                )
+            )
+        for m in meta_params.get("metrics", []):
+            metrics.extend(
+                Metric(m, float(x), now, i) for i, x in enumerate(history[m])
+            )
+        params.extend(
+            Param(k, str(v)) for k, v in meta_params.items() if k != "metrics"
+        )
+
+    return metrics, params
+
+
+def batch_log_items(
+    metrics: List[Metric],
+    params: List[Param],
+    n_max_metrics: int = 200,
+    n_max_params: int = 100,
+) -> List[Dict[str, Union[List[Metric], List[Param]]]]:
+    """
+    Split metrics/params into MlflowClient.log_batch kwargs respecting
+    AzureML's per-request limits (200 metrics / 100 params as of the
+    reference snapshot; reference: mlflow.py:282-341).
+
+    Examples
+    --------
+    >>> batches = batch_log_items([1] * 401, [2] * 150)
+    >>> [len(b["metrics"]) for b in batches]
+    [200, 200, 1]
+    >>> [len(b["params"]) for b in batches]
+    [100, 50, 0]
+    """
+
+    def n_batches(n: int, n_max: int) -> int:
+        return (n // n_max) + (1 if n % n_max else 0)
+
+    total = max(
+        n_batches(len(metrics), n_max_metrics), n_batches(len(params), n_max_params)
+    )
+    out = []
+    for b in range(total):
+        out.append(
+            {
+                "metrics": metrics[b * n_max_metrics : (b + 1) * n_max_metrics],
+                "params": params[b * n_max_params : (b + 1) * n_max_params],
+            }
+        )
+    return out
+
+
+class MlFlowReporter(BaseReporter):
+    """
+    Log the machine's build metadata to MLflow/AzureML
+    (reference: mlflow.py:485-499). Requires the optional mlflow package at
+    report() time; the flattening above is importable without it.
+    """
+
+    @capture_args
+    def __init__(self, *args, **kwargs):
+        pass
+
+    def report(self, machine: Machine):
+        try:
+            import mlflow  # noqa: F401
+            from mlflow.tracking import MlflowClient
+        except ImportError as exc:
+            raise MlflowLoggingError(
+                "mlflow is required for MlFlowReporter but is not installed"
+            ) from exc
+
+        workspace_kwargs = get_workspace_kwargs()
+        service_principal_kwargs = get_spauth_kwargs()
+        with mlflow_context(
+            machine.name,
+            machine.host,
+            workspace_kwargs,
+            service_principal_kwargs,
+        ) as (mlflow_client, run_id):
+            log_machine(mlflow_client, run_id, machine)
+
+
+def get_kwargs_from_secret(name: str, keys: List[str]) -> dict:
+    """
+    Parse a ``:``-delimited env-var secret into kwargs
+    (reference: mlflow.py:344-375).
+
+    Examples
+    --------
+    >>> import os
+    >>> os.environ["MY_SECRET"] = "a-id:b-pass"
+    >>> get_kwargs_from_secret("MY_SECRET", ["id", "pass"])
+    {'id': 'a-id', 'pass': 'b-pass'}
+    """
+    import os
+
+    secret_str = os.getenv(name)
+    if secret_str is None:
+        raise ValueError(f"The env var '{name}' is not set.")
+    elements = secret_str.split(":")
+    if len(elements) != len(keys):
+        raise ValueError(
+            f"Secret '{name}' has {len(elements)} elements, expected {len(keys)}"
+        )
+    return dict(zip(keys, elements))
+
+
+def get_workspace_kwargs() -> dict:
+    """
+    AzureML workspace kwargs from ``AZUREML_WORKSPACE_STR``
+    (``subscription_id:resource_group:workspace_name``), empty dict when
+    unset → plain MLflow (reference: mlflow.py:377-393).
+    """
+    import os
+
+    return (
+        get_kwargs_from_secret(
+            "AZUREML_WORKSPACE_STR",
+            ["subscription_id", "resource_group", "workspace_name"],
+        )
+        if os.getenv("AZUREML_WORKSPACE_STR")
+        else {}
+    )
+
+
+def get_spauth_kwargs() -> dict:
+    """
+    AzureML service-principal kwargs from ``DL_SERVICE_AUTH_STR``
+    (``tenant:client-id:client-secret``), empty when unset
+    (reference: mlflow.py:395-413).
+    """
+    import os
+
+    return (
+        get_kwargs_from_secret(
+            "DL_SERVICE_AUTH_STR",
+            ["tenant_id", "service_principal_id", "service_principal_password"],
+        )
+        if os.getenv("DL_SERVICE_AUTH_STR")
+        else {}
+    )
+
+
+def mlflow_context(
+    name: str,
+    model_key: str = "",
+    workspace_kwargs: dict = {},
+    service_principal_kwargs: dict = {},
+):
+    """
+    Context manager yielding ``(MlflowClient, run_id)`` against either a
+    local tracking store or an AzureML workspace, ending the run on exit
+    (reference: mlflow.py:415-453). Import-gated on mlflow.
+    """
+    from contextlib import contextmanager
+
+    try:
+        from mlflow.tracking import MlflowClient
+    except ImportError as exc:
+        raise MlflowLoggingError("mlflow is not installed") from exc
+
+    @contextmanager
+    def _ctx():
+        import mlflow
+
+        if workspace_kwargs:  # pragma: no cover - needs azureml
+            from azureml.core import Workspace
+            from azureml.core.authentication import (
+                InteractiveLoginAuthentication,
+                ServicePrincipalAuthentication,
+            )
+
+            auth = (
+                ServicePrincipalAuthentication(**service_principal_kwargs)
+                if service_principal_kwargs
+                else InteractiveLoginAuthentication(force=True)
+            )
+            workspace = Workspace.get(auth=auth, **workspace_kwargs)
+            mlflow.set_tracking_uri(workspace.get_mlflow_tracking_uri())
+        client = MlflowClient()
+        experiment = client.get_experiment_by_name(name)
+        experiment_id = (
+            experiment.experiment_id
+            if experiment
+            else client.create_experiment(name)
+        )
+        run_id = client.create_run(
+            experiment_id, tags={"model_key": model_key}
+        ).info.run_id
+        try:
+            yield client, run_id
+        finally:
+            client.set_terminated(run_id)
+
+    return _ctx()
+
+
+def log_machine(mlflow_client, run_id: str, machine: Machine):
+    """
+    Send the flattened machine to MLflow in limit-respecting batches.
+    (The reference additionally logs the machine JSON as a run artifact,
+    mlflow.py:473-479; that requires an artifact store and is out of scope
+    for the metric/param path here.)
+    """
+    metrics, params = get_machine_log_items(machine)
+    for batch_kwargs in batch_log_items(metrics, params):
+        mlflow_client.log_batch(run_id, **batch_kwargs)

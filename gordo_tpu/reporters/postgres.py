@@ -1,0 +1,156 @@
+"""
+SQL reporters: upsert each built Machine (name + dataset/model/metadata
+JSON) into a relational store (reference parity: gordo/reporters/
+postgres.py:31-108, built there on peewee + PostgresqlExtDatabase).
+
+Rebuilt on bare DB-API here: the same single-table schema and upsert
+semantics, with the SQL dialect injectable so the identical reporter logic
+runs against Postgres (psycopg2, optional in this image) or stdlib sqlite
+(the test / local-dev backend).
+"""
+
+import json
+import logging
+from typing import Any, Optional
+
+from gordo_tpu.machine import Machine
+from gordo_tpu.machine.machine import MachineEncoder
+from gordo_tpu.reporters.base import BaseReporter, ReporterException
+from gordo_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+#: Upsert on the unique machine name (reference: postgres.py:75-89 does a
+#: get-then-save/update; a single ON CONFLICT statement is atomic instead).
+_UPSERT_SQL = """
+INSERT INTO machine (name, dataset, model, metadata)
+VALUES ({ph}, {ph}, {ph}, {ph})
+ON CONFLICT (name) DO UPDATE SET
+    dataset = excluded.dataset,
+    model = excluded.model,
+    metadata = excluded.metadata
+"""
+
+_CREATE_SQL = """
+CREATE TABLE IF NOT EXISTS machine (
+    name TEXT NOT NULL UNIQUE,
+    dataset {json_type} NOT NULL,
+    model {json_type} NOT NULL,
+    metadata {json_type} NOT NULL
+)
+"""
+
+
+class PostgresReporterException(ReporterException):
+    pass
+
+
+class SqlReporter(BaseReporter):
+    """
+    Shared SQL reporter core. Subclasses provide a DB-API connection, the
+    parameter placeholder, and the JSON column type.
+    """
+
+    _placeholder = "?"
+    _json_type = "TEXT"
+
+    def _connect(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _ensure_table(self, conn) -> None:
+        with conn:
+            cursor = conn.cursor()
+            cursor.execute(_CREATE_SQL.format(json_type=self._json_type))
+            cursor.close()
+
+    def report(self, machine: Machine):
+        """
+        Upsert the machine's config + metadata keyed by name
+        (reference: postgres.py:61-91).
+        """
+        # Round-trip through MachineEncoder so datetimes / numpy scalars
+        # become JSON-clean (reference: postgres.py:79-80).
+        record = json.loads(json.dumps(machine.to_dict(), cls=MachineEncoder))
+        try:
+            conn = self._connect()
+            try:
+                self._ensure_table(conn)
+                with conn:
+                    cursor = conn.cursor()
+                    cursor.execute(
+                        _UPSERT_SQL.format(ph=self._placeholder),
+                        (
+                            record["name"],
+                            json.dumps(record["dataset"]),
+                            json.dumps(record["model"]),
+                            json.dumps(record["metadata"]),
+                        ),
+                    )
+                    cursor.close()
+            finally:
+                conn.close()
+        except Exception as exc:
+            raise PostgresReporterException(exc) from exc
+        logger.info("Reported machine %s to sql", machine.name)
+
+
+class PostgresReporter(SqlReporter):
+    """
+    Store machines in Postgres (reference: postgres.py:31-91). Requires
+    psycopg2, which this image does not ship — instantiating without it
+    raises a clear error; everything above the connection is shared with
+    :class:`SqliteReporter` and covered by its tests.
+    """
+
+    _placeholder = "%s"
+    _json_type = "JSONB"
+
+    @capture_args
+    def __init__(
+        self,
+        host: str,
+        port: int = 5432,
+        user: str = "postgres",
+        password: str = "postgres",
+        database: str = "postgres",
+    ):
+        self.host = host
+        self.port = port
+        self.user = user
+        self.password = password
+        self.database = database
+        try:
+            import psycopg2  # noqa: F401
+        except ImportError as exc:
+            raise PostgresReporterException(
+                "psycopg2 is required for PostgresReporter but is not "
+                "installed; use SqliteReporter for a dependency-free store."
+            ) from exc
+
+    def _connect(self):
+        import psycopg2
+
+        return psycopg2.connect(
+            host=self.host,
+            port=self.port,
+            user=self.user,
+            password=self.password,
+            dbname=self.database,
+        )
+
+
+class SqliteReporter(SqlReporter):
+    """
+    Same schema and upsert on stdlib sqlite — the local-dev / test backend,
+    and the CI stand-in for the reference's dockerized postgres fixture
+    (reference test: tests/gordo/reporters/test_postgres.py).
+    """
+
+    @capture_args
+    def __init__(self, path: str):
+        self.path = path
+
+    def _connect(self):
+        import sqlite3
+
+        return sqlite3.connect(self.path)

@@ -1,0 +1,146 @@
+"""
+Reporter tests (reference: tests/gordo/reporters/ — postgres there runs a
+docker fixture; the shared SQL core is exercised on sqlite here).
+"""
+
+import json
+import sqlite3
+
+import pytest
+
+from gordo_tpu import serializer
+from gordo_tpu.machine import Machine
+from gordo_tpu.reporters import BaseReporter, SqliteReporter
+from gordo_tpu.reporters.mlflow import (
+    Metric,
+    Param,
+    batch_log_items,
+    get_kwargs_from_secret,
+    get_machine_log_items,
+    get_spauth_kwargs,
+    get_workspace_kwargs,
+)
+from gordo_tpu.reporters.postgres import PostgresReporterException
+from tests.conftest import GORDO_SINGLE_TARGET
+
+
+@pytest.fixture
+def built_machine(trained_model_collection):
+    meta = serializer.load_metadata(
+        str(trained_model_collection / GORDO_SINGLE_TARGET)
+    )
+    return Machine.unvalidated(**meta)
+
+
+def test_sqlite_reporter_upsert(tmp_path, built_machine):
+    db_path = str(tmp_path / "report.db")
+    reporter = SqliteReporter(db_path)
+    reporter.report(built_machine)
+    reporter.report(built_machine)  # upsert: second write must not duplicate
+
+    conn = sqlite3.connect(db_path)
+    rows = conn.execute("SELECT name, dataset, model, metadata FROM machine").fetchall()
+    conn.close()
+    assert len(rows) == 1
+    name, dataset, model, metadata = rows[0]
+    assert name == built_machine.name
+    assert json.loads(dataset)["type"] == "RandomDataset"
+    assert "build_metadata" in json.loads(metadata)
+
+
+def test_sqlite_reporter_roundtrip_definition(tmp_path, built_machine):
+    """Reporter definition → from_dict → report, as Machine.report() does."""
+    db_path = str(tmp_path / "r.db")
+    config = {"gordo_tpu.reporters.postgres.SqliteReporter": {"path": db_path}}
+    reporter = BaseReporter.from_dict(config)
+    assert isinstance(reporter, SqliteReporter)
+    # to_dict round-trips via capture_args
+    assert reporter.to_dict() == config
+    reporter.report(built_machine)
+    conn = sqlite3.connect(db_path)
+    assert conn.execute("SELECT COUNT(*) FROM machine").fetchone()[0] == 1
+    conn.close()
+
+
+def test_machine_report_runs_configured_reporters(tmp_path, built_machine):
+    db_path = str(tmp_path / "via-machine.db")
+    built_machine.runtime = {
+        "reporters": [
+            {"gordo_tpu.reporters.postgres.SqliteReporter": {"path": db_path}}
+        ]
+    }
+    built_machine.report()
+    conn = sqlite3.connect(db_path)
+    assert conn.execute("SELECT COUNT(*) FROM machine").fetchone()[0] == 1
+    conn.close()
+
+
+def test_postgres_reporter_requires_psycopg2():
+    try:
+        import psycopg2  # noqa: F401
+
+        pytest.skip("psycopg2 installed; the gated-import error path is moot")
+    except ImportError:
+        pass
+    with pytest.raises(PostgresReporterException, match="psycopg2"):
+        from gordo_tpu.reporters import PostgresReporter
+
+        PostgresReporter(host="localhost")
+
+
+def test_get_machine_log_items(built_machine):
+    metrics, params = get_machine_log_items(built_machine)
+    param_keys = {p.key for p in params}
+    assert {"project_name", "name", "train_start_date", "model_offset"} <= param_keys
+    # CV summary metrics present with fold steps
+    metric_keys = {m.key for m in metrics}
+    assert any(k.endswith("-mean") for k in metric_keys)
+    # per-tag scores skipped
+    assert not any("tag-0" in k for k in metric_keys)
+    # every metric carries a timestamp and step
+    assert all(isinstance(m.step, int) for m in metrics)
+
+
+def test_batch_log_items_limits():
+    metrics = [Metric(f"m{i}", float(i), 0, 0) for i in range(401)]
+    params = [Param(f"p{i}", str(i)) for i in range(150)]
+    batches = batch_log_items(metrics, params)
+    assert [len(b["metrics"]) for b in batches] == [200, 200, 1]
+    assert [len(b["params"]) for b in batches] == [100, 50, 0]
+    assert all(len(b["metrics"]) <= 200 and len(b["params"]) <= 100 for b in batches)
+
+
+def test_secret_parsing(monkeypatch):
+    monkeypatch.setenv("SECRET_X", "t:i:s")
+    assert get_kwargs_from_secret("SECRET_X", ["a", "b", "c"]) == {
+        "a": "t", "b": "i", "c": "s",
+    }
+    with pytest.raises(ValueError):
+        get_kwargs_from_secret("SECRET_X", ["a", "b"])
+    with pytest.raises(ValueError):
+        get_kwargs_from_secret("SECRET_MISSING", ["a"])
+    monkeypatch.delenv("AZUREML_WORKSPACE_STR", raising=False)
+    monkeypatch.delenv("DL_SERVICE_AUTH_STR", raising=False)
+    assert get_workspace_kwargs() == {}
+    assert get_spauth_kwargs() == {}
+    monkeypatch.setenv("AZUREML_WORKSPACE_STR", "sub:rg:ws")
+    assert get_workspace_kwargs()["workspace_name"] == "ws"
+
+
+class _FakeMlflowClient:
+    def __init__(self):
+        self.batches = []
+
+    def log_batch(self, run_id, metrics=(), params=()):
+        self.batches.append((run_id, list(metrics), list(params)))
+
+
+def test_log_machine_batches(built_machine):
+    from gordo_tpu.reporters.mlflow import log_machine
+
+    client = _FakeMlflowClient()
+    log_machine(client, "run-1", built_machine)
+    assert client.batches
+    assert all(run_id == "run-1" for run_id, _, _ in client.batches)
+    total_params = sum(len(p) for _, _, p in client.batches)
+    assert total_params >= 10
