@@ -1,0 +1,7 @@
+"""
+CLI layer (reference parity: gordo/cli/).
+"""
+
+from gordo_tpu.cli.cli import gordo
+
+__all__ = ["gordo"]

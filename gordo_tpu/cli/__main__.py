@@ -1,0 +1,6 @@
+"""``python -m gordo_tpu.cli`` entry (the installed script is ``gordo-tpu``)."""
+
+from gordo_tpu.cli import gordo
+
+if __name__ == "__main__":
+    gordo()

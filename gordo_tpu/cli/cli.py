@@ -1,0 +1,332 @@
+"""
+CLI entry points (reference parity: gordo/cli/cli.py).
+
+Commands: ``build`` (one Machine per process — reference semantics),
+``build-fleet`` (TPU-native addition: a bucket of Machines trained as one
+vmapped XLA program per architecture bucket — the fleet builder that
+replaces one-pod-per-model), ``run-server``, plus the ``workflow`` and
+``client`` groups.
+
+Note: the reference snapshot plants a fault raising FileNotFoundError for
+machine names containing "err" (gordo/cli/cli.py:178-179); that is a bug in
+the snapshot and is deliberately not replicated.
+"""
+
+import logging
+import sys
+import traceback
+from typing import Any, List, Tuple, cast
+
+import click
+import jinja2
+import yaml
+
+from gordo_tpu import __version__, serializer
+from gordo_tpu.builder import FleetModelBuilder, ModelBuilder
+from gordo_tpu.cli.client import client as gordo_client
+from gordo_tpu.cli.custom_types import HostIP, key_value_par
+from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
+from gordo_tpu.cli.workflow_generator import workflow_cli
+from gordo_tpu.data.base import InsufficientDataError
+from gordo_tpu.data.datasets import InsufficientDataAfterRowFilteringError
+from gordo_tpu.data.providers import NoSuitableDataProviderError
+from gordo_tpu.data.sensor_tag import SensorTagNormalizationError
+from gordo_tpu.machine import Machine
+from gordo_tpu.reporters.base import ReporterException
+
+logger = logging.getLogger(__name__)
+
+#: Exception class → pod exit code (reference: cli.py:36-49; the azure
+#: datalake transfer error has no equivalent in this stack).
+_exceptions_reporter = ExceptionsReporter(
+    (
+        (Exception, 1),
+        (PermissionError, 20),
+        (FileNotFoundError, 30),
+        (SensorTagNormalizationError, 60),
+        (NoSuitableDataProviderError, 70),
+        (InsufficientDataError, 80),
+        (InsufficientDataAfterRowFilteringError, 81),
+        (ReporterException, 90),
+    )
+)
+
+
+@click.group("gordo-tpu")
+@click.version_option(version=__version__, message=__version__)
+@click.option(
+    "--log-level",
+    type=str,
+    default="INFO",
+    help="Run with custom log-level.",
+    envvar="GORDO_LOG_LEVEL",
+)
+@click.pass_context
+def gordo(gordo_ctx: click.Context, **ctx):
+    """gordo-tpu: build, serve and orchestrate fleets of time-series models on TPU."""
+    logging.basicConfig(
+        level=getattr(logging, str(gordo_ctx.params.get("log_level")).upper()),
+        format=(
+            "[%(asctime)s] %(levelname)s "
+            "[%(name)s.%(funcName)s:%(lineno)d] %(message)s"
+        ),
+    )
+    gordo_ctx.obj = gordo_ctx.params
+
+
+_build_options = [
+    click.option(
+        "--model-register-dir",
+        default=None,
+        envvar="MODEL_REGISTER_DIR",
+        type=click.Path(exists=False, file_okay=False, dir_okay=True),
+        help="Directory indexing built models for reuse (the build cache).",
+    ),
+    click.option(
+        "--print-cv-scores",
+        help="Print CV scores to stdout (Katib key=value format)",
+        is_flag=True,
+        default=False,
+    ),
+    click.option(
+        "--model-parameter",
+        type=key_value_par,
+        multiple=True,
+        default=(),
+        help="key,value pair injected into jinja variables of a string "
+        "model config; repeatable.",
+    ),
+    click.option(
+        "--exceptions-reporter-file",
+        envvar="EXCEPTIONS_REPORTER_FILE",
+        help="JSON output file for exception information",
+    ),
+    click.option(
+        "--exceptions-report-level",
+        type=click.Choice(ReportLevel.get_names(), case_sensitive=False),
+        default=ReportLevel.MESSAGE.name,
+        envvar="EXCEPTIONS_REPORT_LEVEL",
+        help="Detail level for exception reporting",
+    ),
+]
+
+
+def _with_build_options(fn):
+    for option in reversed(_build_options):
+        fn = option(fn)
+    return fn
+
+
+def _report_and_exit(exceptions_reporter_file: str, exceptions_report_level: str):
+    """Shared failure path: JSON report + typed exit code."""
+    traceback.print_exc()
+    exc_type, exc_value, exc_traceback = sys.exc_info()
+    exit_code = _exceptions_reporter.exception_exit_code(exc_type)
+    if exceptions_reporter_file:
+        _exceptions_reporter.safe_report(
+            cast(
+                ReportLevel,
+                ReportLevel.get_by_name(
+                    exceptions_report_level, ReportLevel.EXIT_CODE
+                ),
+            ),
+            exc_type,
+            exc_value,
+            exc_traceback,
+            exceptions_reporter_file,
+            max_message_len=2024 - 500,
+        )
+    sys.exit(exit_code)
+
+
+@click.command()
+@click.argument("machine-config", envvar="MACHINE", type=yaml.safe_load)
+@click.argument("output-dir", default="/data", envvar="OUTPUT_DIR")
+@_with_build_options
+def build(
+    machine_config: dict,
+    output_dir: str,
+    model_register_dir: str,
+    print_cv_scores: bool,
+    model_parameter: List[Tuple[str, Any]],
+    exceptions_reporter_file: str,
+    exceptions_report_level: str,
+):
+    """
+    Build one model from MACHINE-CONFIG and write it to OUTPUT-DIR
+    (reference: cli.py:80-206; env-driven in pods: MACHINE, OUTPUT_DIR).
+    """
+    try:
+        if model_parameter and isinstance(machine_config["model"], str):
+            machine_config["model"] = expand_model(
+                machine_config["model"], dict(model_parameter)
+            )
+        machine = Machine.from_config(
+            machine_config, project_name=machine_config["project_name"]
+        )
+        logger.info("Building, output will be at: %s", output_dir)
+
+        # Round-trip the model config through the serializer so defaults are
+        # expanded into the stored definition (reference: cli.py:164-168).
+        machine.model = serializer.into_definition(
+            serializer.from_definition(machine.model)
+        )
+
+        builder = ModelBuilder(machine=machine)
+        _, machine_out = builder.build(output_dir, model_register_dir)
+
+        machine_out.report()
+
+        if print_cv_scores:
+            for score in get_all_score_strings(machine_out):
+                print(score)
+    except Exception:
+        _report_and_exit(exceptions_reporter_file, exceptions_report_level)
+    else:
+        return 0
+
+
+@click.command("build-fleet")
+@click.argument("machines-config", envvar="MACHINES", type=yaml.safe_load)
+@click.argument("output-dir", default="/data", envvar="OUTPUT_DIR")
+@_with_build_options
+def build_fleet(
+    machines_config: list,
+    output_dir: str,
+    model_register_dir: str,
+    print_cv_scores: bool,
+    model_parameter: List[Tuple[str, Any]],
+    exceptions_reporter_file: str,
+    exceptions_report_level: str,
+):
+    """
+    Build MANY models in one process: machines are bucketed by architecture
+    and each bucket trains as a single vmapped, mesh-sharded XLA program
+    (TPU-native replacement for the reference's one-pod-per-machine fan-out;
+    SURVEY.md §2.10/§7.6). MACHINES-CONFIG is a YAML list of machine
+    configs; artifacts land at OUTPUT-DIR/<machine-name>/.
+    """
+    try:
+        machines = []
+        for machine_config in machines_config:
+            if model_parameter and isinstance(machine_config["model"], str):
+                machine_config["model"] = expand_model(
+                    machine_config["model"], dict(model_parameter)
+                )
+            machine = Machine.from_config(
+                machine_config, project_name=machine_config["project_name"]
+            )
+            machine.model = serializer.into_definition(
+                serializer.from_definition(machine.model)
+            )
+            machines.append(machine)
+        logger.info(
+            "Fleet-building %d machines, output at: %s", len(machines), output_dir
+        )
+        built = FleetModelBuilder(machines).build(output_dir_base=output_dir)
+        for _, machine_out in built:
+            machine_out.report()
+            if print_cv_scores:
+                for score in get_all_score_strings(machine_out):
+                    print(f"{machine_out.name}: {score}")
+    except Exception:
+        _report_and_exit(exceptions_reporter_file, exceptions_report_level)
+    else:
+        return 0
+
+
+def expand_model(model_config: str, model_parameters: dict):
+    """
+    Render jinja variables in a string model config
+    (reference: cli.py:209-240).
+    """
+    try:
+        template = jinja2.Environment(
+            loader=jinja2.BaseLoader(), undefined=jinja2.StrictUndefined
+        ).from_string(model_config)
+        model_config = template.render(**model_parameters)
+    except jinja2.exceptions.UndefinedError as e:
+        raise ValueError("Model parameter missing value!") from e
+    logger.info("Expanded model config: %s", model_config)
+    return yaml.safe_load(model_config)
+
+
+def get_all_score_strings(machine) -> List[str]:
+    """
+    CV scores as ``metric_fold=value`` lines for Katib hyperparameter
+    search to scrape (reference: cli.py:243-275).
+    """
+    all_scores = []
+    scores = machine.metadata.build_metadata.model.cross_validation.scores
+    for metric_name, metric_scores in scores.items():
+        metric_name = metric_name.replace(" ", "-")
+        for score_name, score_val in metric_scores.items():
+            score_name = score_name.replace(" ", "-")
+            all_scores.append(f"{metric_name}_{score_name}={score_val}")
+    return all_scores
+
+
+@click.command("run-server")
+@click.option(
+    "--host",
+    type=HostIP(),
+    default="0.0.0.0",
+    envvar="GORDO_SERVER_HOST",
+    show_default=True,
+    help="The host to run the server on.",
+)
+@click.option(
+    "--port",
+    type=click.IntRange(1, 65535),
+    default=5555,
+    envvar="GORDO_SERVER_PORT",
+    show_default=True,
+    help="The port to run the server on.",
+)
+@click.option(
+    "--workers",
+    type=click.IntRange(1, 4),
+    default=2,
+    envvar="GORDO_SERVER_WORKERS",
+    show_default=True,
+    help="Worker processes (kept for flag parity; the werkzeug server is "
+    "single-process multi-threaded, which keeps one TPU context hot).",
+)
+@click.option(
+    "--threads",
+    type=int,
+    default=8,
+    envvar="GORDO_SERVER_THREADS",
+    help="Worker threads for handling requests.",
+)
+@click.option(
+    "--log-level",
+    type=click.Choice(["debug", "info", "warning", "error", "critical"]),
+    default="debug",
+    envvar="GORDO_SERVER_LOG_LEVEL",
+    show_default=True,
+    help="The log level for the server.",
+)
+@click.option(
+    "--with-prometheus",
+    is_flag=True,
+    help="Enable Prometheus request metrics.",
+)
+def run_server_cli(host, port, workers, threads, log_level, with_prometheus):
+    """Run the model server (reference: cli.py:278-374)."""
+    from gordo_tpu.server import app as server_app
+
+    config = {"ENABLE_PROMETHEUS": True} if with_prometheus else None
+    server_app.run_server(
+        host, port, workers, log_level, config=config, threads=threads
+    )
+
+
+gordo.add_command(workflow_cli)
+gordo.add_command(build)
+gordo.add_command(build_fleet)
+gordo.add_command(run_server_cli)
+gordo.add_command(gordo_client)
+
+if __name__ == "__main__":
+    gordo()

@@ -1,0 +1,218 @@
+"""
+Client subcommands (reference parity: gordo/cli/client.py).
+"""
+
+import json
+import os
+import sys
+import typing
+from datetime import datetime
+from pprint import pprint
+
+import click
+import yaml
+from requests import Session
+
+from gordo_tpu import serializer
+from gordo_tpu.cli.custom_types import (
+    DataProviderParam,
+    IsoFormatDateTime,
+    key_value_par,
+)
+from gordo_tpu.client import Client
+from gordo_tpu.client.forwarders import ForwardPredictionsIntoInflux
+from gordo_tpu.data.providers import GordoBaseDataProvider
+
+
+@click.group("client")
+@click.option("--project", help="The project to target")
+@click.option("--host", help="The host the server is running on", default="localhost")
+@click.option("--port", help="Port the server is running on", default=443)
+@click.option("--scheme", help="tcp/http/https", default="https")
+@click.option("--batch-size", help="How many samples to send", default=100000)
+@click.option("--parallelism", help="Maximum concurrent jobs to run", default=10)
+@click.option(
+    "--metadata",
+    type=key_value_par,
+    multiple=True,
+    default=(),
+    help="key,value pair sent as metadata labels with forwarded "
+    "predictions; repeatable.",
+)
+@click.option(
+    "--session-config",
+    type=yaml.safe_load,
+    default="{}",
+    help="JSON/YAML of attributes to set on the requests.Session, e.g. "
+    "auth headers: --session-config \"{'headers': {'API-KEY': 'foo'}}\"",
+)
+@click.pass_context
+def client(ctx: click.Context, *args, **kwargs):
+    """Client sub-commands (predict / metadata / download-model)."""
+    kwargs["metadata"] = dict(kwargs.get("metadata", ()))
+    session_config = kwargs.pop("session_config", None)
+    if session_config:
+        session = Session()
+        for key, value in session_config.items():
+            setattr(session, key, value)
+        kwargs["session"] = session
+    ctx.obj = {"args": args, "kwargs": kwargs}
+
+
+@click.command("predict")
+@click.argument("start", type=IsoFormatDateTime())
+@click.argument("end", type=IsoFormatDateTime())
+@click.option(
+    "--target",
+    multiple=True,
+    default=[],
+    help="Machines to target; defaults to all machines in the project",
+)
+@click.option(
+    "--data-provider",
+    type=DataProviderParam(),
+    envvar="DATA_PROVIDER",
+    help="DataProvider JSON/YAML (requires a 'type' key).",
+)
+@click.option(
+    "--output-dir",
+    type=click.Path(exists=True),
+    help="Save output prediction dataframes in a directory",
+)
+@click.option(
+    "--influx-uri",
+    help="<username>:<password>@<host>:<port>/<optional-path>/<db_name>",
+)
+@click.option("--influx-api-key", help="Key for the destination influx")
+@click.option(
+    "--influx-recreate-db",
+    is_flag=True,
+    default=False,
+    help="Recreate the destination DB before writing",
+)
+@click.option(
+    "--forward-resampled-sensors",
+    is_flag=True,
+    default=False,
+    help="Forward the resampled sensor values",
+)
+@click.option(
+    "--n-retries",
+    type=int,
+    default=5,
+    help="Times the client should retry failed predictions",
+)
+@click.option(
+    "--parquet/--no-parquet",
+    default=True,
+    help="Use parquet serialization to/from the server",
+)
+@click.pass_context
+def predict(
+    ctx: click.Context,
+    start: datetime,
+    end: datetime,
+    target: typing.List[str],
+    data_provider: GordoBaseDataProvider,
+    output_dir: str,
+    influx_uri: str,
+    influx_api_key: str,
+    influx_recreate_db: bool,
+    forward_resampled_sensors: bool,
+    n_retries: int,
+    parquet: bool,
+):
+    """Run predictions for [START, END] (reference: cli/client.py:60-167)."""
+    ctx.obj["kwargs"].update(
+        {
+            "data_provider": data_provider,
+            "forward_resampled_sensors": forward_resampled_sensors,
+            "n_retries": n_retries,
+            "use_parquet": parquet,
+        }
+    )
+    client = Client(*ctx.obj["args"], **ctx.obj["kwargs"])
+    if influx_uri is not None:
+        client.prediction_forwarder = ForwardPredictionsIntoInflux(
+            destination_influx_uri=influx_uri,
+            destination_influx_api_key=influx_api_key,
+            destination_influx_recreate=influx_recreate_db,
+            n_retries=n_retries,
+        )
+
+    predictions = client.predict(start, end, targets=list(target))
+
+    click.secho(f"\n{'-' * 20} Summary of failed predictions (if any) {'-' * 20}")
+    exit_code = 0
+    for _name, _df, error_messages in predictions:
+        for err_msg in error_messages:
+            exit_code = 1
+            click.secho(err_msg, fg="red")
+
+    if output_dir is not None:
+        for name, prediction_df, _err_msgs in predictions:
+            prediction_df.to_csv(
+                os.path.join(output_dir, f"{name}.csv.gz"), compression="gzip"
+            )
+    sys.exit(exit_code)
+
+
+@click.command("metadata")
+@click.option(
+    "--output-file",
+    type=click.File(mode="w"),
+    help="Optional output file to save metadata",
+)
+@click.option(
+    "--target",
+    multiple=True,
+    default=[],
+    help="Machines to target; defaults to all machines in the project",
+)
+@click.pass_context
+def metadata(
+    ctx: click.Context,
+    output_file: typing.Optional[typing.IO[str]],
+    target: typing.List[str],
+):
+    """Fetch machine metadata (reference: cli/client.py:170-201)."""
+    client = Client(*ctx.obj["args"], **ctx.obj["kwargs"])
+    meta = {
+        k: v.to_dict() for k, v in client.get_metadata(targets=list(target)).items()
+    }
+    if output_file:
+        json.dump(meta, output_file)
+        click.secho(f"Saved metadata json to file: '{output_file}'")
+    else:
+        pprint(meta)
+    return meta
+
+
+@click.command("download-model")
+@click.argument("output-dir", type=click.Path(exists=True))
+@click.option(
+    "--target",
+    multiple=True,
+    default=[],
+    help="Machines to target; defaults to all machines in the project",
+)
+@click.pass_context
+def download_model(ctx: click.Context, output_dir: str, target: typing.List[str]):
+    """Download models into per-machine dirs (reference: cli/client.py:204-232)."""
+    client = Client(*ctx.obj["args"], **ctx.obj["kwargs"])
+    models = client.download_model(targets=list(target))
+    for model_name, model in models.items():
+        model_out_dir = os.path.join(output_dir, model_name)
+        os.mkdir(model_out_dir)
+        click.secho(
+            f"Writing model '{model_name}' to directory: '{model_out_dir}'...",
+            nl=False,
+        )
+        serializer.dump(model, model_out_dir)
+        click.secho("done")
+    click.secho(f"Wrote all models to directory: {output_dir}", fg="green")
+
+
+client.add_command(predict)
+client.add_command(metadata)
+client.add_command(download_model)

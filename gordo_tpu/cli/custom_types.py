@@ -1,0 +1,67 @@
+"""
+Click parameter types (reference parity: gordo/cli/custom_types.py:14-73).
+"""
+
+import ipaddress
+import os
+import typing
+
+import click
+import yaml
+from dateutil import parser
+
+from gordo_tpu.data import providers
+
+
+class DataProviderParam(click.ParamType):
+    """Load a data provider from inline JSON/YAML or a JSON/YAML file."""
+
+    name = "data-provider"
+
+    def convert(self, value, param, ctx):
+        if os.path.isfile(value):
+            with open(value) as f:
+                kwargs = yaml.safe_load(f)
+        else:
+            kwargs = yaml.safe_load(value)
+        if "type" not in kwargs:
+            self.fail("Cannot create DataProvider without 'type' key defined")
+        kind = kwargs.pop("type")
+        provider_cls = getattr(providers, kind, None)
+        if provider_cls is None:
+            self.fail(f"No DataProvider named '{kind}'")
+        return provider_cls(**kwargs)
+
+
+class IsoFormatDateTime(click.ParamType):
+    """Parse an ISO-formatted datetime string."""
+
+    name = "iso-datetime"
+
+    def convert(self, value, param, ctx):
+        try:
+            return parser.isoparse(value)
+        except ValueError:
+            self.fail(f"Failed to parse date '{value}' as ISO formatted date")
+
+
+class HostIP(click.ParamType):
+    """Validate the input is an IP address."""
+
+    name = "host"
+
+    def convert(self, value, param, ctx):
+        try:
+            ipaddress.ip_address(value)
+            return value
+        except ValueError as e:
+            self.fail(str(e))
+
+
+def key_value_par(val) -> typing.Tuple[str, str]:
+    """'key,val' → (key, val); a missing comma is a usage error."""
+    if "," not in val:
+        raise click.BadParameter(
+            f"Expected 'key,value' (comma-separated), got {val!r}"
+        )
+    return tuple(val.split(",", 1))

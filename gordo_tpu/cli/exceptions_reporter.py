@@ -1,0 +1,195 @@
+"""
+Structured failure reporting for build pods (reference parity:
+gordo/cli/exceptions_reporter.py:12-224): map exception class → exit code
+and write a trimmed JSON report sized for the k8s pod termination message
+(≤2024 bytes).
+"""
+
+import json
+import traceback
+from collections import Counter
+from enum import Enum
+from types import TracebackType
+from typing import IO, Dict, Iterable, List, Optional, Tuple, Type
+
+from gordo_tpu.utils import replace_all_non_ascii_chars_with_default
+
+DEFAULT_EXIT_CODE = 1
+
+
+class ReportLevel(Enum):
+    EXIT_CODE = 0
+    TYPE = 1
+    MESSAGE = 2
+    TRACEBACK = 3
+
+    @classmethod
+    def get_by_name(
+        cls, name: str, default: Optional["ReportLevel"] = None
+    ) -> Optional["ReportLevel"]:
+        for level in cls:
+            if name == level.name:
+                return level
+        return default
+
+    @classmethod
+    def get_names(cls) -> List[str]:
+        return [level.name for level in cls]
+
+
+class ExceptionsReporter:
+    """
+    Save exception info as JSON (k8s terminationMessagePath consumer) and
+    translate exception types to exit codes.
+
+    Parameters
+    ----------
+    exceptions
+        (exception class, exit code) pairs. Subclass matches win over base
+        classes regardless of registration order.
+    default_exit_code
+        Exit code for unregistered exception types.
+    traceback_limit
+        Passed to ``traceback.format_exception``.
+    """
+
+    def __init__(
+        self,
+        exceptions: Iterable[Tuple[Type[Exception], int]],
+        default_exit_code: int = DEFAULT_EXIT_CODE,
+        traceback_limit: Optional[int] = None,
+    ):
+        self.exceptions_items = self.sort_exceptions(exceptions)
+        self.default_exit_code = default_exit_code
+        self.traceback_limit = traceback_limit
+
+    @staticmethod
+    def sort_exceptions(
+        exceptions: Iterable[Tuple[Type[Exception], int]]
+    ) -> List[Tuple[Type[Exception], int]]:
+        """
+        Order so the most-derived classes are found first
+        (reference: exceptions_reporter.py:61-77).
+        """
+        exceptions = list(exceptions)
+        inheritance_levels: Dict[Type[BaseException], int] = Counter()
+        for exc, _ in exceptions:
+            for other, _ in exceptions:
+                if other is not exc and issubclass(exc, other):
+                    inheritance_levels[other] += 1
+        return sorted(
+            exceptions, key=lambda item: (inheritance_levels[item[0]], item[1])
+        )
+
+    @staticmethod
+    def trim_message(message: str, max_length: int) -> str:
+        if len(message) > max_length:
+            message = message[: max_length - 3]
+            return "" if len(message) <= 3 else message + "..."
+        return message
+
+    @staticmethod
+    def trim_formatted_traceback(
+        formatted_traceback: List[str], max_length: int
+    ) -> List[str]:
+        """Keep the tail of the traceback within budget, '...'-prefixed."""
+        if sum(len(line) for line in formatted_traceback) <= max_length:
+            return formatted_traceback
+        length = 4
+        result: List[str] = []
+        for line in reversed(formatted_traceback):
+            length += len(line)
+            if length > max_length:
+                result.append("...\n")
+                break
+            result.append(line)
+        return list(reversed(result))
+
+    def found_exception_item(self, exc_type: Type[BaseException]):
+        for item in self.exceptions_items:
+            if issubclass(exc_type, item[0]):
+                return item
+        return None
+
+    def exception_exit_code(
+        self, exc_type: Optional[Type[BaseException]]
+    ) -> int:
+        """Exit code for the exception type (0 for None)."""
+        if exc_type is None:
+            return 0
+        item = self.found_exception_item(exc_type)
+        return item[1] if item is not None else self.default_exit_code
+
+    def report(
+        self,
+        level: ReportLevel,
+        exc_type: Optional[Type[BaseException]],
+        exc_value: Optional[BaseException],
+        exc_traceback: Optional[TracebackType],
+        report_file: IO[str],
+        max_message_len: Optional[int] = None,
+    ):
+        """Write the JSON report at the given verbosity."""
+        report: Dict[str, str] = {}
+        if (
+            exc_type is not None
+            and exc_value is not None
+            and exc_traceback is not None
+            and self.found_exception_item(exc_type) is not None
+        ):
+            if level in (
+                ReportLevel.MESSAGE,
+                ReportLevel.TYPE,
+                ReportLevel.TRACEBACK,
+            ):
+                report["type"] = replace_all_non_ascii_chars_with_default(
+                    exc_type.__name__, "?"
+                )
+            if level == ReportLevel.MESSAGE:
+                report["message"] = replace_all_non_ascii_chars_with_default(
+                    str(exc_value), "?"
+                )
+                if max_message_len is not None:
+                    report["message"] = self.trim_message(
+                        report["message"], max_message_len
+                    )
+            elif level == ReportLevel.TRACEBACK:
+                formatted = traceback.format_exception(
+                    exc_type,
+                    exc_value,
+                    exc_traceback,
+                    limit=self.traceback_limit,
+                )
+                formatted = [
+                    replace_all_non_ascii_chars_with_default(v, "?")
+                    for v in formatted
+                ]
+                if max_message_len is not None:
+                    formatted = self.trim_formatted_traceback(
+                        formatted, max_message_len
+                    )
+                report["traceback"] = "".join(formatted)
+        json.dump(report, report_file)
+
+    def safe_report(
+        self,
+        level: ReportLevel,
+        exc_type: Optional[Type[BaseException]],
+        exc_value: Optional[BaseException],
+        exc_traceback: Optional[TracebackType],
+        report_file_path: str,
+        max_message_len: Optional[int] = None,
+    ):
+        """report(), never raising (reference: exceptions_reporter.py:188-224)."""
+        try:
+            with open(report_file_path, "w") as report_file:
+                self.report(
+                    level,
+                    exc_type,
+                    exc_value,
+                    exc_traceback,
+                    report_file,
+                    max_message_len,
+                )
+        except Exception:
+            traceback.print_exc()

@@ -365,7 +365,9 @@ class Client:
                 return PredictionResult(
                     name=machine.name, predictions=None, error_messages=[msg]
                 )
-            except (BadGordoRequest, NotFound) as exc:
+            except (HttpUnprocessableEntity, BadGordoRequest, NotFound) as exc:
+                # A second 422 (the fallback /prediction also refused) is a
+                # per-machine failure like any other 4xx — not a run-abort.
                 msg = (
                     f"Failed with bad request or not found for dates "
                     f"{start} -> {end} for target: '{machine.name}' Error: {exc}"

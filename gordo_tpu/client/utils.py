@@ -69,13 +69,19 @@ def cached_method(maxsize: int = 128, ttl: Optional[float] = None):
 
     def decorator(fn):
         attr = f"_cache_{fn.__name__}"
+        creation_lock = threading.Lock()
 
         @wraps(fn)
         def wrapper(self, *args, **kwargs):
             cache = getattr(self, attr, None)
             if cache is None:
-                cache = _BoundedCache(maxsize=maxsize, ttl=ttl)
-                setattr(self, attr, cache)
+                # Atomic creation: concurrent first calls (the client fans
+                # metadata fetches over a thread pool) must share one cache.
+                with creation_lock:
+                    cache = getattr(self, attr, None)
+                    if cache is None:
+                        cache = _BoundedCache(maxsize=maxsize, ttl=ttl)
+                        setattr(self, attr, cache)
             key = (args, tuple(sorted(kwargs.items())))
             value = cache.get(key, _CACHE_MISS)
             if value is _CACHE_MISS:

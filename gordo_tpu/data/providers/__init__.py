@@ -11,7 +11,11 @@ Data providers: sources of raw tag series.
 from .base import GordoBaseDataProvider
 from .random_provider import RandomDataProvider
 from .filesystem import FileSystemProvider
-from .compound import DataLakeProvider, providers_for_tags
+from .compound import (
+    DataLakeProvider,
+    NoSuitableDataProviderError,
+    providers_for_tags,
+)
 
 try:  # influxdb client is optional
     from .influx import InfluxDataProvider  # noqa: F401
@@ -25,6 +29,7 @@ __all__ = [
     "RandomDataProvider",
     "FileSystemProvider",
     "DataLakeProvider",
+    "NoSuitableDataProviderError",
     "providers_for_tags",
 ]
 if _HAS_INFLUX:

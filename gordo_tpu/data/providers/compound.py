@@ -27,6 +27,14 @@ logger = logging.getLogger(__name__)
 LAKE_DIR_ENV_VAR = "GORDO_TPU_LAKE_DIR"
 
 
+class NoSuitableDataProviderError(ValueError):
+    """
+    No configured provider can handle a requested tag (reference parity:
+    gordo/machine/dataset/data_provider/providers.py — carries its own
+    exit code in the build CLI's exception table).
+    """
+
+
 def providers_for_tags(
     providers: typing.List[GordoBaseDataProvider],
     tag_list: typing.List[SensorTag],
@@ -39,7 +47,9 @@ def providers_for_tags(
                 assignment.setdefault(provider, []).append(tag)
                 break
         else:
-            raise ValueError(f"No provider can handle tag {tag}")
+            raise NoSuitableDataProviderError(
+                f"No provider can handle tag {tag}"
+            )
     return assignment
 
 

@@ -1,0 +1,315 @@
+"""
+CLI tests via click's CliRunner (reference: tests/gordo/cli/test_cli.py,
+test_workflow_generator.py — argo-lint via docker is out of scope in this
+image; the rendered YAML is instead parsed and structurally asserted).
+"""
+
+import json
+import os
+
+import pytest
+import yaml
+from click.testing import CliRunner
+
+from gordo_tpu import __version__, serializer
+from gordo_tpu.cli import gordo
+from gordo_tpu.cli.cli import expand_model, get_all_score_strings
+from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
+
+MACHINE_YAML = """
+name: cli-machine
+project_name: cli-project
+dataset:
+  type: RandomDataset
+  tags: [tag-0, tag-1, tag-2]
+  target_tag_list: [tag-0, tag-1, tag-2]
+  train_start_date: '2019-01-01T00:00:00+00:00'
+  train_end_date: '2019-01-02T00:00:00+00:00'
+  asset: gra
+model:
+  gordo_tpu.models.AutoEncoder:
+    kind: feedforward_hourglass
+    epochs: 1
+"""
+
+PROJECT_YAML = """
+machines:
+  - name: wf-machine-0
+    dataset:
+      type: RandomDataset
+      tags: [tag-0, tag-1]
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-02T00:00:00+00:00'
+      asset: gra
+  - name: wf-machine-1
+    dataset:
+      type: RandomDataset
+      tags: [tag-1, tag-2]
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-02T00:00:00+00:00'
+      asset: gra
+  - name: wf-machine-2
+    dataset:
+      type: RandomDataset
+      tags: [tag-3]
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-02T00:00:00+00:00'
+      asset: gra
+globals:
+  model:
+    gordo_tpu.models.AutoEncoder:
+      kind: feedforward_hourglass
+  runtime:
+    builder:
+      machines_per_pod: 2
+"""
+
+
+@pytest.fixture
+def runner():
+    return CliRunner()
+
+
+def test_version(runner):
+    result = runner.invoke(gordo, ["--version"])
+    assert result.exit_code == 0
+    assert __version__ in result.output
+
+
+def test_build(runner, tmp_path):
+    out_dir = str(tmp_path / "out")
+    result = runner.invoke(
+        gordo, ["build", MACHINE_YAML, out_dir, "--print-cv-scores"]
+    )
+    assert result.exit_code == 0, result.output
+    model = serializer.load(out_dir)
+    metadata = serializer.load_metadata(out_dir)
+    assert metadata["name"] == "cli-machine"
+    assert model is not None
+    # Katib-format CV score lines on stdout (reference: cli.py:243-275)
+    assert any("=" in line and "fold" in line for line in result.output.splitlines())
+
+
+def test_build_env_vars(runner, tmp_path):
+    """MACHINE / OUTPUT_DIR env vars drive the build (pod semantics)."""
+    out_dir = str(tmp_path / "out-env")
+    result = runner.invoke(
+        gordo, ["build"], env={"MACHINE": MACHINE_YAML, "OUTPUT_DIR": out_dir}
+    )
+    assert result.exit_code == 0, result.output
+    assert os.path.exists(os.path.join(out_dir, "model.pkl"))
+
+
+def test_build_insufficient_data_exit_code(runner, tmp_path):
+    """Typed exit code 80 + JSON report file on InsufficientDataError."""
+    bad_yaml = MACHINE_YAML.replace(
+        "asset: gra", "asset: gra\n  n_samples_threshold: 100000"
+    )
+    report_file = str(tmp_path / "exc.json")
+    result = runner.invoke(
+        gordo,
+        [
+            "build",
+            bad_yaml,
+            str(tmp_path / "o"),
+            "--exceptions-reporter-file",
+            report_file,
+            "--exceptions-report-level",
+            "MESSAGE",
+        ],
+    )
+    assert result.exit_code == 80
+    with open(report_file) as f:
+        report = json.load(f)
+    assert report["type"] == "InsufficientDataError"
+    assert "message" in report
+
+
+def test_build_fleet(runner, tmp_path):
+    machines = [
+        yaml.safe_load(MACHINE_YAML) | {"name": f"fleet-m-{i}"} for i in range(3)
+    ]
+    out_dir = str(tmp_path / "fleet-out")
+    # JSON is the canonical MACHINES payload (what the workflow template
+    # injects); YAML block style would lead with "- " which click rejects
+    # as an option when passed positionally.
+    result = runner.invoke(gordo, ["build-fleet", json.dumps(machines), out_dir])
+    assert result.exit_code == 0, result.output
+    for i in range(3):
+        sub = os.path.join(out_dir, f"fleet-m-{i}")
+        assert os.path.exists(os.path.join(sub, "model.pkl"))
+        meta = serializer.load_metadata(sub)
+        assert meta["name"] == f"fleet-m-{i}"
+
+
+def test_expand_model():
+    expanded = expand_model(
+        "gordo_tpu.models.AutoEncoder: {kind: feedforward_hourglass, "
+        "epochs: {{ epochs }}}",
+        {"epochs": 7},
+    )
+    assert expanded["gordo_tpu.models.AutoEncoder"]["epochs"] == 7
+    with pytest.raises(ValueError):
+        expand_model("a: {{ missing }}", {})
+
+
+def test_exceptions_reporter_ordering_and_codes():
+    reporter = ExceptionsReporter(
+        ((Exception, 1), (ValueError, 5), (FileNotFoundError, 30), (OSError, 40))
+    )
+    assert reporter.exception_exit_code(None) == 0
+    assert reporter.exception_exit_code(FileNotFoundError) == 30  # subclass wins
+    assert reporter.exception_exit_code(OSError) == 40
+    assert reporter.exception_exit_code(ValueError) == 5
+    assert reporter.exception_exit_code(KeyError) == 1  # default via Exception
+
+
+def test_exceptions_reporter_trimming(tmp_path):
+    reporter = ExceptionsReporter(((ValueError, 5),))
+    path = str(tmp_path / "r.json")
+    try:
+        raise ValueError("x" * 5000)
+    except ValueError:
+        import sys
+
+        reporter.safe_report(
+            ReportLevel.MESSAGE, *sys.exc_info(), path, max_message_len=100
+        )
+    with open(path) as f:
+        report = json.load(f)
+    assert len(report["message"]) <= 100
+    assert report["message"].endswith("...")
+
+
+def test_get_all_score_strings_spaces_replaced():
+    class FakeMachine:
+        class metadata:
+            class build_metadata:
+                class model:
+                    class cross_validation:
+                        scores = {"mean squared error": {"fold 1": 0.5}}
+
+    lines = get_all_score_strings(FakeMachine)
+    assert lines == ["mean-squared-error_fold-1=0.5"]
+
+
+# --- workflow generation ----------------------------------------------------
+
+
+@pytest.fixture
+def project_config_file(tmp_path):
+    path = tmp_path / "config.yml"
+    path.write_text(PROJECT_YAML)
+    return str(path)
+
+
+def _render_workflows(runner, config_file, *extra):
+    result = runner.invoke(
+        gordo,
+        [
+            "workflow",
+            "generate",
+            "--machine-config",
+            config_file,
+            "--project-name",
+            "wf-proj",
+            "--project-revision",
+            "123",
+            *extra,
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    return list(yaml.safe_load_all(result.output))
+
+
+def test_workflow_generate_renders_valid_yaml(runner, project_config_file):
+    docs = _render_workflows(runner, project_config_file)
+    assert len(docs) == 1
+    wf = docs[0]
+    assert wf["kind"] == "Workflow"
+    assert wf["metadata"]["labels"]["gordo-tpu/project-name"] == "wf-proj"
+    names = {t["name"] for t in wf["spec"]["templates"]}
+    assert {
+        "do-all",
+        "ensure-single-workflow",
+        "model-fleet-builder",
+        "gordo-server-deployment",
+        "gordo-client",
+    } <= names
+    # 3 machines, machines_per_pod=2 → 2 builder buckets in the DAG
+    dag = next(t for t in wf["spec"]["templates"] if t["name"] == "do-all")
+    build_tasks = [
+        t for t in dag["dag"]["tasks"] if t["name"].startswith("build-bucket")
+    ]
+    assert len(build_tasks) == 2
+    assert dag["dag"]["failFast"] is False
+    # bucket MACHINES payload is valid JSON with the right machines
+    payload = json.loads(
+        build_tasks[0]["arguments"]["parameters"][0]["value"]
+    )
+    assert [m["name"] for m in payload] == ["wf-machine-0", "wf-machine-1"]
+    # postgres reporter injected when influx enabled
+    assert any(
+        "PostgresReporter" in json.dumps(m) for m in payload
+    )
+    # per-machine client tasks exist and depend on their bucket build
+    client_tasks = [
+        t for t in dag["dag"]["tasks"] if t["name"].startswith("client-wf-machine")
+    ]
+    assert len(client_tasks) == 3
+
+
+def test_workflow_generate_split(runner, project_config_file):
+    docs = _render_workflows(
+        runner, project_config_file, "--split-workflows", "2"
+    )
+    assert len(docs) == 2
+    first_names = json.loads(docs[0]["metadata"]["annotations"]["gordo-models"])
+    second_names = json.loads(docs[1]["metadata"]["annotations"]["gordo-models"])
+    assert first_names == ["wf-machine-0", "wf-machine-1"]
+    assert second_names == ["wf-machine-2"]
+
+
+def test_workflow_generate_tpu_node_pool(runner, tmp_path):
+    config = PROJECT_YAML + """
+      tpu:
+        enable: true
+        accelerator: v5litepod-16
+        chips: 4
+"""
+    path = tmp_path / "tpu-config.yml"
+    path.write_text(config)
+    docs = _render_workflows(runner, str(path))
+    builder = next(
+        t for t in docs[0]["spec"]["templates"] if t["name"] == "model-fleet-builder"
+    )
+    assert (
+        builder["nodeSelector"]["cloud.google.com/gke-tpu-accelerator"]
+        == "v5litepod-16"
+    )
+    assert builder["container"]["resources"]["limits"]["google.com/tpu"] == 4
+
+
+def test_workflow_unique_tags(runner, project_config_file, tmp_path):
+    out = tmp_path / "tags.txt"
+    result = runner.invoke(
+        gordo,
+        [
+            "workflow",
+            "unique-tags",
+            "--machine-config",
+            project_config_file,
+            "--output-file-tag-list",
+            str(out),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    tags = set(out.read_text().split())
+    assert tags == {"tag-0", "tag-1", "tag-2", "tag-3"}
+
+
+def test_client_cli_help(runner):
+    result = runner.invoke(gordo, ["client", "--help"])
+    assert result.exit_code == 0
+    for sub in ("predict", "metadata", "download-model"):
+        assert sub in result.output

@@ -59,7 +59,7 @@ class WSGIAdapter(BaseAdapter):
         pass
 
 
-def loopback_session(wsgi_app, prefix: str = "http://") -> requests.Session:
+def loopback_session(wsgi_app) -> requests.Session:
     """A requests.Session whose http(s) traffic hits ``wsgi_app`` in-process."""
     session = requests.Session()
     adapter = WSGIAdapter(wsgi_app)
