@@ -15,6 +15,10 @@ from .models import (
     LSTMBaseEstimator,
     LSTMForecast,
     RawModelRegressor,
+    TCNAutoEncoder,
+    TCNForecast,
+    TransformerAutoEncoder,
+    TransformerForecast,
 )
 from .register import register_model_builder
 from .specs import ModelSpec
@@ -27,6 +31,10 @@ __all__ = [
     "LSTMForecast",
     "LSTMBaseEstimator",
     "RawModelRegressor",
+    "TransformerAutoEncoder",
+    "TransformerForecast",
+    "TCNAutoEncoder",
+    "TCNForecast",
     "KerasAutoEncoder",
     "KerasLSTMAutoEncoder",
     "KerasLSTMForecast",

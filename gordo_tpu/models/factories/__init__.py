@@ -8,4 +8,6 @@ from .feedforward import (  # noqa: F401
     feedforward_symmetric,
 )
 from .lstm import lstm_hourglass, lstm_model, lstm_symmetric  # noqa: F401
+from .tcn import tcn_model  # noqa: F401
+from .transformer import transformer_model  # noqa: F401
 from .utils import check_dim_func_len, hourglass_calc_dims  # noqa: F401

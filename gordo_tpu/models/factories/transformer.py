@@ -1,0 +1,65 @@
+"""
+Transformer encoder factories — a new backend beyond the reference's LSTM
+ceiling (BASELINE.json config #5). Registered under TransformerAutoEncoder /
+TransformerForecast the same way the LSTM trio registers under its two types
+(reference pattern: gordo/machine/model/factories/lstm_autoencoder.py:15-16).
+"""
+
+from typing import Any, Dict, Optional, Union
+
+from gordo_tpu.models.register import register_model_builder
+from gordo_tpu.models.specs import ModelSpec, resolve_dtype
+from gordo_tpu.models.specs_seq import ATTENTION_IMPLS, TransformerNet
+
+
+@register_model_builder(type="TransformerAutoEncoder")
+@register_model_builder(type="TransformerForecast")
+def transformer_model(
+    n_features: int,
+    n_features_out: Optional[int] = None,
+    lookback_window: int = 1,
+    d_model: int = 64,
+    n_heads: int = 4,
+    n_layers: int = 2,
+    ff_dim: Optional[int] = None,
+    dropout: float = 0.1,
+    causal: bool = True,
+    attention_impl: str = "dense",
+    out_func: str = "linear",
+    optimizer: str = "Adam",
+    optimizer_kwargs: Dict[str, Any] = dict(),
+    compile_kwargs: Dict[str, Any] = dict(),
+    dtype: Union[str, Any] = "float32",
+    **kwargs,
+) -> ModelSpec:
+    """
+    Encoder-only Transformer over the lookback window.
+
+    ``attention_impl``: "dense" (XLA einsum) or "flash" (Pallas blockwise
+    kernel — preferable once lookback_window reaches hundreds of steps).
+    """
+    n_features_out = n_features_out or n_features
+    if attention_impl not in ATTENTION_IMPLS:
+        raise ValueError(
+            f"attention_impl must be one of {ATTENTION_IMPLS}, got {attention_impl!r}"
+        )
+    module = TransformerNet(
+        d_model=d_model,
+        n_heads=n_heads,
+        n_layers=n_layers,
+        ff_dim=ff_dim or 4 * d_model,
+        out_dim=n_features_out,
+        dropout=dropout,
+        causal=causal,
+        attention_impl=attention_impl,
+        out_func=out_func,
+        dtype=resolve_dtype(dtype),
+    )
+    return ModelSpec(
+        module=module,
+        optimizer=optimizer,
+        optimizer_kwargs=dict(optimizer_kwargs),
+        loss=dict(compile_kwargs).get("loss", "mse"),
+        windowed=True,
+        lookback_window=lookback_window,
+    )

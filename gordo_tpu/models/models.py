@@ -156,6 +156,45 @@ class LSTMAutoEncoder(LSTMBaseEstimator):
         return 0
 
 
+class TransformerAutoEncoder(LSTMBaseEstimator):
+    """
+    Transformer-encoder window reconstructor — new backend beyond the
+    reference (BASELINE.json config #5). Same windowed many-to-one contract
+    as LSTMAutoEncoder; architecture from factories/transformer.py.
+    """
+
+    @property
+    def lookahead(self) -> int:
+        return 0
+
+
+class TransformerForecast(LSTMBaseEstimator):
+    """Transformer-encoder 1-step-ahead forecaster (new backend)."""
+
+    @property
+    def lookahead(self) -> int:
+        return 1
+
+
+class TCNAutoEncoder(LSTMBaseEstimator):
+    """
+    Dilated-causal-conv (TCN) window reconstructor — new backend beyond the
+    reference (BASELINE.json config #5); architecture from factories/tcn.py.
+    """
+
+    @property
+    def lookahead(self) -> int:
+        return 0
+
+
+class TCNForecast(LSTMBaseEstimator):
+    """TCN 1-step-ahead forecaster (new backend)."""
+
+    @property
+    def lookahead(self) -> int:
+        return 1
+
+
 # layer path/name -> SequentialNet layer kind
 _RAW_LAYER_KINDS = {
     "dense": "dense",

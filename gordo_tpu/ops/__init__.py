@@ -12,4 +12,15 @@ __all__ = [
     "target_indices",
     "ACTIVATIONS",
     "resolve_activation",
+    "flash_attention",
 ]
+
+
+def __getattr__(name):
+    # lazy: keep jax.experimental.pallas out of the default import path —
+    # only the flash attention_impl pays for it
+    if name == "flash_attention":
+        from .flash_attention import flash_attention
+
+        return flash_attention
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

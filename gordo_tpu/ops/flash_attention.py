@@ -1,0 +1,167 @@
+"""
+Blockwise (flash-style) attention as a Pallas TPU kernel.
+
+The dense attention path (gordo_tpu/models/specs_seq.py:dense_attention)
+materializes the full (seq, seq) score matrix in HBM; this kernel tiles the
+query axis so only a (block_q, seq) strip ever lives in VMEM, with the
+matmuls hitting the MXU in float32 accumulation. Head_dim and seq are padded
+to lane/sublane multiples (128) outside the kernel — zero-padded key columns
+are masked, zero-padded head dims contribute nothing to the dot products.
+
+Autodiff: Pallas kernels don't get automatic transposition, so training
+runs through ``jax.custom_vjp`` — the forward saves (q, k, v) and the
+backward recomputes attention with the standard closed-form gradients in
+plain XLA einsums (cheap at these window lengths; the win of the kernel is
+the inference/serving path and forward memory).
+
+On non-TPU backends (CPU tests) the kernel runs in interpret mode.
+"""
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, seq_len, causal, block_q, sm_scale):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (block_q, d_pad)
+    k = k_ref[0].astype(jnp.float32)  # (seq_pad, d_pad)
+    v = v_ref[0].astype(jnp.float32)
+
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+    kpos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+    mask = kpos < seq_len
+    if causal:
+        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
+        mask = jnp.logical_and(mask, kpos <= qpos)
+    scores = jnp.where(mask, scores, _NEG_INF)
+
+    # numerically-stable softmax on the VPU, accumulation in f32
+    scores = scores - jnp.max(scores, axis=-1, keepdims=True)
+    weights = jnp.exp(scores)
+    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+    o_ref[0] = jnp.dot(weights, v, preferred_element_type=jnp.float32).astype(
+        o_ref.dtype
+    )
+
+
+def _flash_forward_bhsd(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool,
+    sm_scale: float,
+    block_q: int,
+    interpret: bool,
+) -> jnp.ndarray:
+    """Attention over (batch*heads, seq, head_dim) tensors via pallas_call."""
+    bh, seq, d = q.shape
+    seq_pad = _round_up(seq, block_q)
+    d_pad = _round_up(d, 128)
+
+    def pad(x):
+        return jnp.pad(x, ((0, 0), (0, seq_pad - seq), (0, d_pad - d)))
+
+    qp, kp, vp = pad(q), pad(k), pad(v)
+    n_q_blocks = seq_pad // block_q
+
+    kernel = functools.partial(
+        _attn_kernel,
+        seq_len=seq,
+        causal=causal,
+        block_q=block_q,
+        sm_scale=sm_scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, seq_pad, d_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, seq_pad, d_pad), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_pad, d_pad), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :seq, :d]
+
+
+def _dense_weights(q, k, causal, sm_scale):
+    """Recomputed softmax attention weights over (bh, s, d) inputs."""
+    scores = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * sm_scale
+    if causal:
+        s = scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
+        scores = jnp.where(mask, scores, _NEG_INF)
+    return jax.nn.softmax(scores, axis=-1)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_attention_bhsd(q, k, v, causal, sm_scale, block_q, interpret):
+    return _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret)
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, interpret):
+    out = _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, sm_scale, block_q, interpret, residuals, d_out):
+    q, k, v = residuals
+    weights = _dense_weights(q, k, causal, sm_scale)
+    d_out32 = d_out.astype(jnp.float32)
+    v32, q32, k32 = (x.astype(jnp.float32) for x in (v, q, k))
+    w32 = weights.astype(jnp.float32)
+
+    dv = jnp.einsum("bqk,bqd->bkd", w32, d_out32)
+    ds = jnp.einsum("bqd,bkd->bqk", d_out32, v32)
+    dp = w32 * (ds - jnp.sum(ds * w32, axis=-1, keepdims=True))
+    dq = jnp.einsum("bqk,bkd->bqd", dp, k32) * sm_scale
+    dk = jnp.einsum("bqk,bqd->bkd", dp, q32) * sm_scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash_attention_bhsd.defvjp(_fwd, _bwd)
+
+
+def flash_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    causal: bool = False,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    interpret: Optional[bool] = None,
+) -> jnp.ndarray:
+    """
+    Flash attention over (batch, seq, heads, head_dim) tensors — drop-in for
+    gordo_tpu.models.specs_seq.dense_attention.
+
+    ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
+    interpreter elsewhere (so CPU test runs exercise identical kernel code).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    batch, seq, heads, head_dim = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(head_dim)
+
+    def to_bhsd(x):
+        return x.transpose(0, 2, 1, 3).reshape(batch * heads, seq, head_dim)
+
+    out = _flash_attention_bhsd(
+        to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, sm_scale, block_q, interpret
+    )
+    return out.reshape(batch, heads, seq, head_dim).transpose(0, 2, 1, 3)

@@ -1,0 +1,180 @@
+"""
+Transformer / TCN backend tests (new backends beyond the reference —
+BASELINE.json config #5) plus the Pallas flash-attention kernel (interpret
+mode on CPU; the same kernel code compiles via Mosaic on TPU).
+"""
+
+import pickle
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from gordo_tpu.models import (
+    TCNAutoEncoder,
+    TCNForecast,
+    TransformerAutoEncoder,
+    TransformerForecast,
+)
+from gordo_tpu.models.anomaly import DiffBasedAnomalyDetector
+from gordo_tpu.models.specs_seq import (
+    TransformerNet,
+    dense_attention,
+    default_dilations,
+    receptive_field,
+    sinusoidal_positions,
+)
+from gordo_tpu.ops.flash_attention import flash_attention
+
+RNG = np.random.default_rng(7)
+
+
+def make_data(n=200, f=4):
+    X = RNG.random((n, f)).astype("float32")
+    return X, X.copy()
+
+
+SMALL_TRANSFORMER = dict(d_model=16, n_heads=2, n_layers=1, epochs=2, batch_size=16)
+SMALL_TCN = dict(channels=(8, 8), kernel_size=3, epochs=2, batch_size=16)
+
+
+@pytest.mark.parametrize(
+    "cls,kind,kwargs,lookahead",
+    [
+        (TransformerAutoEncoder, "transformer_model", SMALL_TRANSFORMER, 0),
+        (TransformerForecast, "transformer_model", SMALL_TRANSFORMER, 1),
+        (TCNAutoEncoder, "tcn_model", SMALL_TCN, 0),
+        (TCNForecast, "tcn_model", SMALL_TCN, 1),
+    ],
+)
+def test_fit_predict_shapes(cls, kind, kwargs, lookahead):
+    X, y = make_data()
+    model = cls(kind=kind, lookback_window=12, **kwargs)
+    assert model.lookahead == lookahead
+    assert model.fit(X, y) is model
+    out = model.predict(X)
+    assert out.shape == (len(X) - 12 + 1 - lookahead, X.shape[1])
+    assert np.isfinite(out).all()
+    # training converged at least a little
+    losses = model.history_["loss"]
+    assert losses[-1] < losses[0]
+    assert np.isfinite(model.score(X, y))
+
+
+def test_transformer_pickle_roundtrip():
+    X, y = make_data(150)
+    model = TransformerAutoEncoder(
+        kind="transformer_model", lookback_window=8, **SMALL_TRANSFORMER
+    )
+    model.fit(X, y)
+    expected = model.predict(X)
+    restored = pickle.loads(pickle.dumps(model))
+    np.testing.assert_allclose(restored.predict(X), expected, rtol=1e-5)
+
+
+def test_serializer_roundtrip():
+    from gordo_tpu.serializer import from_definition, into_definition
+
+    definition = {
+        "gordo_tpu.models.TransformerAutoEncoder": {
+            "kind": "transformer_model",
+            "lookback_window": 8,
+            "d_model": 16,
+            "n_heads": 2,
+            "n_layers": 1,
+            "epochs": 1,
+        }
+    }
+    model = from_definition(definition)
+    assert isinstance(model, TransformerAutoEncoder)
+    assert model.lookback_window == 8
+    round_tripped = into_definition(model)
+    rebuilt = from_definition(round_tripped)
+    assert isinstance(rebuilt, TransformerAutoEncoder)
+    assert rebuilt.kwargs["d_model"] == 16
+
+
+def test_transformer_inside_anomaly_detector():
+    X, y = make_data(240)
+    detector = DiffBasedAnomalyDetector(
+        base_estimator=TransformerAutoEncoder(
+            kind="transformer_model", lookback_window=8, **SMALL_TRANSFORMER
+        ),
+        require_thresholds=False,
+    )
+    detector.fit(X, y)
+    import pandas as pd
+
+    index = pd.date_range("2020-01-01", periods=len(X), freq="10min", tz="UTC")
+    anomalies = detector.anomaly(
+        pd.DataFrame(X, index=index), pd.DataFrame(y, index=index)
+    )
+    assert "total-anomaly-scaled" in anomalies.columns.get_level_values(0)
+    assert np.isfinite(
+        anomalies["total-anomaly-scaled"].to_numpy(dtype=float)
+    ).all()
+
+
+def test_tcn_receptive_field_and_dilations():
+    assert default_dilations(4) == (1, 2, 4, 8)
+    # 2 convs per block: rf = 1 + 2*(k-1)*sum(d)
+    assert receptive_field(3, (1, 2, 4)) == 1 + 2 * 2 * 7
+
+
+def test_sinusoidal_positions_shape_and_range():
+    enc = sinusoidal_positions(10, 16)
+    assert enc.shape == (10, 16)
+    assert float(jnp.abs(enc).max()) <= 1.0
+    # rows are distinct (positions distinguishable)
+    assert not np.allclose(np.asarray(enc[0]), np.asarray(enc[1]))
+
+
+# -- flash attention kernel (interpret mode on CPU) -------------------------
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_dense(causal):
+    q, k, v = (
+        jnp.asarray(RNG.normal(size=(2, 37, 2, 16)), dtype=jnp.float32)
+        for _ in range(3)
+    )
+    out_flash = flash_attention(q, k, v, causal=causal)
+    out_dense = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(out_flash, out_dense, atol=2e-3)
+
+
+def test_flash_gradients_match_dense():
+    q, k, v = (
+        jnp.asarray(RNG.normal(size=(1, 24, 2, 8)), dtype=jnp.float32)
+        for _ in range(3)
+    )
+
+    def loss_flash(q_):
+        return jnp.sum(flash_attention(q_, k, v, causal=True) ** 2)
+
+    def loss_dense(q_):
+        return jnp.sum(dense_attention(q_, k, v, causal=True) ** 2)
+
+    np.testing.assert_allclose(
+        jax.grad(loss_flash)(q), jax.grad(loss_dense)(q), atol=2e-3
+    )
+
+
+def test_flash_attention_impl_in_estimator():
+    X, y = make_data(120)
+    model = TransformerAutoEncoder(
+        kind="transformer_model",
+        lookback_window=8,
+        attention_impl="flash",
+        **SMALL_TRANSFORMER,
+    )
+    model.fit(X, y)
+    out = model.predict(X)
+    assert np.isfinite(out).all()
+
+
+def test_unknown_attention_impl_raises():
+    with pytest.raises(ValueError, match="attention_impl"):
+        model = TransformerAutoEncoder(
+            kind="transformer_model", attention_impl="nope", **SMALL_TRANSFORMER
+        )
+        model.fit(*make_data(60))
