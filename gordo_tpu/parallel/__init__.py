@@ -13,11 +13,18 @@ problem, and one compiled program serves the whole bucket.
 - ``fleet``     — FleetTrainer: stacked/vmapped train + predict
 - ``bucketing`` — grouping Machines into shape-compatible buckets
 - ``distributed`` — multi-host initialization (jax.distributed)
+- ``sequence``  — ring / all-to-all sequence-context parallelism for long
+  windows (Transformer backend)
 """
 
 from .mesh import fleet_sharding, get_device_mesh, replicated_sharding
 from .fleet import FleetTrainer, StackedData
 from .bucketing import bucket_machines
+from .sequence import (
+    ring_attention,
+    sequence_sharded_attention,
+    ulysses_attention,
+)
 
 __all__ = [
     "get_device_mesh",
@@ -26,4 +33,7 @@ __all__ = [
     "FleetTrainer",
     "StackedData",
     "bucket_machines",
+    "ring_attention",
+    "ulysses_attention",
+    "sequence_sharded_attention",
 ]
