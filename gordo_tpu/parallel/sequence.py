@@ -128,8 +128,9 @@ def ulysses_attention(
     """
     All-to-all (DeepSpeed-Ulysses style) sequence parallelism: reshard
     (batch, seq/N, heads, d) -> (batch, seq, heads/N, d), run exact local
-    attention per head group, reshard back. ``attn_fn(q, k, v, causal)``
-    defaults to the dense XLA path (gordo_tpu.models.specs_seq).
+    attention per head group, reshard back.
+    ``attn_fn(q, k, v, causal=..., sm_scale=...)`` defaults to the dense
+    XLA path (gordo_tpu.models.specs_seq.dense_attention).
     """
     if attn_fn is None:
         from gordo_tpu.models.specs_seq import dense_attention
