@@ -30,10 +30,12 @@ import io
 import json
 import logging
 import os
+import threading
 import timeit
 import traceback
 import typing
 
+import numpy as np
 import pandas as pd
 from werkzeug.exceptions import HTTPException, NotFound
 from werkzeug.routing import Map, Rule
@@ -138,9 +140,19 @@ class GordoApp:
                     endpoint="anomaly_prediction",
                     methods=["POST"],
                 ),
+                # TPU-native extension (no reference equivalent): one POST
+                # scores many machines through stacked params + vmap
+                Rule(
+                    "/gordo/v0/<gordo_project>/prediction/fleet",
+                    endpoint="fleet_prediction",
+                    methods=["POST"],
+                ),
             ],
             strict_slashes=False,
         )
+        # (collection_dir, machine-name tuple) -> (FleetScorer, prefixes, fallback)
+        self._fleet_scorers: typing.Dict[tuple, tuple] = {}
+        self._fleet_scorers_lock = threading.Lock()
         self.prometheus_metrics = None
         if self.config.get("ENABLE_PROMETHEUS"):
             from gordo_tpu.server.prometheus.metrics import (
@@ -380,6 +392,95 @@ class GordoApp:
             )
         context = {
             "data": server_utils.dataframe_to_dict(data),
+            "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
+        }
+        return _json_response(context, 200)
+
+    def _get_fleet_scorer(self, ctx, names: typing.Tuple[str, ...]):
+        key = (ctx.collection_dir, names)
+        # the server runs threaded (run_simple(threaded=True)); serialize
+        # check/build/evict so concurrent first requests build one scorer
+        with self._fleet_scorers_lock:
+            if key not in self._fleet_scorers:
+                from gordo_tpu.server.fleet_serving import fleet_scorer_from_models
+
+                models = {name: self._get_model(ctx, name) for name in names}
+                if len(self._fleet_scorers) >= 16:  # bound param-stack memory
+                    self._fleet_scorers.pop(next(iter(self._fleet_scorers)))
+                self._fleet_scorers[key] = fleet_scorer_from_models(models)
+            return self._fleet_scorers[key]
+
+    def view_fleet_prediction(
+        self, ctx, request, gordo_project: str
+    ) -> Response:
+        """
+        Batched multi-machine scoring from TPU-resident stacked params
+        (SURVEY.md §2.10(c); no reference equivalent — the reference's unit
+        of serving is one model per POST, views/base.py:107-187).
+
+        Body: ``{"machines": {<name>: <X as dict-of-dicts or list-of-rows>}}``.
+        Returns the base-prediction frame per machine (model-input /
+        model-output), computed by one vmapped dispatch per architecture
+        group rather than one forward per machine.
+        """
+        body = request.get_json(silent=True) or {}
+        machines = body.get("machines")
+        if not isinstance(machines, dict) or not machines:
+            return _json_response(
+                {"error": "Body must contain a non-empty 'machines' mapping."}, 400
+            )
+
+        names = tuple(sorted(machines))
+        scorer, prefixes, fallback = self._get_fleet_scorer(ctx, names)
+
+        frames: typing.Dict[str, pd.DataFrame] = {}
+        inputs: typing.Dict[str, typing.Any] = {}
+        meta: typing.Dict[str, dict] = {}
+        for name in names:
+            metadata = self._get_metadata(ctx, name)
+            meta[name] = metadata
+            tags = [t.name for t in self._tags(metadata)]
+            raw = machines[name]
+            try:
+                if isinstance(raw, dict):
+                    X = server_utils.dataframe_from_dict(raw)
+                    X = server_utils.verify_dataframe(X, tags)
+                else:
+                    X = pd.DataFrame(np.asarray(raw, dtype="float64"), columns=tags)
+            except ValueError as err:
+                return _json_response(
+                    {"error": f"Bad input for machine {name!r}: {err}"}, 400
+                )
+            frames[name] = X
+            transformed = X.values
+            for step in prefixes.get(name, []):
+                transformed = step.transform(transformed)
+            inputs[name] = np.asarray(transformed, dtype="float32")
+
+        outputs: typing.Dict[str, np.ndarray] = {}
+        if scorer is not None:
+            batchable = {n: x for n, x in inputs.items() if n not in fallback}
+            try:
+                outputs.update(scorer.predict(batchable))
+            except ValueError as err:
+                return _json_response({"error": f"ValueError: {err}"}, 400)
+        for name, model in fallback.items():
+            outputs[name] = model_io.get_model_output(model=model, X=frames[name])
+
+        data = {}
+        for name in names:
+            tags = self._tags(meta[name])
+            target_tags = self._target_tags(meta[name]) or tags
+            frame = model_utils.make_base_dataframe(
+                tags=tags,
+                model_input=frames[name].values,
+                model_output=outputs[name],
+                target_tag_list=target_tags,
+                index=frames[name].index,
+            )
+            data[name] = server_utils.dataframe_to_dict(frame)
+        context = {
+            "data": data,
             "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
         }
         return _json_response(context, 200)

@@ -1,0 +1,164 @@
+"""
+Fleet serving: stacked-parameter batched scoring (SURVEY.md §2.10(c)).
+
+The reference serves one model per request (gordo/server/views/base.py) —
+each POST runs one Keras forward. Here, trained same-architecture
+estimators are re-stacked on a leading machine axis (the inverse of the
+fleet *training* stack, gordo_tpu/parallel/fleet.py) so one jitted,
+``vmap``-ed program scores a whole group of machines per dispatch: params
+stay TPU-resident between requests, the machine axis rides the MXU's batch
+dimension, and one compile serves every machine in the group.
+
+Host/device split: per-machine sklearn prefix transforms (scalers) stay on
+host — they're cheap and heterogeneous; the batched device program is the
+model forward, where the FLOPs are.
+"""
+
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gordo_tpu.models.core import BaseJaxEstimator
+from gordo_tpu.ops.windowing import num_windows, window_sample_indices
+
+logger = logging.getLogger(__name__)
+
+
+def _group_key(est: BaseJaxEstimator) -> Tuple:
+    """Machines whose estimators share this key can be stacked and vmapped."""
+    spec = est.spec_
+    return (
+        repr(spec.module),
+        spec.windowed,
+        spec.lookback_window if spec.windowed else 1,
+        est.lookahead if spec.windowed else 0,
+        est.n_features_,
+        est.n_features_out_,
+    )
+
+
+class FleetScorer:
+    """
+    Batched scorer over a set of *trained* estimators.
+
+    Estimators are grouped by architecture (module structure + window
+    geometry + feature widths); each group's param pytrees are stacked on a
+    leading machine axis and applied via one jitted ``vmap`` program.
+    """
+
+    def __init__(self, estimators: Dict[str, BaseJaxEstimator]):
+        for name, est in estimators.items():
+            if not hasattr(est, "params_"):
+                raise ValueError(f"Estimator for {name!r} is not fitted")
+        self._groups: List[dict] = []
+        by_key: Dict[Tuple, List[str]] = {}
+        for name, est in estimators.items():
+            by_key.setdefault(_group_key(est), []).append(name)
+        for key, names in by_key.items():
+            group_ests = [estimators[n] for n in names]
+            stacked = jax.tree_util.tree_map(
+                lambda *leaves: jnp.stack(leaves), *[e.params_ for e in group_ests]
+            )
+            spec = group_ests[0].spec_
+            apply_fn = jax.jit(
+                jax.vmap(lambda p, x, module=spec.module: module.apply(p, x)[0])
+            )
+            self._groups.append(
+                {
+                    "names": names,
+                    "params": stacked,
+                    "apply": apply_fn,
+                    "windowed": spec.windowed,
+                    "lookback": spec.lookback_window if spec.windowed else 1,
+                    "lookahead": group_ests[0].lookahead if spec.windowed else 0,
+                    "n_features_out": group_ests[0].n_features_out_,
+                }
+            )
+
+    @property
+    def names(self) -> List[str]:
+        return [n for g in self._groups for n in g["names"]]
+
+    @property
+    def n_groups(self) -> int:
+        return len(self._groups)
+
+    def predict(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """
+        Model outputs for each named machine. ``inputs[name]`` is the
+        machine's (already host-transformed) model input, shape
+        (n_rows, n_features); rows may differ per machine — shorter
+        machines are zero-padded to the group's max and sliced back.
+        """
+        missing = set(inputs) - set(self.names)
+        if missing:
+            raise KeyError(f"No stacked params for machines: {sorted(missing)}")
+        out: Dict[str, np.ndarray] = {}
+        for group in self._groups:
+            names = [n for n in group["names"] if n in inputs]
+            if not names:
+                continue
+            out.update(self._predict_group(group, {n: inputs[n] for n in names}))
+        return out
+
+    def _predict_group(
+        self, group: dict, inputs: Dict[str, np.ndarray]
+    ) -> Dict[str, np.ndarray]:
+        names = list(inputs)
+        lb, la = group["lookback"], group["lookahead"]
+        if group["windowed"]:
+            prepared = {}
+            for name, X in inputs.items():
+                X = np.asarray(X, dtype=np.float32)
+                idx = window_sample_indices(len(X), lb, la)
+                prepared[name] = X[idx]  # (windows, lb, f)
+        else:
+            prepared = {
+                name: np.asarray(X, dtype=np.float32) for name, X in inputs.items()
+            }
+
+        n_rows = {name: len(x) for name, x in prepared.items()}
+        max_rows = max(n_rows.values())
+        batch = np.stack(
+            [
+                np.pad(x, [(0, max_rows - len(x))] + [(0, 0)] * (x.ndim - 1))
+                for x in prepared.values()
+            ]
+        )
+
+        # gather only for true subsets — the common full-group case reuses
+        # the resident stack without copying any param leaves
+        if names == group["names"]:
+            params = group["params"]
+        else:
+            sel = np.asarray([group["names"].index(n) for n in names], dtype=np.int32)
+            params = jax.tree_util.tree_map(lambda leaf: leaf[sel], group["params"])
+        outputs = np.asarray(group["apply"](params, jnp.asarray(batch)))
+        return {name: outputs[i, : n_rows[name]] for i, name in enumerate(names)}
+
+
+def fleet_scorer_from_models(models: Dict[str, Any]) -> Tuple[
+    Optional[FleetScorer], Dict[str, List], Dict[str, Any]
+]:
+    """
+    Build a FleetScorer from full (possibly wrapped) models as the server
+    loads them: returns (scorer, host prefix-transformers per machine,
+    non-batchable models that must fall back to per-model predict).
+    """
+    from gordo_tpu.builder.fleet_build import _find_jax_estimator, _prefix_transformers
+
+    estimators: Dict[str, BaseJaxEstimator] = {}
+    prefixes: Dict[str, List] = {}
+    fallback: Dict[str, Any] = {}
+    for name, model in models.items():
+        est = _find_jax_estimator(model)
+        if est is None or not hasattr(est, "params_"):
+            fallback[name] = model
+        else:
+            estimators[name] = est
+            prefixes[name] = _prefix_transformers(model)
+    scorer = FleetScorer(estimators) if estimators else None
+    return scorer, prefixes, fallback

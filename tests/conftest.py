@@ -113,3 +113,19 @@ def gordo_ml_server_client(model_collection_env):
 
     server_utils.clear_caches()
     return Client(build_app())
+
+
+N_SAMPLES = 10
+
+
+@pytest.fixture
+def sensor_frame():
+    """A small indexed frame shaped like the trained machines' inputs."""
+    import numpy as np
+    import pandas as pd
+
+    rng = np.random.default_rng(1)
+    index = pd.date_range("2019-01-01", periods=N_SAMPLES, freq="10min", tz="UTC")
+    return pd.DataFrame(
+        rng.random((N_SAMPLES, len(SENSORS))), columns=SENSORS, index=index
+    )

@@ -1,0 +1,202 @@
+"""
+Fleet serving tests (SURVEY.md §2.10(c)): stacked-param batched scoring
+must agree exactly with per-machine predicts, at the FleetScorer level and
+through the server's /prediction/fleet endpoint.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gordo_tpu.models import AutoEncoder, LSTMAutoEncoder
+from gordo_tpu.server.fleet_serving import FleetScorer, fleet_scorer_from_models
+
+RNG = np.random.default_rng(11)
+
+
+def _train(cls, n=80, f=4, **kwargs):
+    X = RNG.random((n, f)).astype("float32")
+    model = cls(**kwargs)
+    model.fit(X, X.copy())
+    return model
+
+
+def test_scorer_matches_per_model_predict():
+    models = {
+        f"m{i}": _train(
+            AutoEncoder, kind="feedforward_hourglass", epochs=1, seed=i
+        )
+        for i in range(3)
+    }
+    scorer = FleetScorer(models)
+    assert scorer.n_groups == 1  # same architecture -> one stacked group
+    X = {name: RNG.random((30, 4)).astype("float32") for name in models}
+    batched = scorer.predict(X)
+    for name, model in models.items():
+        np.testing.assert_allclose(
+            batched[name], model.predict(X[name]), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_scorer_windowed_and_ragged_lengths():
+    models = {
+        f"w{i}": _train(
+            LSTMAutoEncoder,
+            kind="lstm_hourglass",
+            lookback_window=6,
+            epochs=1,
+            seed=i,
+        )
+        for i in range(2)
+    }
+    scorer = FleetScorer(models)
+    # ragged: different row counts get padded to the group max and sliced
+    X = {
+        "w0": RNG.random((40, 4)).astype("float32"),
+        "w1": RNG.random((25, 4)).astype("float32"),
+    }
+    batched = scorer.predict(X)
+    for name, model in models.items():
+        assert batched[name].shape == (len(X[name]) - 6 + 1, 4)
+        np.testing.assert_allclose(
+            batched[name], model.predict(X[name]), rtol=1e-5, atol=1e-6
+        )
+
+
+def test_scorer_mixed_architectures_form_groups():
+    models = {
+        "dense": _train(AutoEncoder, kind="feedforward_hourglass", epochs=1),
+        "lstm": _train(
+            LSTMAutoEncoder, kind="lstm_hourglass", lookback_window=4, epochs=1
+        ),
+    }
+    scorer = FleetScorer(models)
+    assert scorer.n_groups == 2
+    X = {name: RNG.random((30, 4)).astype("float32") for name in models}
+    out = scorer.predict(X)
+    assert set(out) == {"dense", "lstm"}
+
+
+def test_scorer_unknown_machine_raises():
+    scorer = FleetScorer(
+        {"a": _train(AutoEncoder, kind="feedforward_hourglass", epochs=1)}
+    )
+    with pytest.raises(KeyError, match="nope"):
+        scorer.predict({"nope": np.zeros((5, 4), dtype="float32")})
+
+
+def test_scorer_unfitted_raises():
+    with pytest.raises(ValueError, match="not fitted"):
+        FleetScorer({"a": AutoEncoder(kind="feedforward_hourglass")})
+
+
+def test_fleet_scorer_from_wrapped_models():
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import MinMaxScaler
+
+    X = RNG.random((60, 4)).astype("float32")
+    pipe = Pipeline(
+        [
+            ("scale", MinMaxScaler()),
+            ("model", AutoEncoder(kind="feedforward_hourglass", epochs=1)),
+        ]
+    )
+    pipe.fit(X, X.copy())
+    scorer, prefixes, fallback = fleet_scorer_from_models({"p": pipe})
+    assert scorer is not None and not fallback
+    assert len(prefixes["p"]) == 1  # the scaler stays on host
+    transformed = prefixes["p"][0].transform(X)
+    np.testing.assert_allclose(
+        scorer.predict({"p": transformed.astype("float32")})["p"],
+        pipe.predict(X),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
+# -- endpoint, against the session's real trained artifacts -----------------
+def test_fleet_prediction_endpoint(gordo_ml_server_client, sensor_frame):
+    from tests.conftest import GORDO_BASE_TARGETS, GORDO_PROJECT, GORDO_SINGLE_TARGET
+
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    X = dataframe_to_dict(sensor_frame)
+    resp = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/prediction/fleet",
+        json={
+            "machines": {
+                GORDO_SINGLE_TARGET: X,
+                GORDO_BASE_TARGETS[0]: X,
+            }
+        },
+    )
+    assert resp.status_code == 200, resp.get_data()
+    payload = json.loads(resp.get_data())
+    assert set(payload["data"]) == {GORDO_SINGLE_TARGET, GORDO_BASE_TARGETS[0]}
+    # batched output equals the single-machine endpoint's output
+    single = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/{GORDO_SINGLE_TARGET}/prediction",
+        json={"X": X},
+    )
+    assert single.status_code == 200
+    single_out = json.loads(single.get_data())["data"]["model-output"]
+    fleet_out = payload["data"][GORDO_SINGLE_TARGET]["model-output"]
+    for col, series in single_out.items():
+        for ts, value in series.items():
+            assert abs(fleet_out[col][ts] - value) < 1e-4
+
+
+def test_fleet_prediction_reorders_labeled_columns(
+    gordo_ml_server_client, sensor_frame
+):
+    """Labeled input columns in a different order must be realigned."""
+    from tests.conftest import GORDO_PROJECT, GORDO_SINGLE_TARGET
+
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    shuffled = sensor_frame[list(sensor_frame.columns[::-1])]
+    url = f"/gordo/v0/{GORDO_PROJECT}/prediction/fleet"
+    resp_shuffled = gordo_ml_server_client.post(
+        url, json={"machines": {GORDO_SINGLE_TARGET: dataframe_to_dict(shuffled)}}
+    )
+    resp_ordered = gordo_ml_server_client.post(
+        url, json={"machines": {GORDO_SINGLE_TARGET: dataframe_to_dict(sensor_frame)}}
+    )
+    assert resp_shuffled.status_code == resp_ordered.status_code == 200
+    out_shuffled = json.loads(resp_shuffled.get_data())["data"][GORDO_SINGLE_TARGET]
+    out_ordered = json.loads(resp_ordered.get_data())["data"][GORDO_SINGLE_TARGET]
+    for col, series in out_ordered["model-output"].items():
+        for ts, value in series.items():
+            assert abs(out_shuffled["model-output"][col][ts] - value) < 1e-6
+
+
+def test_fleet_prediction_bad_width_is_400(gordo_ml_server_client):
+    from tests.conftest import GORDO_PROJECT, GORDO_SINGLE_TARGET
+
+    resp = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/prediction/fleet",
+        json={"machines": {GORDO_SINGLE_TARGET: [[1.0, 2.0]]}},
+    )
+    assert resp.status_code == 400
+
+
+def test_fleet_prediction_endpoint_empty_body(gordo_ml_server_client):
+    from tests.conftest import GORDO_PROJECT
+
+    resp = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/prediction/fleet", json={}
+    )
+    assert resp.status_code == 400
+
+
+def test_fleet_prediction_unknown_machine_404(gordo_ml_server_client, sensor_frame):
+    from tests.conftest import GORDO_PROJECT
+
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    resp = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/prediction/fleet",
+        json={"machines": {"no-such-machine": dataframe_to_dict(sensor_frame)}},
+    )
+    assert resp.status_code == 404

@@ -16,23 +16,16 @@ from tests.conftest import (
     GORDO_PROJECT,
     GORDO_REVISION,
     GORDO_SINGLE_TARGET,
+    N_SAMPLES,
     SENSORS,
 )
-
-N_SAMPLES = 10
 
 
 def _url(*parts):
     return "/gordo/v0/" + "/".join(parts)
 
 
-@pytest.fixture
-def sensor_frame():
-    rng = np.random.default_rng(1)
-    index = pd.date_range("2019-01-01", periods=N_SAMPLES, freq="10min", tz="UTC")
-    return pd.DataFrame(
-        rng.random((N_SAMPLES, len(SENSORS))), columns=SENSORS, index=index
-    )
+# sensor_frame fixture lives in conftest (shared with test_fleet_serving)
 
 
 def test_healthcheck(gordo_ml_server_client):
