@@ -1,0 +1,98 @@
+"""
+Fleet-training throughput harness: models-trained/hour through the
+stacked-vmap FleetModelBuilder vs the sequential per-machine ModelBuilder
+loop — the BASELINE.json north-star axis ("1000-Machine batch build
+vmap'd over v5e-16"), runnable at any size.
+
+Prints one JSON object with both rates and the speedup.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    # the TPU plugin pins jax_platforms via sitecustomize; honor the env var
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+CONFIG_TPL = """
+  - name: fleet-m{i}
+    dataset:
+      type: RandomDataset
+      tags: [tag-0, tag-1, tag-2, tag-3]
+      target_tag_list: [tag-0, tag-1, tag-2, tag-3]
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-03T00:00:00+00:00'
+      asset: gra
+    model:
+      gordo_tpu.models.anomaly.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.AutoEncoder:
+            kind: feedforward_hourglass
+            epochs: {epochs}
+"""
+
+
+def make_machines(n: int, epochs: int):
+    import yaml
+
+    from gordo_tpu.workflow.config_elements.normalized_config import NormalizedConfig
+
+    config = yaml.safe_load(
+        "machines:" + "".join(CONFIG_TPL.format(i=i, epochs=epochs) for i in range(n))
+    )
+    return NormalizedConfig(config, project_name="bench").machines
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--machines", type=int, default=16)
+    parser.add_argument("--epochs", type=int, default=5)
+    parser.add_argument(
+        "--sequential-sample",
+        type=int,
+        default=4,
+        help="How many machines to time with the sequential builder "
+        "(extrapolated; building all sequentially is the slow case)",
+    )
+    args = parser.parse_args()
+
+    from gordo_tpu.builder.build_model import ModelBuilder
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder
+
+    machines = make_machines(args.machines, args.epochs)
+
+    start = time.perf_counter()
+    FleetModelBuilder(machines).build()
+    fleet_s = time.perf_counter() - start
+
+    seq_machines = make_machines(args.sequential_sample, args.epochs)
+    start = time.perf_counter()
+    for machine in seq_machines:
+        ModelBuilder(machine).build()
+    seq_s_per_machine = (time.perf_counter() - start) / len(seq_machines)
+
+    fleet_rate = args.machines / fleet_s * 3600
+    seq_rate = 3600 / seq_s_per_machine
+    print(
+        json.dumps(
+            {
+                "machines": args.machines,
+                "epochs": args.epochs,
+                "fleet_build_s": round(fleet_s, 2),
+                "fleet_models_per_hour": round(fleet_rate, 1),
+                "sequential_models_per_hour": round(seq_rate, 1),
+                "speedup": round(fleet_rate / seq_rate, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

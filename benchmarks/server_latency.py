@@ -1,0 +1,136 @@
+"""
+Server endpoint latency harness (reference shape:
+benchmarks/test_ml_server.py:21-41 — 100 samples x 4 tags, repeated
+rounds against prediction and anomaly endpoints), extended with the fleet
+endpoint.
+
+Prints one JSON object: per-endpoint mean/p50/p95 milliseconds.
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    # the TPU plugin pins jax_platforms via sitecustomize; honor the env var
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def build_collection(n_machines: int, tmp: str) -> str:
+    from gordo_tpu import serializer
+    from gordo_tpu.builder import local_build
+
+    machine_tpl = """
+  - name: bench-m{i}
+    dataset:
+      type: RandomDataset
+      tags: [tag-0, tag-1, tag-2, tag-3]
+      target_tag_list: [tag-0, tag-1, tag-2, tag-3]
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-02T00:00:00+00:00'
+      asset: gra
+    model:
+      gordo_tpu.models.anomaly.DiffBasedAnomalyDetector:
+        base_estimator:
+          gordo_tpu.models.AutoEncoder:
+            kind: feedforward_hourglass
+            epochs: 1
+"""
+    config = "machines:" + "".join(
+        machine_tpl.format(i=i) for i in range(n_machines)
+    )
+    collection = os.path.join(tmp, "proj", "models", "rev1")
+    for model, machine in local_build(config):
+        serializer.dump(
+            model, os.path.join(collection, machine.name), metadata=machine.to_dict()
+        )
+    return collection
+
+
+def timed_posts(client, url, body, rounds):
+    times = []
+    for _ in range(rounds):
+        start = time.perf_counter()
+        resp = client.post(url, json=body)
+        times.append((time.perf_counter() - start) * 1000)
+        assert resp.status_code == 200, resp.get_data()
+    return {
+        "mean_ms": round(statistics.mean(times), 3),
+        "p50_ms": round(statistics.median(times), 3),
+        "p95_ms": round(sorted(times)[int(0.95 * len(times)) - 1], 3),
+        "rounds": rounds,
+    }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rounds", type=int, default=100)
+    parser.add_argument("--samples", type=int, default=100)
+    parser.add_argument("--fleet-machines", type=int, default=8)
+    args = parser.parse_args()
+
+    import numpy as np
+    import pandas as pd
+    from werkzeug.test import Client
+
+    with tempfile.TemporaryDirectory() as tmp:
+        collection = build_collection(args.fleet_machines, tmp)
+        os.environ["MODEL_COLLECTION_DIR"] = collection
+
+        from gordo_tpu.server import build_app
+        from gordo_tpu.server.utils import dataframe_to_dict
+
+        client = Client(build_app())
+        rng = np.random.default_rng(0)
+        index = pd.date_range(
+            "2019-01-01", periods=args.samples, freq="10min", tz="UTC"
+        )
+        frame = pd.DataFrame(
+            rng.random((args.samples, 4)),
+            columns=[f"tag-{i}" for i in range(4)],
+            index=index,
+        )
+        X = dataframe_to_dict(frame)
+
+        results = {}
+        base_url = "/gordo/v0/proj"
+        # warmup (first request pays model load + jit compile)
+        client.post(f"{base_url}/bench-m0/prediction", json={"X": X})
+        results["prediction"] = timed_posts(
+            client, f"{base_url}/bench-m0/prediction", {"X": X}, args.rounds
+        )
+        client.post(
+            f"{base_url}/bench-m0/anomaly/prediction", json={"X": X, "y": X}
+        )
+        results["anomaly_prediction"] = timed_posts(
+            client,
+            f"{base_url}/bench-m0/anomaly/prediction",
+            {"X": X, "y": X},
+            args.rounds,
+        )
+        fleet_body = {
+            "machines": {f"bench-m{i}": X for i in range(args.fleet_machines)}
+        }
+        client.post(f"{base_url}/prediction/fleet", json=fleet_body)
+        fleet = timed_posts(
+            client, f"{base_url}/prediction/fleet", fleet_body, args.rounds
+        )
+        fleet["machines_per_request"] = args.fleet_machines
+        fleet["ms_per_machine"] = round(
+            fleet["mean_ms"] / args.fleet_machines, 3
+        )
+        results["fleet_prediction"] = fleet
+
+        print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -70,11 +70,16 @@ def _find_jax_estimator(model) -> Optional[BaseJaxEstimator]:
 
 
 def _prefix_transformers(model) -> List[TransformerMixin]:
-    """Host-side transformer steps applied before the JAX estimator."""
+    """
+    Host-side transformer steps applied before the JAX estimator, in
+    application order — recursing the same wrappers _find_jax_estimator
+    does, so nested pipelines surface their inner scalers too.
+    """
     if isinstance(model, DiffBasedAnomalyDetector):
         return _prefix_transformers(model.base_estimator)
     if isinstance(model, Pipeline):
-        return [step for _, step in model.steps[:-1]]
+        outer = [step for _, step in model.steps[:-1]]
+        return outer + _prefix_transformers(model.steps[-1][1])
     return []
 
 

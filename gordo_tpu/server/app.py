@@ -397,18 +397,24 @@ class GordoApp:
         return _json_response(context, 200)
 
     def _get_fleet_scorer(self, ctx, names: typing.Tuple[str, ...]):
-        key = (ctx.collection_dir, names)
-        # the server runs threaded (run_simple(threaded=True)); serialize
-        # check/build/evict so concurrent first requests build one scorer
+        key = (os.path.realpath(ctx.collection_dir), names)
+        # the server runs threaded (run_simple(threaded=True)): hold the
+        # lock only for dict reads/writes so warm lookups never stall
+        # behind another key's build; two concurrent first requests for the
+        # same key may both build (harmless — last insert wins)
         with self._fleet_scorers_lock:
-            if key not in self._fleet_scorers:
-                from gordo_tpu.server.fleet_serving import fleet_scorer_from_models
+            cached = self._fleet_scorers.get(key)
+        if cached is not None:
+            return cached
+        from gordo_tpu.server.fleet_serving import fleet_scorer_from_models
 
-                models = {name: self._get_model(ctx, name) for name in names}
-                if len(self._fleet_scorers) >= 16:  # bound param-stack memory
-                    self._fleet_scorers.pop(next(iter(self._fleet_scorers)))
-                self._fleet_scorers[key] = fleet_scorer_from_models(models)
-            return self._fleet_scorers[key]
+        models = {name: self._get_model(ctx, name) for name in names}
+        built = fleet_scorer_from_models(models)
+        with self._fleet_scorers_lock:
+            if len(self._fleet_scorers) >= 16:  # bound param-stack memory
+                self._fleet_scorers.pop(next(iter(self._fleet_scorers)))
+            self._fleet_scorers[key] = built
+        return built
 
     def view_fleet_prediction(
         self, ctx, request, gordo_project: str
@@ -444,28 +450,39 @@ class GordoApp:
             try:
                 if isinstance(raw, dict):
                     X = server_utils.dataframe_from_dict(raw)
-                    X = server_utils.verify_dataframe(X, tags)
                 else:
-                    X = pd.DataFrame(np.asarray(raw, dtype="float64"), columns=tags)
+                    X = pd.DataFrame(np.asarray(raw, dtype="float64"))
+                X = server_utils.verify_dataframe(X, tags)
             except ValueError as err:
                 return _json_response(
                     {"error": f"Bad input for machine {name!r}: {err}"}, 400
                 )
             frames[name] = X
+            if name in fallback:
+                continue  # scored from the frame via its own predict below
             transformed = X.values
             for step in prefixes.get(name, []):
                 transformed = step.transform(transformed)
             inputs[name] = np.asarray(transformed, dtype="float32")
 
         outputs: typing.Dict[str, np.ndarray] = {}
-        if scorer is not None:
-            batchable = {n: x for n, x in inputs.items() if n not in fallback}
-            try:
-                outputs.update(scorer.predict(batchable))
-            except ValueError as err:
-                return _json_response({"error": f"ValueError: {err}"}, 400)
-        for name, model in fallback.items():
-            outputs[name] = model_io.get_model_output(model=model, X=frames[name])
+        try:
+            if scorer is not None and inputs:
+                outputs.update(scorer.predict(inputs))
+            for name, model in fallback.items():
+                outputs[name] = model_io.get_model_output(
+                    model=model, X=frames[name]
+                )
+        except ValueError as err:
+            return _json_response({"error": f"ValueError: {err}"}, 400)
+        except Exception:
+            logger.error(
+                "Fleet prediction failed:\n%s", traceback.format_exc()
+            )
+            return _json_response(
+                {"error": "Something unexpected happened; check your input data"},
+                400,
+            )
 
         data = {}
         for name in names:

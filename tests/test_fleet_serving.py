@@ -115,6 +115,37 @@ def test_fleet_scorer_from_wrapped_models():
     )
 
 
+def test_fleet_scorer_nested_pipeline_prefixes():
+    """Inner scalers of nested pipelines must reach the host prefix list."""
+    from sklearn.pipeline import Pipeline
+    from sklearn.preprocessing import MinMaxScaler, RobustScaler
+
+    X = RNG.random((60, 4)).astype("float32")
+    inner = Pipeline(
+        [
+            ("scale", MinMaxScaler()),
+            ("model", AutoEncoder(kind="feedforward_hourglass", epochs=1)),
+        ]
+    )
+    outer = Pipeline([("robust", RobustScaler()), ("inner", inner)])
+    outer.fit(X, X.copy())
+    scorer, prefixes, fallback = fleet_scorer_from_models({"n": outer})
+    assert scorer is not None and not fallback
+    assert [type(t).__name__ for t in prefixes["n"]] == [
+        "RobustScaler",
+        "MinMaxScaler",
+    ]
+    transformed = X
+    for step in prefixes["n"]:
+        transformed = step.transform(transformed)
+    np.testing.assert_allclose(
+        scorer.predict({"n": np.asarray(transformed, dtype="float32")})["n"],
+        outer.predict(X),
+        rtol=1e-5,
+        atol=1e-6,
+    )
+
+
 # -- endpoint, against the session's real trained artifacts -----------------
 def test_fleet_prediction_endpoint(gordo_ml_server_client, sensor_frame):
     from tests.conftest import GORDO_BASE_TARGETS, GORDO_PROJECT, GORDO_SINGLE_TARGET
