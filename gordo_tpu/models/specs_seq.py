@@ -32,9 +32,13 @@ from gordo_tpu.ops.activations import resolve_activation
 ATTENTION_IMPLS = ("dense", "flash")
 
 
-def sinusoidal_positions(seq_len: int, d_model: int) -> jnp.ndarray:
-    """Standard fixed sinusoidal positional encoding, (seq_len, d_model)."""
-    pos = jnp.arange(seq_len, dtype=jnp.float32)[:, None]
+def sinusoidal_positions(seq_len: int, d_model: int, offset=0) -> jnp.ndarray:
+    """
+    Standard fixed sinusoidal positional encoding, (seq_len, d_model).
+    ``offset`` shifts the positions — under sequence sharding each device
+    passes ``axis_index * local_len`` so shards see global positions.
+    """
+    pos = (jnp.arange(seq_len, dtype=jnp.float32) + offset)[:, None]
     dim = jnp.arange(0, d_model, 2, dtype=jnp.float32)[None, :]
     angle = pos / jnp.power(10000.0, dim / d_model)
     enc = jnp.zeros((seq_len, d_model), dtype=jnp.float32)
@@ -69,12 +73,20 @@ def dense_attention(
 
 
 class MultiHeadSelfAttention(nn.Module):
-    """QKV projection + pluggable attention core + output projection."""
+    """
+    QKV projection + pluggable attention core + output projection.
+
+    With ``seq_axis`` set the module must run inside ``shard_map`` with the
+    sequence axis sharded over that mesh axis; the attention core is then
+    ring or Ulysses all-to-all attention (gordo_tpu.parallel.sequence), and
+    ``attention_impl`` selects between them ("ring" | "ulysses").
+    """
 
     d_model: int
     n_heads: int
     causal: bool = False
     attention_impl: str = "dense"
+    seq_axis: Optional[str] = None
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -92,7 +104,18 @@ class MultiHeadSelfAttention(nn.Module):
             )
 
         q, k, v = proj("query"), proj("key"), proj("value")
-        if self.attention_impl == "flash":
+        if self.seq_axis is not None:
+            from gordo_tpu.parallel.sequence import SEQUENCE_IMPLS
+
+            if self.attention_impl not in SEQUENCE_IMPLS:
+                raise ValueError(
+                    f"attention_impl {self.attention_impl!r} invalid with "
+                    f"seq_axis; available: {sorted(SEQUENCE_IMPLS)}"
+                )
+            out = SEQUENCE_IMPLS[self.attention_impl](
+                q, k, v, axis_name=self.seq_axis, causal=self.causal
+            )
+        elif self.attention_impl == "flash":
             from gordo_tpu.ops.flash_attention import flash_attention
 
             out = flash_attention(q, k, v, causal=self.causal)
@@ -116,6 +139,7 @@ class TransformerBlock(nn.Module):
     dropout: float = 0.0
     causal: bool = False
     attention_impl: str = "dense"
+    seq_axis: Optional[str] = None
     ff_func: str = "gelu"
     dtype: Any = jnp.float32
 
@@ -127,6 +151,7 @@ class TransformerBlock(nn.Module):
             n_heads=self.n_heads,
             causal=self.causal,
             attention_impl=self.attention_impl,
+            seq_axis=self.seq_axis,
             dtype=self.dtype,
         )(h)
         h = nn.Dropout(rate=self.dropout)(h, deterministic=deterministic)
@@ -155,14 +180,20 @@ class TransformerNet(nn.Module):
     dropout: float = 0.0
     causal: bool = True
     attention_impl: str = "dense"
+    seq_axis: Optional[str] = None
     out_func: str = "linear"
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):  # x: (batch, time, features)
         seq = x.shape[1]
+        # under sequence sharding x is the local shard; offset recovers the
+        # shard's global token positions
+        offset = 0
+        if self.seq_axis is not None:
+            offset = jax.lax.axis_index(self.seq_axis) * seq
         h = nn.Dense(self.d_model, dtype=self.dtype, name="embed")(x)
-        h = h + sinusoidal_positions(seq, self.d_model).astype(h.dtype)
+        h = h + sinusoidal_positions(seq, self.d_model, offset).astype(h.dtype)
         h = nn.Dropout(rate=self.dropout)(h, deterministic=deterministic)
         for _ in range(self.n_layers):
             h = TransformerBlock(
@@ -172,10 +203,18 @@ class TransformerNet(nn.Module):
                 dropout=self.dropout,
                 causal=self.causal,
                 attention_impl=self.attention_impl,
+                seq_axis=self.seq_axis,
                 dtype=self.dtype,
             )(h, deterministic=deterministic)
         h = nn.LayerNorm(dtype=jnp.float32)(h)
         h = h[:, -1, :]
+        if self.seq_axis is not None:
+            # the true final timestep lives on the last shard; mask + psum
+            # replicates it so the head (and output) agree on every device
+            idx = jax.lax.axis_index(self.seq_axis)
+            n_shards = jax.lax.psum(1, self.seq_axis)
+            is_last = (idx == n_shards - 1).astype(h.dtype)
+            h = jax.lax.psum(h * is_last, self.seq_axis)
         h = nn.Dense(self.out_dim, dtype=self.dtype, name="head")(h)
         out = resolve_activation(self.out_func)(h).astype(jnp.float32)
         return out, jnp.asarray(0.0, dtype=jnp.float32)

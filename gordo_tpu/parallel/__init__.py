@@ -25,6 +25,7 @@ from .sequence import (
     sequence_sharded_attention,
     ulysses_attention,
 )
+from .long_context import LongContextTrainer
 
 __all__ = [
     "get_device_mesh",
@@ -36,4 +37,5 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "sequence_sharded_attention",
+    "LongContextTrainer",
 ]

@@ -1,0 +1,159 @@
+"""
+Long-context training: a Transformer whose *activations* are sequence-
+sharded across the mesh, for windows too long for one chip's HBM.
+
+This composes the pieces below into one training program:
+
+- TransformerNet with ``seq_axis`` set (gordo_tpu/models/specs_seq.py):
+  global positional offsets from ``axis_index``, ring / Ulysses attention
+  as the core, and a psum-select so the final-timestep head is replicated;
+- ``shard_map`` over the mesh's ``seq`` axis: params replicated, the
+  (batch, seq, features) window sharded on its sequence axis — each device
+  holds seq/N timesteps of activations through every layer;
+- one ``jax.jit``-ed ``value_and_grad`` over the shard_mapped loss: the
+  replicated-out loss transposes to a gradient psum, so the optimizer step
+  is a plain replicated optax update.
+
+The reference has no analogue — its long-sequence story is resampling and
+windowing (SURVEY.md §5 "Long-context"); this is the capability that
+removes the single-chip window ceiling.
+"""
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from gordo_tpu.models.specs import make_optimizer, per_sample_loss
+from gordo_tpu.models.specs_seq import TransformerNet
+from gordo_tpu.parallel.sequence import SEQ_AXIS, shard_map
+
+
+def build_long_context_transformer(
+    n_features: int,
+    n_features_out: Optional[int] = None,
+    d_model: int = 64,
+    n_heads: int = 4,
+    n_layers: int = 2,
+    ff_dim: Optional[int] = None,
+    causal: bool = True,
+    attention_impl: str = "ring",
+    axis_name: str = SEQ_AXIS,
+    dtype: Any = jnp.float32,
+) -> Tuple[TransformerNet, TransformerNet]:
+    """
+    (sharded, local) twin modules with identical parameter trees: the
+    ``local`` twin initializes params and serves single-device inference;
+    the ``sharded`` twin runs inside shard_map for training.
+    """
+    common = dict(
+        d_model=d_model,
+        n_heads=n_heads,
+        n_layers=n_layers,
+        ff_dim=ff_dim or 4 * d_model,
+        out_dim=n_features_out or n_features,
+        dropout=0.0,  # long-context training path runs deterministic
+        causal=causal,
+        dtype=dtype,
+    )
+    sharded = TransformerNet(
+        attention_impl=attention_impl, seq_axis=axis_name, **common
+    )
+    local = TransformerNet(attention_impl="dense", seq_axis=None, **common)
+    return sharded, local
+
+
+class LongContextTrainer:
+    """
+    Train a many-to-one Transformer on sequence-sharded windows.
+
+    ``fit``-style usage::
+
+        trainer = LongContextTrainer(n_features=8, mesh=mesh)
+        params, opt_state = trainer.init(jax.random.PRNGKey(0))
+        for step in range(n_steps):
+            params, opt_state, loss = trainer.train_step(
+                params, opt_state, windows, targets
+            )
+
+    ``windows`` is (batch, seq, features) with seq divisible by the mesh's
+    sequence axis; ``targets`` is (batch, n_features_out).
+    """
+
+    def __init__(
+        self,
+        n_features: int,
+        mesh: Mesh,
+        n_features_out: Optional[int] = None,
+        axis_name: str = SEQ_AXIS,
+        optimizer: str = "Adam",
+        optimizer_kwargs: Optional[dict] = None,
+        loss: str = "mse",
+        **transformer_kwargs,
+    ):
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.n_features = n_features
+        self.loss = loss
+        self.module, self.local_module = build_long_context_transformer(
+            n_features,
+            n_features_out=n_features_out,
+            axis_name=axis_name,
+            **transformer_kwargs,
+        )
+        self._optimizer = make_optimizer(optimizer, optimizer_kwargs or {})
+        self._step_fn = None
+        self._forward_fn = None
+
+    def init(self, key, example_seq_len: int = 8):
+        """Params + opt state; shapes are independent of sequence length."""
+        example = jnp.zeros((1, example_seq_len, self.n_features))
+        params = self.local_module.init(key, example)
+        return params, self._optimizer.init(params)
+
+    def _build_step(self):
+        module = self.module
+        axis = self.axis_name
+        loss_name = self.loss
+        optimizer = self._optimizer
+
+        def sharded_loss(params, xb, yb):
+            out, penalty = module.apply(params, xb)
+            return jnp.mean(per_sample_loss(loss_name, out, yb)) + penalty
+
+        mapped = shard_map(
+            sharded_loss,
+            mesh=self.mesh,
+            in_specs=(P(), P(None, axis, None), P()),
+            out_specs=P(),
+        )
+
+        def step(params, opt_state, xb, yb):
+            loss_val, grads = jax.value_and_grad(mapped)(params, xb, yb)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss_val
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def train_step(self, params, opt_state, windows, targets):
+        axis_size = self.mesh.shape[self.axis_name]
+        if windows.shape[1] % axis_size:
+            raise ValueError(
+                f"Sequence length {windows.shape[1]} not divisible by mesh "
+                f"axis {self.axis_name!r} size {axis_size}"
+            )
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        return self._step_fn(
+            params, opt_state, jnp.asarray(windows), jnp.asarray(targets)
+        )
+
+    def predict(self, params, windows):
+        """Single-device inference with the local twin (same params)."""
+        if self._forward_fn is None:
+            module = self.local_module
+            self._forward_fn = jax.jit(lambda p, x: module.apply(p, x)[0])
+        return jax.device_get(self._forward_fn(params, jnp.asarray(windows)))
