@@ -1,0 +1,32 @@
+# Build/push targets for the four deploy images (reference shape: Makefile).
+# Image names match what the Argo workflow template pulls
+# (argo-workflow.yml.template: gordo-tpu-{builder,server,client,deploy}).
+REGISTRY ?= localhost:5000
+TAG ?= $(shell git rev-parse --short HEAD)
+
+IMAGES = builder server client deploy
+
+DOCKERFILE_builder = Dockerfile-ModelBuilder
+DOCKERFILE_server  = Dockerfile-ModelServer
+DOCKERFILE_client  = Dockerfile-Client
+DOCKERFILE_deploy  = Dockerfile-Deploy
+
+.PHONY: all test bench images push $(addprefix image-,$(IMAGES)) $(addprefix push-,$(IMAGES))
+
+all: test
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+images: $(addprefix image-,$(IMAGES))
+
+image-%:
+	docker build -f $(DOCKERFILE_$*) -t $(REGISTRY)/gordo-tpu-$*:$(TAG) .
+
+push: $(addprefix push-,$(IMAGES))
+
+push-%: image-%
+	docker push $(REGISTRY)/gordo-tpu-$*:$(TAG)

@@ -52,13 +52,23 @@ from gordo_tpu.utils.compat import normalize_frequency
 logger = logging.getLogger(__name__)
 
 
+def _env_bool(name: str, default: bool = False) -> bool:
+    value = os.environ.get(name)
+    if value is None:
+        return default
+    return value.strip().lower() in ("1", "true", "yes", "on")
+
+
 class Config:
     """Default app config (reference: gordo/server/config.py)."""
 
     MODEL_COLLECTION_DIR_ENV_VAR = "MODEL_COLLECTION_DIR"
     EXPECTED_MODELS_ENV_VAR = "EXPECTED_MODELS"
-    ENABLE_PROMETHEUS = False
     PROJECT: typing.Optional[str] = None
+
+    def __init__(self):
+        # env fallback so containers can enable metrics without CLI flags
+        self.ENABLE_PROMETHEUS = _env_bool("ENABLE_PROMETHEUS", False)
 
     def to_dict(self) -> dict:
         return {
@@ -568,6 +578,8 @@ def build_app(
 ) -> GordoApp:
     """Build the WSGI app (reference: server/server.py:138-212)."""
     config = dict(config or {})
+    if "ENABLE_PROMETHEUS" not in config:
+        config["ENABLE_PROMETHEUS"] = _env_bool("ENABLE_PROMETHEUS", False)
     if prometheus_registry is not None:
         if config.get("ENABLE_PROMETHEUS"):
             config["PROMETHEUS_REGISTRY"] = prometheus_registry

@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# Model-builder container entrypoint (reference shape: build.sh — wait for
+# the shared volume, then run the build). TPU twist: prefers the bucketed
+# fleet build (MACHINES, many models in one process); falls back to the
+# single-machine build (MACHINE) for reference parity.
+set -euo pipefail
+
+MOUNT_ROOT="${GORDO_MOUNT_PATH:-/gordo}"
+WAIT_SECONDS="${GORDO_MOUNT_WAIT_SECONDS:-60}"
+
+for _ in $(seq "$WAIT_SECONDS"); do
+    [ -d "$MOUNT_ROOT" ] && break
+    echo "waiting for $MOUNT_ROOT to be mounted..."
+    sleep 1
+done
+[ -d "$MOUNT_ROOT" ] || { echo "mount $MOUNT_ROOT never appeared" >&2; exit 1; }
+
+if [ -n "${MACHINES:-}" ]; then
+    exec python -m gordo_tpu.cli build-fleet
+else
+    exec python -m gordo_tpu.cli build
+fi

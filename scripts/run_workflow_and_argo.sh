@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Deploy container entrypoint (reference shape: run_workflow_and_argo.sh):
+# unwrap the CRD config, render the Argo workflow, lint, submit.
+set -euo pipefail
+
+CONFIG_FILE="${GORDO_CONFIG_FILE:-/tmp/config.yml}"
+PROJECT_NAME="${PROJECT_NAME:?PROJECT_NAME must be set}"
+OUT_FILE="${WORKFLOW_OUTPUT_FILE:-/tmp/workflow.yml}"
+
+python -m gordo_tpu.cli workflow generate \
+    --machine-config "$CONFIG_FILE" \
+    --project-name "$PROJECT_NAME" \
+    --output-file "$OUT_FILE"
+
+if command -v argo >/dev/null 2>&1; then
+    argo lint "$OUT_FILE"
+    argo submit "$OUT_FILE"
+else
+    echo "argo CLI not available; generated workflow left at $OUT_FILE"
+fi

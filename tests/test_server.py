@@ -235,6 +235,19 @@ def test_prometheus_metrics(model_collection_env):
     assert count == 1.0
 
 
+def test_prometheus_enabled_by_env_var(model_collection_env, monkeypatch):
+    """Containers enable metrics via ENABLE_PROMETHEUS (no CLI flag)."""
+    from prometheus_client import CollectorRegistry
+
+    from gordo_tpu.server import build_app
+
+    monkeypatch.setenv("ENABLE_PROMETHEUS", "true")
+    app = build_app(prometheus_registry=CollectorRegistry())
+    assert app.prometheus_metrics is not None
+    monkeypatch.setenv("ENABLE_PROMETHEUS", "0")
+    assert build_app().prometheus_metrics is None
+
+
 def test_envoy_prefix_rewrite(gordo_ml_server_client):
     resp = gordo_ml_server_client.get(
         _url(GORDO_PROJECT, "models"),
