@@ -11,7 +11,9 @@ DOCKERFILE_server  = Dockerfile-ModelServer
 DOCKERFILE_client  = Dockerfile-Client
 DOCKERFILE_deploy  = Dockerfile-Deploy
 
-.PHONY: all test bench images push $(addprefix image-,$(IMAGES)) $(addprefix push-,$(IMAGES))
+# NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
+# implicit-rule search for .PHONY targets
+.PHONY: all test bench images push
 
 all: test
 

@@ -1,0 +1,139 @@
+"""
+Concurrent-user serving load test (reference analogue:
+benchmarks/load_test/load_test.py, which drives Locust against a deployed
+cluster). This is dependency-free: N worker threads hammer the prediction
+endpoint of a running server for a fixed duration and report RPS and
+latency percentiles as one JSON object.
+
+Target a deployed server:
+
+    python benchmarks/load_test.py --base-url http://host:5555 \\
+        --project proj --machine m0 --users 8 --duration 30
+
+or self-serve a temporary in-process server on random-data artifacts:
+
+    python benchmarks/load_test.py --self-serve --users 4 --duration 10
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import threading
+import time
+import urllib.error
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    # the TPU plugin pins jax_platforms via sitecustomize; honor the env var
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def self_serve(tmp: str, port: int) -> str:
+    """Train one machine on random data and serve it; returns base URL."""
+    from werkzeug.serving import make_server
+
+    from benchmarks.server_latency import build_collection
+    from gordo_tpu.server import build_app
+
+    collection = build_collection(1, tmp)
+    os.environ["MODEL_COLLECTION_DIR"] = collection
+    server = make_server("127.0.0.1", port, build_app(), threaded=True)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return f"http://127.0.0.1:{port}"
+
+
+def worker(url: str, body: bytes, stop_at: float, latencies, errors):
+    while time.perf_counter() < stop_at:
+        request = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        start = time.perf_counter()
+        try:
+            with urllib.request.urlopen(request, timeout=30) as resp:
+                resp.read()
+        except urllib.error.HTTPError as err:
+            errors.append(err.code)
+            continue
+        except Exception:
+            errors.append("exception")
+            continue
+        latencies.append((time.perf_counter() - start) * 1000)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--base-url", default=None)
+    parser.add_argument("--project", default="proj")
+    parser.add_argument("--machine", default="bench-m0")
+    parser.add_argument("--users", type=int, default=4)
+    parser.add_argument("--duration", type=float, default=15.0)
+    parser.add_argument("--samples", type=int, default=100)
+    parser.add_argument("--self-serve", action="store_true")
+    parser.add_argument("--port", type=int, default=5599)
+    args = parser.parse_args()
+
+    import numpy as np
+
+    tmp_ctx = tempfile.TemporaryDirectory()
+    base_url = args.base_url
+    if base_url is None:
+        if not args.self_serve:
+            parser.error("--base-url or --self-serve required")
+        base_url = self_serve(tmp_ctx.name, args.port)
+
+    rows = np.random.default_rng(0).random((args.samples, 4)).tolist()
+    body = json.dumps({"X": rows}).encode()
+    url = f"{base_url}/gordo/v0/{args.project}/{args.machine}/prediction"
+
+    # warmup: first request pays model load + compile
+    urllib.request.urlopen(
+        urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        ),
+        timeout=120,
+    ).read()
+
+    latencies: list = []
+    errors: list = []
+    stop_at = time.perf_counter() + args.duration
+    threads = [
+        threading.Thread(
+            target=worker, args=(url, body, stop_at, latencies, errors)
+        )
+        for _ in range(args.users)
+    ]
+    start = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - start
+
+    ordered = sorted(latencies)
+    print(
+        json.dumps(
+            {
+                "users": args.users,
+                "duration_s": round(elapsed, 1),
+                "requests": len(latencies),
+                "errors": len(errors),
+                "rps": round(len(latencies) / elapsed, 1),
+                "mean_ms": round(statistics.mean(ordered), 2) if ordered else None,
+                "p50_ms": round(ordered[len(ordered) // 2], 2) if ordered else None,
+                "p95_ms": round(ordered[int(len(ordered) * 0.95) - 1], 2)
+                if ordered
+                else None,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -64,11 +64,8 @@ class Config:
 
     MODEL_COLLECTION_DIR_ENV_VAR = "MODEL_COLLECTION_DIR"
     EXPECTED_MODELS_ENV_VAR = "EXPECTED_MODELS"
+    ENABLE_PROMETHEUS = False  # env fallback applied in build_app
     PROJECT: typing.Optional[str] = None
-
-    def __init__(self):
-        # env fallback so containers can enable metrics without CLI flags
-        self.ENABLE_PROMETHEUS = _env_bool("ENABLE_PROMETHEUS", False)
 
     def to_dict(self) -> dict:
         return {
@@ -110,6 +107,7 @@ class GordoApp:
             [
                 Rule("/healthcheck", endpoint="healthcheck", methods=["GET"]),
                 Rule("/server-version", endpoint="server_version", methods=["GET"]),
+                Rule("/metrics", endpoint="metrics", methods=["GET"]),
                 Rule(
                     "/gordo/v0/<gordo_project>/models",
                     endpoint="models",
@@ -292,6 +290,18 @@ class GordoApp:
         return []
 
     # -- views -------------------------------------------------------------
+
+    def view_metrics(self, ctx, request) -> Response:
+        """Prometheus exposition for the in-process registry (404 when off)."""
+        if self.prometheus_metrics is None:
+            raise NotFound("Prometheus metrics are not enabled")
+        from prometheus_client import CONTENT_TYPE_LATEST, generate_latest
+
+        return Response(
+            generate_latest(self.prometheus_metrics.registry),
+            200,
+            mimetype=CONTENT_TYPE_LATEST,
+        )
 
     def view_healthcheck(self, ctx, request) -> Response:
         return Response("", 200)

@@ -238,14 +238,24 @@ def test_prometheus_metrics(model_collection_env):
 def test_prometheus_enabled_by_env_var(model_collection_env, monkeypatch):
     """Containers enable metrics via ENABLE_PROMETHEUS (no CLI flag)."""
     from prometheus_client import CollectorRegistry
+    from werkzeug.test import Client
 
     from gordo_tpu.server import build_app
 
     monkeypatch.setenv("ENABLE_PROMETHEUS", "true")
     app = build_app(prometheus_registry=CollectorRegistry())
     assert app.prometheus_metrics is not None
+    # the app serves its own exposition endpoint
+    client = Client(app)
+    assert client.get(_url(GORDO_PROJECT, "models")).status_code == 200
+    metrics_resp = client.get("/metrics")
+    assert metrics_resp.status_code == 200
+    assert b"gordo_server_requests_total" in metrics_resp.get_data()
+
     monkeypatch.setenv("ENABLE_PROMETHEUS", "0")
-    assert build_app().prometheus_metrics is None
+    disabled = build_app()
+    assert disabled.prometheus_metrics is None
+    assert Client(disabled).get("/metrics").status_code == 404
 
 
 def test_envoy_prefix_rewrite(gordo_ml_server_client):
