@@ -18,7 +18,6 @@ or self-serve a temporary in-process server on random-data artifacts:
 import argparse
 import json
 import os
-import statistics
 import sys
 import tempfile
 import threading
@@ -75,6 +74,13 @@ def main():
     parser.add_argument("--users", type=int, default=4)
     parser.add_argument("--duration", type=float, default=15.0)
     parser.add_argument("--samples", type=int, default=100)
+    parser.add_argument(
+        "--features",
+        type=int,
+        default=4,
+        help="Feature width of the request payload; must match the target "
+        "model's tag count (self-serve models have 4)",
+    )
     parser.add_argument("--self-serve", action="store_true")
     parser.add_argument("--port", type=int, default=5599)
     args = parser.parse_args()
@@ -88,17 +94,27 @@ def main():
             parser.error("--base-url or --self-serve required")
         base_url = self_serve(tmp_ctx.name, args.port)
 
-    rows = np.random.default_rng(0).random((args.samples, 4)).tolist()
+    rows = np.random.default_rng(0).random((args.samples, args.features)).tolist()
     body = json.dumps({"X": rows}).encode()
     url = f"{base_url}/gordo/v0/{args.project}/{args.machine}/prediction"
 
     # warmup: first request pays model load + compile
-    urllib.request.urlopen(
-        urllib.request.Request(
-            url, data=body, headers={"Content-Type": "application/json"}
-        ),
-        timeout=120,
-    ).read()
+    try:
+        urllib.request.urlopen(
+            urllib.request.Request(
+                url, data=body, headers={"Content-Type": "application/json"}
+            ),
+            timeout=120,
+        ).read()
+    except urllib.error.HTTPError as err:
+        detail = err.read().decode(errors="replace")[:300]
+        sys.exit(
+            f"warmup request failed with HTTP {err.code}: {detail}\n"
+            f"(check --project/--machine, and that --features matches the "
+            f"model's tag count)"
+        )
+    except urllib.error.URLError as err:
+        sys.exit(f"cannot reach {url}: {err.reason}")
 
     latencies: list = []
     errors: list = []
@@ -116,7 +132,9 @@ def main():
         t.join()
     elapsed = time.perf_counter() - start
 
-    ordered = sorted(latencies)
+    from benchmarks.server_latency import summarize_ms
+
+    summary = summarize_ms(latencies) if latencies else {}
     print(
         json.dumps(
             {
@@ -125,11 +143,7 @@ def main():
                 "requests": len(latencies),
                 "errors": len(errors),
                 "rps": round(len(latencies) / elapsed, 1),
-                "mean_ms": round(statistics.mean(ordered), 2) if ordered else None,
-                "p50_ms": round(ordered[len(ordered) // 2], 2) if ordered else None,
-                "p95_ms": round(ordered[int(len(ordered) * 0.95) - 1], 2)
-                if ordered
-                else None,
+                **summary,
             }
         )
     )

@@ -55,6 +55,16 @@ def build_collection(n_machines: int, tmp: str) -> str:
     return collection
 
 
+def summarize_ms(times):
+    """mean/p50/p95 summary of a list of millisecond latencies."""
+    ordered = sorted(times)
+    return {
+        "mean_ms": round(statistics.mean(ordered), 3),
+        "p50_ms": round(statistics.median(ordered), 3),
+        "p95_ms": round(ordered[max(0, int(0.95 * len(ordered)) - 1)], 3),
+    }
+
+
 def timed_posts(client, url, body, rounds):
     times = []
     for _ in range(rounds):
@@ -62,12 +72,7 @@ def timed_posts(client, url, body, rounds):
         resp = client.post(url, json=body)
         times.append((time.perf_counter() - start) * 1000)
         assert resp.status_code == 200, resp.get_data()
-    return {
-        "mean_ms": round(statistics.mean(times), 3),
-        "p50_ms": round(statistics.median(times), 3),
-        "p95_ms": round(sorted(times)[int(0.95 * len(times)) - 1], 3),
-        "rounds": rounds,
-    }
+    return {**summarize_ms(times), "rounds": rounds}
 
 
 def main():

@@ -244,7 +244,10 @@ class GordoApp:
             response.headers["revision"] = ctx.revision
         runtime_s = timeit.default_timer() - ctx.start_time
         response.headers["Server-Timing"] = f"request_walltime_s;dur={runtime_s}"
-        if self.prometheus_metrics is not None and request.path != "/healthcheck":
+        if self.prometheus_metrics is not None and request.path not in (
+            "/healthcheck",
+            "/metrics",  # don't count scrapes as server traffic
+        ):
             self.prometheus_metrics.observe(
                 request=request,
                 endpoint=endpoint or "unmatched",
