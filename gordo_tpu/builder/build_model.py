@@ -30,6 +30,7 @@ from sklearn.pipeline import Pipeline
 
 from gordo_tpu import MAJOR_VERSION, MINOR_VERSION, __version__, serializer
 from gordo_tpu.data import _get_dataset
+from gordo_tpu.utils.tracing import annotate, maybe_trace
 from gordo_tpu.machine import Machine
 from gordo_tpu.machine.metadata import (
     BuildMetadata,
@@ -147,13 +148,19 @@ class ModelBuilder:
         return model, machine
 
     def _build(self) -> Tuple[BaseEstimator, Machine]:
-        """Run the actual build (reference: build_model.py:160-303)."""
+        """Run the actual build (reference: build_model.py:160-303),
+        profiler-traced when GORDO_TPU_PROFILE_DIR is configured."""
+        with maybe_trace(f"build-{self.machine.name}"):
+            return self._build_traced()
+
+    def _build_traced(self) -> Tuple[BaseEstimator, Machine]:
         self.set_seed(seed=self.machine.evaluation.get("seed", 0))
 
         dataset = _get_dataset(self.machine.dataset.to_dict())
 
         start = time.time()
-        X, y = dataset.get_data()
+        with annotate("data-fetch"):
+            X, y = dataset.get_data()
         time_elapsed_data = time.time() - start
 
         model = serializer.from_definition(self.machine.model)
@@ -194,10 +201,11 @@ class ModelBuilder:
                 cv_kwargs = dict(
                     X=X, y=y, scoring=metrics_dict, return_estimator=True, cv=split_obj
                 )
-                if hasattr(model, "cross_validate"):
-                    cv = model.cross_validate(**cv_kwargs)
-                else:
-                    cv = cross_validate(model, **cv_kwargs)
+                with annotate("cross-validation"):
+                    if hasattr(model, "cross_validate"):
+                        cv = model.cross_validate(**cv_kwargs)
+                    else:
+                        cv = cross_validate(model, **cv_kwargs)
 
                 for metric, test_metric in map(lambda k: (k, f"test_{k}"), metrics_dict):
                     val = {
@@ -234,7 +242,8 @@ class ModelBuilder:
                 return model, machine
 
         start = time.time()
-        model.fit(X, y)
+        with annotate("fit"):
+            model.fit(X, y)
         time_elapsed_model = time.time() - start
 
         machine.metadata.build_metadata = BuildMetadata(

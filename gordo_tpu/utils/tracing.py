@@ -1,0 +1,81 @@
+"""
+Profiling/trace hooks — the TPU-native analogue of the reference's
+lightweight timing surface (SURVEY.md §5: Server-Timing headers and
+metadata-embedded durations, which this package also keeps).
+
+``maybe_trace`` wraps a region in a ``jax.profiler`` trace when profiling
+is enabled, producing TensorBoard-loadable dumps (XLA op timelines, HBM
+usage) under ``<dir>/<name>-<timestamp>/``. Enable per-process with the
+``GORDO_TPU_PROFILE_DIR`` env var or per-call with an explicit directory.
+
+``annotate`` adds named spans inside an active trace so builder phases
+(data fetch, CV folds, fit) are attributable on the timeline.
+"""
+
+import contextlib
+import logging
+import os
+import time
+
+logger = logging.getLogger(__name__)
+
+PROFILE_DIR_ENV_VAR = "GORDO_TPU_PROFILE_DIR"
+
+
+def profile_dir() -> str:
+    """Configured profile dump directory, or '' when profiling is off."""
+    return os.environ.get(PROFILE_DIR_ENV_VAR, "")
+
+
+@contextlib.contextmanager
+def maybe_trace(name: str, directory: str = ""):
+    """
+    Trace the region into ``<directory>/<name>-<unix_ms>`` when a directory
+    is configured (argument wins over env); no-op otherwise. Never lets a
+    profiler failure break the traced workload.
+    """
+    directory = directory or profile_dir()
+    if not directory:
+        yield
+        return
+
+    target = os.path.join(directory, f"{name}-{int(time.time() * 1000)}")
+    started = False
+    try:
+        import jax
+
+        jax.profiler.start_trace(target)
+        started = True
+    except Exception:  # pragma: no cover - broken jax / profiler quirks
+        logger.warning("Could not start jax profiler trace", exc_info=True)
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                import jax
+
+                jax.profiler.stop_trace()
+                logger.info("Wrote profiler trace to %s", target)
+            except Exception:  # pragma: no cover
+                logger.warning("Could not stop jax profiler trace", exc_info=True)
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """
+    Named span inside an active trace. Cheap no-op when profiling is off,
+    and never breaks the annotated workload if the profiler is unusable.
+    """
+    if not profile_dir():
+        yield
+        return
+    try:
+        import jax
+
+        span = jax.profiler.TraceAnnotation(name)
+    except Exception:  # pragma: no cover - broken jax
+        yield
+        return
+    with span:
+        yield
