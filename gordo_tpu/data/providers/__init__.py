@@ -2,7 +2,10 @@
 Data providers: sources of raw tag series.
 
 - RandomDataProvider — deterministic random series (test backbone)
-- FileSystemProvider — local/NFS/FUSE-mounted lake reader (parquet/csv)
+- FileSystemProvider — local/NFS/FUSE-mounted lake reader, one file per
+  tag (parquet/csv)
+- LongFormatProvider — melted (tag, time, value) files in date-partitioned
+  directories, pivoted long→wide (the IROC-reader analogue)
 - InfluxDataProvider — InfluxDB reader (requires the ``influxdb`` package)
 - DataLakeProvider  — compat alias accepted in legacy configs; resolves to
   FileSystemProvider semantics against a mounted lake path
@@ -11,6 +14,7 @@ Data providers: sources of raw tag series.
 from .base import GordoBaseDataProvider
 from .random_provider import RandomDataProvider
 from .filesystem import FileSystemProvider
+from .longformat import LongFormatProvider
 from .compound import (
     DataLakeProvider,
     NoSuitableDataProviderError,
@@ -28,6 +32,7 @@ __all__ = [
     "GordoBaseDataProvider",
     "RandomDataProvider",
     "FileSystemProvider",
+    "LongFormatProvider",
     "DataLakeProvider",
     "NoSuitableDataProviderError",
     "providers_for_tags",

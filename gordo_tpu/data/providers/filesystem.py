@@ -54,14 +54,14 @@ class FileSystemProvider(GordoBaseDataProvider):
         return self._tag_dir(tag) is not None
 
     def _tag_dir(self, tag: SensorTag) -> typing.Optional[Path]:
+        """Root directory holding the tag's dir or single file, or None."""
         candidates = []
         if tag.asset:
             candidates.append(self.base_dir / tag.asset)
         candidates.append(self.base_dir)
         for root in candidates:
-            tag_dir = root / tag.name
-            if tag_dir.is_dir():
-                return tag_dir
+            if (root / tag.name).is_dir():
+                return root
             for suffix in (".parquet", ".csv"):
                 if (root / (tag.name + suffix)).is_file():
                     return root
@@ -129,7 +129,9 @@ class FileSystemProvider(GordoBaseDataProvider):
         frames = [self._read_file(p, tag.name) for p in self._tag_files(tag, years)]
         if not frames:
             return pd.Series(name=tag.name, dtype="float64")
-        df = pd.concat(frames).sort_index()
+        # stable sort: concat order (later year-files last) must survive
+        # among equal timestamps for keep-last dedup
+        df = pd.concat(frames).sort_index(kind="stable")
         # dedup timestamps keep-last (reference: ncs_reader.py:371-372)
         df = df[~df.index.duplicated(keep="last")]
         series = df["Value"]

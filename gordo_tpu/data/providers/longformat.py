@@ -1,0 +1,190 @@
+"""
+Long-format (melted) file reader — the TPU-native stand-in for the
+reference's IROC reader (gordo/machine/dataset/data_provider/
+iroc_reader.py): files hold MANY tags in long format
+(tag, timestamp, value rows) partitioned into date directories, and are
+pivoted to one series per requested tag. Same responsibilities — walk
+date-partitioned directories with ±1 day of timezone slop, thread-pool
+file fetch, long→wide pivot, keep-last dedup — against a local/NFS/
+gcsfuse-mounted directory.
+
+Expected layout::
+
+    <base_dir>/[<asset>/]<YYYY>/<MM>/<DD>/*.parquet|*.csv
+    <base_dir>/[<asset>/]*.parquet|*.csv          (unpartitioned)
+
+File schema: columns (tag, time, value) — case-insensitive, extra columns
+ignored.
+"""
+
+import logging
+import typing
+from concurrent.futures import ThreadPoolExecutor
+from datetime import datetime, timedelta
+from pathlib import Path
+
+import pandas as pd
+
+from gordo_tpu.data.providers.base import GordoBaseDataProvider
+from gordo_tpu.data.sensor_tag import SensorTag
+from gordo_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+
+class LongFormatProvider(GordoBaseDataProvider):
+    @capture_args
+    def __init__(
+        self,
+        base_dir: str,
+        threads: int = 10,
+        dry_run: bool = False,
+        **kwargs,
+    ):
+        self.base_dir = Path(base_dir)
+        self.threads = threads
+        self.dry_run = dry_run
+
+    def can_handle_tag(self, tag: SensorTag) -> bool:
+        """
+        The melted format can't cheaply prove a tag exists without reading
+        files, so handleability = the tag's asset directory exists and
+        holds data somewhere below. Tags absent from the files yield empty
+        series (logged), matching the reference reader's behavior.
+        """
+        root = self._asset_dir(tag)
+        return root is not None and self._has_data_files(root)
+
+    def _asset_dir(self, tag: SensorTag) -> typing.Optional[Path]:
+        # layout doc: the <asset>/ level is optional — fall back to the
+        # base dir for asset-less layouts
+        if tag.asset and (self.base_dir / tag.asset).is_dir():
+            return self.base_dir / tag.asset
+        if self.base_dir.is_dir():
+            return self.base_dir
+        return None
+
+    @staticmethod
+    def _has_data_files(root: Path) -> bool:
+        for pattern in ("*.parquet", "*.csv", "*/*/*/*.parquet", "*/*/*/*.csv"):
+            if next(root.glob(pattern), None) is not None:
+                return True
+        return False
+
+    @staticmethod
+    def _day_dirs(
+        root: Path, start: datetime, end: datetime
+    ) -> typing.Iterator[Path]:
+        """
+        Date-partition dirs overlapping [start, end), padded one day each
+        side for timezone slop (reference: iroc_reader.py:72-83). Falls
+        back to the root itself for unpartitioned layouts.
+        """
+        day = (start - timedelta(days=1)).date()
+        stop = (end + timedelta(days=1)).date()
+        found_any = False
+        while day <= stop:
+            candidate = root / f"{day.year:04d}" / f"{day.month:02d}" / f"{day.day:02d}"
+            if candidate.is_dir():
+                found_any = True
+                yield candidate
+            day += timedelta(days=1)
+        if not found_any:
+            yield root
+
+    @staticmethod
+    def _read_long_file(
+        path: Path,
+        wanted: typing.AbstractSet[str],
+        start: pd.Timestamp,
+        end: pd.Timestamp,
+    ) -> pd.DataFrame:
+        """Read one melted file, filtered to the wanted tags and window —
+        per-thread filtering keeps memory proportional to requested data."""
+        if path.suffix == ".parquet":
+            df = pd.read_parquet(path)
+        else:
+            df = pd.read_csv(path)
+        cols = {c.lower(): c for c in df.columns}
+        missing = [c for c in ("tag", "time", "value") if c not in cols]
+        if missing:
+            raise ValueError(f"File {path} lacks long-format columns {missing}")
+        out = pd.DataFrame(
+            {
+                "tag": df[cols["tag"]].astype(str),
+                "time": pd.to_datetime(df[cols["time"]], utc=True),
+                "value": pd.to_numeric(df[cols["value"]], errors="coerce"),
+            }
+        ).dropna()
+        out = out[out["tag"].isin(wanted)]
+        return out[(out["time"] >= start) & (out["time"] < end)]
+
+    def load_series(
+        self,
+        train_start_date: datetime,
+        train_end_date: datetime,
+        tag_list: typing.List[SensorTag],
+        dry_run: typing.Optional[bool] = False,
+    ) -> typing.Iterable[pd.Series]:
+        if train_start_date >= train_end_date:
+            raise ValueError(
+                f"start date {train_start_date} is not before end {train_end_date}"
+            )
+        if not tag_list:
+            return
+        wanted = {tag.name for tag in tag_list}
+        roots = {self._asset_dir(tag) for tag in tag_list}
+        roots.discard(None)
+
+        files: typing.List[Path] = []
+        for root in roots:
+            for day_dir in self._day_dirs(root, train_start_date, train_end_date):
+                files.extend(
+                    p
+                    for p in sorted(day_dir.iterdir())
+                    if p.suffix in (".parquet", ".csv")
+                )
+        start = pd.Timestamp(train_start_date)
+        end = pd.Timestamp(train_end_date)
+
+        if files:
+            with ThreadPoolExecutor(max_workers=self.threads) as executor:
+                frames = list(
+                    executor.map(
+                        lambda p: self._read_long_file(p, wanted, start, end), files
+                    )
+                )
+            combined = pd.concat(frames, ignore_index=True)
+        else:
+            if not any(self._has_data_files(root) for root in roots):
+                # no data anywhere below the configured roots: misconfig
+                raise FileNotFoundError(
+                    f"No long-format files under {sorted(map(str, roots))}"
+                )
+            # a valid lake whose partitions fall outside the window is a
+            # no-data case, not an error
+            logger.warning(
+                "No long-format files under %s for window [%s, %s)",
+                sorted(map(str, roots)),
+                train_start_date,
+                train_end_date,
+            )
+            combined = pd.DataFrame(columns=["tag", "time", "value"])
+
+        # long -> wide: one series per tag (reference: iroc_reader.py:208-218)
+        by_tag = dict(tuple(combined.groupby("tag")))
+        for tag in tag_list:
+            frame = by_tag.get(tag.name)
+            if frame is None or frame.empty:
+                logger.warning("No data found for tag %s", tag.name)
+                series = pd.Series(name=tag.name, dtype="float64")
+            else:
+                # stable sort so concat order (later partitions last) is
+                # preserved among equal timestamps for keep-last dedup
+                frame = frame.set_index("time").sort_index(kind="stable")
+                frame = frame[~frame.index.duplicated(keep="last")]
+                series = frame["value"]
+                series.name = tag.name
+            if dry_run or self.dry_run:
+                logger.info("Dry run: %s (%d rows)", tag.name, len(series))
+            yield series

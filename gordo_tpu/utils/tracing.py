@@ -15,11 +15,16 @@ usage) under ``<dir>/<name>-<timestamp>/``. Enable per-process with the
 import contextlib
 import logging
 import os
+import threading
 import time
 
 logger = logging.getLogger(__name__)
 
 PROFILE_DIR_ENV_VAR = "GORDO_TPU_PROFILE_DIR"
+
+# set while a maybe_trace region is active, so annotate() works for both
+# env-var and explicit-directory tracing
+_active = threading.local()
 
 
 def profile_dir() -> str:
@@ -46,12 +51,14 @@ def maybe_trace(name: str, directory: str = ""):
 
         jax.profiler.start_trace(target)
         started = True
+        _active.tracing = True
     except Exception:  # pragma: no cover - broken jax / profiler quirks
         logger.warning("Could not start jax profiler trace", exc_info=True)
     try:
         yield
     finally:
         if started:
+            _active.tracing = False
             try:
                 import jax
 
@@ -64,10 +71,11 @@ def maybe_trace(name: str, directory: str = ""):
 @contextlib.contextmanager
 def annotate(name: str):
     """
-    Named span inside an active trace. Cheap no-op when profiling is off,
-    and never breaks the annotated workload if the profiler is unusable.
+    Named span inside an active ``maybe_trace`` region. Cheap no-op when no
+    trace is active, and never breaks the annotated workload if the
+    profiler is unusable.
     """
-    if not profile_dir():
+    if not getattr(_active, "tracing", False):
         yield
         return
     try:

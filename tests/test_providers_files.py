@@ -1,0 +1,122 @@
+"""
+File-backed provider tests: the per-tag-file FileSystemProvider (NCS-reader
+analogue) and the melted LongFormatProvider (IROC-reader analogue), against
+real temp-dir layouts.
+"""
+
+from datetime import datetime, timezone
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.data.providers import FileSystemProvider, LongFormatProvider
+from gordo_tpu.data.sensor_tag import SensorTag
+
+START = datetime(2019, 1, 1, tzinfo=timezone.utc)
+END = datetime(2019, 1, 3, tzinfo=timezone.utc)
+
+
+def make_long_frame(tags, periods=48, start="2019-01-01", seed=0):
+    rng = np.random.default_rng(seed)
+    index = pd.date_range(start, periods=periods, freq="1h", tz="UTC")
+    rows = []
+    for tag in tags:
+        for ts, value in zip(index, rng.random(periods)):
+            rows.append({"tag": tag, "time": ts, "value": value})
+    return pd.DataFrame(rows)
+
+
+@pytest.fixture
+def long_partitioned_dir(tmp_path):
+    """Two day-partitions of melted parquet files."""
+    for day in (1, 2):
+        day_dir = tmp_path / "2019" / "01" / f"{day:02d}"
+        day_dir.mkdir(parents=True)
+        frame = make_long_frame(
+            ["GRA-A", "GRA-B"], periods=24, start=f"2019-01-{day:02d}", seed=day
+        )
+        frame.to_parquet(day_dir / "readings.parquet")
+    return tmp_path
+
+
+def test_long_format_partitioned(long_partitioned_dir):
+    provider = LongFormatProvider(base_dir=str(long_partitioned_dir))
+    tags = [SensorTag("GRA-A", "gra"), SensorTag("GRA-B", "gra")]
+    series = list(provider.load_series(START, END, tags))
+    assert [s.name for s in series] == ["GRA-A", "GRA-B"]
+    # both day partitions contribute
+    assert all(len(s) == 48 for s in series)
+    assert all(s.index.min() >= pd.Timestamp(START) for s in series)
+
+
+def test_long_format_unpartitioned_csv(tmp_path):
+    frame = make_long_frame(["GRA-A"], periods=24)
+    frame.to_csv(tmp_path / "flat.csv", index=False)
+    provider = LongFormatProvider(base_dir=str(tmp_path))
+    (series,) = provider.load_series(START, END, [SensorTag("GRA-A", "gra")])
+    assert len(series) == 24
+
+
+def test_long_format_missing_tag_yields_empty(long_partitioned_dir):
+    provider = LongFormatProvider(base_dir=str(long_partitioned_dir))
+    (series,) = provider.load_series(START, END, [SensorTag("NOPE", "gra")])
+    assert series.empty
+
+
+def test_long_format_dedups_keep_last(tmp_path):
+    ts = pd.Timestamp("2019-01-01T06:00:00Z")
+    frame = pd.DataFrame(
+        {
+            "tag": ["GRA-A", "GRA-A"],
+            "time": [ts, ts],
+            "value": [1.0, 2.0],
+        }
+    )
+    frame.to_csv(tmp_path / "dup.csv", index=False)
+    provider = LongFormatProvider(base_dir=str(tmp_path))
+    (series,) = provider.load_series(START, END, [SensorTag("GRA-A", "gra")])
+    assert len(series) == 1
+    assert series.iloc[0] == 2.0
+
+
+def test_long_format_bad_schema_raises(tmp_path):
+    pd.DataFrame({"a": [1]}).to_csv(tmp_path / "bad.csv", index=False)
+    provider = LongFormatProvider(base_dir=str(tmp_path))
+    with pytest.raises(ValueError, match="long-format columns"):
+        list(provider.load_series(START, END, [SensorTag("GRA-A", "gra")]))
+
+
+def test_long_format_no_files_raises(tmp_path):
+    provider = LongFormatProvider(base_dir=str(tmp_path))
+    with pytest.raises(FileNotFoundError):
+        list(provider.load_series(START, END, [SensorTag("GRA-A", "gra")]))
+
+
+def test_long_format_date_window_filter(long_partitioned_dir):
+    provider = LongFormatProvider(base_dir=str(long_partitioned_dir))
+    end = datetime(2019, 1, 2, tzinfo=timezone.utc)  # only day 1
+    (series, _) = provider.load_series(
+        START, end, [SensorTag("GRA-A", "gra"), SensorTag("GRA-B", "gra")]
+    )
+    assert len(series) == 24
+    assert series.index.max() < pd.Timestamp(end)
+
+
+# -- per-tag-file provider: year files + status codes ------------------------
+def test_filesystem_provider_year_files_and_status(tmp_path):
+    tag_dir = tmp_path / "gra" / "GRA-A"
+    tag_dir.mkdir(parents=True)
+    index = pd.date_range("2019-01-01", periods=24, freq="1h", tz="UTC")
+    frame = pd.DataFrame(
+        {
+            "Time": index,
+            "Value": np.arange(24, dtype="float64"),
+            "Status": [0, 192] * 11 + [1, 99],  # last two are bad codes
+        }
+    )
+    frame.to_parquet(tag_dir / "GRA-A_2019.parquet")
+    provider = FileSystemProvider(base_dir=str(tmp_path))
+    assert provider.can_handle_tag(SensorTag("GRA-A", "gra"))
+    (series,) = provider.load_series(START, END, [SensorTag("GRA-A", "gra")])
+    assert len(series) == 22  # bad status rows dropped
