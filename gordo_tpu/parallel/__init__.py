@@ -26,6 +26,7 @@ from .sequence import (
     ulysses_attention,
 )
 from .long_context import LongContextTrainer
+from .checkpoint import FleetCheckpointer
 
 __all__ = [
     "get_device_mesh",
@@ -38,4 +39,5 @@ __all__ = [
     "ulysses_attention",
     "sequence_sharded_attention",
     "LongContextTrainer",
+    "FleetCheckpointer",
 ]

@@ -1,0 +1,74 @@
+"""
+Mid-training checkpoint/resume for fleet training, on orbax.
+
+The reference's resume granularity is the whole model — its sha3-keyed
+build cache skips machines already built (SURVEY.md §5 "Checkpoint /
+resume"; that cache exists here too, gordo_tpu/builder/build_model.py).
+This module adds the granularity the reference never needed: epoch-level
+checkpoints of the *stacked fleet* (params + optimizer state), so a long
+fleet build on a preemptible TPU slice resumes from the last completed
+epoch instead of refitting every machine from scratch.
+"""
+
+import logging
+from typing import Any, Optional, Tuple
+
+logger = logging.getLogger(__name__)
+
+
+class FleetCheckpointer:
+    """
+    Epoch-granular checkpointing of (params, opt_state) via an orbax
+    ``CheckpointManager``. Sharded arrays save/restore with their
+    shardings; single-process and multi-host both work (orbax coordinates
+    across `jax.distributed` processes).
+    """
+
+    def __init__(self, directory: str, keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = str(directory)
+        self._manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=keep),
+        )
+
+    def latest_epoch(self) -> Optional[int]:
+        """Last checkpointed epoch number, or None."""
+        return self._manager.latest_step()
+
+    def save(self, epoch: int, params: Any, opt_state: Any) -> None:
+        self._manager.save(
+            epoch,
+            args=self._ocp.args.StandardSave(
+                {"params": params, "opt_state": opt_state}
+            ),
+        )
+
+    def restore(
+        self, params_template: Any, opt_state_template: Any, epoch: Optional[int] = None
+    ) -> Tuple[Any, Any, int]:
+        """
+        Restore (params, opt_state, epoch). Templates (e.g. freshly
+        initialized state) carry the tree structure and shardings the
+        arrays restore into.
+        """
+        epoch = self._manager.latest_step() if epoch is None else epoch
+        if epoch is None:
+            raise FileNotFoundError(f"No checkpoints under {self.directory}")
+        restored = self._manager.restore(
+            epoch,
+            args=self._ocp.args.StandardRestore(
+                {"params": params_template, "opt_state": opt_state_template}
+            ),
+        )
+        logger.info("Restored fleet checkpoint at epoch %d", epoch)
+        return restored["params"], restored["opt_state"], epoch
+
+    def wait(self) -> None:
+        """Block until async checkpoint writes land."""
+        self._manager.wait_until_finished()
+
+    def close(self) -> None:
+        self._manager.close()

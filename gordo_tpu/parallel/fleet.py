@@ -266,6 +266,8 @@ class FleetTrainer:
         shuffle: Optional[bool] = None,
         params: Any = None,
         extra_weight: Optional[jnp.ndarray] = None,
+        checkpointer: Optional[Any] = None,
+        checkpoint_every: int = 1,
     ) -> Tuple[Any, np.ndarray]:
         """
         Train the fleet. Returns (stacked params, losses (epochs, M)).
@@ -273,6 +275,11 @@ class FleetTrainer:
         ``extra_weight`` ((M, n), e.g. a CV-fold train mask) multiplies the
         base sample weights — this is how fold training reuses the same
         compiled program.
+
+        ``checkpointer`` (a parallel.checkpoint.FleetCheckpointer) saves
+        (params, opt_state) every ``checkpoint_every`` epochs and, when the
+        directory already holds checkpoints, resumes from the last
+        completed epoch — preemption-safe long fleet builds.
         """
         if shuffle is None:
             shuffle = not self.spec.windowed
@@ -286,14 +293,26 @@ class FleetTrainer:
         opt_state = self.init_opt_state(params)
         keys = self._shard(jnp.asarray(keys))
 
+        start_epoch = 0
+        if checkpointer is not None and checkpointer.latest_epoch() is not None:
+            params, opt_state, done = checkpointer.restore(params, opt_state)
+            start_epoch = done + 1
+            logger.info("Resuming fleet fit at epoch %d/%d", start_epoch, epochs)
+
         epoch_fn = self._epoch_fn(data.n_timesteps, batch_size, shuffle)
         losses = []
-        for epoch in range(epochs):
+        for epoch in range(start_epoch, epochs):
             epoch_keys = jax.vmap(lambda k: jax.random.fold_in(k, epoch))(keys)
             params, opt_state, epoch_loss = epoch_fn(
                 params, opt_state, epoch_keys, data.X, data.y, w
             )
             losses.append(np.asarray(epoch_loss))
+            if checkpointer is not None and (epoch + 1) % max(
+                1, checkpoint_every
+            ) == 0:
+                checkpointer.save(epoch, params, opt_state)
+        if checkpointer is not None:
+            checkpointer.wait()
         return params, np.stack(losses) if losses else np.zeros((0, data.n_machines))
 
     def predict(self, params: Any, X: jnp.ndarray, batch_size: int = 8192) -> np.ndarray:
