@@ -1,0 +1,214 @@
+"""
+DiffBasedAnomalyDetector unit tests (reference model:
+tests/gordo/machine/model/anomaly/test_anomaly_detectors.py — threshold
+derivation via rolling(6).min().max(), anomaly frame schema, confidence
+columns, require_thresholds behavior, delegation).
+
+Uses a plain sklearn LinearRegression as the base estimator so no JAX
+training is needed — the detector must wrap ANY estimator, exactly as the
+reference does (diff.py:19-25).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.linear_model import LinearRegression
+from sklearn.model_selection import TimeSeriesSplit
+
+from gordo_tpu.models.anomaly import DiffBasedAnomalyDetector
+
+
+def _data(n=240, n_tags=3, seed=0):
+    rng = np.random.default_rng(seed)
+    index = pd.date_range("2020-01-01", periods=n, freq="10min", tz="UTC")
+    X = pd.DataFrame(
+        rng.normal(size=(n, n_tags)),
+        columns=[f"Tag {i}" for i in range(n_tags)],
+        index=index,
+    )
+    # target = linear function of X + noise, so LinearRegression fits well
+    W = rng.normal(size=(n_tags, n_tags))
+    y = pd.DataFrame(
+        X.to_numpy() @ W + 0.01 * rng.normal(size=(n, n_tags)),
+        columns=X.columns,
+        index=index,
+    )
+    return X, y
+
+
+def test_anomaly_requires_thresholds_by_default():
+    X, y = _data()
+    model = DiffBasedAnomalyDetector(base_estimator=LinearRegression())
+    model.fit(X, y)
+    with pytest.raises(AttributeError, match="cross_validate"):
+        model.anomaly(X, y)
+
+
+def test_anomaly_frame_schema_without_thresholds():
+    X, y = _data()
+    model = DiffBasedAnomalyDetector(
+        base_estimator=LinearRegression(), require_thresholds=False
+    )
+    model.fit(X, y)
+    out = model.anomaly(X, y)
+
+    top = set(out.columns.get_level_values(0))
+    assert {
+        "model-input",
+        "model-output",
+        "tag-anomaly-scaled",
+        "tag-anomaly-unscaled",
+        "total-anomaly-scaled",
+        "total-anomaly-unscaled",
+        "start",
+        "end",
+    } <= top
+    # no thresholds -> no confidence columns
+    assert "anomaly-confidence" not in top
+    assert "total-anomaly-confidence" not in top
+    assert len(out) == len(X)
+    # total-anomaly-scaled is the mean of squared per-tag scaled anomalies
+    expected = np.square(out["tag-anomaly-scaled"]).mean(axis=1)
+    np.testing.assert_allclose(
+        out["total-anomaly-scaled"].to_numpy().ravel(),
+        expected.to_numpy().ravel(),
+        rtol=1e-10,
+    )
+
+
+def test_cross_validate_thresholds_last_fold():
+    X, y = _data()
+    model = DiffBasedAnomalyDetector(base_estimator=LinearRegression())
+    model.fit(X, y)
+    cv_out = model.cross_validate(X=X, y=y)
+    assert "estimator" in cv_out
+
+    n_folds = 3  # TimeSeriesSplit default in cross_validate
+    assert len(model.aggregate_thresholds_per_fold_) == n_folds
+    assert len(model.feature_thresholds_per_fold_) == n_folds
+    # final thresholds are the LAST fold's (reference diff.py:214-222)
+    assert (
+        model.aggregate_threshold_
+        == model.aggregate_thresholds_per_fold_[f"fold-{n_folds - 1}"]
+    )
+    pd.testing.assert_series_equal(
+        model.feature_thresholds_,
+        model.feature_thresholds_per_fold_.iloc[-1],
+        check_names=False,
+    )
+    assert np.isfinite(model.aggregate_threshold_)
+
+
+def test_threshold_is_rolling6_min_max():
+    """Re-derive one fold's threshold by hand and compare."""
+    X, y = _data()
+    model = DiffBasedAnomalyDetector(base_estimator=LinearRegression())
+    model.fit(X, y)
+    cv = TimeSeriesSplit(n_splits=3)
+    model.cross_validate(X=X, y=y, cv=cv)
+
+    # recompute fold-2 threshold: scaled MSE series -> rolling(6).min().max().
+    # Each fold clones the whole detector, so the fold's scaler is fitted on
+    # the fold's training y — replicate that here.
+    from sklearn.preprocessing import RobustScaler
+
+    splits = list(cv.split(X, y))
+    train_idx, test_idx = splits[-1]
+    est = LinearRegression().fit(X.iloc[train_idx], y.iloc[train_idx])
+    fold_scaler = RobustScaler().fit(y.iloc[train_idx])
+    y_pred = est.predict(X.iloc[test_idx])
+    scaled_true = fold_scaler.transform(y.iloc[test_idx])
+    scaled_pred = fold_scaler.transform(y_pred)
+    mse = ((scaled_pred - scaled_true) ** 2).mean(axis=1)
+    expected = pd.Series(mse).rolling(6).min().max()
+    assert model.aggregate_threshold_ == pytest.approx(expected, rel=1e-6)
+
+
+def test_confidence_columns_after_cross_validate():
+    X, y = _data()
+    model = DiffBasedAnomalyDetector(base_estimator=LinearRegression())
+    model.fit(X, y)
+    model.cross_validate(X=X, y=y)
+    out = model.anomaly(X, y)
+
+    top = set(out.columns.get_level_values(0))
+    assert "anomaly-confidence" in top
+    assert "total-anomaly-confidence" in top
+    conf = (
+        out["total-anomaly-scaled"].to_numpy().ravel()
+        / model.aggregate_threshold_
+    )
+    np.testing.assert_allclose(
+        out["total-anomaly-confidence"].to_numpy().ravel(), conf, rtol=1e-10
+    )
+
+
+def test_smoothed_variants_with_window():
+    X, y = _data()
+    model = DiffBasedAnomalyDetector(
+        base_estimator=LinearRegression(), window=12
+    )
+    model.fit(X, y)
+    model.cross_validate(X=X, y=y)
+    out = model.anomaly(X, y)
+
+    top = set(out.columns.get_level_values(0))
+    assert {
+        "smooth-tag-anomaly-scaled",
+        "smooth-total-anomaly-scaled",
+        "smooth-tag-anomaly-unscaled",
+        "smooth-total-anomaly-unscaled",
+    } <= top
+    # smoothing = rolling median over the window
+    expected = out["total-anomaly-scaled"].rolling(12).median()
+    pd.testing.assert_series_equal(
+        out["smooth-total-anomaly-scaled"],
+        expected,
+        check_names=False,
+    )
+    assert model.smooth_aggregate_threshold_ is not None
+    # first window-1 rows of smoothed series are NaN
+    assert out["smooth-total-anomaly-scaled"].iloc[:11].isna().all()
+
+
+def test_getattr_delegates_to_base_estimator():
+    X, y = _data()
+    model = DiffBasedAnomalyDetector(base_estimator=LinearRegression())
+    model.fit(X, y)
+    # coef_ lives on the base estimator
+    assert model.coef_.shape == (3, 3)
+    with pytest.raises(AttributeError):
+        model.nonexistent_attribute_xyz
+
+
+def test_get_metadata_exposes_thresholds():
+    X, y = _data()
+    model = DiffBasedAnomalyDetector(base_estimator=LinearRegression())
+    model.fit(X, y)
+    model.cross_validate(X=X, y=y)
+    meta = model.get_metadata()
+    assert "feature-thresholds" in meta
+    assert "aggregate-threshold" in meta
+    assert "feature-thresholds-per-fold" in meta
+    assert len(meta["feature-thresholds"]) == 3
+
+
+def test_get_params_roundtrip_clone():
+    from sklearn.base import clone
+
+    model = DiffBasedAnomalyDetector(
+        base_estimator=LinearRegression(), window=6
+    )
+    params = model.get_params()
+    assert params["window"] == 6
+    cloned = clone(model)
+    assert cloned.window == 6
+    assert isinstance(cloned.base_estimator, LinearRegression)
+
+
+def test_default_base_estimator_is_hourglass_autoencoder():
+    model = DiffBasedAnomalyDetector()
+    from gordo_tpu.models import AutoEncoder
+
+    assert isinstance(model.base_estimator, AutoEncoder)
+    assert model.base_estimator.kind == "feedforward_hourglass"

@@ -1,0 +1,113 @@
+"""
+FilterPeriods tests (reference model: tests for
+gordo/machine/dataset/filter_periods.py — rolling-median+IQR and
+IsolationForest period detection, contiguous-period grouping, row dropping).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.data.filter_periods import FilterPeriods, WrongFilterMethodType
+
+
+def _frame(n=400, spike_at=(200, 201, 202), freq="10min", seed=0):
+    rng = np.random.default_rng(seed)
+    index = pd.date_range("2020-01-01", periods=n, freq=freq, tz="UTC")
+    values = rng.normal(0.0, 0.1, size=(n, 2))
+    for i in spike_at:
+        values[i] += 50.0
+    return pd.DataFrame(values, columns=["Tag 1", "Tag 2"], index=index)
+
+
+def test_invalid_method_raises():
+    with pytest.raises(WrongFilterMethodType):
+        FilterPeriods(granularity="10T", filter_method="bogus")
+
+
+@pytest.mark.parametrize("method", ["median", "iforest", "all"])
+def test_filter_data_drops_spike(method):
+    data = _frame()
+    fp = FilterPeriods(granularity="10T", filter_method=method, window=24)
+    filtered, drop_periods, predictions = fp.filter_data(data)
+
+    assert set(predictions) == (
+        {"median", "iforest"} if method == "all" else {method}
+    )
+    # the spike rows must be gone, and we never drop everything
+    for i in (200, 201, 202):
+        assert data.index[i] not in filtered.index
+    assert len(filtered) > 0.8 * len(data)
+    # drop periods recorded for each active method
+    for pred_type in predictions:
+        assert isinstance(drop_periods[pred_type], list)
+    assert any(len(v) for v in drop_periods.values())
+
+
+def test_contiguous_flags_grouped_into_one_period():
+    data = _frame(spike_at=(100, 101, 102, 103))
+    fp = FilterPeriods(granularity="10T", filter_method="median", window=24)
+    _, drop_periods, _ = fp.filter_data(data)
+    periods = drop_periods["median"]
+    assert len(periods) == 1
+    assert pd.Timestamp(periods[0]["drop_start"]) == data.index[100]
+    assert pd.Timestamp(periods[0]["drop_end"]) == data.index[103]
+
+
+def test_separated_flags_make_separate_periods():
+    data = _frame(spike_at=(100, 300))
+    fp = FilterPeriods(granularity="10T", filter_method="median", window=24)
+    _, drop_periods, _ = fp.filter_data(data)
+    assert len(drop_periods["median"]) == 2
+
+
+def test_clean_data_drops_nothing():
+    data = _frame(spike_at=())
+    fp = FilterPeriods(granularity="10T", filter_method="median", window=24)
+    filtered, drop_periods, _ = fp.filter_data(data)
+    assert len(filtered) == len(data)
+    assert drop_periods["median"] == []
+
+
+def test_iforest_contamination_bounds_drops():
+    data = _frame(n=600, spike_at=(100,))
+    fp = FilterPeriods(
+        granularity="10T", filter_method="iforest", contamination=0.03
+    )
+    filtered, _, predictions = fp.filter_data(data)
+    flagged = (predictions["iforest"]["pred"] == -1).sum()
+    # IsolationForest flags ~contamination fraction
+    assert flagged <= int(0.10 * len(data))
+    assert len(filtered) >= len(data) - flagged
+    # scores exposed for metadata, as the reference does
+    assert hasattr(fp, "iforest_scores")
+    assert hasattr(fp, "iforest_scores_transformed")
+
+
+def test_iforest_smooth_mode_runs():
+    data = _frame(n=300, spike_at=(150,))
+    fp = FilterPeriods(
+        granularity="10T", filter_method="iforest", iforest_smooth=True
+    )
+    filtered, _, predictions = fp.filter_data(data)
+    assert "iforest" in predictions
+    assert len(filtered) <= len(data)
+
+
+def test_dataset_integration_filter_periods():
+    """TimeSeriesDataset wires filter_periods through to metadata."""
+    from gordo_tpu.data import TimeSeriesDataset
+    from gordo_tpu.data.providers import RandomDataProvider
+
+    dataset = TimeSeriesDataset(
+        data_provider=RandomDataProvider(),
+        train_start_date="2020-01-01T00:00:00+00:00",
+        train_end_date="2020-01-04T00:00:00+00:00",
+        tag_list=["tag-1", "tag-2"],
+        asset="asset",
+        filter_periods={"filter_method": "median", "window": 24},
+    )
+    X, y = dataset.get_data()
+    assert len(X) > 0
+    metadata = dataset.get_metadata()
+    assert "filtered_periods" in metadata

@@ -1,0 +1,81 @@
+"""
+Utility-layer tests (reference model: tests/gordo/util/ — disk_registry
+key semantics, capture_args round-trip capture, non-ascii replacement).
+"""
+
+import pytest
+
+from gordo_tpu.utils import disk_registry
+from gordo_tpu.utils.utils import (
+    capture_args,
+    replace_all_non_ascii_chars_with_default,
+)
+
+
+def test_registry_write_get_delete(tmp_path):
+    reg = tmp_path / "registry"
+    assert disk_registry.get_value(reg, "missing") is None
+
+    disk_registry.write_key(reg, "abc-123", "some/output/dir")
+    assert disk_registry.get_value(reg, "abc-123") == "some/output/dir"
+
+    # overwrite wins
+    disk_registry.write_key(reg, "abc-123", "other/dir")
+    assert disk_registry.get_value(reg, "abc-123") == "other/dir"
+
+    assert disk_registry.delete_value(reg, "abc-123") is True
+    assert disk_registry.get_value(reg, "abc-123") is None
+    assert disk_registry.delete_value(reg, "abc-123") is False
+
+
+def test_registry_nonexistent_dir_reads_none(tmp_path):
+    assert disk_registry.get_value(tmp_path / "nope", "k") is None
+    assert disk_registry.delete_value(tmp_path / "nope", "k") is False
+
+
+@pytest.mark.parametrize("bad", ["a/b", "../x", "a b", "", "k\n"])
+def test_registry_rejects_path_escaping_keys(bad, tmp_path):
+    with pytest.raises(ValueError):
+        disk_registry.write_key(tmp_path, bad, "v")
+
+
+def test_registry_value_coerced_to_str(tmp_path):
+    disk_registry.write_key(tmp_path, "num", 42)
+    assert disk_registry.get_value(tmp_path, "num") == "42"
+
+
+def test_capture_args_records_effective_config():
+    class Thing:
+        @capture_args
+        def __init__(self, a, b=10, *args, c="x", **kwargs):
+            pass
+
+    t = Thing(1, 2, 3, c="y", extra=True)
+    assert t._params == {"a": 1, "b": 2, "args": [3], "c": "y", "extra": True}
+
+    # defaults applied when not passed
+    t2 = Thing(5)
+    assert t2._params["b"] == 10
+    assert t2._params["c"] == "x"
+
+
+def test_capture_args_used_by_dataset_roundtrip():
+    from gordo_tpu.data import TimeSeriesDataset
+    from gordo_tpu.data.providers import RandomDataProvider
+
+    ds = TimeSeriesDataset(
+        data_provider=RandomDataProvider(),
+        train_start_date="2020-01-01T00:00:00+00:00",
+        train_end_date="2020-01-02T00:00:00+00:00",
+        tag_list=["tag-1"],
+        asset="asset",
+    )
+    d = ds.to_dict()
+    assert d["train_start_date"].startswith("2020-01-01")
+    assert d["type"].endswith("TimeSeriesDataset")
+
+
+def test_replace_non_ascii():
+    assert replace_all_non_ascii_chars_with_default("abcæøå123") == "abc---123"
+    assert replace_all_non_ascii_chars_with_default("åbc", "_") == "_bc"
+    assert replace_all_non_ascii_chars_with_default("plain") == "plain"
