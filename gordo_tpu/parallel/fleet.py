@@ -306,14 +306,19 @@ class FleetTrainer:
             params, opt_state, epoch_loss = epoch_fn(
                 params, opt_state, epoch_keys, data.X, data.y, w
             )
-            losses.append(np.asarray(epoch_loss))
+            # keep the loss on device: a host fetch here would sync every
+            # epoch and stall the dispatch pipeline (costly over DCN/tunnel
+            # links); all losses are pulled in one transfer after the loop
+            losses.append(epoch_loss)
             if checkpointer is not None and (epoch + 1) % max(
                 1, checkpoint_every
             ) == 0:
                 checkpointer.save(epoch, params, opt_state)
         if checkpointer is not None:
             checkpointer.wait()
-        return params, np.stack(losses) if losses else np.zeros((0, data.n_machines))
+        if losses:
+            return params, np.stack(jax.device_get(losses))
+        return params, np.zeros((0, data.n_machines))
 
     def predict(self, params: Any, X: jnp.ndarray, batch_size: int = 8192) -> np.ndarray:
         """

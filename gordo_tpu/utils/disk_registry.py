@@ -13,7 +13,7 @@ from typing import Optional, Union
 
 logger = logging.getLogger(__name__)
 
-_VALID_KEY = re.compile(r"^[A-Za-z0-9_.\-]+\Z")
+_VALID_KEY = re.compile(r"^(?!\.\.?\Z)[A-Za-z0-9_.\-]+\Z")
 
 
 def _key_path(registry_dir: Union[os.PathLike, str], key: str) -> Path:

@@ -1,0 +1,151 @@
+"""
+Model-layer helper tests (reference model:
+tests/gordo/machine/model/test_utils.py, test_transformers.py,
+tests/gordo/server/test_model_io.py — metric_wrapper scaling/alignment,
+make_base_dataframe assembly, InfImputer, get_model_output dispatch).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+from sklearn.metrics import mean_squared_error
+from sklearn.preprocessing import MinMaxScaler
+
+from gordo_tpu.models.transformers import InfImputer
+from gordo_tpu.models.utils import make_base_dataframe, metric_wrapper
+from gordo_tpu.server.model_io import get_model_output
+
+
+def test_metric_wrapper_scaling_equalizes_features():
+    """Reference test_utils.py: scaled metric is feature-scale invariant."""
+    y = np.array([[1, 1], [2, 2], [3, 3], [4, 4], [5, 5]]) * [1, 100]
+
+    noscale = metric_wrapper(mean_squared_error)
+    assert not np.isclose(noscale(y, y * [0.8, 1]), noscale(y, y * [1, 0.8]))
+
+    scaler = MinMaxScaler().fit(y)
+    scaled = metric_wrapper(mean_squared_error, scaler=scaler)
+    assert np.isclose(scaled(y, y * [0.8, 1]), scaled(y, y * [1, 0.8]))
+
+
+def test_metric_wrapper_aligns_offset_outputs():
+    """y_true longer than y_pred (windowed model offset) -> tail aligned."""
+    y_true = np.arange(10, dtype=float).reshape(-1, 1)
+    y_pred = y_true[3:]  # model with offset 3
+    wrapped = metric_wrapper(mean_squared_error)
+    assert wrapped(y_true, y_pred) == 0.0
+
+
+@pytest.mark.parametrize("offset", (0, 1, 3))
+@pytest.mark.parametrize("with_dates", (True, False))
+def test_make_base_dataframe(offset, with_dates):
+    n, n_tags = 10, 2
+    tags = ["tag1", "tag2"]
+    index = (
+        pd.date_range("2016-01-01", periods=n, freq="10min", tz="UTC")
+        if with_dates
+        else None
+    )
+    model_input = np.random.random((n, n_tags))
+    model_output = np.random.random((n - offset, n_tags))
+
+    df = make_base_dataframe(
+        tags=tags,
+        model_input=model_input,
+        model_output=model_output,
+        index=index,
+        frequency=pd.Timedelta("10min") if with_dates else None,
+    )
+    assert len(df) == n - offset
+    top = set(df.columns.get_level_values(0))
+    assert {"start", "end", "model-input", "model-output"} <= top
+    assert list(df["model-input"].columns) == tags
+    # model-input is tail-aligned to the (shorter) output
+    np.testing.assert_allclose(df["model-input"].to_numpy(), model_input[offset:])
+    start = df[("start", "")]
+    end = df[("end", "")]
+    if with_dates:
+        assert start.iloc[0] == index[offset].isoformat()
+        assert end.iloc[0] == (index[offset] + pd.Timedelta("10min")).isoformat()
+    else:
+        assert start.iloc[0] is None
+
+
+def test_make_base_dataframe_different_target_tags():
+    """Output columns use target_tag_list; mismatched widths fall back to ints."""
+    n = 5
+    df = make_base_dataframe(
+        tags=["a", "b"],
+        model_input=np.zeros((n, 2)),
+        model_output=np.zeros((n, 3)),
+        target_tag_list=["x", "y", "z"],
+    )
+    assert list(df["model-output"].columns) == ["x", "y", "z"]
+
+    df2 = make_base_dataframe(
+        tags=["a", "b"],
+        model_input=np.zeros((n, 2)),
+        model_output=np.zeros((n, 4)),
+    )
+    assert list(df2["model-output"].columns) == ["0", "1", "2", "3"]
+
+
+def test_inf_imputer_minmax():
+    X = np.array([[1.0, 10.0], [np.inf, 20.0], [3.0, -np.inf]])
+    out = InfImputer(delta=2.0).fit_transform(X)
+    assert out[1, 0] == 3.0 + 2.0  # observed max + delta
+    assert out[2, 1] == 10.0 - 2.0  # observed min - delta
+    assert np.isfinite(out).all()
+
+
+def test_inf_imputer_extremes():
+    X = np.array([[1.0, np.inf], [-np.inf, 2.0]])
+    out = InfImputer(strategy="extremes").fit_transform(X)
+    info = np.finfo(X.dtype)
+    assert out[0, 1] == info.max
+    assert out[1, 0] == info.min
+
+
+def test_inf_imputer_explicit_fill_values():
+    X = np.array([[np.inf, -np.inf]])
+    out = InfImputer(inf_fill_value=99.0, neg_inf_fill_value=-99.0).fit_transform(X)
+    assert out[0, 0] == 99.0
+    assert out[0, 1] == -99.0
+
+
+def test_inf_imputer_bad_strategy():
+    with pytest.raises(ValueError):
+        InfImputer(strategy="bogus").fit(np.zeros((2, 2)))
+
+
+def test_inf_imputer_in_pipeline_definition():
+    """The imputer is reachable through the config language."""
+    from gordo_tpu.serializer import from_definition, into_definition
+
+    pipe = from_definition(
+        {
+            "sklearn.pipeline.Pipeline": {
+                "steps": [
+                    {"gordo_tpu.models.transformers.InfImputer": {"delta": 1.0}},
+                    {"sklearn.preprocessing.MinMaxScaler": {}},
+                ]
+            }
+        }
+    )
+    assert isinstance(pipe.steps[0][1], InfImputer)
+    round_tripped = into_definition(pipe)
+    assert "gordo_tpu.models.transformers.imputer.InfImputer" in str(round_tripped)
+
+
+def test_get_model_output_predict_and_transform_fallback():
+    class HasPredict:
+        def predict(self, X):
+            return np.ones((len(X), 1))
+
+    class OnlyTransform:
+        def transform(self, X):
+            return np.zeros((len(X), 1))
+
+    X = np.zeros((4, 2))
+    assert get_model_output(HasPredict(), X).sum() == 4
+    assert get_model_output(OnlyTransform(), X).sum() == 0

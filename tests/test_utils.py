@@ -33,7 +33,7 @@ def test_registry_nonexistent_dir_reads_none(tmp_path):
     assert disk_registry.delete_value(tmp_path / "nope", "k") is False
 
 
-@pytest.mark.parametrize("bad", ["a/b", "../x", "a b", "", "k\n"])
+@pytest.mark.parametrize("bad", ["a/b", "../x", "a b", "", "k\n", ".", ".."])
 def test_registry_rejects_path_escaping_keys(bad, tmp_path):
     with pytest.raises(ValueError):
         disk_registry.write_key(tmp_path, bad, "v")
