@@ -25,12 +25,27 @@ logger = logging.getLogger(__name__)
 
 
 class DataParallelTrainer:
-    """Single-model trainer with the batch axis sharded over ``axis``."""
+    """
+    Single-model trainer with the batch axis sharded over ``axis``.
 
-    def __init__(self, spec: ModelSpec, mesh: Mesh, axis: str = DATA_AXIS):
+    ``zero1=True`` additionally shards the optimizer state over the same
+    axis (ZeRO stage 1): each chip keeps 1/N of the Adam moments, and XLA's
+    SPMD partitioner turns the gradient all-reduce + update + param
+    broadcast into reduce-scatter / all-gather over ICI on its own — the
+    shardings are the whole "implementation".
+    """
+
+    def __init__(
+        self,
+        spec: ModelSpec,
+        mesh: Mesh,
+        axis: str = DATA_AXIS,
+        zero1: bool = False,
+    ):
         self.spec = spec
         self.mesh = mesh
         self.axis = axis
+        self.zero1 = zero1
         self._optimizer = spec.make_optimizer()
         self._step_fn = None
 
@@ -42,10 +57,29 @@ class DataParallelTrainer:
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, PartitionSpec())
 
+    def _opt_state_sharding(self, opt_state: Any) -> Any:
+        """
+        Per-leaf sharding for the optimizer state: leaves whose leading dim
+        divides evenly over the mesh axis are sharded there; scalars and
+        indivisible leaves stay replicated.
+        """
+        if not self.zero1:
+            return self.replicated
+        n = self.mesh.shape[self.axis]
+        sharded = NamedSharding(self.mesh, PartitionSpec(self.axis))
+
+        def leaf_sharding(leaf):
+            if hasattr(leaf, "ndim") and leaf.ndim >= 1 and leaf.shape[0] % n == 0:
+                return sharded
+            return self.replicated
+
+        return jax.tree.map(leaf_sharding, opt_state)
+
     def init(self, key, example_batch) -> Tuple[Any, Any]:
         params = self.spec.module.init(key, example_batch[:1])
         params = jax.device_put(params, self.replicated)
-        opt_state = jax.device_put(self._optimizer.init(params), self.replicated)
+        opt_state = self._optimizer.init(params)
+        opt_state = jax.device_put(opt_state, self._opt_state_sharding(opt_state))
         return params, opt_state
 
     def shard_batch(self, x):
@@ -67,20 +101,22 @@ class DataParallelTrainer:
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
-        rep, bsh = self.replicated, self.batch_sharding
-        return jax.jit(
-            step,
-            in_shardings=(rep, rep, bsh, bsh),
-            out_shardings=(rep, rep, rep),
-            donate_argnums=(0, 1),
-        )
+        return step
 
     def train_step(self, params, opt_state, xb, yb):
         """
         One optimizer step. With the batch sharded over the data axis and
         params replicated, XLA's SPMD partitioner emits the gradient
-        all-reduce automatically.
+        all-reduce automatically (reduce-scatter/all-gather when the
+        optimizer state is ZeRO-sharded).
         """
         if self._step_fn is None:
-            self._step_fn = self._build_step()
+            rep, bsh = self.replicated, self.batch_sharding
+            osh = self._opt_state_sharding(opt_state)
+            self._step_fn = jax.jit(
+                self._build_step(),
+                in_shardings=(rep, osh, bsh, bsh),
+                out_shardings=(rep, osh, rep),
+                donate_argnums=(0, 1),
+            )
         return self._step_fn(params, opt_state, xb, yb)

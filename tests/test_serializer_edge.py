@@ -1,0 +1,129 @@
+"""
+Serializer edge cases (reference model:
+tests/gordo/serializer/test_serializer_from_definition.py and
+test_serializer_into_definition.py — FeatureUnion, nested estimator params,
+default pruning, dump/load of fitted pipelines).
+"""
+
+import numpy as np
+import pytest
+from sklearn.decomposition import PCA
+from sklearn.pipeline import FeatureUnion, Pipeline
+from sklearn.preprocessing import MinMaxScaler, RobustScaler
+
+from gordo_tpu.serializer import (
+    dump,
+    dumps,
+    from_definition,
+    into_definition,
+    load,
+    load_metadata,
+    loads,
+)
+
+
+def test_feature_union_from_definition():
+    obj = from_definition(
+        {
+            "sklearn.pipeline.FeatureUnion": {
+                "transformer_list": [
+                    {"sklearn.decomposition.PCA": {"n_components": 2}},
+                    "sklearn.preprocessing.MinMaxScaler",
+                ]
+            }
+        }
+    )
+    assert isinstance(obj, FeatureUnion)
+    kinds = [type(t) for _, t in obj.transformer_list]
+    assert kinds == [PCA, MinMaxScaler]
+
+
+def test_feature_union_roundtrip():
+    union = FeatureUnion(
+        [("pca", PCA(n_components=2)), ("scale", MinMaxScaler())]
+    )
+    definition = into_definition(union)
+    rebuilt = from_definition(definition)
+    assert isinstance(rebuilt, FeatureUnion)
+    assert isinstance(rebuilt.transformer_list[0][1], PCA)
+    assert rebuilt.transformer_list[0][1].n_components == 2
+
+
+def test_nested_estimator_param():
+    """A param that is itself a single-key definition dict instantiates."""
+    obj = from_definition(
+        {
+            "gordo_tpu.models.anomaly.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.linear_model.LinearRegression": {}
+                },
+                "scaler": "sklearn.preprocessing.RobustScaler",
+            }
+        }
+    )
+    from sklearn.linear_model import LinearRegression
+
+    assert isinstance(obj.base_estimator, LinearRegression)
+    assert isinstance(obj.scaler, RobustScaler)
+
+
+def test_into_definition_nested_estimator():
+    from sklearn.linear_model import LinearRegression
+
+    from gordo_tpu.models.anomaly import DiffBasedAnomalyDetector
+
+    model = DiffBasedAnomalyDetector(base_estimator=LinearRegression())
+    definition = into_definition(model)
+    rebuilt = from_definition(definition)
+    assert isinstance(rebuilt.base_estimator, LinearRegression)
+
+
+def test_into_definition_prune_defaults():
+    full = into_definition(PCA(n_components=2), prune_default_params=False)
+    pruned = into_definition(PCA(n_components=2), prune_default_params=True)
+    (full_params,) = [v for v in full.values()]
+    (pruned_params,) = [v for v in pruned.values()]
+    assert len(pruned_params) < len(full_params)
+    assert pruned_params == {"n_components": 2}
+
+
+def test_dump_load_fitted_pipeline(tmp_path):
+    X = np.random.default_rng(0).random((30, 4))
+    pipe = Pipeline([("scale", MinMaxScaler()), ("pca", PCA(n_components=2))])
+    pipe.fit(X)
+
+    dump(pipe, tmp_path, metadata={"project": "unit-test"})
+    rebuilt = load(tmp_path)
+    np.testing.assert_allclose(rebuilt.transform(X), pipe.transform(X))
+
+    meta = load_metadata(tmp_path)
+    assert meta["project"] == "unit-test"
+
+
+def test_load_metadata_checks_parent(tmp_path):
+    """Reference serializer.py:69-103: metadata may live one dir up."""
+    X = np.random.default_rng(0).random((10, 2))
+    pipe = Pipeline([("scale", MinMaxScaler())]).fit(X)
+    dump(pipe, tmp_path, metadata={"k": "v"})
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    assert load_metadata(sub)["k"] == "v"
+
+
+def test_dumps_loads_bytes_roundtrip():
+    X = np.random.default_rng(0).random((20, 3))
+    pipe = Pipeline([("scale", RobustScaler())]).fit(X)
+    blob = dumps(pipe)
+    assert isinstance(blob, bytes)
+    rebuilt = loads(blob)
+    np.testing.assert_allclose(rebuilt.transform(X), pipe.transform(X))
+
+
+def test_from_definition_rejects_multi_key_dict():
+    with pytest.raises((ValueError, TypeError)):
+        from_definition(
+            {
+                "sklearn.decomposition.PCA": {},
+                "sklearn.preprocessing.MinMaxScaler": {},
+            }
+        )

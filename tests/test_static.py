@@ -1,0 +1,57 @@
+"""
+Static-health checks — the stand-in for the reference's mypy/pyflakes
+pytest plugins (reference pytest.ini:8-9; neither tool is available in this
+image). Every module must byte-compile and import cleanly, so broken
+imports in rarely-exercised modules fail fast here instead of at runtime.
+"""
+
+import compileall
+import importlib
+import pkgutil
+from pathlib import Path
+
+import gordo_tpu
+
+PACKAGE_ROOT = Path(gordo_tpu.__file__).parent
+
+
+def _iter_module_names():
+    for info in pkgutil.walk_packages([str(PACKAGE_ROOT)], prefix="gordo_tpu."):
+        yield info.name
+
+
+def test_every_module_imports():
+    failures = {}
+    for name in _iter_module_names():
+        try:
+            importlib.import_module(name)
+        except ModuleNotFoundError as exc:
+            # optional-dependency gates (e.g. the influxdb client) are fine
+            # — but a missing gordo_tpu-internal module is always a bug
+            if exc.name and exc.name.startswith("gordo_tpu"):
+                failures[name] = repr(exc)
+        except Exception as exc:  # noqa: BLE001 — collecting all failures
+            failures[name] = repr(exc)
+    assert not failures, f"modules failed to import: {failures}"
+
+
+def test_package_byte_compiles():
+    assert compileall.compile_dir(
+        str(PACKAGE_ROOT), quiet=2, force=False
+    ), "byte-compilation failed"
+
+
+def test_no_module_shadows_stdlib():
+    """Top-level module names must not shadow common stdlib modules."""
+    import sys
+
+    stdlib = set(sys.stdlib_module_names)
+    ours = {
+        p.stem
+        for p in PACKAGE_ROOT.iterdir()
+        if not p.name.startswith("_") and (p.is_dir() or p.suffix == ".py")
+    }
+    # these would break `import logging`-style absolute imports if run
+    # from inside the package directory; keep the namespace clean
+    dangerous = ours & stdlib - {"data"}  # 'data' is not a stdlib module
+    assert not dangerous, f"package dirs shadow stdlib modules: {dangerous}"
