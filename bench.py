@@ -143,6 +143,7 @@ def accelerator_usable(timeout_s: int = 180) -> bool:
     Probe backend init in a subprocess with a hard timeout: a wedged TPU
     tunnel hangs jax.devices() forever, which must degrade to a CPU run
     (with a real JSON line) rather than hang the whole benchmark.
+
     """
     try:
         proc = subprocess.run(
@@ -159,14 +160,16 @@ def accelerator_usable(timeout_s: int = 180) -> bool:
 
 
 def main():
-    # the TPU tunnel can wedge transiently; give it a few chances before
-    # recording a degraded CPU number
+    # the TPU tunnel can wedge transiently (hang OR fail fast mid-restart);
+    # give it a few chances before recording a degraded CPU number. Fast
+    # deterministic failures cost at most 2 x 30s of sleep here, while a
+    # wedged-tunnel hang is already bounded by the probe's own timeout.
     for attempt in range(3):
         if accelerator_usable():
             break
         log(f"accelerator probe attempt {attempt + 1}/3 failed")
         if attempt < 2:
-            time.sleep(60)
+            time.sleep(30)
     else:
         log("falling back to CPU backend")
         import jax

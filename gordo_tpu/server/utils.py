@@ -53,18 +53,22 @@ def dataframe_to_dict(df: pd.DataFrame) -> dict:
     >>> index = pd.date_range('2019-01-01', '2019-02-01', periods=2)
     >>> df = pd.DataFrame(np.arange(8).reshape((2, 4)), columns=columns, index=index)
     >>> pprint.pprint(dataframe_to_dict(df))
-    {'feature0': {'sub-feature-0': {'2019-01-01 00:00:00': 0,
-                                    '2019-02-01 00:00:00': 4},
-                  'sub-feature-1': {'2019-01-01 00:00:00': 1,
-                                    '2019-02-01 00:00:00': 5}},
-     'feature1': {'sub-feature-0': {'2019-01-01 00:00:00': 2,
-                                    '2019-02-01 00:00:00': 6},
-                  'sub-feature-1': {'2019-01-01 00:00:00': 3,
-                                    '2019-02-01 00:00:00': 7}}}
+    {'feature0': {'sub-feature-0': {'2019-01-01T00:00:00': 0,
+                                    '2019-02-01T00:00:00': 4},
+                  'sub-feature-1': {'2019-01-01T00:00:00': 1,
+                                    '2019-02-01T00:00:00': 5}},
+     'feature1': {'sub-feature-0': {'2019-01-01T00:00:00': 2,
+                                    '2019-02-01T00:00:00': 6},
+                  'sub-feature-1': {'2019-01-01T00:00:00': 3,
+                                    '2019-02-01T00:00:00': 7}}}
     """
     data = df.copy()
     if isinstance(data.index, pd.DatetimeIndex):
-        data.index = data.index.astype(str)
+        # explicit ISO-8601 keys: pandas' str() rendering of timestamps
+        # varies across versions (date-only for midnight in pandas 3);
+        # isoformat matches the frame's start/end fields and round-trips
+        # through pd.to_datetime in dataframe_from_dict
+        data.index = pd.Index([t.isoformat() for t in data.index], dtype=object)
     if isinstance(df.columns, pd.MultiIndex):
         return {
             col: (

@@ -1,0 +1,35 @@
+"""
+Run the package's embedded doctests — the reference runs
+``--doctest-modules`` over everything (pytest.ini:6-7); here the modules
+carrying examples are enumerated so optional-dependency-gated modules
+(influx) and TPU-touching ones don't break collection on CPU.
+
+``builder.local_build``'s doctest trains a real model and is covered by
+tests/test_builder.py instead.
+"""
+
+import doctest
+import importlib
+
+import pytest
+
+MODULES = [
+    "gordo_tpu.server.utils",
+    "gordo_tpu.builder.build_model",
+    "gordo_tpu.models.factories.utils",
+    "gordo_tpu.data.filter_rows",
+    "gordo_tpu.workflow.helpers",
+    "gordo_tpu.client.client",
+    "gordo_tpu.client.forwarders",
+    "gordo_tpu.client.utils",
+    "gordo_tpu.utils.compat",
+    "gordo_tpu.reporters.mlflow",
+]
+
+
+@pytest.mark.parametrize("module_name", MODULES)
+def test_module_doctests(module_name):
+    module = importlib.import_module(module_name)
+    results = doctest.testmod(module, verbose=False)
+    assert results.failed == 0, f"{results.failed} doctest failures in {module_name}"
+    assert results.attempted > 0, f"no doctests found in {module_name}"
