@@ -1,0 +1,124 @@
+"""
+Full-system test — the capstone loop the reference spreads across a k8s
+cluster, run in-process (SURVEY.md §4's fake-cluster pattern, fleet
+edition): project YAML -> NormalizedConfig -> FleetModelBuilder (vmap
+bucket training + threshold CV) -> deployment artifact layout -> real WSGI
+server -> real Client.predict over the loopback transport.
+"""
+
+import numpy as np
+import pytest
+import yaml
+
+from gordo_tpu.builder.fleet_build import FleetModelBuilder
+from gordo_tpu.client import Client
+from gordo_tpu.data.providers import RandomDataProvider
+from gordo_tpu.workflow.config_elements.normalized_config import NormalizedConfig
+
+from tests.utils import loopback_session
+
+PROJECT = "system-test"
+REVISION = "1600000000000"
+SENSORS = ["tag-0", "tag-1", "tag-2"]
+
+CONFIG = f"""
+machines:
+{{machines}}
+globals:
+  model:
+    gordo_tpu.models.anomaly.DiffBasedAnomalyDetector:
+      base_estimator:
+        gordo_tpu.models.AutoEncoder:
+          kind: feedforward_hourglass
+          epochs: 2
+  dataset:
+    type: RandomDataset
+    tags: {SENSORS}
+    target_tag_list: {SENSORS}
+    train_start_date: '2019-01-01T00:00:00+00:00'
+    train_end_date: '2019-01-03T00:00:00+00:00'
+    asset: gra
+"""
+
+MACHINE_TPL = "  - name: system-m{i}\n"
+
+
+@pytest.fixture(scope="module")
+def system_collection(tmp_path_factory):
+    """Fleet-build 3 machines and lay out artifacts like a deployment."""
+    config = yaml.safe_load(
+        CONFIG.format(machines="".join(MACHINE_TPL.format(i=i) for i in range(3)))
+    )
+    machines = NormalizedConfig(config, project_name=PROJECT).machines
+    assert len(machines) == 3
+
+    root = tmp_path_factory.mktemp("system") / PROJECT / "models" / REVISION
+    builder = FleetModelBuilder(machines)
+    results = builder.build(output_dir_base=root)
+    assert len(results) == 3
+    return root
+
+
+@pytest.fixture
+def system_server(system_collection, monkeypatch):
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(system_collection))
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.clear_caches()
+    return build_app()
+
+
+def test_fleet_built_artifacts_layout(system_collection):
+    for i in range(3):
+        assert (system_collection / f"system-m{i}" / "model.pkl").is_file()
+        assert (system_collection / f"system-m{i}" / "metadata.json").is_file()
+
+
+def test_client_predicts_whole_fleet(system_server):
+    client = Client(
+        project=PROJECT,
+        host="localhost",
+        port=80,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(system_server),
+        parallelism=3,
+    )
+    machine_names = client.get_machine_names()
+    assert sorted(machine_names) == [f"system-m{i}" for i in range(3)]
+
+    import dateutil.parser
+
+    results = client.predict(
+        start=dateutil.parser.isoparse("2019-01-01T00:00:00+00:00"),
+        end=dateutil.parser.isoparse("2019-01-01T06:00:00+00:00"),
+    )
+    assert len(results) == 3
+    for result in results:
+        name, frame, error_messages = result
+        assert not error_messages, f"{name}: {error_messages}"
+        top = set(frame.columns.get_level_values(0))
+        # the full anomaly schema made it through train -> serve -> client
+        assert {"model-input", "model-output", "total-anomaly-scaled"} <= top
+        assert "anomaly-confidence" in top  # thresholds came from fleet CV
+        assert len(frame) > 0
+        assert np.isfinite(
+            frame["total-anomaly-scaled"].to_numpy().ravel()
+        ).all()
+
+
+def test_fleet_metadata_served(system_server):
+    client = Client(
+        project=PROJECT,
+        host="localhost",
+        port=80,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(system_server),
+    )
+    meta = client.get_metadata()
+    assert set(meta) == {f"system-m{i}" for i in range(3)}
+    for name, machine_meta in meta.items():
+        build_meta = machine_meta.build_metadata
+        assert build_meta.model.model_training_duration_sec is not None
