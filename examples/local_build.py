@@ -6,7 +6,19 @@ of the reference's "Pipelines with Gordo" notebook flow.
 Run: python examples/local_build.py
 """
 
-from gordo_tpu.builder.local_build import local_build
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    # some TPU plugins pin jax_platforms via sitecustomize at interpreter
+    # start, silently overriding the env var — honor it explicitly
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+from gordo_tpu.builder.local_build import local_build  # noqa: E402
 
 CONFIG = """
 machines:

@@ -290,6 +290,43 @@ def test_workflow_generate_tpu_node_pool(runner, tmp_path):
     assert builder["container"]["resources"]["limits"]["google.com/tpu"] == 4
 
 
+def test_workflow_failure_semantics_rendered(runner, project_config_file):
+    """
+    The reference's failure-handling contract (SURVEY.md §5) must survive
+    rendering: retry-with-backoff on every pod template, exceptions report
+    via the pod termination message, stale-workflow cleanup, and probes on
+    the server deployment.
+    """
+    (wf,) = _render_workflows(runner, project_config_file)
+    templates = {t["name"]: t for t in wf["spec"]["templates"]}
+
+    builder = templates["model-fleet-builder"]
+    assert builder["retryStrategy"]["retryPolicy"] == "Always"
+    assert "backoff" in builder["retryStrategy"]
+    env = {e["name"]: e.get("value") for e in builder["container"]["env"]}
+    assert {"MACHINES", "OUTPUT_DIR", "EXCEPTIONS_REPORTER_FILE"} <= set(env)
+    # the exceptions report file IS the k8s termination message
+    # (reference: argo-workflow.yml.template:702-703)
+    assert (
+        builder["container"]["terminationMessagePath"]
+        == env["EXCEPTIONS_REPORTER_FILE"]
+    )
+
+    ensure = templates["ensure-single-workflow"]
+    script = ensure["script"]["source"]
+    # the cleanup logic: finds older-revision Running workflows and deletes
+    assert "kubectl delete" in script
+    assert "project-revision!=" in script
+
+    server = templates["gordo-server-deployment"]
+    (apply_step,) = server["steps"][0]
+    (param,) = apply_step["arguments"]["parameters"]
+    manifest = yaml.safe_load(param["value"])
+    container = manifest["spec"]["template"]["spec"]["containers"][0]
+    assert "livenessProbe" in container
+    assert "readinessProbe" in container
+
+
 def test_workflow_unique_tags(runner, project_config_file, tmp_path):
     out = tmp_path / "tags.txt"
     result = runner.invoke(
