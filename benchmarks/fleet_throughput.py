@@ -15,11 +15,9 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    # the TPU plugin pins jax_platforms via sitecustomize; honor the env var
-    import jax
+from gordo_tpu.utils import honor_jax_platforms_env
 
-    jax.config.update("jax_platforms", "cpu")
+honor_jax_platforms_env()
 
 CONFIG_TPL = """
   - name: fleet-m{i}

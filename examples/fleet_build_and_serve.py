@@ -14,10 +14,9 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    import jax
+from gordo_tpu.utils import honor_jax_platforms_env
 
-    jax.config.update("jax_platforms", "cpu")
+honor_jax_platforms_env()
 
 N_MACHINES = 4
 

@@ -11,12 +11,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-    # some TPU plugins pin jax_platforms via sitecustomize at interpreter
-    # start, silently overriding the env var — honor it explicitly
-    import jax
+from gordo_tpu.utils import honor_jax_platforms_env  # noqa: E402
 
-    jax.config.update("jax_platforms", "cpu")
+honor_jax_platforms_env()
 
 from gordo_tpu.builder.local_build import local_build  # noqa: E402
 

@@ -2,12 +2,17 @@
 Small shared utilities (reference parity: gordo/util/__init__.py:1-3).
 """
 
-from .utils import capture_args, replace_all_non_ascii_chars_with_default
+from .utils import (
+    capture_args,
+    honor_jax_platforms_env,
+    replace_all_non_ascii_chars_with_default,
+)
 from . import disk_registry
 from .compat import normalize_frequency
 
 __all__ = [
     "capture_args",
+    "honor_jax_platforms_env",
     "replace_all_non_ascii_chars_with_default",
     "disk_registry",
     "normalize_frequency",

@@ -41,3 +41,18 @@ def capture_args(init):
 def replace_all_non_ascii_chars_with_default(value: str, default: str = "-") -> str:
     """Replace every non-ASCII character in ``value`` with ``default``."""
     return re.sub(r"[^\x00-\x7F]", default, value)
+
+
+def honor_jax_platforms_env() -> None:
+    """
+    Make ``JAX_PLATFORMS=cpu`` effective even where a TPU plugin pins
+    ``jax_platforms`` via sitecustomize at interpreter start (which silently
+    overrides the env var). Call before any JAX backend initializes; no-op
+    when the env var is unset or JAX is absent.
+    """
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -36,6 +36,27 @@ def test_stacked_data_padding():
     assert float(data.sample_weight[3:].sum()) == 0  # dummy machines
 
 
+def test_scan_unroll_is_pure_layout():
+    """Unrolling the minibatch scan must not change the training math."""
+    import jax
+
+    Xs, ys = make_fleet_data(m=2)
+    data = StackedData.from_ragged(Xs, ys)
+    spec = feedforward_hourglass(n_features=3)
+    results = []
+    for unroll in (1, 4):
+        trainer = FleetTrainer(spec, scan_unroll=unroll)
+        keys = trainer.machine_keys(2)
+        params, losses = trainer.fit(data, keys, epochs=2, batch_size=16)
+        results.append((jax.device_get(params), losses))
+    (p1, l1), (p4, l4) = results
+    # tight tolerance, not bitwise: differently-unrolled programs may fuse
+    # FMAs/reductions differently on accelerator backends
+    np.testing.assert_allclose(l1, l4, rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(p1), jax.tree.leaves(p4)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
 def test_fleet_trainer_unsharded():
     Xs, ys = make_fleet_data(m=3)
     data = StackedData.from_ragged(Xs, ys)
