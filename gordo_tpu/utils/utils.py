@@ -53,6 +53,9 @@ def honor_jax_platforms_env() -> None:
     import os
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        import jax
+        try:
+            import jax
+        except ImportError:
+            return
 
         jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,133 @@
+"""
+Descriptor-validator tests (reference model:
+tests/gordo/machine/test_descriptors.py — per-descriptor accept/reject
+tables, runtime resource fixing).
+"""
+
+import pytest
+
+from gordo_tpu.machine import validators
+
+
+class Holder:
+    """Host class: descriptors must be class attributes."""
+
+    datetime_attr = validators.ValidDatetime()
+    tag_list = validators.ValidTagList()
+    model = validators.ValidModel()
+    metadata = validators.ValidMetadata()
+    url = validators.ValidUrlString()
+    runtime = validators.ValidMachineRuntime()
+
+
+@pytest.mark.parametrize(
+    "value,ok",
+    [
+        ("2019-01-01T00:00:00+00:00", True),
+        ("2019-01-01 00:00:00+01:00", True),
+        ("2019-01-01T00:00:00", False),  # naive: tz required
+        ("not-a-date", False),
+        (123, False),
+    ],
+)
+def test_valid_datetime(value, ok):
+    h = Holder()
+    if ok:
+        h.datetime_attr = value
+        assert h.datetime_attr.tzinfo is not None
+    else:
+        with pytest.raises(ValueError):
+            h.datetime_attr = value
+
+
+@pytest.mark.parametrize(
+    "value,ok",
+    [(["tag-1", "tag-2"], True), ([], False), ("tag-1", False)],
+)
+def test_valid_tag_list(value, ok):
+    h = Holder()
+    if ok:
+        h.tag_list = value
+    else:
+        with pytest.raises(ValueError):
+            h.tag_list = value
+
+
+def test_valid_model_accepts_definition_and_rejects_garbage():
+    h = Holder()
+    h.model = {"sklearn.decomposition.PCA": {"n_components": 2}}
+    with pytest.raises(ValueError):
+        h.model = {"no.such.module.Klass": {}}
+    with pytest.raises(ValueError):
+        h.model = 42
+
+
+@pytest.mark.parametrize(
+    "value,ok",
+    [
+        ({"user": "info"}, True),
+        (None, True),  # unset metadata is valid (reference parity)
+        ([1, 2], False),
+    ],
+)
+def test_valid_metadata(value, ok):
+    h = Holder()
+    if ok:
+        h.metadata = value
+    else:
+        with pytest.raises(ValueError):
+            h.metadata = value
+
+
+@pytest.mark.parametrize(
+    "value,ok",
+    [
+        ("valid-name-here", True),
+        ("a" * 63, True),
+        ("a" * 64, False),  # k8s DNS label limit
+        ("Invalid_Caps", False),
+        ("has space", False),
+        ("-leading-dash", False),
+    ],
+)
+def test_valid_url_string(value, ok):
+    h = Holder()
+    if ok:
+        h.url = value
+    else:
+        with pytest.raises(ValueError):
+            h.url = value
+
+
+def test_fix_resource_limits_bumps_limits_to_requests():
+    fixed = validators.fix_resource_limits(
+        {"requests": {"memory": 4000}, "limits": {"memory": 2000}}
+    )
+    assert fixed["limits"]["memory"] == 4000
+
+    untouched = validators.fix_resource_limits(
+        {"requests": {"memory": 1000}, "limits": {"memory": 2000}}
+    )
+    assert untouched["limits"]["memory"] == 2000
+
+
+def test_fix_resource_limits_rejects_non_int():
+    with pytest.raises(ValueError):
+        validators.fix_resource_limits(
+            {"requests": {"memory": "4Gi"}, "limits": {"memory": 2000}}
+        )
+
+
+def test_valid_runtime_fixes_nested_resources():
+    h = Holder()
+    h.runtime = {
+        "builder": {
+            "resources": {
+                "requests": {"memory": 3000},
+                "limits": {"memory": 1000},
+            }
+        }
+    }
+    assert h.runtime["builder"]["resources"]["limits"]["memory"] == 3000
+    with pytest.raises(ValueError):
+        h.runtime = "not-a-dict"
