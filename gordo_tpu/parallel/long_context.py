@@ -41,12 +41,15 @@ def build_long_context_transformer(
     causal: bool = True,
     attention_impl: str = "ring",
     axis_name: str = SEQ_AXIS,
+    remat: bool = False,
     dtype: Any = jnp.float32,
 ) -> Tuple[TransformerNet, TransformerNet]:
     """
     (sharded, local) twin modules with identical parameter trees: the
     ``local`` twin initializes params and serves single-device inference;
-    the ``sharded`` twin runs inside shard_map for training.
+    the ``sharded`` twin runs inside shard_map for training. ``remat``
+    checkpoints each block on the sharded (training) twin only — inference
+    keeps no backward state, so the local twin never needs it.
     """
     common = dict(
         d_model=d_model,
@@ -59,7 +62,7 @@ def build_long_context_transformer(
         dtype=dtype,
     )
     sharded = TransformerNet(
-        attention_impl=attention_impl, seq_axis=axis_name, **common
+        attention_impl=attention_impl, seq_axis=axis_name, remat=remat, **common
     )
     local = TransformerNet(attention_impl="dense", seq_axis=None, **common)
     return sharded, local
