@@ -598,7 +598,52 @@ def build_app(
             config["PROMETHEUS_REGISTRY"] = prometheus_registry
         else:
             logger.warning("Ignoring non empty prometheus_registry argument")
-    return GordoApp(config)
+    app = GordoApp(config)
+    if config.get("PRELOAD_MODELS", _env_bool("GORDO_SERVER_PRELOAD", False)):
+        _preload_models(app)
+    return app
+
+
+def _preload_models(app: "GordoApp") -> None:
+    """
+    Eagerly load (and thereby jit-warm) every model in the collection.
+
+    The reference lazy-loads per request (server/utils.py:323-343 — "no
+    warmup"); on TPU the first request would then pay device transfer +
+    XLA compile, so ``GORDO_SERVER_PRELOAD=true`` moves that cost to
+    startup, behind the readiness probe instead of a user request.
+    """
+    env_var = app.config["MODEL_COLLECTION_DIR_ENV_VAR"]
+    collection_dir = os.environ.get(env_var)
+    if not collection_dir or not os.path.isdir(collection_dir):
+        logger.warning("PRELOAD_MODELS set but %s is not a directory", env_var)
+        return
+    names = sorted(
+        n
+        for n in os.listdir(collection_dir)
+        if os.path.isdir(os.path.join(collection_dir, n))
+    )
+    # preloading past the model-cache capacity would only churn the LRU
+    capacity = server_utils.load_model.cache_info().maxsize
+    if capacity == 0:
+        logger.warning("PRELOAD_MODELS set but N_CACHED_MODELS=0; skipping")
+        return
+    if capacity is None:  # unbounded cache
+        capacity = len(names)
+    if len(names) > capacity:
+        logger.warning(
+            "Preloading %d of %d models (N_CACHED_MODELS=%d); raise "
+            "N_CACHED_MODELS to warm the whole collection",
+            capacity,
+            len(names),
+            capacity,
+        )
+    for name in names[:capacity]:
+        try:
+            server_utils.load_model(collection_dir, name)
+            logger.info("Preloaded model %s", name)
+        except Exception as exc:  # pragma: no cover - defensive per-model
+            logger.warning("Preload failed for %s: %s", name, exc)
 
 
 def run_server(

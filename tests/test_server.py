@@ -258,6 +258,38 @@ def test_prometheus_enabled_by_env_var(model_collection_env, monkeypatch):
     assert Client(disabled).get("/metrics").status_code == 404
 
 
+def test_preload_models_on_startup(model_collection_env, monkeypatch):
+    """
+    GORDO_SERVER_PRELOAD warms the model cache at build_app time, so the
+    first request doesn't pay load/compile cost (TPU extension; the
+    reference is lazy-per-request by design).
+    """
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.clear_caches()
+    monkeypatch.setenv("GORDO_SERVER_PRELOAD", "true")
+    build_app()
+    info = server_utils.load_model.cache_info()
+    assert info.currsize > 0  # models already resident
+    loads_before = info.misses
+    # a prediction against a preloaded model must hit the cache, not load
+    from werkzeug.test import Client
+
+    client = Client(build_app({"PRELOAD_MODELS": False}))
+    index = pd.date_range("2019-01-01", periods=4, freq="10min", tz="UTC")
+    X = {
+        t: {str(ts): 0.5 for ts in index}
+        for t in SENSORS
+    }
+    resp = client.post(
+        _url(GORDO_PROJECT, GORDO_BASE_TARGETS[0], "prediction"),
+        json={"X": X},
+    )
+    assert resp.status_code == 200
+    assert server_utils.load_model.cache_info().misses == loads_before
+
+
 def test_envoy_prefix_rewrite(gordo_ml_server_client):
     resp = gordo_ml_server_client.get(
         _url(GORDO_PROJECT, "models"),
