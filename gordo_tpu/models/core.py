@@ -40,6 +40,14 @@ logger = logging.getLogger(__name__)
 # attributes never pickled (compiled/jitted/device state)
 _EPHEMERAL_ATTRS = ("_apply_fn", "_train_epoch_fn", "_device_params")
 
+
+def _batch_bucket(n: int, cap: int) -> int:
+    """Smallest power-of-4 >= n, capped at ``cap`` (XLA shape bucketing)."""
+    bucket = 1
+    while bucket < n and bucket < cap:
+        bucket *= 4
+    return min(bucket, cap)
+
 # Default PRNG seed for fits without an explicit ``seed`` kwarg (the builder
 # injects the Machine's evaluation seed into each estimator's kwargs).
 DEFAULT_SEED = 0
@@ -274,7 +282,13 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         return self._apply_fn
 
     def _forward(self, X: np.ndarray, batch_size: int = 10000) -> np.ndarray:
-        """Apply the model to prepared model-inputs (already windowed if needed)."""
+        """
+        Apply the model to prepared model-inputs (already windowed if
+        needed). Each chunk is zero-padded up to a power-of-4 bucket
+        (1, 4, 16, ..., batch_size) so ``jax.jit`` sees a bounded set of
+        shapes — arbitrary request lengths would otherwise each pay an XLA
+        compile; padding rows are sliced off the output.
+        """
         apply_fn = self._ensure_apply_fn()
         params = getattr(self, "_device_params", self.params_)
         if len(X) == 0:
@@ -282,8 +296,14 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
             return np.empty((0, n_out), dtype=np.float32)
         outs = []
         for start in range(0, len(X), batch_size):
-            xb = jnp.asarray(X[start : start + batch_size], dtype=jnp.float32)
-            outs.append(np.asarray(apply_fn(params, xb)))
+            xb_host = np.asarray(X[start : start + batch_size], dtype=np.float32)
+            n = len(xb_host)
+            bucket = _batch_bucket(n, batch_size)
+            if bucket > n:
+                pad_width = ((0, bucket - n),) + ((0, 0),) * (xb_host.ndim - 1)
+                xb_host = np.pad(xb_host, pad_width)
+            out = apply_fn(params, jnp.asarray(xb_host))
+            outs.append(np.asarray(out[:n]))
         return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
     def predict(self, X: np.ndarray, **kwargs) -> np.ndarray:

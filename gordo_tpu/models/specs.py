@@ -59,10 +59,14 @@ _OPTIMIZERS: Dict[str, Callable[..., optax.GradientTransformation]] = {
 _OPT_KWARG_ALIASES = {"lr": "learning_rate", "decay": "weight_decay"}
 
 
-def make_optimizer(
+def resolve_optimizer(
     name: str, optimizer_kwargs: Optional[Dict[str, Any]] = None
-) -> optax.GradientTransformation:
-    """Build an optax optimizer from a Keras-style name + kwargs."""
+) -> Tuple[Callable[..., optax.GradientTransformation], Dict[str, Any]]:
+    """
+    (constructor, normalized kwargs) for a Keras-style optimizer config —
+    alias translation (lr -> learning_rate, ...) and the default learning
+    rate applied. Shared by make_optimizer and the hyperparameter sweep.
+    """
     kwargs = dict(optimizer_kwargs or {})
     for old, new in _OPT_KWARG_ALIASES.items():
         if old in kwargs:
@@ -74,6 +78,14 @@ def make_optimizer(
         raise ValueError(
             f"Unknown optimizer {name!r}; available: {sorted(_OPTIMIZERS)}"
         ) from None
+    return ctor, kwargs
+
+
+def make_optimizer(
+    name: str, optimizer_kwargs: Optional[Dict[str, Any]] = None
+) -> optax.GradientTransformation:
+    """Build an optax optimizer from a Keras-style name + kwargs."""
+    ctor, kwargs = resolve_optimizer(name, optimizer_kwargs)
     return ctor(**kwargs)
 
 

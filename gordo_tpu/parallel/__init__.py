@@ -27,8 +27,11 @@ from .sequence import (
 )
 from .long_context import LongContextTrainer
 from .checkpoint import FleetCheckpointer
+from .sweep import HyperparamSweep, SweepResult
 
 __all__ = [
+    "HyperparamSweep",
+    "SweepResult",
     "get_device_mesh",
     "fleet_sharding",
     "replicated_sharding",

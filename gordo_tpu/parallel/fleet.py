@@ -108,6 +108,15 @@ class FleetTrainer:
         Unroll factor for the per-epoch minibatch ``lax.scan`` — higher
         values let XLA fuse across step boundaries (less loop overhead for
         small cells) at the cost of compile time. 1 = no unrolling.
+    optimizer
+        Optional optax optimizer overriding ``spec.make_optimizer()`` —
+        e.g. an ``optax.inject_hyperparams``-wrapped one whose state
+        carries per-machine hyperparameters (parallel.sweep).
+    broadcast_data
+        When True, all machines train on ONE shared (n, f) dataset
+        (hyperparameter sweeps): ``fit`` takes a single-machine
+        StackedData and the epoch vmaps with ``in_axes=None`` for the
+        data, so device memory holds one copy instead of M.
     """
 
     def __init__(
@@ -117,13 +126,16 @@ class FleetTrainer:
         mesh: Optional[Mesh] = None,
         donate: bool = True,
         scan_unroll: int = 1,
+        optimizer: Optional[Any] = None,
+        broadcast_data: bool = False,
     ):
         self.spec = spec
         self.lookahead = int(lookahead) if spec.windowed else 0
         self.mesh = mesh
         self.donate = donate
         self.scan_unroll = max(1, int(scan_unroll))
-        self._optimizer = spec.make_optimizer()
+        self.broadcast_data = broadcast_data
+        self._optimizer = optimizer if optimizer is not None else spec.make_optimizer()
         self._epoch_fn_cache: dict = {}
 
     # -- setup -----------------------------------------------------------
@@ -155,7 +167,12 @@ class FleetTrainer:
     def shard_data(self, data: StackedData) -> StackedData:
         if self.mesh is None:
             return data
-        sharding = fleet_sharding(self.mesh)
+        # broadcast mode: the one shared dataset is replicated, not split
+        sharding = (
+            replicated_sharding(self.mesh)
+            if self.broadcast_data
+            else fleet_sharding(self.mesh)
+        )
         return StackedData(
             X=jax.device_put(data.X, sharding),
             y=jax.device_put(data.y, sharding),
@@ -251,12 +268,20 @@ class FleetTrainer:
             epoch_loss = jnp.sum(loss_sums) / jnp.maximum(jnp.sum(w_sums), 1.0)
             return params, opt_state, epoch_loss
 
-        fleet_epoch = jax.vmap(machine_epoch)
+        if self.broadcast_data:
+            # one shared dataset; only params/opt/keys carry the fleet axis
+            fleet_epoch = jax.vmap(
+                machine_epoch, in_axes=(0, 0, 0, None, None, None)
+            )
+        else:
+            fleet_epoch = jax.vmap(machine_epoch)
 
         jit_kwargs: dict = {}
         if self.mesh is not None:
             fs = fleet_sharding(self.mesh)
-            jit_kwargs["in_shardings"] = (fs, fs, fs, fs, fs, fs)
+            rs = replicated_sharding(self.mesh)
+            data_sh = rs if self.broadcast_data else fs
+            jit_kwargs["in_shardings"] = (fs, fs, fs, data_sh, data_sh, data_sh)
             jit_kwargs["out_shardings"] = (fs, fs, fs)
         if self.donate:
             jit_kwargs["donate_argnums"] = (0, 1)
@@ -274,12 +299,17 @@ class FleetTrainer:
         batch_size: int = 32,
         shuffle: Optional[bool] = None,
         params: Any = None,
+        opt_state: Any = None,
         extra_weight: Optional[jnp.ndarray] = None,
         checkpointer: Optional[Any] = None,
         checkpoint_every: int = 1,
     ) -> Tuple[Any, np.ndarray]:
         """
         Train the fleet. Returns (stacked params, losses (epochs, M)).
+
+        ``opt_state`` lets callers pre-build/modify the stacked optimizer
+        state (e.g. per-machine hyperparameters via inject_hyperparams);
+        None initializes it fresh from ``params``.
 
         ``extra_weight`` ((M, n), e.g. a CV-fold train mask) multiplies the
         base sample weights — this is how fold training reuses the same
@@ -299,7 +329,8 @@ class FleetTrainer:
 
         if params is None:
             params = self.init_params(keys, data.X.shape[-1])
-        opt_state = self.init_opt_state(params)
+        if opt_state is None:
+            opt_state = self.init_opt_state(params)
         keys = self._shard(jnp.asarray(keys))
 
         start_epoch = 0
@@ -308,12 +339,30 @@ class FleetTrainer:
             start_epoch = done + 1
             logger.info("Resuming fleet fit at epoch %d/%d", start_epoch, epochs)
 
+        if self.broadcast_data:
+            if data.n_machines != 1:
+                raise ValueError(
+                    "broadcast_data expects a single-machine StackedData "
+                    f"(shared by all fleet members), got M={data.n_machines}"
+                )
+            if w.shape[0] != 1:
+                # e.g. a per-machine (M, n) extra_weight: the shared-data
+                # epoch takes ONE weight row; silently using row 0 would
+                # train every member with machine 0's mask
+                raise ValueError(
+                    "broadcast_data cannot take per-machine weights "
+                    f"(got weight shape {w.shape}); weights must be (1, n)"
+                )
+            X_arg, y_arg, w_arg = data.X[0], data.y[0], w[0]
+        else:
+            X_arg, y_arg, w_arg = data.X, data.y, w
+
         epoch_fn = self._epoch_fn(data.n_timesteps, batch_size, shuffle)
         losses = []
         for epoch in range(start_epoch, epochs):
             epoch_keys = jax.vmap(lambda k: jax.random.fold_in(k, epoch))(keys)
             params, opt_state, epoch_loss = epoch_fn(
-                params, opt_state, epoch_keys, data.X, data.y, w
+                params, opt_state, epoch_keys, X_arg, y_arg, w_arg
             )
             # keep the loss on device: a host fetch here would sync every
             # epoch and stall the dispatch pipeline (costly over DCN/tunnel
@@ -327,7 +376,7 @@ class FleetTrainer:
             checkpointer.wait()
         if losses:
             return params, np.stack(jax.device_get(losses))
-        return params, np.zeros((0, data.n_machines))
+        return params, np.zeros((0, len(keys)))
 
     def predict(self, params: Any, X: jnp.ndarray, batch_size: int = 8192) -> np.ndarray:
         """

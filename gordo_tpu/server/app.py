@@ -640,10 +640,49 @@ def _preload_models(app: "GordoApp") -> None:
         )
     for name in names[:capacity]:
         try:
-            server_utils.load_model(collection_dir, name)
-            logger.info("Preloaded model %s", name)
+            model = server_utils.load_model(collection_dir, name)
+            warmed = _warm_model(model)
+            logger.info(
+                "Preloaded model %s%s", name, "" if warmed else " (no warmup)"
+            )
         except Exception as exc:  # pragma: no cover - defensive per-model
             logger.warning("Preload failed for %s: %s", name, exc)
+
+
+def _unwrap_estimators(model) -> typing.Iterable[typing.Any]:
+    """model, then recursively base_estimator / pipeline steps."""
+    yield model
+    base = getattr(model, "base_estimator", None)
+    if base is not None and base is not model:
+        yield from _unwrap_estimators(base)
+    for _, step in getattr(model, "steps", []) or []:
+        yield from _unwrap_estimators(step)
+
+
+def _warm_model(model) -> bool:
+    """
+    Run one dummy forward so device transfer + XLA compile happen NOW:
+    unpickled estimators hold host params and rebuild their jitted apply on
+    first use (models/core.py _ensure_apply_fn) — without this, preload
+    would only warm the unpickle, not the first-request latency.
+    """
+    n_features = lookback = None
+    for est in _unwrap_estimators(model):
+        n_features = n_features or getattr(est, "n_features_", None)
+        lb = getattr(est, "lookback_window", None)
+        lookback = lookback or (int(lb) if lb else None)
+    if not n_features:
+        return False
+    # 255 + lookback rows lands in the 256-row jit bucket (core.py
+    # _batch_bucket), the shape small/typical requests pad to — so the
+    # compile this triggers is the one real traffic will reuse
+    rows = 255 + max(lookback or 1, 1)
+    try:
+        model_io.get_model_output(model, np.zeros((rows, n_features), "float32"))
+        return True
+    except Exception as exc:
+        logger.debug("Warmup forward failed: %s", exc)
+        return False
 
 
 def run_server(

@@ -1,0 +1,68 @@
+"""
+Click parameter-type tests (reference model: custom param types exercised
+in tests/gordo/cli/test_cli.py — provider-from-JSON/YAML/file, ISO
+datetimes, host IPs, key,value pairs).
+"""
+
+import click
+import pytest
+
+from gordo_tpu.cli.custom_types import (
+    DataProviderParam,
+    HostIP,
+    IsoFormatDateTime,
+    key_value_par,
+)
+from gordo_tpu.data.providers import RandomDataProvider
+
+
+def test_data_provider_from_inline_json():
+    provider = DataProviderParam().convert(
+        '{"type": "RandomDataProvider", "min_size": 50, "max_size": 51}',
+        None,
+        None,
+    )
+    assert isinstance(provider, RandomDataProvider)
+    assert provider.min_size == 50
+
+
+def test_data_provider_from_yaml_file(tmp_path):
+    path = tmp_path / "provider.yaml"
+    path.write_text("type: RandomDataProvider\nmax_size: 120\n")
+    provider = DataProviderParam().convert(str(path), None, None)
+    assert isinstance(provider, RandomDataProvider)
+    assert provider.max_size == 120
+
+
+def test_data_provider_requires_type():
+    with pytest.raises(click.exceptions.UsageError):
+        DataProviderParam().convert('{"min_size": 10}', None, None)
+
+
+def test_data_provider_unknown_type():
+    with pytest.raises(click.exceptions.UsageError):
+        DataProviderParam().convert('{"type": "NoSuchProvider"}', None, None)
+
+
+def test_iso_datetime():
+    dt = IsoFormatDateTime().convert("2020-01-01T12:30:00+00:00", None, None)
+    assert dt.hour == 12
+    assert dt.tzinfo is not None
+    with pytest.raises(click.exceptions.UsageError):
+        IsoFormatDateTime().convert("not-a-date", None, None)
+
+
+@pytest.mark.parametrize("value,ok", [("127.0.0.1", True), ("::1", True), ("nope", False)])
+def test_host_ip(value, ok):
+    if ok:
+        assert HostIP().convert(value, None, None) == value
+    else:
+        with pytest.raises(click.exceptions.UsageError):
+            HostIP().convert(value, None, None)
+
+
+def test_key_value_par():
+    assert key_value_par("a,b") == ("a", "b")
+    assert key_value_par("a,b,c") == ("a", "b,c")  # split once
+    with pytest.raises(click.BadParameter):
+        key_value_par("no-comma")

@@ -64,6 +64,28 @@ def test_autoencoder_fit_predict(kind):
     assert isinstance(score, float)
 
 
+def test_forward_shape_bucketing_identical_outputs():
+    """
+    _forward pads chunks to power-of-4 buckets for jit shape stability;
+    padding rows must never leak into outputs.
+    """
+    from gordo_tpu.models.core import _batch_bucket
+
+    assert [_batch_bucket(n, 10000) for n in (1, 2, 4, 5, 16, 17, 300)] == [
+        1, 4, 4, 16, 16, 64, 1024,
+    ]
+    assert _batch_bucket(20000, 10000) == 10000
+
+    model = AutoEncoder(kind="feedforward_hourglass", epochs=1)
+    X = np.random.default_rng(0).random((300, 4))
+    model.fit(X, X)
+    full = model.predict(X)
+    assert full.shape == (300, 4)
+    # a shorter slice (different bucket) must agree row-for-row
+    np.testing.assert_allclose(model.predict(X[:5]), full[:5], rtol=1e-5)
+    np.testing.assert_allclose(model.predict(X[:17]), full[:17], rtol=1e-5)
+
+
 def test_autoencoder_unknown_kind():
     with pytest.raises(ValueError):
         AutoEncoder(kind="no_such_kind")

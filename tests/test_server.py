@@ -272,6 +272,18 @@ def test_preload_models_on_startup(model_collection_env, monkeypatch):
     build_app()
     info = server_utils.load_model.cache_info()
     assert info.currsize > 0  # models already resident
+
+    # warmup ran a dummy forward: the jitted apply fn is already built on
+    # at least one preloaded jax estimator (it is rebuilt lazily after
+    # unpickle, so without warmup it would be None until the first request)
+    from gordo_tpu.server.app import _unwrap_estimators
+
+    collection = os.environ["MODEL_COLLECTION_DIR"]
+    model = server_utils.load_model(collection, GORDO_BASE_TARGETS[0])
+    assert any(
+        getattr(est, "_apply_fn", None) is not None
+        for est in _unwrap_estimators(model)
+    )
     loads_before = info.misses
     # a prediction against a preloaded model must hit the cache, not load
     from werkzeug.test import Client
