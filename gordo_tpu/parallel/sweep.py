@@ -68,7 +68,14 @@ class HyperparamSweep:
         (self.n_variants,) = lengths
         if self.n_variants == 0:
             raise ValueError("grid value lists are empty")
-        self.grid = {k: [float(x) for x in v] for k, v in grid.items()}
+        from gordo_tpu.models.specs import _OPT_KWARG_ALIASES
+
+        # accept the reference dialect's spellings ("lr", "decay") the same
+        # way optimizer_kwargs does
+        self.grid = {
+            _OPT_KWARG_ALIASES.get(k, k): [float(x) for x in v]
+            for k, v in grid.items()
+        }
         self.spec = spec
         # even shardings need the variant axis padded to the mesh size;
         # padding variants reuse the last grid values and are dropped from

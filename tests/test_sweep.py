@@ -91,6 +91,16 @@ def test_sweep_over_mesh(n_variants):
     assert jax.tree_util.tree_leaves(best)[0].ndim >= 1
 
 
+def test_sweep_grid_accepts_keras_alias():
+    """Grid keys in the reference dialect ('lr') normalize too."""
+    spec = feedforward_hourglass(n_features=F)
+    sweep = HyperparamSweep(spec, {"lr": [1e-4, 1e-3]})
+    assert "learning_rate" in sweep.grid
+    result = sweep.fit(_data(), epochs=2, batch_size=32)
+    assert result.losses.shape == (2, 2)
+    assert "learning_rate" in result.best_hyperparams
+
+
 def test_sweep_keras_style_optimizer_kwargs():
     """Reference-dialect configs use 'lr'; the sweep must normalize it."""
     spec = feedforward_hourglass(
