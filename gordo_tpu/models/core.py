@@ -41,12 +41,16 @@ logger = logging.getLogger(__name__)
 _EPHEMERAL_ATTRS = ("_apply_fn", "_train_epoch_fn", "_device_params")
 
 
-def _batch_bucket(n: int, cap: int) -> int:
-    """Smallest power-of-4 >= n, capped at ``cap`` (XLA shape bucketing)."""
+def _batch_bucket(n: int, cap: Optional[int] = None, base: int = 4) -> int:
+    """
+    Smallest power of ``base`` >= n, optionally capped (XLA shape
+    bucketing). base=4 bounds compiles hardest (<=4x padded compute);
+    base=2 halves the padding waste at twice the distinct shapes.
+    """
     bucket = 1
-    while bucket < n and bucket < cap:
-        bucket *= 4
-    return min(bucket, cap)
+    while bucket < n and (cap is None or bucket < cap):
+        bucket *= base
+    return bucket if cap is None else min(bucket, cap)
 
 # Default PRNG seed for fits without an explicit ``seed`` kwarg (the builder
 # injects the Machine's evaluation seed into each estimator's kwargs).

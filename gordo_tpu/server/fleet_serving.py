@@ -21,10 +21,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from gordo_tpu.models.core import BaseJaxEstimator
+from gordo_tpu.models.core import BaseJaxEstimator, _batch_bucket
 from gordo_tpu.ops.windowing import num_windows, window_sample_indices
 
 logger = logging.getLogger(__name__)
+
+
+def _pow2_bucket(n: int, cap: Optional[int] = None) -> int:
+    """Smallest power of two >= n (jit shape bucketing, <=2x padding)."""
+    return _batch_bucket(n, cap, base=2)
 
 
 def _group_key(est: BaseJaxEstimator) -> Tuple:
@@ -90,8 +95,9 @@ class FleetScorer:
         """
         Model outputs for each named machine. ``inputs[name]`` is the
         machine's (already host-transformed) model input, shape
-        (n_rows, n_features); rows may differ per machine — shorter
-        machines are zero-padded to the group's max and sliced back.
+        (n_rows, n_features); rows may differ per machine — machines are
+        zero-padded to the power-of-two bucket above the group's max (so
+        jit sees bounded shapes) and sliced back.
         """
         missing = set(inputs) - set(self.names)
         if missing:
@@ -121,7 +127,10 @@ class FleetScorer:
             }
 
         n_rows = {name: len(x) for name, x in prepared.items()}
-        max_rows = max(n_rows.values())
+        # bucket BOTH varying axes so jit sees a bounded set of shapes:
+        # rows to the next power of two (<=2x padded compute beats a
+        # per-request XLA compile), machines likewise capped at group size
+        max_rows = _pow2_bucket(max(n_rows.values()))
         batch = np.stack(
             [
                 np.pad(x, [(0, max_rows - len(x))] + [(0, 0)] * (x.ndim - 1))
@@ -129,13 +138,31 @@ class FleetScorer:
             ]
         )
 
-        # gather only for true subsets — the common full-group case reuses
-        # the resident stack without copying any param leaves
-        if names == group["names"]:
+        group_size = len(group["names"])
+        m_bucket = min(_pow2_bucket(len(names)), group_size)
+        if names == group["names"] or m_bucket == group_size:
+            # full group, or a subset whose bucket rounds up to it: scatter
+            # inputs into group positions (zeros for absent machines) and
+            # reuse the resident stack — no param leaves are copied
             params = group["params"]
-        else:
-            sel = np.asarray([group["names"].index(n) for n in names], dtype=np.int32)
-            params = jax.tree_util.tree_map(lambda leaf: leaf[sel], group["params"])
+            row_index = {n: i for i, n in enumerate(group["names"])}
+            full = np.zeros((group_size,) + batch.shape[1:], dtype=batch.dtype)
+            for i, name in enumerate(names):
+                full[row_index[name]] = batch[i]
+            outputs = np.asarray(group["apply"](params, jnp.asarray(full)))
+            return {
+                name: outputs[row_index[name], : n_rows[name]] for name in names
+            }
+        # small subset: gather just those machines' params, padded with
+        # dummy repeats to the machine bucket (sliced off below)
+        sel = [group["names"].index(n) for n in names]
+        sel += [sel[0]] * (m_bucket - len(sel))
+        sel = np.asarray(sel, dtype=np.int32)
+        params = jax.tree_util.tree_map(lambda leaf: leaf[sel], group["params"])
+        if len(batch) < m_bucket:
+            batch = np.pad(
+                batch, [(0, m_bucket - len(batch))] + [(0, 0)] * (batch.ndim - 1)
+            )
         outputs = np.asarray(group["apply"](params, jnp.asarray(batch)))
         return {name: outputs[i, : n_rows[name]] for i, name in enumerate(names)}
 

@@ -39,6 +39,37 @@ def test_scorer_matches_per_model_predict():
         )
 
 
+def test_scorer_subset_request_matches_per_model():
+    """
+    A strict-subset request gathers params (padded to a power-of-2 machine
+    bucket with dummy repeats) — outputs must still match per-model
+    predict, and dummies must be sliced off.
+    """
+    models = {
+        f"s{i}": _train(
+            AutoEncoder, kind="feedforward_hourglass", epochs=1, seed=i
+        )
+        for i in range(5)
+    }
+    scorer = FleetScorer(models)
+    # 3 of 5 machines -> machine bucket 4 < group size: gather path
+    X = {name: RNG.random((11, 4)).astype("float32") for name in ["s0", "s2", "s4"]}
+    batched = scorer.predict(X)
+    assert set(batched) == {"s0", "s2", "s4"}
+    for name in batched:
+        np.testing.assert_allclose(
+            batched[name], models[name].predict(X[name]), rtol=1e-5, atol=1e-6
+        )
+    # 4 of 5 -> bucket rounds to group size: scatter path, params not copied
+    X4 = {name: RNG.random((9, 4)).astype("float32") for name in ["s0", "s1", "s2", "s3"]}
+    batched4 = scorer.predict(X4)
+    assert set(batched4) == set(X4)
+    for name in batched4:
+        np.testing.assert_allclose(
+            batched4[name], models[name].predict(X4[name]), rtol=1e-5, atol=1e-6
+        )
+
+
 def test_scorer_windowed_and_ragged_lengths():
     models = {
         f"w{i}": _train(
