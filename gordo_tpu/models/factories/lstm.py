@@ -27,9 +27,14 @@ def lstm_model(
     optimizer_kwargs: Dict[str, Any] = dict(),
     compile_kwargs: Dict[str, Any] = dict(),
     dtype: Union[str, Any] = "float32",
+    fused: bool = False,
     **kwargs,
 ) -> ModelSpec:
-    """Stacked LSTM encoder/decoder with a Dense head on the last timestep."""
+    """
+    Stacked LSTM encoder/decoder with a Dense head on the last timestep.
+    ``fused=True`` hoists input projections out of the time scan
+    (specs.FusedLSTMLayer) — same math, TPU-friendlier schedule.
+    """
     n_features_out = n_features_out or n_features
     check_dim_func_len("encoding", encoding_dim, encoding_func)
     check_dim_func_len("decoding", decoding_dim, decoding_func)
@@ -39,6 +44,7 @@ def lstm_model(
         layer_funcs=tuple(encoding_func) + tuple(decoding_func),
         out_dim=n_features_out,
         out_func=out_func,
+        fused=fused,
         dtype=resolve_dtype(dtype),
     )
     return ModelSpec(

@@ -18,6 +18,7 @@ import dataclasses
 from typing import Any, Callable, Dict, Optional, Sequence, Tuple
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -153,30 +154,93 @@ class FeedForwardNet(nn.Module):
         return resolve_activation(self.out_func)(x).astype(jnp.float32), penalty
 
 
+class FusedLSTMLayer(nn.Module):
+    """
+    LSTM layer with the input projection hoisted OUT of the time scan: the
+    x@W_[ifgo] matmul for the whole sequence runs as one (batch*time, f) x
+    (f, 4h) product (MXU-sized), and the scan carries only the recurrent
+    h@W_h matmul. Same math as ``nn.RNN(OptimizedLSTMCell)`` — gate order
+    [i, f, g, o], sigmoid gates, ``activation_fn`` on g and the cell
+    output — with a TPU-friendlier schedule.
+    """
+
+    features: int
+    activation_fn: Any = jnp.tanh
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):  # x: (batch, time, f)
+        h_dim = self.features
+        # one big matmul over the full sequence (no bias: the recurrent
+        # projection's bias covers it, as in OptimizedLSTMCell)
+        z = nn.Dense(
+            4 * h_dim, use_bias=False, dtype=self.dtype, name="input_proj"
+        )(x)
+        w_h = self.param(
+            "recurrent_kernel",
+            nn.initializers.orthogonal(),
+            (h_dim, 4 * h_dim),
+            jnp.float32,
+        ).astype(self.dtype)
+        b_h = self.param(
+            "recurrent_bias", nn.initializers.zeros_init(), (4 * h_dim,), jnp.float32
+        ).astype(self.dtype)
+        act = self.activation_fn
+
+        def step(carry, z_t):
+            c, h = carry
+            # matmul in self.dtype (MXU); gate math + cell state in float32,
+            # matching OptimizedLSTMCell's float32 (param_dtype) carry
+            gates = (z_t + h.astype(self.dtype) @ w_h + b_h).astype(jnp.float32)
+            i, f, g, o = jnp.split(gates, 4, axis=-1)
+            i, f, o = nn.sigmoid(i), nn.sigmoid(f), nn.sigmoid(o)
+            c = f * c + i * act(g)
+            h = o * act(c)
+            return (c, h), h
+
+        batch = x.shape[0]
+        carry0 = (
+            jnp.zeros((batch, h_dim), dtype=jnp.float32),
+            jnp.zeros((batch, h_dim), dtype=jnp.float32),
+        )
+        _, hs = jax.lax.scan(step, carry0, z.swapaxes(0, 1))
+        return hs.swapaxes(0, 1).astype(self.dtype)
+
+
 class LSTMNet(nn.Module):
     """
     Stacked LSTM -> Dense head (reference shape:
     factories/lstm_autoencoder.py:17-103): every LSTM layer emits its full
     sequence to the next; the Dense head reads the final layer's last
     timestep — identical math to Keras' return_sequences=False on the last
-    recurrent layer.
+    recurrent layer. ``fused=True`` swaps each layer for FusedLSTMLayer
+    (input projections hoisted out of the scan; different param tree, so
+    choose it at model definition time).
     """
 
     layer_dims: Tuple[int, ...]
     layer_funcs: Tuple[str, ...]
     out_dim: int
     out_func: str = "linear"
+    fused: bool = False
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):  # x: (batch, time, features)
         for dim, func in zip(self.layer_dims, self.layer_funcs):
-            cell = nn.OptimizedLSTMCell(
-                dim,
-                activation_fn=resolve_activation(func),
-                dtype=self.dtype,
-            )
-            x = nn.RNN(cell)(x)
+            if self.fused:
+                x = FusedLSTMLayer(
+                    dim,
+                    activation_fn=resolve_activation(func),
+                    dtype=self.dtype,
+                )(x)
+            else:
+                cell = nn.OptimizedLSTMCell(
+                    dim,
+                    activation_fn=resolve_activation(func),
+                    dtype=self.dtype,
+                )
+                x = nn.RNN(cell)(x)
         x = x[:, -1, :]
         x = nn.Dense(self.out_dim, dtype=self.dtype)(x)
         return resolve_activation(self.out_func)(x).astype(jnp.float32), jnp.asarray(

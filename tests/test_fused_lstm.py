@@ -1,0 +1,103 @@
+"""
+FusedLSTMLayer parity: the hoisted-input-projection LSTM must compute
+exactly what nn.RNN(OptimizedLSTMCell) computes when given the same
+weights (gate order [i, f, g, o]), and train end-to-end through the
+standard estimator machinery.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from gordo_tpu.models import LSTMAutoEncoder
+from gordo_tpu.models.specs import LSTMNet
+
+B, T, F, H = 3, 7, 5, 8
+
+
+def _map_cell_params_to_fused(cell_params):
+    """OptimizedLSTMCell's i/f/g/o denses -> fused concatenated layout."""
+    p = cell_params
+    input_kernel = jnp.concatenate(
+        [p["ii"]["kernel"], p["if"]["kernel"], p["ig"]["kernel"], p["io"]["kernel"]],
+        axis=1,
+    )
+    recurrent_kernel = jnp.concatenate(
+        [p["hi"]["kernel"], p["hf"]["kernel"], p["hg"]["kernel"], p["ho"]["kernel"]],
+        axis=1,
+    )
+    recurrent_bias = jnp.concatenate(
+        [p["hi"]["bias"], p["hf"]["bias"], p["hg"]["bias"], p["ho"]["bias"]]
+    )
+    return input_kernel, recurrent_kernel, recurrent_bias
+
+
+def test_fused_layer_matches_optimized_cell():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, T, F)), jnp.float32)
+
+    plain = LSTMNet(layer_dims=(H,), layer_funcs=("tanh",), out_dim=F)
+    fused = LSTMNet(layer_dims=(H,), layer_funcs=("tanh",), out_dim=F, fused=True)
+
+    plain_params = plain.init(jax.random.PRNGKey(0), x)
+    fused_params = fused.init(jax.random.PRNGKey(0), x)
+
+    # copy the cell's weights into the fused layout (+ shared head)
+    cell_params = plain_params["params"]["OptimizedLSTMCell_0"]
+    ik, rk, rb = _map_cell_params_to_fused(cell_params)
+    fused_params = jax.tree_util.tree_map(lambda a: a, fused_params)  # copy
+    fp = fused_params["params"]
+    fp["FusedLSTMLayer_0"]["input_proj"]["kernel"] = ik
+    fp["FusedLSTMLayer_0"]["recurrent_kernel"] = rk
+    fp["FusedLSTMLayer_0"]["recurrent_bias"] = rb
+    fp["Dense_0"] = plain_params["params"]["Dense_0"]
+
+    out_plain, _ = plain.apply(plain_params, x)
+    out_fused, _ = fused.apply(fused_params, x)
+    np.testing.assert_allclose(out_fused, out_plain, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_stacked_layers_match():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(B, T, F)), jnp.float32)
+    dims, funcs = (H, 4), ("tanh", "relu")
+
+    plain = LSTMNet(layer_dims=dims, layer_funcs=funcs, out_dim=2)
+    fused = LSTMNet(layer_dims=dims, layer_funcs=funcs, out_dim=2, fused=True)
+    plain_params = plain.init(jax.random.PRNGKey(0), x)
+    fused_params = fused.init(jax.random.PRNGKey(0), x)
+
+    fp = fused_params["params"]
+    for i in range(len(dims)):
+        cell = plain_params["params"][f"OptimizedLSTMCell_{i}"]
+        ik, rk, rb = _map_cell_params_to_fused(cell)
+        fp[f"FusedLSTMLayer_{i}"]["input_proj"]["kernel"] = ik
+        fp[f"FusedLSTMLayer_{i}"]["recurrent_kernel"] = rk
+        fp[f"FusedLSTMLayer_{i}"]["recurrent_bias"] = rb
+    fp["Dense_0"] = plain_params["params"]["Dense_0"]
+
+    out_plain, _ = plain.apply(plain_params, x)
+    out_fused, _ = fused.apply(fused_params, x)
+    np.testing.assert_allclose(out_fused, out_plain, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_estimator_trains_and_pickles():
+    import pickle
+
+    rng = np.random.default_rng(2)
+    X = rng.random((80, F)).astype("float32")
+    model = LSTMAutoEncoder(
+        kind="lstm_model",
+        lookback_window=6,
+        encoding_dim=(8,),
+        encoding_func=("tanh",),
+        decoding_dim=(8,),
+        decoding_func=("tanh",),
+        fused=True,
+        epochs=2,
+    )
+    model.fit(X, X)
+    out = model.predict(X)
+    assert out.shape == (80 - 6 + 1, F)
+    clone = pickle.loads(pickle.dumps(model))
+    np.testing.assert_allclose(clone.predict(X), out, rtol=1e-5)

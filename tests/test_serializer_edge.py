@@ -127,3 +127,20 @@ def test_from_definition_rejects_multi_key_dict():
                 "sklearn.preprocessing.MinMaxScaler": {},
             }
         )
+
+
+def test_function_transformer_funcs_in_config():
+    """transformer_funcs are reachable via FunctionTransformer configs."""
+    import numpy as np
+
+    pipe = from_definition(
+        {
+            "sklearn.preprocessing.FunctionTransformer": {
+                "func": "gordo_tpu.models.transformer_funcs.general.multiply_by",
+                "kw_args": {"factor": 2},
+            }
+        }
+    )
+    np.testing.assert_array_equal(
+        pipe.transform(np.array([[1.0, 2.0]])), [[2.0, 4.0]]
+    )
