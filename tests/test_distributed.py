@@ -1,0 +1,82 @@
+"""
+Multi-host initialization tests (single-host behaviors: the no-op guard,
+env-var detection gate, global mesh, topology snapshot). True multi-process
+init needs multiple hosts; what can regress silently on one host is the
+single-host no-op path and the env sniffing, tested here.
+"""
+
+from gordo_tpu.parallel import distributed
+from gordo_tpu.parallel.mesh import FLEET_AXIS
+
+
+def test_initialize_noop_single_host(monkeypatch):
+    for var in (
+        "COORDINATOR_ADDRESS",
+        "JAX_COORDINATOR_ADDRESS",
+        "MEGASCALE_COORDINATOR_ADDRESS",
+        "TPU_WORKER_HOSTNAMES",
+    ):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(distributed, "_initialized", False)
+
+    called = []
+    monkeypatch.setattr(
+        distributed.jax.distributed,
+        "initialize",
+        lambda **kw: called.append(kw),
+    )
+    distributed.initialize()
+    assert called == []  # single host -> no-op
+    assert distributed._initialized is False
+
+
+def test_initialize_triggered_by_env(monkeypatch):
+    monkeypatch.setenv("COORDINATOR_ADDRESS", "10.0.0.1:8476")
+    monkeypatch.setattr(distributed, "_initialized", False)
+    called = []
+    monkeypatch.setattr(
+        distributed.jax.distributed,
+        "initialize",
+        lambda **kw: called.append(kw),
+    )
+    distributed.initialize()
+    assert len(called) == 1
+    assert distributed._initialized is True
+
+    # second call is a no-op (already initialized)
+    distributed.initialize()
+    assert len(called) == 1
+
+
+def test_initialize_explicit_args(monkeypatch):
+    monkeypatch.setattr(distributed, "_initialized", False)
+    called = []
+    monkeypatch.setattr(
+        distributed.jax.distributed,
+        "initialize",
+        lambda **kw: called.append(kw),
+    )
+    distributed.initialize(
+        coordinator_address="host:1234", num_processes=4, process_id=2
+    )
+    assert called == [
+        {
+            "coordinator_address": "host:1234",
+            "num_processes": 4,
+            "process_id": 2,
+        }
+    ]
+
+
+def test_global_mesh_spans_devices():
+    mesh = distributed.global_mesh()
+    assert mesh.devices.size == 8  # the virtual CPU mesh
+    assert mesh.axis_names == (FLEET_AXIS,)
+
+
+def test_process_info_single_host():
+    info = distributed.process_info()
+    assert info["process_index"] == 0
+    assert info["process_count"] == 1
+    assert info["global_device_count"] == 8
+    assert info["local_device_count"] == 8
