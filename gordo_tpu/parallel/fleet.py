@@ -137,6 +137,7 @@ class FleetTrainer:
         self.broadcast_data = broadcast_data
         self._optimizer = optimizer if optimizer is not None else spec.make_optimizer()
         self._epoch_fn_cache: dict = {}
+        self._predict_fn_cache: dict = {}
 
     # -- setup -----------------------------------------------------------
     def machine_keys(self, n_machines: int, seed: int = 0) -> jnp.ndarray:
@@ -383,21 +384,60 @@ class FleetTrainer:
         Fleet forward pass. X: (M, n, f) ->
         (M, n_out, f_out) where n_out = n - lookback + 1 - lookahead for
         windowed models, else n.
+
+        For windowed models with more than ``batch_size`` windows per
+        machine, windows are materialized in ``batch_size`` chunks inside
+        the program (``lax.map``), bounding the gather's HBM footprint to
+        (batch_size, lookback, f) per machine instead of (n, lookback, f).
         """
+        X = jnp.asarray(X)
+        n = X.shape[1]
+        fn = self._predict_fn(n, batch_size)
+        return np.asarray(fn(params, X))
+
+    def _predict_fn(self, n: int, batch_size: int):
+        """Build (and cache) the jitted fleet forward for a geometry."""
+        from gordo_tpu.ops.windowing import num_windows, window_sample_indices
+
         spec = self.spec
         lb = spec.lookback_window if spec.windowed else 1
         la = self.lookahead
-        n = X.shape[1]
+        if batch_size < 1:
+            raise ValueError(f"batch_size must be >= 1, got {batch_size}")
+        # the direct (un-chunked) program is independent of batch_size, so
+        # all large-enough batch_sizes share one cache entry
+        chunked = spec.windowed and num_windows(n, lb, la) > batch_size
+        cache_key = (n, batch_size if chunked else None)
+        if cache_key in self._predict_fn_cache:
+            return self._predict_fn_cache[cache_key]
 
         if spec.windowed:
-            n_out = n - lb + 1 - la
-            starts = jnp.arange(n_out, dtype=jnp.int32)
-            rows = starts[:, None] + jnp.arange(lb, dtype=jnp.int32)[None, :]
+            rows_np = window_sample_indices(n, lb, la)  # (n_out, lb)
+            n_out = len(rows_np)
+            if not chunked:
+                rows = jnp.asarray(rows_np)
 
-            def one(p, Xi):
-                windows = Xi[rows]  # (n_out, lb, f)
-                out, _ = spec.module.apply(p, windows)
-                return out
+                def one(p, Xi):
+                    out, _ = spec.module.apply(p, Xi[rows])  # (n_out, lb, f)
+                    return out
+
+            else:
+                offs = jnp.arange(lb, dtype=jnp.int32)[None, :]
+                n_chunks = math.ceil(n_out / batch_size)
+                n_pad = n_chunks * batch_size
+                starts = np.zeros(n_pad, dtype=np.int32)
+                starts[:n_out] = np.arange(n_out, dtype=np.int32)
+                chunked_starts = jnp.asarray(
+                    starts.reshape(n_chunks, batch_size)
+                )
+
+                def one(p, Xi):
+                    def do_chunk(sel):
+                        out, _ = spec.module.apply(p, Xi[sel[:, None] + offs])
+                        return out
+
+                    outs = jax.lax.map(do_chunk, chunked_starts)
+                    return outs.reshape(n_pad, *outs.shape[2:])[:n_out]
 
         else:
             def one(p, Xi):
@@ -412,7 +452,8 @@ class FleetTrainer:
             )
         else:
             fleet_apply = jax.jit(fleet_apply)
-        return np.asarray(fleet_apply(params, jnp.asarray(X)))
+        self._predict_fn_cache[cache_key] = fleet_apply
+        return fleet_apply
 
     @staticmethod
     def unstack_params(params: Any, index: int) -> Any:

@@ -125,6 +125,30 @@ def test_fleet_windowed_lstm():
     assert preds.shape == (2, 60 - 5 + 1, 3)
 
 
+def test_fleet_predict_chunked_matches_direct():
+    """Chunked windowed predict (n_out > batch_size) equals the direct path."""
+    from gordo_tpu.models.factories.lstm import lstm_model
+
+    Xs, ys = make_fleet_data(m=2, n=60)
+    data = StackedData.from_ragged(Xs, ys)
+    spec = lstm_model(n_features=3, lookback_window=5)
+    trainer = FleetTrainer(spec, lookahead=0)
+    keys = trainer.machine_keys(2)
+    params, _ = trainer.fit(data, keys, epochs=1, batch_size=16)
+    direct = trainer.predict(params, data.X)  # 56 windows <= default chunk
+    chunked = trainer.predict(params, data.X, batch_size=9)  # 7 chunks, padded
+    np.testing.assert_allclose(chunked, direct, rtol=1e-6, atol=1e-7)
+    # compiled programs are cached per geometry, not rebuilt per call
+    assert len(trainer._predict_fn_cache) == 2
+    trainer.predict(params, data.X, batch_size=9)
+    assert len(trainer._predict_fn_cache) == 2
+    # direct-path programs don't depend on batch_size: one shared entry
+    trainer.predict(params, data.X, batch_size=4096)
+    assert len(trainer._predict_fn_cache) == 2
+    with pytest.raises(ValueError, match="batch_size"):
+        trainer.predict(params, data.X, batch_size=0)
+
+
 def make_machines(n, epochs=2):
     return [
         Machine(
