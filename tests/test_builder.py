@@ -157,6 +157,63 @@ def test_saved_artifact_loads(tmp_path):
     assert meta.build_metadata.model.model_builder_version
 
 
+@pytest.mark.parametrize(
+    "metrics_list,expect_key",
+    [
+        (None, "explained-variance-score"),
+        (["sklearn.metrics.mean_squared_error"], "mean-squared-error"),
+        (["mean_absolute_error"], "mean-absolute-error"),  # bare sklearn name
+    ],
+)
+def test_builder_metrics_list(metrics_list, expect_key):
+    """evaluation.metrics selects the CV scorers (ref: test_builder.py:548)."""
+    evaluation = {"cv_mode": "cross_val_only"}
+    if metrics_list is not None:
+        evaluation["metrics"] = metrics_list
+    _, machine = ModelBuilder(make_machine(evaluation=evaluation)).build()
+    scores = machine.metadata.build_metadata.model.cross_validation.scores
+    assert expect_key in scores
+    if metrics_list is not None:
+        assert len([k for k in scores if not k.endswith(("Tag-1", "Tag-2"))]) == 1
+
+
+def test_metrics_from_list_resolution():
+    funcs = ModelBuilder.metrics_from_list(
+        ["sklearn.metrics.r2_score", "mean_squared_error"]
+    )
+    from sklearn.metrics import mean_squared_error, r2_score
+
+    assert funcs == [r2_score, mean_squared_error]
+    # defaults come from the normalized-config globals
+    from gordo_tpu.workflow.config_elements.normalized_config import NormalizedConfig
+
+    defaults = NormalizedConfig.DEFAULT_CONFIG_GLOBALS["evaluation"]["metrics"]
+    assert len(ModelBuilder.metrics_from_list(None)) == len(defaults)
+
+
+def test_n_splits_from_config():
+    """evaluation.cv overrides the TimeSeriesSplit (ref: test_builder.py:666)."""
+    evaluation = {
+        "cv_mode": "cross_val_only",
+        "cv": {"sklearn.model_selection.TimeSeriesSplit": {"n_splits": 5}},
+    }
+    _, machine = ModelBuilder(make_machine(evaluation=evaluation)).build()
+    cv_meta = machine.metadata.build_metadata.model.cross_validation
+    assert "fold-5" in cv_meta.scores["r2-score"]
+    assert "fold-5-train-start" in cv_meta.splits
+
+
+def test_builder_preserves_runtime_reporters(tmp_path):
+    """The built machine keeps runtime.reporters so cli.build's
+    machine_out.report() runs them (ref: test_builder.py:700; the
+    report->reporter plumbing itself is covered in test_reporters.py)."""
+    machine = make_machine()
+    reporters = [{"gordo_tpu.reporters.postgres.SqliteReporter": {"db_path": ":memory:"}}]
+    machine.runtime = {"reporters": reporters}
+    _, machine_out = ModelBuilder(machine).build(output_dir=tmp_path)
+    assert machine_out.runtime.get("reporters") == reporters
+
+
 def test_local_build_anomaly_pipeline():
     results = list(local_build(ANOMALY_CONFIG))
     assert len(results) == 1
