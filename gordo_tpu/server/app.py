@@ -11,6 +11,7 @@ views/anomaly.py:150-152, server.py:204-209):
 
 - ``GET  /healthcheck``
 - ``GET  /server-version``
+- ``GET  /gordo/v0/specs.json`` (OpenAPI description of this surface)
 - ``GET  /gordo/v0/<project>/models``
 - ``GET  /gordo/v0/<project>/revisions``
 - ``GET  /gordo/v0/<project>/expected-models``
@@ -30,6 +31,7 @@ import io
 import json
 import logging
 import os
+import re
 import threading
 import timeit
 import traceback
@@ -105,6 +107,9 @@ class GordoApp:
 
         self.url_map = Map(
             [
+                # machine-readable API description (reference: rest_api.py's
+                # flask-restplus Api serving its specs at a relative URL)
+                Rule("/gordo/v0/specs.json", endpoint="specs", methods=["GET"]),
                 Rule("/healthcheck", endpoint="healthcheck", methods=["GET"]),
                 Rule("/server-version", endpoint="server_version", methods=["GET"]),
                 Rule("/metrics", endpoint="metrics", methods=["GET"]),
@@ -233,7 +238,9 @@ class GordoApp:
     ) -> Response:
         """Stamp revision + Server-Timing (reference: server.py:188-202)."""
         if ctx.revision:
-            if response.mimetype == "application/json":
+            # the OpenAPI document must stay schema-conformant: no foreign
+            # top-level keys (the revision still rides the response header)
+            if response.mimetype == "application/json" and endpoint != "specs":
                 try:
                     data = json.loads(response.get_data())
                     if isinstance(data, dict):
@@ -293,6 +300,67 @@ class GordoApp:
         return []
 
     # -- views -------------------------------------------------------------
+
+    #: endpoint -> public operation summary for the generated OpenAPI spec
+    #: (docstrings are internal and may cite reference file:line — not
+    #: suitable for a published API description)
+    _SPEC_SUMMARIES = {
+        "specs": "OpenAPI description of this API",
+        "healthcheck": "Liveness check",
+        "server_version": "Server version",
+        "metrics": "Prometheus metrics exposition",
+        "models": "List models in the served revision",
+        "revisions": "List available model revisions",
+        "expected_models": "List models the deployment expects",
+        "metadata": "Build metadata for one model",
+        "download_model": "Download the serialized model",
+        "prediction": "Run the model on posted data",
+        "anomaly_prediction": "Run anomaly scoring on posted data",
+        "fleet_prediction": "Batched multi-machine scoring (TPU extension)",
+    }
+
+    def view_specs(self, ctx, request) -> Response:
+        """
+        OpenAPI 3.0 description of the REST surface, generated from the URL
+        map (reference: server/rest_api.py — the flask-restplus Api's
+        swagger specs endpoint).
+        """
+        paths: typing.Dict[str, dict] = {}
+        op_counts: typing.Dict[str, int] = {}
+        for rule in self.url_map.iter_rules():
+            path = re.sub(r"<(?:[^:<>]+:)?([^<>]+)>", r"{\1}", rule.rule)
+            summary = self._SPEC_SUMMARIES.get(rule.endpoint, rule.endpoint)
+            entry = paths.setdefault(path, {})
+            for method in sorted(rule.methods - {"HEAD", "OPTIONS"}):
+                # several rules may share a view (e.g. per-model healthcheck
+                # serves metadata); operationIds must stay unique
+                n = op_counts.get(rule.endpoint, 0)
+                op_counts[rule.endpoint] = n + 1
+                op_id = rule.endpoint if n == 0 else f"{rule.endpoint}_{n + 1}"
+                entry[method.lower()] = {
+                    "operationId": op_id,
+                    "summary": summary,
+                    "parameters": [
+                        {
+                            "name": arg,
+                            "in": "path",
+                            "required": True,
+                            "schema": {"type": "string"},
+                        }
+                        for arg in sorted(rule.arguments)
+                    ],
+                    "responses": {"200": {"description": "Success"}},
+                }
+        return _json_response(
+            {
+                "openapi": "3.0.3",
+                "info": {
+                    "title": "gordo-tpu model server",
+                    "version": __version__,
+                },
+                "paths": paths,
+            }
+        )
 
     def view_metrics(self, ctx, request) -> Response:
         """Prometheus exposition for the in-process registry (404 when off)."""

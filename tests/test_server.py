@@ -39,6 +39,32 @@ def test_server_version(gordo_ml_server_client):
     assert json.loads(resp.get_data())["version"] == __version__
 
 
+def test_openapi_specs(gordo_ml_server_client):
+    resp = gordo_ml_server_client.get("/gordo/v0/specs.json")
+    assert resp.status_code == 200
+    spec = json.loads(resp.get_data())
+    assert spec["openapi"].startswith("3.")
+    assert spec["info"]["version"] == __version__
+    paths = spec["paths"]
+    pred = paths["/gordo/v0/{gordo_project}/{gordo_name}/prediction"]["post"]
+    assert pred["operationId"] == "prediction"
+    assert {p["name"] for p in pred["parameters"]} == {
+        "gordo_project",
+        "gordo_name",
+    }
+    assert "/gordo/v0/{gordo_project}/models" in paths
+    assert "get" in paths["/healthcheck"]
+    # conformance: no foreign top-level keys (revision rides the header),
+    # unique operationIds even where rules share a view, public summaries
+    assert "revision" not in spec
+    assert resp.headers["revision"]
+    op_ids = [
+        op["operationId"] for entry in paths.values() for op in entry.values()
+    ]
+    assert len(op_ids) == len(set(op_ids))
+    assert all(".py" not in op["summary"] for e in paths.values() for op in e.values())
+
+
 def test_models_listing(gordo_ml_server_client):
     resp = gordo_ml_server_client.get(_url(GORDO_PROJECT, "models"))
     assert resp.status_code == 200
