@@ -214,14 +214,24 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         return pd.Series(mse)
 
     def anomaly(
-        self, X: pd.DataFrame, y: pd.DataFrame, frequency: Optional[timedelta] = None
+        self,
+        X: pd.DataFrame,
+        y: pd.DataFrame,
+        frequency: Optional[timedelta] = None,
+        model_output: Optional[np.ndarray] = None,
     ) -> pd.DataFrame:
         """
         Full anomaly frame for (X, y) (reference: diff.py:252-405).
+
+        ``model_output`` lets callers supply a precomputed base-estimator
+        output for X (the server's fleet path batches many machines'
+        forwards into one vmapped dispatch, then assembles each frame
+        here); None runs this machine's own predict/transform.
         """
-        model_output = (
-            self.predict(X) if hasattr(self, "predict") else self.transform(X)
-        )
+        if model_output is None:
+            model_output = (
+                self.predict(X) if hasattr(self, "predict") else self.transform(X)
+            )
 
         data = model_utils.make_base_dataframe(
             tags=X.columns,

@@ -24,6 +24,7 @@ YAML configs run unchanged (e.g.
 """
 
 import copy
+import inspect
 import logging
 import pydoc
 from typing import Any, Dict, List, Union
@@ -117,8 +118,28 @@ def _prepare_params(cls: type, params: Dict[str, Any]) -> Dict[str, Any]:
         elif key == "callbacks" and isinstance(value, list):
             prepared[key] = [_build_param_value(v) for v in value]
         else:
-            prepared[key] = _build_param_value(value)
+            prepared[key] = _coerce_to_default_type(
+                cls, key, _build_param_value(value)
+            )
     return prepared
+
+
+def _coerce_to_default_type(cls: type, key: str, value: Any) -> Any:
+    """
+    YAML/JSON have no tuple type, so tuple-valued params (e.g. RobustScaler's
+    ``quantile_range=(25.0, 75.0)``) round-trip through a definition as
+    lists; modern sklearn rejects the list at validation time. Cast a list
+    back to tuple when the constructor's declared default is a tuple.
+    """
+    if not isinstance(value, list):
+        return value
+    try:
+        default = inspect.signature(cls.__init__).parameters[key].default
+    except (ValueError, KeyError, TypeError):
+        return value
+    if isinstance(default, tuple):
+        return tuple(value)
+    return value
 
 
 def _build_param_value(value: Any) -> Any:

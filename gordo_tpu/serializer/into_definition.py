@@ -3,6 +3,7 @@ The inverse of ``from_definition``: decompose a live pipeline back into the
 primitive dict config language (reference: gordo/serializer/into_definition.py).
 """
 
+import inspect
 import logging
 from typing import Any, Dict
 
@@ -58,7 +59,12 @@ def _decompose_node(step: Any, prune_default_params: bool = False) -> Dict[str, 
     ``get_params(deep=False)`` recursively
     (reference: gordo/serializer/into_definition.py:62-126).
     """
-    if hasattr(step, "into_definition") and callable(step.into_definition):
+    # resolve the hook statically: wrappers like DiffBasedAnomalyDetector
+    # delegate unknown attributes to their base estimator via __getattr__,
+    # which would surface the BASE's into_definition here and silently
+    # decompose the wrapper into its inner estimator
+    hook = inspect.getattr_static(step, "into_definition", None)
+    if hook is not None and callable(step.into_definition):
         return step.into_definition()
 
     if not hasattr(step, "get_params"):

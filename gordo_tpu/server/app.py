@@ -160,6 +160,11 @@ class GordoApp:
                     endpoint="fleet_prediction",
                     methods=["POST"],
                 ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/anomaly/prediction/fleet",
+                    endpoint="fleet_anomaly_prediction",
+                    methods=["POST"],
+                ),
             ],
             strict_slashes=False,
         )
@@ -317,6 +322,9 @@ class GordoApp:
         "prediction": "Run the model on posted data",
         "anomaly_prediction": "Run anomaly scoring on posted data",
         "fleet_prediction": "Batched multi-machine scoring (TPU extension)",
+        "fleet_anomaly_prediction": (
+            "Batched multi-machine anomaly scoring (TPU extension)"
+        ),
     }
 
     def view_specs(self, ctx, request) -> Response:
@@ -487,7 +495,12 @@ class GordoApp:
         }
         return _json_response(context, 200)
 
-    def _get_fleet_scorer(self, ctx, names: typing.Tuple[str, ...]):
+    def _get_fleet_scorer(
+        self,
+        ctx,
+        names: typing.Tuple[str, ...],
+        models: typing.Optional[typing.Dict[str, typing.Any]] = None,
+    ):
         key = (os.path.realpath(ctx.collection_dir), names)
         # the server runs threaded (run_simple(threaded=True)): hold the
         # lock only for dict reads/writes so warm lookups never stall
@@ -499,7 +512,8 @@ class GordoApp:
             return cached
         from gordo_tpu.server.fleet_serving import fleet_scorer_from_models
 
-        models = {name: self._get_model(ctx, name) for name in names}
+        if models is None:
+            models = {name: self._get_model(ctx, name) for name in names}
         built = fleet_scorer_from_models(models)
         with self._fleet_scorers_lock:
             if len(self._fleet_scorers) >= 16:  # bound param-stack memory
@@ -539,12 +553,8 @@ class GordoApp:
             tags = [t.name for t in self._tags(metadata)]
             raw = machines[name]
             try:
-                if isinstance(raw, dict):
-                    X = server_utils.dataframe_from_dict(raw)
-                else:
-                    X = pd.DataFrame(np.asarray(raw, dtype="float64"))
-                X = server_utils.verify_dataframe(X, tags)
-            except ValueError as err:
+                X = self._parse_fleet_frame(raw, tags)
+            except (ValueError, ApiError) as err:
                 return _json_response(
                     {"error": f"Bad input for machine {name!r}: {err}"}, 400
                 )
@@ -587,6 +597,132 @@ class GordoApp:
                 index=frames[name].index,
             )
             data[name] = server_utils.dataframe_to_dict(frame)
+        context = {
+            "data": data,
+            "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
+        }
+        return _json_response(context, 200)
+
+    @staticmethod
+    def _parse_fleet_frame(raw, columns: typing.List[str]) -> pd.DataFrame:
+        """Dict-of-dicts or list-of-rows -> verified DataFrame."""
+        if isinstance(raw, dict):
+            frame = server_utils.dataframe_from_dict(raw)
+        else:
+            frame = pd.DataFrame(np.asarray(raw, dtype="float64"))
+        return server_utils.verify_dataframe(frame, columns)
+
+    def view_fleet_anomaly_prediction(
+        self, ctx, request, gordo_project: str
+    ) -> Response:
+        """
+        Batched multi-machine anomaly scoring (TPU extension; the
+        reference's unit is one model per POST, views/anomaly.py:99-147).
+
+        Body: ``{"machines": {<name>: {"X": <frame>, "y": <frame>}}}``.
+        The base-estimator forwards for all machines run as one vmapped
+        dispatch per architecture group from TPU-resident stacked params;
+        each machine's anomaly frame (thresholds, confidences, smoothing)
+        is then assembled from its precomputed output. 422 when any
+        requested model is not an anomaly detector, mirroring the
+        single-machine endpoint.
+        """
+        from gordo_tpu.models.anomaly.base import AnomalyDetectorBase
+
+        body = request.get_json(silent=True) or {}
+        machines = body.get("machines")
+        if not isinstance(machines, dict) or not machines:
+            return _json_response(
+                {"error": "Body must contain a non-empty 'machines' mapping."}, 400
+            )
+
+        names = tuple(sorted(machines))
+        models = {name: self._get_model(ctx, name) for name in names}
+        non_anomaly = [
+            name
+            for name, model in models.items()
+            if not isinstance(model, AnomalyDetectorBase)
+        ]
+        if non_anomaly:
+            return _json_response(
+                {
+                    "message": "Models are not AnomalyDetectors: "
+                    + ", ".join(
+                        f"{n} ({type(models[n]).__name__})" for n in non_anomaly
+                    )
+                },
+                422,
+            )
+        scorer, prefixes, fallback = self._get_fleet_scorer(ctx, names, models)
+
+        frames: typing.Dict[str, pd.DataFrame] = {}
+        targets: typing.Dict[str, pd.DataFrame] = {}
+        inputs: typing.Dict[str, typing.Any] = {}
+        meta: typing.Dict[str, dict] = {}
+        for name in names:
+            metadata = self._get_metadata(ctx, name)
+            meta[name] = metadata
+            tags = [t.name for t in self._tags(metadata)]
+            target_tags = [t.name for t in self._target_tags(metadata)] or tags
+            raw = machines[name]
+            if not isinstance(raw, dict) or "X" not in raw:
+                return _json_response(
+                    {"error": f"Machine {name!r} entry must contain 'X'."}, 400
+                )
+            if raw.get("y") is None:
+                return _json_response(
+                    {
+                        "message": "Cannot perform anomaly without 'y' "
+                        f"to compare against (machine {name!r})."
+                    },
+                    400,
+                )
+            try:
+                X = self._parse_fleet_frame(raw["X"], tags)
+                y = self._parse_fleet_frame(raw["y"], target_tags)
+            except (ValueError, ApiError) as err:
+                return _json_response(
+                    {"error": f"Bad input for machine {name!r}: {err}"}, 400
+                )
+            frames[name], targets[name] = X, y
+            if name in fallback:
+                continue  # scored via its own anomaly() below
+            transformed = X.values
+            for step in prefixes.get(name, []):
+                transformed = step.transform(transformed)
+            inputs[name] = np.asarray(transformed, dtype="float32")
+
+        outputs: typing.Dict[str, np.ndarray] = {}
+        data: typing.Dict[str, typing.Any] = {}
+        try:
+            if scorer is not None and inputs:
+                outputs.update(scorer.predict(inputs))
+            for name in names:
+                frequency = pd.tseries.frequencies.to_offset(
+                    normalize_frequency(
+                        meta[name]["dataset"].get("resolution", "10min")
+                    )
+                )
+                # only batchable (fleet-scored) machines get a precomputed
+                # output; fallback machines run their own predict inside
+                # anomaly() and may not accept the kwarg
+                kwargs = (
+                    {"model_output": outputs[name]} if name in outputs else {}
+                )
+                frame = models[name].anomaly(
+                    frames[name], targets[name], frequency=frequency, **kwargs
+                )
+                data[name] = server_utils.dataframe_to_dict(frame)
+        except ValueError as err:
+            return _json_response({"error": f"ValueError: {err}"}, 400)
+        except Exception:
+            logger.error(
+                "Fleet anomaly prediction failed:\n%s", traceback.format_exc()
+            )
+            return _json_response(
+                {"error": "Something unexpected happened; check your input data"},
+                400,
+            )
         context = {
             "data": data,
             "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",

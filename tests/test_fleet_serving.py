@@ -262,3 +262,73 @@ def test_fleet_prediction_unknown_machine_404(gordo_ml_server_client, sensor_fra
         json={"machines": {"no-such-machine": dataframe_to_dict(sensor_frame)}},
     )
     assert resp.status_code == 404
+
+
+def test_fleet_anomaly_endpoint_matches_single(gordo_ml_server_client, sensor_frame):
+    """Batched anomaly frames equal the single-machine anomaly endpoint's."""
+    from tests.conftest import GORDO_PROJECT, GORDO_SINGLE_TARGET
+
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    X = dataframe_to_dict(sensor_frame)
+    resp = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/anomaly/prediction/fleet",
+        json={"machines": {GORDO_SINGLE_TARGET: {"X": X, "y": X}}},
+    )
+    assert resp.status_code == 200, resp.get_data()
+    fleet_frame = json.loads(resp.get_data())["data"][GORDO_SINGLE_TARGET]
+
+    single = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/{GORDO_SINGLE_TARGET}/anomaly/prediction",
+        json={"X": X, "y": X},
+    )
+    assert single.status_code == 200
+    single_frame = json.loads(single.get_data())["data"]
+    # anomaly-specific outputs (thresholded confidences included) must match
+    assert set(fleet_frame) == set(single_frame)
+    for group in (
+        "total-anomaly-scaled",
+        "total-anomaly-confidence",
+        "anomaly-confidence",
+        "tag-anomaly-unscaled",
+    ):
+        assert group in fleet_frame
+        for col, series in single_frame[group].items():
+            for ts, value in series.items():
+                assert abs(fleet_frame[group][col][ts] - value) < 1e-4
+
+
+def test_fleet_anomaly_non_anomaly_model_is_422(
+    gordo_ml_server_client, sensor_frame
+):
+    from tests.conftest import GORDO_BASE_TARGETS, GORDO_PROJECT, GORDO_SINGLE_TARGET
+
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    X = dataframe_to_dict(sensor_frame)
+    resp = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/anomaly/prediction/fleet",
+        json={
+            "machines": {
+                GORDO_SINGLE_TARGET: {"X": X, "y": X},
+                GORDO_BASE_TARGETS[0]: {"X": X, "y": X},
+            }
+        },
+    )
+    assert resp.status_code == 422
+    assert GORDO_BASE_TARGETS[0] in json.loads(resp.get_data())["message"]
+
+
+def test_fleet_anomaly_requires_y(gordo_ml_server_client, sensor_frame):
+    from tests.conftest import GORDO_PROJECT, GORDO_SINGLE_TARGET
+
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    resp = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/anomaly/prediction/fleet",
+        json={
+            "machines": {GORDO_SINGLE_TARGET: {"X": dataframe_to_dict(sensor_frame)}}
+        },
+    )
+    assert resp.status_code == 400
+    assert "y" in json.loads(resp.get_data())["message"]

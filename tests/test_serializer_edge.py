@@ -144,3 +144,46 @@ def test_function_transformer_funcs_in_config():
     np.testing.assert_array_equal(
         pipe.transform(np.array([[1.0, 2.0]])), [[2.0, 4.0]]
     )
+
+
+def test_anomaly_wrapper_survives_round_trip():
+    """into_definition must not let the detector's __getattr__ delegation
+    surface the BASE estimator's into_definition hook — that silently
+    decomposed the wrapper into its inner model, so CLI-built anomaly
+    machines (which round-trip configs to expand defaults) lost their
+    thresholds/anomaly surface entirely."""
+    from gordo_tpu.serializer import into_definition
+
+    cfg = {
+        "gordo_tpu.models.anomaly.DiffBasedAnomalyDetector": {
+            "base_estimator": {
+                "gordo_tpu.models.AutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "epochs": 1,
+                }
+            }
+        }
+    }
+    expanded = into_definition(from_definition(cfg))
+    (top_path,) = expanded
+    assert top_path.endswith("DiffBasedAnomalyDetector")
+    rebuilt = from_definition(expanded)
+    assert type(rebuilt).__name__ == "DiffBasedAnomalyDetector"
+    assert type(rebuilt.base_estimator).__name__ == "AutoEncoder"
+
+
+def test_tuple_params_survive_round_trip():
+    """YAML/JSON turn tuples into lists; rebuilding must restore tuples for
+    params whose constructor default is a tuple (sklearn validates types
+    at fit time: RobustScaler rejects quantile_range as a list)."""
+    import numpy as np
+    from sklearn.preprocessing import RobustScaler
+
+    from gordo_tpu.serializer import into_definition
+
+    expanded = into_definition(from_definition({"sklearn.preprocessing.RobustScaler": {}}))
+    qr = expanded["sklearn.preprocessing._data.RobustScaler"]["quantile_range"]
+    assert isinstance(qr, list)  # the definition stays YAML/JSON-safe
+    scaler = from_definition(expanded)
+    assert isinstance(scaler.quantile_range, tuple)
+    scaler.fit(np.random.default_rng(0).random((10, 2)))  # would raise on a list
