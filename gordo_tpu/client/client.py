@@ -247,6 +247,218 @@ class Client:
             )
             return [(j.name, j.predictions, j.error_messages) for j in jobs]
 
+    def predict_fleet(
+        self,
+        start: datetime,
+        end: datetime,
+        targets: Optional[List[str]] = None,
+        revision: Optional[str] = None,
+        group_size: int = 8,
+    ) -> typing.List[typing.Tuple[str, pd.DataFrame, typing.List[str]]]:
+        """
+        Fleet-batched prediction (TPU-native extension; no reference
+        equivalent): machines are grouped and each group's row-chunks go to
+        the server's ``…/prediction/fleet`` endpoints, so one POST scores
+        ``group_size`` machines through one vmapped device dispatch instead
+        of one forward per machine.
+
+        Falls back to the per-machine path (`predict_single_machine`) for a
+        whole group when the fleet endpoint refuses it (e.g. 422: a group
+        containing non-anomaly models). Requests are JSON (the fleet
+        endpoints take per-machine frames in one JSON body).
+
+        Returns the same ``(name, frame, errors)`` list as :meth:`predict`.
+        """
+        _revision = revision or self._get_latest_revision()
+        machines = self._get_machines(revision=_revision, machine_names=targets)
+        # machines already known to refuse the anomaly path go per-machine
+        # up front so they don't 422 their whole group off the fleet path
+        solo = [m for m in machines if m.name in self._fallback_machines]
+        groupable = [m for m in machines if m.name not in self._fallback_machines]
+        groups: typing.List[typing.List[Machine]] = [
+            groupable[i : i + max(1, group_size)]
+            for i in range(0, len(groupable), max(1, group_size))
+        ]
+        results: typing.List[typing.Tuple[str, pd.DataFrame, typing.List[str]]] = []
+        with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
+            solo_jobs = [
+                executor.submit(
+                    self.predict_single_machine,
+                    machine=machine,
+                    start=start,
+                    end=end,
+                    revision=_revision,
+                )
+                for machine in solo
+            ]
+            for group_results in executor.map(
+                lambda group: self._predict_machine_group(
+                    group, start=start, end=end, revision=_revision
+                ),
+                groups,
+            ):
+                results.extend(
+                    (r.name, r.predictions, r.error_messages) for r in group_results
+                )
+            for job in solo_jobs:
+                r = job.result()
+                results.append((r.name, r.predictions, r.error_messages))
+        return results
+
+    def _predict_machine_group(
+        self,
+        group: typing.List[Machine],
+        start: datetime,
+        end: datetime,
+        revision: str,
+    ) -> typing.List[PredictionResult]:
+        """One group: fetch raw data, POST row-chunks to the fleet endpoint."""
+        anomaly = self.prediction_path == "/anomaly/prediction"
+        url = (
+            f"{self.server_endpoint}/anomaly/prediction/fleet"
+            if anomaly
+            else f"{self.server_endpoint}/prediction/fleet"
+        )
+
+        data: typing.Dict[str, typing.Tuple[Machine, pd.DataFrame, pd.DataFrame]] = {}
+        for machine in group:
+            X, y = self._raw_data(machine, start, end)
+            if y is None:
+                y = X
+            if self.prediction_forwarder is not None and self.forward_resampled_sensors:
+                self.prediction_forwarder(resampled_sensor_data=X)
+            data[machine.name] = (machine, X, y)
+
+        chunk_bounds = {
+            name: self._row_chunks(
+                len(X), self.batch_size, self._min_chunk_rows(machine)
+            )
+            for name, (machine, X, _) in data.items()
+        }
+        n_chunks = max((len(b) for b in chunk_bounds.values()), default=0)
+        frames: typing.Dict[str, typing.List[pd.DataFrame]] = {
+            name: [] for name in data
+        }
+        errors: typing.Dict[str, typing.List[str]] = {name: [] for name in data}
+        for k in range(n_chunks):
+            payload: typing.Dict[str, Any] = {}
+            for name, (machine, X, y) in data.items():
+                if k >= len(chunk_bounds[name]):
+                    continue
+                chunk = slice(*chunk_bounds[name][k])
+                Xc = X.iloc[chunk]
+                if not len(Xc):
+                    continue
+                if anomaly:
+                    payload[name] = {
+                        "X": server_utils.dataframe_to_dict(Xc),
+                        "y": server_utils.dataframe_to_dict(y.iloc[chunk]),
+                    }
+                else:
+                    payload[name] = server_utils.dataframe_to_dict(Xc)
+            if not payload:
+                continue
+            status, resp = self._post_fleet_chunk(url, payload, revision)
+            if status == "refused" and not any(frames.values()):
+                # the endpoint refused the group outright (e.g. 422: it
+                # contains non-anomaly models) before anything succeeded or
+                # was forwarded: score its machines through the per-machine
+                # path (which has its own 422 fallback) and return those
+                # results wholesale
+                return [
+                    self.predict_single_machine(
+                        machine=machine, start=start, end=end, revision=revision
+                    )
+                    for machine, _, _ in data.values()
+                ]
+            if status != "ok":
+                # mid-stream failure (or a refusal after earlier chunks
+                # were already forwarded): record the failed chunk per
+                # machine — re-running the whole group would duplicate
+                # forwarder side effects and double the retry wall-clock
+                for name in payload:
+                    (s, e) = chunk_bounds[name][k]
+                    errors[name].append(
+                        f"Fleet chunk rows {s}:{e} failed for "
+                        f"'{name}': {resp}"
+                    )
+                continue
+            for name, frame_dict in resp["data"].items():
+                frame = server_utils.dataframe_from_dict(frame_dict)
+                frames[name].append(frame)
+                if self.prediction_forwarder is not None:
+                    self.prediction_forwarder(
+                        predictions=frame,
+                        machine=data[name][0],
+                        metadata=self.metadata,
+                    )
+
+        return [
+            PredictionResult(
+                name=name,
+                predictions=(
+                    pd.concat(frames[name]).sort_index()
+                    if frames[name]
+                    else pd.DataFrame()
+                ),
+                error_messages=errors[name],
+            )
+            for name in data
+        ]
+
+    def _post_fleet_chunk(
+        self, url: str, payload: typing.Dict[str, Any], revision: str
+    ) -> typing.Tuple[str, Any]:
+        """
+        POST one fleet chunk with the single-machine path's retry/backoff
+        discipline. Returns one of:
+
+        - ``("ok", response_dict)``
+        - ``("refused", message)`` — a 4xx the server will repeat (422 mixed
+          group, bad input): retrying is pointless, fall back or record
+        - ``("io_error", message)`` — retries exhausted: record the failure;
+          do NOT re-run the group per-machine (that doubles the backoff
+          wall-clock against a server that is already down)
+
+        410 propagates (deployment revision gone, like the per-machine path).
+        """
+        for current_attempt in itertools.count(start=1):
+            try:
+                return "ok", handle_response(
+                    self.session.post(
+                        url,
+                        json={"machines": payload},
+                        params={"revision": revision},
+                    )
+                )
+            except (
+                IOError,
+                TimeoutError,
+                requests.ConnectionError,
+                requests.HTTPError,
+            ) as exc:
+                if current_attempt <= self.n_retries:
+                    time_to_sleep = backoff_seconds(current_attempt)
+                    logger.warning(
+                        "Fleet chunk failed attempt %d of %d; retrying in %ds",
+                        current_attempt,
+                        self.n_retries,
+                        time_to_sleep,
+                    )
+                    sleep(time_to_sleep)
+                    continue
+                logger.error("Fleet chunk failed after retries: %s", exc)
+                return "io_error", str(exc)
+            except ResourceGone:
+                raise
+            except (HttpUnprocessableEntity, BadGordoRequest, NotFound) as exc:
+                logger.warning(
+                    "Fleet endpoint refused group (%s); falling back to "
+                    "per-machine path",
+                    exc,
+                )
+                return "refused", str(exc)
+
     def predict_single_machine(
         self, machine: Machine, start: datetime, end: datetime, revision: str
     ) -> PredictionResult:
@@ -259,19 +471,21 @@ class Client:
         if self.prediction_forwarder is not None and self.forward_resampled_sensors:
             self.prediction_forwarder(resampled_sensor_data=X)
 
-        max_idx = len(X.index) - 1
+        chunks = self._row_chunks(
+            len(X), self.batch_size, self._min_chunk_rows(machine)
+        )
         with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
             jobs = executor.map(
-                lambda i: self._send_prediction_request(
+                lambda bounds: self._send_prediction_request(
                     X,
                     y,
-                    chunk=slice(i, i + self.batch_size),
+                    chunk=slice(*bounds),
                     machine=machine,
-                    start=X.index[i],
-                    end=X.index[min(i + self.batch_size - 1, max_idx)],
+                    start=X.index[bounds[0]],
+                    end=X.index[bounds[1] - 1],
                     revision=revision,
                 ),
-                range(0, X.shape[0], self.batch_size),
+                chunks,
             )
             prediction_dfs = []
             error_messages: List[str] = []
@@ -432,6 +646,45 @@ class Client:
         '2019-01-01 10:45:00+00:00'
         """
         return dt - (pd.Timedelta(normalize_frequency(resolution)) * n_intervals)
+
+    @staticmethod
+    def _row_chunks(
+        n_rows: int, batch_size: int, min_rows: int = 1
+    ) -> typing.List[typing.Tuple[int, int]]:
+        """
+        [start, end) row-slice bounds of ~batch_size rows. A trailing chunk
+        smaller than ``min_rows`` merges into the previous chunk: a windowed
+        model consumes (lookback-1) = model_offset rows before producing
+        any output, so a tiny tail chunk could only ever be a server error.
+
+        Examples
+        --------
+        >>> Client._row_chunks(78, 40, min_rows=5)
+        [(0, 40), (40, 78)]
+        >>> Client._row_chunks(81, 40, min_rows=5)
+        [(0, 40), (40, 81)]
+        >>> Client._row_chunks(90, 40, min_rows=5)
+        [(0, 40), (40, 80), (80, 90)]
+        >>> Client._row_chunks(90, 17, min_rows=32)  # batch below lookback
+        [(0, 32), (32, 90)]
+        """
+        batch_size = max(batch_size, min_rows)
+        bounds = [
+            (s, min(s + batch_size, n_rows)) for s in range(0, n_rows, batch_size)
+        ]
+        if len(bounds) > 1 and bounds[-1][1] - bounds[-1][0] < min_rows:
+            (s, _) = bounds.pop()
+            bounds[-1] = (bounds[-1][0], n_rows)
+        return bounds
+
+    @staticmethod
+    def _min_chunk_rows(machine: Machine) -> int:
+        offset = 0
+        try:
+            offset = int(machine.metadata.build_metadata.model.model_offset or 0)
+        except AttributeError:
+            pass
+        return offset + 1
 
     @staticmethod
     def dataframe_from_response(

@@ -760,6 +760,11 @@ class GordoApp:
                 },
                 422,
             )
+        except ValueError as err:
+            # e.g. fewer rows than a windowed model's lookback — client
+            # input trouble, not a server fault (the base-prediction and
+            # fleet views report this as 400 too)
+            return _json_response({"error": f"ValueError: {err}"}, 400)
 
         if request.args.get("format") == "parquet":
             return Response(

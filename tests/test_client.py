@@ -128,6 +128,58 @@ def test_predict_fallback_on_non_anomaly_model(ml_server):
     assert client.prediction_path == "/anomaly/prediction"
 
 
+def test_predict_fleet_matches_per_machine(ml_server):
+    """Fleet-batched client results equal the per-machine path's."""
+    forwarded = []
+
+    def forwarder(predictions=None, machine=None, metadata=dict(), **kwargs):
+        forwarded.append(machine.name)
+
+    client = Client(
+        project=GORDO_PROJECT,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(ml_server),
+        prediction_forwarder=forwarder,
+        parallelism=2,
+        batch_size=17,  # force several row-chunks per group
+    )
+    fleet = dict(
+        (n, (p, e))
+        for n, p, e in client.predict_fleet(START, END, targets=GORDO_TARGETS)
+    )
+    single = dict(
+        (n, (p, e)) for n, p, e in client.predict(START, END, targets=GORDO_TARGETS)
+    )
+    assert set(fleet) == set(single) == set(GORDO_TARGETS)
+    for name in fleet:
+        fp, fe = fleet[name]
+        sp, se = single[name]
+        assert fe == [] and se == []
+        pd.testing.assert_frame_equal(fp, sp, check_exact=False, rtol=1e-4, atol=1e-6)
+    assert GORDO_SINGLE_TARGET in forwarded
+
+
+def test_predict_fleet_mixed_group_falls_back(ml_server):
+    """A group mixing anomaly and plain models 422s on the fleet endpoint
+    and must fall back to the per-machine path for that group."""
+    client = Client(
+        project=GORDO_PROJECT,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(ml_server),
+        parallelism=2,
+    )
+    targets = GORDO_TARGETS + GORDO_BASE_TARGETS
+    results = {n: (p, e) for n, p, e in client.predict_fleet(START, END, targets=targets)}
+    assert set(results) == set(targets)
+    for name, (predictions, errors) in results.items():
+        assert errors == []
+        assert len(predictions) > 0
+    # the plain machine went through the per-machine 422 fallback
+    assert GORDO_BASE_TARGETS[0] in client._fallback_machines
+
+
 def test_fallback_does_not_downgrade_other_machines(ml_server):
     """A plain model's 422 must not reroute the anomaly machine's batches."""
     client = Client(
