@@ -107,6 +107,18 @@ def client(ctx: click.Context, *args, **kwargs):
     default=True,
     help="Use parquet serialization to/from the server",
 )
+@click.option(
+    "--fleet/--no-fleet",
+    default=False,
+    help="Batch groups of machines into single fleet-endpoint requests "
+    "(one vmapped device dispatch per group; JSON transport)",
+)
+@click.option(
+    "--fleet-group-size",
+    type=int,
+    default=8,
+    help="Machines per fleet request when --fleet is given",
+)
 @click.pass_context
 def predict(
     ctx: click.Context,
@@ -121,6 +133,8 @@ def predict(
     forward_resampled_sensors: bool,
     n_retries: int,
     parquet: bool,
+    fleet: bool,
+    fleet_group_size: int,
 ):
     """Run predictions for [START, END] (reference: cli/client.py:60-167)."""
     ctx.obj["kwargs"].update(
@@ -140,7 +154,12 @@ def predict(
             n_retries=n_retries,
         )
 
-    predictions = client.predict(start, end, targets=list(target))
+    if fleet:
+        predictions = client.predict_fleet(
+            start, end, targets=list(target), group_size=fleet_group_size
+        )
+    else:
+        predictions = client.predict(start, end, targets=list(target))
 
     click.secho(f"\n{'-' * 20} Summary of failed predictions (if any) {'-' * 20}")
     exit_code = 0

@@ -350,3 +350,34 @@ def test_client_cli_help(runner):
     assert result.exit_code == 0
     for sub in ("predict", "metadata", "download-model"):
         assert sub in result.output
+
+
+def test_client_predict_cli_fleet_flag(runner, monkeypatch):
+    """--fleet routes through Client.predict_fleet with the group size."""
+    import pandas as pd
+
+    from gordo_tpu.client import Client
+
+    calls = {}
+
+    def fake_fleet(self, start, end, targets=None, revision=None, group_size=8):
+        calls["group_size"] = group_size
+        return [("m1", pd.DataFrame(), [])]
+
+    monkeypatch.setattr(Client, "predict_fleet", fake_fleet)
+    result = runner.invoke(
+        gordo,
+        [
+            "client",
+            "--project",
+            "proj",
+            "predict",
+            "2019-01-01T00:00:00+00:00",
+            "2019-01-02T00:00:00+00:00",
+            "--fleet",
+            "--fleet-group-size",
+            "4",
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    assert calls["group_size"] == 4
