@@ -252,11 +252,37 @@ def test_workflow_generate_renders_valid_yaml(runner, project_config_file):
     assert any(
         "PostgresReporter" in json.dumps(m) for m in payload
     )
-    # per-machine client tasks exist and depend on their bucket build
+    # one fleet client task per bucket, covering every machine, depending
+    # on its bucket's build
     client_tasks = [
-        t for t in dag["dag"]["tasks"] if t["name"].startswith("client-wf-machine")
+        t for t in dag["dag"]["tasks"] if t.get("template") == "gordo-client"
     ]
-    assert len(client_tasks) == 3
+    assert len(client_tasks) == 2
+    all_targets = " ".join(
+        t["arguments"]["parameters"][0]["value"] for t in client_tasks
+    ).split()
+    assert sorted(all_targets) == ["wf-machine-0", "wf-machine-1", "wf-machine-2"]
+    # client -> its waiter -> the bucket's build
+    assert client_tasks[0]["dependencies"] == [
+        client_tasks[0]["name"].replace("client-", "client-wait-")
+    ]
+    wait_tasks = {
+        t["name"]: t
+        for t in dag["dag"]["tasks"]
+        if t["name"].startswith("client-wait")
+    }
+    assert any(
+        dep.startswith("build-bucket")
+        for dep in wait_tasks[client_tasks[0]["dependencies"][0]]["dependencies"]
+    )
+    # the client template drives the fleet endpoints, with memory scaled
+    # to the bucket size (machines_per_pod=2 -> 2x the per-machine default)
+    client_tpl = next(
+        t for t in wf["spec"]["templates"] if t["name"] == "gordo-client"
+    )
+    assert "--fleet" in client_tpl["script"]["source"]
+    assert client_tpl["script"]["resources"]["limits"]["memory"] == "8000M"
+    assert client_tpl["script"]["resources"]["requests"]["memory"] == "7000M"
 
 
 def test_workflow_generate_split(runner, project_config_file):
