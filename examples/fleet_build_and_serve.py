@@ -73,9 +73,37 @@ def main():
         )
         with urllib.request.urlopen(request) as resp:
             payload = json.loads(resp.read())
+
+        # the same batching through the client driver: groups of machines
+        # per request, raw data pulled through the machines' own dataset
+        # configs with the client's provider
+        import dateutil.parser
+
+        from gordo_tpu.client import Client
+        from gordo_tpu.data.providers import RandomDataProvider
+
+        client = Client(
+            project="fleet-example",
+            host="127.0.0.1",
+            port=5598,
+            scheme="http",
+            data_provider=RandomDataProvider(),
+            parallelism=2,
+        )
+        span = (
+            dateutil.parser.isoparse("2019-01-01T00:00:00+00:00"),
+            dateutil.parser.isoparse("2019-01-01T06:00:00+00:00"),
+        )
+        # first call probes /anomaly/prediction/fleet, learns these are
+        # plain models (422), and scores them per-machine; the second call
+        # batches the whole group through the base fleet endpoint
+        client.predict_fleet(*span, group_size=N_MACHINES)
+        fleet_results = client.predict_fleet(*span, group_size=N_MACHINES)
         server.shutdown()
 
     print("one batched request scored:", sorted(payload["data"]))
+    for name, frame, errors in sorted(fleet_results):
+        print(f"client fleet: {name} rows={len(frame)} errors={errors}")
 
 
 if __name__ == "__main__":

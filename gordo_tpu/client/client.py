@@ -271,38 +271,37 @@ class Client:
         """
         _revision = revision or self._get_latest_revision()
         machines = self._get_machines(revision=_revision, machine_names=targets)
-        # machines already known to refuse the anomaly path go per-machine
-        # up front so they don't 422 their whole group off the fleet path
-        solo = [m for m in machines if m.name in self._fallback_machines]
-        groupable = [m for m in machines if m.name not in self._fallback_machines]
-        groups: typing.List[typing.List[Machine]] = [
-            groupable[i : i + max(1, group_size)]
-            for i in range(0, len(groupable), max(1, group_size))
+        # machines already known to refuse the anomaly path batch into
+        # their own groups against the BASE fleet endpoint, so one plain
+        # model neither 422s its group off the fleet path nor degrades to
+        # per-machine POSTs
+        base_path = [m for m in machines if m.name in self._fallback_machines]
+        anomaly_path = [
+            m for m in machines if m.name not in self._fallback_machines
+        ]
+        size = max(1, group_size)
+        jobs: typing.List[typing.Tuple[typing.List[Machine], bool]] = [
+            (anomaly_path[i : i + size], False)
+            for i in range(0, len(anomaly_path), size)
+        ] + [
+            (base_path[i : i + size], True)
+            for i in range(0, len(base_path), size)
         ]
         results: typing.List[typing.Tuple[str, pd.DataFrame, typing.List[str]]] = []
         with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
-            solo_jobs = [
-                executor.submit(
-                    self.predict_single_machine,
-                    machine=machine,
+            for group_results in executor.map(
+                lambda job: self._predict_machine_group(
+                    job[0],
                     start=start,
                     end=end,
                     revision=_revision,
-                )
-                for machine in solo
-            ]
-            for group_results in executor.map(
-                lambda group: self._predict_machine_group(
-                    group, start=start, end=end, revision=_revision
+                    use_base_path=job[1],
                 ),
-                groups,
+                jobs,
             ):
                 results.extend(
                     (r.name, r.predictions, r.error_messages) for r in group_results
                 )
-            for job in solo_jobs:
-                r = job.result()
-                results.append((r.name, r.predictions, r.error_messages))
         return results
 
     def _predict_machine_group(
@@ -311,9 +310,10 @@ class Client:
         start: datetime,
         end: datetime,
         revision: str,
+        use_base_path: bool = False,
     ) -> typing.List[PredictionResult]:
         """One group: fetch raw data, POST row-chunks to the fleet endpoint."""
-        anomaly = self.prediction_path == "/anomaly/prediction"
+        anomaly = not use_base_path and self.prediction_path == "/anomaly/prediction"
         url = (
             f"{self.server_endpoint}/anomaly/prediction/fleet"
             if anomaly

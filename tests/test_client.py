@@ -180,6 +180,37 @@ def test_predict_fleet_mixed_group_falls_back(ml_server):
     assert GORDO_BASE_TARGETS[0] in client._fallback_machines
 
 
+def test_predict_fleet_known_plain_machines_batch_via_base_endpoint(ml_server):
+    """After the first call learns a machine is plain, later calls batch it
+    through the BASE fleet endpoint instead of per-machine POSTs."""
+    client = Client(
+        project=GORDO_PROJECT,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(ml_server),
+        parallelism=2,
+    )
+    first = client.predict_fleet(START, END, targets=GORDO_BASE_TARGETS)
+    assert GORDO_BASE_TARGETS[0] in client._fallback_machines
+
+    urls = []
+    orig_post = client.session.post
+
+    def recording_post(url, **kwargs):
+        urls.append(url)
+        return orig_post(url, **kwargs)
+
+    client.session.post = recording_post
+    second = client.predict_fleet(START, END, targets=GORDO_BASE_TARGETS)
+    assert all(url.endswith("/prediction/fleet") for url in urls)
+    assert not any("/anomaly/" in url for url in urls)
+    (name, frame, errors) = second[0]
+    assert errors == [] and len(frame) > 0
+    pd.testing.assert_frame_equal(
+        frame, first[0][1], check_exact=False, rtol=1e-4, atol=1e-6
+    )
+
+
 def test_fallback_does_not_downgrade_other_machines(ml_server):
     """A plain model's 422 must not reroute the anomaly machine's batches."""
     client = Client(
