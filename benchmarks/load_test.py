@@ -32,14 +32,14 @@ from gordo_tpu.utils import honor_jax_platforms_env
 honor_jax_platforms_env()
 
 
-def self_serve(tmp: str, port: int) -> str:
-    """Train one machine on random data and serve it; returns base URL."""
+def self_serve(tmp: str, port: int, n_machines: int = 1) -> str:
+    """Train machine(s) on random data and serve them; returns base URL."""
     from werkzeug.serving import make_server
 
     from benchmarks.server_latency import build_collection
     from gordo_tpu.server import build_app
 
-    collection = build_collection(1, tmp)
+    collection = build_collection(n_machines, tmp)
     os.environ["MODEL_COLLECTION_DIR"] = collection
     server = make_server("127.0.0.1", port, build_app(), threaded=True)
     threading.Thread(target=server.serve_forever, daemon=True).start()
@@ -81,6 +81,21 @@ def main():
     )
     parser.add_argument("--self-serve", action="store_true")
     parser.add_argument("--port", type=int, default=5599)
+    def _non_negative(value):
+        n = int(value)
+        if n < 0:
+            raise argparse.ArgumentTypeError("--fleet must be >= 0")
+        return n
+
+    parser.add_argument(
+        "--fleet",
+        type=_non_negative,
+        default=0,
+        metavar="N",
+        help="Drive the batched fleet endpoint with N machines per request "
+        "instead of the single-machine endpoint (self-serve builds N "
+        "machines named bench-m0..bench-m<N-1>)",
+    )
     args = parser.parse_args()
 
     import numpy as np
@@ -90,11 +105,17 @@ def main():
     if base_url is None:
         if not args.self_serve:
             parser.error("--base-url or --self-serve required")
-        base_url = self_serve(tmp_ctx.name, args.port)
+        base_url = self_serve(tmp_ctx.name, args.port, max(1, args.fleet))
 
     rows = np.random.default_rng(0).random((args.samples, args.features)).tolist()
-    body = json.dumps({"X": rows}).encode()
-    url = f"{base_url}/gordo/v0/{args.project}/{args.machine}/prediction"
+    if args.fleet:
+        body = json.dumps(
+            {"machines": {f"bench-m{i}": rows for i in range(args.fleet)}}
+        ).encode()
+        url = f"{base_url}/gordo/v0/{args.project}/prediction/fleet"
+    else:
+        body = json.dumps({"X": rows}).encode()
+        url = f"{base_url}/gordo/v0/{args.project}/{args.machine}/prediction"
 
     # warmup: first request pays model load + compile
     try:
@@ -133,18 +154,22 @@ def main():
     from benchmarks.server_latency import summarize_ms
 
     summary = summarize_ms(latencies) if latencies else {}
-    print(
-        json.dumps(
-            {
-                "users": args.users,
-                "duration_s": round(elapsed, 1),
-                "requests": len(latencies),
-                "errors": len(errors),
-                "rps": round(len(latencies) / elapsed, 1),
-                **summary,
-            }
+    out = {
+        "users": args.users,
+        "duration_s": round(elapsed, 1),
+        "requests": len(latencies),
+        "errors": len(errors),
+        "rps": round(len(latencies) / elapsed, 1),
+        **summary,
+    }
+    if args.fleet:
+        # each request scores --fleet machines; the comparable per-machine
+        # rate against the single-machine mode
+        out["fleet_size"] = args.fleet
+        out["machine_scores_per_s"] = round(
+            args.fleet * len(latencies) / elapsed, 1
         )
-    )
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
