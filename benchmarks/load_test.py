@@ -96,6 +96,13 @@ def main():
         "instead of the single-machine endpoint (self-serve builds N "
         "machines named bench-m0..bench-m<N-1>)",
     )
+    parser.add_argument(
+        "--fleet-machines",
+        default=None,
+        metavar="NAME,NAME,...",
+        help="Comma-separated machine names for fleet mode against a real "
+        "--base-url deployment (default: the self-serve bench-m<i> names)",
+    )
     args = parser.parse_args()
 
     import numpy as np
@@ -109,9 +116,12 @@ def main():
 
     rows = np.random.default_rng(0).random((args.samples, args.features)).tolist()
     if args.fleet:
-        body = json.dumps(
-            {"machines": {f"bench-m{i}": rows for i in range(args.fleet)}}
-        ).encode()
+        names = (
+            args.fleet_machines.split(",")
+            if args.fleet_machines
+            else [f"bench-m{i}" for i in range(args.fleet)]
+        )
+        body = json.dumps({"machines": {name: rows for name in names}}).encode()
         url = f"{base_url}/gordo/v0/{args.project}/prediction/fleet"
     else:
         body = json.dumps({"X": rows}).encode()
@@ -127,9 +137,14 @@ def main():
         ).read()
     except urllib.error.HTTPError as err:
         detail = err.read().decode(errors="replace")[:300]
+        hint = (
+            "--project/--fleet-machines"
+            if args.fleet
+            else "--project/--machine"
+        )
         sys.exit(
             f"warmup request failed with HTTP {err.code}: {detail}\n"
-            f"(check --project/--machine, and that --features matches the "
+            f"(check {hint}, and that --features matches the "
             f"model's tag count)"
         )
     except urllib.error.URLError as err:

@@ -280,13 +280,11 @@ class Client:
             m for m in machines if m.name not in self._fallback_machines
         ]
         size = max(1, group_size)
-        jobs: typing.List[typing.Tuple[typing.List[Machine], bool]] = [
-            (anomaly_path[i : i + size], False)
-            for i in range(0, len(anomaly_path), size)
-        ] + [
-            (base_path[i : i + size], True)
-            for i in range(0, len(base_path), size)
-        ]
+        jobs: typing.List[typing.Tuple[typing.List[Machine], bool]] = []
+        for pool, use_base in ((anomaly_path, False), (base_path, True)):
+            jobs.extend(
+                (pool[i : i + size], use_base) for i in range(0, len(pool), size)
+            )
         results: typing.List[typing.Tuple[str, pd.DataFrame, typing.List[str]]] = []
         with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
             for group_results in executor.map(

@@ -243,9 +243,10 @@ class GordoApp:
     ) -> Response:
         """Stamp revision + Server-Timing (reference: server.py:188-202)."""
         if ctx.revision:
-            # the OpenAPI document must stay schema-conformant: no foreign
-            # top-level keys (the revision still rides the response header)
-            if response.mimetype == "application/json" and endpoint != "specs":
+            if (
+                response.mimetype == "application/json"
+                and endpoint not in self._REVISION_BODY_EXEMPT
+            ):
                 try:
                     data = json.loads(response.get_data())
                     if isinstance(data, dict):
@@ -305,6 +306,10 @@ class GordoApp:
         return []
 
     # -- views -------------------------------------------------------------
+
+    #: endpoints whose JSON body must keep its exact schema — the revision
+    #: stamp would add a foreign top-level key (it still rides the header)
+    _REVISION_BODY_EXEMPT = frozenset({"specs"})
 
     #: endpoint -> public operation summary for the generated OpenAPI spec
     #: (docstrings are internal and may cite reference file:line — not
