@@ -7,6 +7,7 @@ server -> real Client.predict over the loopback transport.
 """
 
 import numpy as np
+import pandas as pd
 import pytest
 import yaml
 
@@ -75,8 +76,8 @@ def test_fleet_built_artifacts_layout(system_collection):
         assert (system_collection / f"system-m{i}" / "metadata.json").is_file()
 
 
-def test_client_predicts_whole_fleet(system_server):
-    client = Client(
+def _make_client(system_server):
+    return Client(
         project=PROJECT,
         host="localhost",
         port=80,
@@ -85,6 +86,10 @@ def test_client_predicts_whole_fleet(system_server):
         session=loopback_session(system_server),
         parallelism=3,
     )
+
+
+def test_client_predicts_whole_fleet(system_server):
+    client = _make_client(system_server)
     machine_names = client.get_machine_names()
     assert sorted(machine_names) == [f"system-m{i}" for i in range(3)]
 
@@ -108,16 +113,32 @@ def test_client_predicts_whole_fleet(system_server):
         ).all()
 
 
-def test_fleet_metadata_served(system_server):
-    client = Client(
-        project=PROJECT,
-        host="localhost",
-        port=80,
-        scheme="http",
-        data_provider=RandomDataProvider(),
-        session=loopback_session(system_server),
+def test_fleet_client_end_to_end_matches_per_machine(system_server):
+    """Fleet-built artifacts served and scored through the BATCHED path:
+    one anomaly-fleet POST per group must equal the per-machine results."""
+    import dateutil.parser
+
+    span = (
+        dateutil.parser.isoparse("2019-01-01T00:00:00+00:00"),
+        dateutil.parser.isoparse("2019-01-01T06:00:00+00:00"),
     )
-    meta = client.get_metadata()
+    fleet_results = _make_client(system_server).predict_fleet(*span)
+    single_results = _make_client(system_server).predict(*span)
+    for name, _, errors in fleet_results + single_results:
+        assert not errors, f"{name}: {errors}"
+    fleet = {n: f for n, f, _ in fleet_results}
+    single = {n: f for n, f, _ in single_results}
+    assert set(fleet) == set(single) == {f"system-m{i}" for i in range(3)}
+    for name in fleet:
+        top = set(fleet[name].columns.get_level_values(0))
+        assert "anomaly-confidence" in top and "total-anomaly-scaled" in top
+        pd.testing.assert_frame_equal(
+            fleet[name], single[name], check_exact=False, rtol=1e-4, atol=1e-6
+        )
+
+
+def test_fleet_metadata_served(system_server):
+    meta = _make_client(system_server).get_metadata()
     assert set(meta) == {f"system-m{i}" for i in range(3)}
     for name, machine_meta in meta.items():
         build_meta = machine_meta.build_metadata
