@@ -91,7 +91,19 @@ def metrics_app(registry: typing.Optional[CollectorRegistry] = None):
     """
     Standalone WSGI app exposing ``/metrics``
     (reference: gordo/server/prometheus/server.py:7-25).
+
+    With ``PROMETHEUS_MULTIPROC_DIR`` set (multi-process serving — e.g.
+    several werkzeug/gunicorn workers writing shard files), aggregates
+    across processes via the multiprocess collector, like the reference's
+    standalone metrics app.
     """
+    import os
+
     from prometheus_client import make_wsgi_app
 
+    if registry is None and os.environ.get("PROMETHEUS_MULTIPROC_DIR"):
+        from prometheus_client import multiprocess
+
+        registry = CollectorRegistry()
+        multiprocess.MultiProcessCollector(registry)
     return make_wsgi_app(registry if registry is not None else REGISTRY)

@@ -336,3 +336,27 @@ def test_envoy_prefix_rewrite(gordo_ml_server_client):
         },
     )
     assert resp.status_code == 200
+
+
+def test_standalone_metrics_app(tmp_path, monkeypatch):
+    """The standalone /metrics WSGI app serves a registry's metrics, and
+    aggregates across processes when PROMETHEUS_MULTIPROC_DIR is set."""
+    from prometheus_client import CollectorRegistry, Counter
+    from werkzeug.test import Client as WerkzeugClient
+
+    from gordo_tpu.server.prometheus.metrics import metrics_app
+
+    registry = CollectorRegistry()
+    Counter("test_hits", "hits", registry=registry).inc()
+    resp = WerkzeugClient(metrics_app(registry)).get("/metrics")
+    assert resp.status_code == 200
+    assert b"test_hits_total 1.0" in resp.data
+
+    # multiproc mode: the app must aggregate from the shard dir, NOT fall
+    # back to the process-global REGISTRY (whose python_info etc. would
+    # double-count across workers); an empty dir yields an empty payload
+    monkeypatch.setenv("PROMETHEUS_MULTIPROC_DIR", str(tmp_path))
+    resp = WerkzeugClient(metrics_app()).get("/metrics")
+    assert resp.status_code == 200
+    assert b"python_info" not in resp.data
+    assert resp.data == b""

@@ -76,6 +76,12 @@ def test_fleet_built_artifacts_layout(system_collection):
         assert (system_collection / f"system-m{i}" / "metadata.json").is_file()
 
 
+SPAN = (
+    pd.Timestamp("2019-01-01T00:00:00+00:00"),
+    pd.Timestamp("2019-01-01T06:00:00+00:00"),
+)
+
+
 def _make_client(system_server):
     return Client(
         project=PROJECT,
@@ -93,12 +99,7 @@ def test_client_predicts_whole_fleet(system_server):
     machine_names = client.get_machine_names()
     assert sorted(machine_names) == [f"system-m{i}" for i in range(3)]
 
-    import dateutil.parser
-
-    results = client.predict(
-        start=dateutil.parser.isoparse("2019-01-01T00:00:00+00:00"),
-        end=dateutil.parser.isoparse("2019-01-01T06:00:00+00:00"),
-    )
+    results = client.predict(start=SPAN[0], end=SPAN[1])
     assert len(results) == 3
     for result in results:
         name, frame, error_messages = result
@@ -116,14 +117,16 @@ def test_client_predicts_whole_fleet(system_server):
 def test_fleet_client_end_to_end_matches_per_machine(system_server):
     """Fleet-built artifacts served and scored through the BATCHED path:
     one anomaly-fleet POST per group must equal the per-machine results."""
-    import dateutil.parser
+    fleet_client = _make_client(system_server)
+    urls = []
+    orig_post = fleet_client.session.post
+    fleet_client.session.post = lambda url, **kw: (urls.append(url), orig_post(url, **kw))[1]
+    fleet_results = fleet_client.predict_fleet(*SPAN)
+    # the BATCHED path actually ran — no silent per-machine fallback
+    assert urls and all(url.endswith("/anomaly/prediction/fleet") for url in urls)
+    assert not fleet_client._fallback_machines
 
-    span = (
-        dateutil.parser.isoparse("2019-01-01T00:00:00+00:00"),
-        dateutil.parser.isoparse("2019-01-01T06:00:00+00:00"),
-    )
-    fleet_results = _make_client(system_server).predict_fleet(*span)
-    single_results = _make_client(system_server).predict(*span)
+    single_results = _make_client(system_server).predict(*SPAN)
     for name, _, errors in fleet_results + single_results:
         assert not errors, f"{name}: {errors}"
     fleet = {n: f for n, f, _ in fleet_results}
