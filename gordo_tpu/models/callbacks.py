@@ -1,0 +1,186 @@
+"""
+Training callbacks for the JAX estimators.
+
+The reference trains Keras models whose configs routinely carry
+``callbacks: [EarlyStopping(...)]`` and a ``validation_split`` fit arg
+(gordo/machine/model/models.py's fit path; the serializer materializes
+callback definitions, gordo/serializer/from_definition.py:193-213). Here
+the training loop is a jitted epoch program, so callbacks are host-side
+per-epoch decisions: the loop fetches the monitored scalar after each
+epoch and asks every callback whether to stop.
+
+Keras config paths (``tensorflow.keras.callbacks.EarlyStopping`` /
+``keras.callbacks.EarlyStopping``) resolve to these classes through the
+serializer's legacy path map, so reference configs load unchanged.
+"""
+
+import logging
+import typing
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+def _snapshot(params):
+    """
+    Deep-copy a param pytree. The training loop donates its param buffers
+    to the next epoch's jitted call (donate_argnums), so a stored
+    reference would point at deleted device memory one epoch later.
+    """
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        return jax.tree.map(jnp.copy, params)
+    except ImportError:  # pragma: no cover - jax is a hard dep in practice
+        import copy
+
+        return copy.deepcopy(params)
+
+
+class Callback:
+    """Per-epoch training hook: ``update`` returns True to request a stop."""
+
+    def on_train_begin(self) -> None:  # pragma: no cover - trivial
+        pass
+
+    def get_params(self, deep: bool = False) -> dict:
+        """Constructor args for config round-trips; subclasses with
+        constructor parameters should override."""
+        return {}
+
+    def update(self, epoch: int, logs: typing.Dict[str, float], params) -> bool:
+        return False
+
+    def finalize(self, params):
+        """Return the params training should end with (identity unless the
+        callback restores an earlier snapshot)."""
+        return params
+
+
+class EarlyStopping(Callback):
+    """
+    Stop when a monitored metric stops improving (the Keras contract:
+    ``monitor``/``min_delta``/``patience``/``mode``/``baseline``/
+    ``restore_best_weights``). ``monitor`` falls back from ``val_loss``
+    to ``loss`` when no validation split is configured, with a warning —
+    matching Keras' lenient behavior.
+    """
+
+    def __init__(
+        self,
+        monitor: str = "val_loss",
+        min_delta: float = 0.0,
+        patience: int = 0,
+        mode: str = "auto",
+        baseline: typing.Optional[float] = None,
+        restore_best_weights: bool = False,
+        verbose: int = 0,
+        start_from_epoch: int = 0,
+    ):
+        if mode not in ("min", "max", "auto"):
+            raise ValueError(f"mode must be 'min', 'max' or 'auto', got {mode!r}")
+        # constructor params stored unmodified (sklearn.clone contract);
+        # derived values live in private attrs
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        self.mode = mode
+        self.baseline = baseline
+        self.restore_best_weights = restore_best_weights
+        self.verbose = verbose
+        self.start_from_epoch = start_from_epoch
+        self._delta = abs(float(min_delta))
+        # Keras 'auto' infers the direction from the metric name; every
+        # loss-like metric here is minimized
+        self._direction = (
+            "max" if (mode == "auto" and "acc" in monitor) else
+            ("min" if mode == "auto" else mode)
+        )
+        self._warned_missing = False
+        self.on_train_begin()
+
+    def get_params(self, deep: bool = False) -> dict:
+        """sklearn-style constructor args, so the serializer can round-trip
+        callback objects back into config definitions."""
+        return {
+            "monitor": self.monitor,
+            "min_delta": self.min_delta,
+            "patience": self.patience,
+            "mode": self.mode,
+            "baseline": self.baseline,
+            "restore_best_weights": self.restore_best_weights,
+            "verbose": self.verbose,
+            "start_from_epoch": self.start_from_epoch,
+        }
+
+    def on_train_begin(self) -> None:
+        self.wait = 0
+        self.stopped_epoch: typing.Optional[int] = None
+        self.best = np.inf if self._direction == "min" else -np.inf
+        if self.baseline is not None:
+            self.best = float(self.baseline)
+        self.best_params = None
+
+    def _improved(self, value: float) -> bool:
+        if self._direction == "min":
+            return value < self.best - self._delta
+        return value > self.best + self._delta
+
+    def update(self, epoch: int, logs: typing.Dict[str, float], params) -> bool:
+        if epoch < int(self.start_from_epoch):
+            return False
+        value = logs.get(self.monitor)
+        if value is None:
+            fallback = "loss" if self.monitor != "loss" else None
+            if fallback is not None and fallback in logs:
+                if not self._warned_missing:
+                    logger.warning(
+                        "EarlyStopping monitor %r unavailable (no validation "
+                        "split?); monitoring %r instead",
+                        self.monitor,
+                        fallback,
+                    )
+                    self._warned_missing = True
+                    # the substitute metric is a loss: re-aim a max-mode
+                    # monitor (e.g. val_accuracy) at minimization so the
+                    # fallback doesn't treat every epoch as a regression
+                    if self._direction != "min":
+                        self._direction = "min"
+                        self.best = (
+                            float(self.baseline)
+                            if self.baseline is not None
+                            else np.inf
+                        )
+                value = logs[fallback]
+            else:
+                return False
+        if self._improved(float(value)):
+            self.best = float(value)
+            self.wait = 0
+            if self.restore_best_weights:
+                self.best_params = _snapshot(params)
+            return False
+        self.wait += 1
+        # Keras stops once `wait >= patience` epochs pass without
+        # improvement (patience=0 behaves like patience=1: the first
+        # non-improving epoch stops)
+        if self.wait >= max(int(self.patience), 1):
+            self.stopped_epoch = epoch
+            if self.verbose:
+                logger.info("EarlyStopping at epoch %d (best=%g)", epoch, self.best)
+            return True
+        return False
+
+    def finalize(self, params):
+        # Keras restores the best snapshot only when the callback actually
+        # stopped training (tf.keras on_epoch_end's stop branch); a fit
+        # that runs all epochs keeps its final weights
+        if (
+            self.restore_best_weights
+            and self.best_params is not None
+            and self.stopped_epoch is not None
+        ):
+            return self.best_params
+        return params

@@ -37,6 +37,31 @@ from gordo_tpu.models.specs import ModelSpec, per_sample_loss
 
 logger = logging.getLogger(__name__)
 
+
+def _materialize_callbacks(raw) -> list:
+    """
+    fit-arg ``callbacks`` -> list of Callback objects. The serializer
+    already materializes definitions inside model configs; raw dicts
+    (single-key definition form) are built here for direct constructor use.
+    """
+    if not raw:
+        return []
+    from gordo_tpu.models.callbacks import Callback
+
+    out = []
+    for item in raw:
+        if isinstance(item, Callback):
+            out.append(item)
+        elif isinstance(item, dict):
+            from gordo_tpu.serializer import from_definition
+
+            out.append(from_definition(item))
+        else:
+            raise TypeError(
+                f"Unsupported callback specification: {type(item).__name__}"
+            )
+    return out
+
 # attributes never pickled (compiled/jitted/device state)
 _EPHEMERAL_ATTRS = ("_apply_fn", "_train_epoch_fn", "_device_params")
 
@@ -114,6 +139,13 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
 
     def into_definition(self) -> dict:
         definition = copy(self.kwargs)
+        if definition.get("callbacks"):
+            from gordo_tpu.serializer.into_definition import _decompose_node
+
+            definition["callbacks"] = [
+                cb if isinstance(cb, (str, dict)) else _decompose_node(cb)
+                for cb in definition["callbacks"]
+            ]
         definition["kind"] = self.kind
         return {f"{type(self).__module__}.{type(self).__name__}": definition}
 
@@ -162,6 +194,12 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         batch_size = int(fit_args.get("batch_size", 32))
         shuffle = bool(fit_args.get("shuffle", not self._windowed))
         seed = int(self.kwargs.get("seed", DEFAULT_SEED))
+        validation_split = float(fit_args.get("validation_split") or 0.0)
+        if not 0.0 <= validation_split < 1.0:
+            raise ValueError(
+                f"validation_split must be in [0, 1), got {validation_split}"
+            )
+        callbacks = _materialize_callbacks(fit_args.get("callbacks"))
 
         spec = self._build_spec()
         self.spec_ = spec
@@ -189,12 +227,22 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         optimizer = spec.make_optimizer()
         opt_state = optimizer.init(params)
 
-        n_batches = max(1, math.ceil(n_samples / batch_size))
+        # Keras validation_split semantics: the LAST fraction of samples
+        # (windows, for sequence models) is held out, before any shuffling
+        n_val = int(n_samples * validation_split)
+        n_train = n_samples - n_val
+        if n_train <= 0:
+            raise ValueError(
+                f"validation_split={validation_split} leaves no training "
+                f"samples (of {n_samples})"
+            )
+
+        n_batches = max(1, math.ceil(n_train / batch_size))
         n_pad = n_batches * batch_size
         sample_ids = np.zeros(n_pad, dtype=np.int32)
-        sample_ids[:n_samples] = np.arange(n_samples, dtype=np.int32)
+        sample_ids[:n_train] = np.arange(n_train, dtype=np.int32)
         weights = np.zeros(n_pad, dtype=np.float32)
-        weights[:n_samples] = 1.0
+        weights[:n_train] = 1.0
         ids_d = jnp.asarray(sample_ids)
         w_d = jnp.asarray(weights)
 
@@ -244,18 +292,61 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
 
             step_ids = jnp.arange(n_batches, dtype=jnp.int32)
             (p, o), loss_sums = jax.lax.scan(step, (p, o), (sel_all, w_all, step_ids))
-            epoch_loss = jnp.sum(loss_sums) / n_samples
+            epoch_loss = jnp.sum(loss_sums) / n_train
             return p, o, epoch_loss
 
         train_epoch_jit = jax.jit(train_epoch, donate_argnums=(0, 1))
 
-        losses = []
-        for _ in range(epochs):
+        if n_val:
+            # chunked like training, so the validation gather never
+            # materializes more than (batch_size, lb, f) at once
+            n_val_batches = math.ceil(n_val / batch_size)
+            n_val_pad = n_val_batches * batch_size
+            val_ids = np.full(n_val_pad, n_train, dtype=np.int32)
+            val_ids[:n_val] = np.arange(n_train, n_samples, dtype=np.int32)
+            val_w = np.zeros(n_val_pad, dtype=np.float32)
+            val_w[:n_val] = 1.0
+            val_sel_d = jnp.asarray(val_ids.reshape(n_val_batches, batch_size))
+            val_w_d = jnp.asarray(val_w.reshape(n_val_batches, batch_size))
+
+            def val_loss_fn(p, Xfull, yfull):
+                def one_chunk(args):
+                    sel, wb = args
+                    xb, yb = gather_batch(Xfull, yfull, sel)
+                    out, _ = module.apply(p, xb)
+                    return jnp.sum(per_sample_loss(loss_name, out, yb) * wb)
+
+                sums = jax.lax.map(one_chunk, (val_sel_d, val_w_d))
+                return jnp.sum(sums) / n_val
+
+            val_loss_jit = jax.jit(val_loss_fn)
+
+        for cb in callbacks:
+            cb.on_train_begin()
+
+        losses: list = []
+        val_losses: list = []
+        for epoch in range(epochs):
             key, epoch_key = jax.random.split(key)
             params, opt_state, epoch_loss = train_epoch_jit(
                 params, opt_state, epoch_key, Xd, yd, ids_d, w_d
             )
             losses.append(float(epoch_loss))
+            logs = {"loss": losses[-1]}
+            if n_val:
+                val_losses.append(float(val_loss_jit(params, Xd, yd)))
+                logs["val_loss"] = val_losses[-1]
+            # every callback sees every epoch (no short-circuit): a stop
+            # vote from one must not hide this epoch's metrics from others
+            if callbacks and any(
+                [cb.update(epoch, logs, params) for cb in callbacks]
+            ):
+                break
+        for cb in callbacks:
+            params = cb.finalize(params)
+            # drop any param snapshot so pickled estimators stay small
+            if getattr(cb, "best_params", None) is not None:
+                cb.best_params = None
 
         self.params_ = params
         self.history_ = {
@@ -264,10 +355,14 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
                 "epochs": epochs,
                 "steps": n_batches,
                 "batch_size": batch_size,
-                "samples": n_samples,
-                "metrics": ["loss"],
+                # training samples after the validation holdout, so
+                # samples/steps/batch_size stay mutually consistent
+                "samples": n_train,
+                "metrics": ["loss"] + (["val_loss"] if n_val else []),
             },
         }
+        if n_val:
+            self.history_["val_loss"] = val_losses
         self.n_features_ = X.shape[-1]
         self.n_features_out_ = y.shape[-1]
         self._apply_fn = None  # rebuilt lazily

@@ -42,6 +42,9 @@ LEGACY_PATH_MAP: Dict[str, str] = {
 
 # Ordered (prefix, replacement) rules applied when no exact entry matches.
 LEGACY_PREFIX_RULES = [
+    # Keras training callbacks in reference configs -> native equivalents
+    ("tensorflow.keras.callbacks.", "gordo_tpu.models.callbacks."),
+    ("keras.callbacks.", "gordo_tpu.models.callbacks."),
     ("gordo.machine.dataset.data_provider.", "gordo_tpu.data.providers."),
     ("gordo.machine.dataset.", "gordo_tpu.data."),
     ("gordo.machine.model.anomaly.", "gordo_tpu.models.anomaly."),
