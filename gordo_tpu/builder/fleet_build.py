@@ -209,6 +209,7 @@ class FleetModelBuilder:
         fit_args = proto_est.extract_supported_fit_args(proto_est.kwargs)
         epochs = int(fit_args.get("epochs", 1))
         batch_size = int(fit_args.get("batch_size", 32))
+        es_kwargs = self._early_stopping_kwargs(fit_args)
 
         trainer = FleetTrainer(spec, lookahead=lookahead, mesh=self.mesh)
         # Per-machine PRNG streams are a pure function of (evaluation seed,
@@ -239,14 +240,14 @@ class FleetModelBuilder:
         start_cv = time.time()
         fold_records = self._run_cv_folds(
             trainer, data, keys, bucket, Xs_grid, ys_grid, models,
-            epochs=epochs, batch_size=batch_size,
+            epochs=epochs, batch_size=batch_size, es_kwargs=es_kwargs,
         )
         cv_duration = time.time() - start_cv
 
         # -- final full fit ----------------------------------------------
         start_fit = time.time()
         params, losses = trainer.fit(
-            data, keys, epochs=epochs, batch_size=batch_size
+            data, keys, epochs=epochs, batch_size=batch_size, **es_kwargs
         )
         fit_duration = time.time() - start_fit
 
@@ -298,6 +299,44 @@ class FleetModelBuilder:
             out[machine.name] = (model, machine_out)
         return out
 
+    @staticmethod
+    def _early_stopping_kwargs(fit_args: dict) -> dict:
+        """
+        Map a bucket's EarlyStopping callback (if configured) onto the
+        fleet trainer's per-machine early stopping. The fleet path has no
+        validation split, so only min-mode loss-family monitors translate;
+        anything else trains the full epoch budget (with a warning, so the
+        divergence from the single-machine path is visible).
+        """
+        from gordo_tpu.models.callbacks import EarlyStopping
+        from gordo_tpu.models.core import _materialize_callbacks
+
+        for cb in _materialize_callbacks(fit_args.get("callbacks")):
+            if not isinstance(cb, EarlyStopping):
+                continue
+            if "loss" not in cb.monitor or cb.mode == "max":
+                logger.warning(
+                    "Fleet build: EarlyStopping(monitor=%r, mode=%r) does "
+                    "not translate to the fleet path (training loss only); "
+                    "training the full epoch budget",
+                    cb.monitor,
+                    cb.mode,
+                )
+                return {}
+            if cb.restore_best_weights:
+                logger.warning(
+                    "Fleet build: restore_best_weights is not supported on "
+                    "the fleet path; a stopped machine keeps its params "
+                    "from the stopping epoch, which may differ from its "
+                    "best-epoch params"
+                )
+            return {
+                "early_stopping_patience": int(cb.patience),
+                "early_stopping_min_delta": abs(float(cb.min_delta)),
+                "early_stopping_start_from_epoch": int(cb.start_from_epoch),
+            }
+        return {}
+
     def _run_cv_folds(
         self,
         trainer: FleetTrainer,
@@ -310,11 +349,18 @@ class FleetModelBuilder:
         epochs: int,
         batch_size: int,
         n_splits: int = 3,
+        es_kwargs: Optional[dict] = None,
     ) -> dict:
         """
         TimeSeriesSplit folds, trained fleet-wide with per-machine train
         masks; returns per-machine thresholds and scores (the reference
         computes these per machine in anomaly/diff.py:134-224).
+
+        ``es_kwargs`` applies the same early stopping to fold fits as the
+        final fit — the single-machine path's cross_validate clones also
+        run their configured callbacks, and thresholds calibrated from
+        fully-trained fold models would be too strict for an early-stopped
+        served model.
         """
         from sklearn import metrics as skmetrics
 
@@ -361,6 +407,7 @@ class FleetModelBuilder:
                 epochs=epochs,
                 batch_size=batch_size,
                 extra_weight=train_mask,
+                **(es_kwargs or {}),
             )
             preds = trainer.predict(fold_params, data.X)  # (M, n_out, f_out)
 

@@ -55,10 +55,32 @@ def _materialize_callbacks(raw) -> list:
         elif isinstance(item, dict):
             from gordo_tpu.serializer import from_definition
 
-            out.append(from_definition(item))
+            try:
+                obj = from_definition(item)
+            except ValueError:
+                # e.g. ReduceLROnPlateau / ModelCheckpoint — Keras callback
+                # types with no native equivalent. These were silently
+                # ignored before callbacks ran at all; keep configs loading
+                # but say so
+                logger.warning(
+                    "Ignoring unsupported training callback %s",
+                    next(iter(item), "?"),
+                )
+                continue
+            if not isinstance(obj, Callback):
+                logger.warning(
+                    "Ignoring non-Callback training callback %s",
+                    type(obj).__name__,
+                )
+                continue
+            out.append(obj)
         else:
-            raise TypeError(
-                f"Unsupported callback specification: {type(item).__name__}"
+            # e.g. a real keras callback object (bare `keras` may be
+            # importable even though the engine here is JAX): skip like
+            # the pre-callback-support behavior, loudly
+            logger.warning(
+                "Ignoring unsupported training callback object %s",
+                type(item).__name__,
             )
     return out
 
@@ -142,10 +164,22 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         if definition.get("callbacks"):
             from gordo_tpu.serializer.into_definition import _decompose_node
 
-            definition["callbacks"] = [
-                cb if isinstance(cb, (str, dict)) else _decompose_node(cb)
-                for cb in definition["callbacks"]
-            ]
+            decomposed = []
+            for cb in definition["callbacks"]:
+                if isinstance(cb, (str, dict)):
+                    decomposed.append(cb)
+                elif hasattr(type(cb), "get_params"):
+                    decomposed.append(_decompose_node(cb))
+                else:
+                    # foreign callback objects (e.g. real keras ones) are
+                    # ignored at fit time; drop them from the expanded
+                    # definition so it stays truthful and serializable
+                    logger.warning(
+                        "Dropping unsupported callback %s from expanded "
+                        "model definition",
+                        type(cb).__name__,
+                    )
+            definition["callbacks"] = decomposed
         definition["kind"] = self.kind
         return {f"{type(self).__module__}.{type(self).__name__}": definition}
 

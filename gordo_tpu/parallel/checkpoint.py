@@ -11,7 +11,9 @@ epoch instead of refitting every machine from scratch.
 """
 
 import logging
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
 
 logger = logging.getLogger(__name__)
 
@@ -38,13 +40,58 @@ class FleetCheckpointer:
         """Last checkpointed epoch number, or None."""
         return self._manager.latest_step()
 
-    def save(self, epoch: int, params: Any, opt_state: Any) -> None:
-        self._manager.save(
-            epoch,
-            args=self._ocp.args.StandardSave(
-                {"params": params, "opt_state": opt_state}
-            ),
-        )
+    def save(
+        self,
+        epoch: int,
+        params: Any,
+        opt_state: Any,
+        extra: Optional[Dict[str, np.ndarray]] = None,
+    ) -> None:
+        """
+        ``extra`` is a small dict of host numpy arrays (e.g. the fleet
+        trainer's per-machine early-stopping state) stored inside the
+        orbax payload, so it rides the same cloud-storage/multi-host
+        coordination as the params.
+        """
+        payload = {"params": params, "opt_state": opt_state}
+        if extra is not None:
+            payload["extra"] = {k: np.asarray(v) for k, v in extra.items()}
+        self._manager.save(epoch, args=self._ocp.args.StandardSave(payload))
+
+    def restore_with_extra(
+        self,
+        params_template: Any,
+        opt_state_template: Any,
+        extra_template: Dict[str, np.ndarray],
+        epoch: Optional[int] = None,
+    ) -> Tuple[Any, Any, int, Optional[Dict[str, np.ndarray]]]:
+        """
+        Like :meth:`restore`, also recovering the ``extra`` dict. Returns
+        extra=None (with params/opt_state still restored) when the
+        checkpoint predates extra-state saving.
+        """
+        epoch = self._manager.latest_step() if epoch is None else epoch
+        if epoch is None:
+            raise FileNotFoundError(f"No checkpoints under {self.directory}")
+        template = {
+            "params": params_template,
+            "opt_state": opt_state_template,
+            "extra": {k: np.asarray(v) for k, v in extra_template.items()},
+        }
+        try:
+            restored = self._manager.restore(
+                epoch, args=self._ocp.args.StandardRestore(template)
+            )
+            extra = {
+                k: np.asarray(v) for k, v in restored["extra"].items()
+            }
+        except Exception:
+            params, opt_state, epoch = self.restore(
+                params_template, opt_state_template, epoch
+            )
+            return params, opt_state, epoch, None
+        logger.info("Restored fleet checkpoint (+extra state) at epoch %d", epoch)
+        return restored["params"], restored["opt_state"], epoch, extra
 
     def restore(
         self, params_template: Any, opt_state_template: Any, epoch: Optional[int] = None

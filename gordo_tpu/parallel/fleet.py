@@ -181,13 +181,17 @@ class FleetTrainer:
         )
 
     # -- the compiled epoch ---------------------------------------------
-    def _epoch_fn(self, n: int, batch_size: int, shuffle: bool):
+    def _epoch_fn(self, n: int, batch_size: int, shuffle: bool, gated: bool = False):
         """
         Build (and cache) the jitted fleet-epoch function for a given
         (timesteps, batch_size) geometry. One compiled program per geometry,
         reused across the whole fleet and all epochs/folds.
+
+        ``gated`` variants take a per-machine ``active`` flag (early
+        stopping); the ungated program skips the full-tree select so
+        ordinary fits don't pay for the feature.
         """
-        cache_key = (n, batch_size, shuffle)
+        cache_key = (n, batch_size, shuffle, gated)
         if cache_key in self._epoch_fn_cache:
             return self._epoch_fn_cache[cache_key]
 
@@ -227,8 +231,17 @@ class FleetTrainer:
                 wb = wi[sel]
             return xb, yb, wb
 
-        def machine_epoch(params, opt_state, key, Xi, yi, wi):
-            """One epoch for ONE machine; vmapped over the fleet axis."""
+        def machine_epoch(params, opt_state, key, Xi, yi, wi, active=None):
+            """
+            One epoch for ONE machine; vmapped over the fleet axis.
+
+            ``active`` (scalar 0/1, gated variants only) gates the state
+            transition: an inactive (early-stopped) machine's params and
+            optimizer state come out EXACTLY as they went in —
+            zero-weighting alone would still let regularization-penalty
+            gradients, optimizer momentum, and weight decay drift the
+            params.
+            """
             ids = jnp.asarray(sample_ids)
             pmask = jnp.asarray(pad_mask)
             if shuffle:
@@ -260,29 +273,45 @@ class FleetTrainer:
                 return (p, o), (loss_sum, jnp.sum(wb))
 
             step_ids = jnp.arange(n_batches, dtype=jnp.int32)
-            (params, opt_state), (loss_sums, w_sums) = jax.lax.scan(
+            (new_params, new_opt), (loss_sums, w_sums) = jax.lax.scan(
                 step,
                 (params, opt_state),
                 (sel_all, pm_all, step_ids),
                 unroll=min(self.scan_unroll, n_batches),
             )
+            if gated:
+                keep = active > 0.5
+                params = jax.tree.map(
+                    lambda new, old: jnp.where(keep, new, old),
+                    new_params,
+                    params,
+                )
+                opt_state = jax.tree.map(
+                    lambda new, old: jnp.where(keep, new, old),
+                    new_opt,
+                    opt_state,
+                )
+            else:
+                params, opt_state = new_params, new_opt
             epoch_loss = jnp.sum(loss_sums) / jnp.maximum(jnp.sum(w_sums), 1.0)
             return params, opt_state, epoch_loss
 
+        n_args = 7 if gated else 6
         if self.broadcast_data:
             # one shared dataset; only params/opt/keys carry the fleet axis
-            fleet_epoch = jax.vmap(
-                machine_epoch, in_axes=(0, 0, 0, None, None, None)
-            )
+            in_axes = (0, 0, 0, None, None, None, 0)[:n_args]
+            fleet_epoch = jax.vmap(machine_epoch, in_axes=in_axes)
         else:
-            fleet_epoch = jax.vmap(machine_epoch)
+            fleet_epoch = jax.vmap(machine_epoch, in_axes=(0,) * n_args)
 
         jit_kwargs: dict = {}
         if self.mesh is not None:
             fs = fleet_sharding(self.mesh)
             rs = replicated_sharding(self.mesh)
             data_sh = rs if self.broadcast_data else fs
-            jit_kwargs["in_shardings"] = (fs, fs, fs, data_sh, data_sh, data_sh)
+            jit_kwargs["in_shardings"] = (
+                fs, fs, fs, data_sh, data_sh, data_sh, fs
+            )[:n_args]
             jit_kwargs["out_shardings"] = (fs, fs, fs)
         if self.donate:
             jit_kwargs["donate_argnums"] = (0, 1)
@@ -304,6 +333,9 @@ class FleetTrainer:
         extra_weight: Optional[jnp.ndarray] = None,
         checkpointer: Optional[Any] = None,
         checkpoint_every: int = 1,
+        early_stopping_patience: Optional[int] = None,
+        early_stopping_min_delta: float = 0.0,
+        early_stopping_start_from_epoch: int = 0,
     ) -> Tuple[Any, np.ndarray]:
         """
         Train the fleet. Returns (stacked params, losses (epochs, M)).
@@ -320,6 +352,19 @@ class FleetTrainer:
         (params, opt_state) every ``checkpoint_every`` epochs and, when the
         directory already holds checkpoints, resumes from the last
         completed epoch — preemption-safe long fleet builds.
+
+        ``early_stopping_patience`` enables PER-MACHINE early stopping by
+        loss masking (SURVEY.md §7.6): a machine whose epoch loss hasn't
+        improved by ``early_stopping_min_delta`` for that many epochs gets
+        zero sample weights from then on — its params freeze while the
+        rest of the fleet trains — and the loop ends early once every
+        machine has stopped. This syncs the (M,) losses to host each
+        epoch (the cost of the decision), and stopped machines still ride
+        along in the compiled program (gated, not compacted). Monitored
+        metric is the training loss; there is no per-machine best-weights
+        restore — a stopped machine keeps the params of its stopping
+        epoch, which (after ``patience`` non-improving epochs) may differ
+        from its best-epoch params.
         """
         if shuffle is None:
             shuffle = not self.spec.windowed
@@ -334,9 +379,37 @@ class FleetTrainer:
             opt_state = self.init_opt_state(params)
         keys = self._shard(jnp.asarray(keys))
 
+        early_stopping = early_stopping_patience is not None
+        m = len(keys)  # the fleet axis (== data.n_machines unless broadcast)
+        if early_stopping:
+            es_state = {
+                "best": np.full(m, np.inf, dtype=np.float64),
+                "wait": np.zeros(m, dtype=np.int64),
+                "active": np.ones(m, dtype=bool),
+                "last_loss": np.zeros(m, dtype=np.float64),
+            }
+            es_stop_at = max(int(early_stopping_patience), 1)
+            es_delta = abs(float(early_stopping_min_delta))
+
         start_epoch = 0
         if checkpointer is not None and checkpointer.latest_epoch() is not None:
-            params, opt_state, done = checkpointer.restore(params, opt_state)
+            if early_stopping:
+                params, opt_state, done, restored_es = (
+                    checkpointer.restore_with_extra(params, opt_state, es_state)
+                )
+                if restored_es is not None:
+                    es_state = {
+                        k: np.asarray(v) for k, v in restored_es.items()
+                    }
+                    es_state["active"] = es_state["active"].astype(bool)
+                else:
+                    logger.warning(
+                        "Resuming an early-stopping fleet fit without saved "
+                        "early-stop state (older checkpoint?): stopped "
+                        "machines will briefly reactivate"
+                    )
+            else:
+                params, opt_state, done = checkpointer.restore(params, opt_state)
             start_epoch = done + 1
             logger.info("Resuming fleet fit at epoch %d/%d", start_epoch, epochs)
 
@@ -358,21 +431,70 @@ class FleetTrainer:
         else:
             X_arg, y_arg, w_arg = data.X, data.y, w
 
-        epoch_fn = self._epoch_fn(data.n_timesteps, batch_size, shuffle)
+        epoch_fn = self._epoch_fn(
+            data.n_timesteps, batch_size, shuffle, gated=early_stopping
+        )
         losses = []
         for epoch in range(start_epoch, epochs):
             epoch_keys = jax.vmap(lambda k: jax.random.fold_in(k, epoch))(keys)
-            params, opt_state, epoch_loss = epoch_fn(
-                params, opt_state, epoch_keys, X_arg, y_arg, w_arg
-            )
+            if early_stopping:
+                active = jnp.asarray(es_state["active"].astype(np.float32))
+                if self.mesh is not None:
+                    active = jax.device_put(active, fleet_sharding(self.mesh))
+                params, opt_state, epoch_loss = epoch_fn(
+                    params, opt_state, epoch_keys, X_arg, y_arg, w_arg, active
+                )
+            else:
+                params, opt_state, epoch_loss = epoch_fn(
+                    params, opt_state, epoch_keys, X_arg, y_arg, w_arg
+                )
             # keep the loss on device: a host fetch here would sync every
             # epoch and stall the dispatch pipeline (costly over DCN/tunnel
             # links); all losses are pulled in one transfer after the loop
-            losses.append(epoch_loss)
+            # (except under early stopping, whose per-epoch decision IS a
+            # sync)
+            if early_stopping:
+                loss_np = np.asarray(jax.device_get(epoch_loss), dtype=np.float64)
+                # a stopped machine's computed loss reflects a discarded
+                # would-be update; report its last active loss instead
+                report = np.where(
+                    es_state["active"], loss_np, es_state["last_loss"]
+                )
+                losses.append(report)
+                es_state["last_loss"] = report
+                if epoch >= int(early_stopping_start_from_epoch):
+                    improved = es_state["active"] & (
+                        loss_np < es_state["best"] - es_delta
+                    )
+                    es_state["best"] = np.where(
+                        improved, loss_np, es_state["best"]
+                    )
+                    es_state["wait"] = np.where(
+                        improved, 0, es_state["wait"] + 1
+                    )
+                    es_state["active"] = es_state["active"] & (
+                        es_state["wait"] < es_stop_at
+                    )
+            else:
+                losses.append(epoch_loss)
             if checkpointer is not None and (epoch + 1) % max(
                 1, checkpoint_every
             ) == 0:
-                checkpointer.save(epoch, params, opt_state)
+                checkpointer.save(
+                    epoch,
+                    params,
+                    opt_state,
+                    extra=es_state if early_stopping else None,
+                )
+            if early_stopping and not es_state["active"].any():
+                logger.info(
+                    "Fleet early stop: all %d machines stopped at epoch "
+                    "%d/%d",
+                    m,
+                    epoch,
+                    epochs,
+                )
+                break
         if checkpointer is not None:
             checkpointer.wait()
         if losses:

@@ -141,3 +141,30 @@ def test_validation_split_bounds():
         AutoEncoder(
             kind="feedforward_hourglass", epochs=1, validation_split=1.0
         ).fit(X, X)
+
+
+def test_unsupported_keras_callbacks_are_tolerated():
+    """Callbacks with no native equivalent (e.g. ReduceLROnPlateau) must not
+    break fit or config expansion — they are dropped with a warning, like
+    the pre-callback-support behavior."""
+    from gordo_tpu.serializer import into_definition
+
+    cfg = {
+        "gordo_tpu.models.AutoEncoder": {
+            "kind": "feedforward_hourglass",
+            "epochs": 2,
+            "callbacks": [
+                {"keras.callbacks.EarlyStopping": {"monitor": "loss", "patience": 5}},
+                {"tensorflow.keras.callbacks.ReduceLROnPlateau": {"factor": 0.5}},
+                {"tensorflow.keras.callbacks.NoSuchCallbackAnywhere": {}},
+            ],
+        }
+    }
+    model = from_definition(cfg)
+    X = make_data()
+    model.fit(X, X)  # foreign/unresolvable callbacks skipped
+    assert len(model.history_["loss"]) == 2
+    expanded = into_definition(model)
+    kept = expanded["gordo_tpu.models.models.AutoEncoder"]["callbacks"]
+    kept_paths = [list(c)[0] if isinstance(c, dict) else c for c in kept]
+    assert all("EarlyStopping" in p or "NoSuchCallback" in p for p in kept_paths)

@@ -84,3 +84,39 @@ def test_keep_limit(tmp_path):
     assert len(steps) <= 2
     assert steps[-1] == 4
     ckpt.close()
+
+
+def test_checkpoint_extra_state_round_trip(tmp_path):
+    """Early-stopping (or other host) state rides next to the orbax step."""
+    import numpy as np
+
+    from gordo_tpu.parallel.checkpoint import FleetCheckpointer
+    from gordo_tpu.models.factories.feedforward import feedforward_hourglass
+    from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
+
+    rng = np.random.default_rng(0)
+    X = rng.random((40, 3)).astype("float32")
+    data = StackedData.from_ragged([X], [X.copy()])
+    trainer = FleetTrainer(feedforward_hourglass(n_features=3), donate=False)
+    keys = trainer.machine_keys(1)
+    params, _ = trainer.fit(data, keys, epochs=1, batch_size=16)
+    opt_state = trainer.init_opt_state(params)
+
+    ckpt = FleetCheckpointer(str(tmp_path))
+    extra = {"best": np.array([0.5]), "wait": np.array([2]),
+             "active": np.array([True]), "last_loss": np.array([0.6])}
+    ckpt.save(0, params, opt_state, extra=extra)
+    ckpt.wait()
+    p2, o2, epoch, restored = ckpt.restore_with_extra(params, opt_state, extra)
+    assert epoch == 0 and restored is not None
+    for key in extra:
+        np.testing.assert_array_equal(restored[key], extra[key])
+
+    # a checkpoint saved WITHOUT extra restores params and returns None
+    ckpt.save(1, params, opt_state)
+    ckpt.wait()
+    p3, o3, epoch, missing = ckpt.restore_with_extra(
+        params, opt_state, extra, epoch=1
+    )
+    assert epoch == 1 and missing is None
+    ckpt.close()

@@ -149,6 +149,96 @@ def test_fleet_predict_chunked_matches_direct():
         trainer.predict(params, data.X, batch_size=0)
 
 
+def test_fleet_early_stopping_masks_per_machine():
+    """A stopped machine's params freeze while the rest keep training."""
+    import jax
+
+    Xs, ys = make_fleet_data(m=2, n=80)
+    data = StackedData.from_ragged(Xs, ys)
+    spec = feedforward_hourglass(n_features=3)
+    trainer = FleetTrainer(spec, donate=False)
+    keys = trainer.machine_keys(2)
+
+    # huge min_delta: machine losses "never improve" after epoch 0, so with
+    # patience=2 everything stops at epoch 2 and the loop ends early
+    params, losses = trainer.fit(
+        data,
+        keys,
+        epochs=20,
+        batch_size=16,
+        early_stopping_patience=2,
+        early_stopping_min_delta=1e6,
+    )
+    assert losses.shape[0] == 3  # improve@0, wait@1, stop@2
+
+    # params must be EXACTLY frozen from the stopping epoch: identical to a
+    # plain fit that trains only the epochs the machine was active for.
+    # (adam momentum / penalties would otherwise keep drifting them, which
+    # zero-loss-weight masking alone cannot prevent)
+    frozen = trainer.fit(
+        data, keys, epochs=3, batch_size=16,
+        # stopped after epoch 2 ran; params from epochs 0-2 are kept
+    )[0]
+    for es_leaf, plain_leaf in zip(
+        jax.tree.leaves(params), jax.tree.leaves(frozen)
+    ):
+        np.testing.assert_array_equal(
+            np.asarray(es_leaf), np.asarray(plain_leaf)
+        )
+
+    # per-machine: a machine on constant data plateaus and stops while its
+    # fleet-mate keeps improving; its reported loss freezes at the last
+    # active value (not 0), and the mate's keeps falling
+    X_flat = np.full((60, 3), 0.5, dtype="float32")
+    t = np.linspace(0, 6, 60)
+    X_sig = np.stack([np.sin(t + i) for i in range(3)], 1).astype("float32")
+    d2 = StackedData.from_ragged([X_flat, X_sig], [X_flat.copy(), X_sig.copy()])
+    p2, l2 = trainer.fit(
+        d2, keys, epochs=30, batch_size=16,
+        early_stopping_patience=1, early_stopping_min_delta=1e-3,
+    )
+    m0 = l2[:, 0]
+    # frozen reported losses repeat the last active value exactly
+    assert m0[-1] == m0[-2]
+    assert m0[-1] > 0
+    # the still-active machine improved after machine 0 froze
+    assert l2[-1, 1] < l2[np.argmax(m0 == m0[-1]), 1]
+
+
+def test_fleet_build_honors_early_stopping_config():
+    """Machines configured with EarlyStopping train fewer epochs."""
+    machine = Machine(
+        name="es-m0",
+        project_name="p",
+        model={
+            "gordo_tpu.models.AutoEncoder": {
+                "kind": "feedforward_hourglass",
+                "epochs": 40,
+                "batch_size": 16,
+                "callbacks": [
+                    {
+                        "keras.callbacks.EarlyStopping": {
+                            "monitor": "loss",
+                            "patience": 1,
+                            "min_delta": 1000.0,
+                        }
+                    }
+                ],
+            }
+        },
+        dataset={
+            "type": "RandomDataset",
+            "train_start_date": "2017-12-25 06:00:00Z",
+            "train_end_date": "2017-12-27 06:00:00Z",
+            "tags": [["Tag 1", None], ["Tag 2", None]],
+        },
+    )
+    (model, machine_out), = FleetModelBuilder([machine]).build()
+    history = machine_out.metadata.build_metadata.model.model_meta["history"]
+    # min_delta=1000 -> stop at epoch 1, far below the 40-epoch budget
+    assert len(history["loss"]) == 2
+
+
 def make_machines(n, epochs=2):
     return [
         Machine(
