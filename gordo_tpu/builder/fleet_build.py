@@ -313,6 +313,11 @@ class FleetModelBuilder:
 
         for cb in _materialize_callbacks(fit_args.get("callbacks")):
             if not isinstance(cb, EarlyStopping):
+                logger.warning(
+                    "Fleet build: callback %s does not translate to the "
+                    "fleet path and is ignored there",
+                    type(cb).__name__,
+                )
                 continue
             if "loss" not in cb.monitor or cb.mode == "max":
                 logger.warning(

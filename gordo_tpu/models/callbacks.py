@@ -59,6 +59,23 @@ class Callback:
         return params
 
 
+class TerminateOnNaN(Callback):
+    """Stop training the moment any monitored loss goes non-finite
+    (the Keras callback of the same name)."""
+
+    def update(self, epoch: int, logs: typing.Dict[str, float], params) -> bool:
+        for name, value in logs.items():
+            if value is not None and not np.isfinite(value):
+                logger.warning(
+                    "TerminateOnNaN: %s=%r at epoch %d — stopping",
+                    name,
+                    value,
+                    epoch,
+                )
+                return True
+        return False
+
+
 class EarlyStopping(Callback):
     """
     Stop when a monitored metric stops improving (the Keras contract:

@@ -168,3 +168,26 @@ def test_unsupported_keras_callbacks_are_tolerated():
     kept = expanded["gordo_tpu.models.models.AutoEncoder"]["callbacks"]
     kept_paths = [list(c)[0] if isinstance(c, dict) else c for c in kept]
     assert all("EarlyStopping" in p or "NoSuchCallback" in p for p in kept_paths)
+
+
+def test_terminate_on_nan():
+    """NaN-poisoned input makes the loss non-finite at epoch 0; the
+    callback stops training immediately."""
+    from gordo_tpu.models.callbacks import TerminateOnNaN
+
+    X = make_data()
+    X[7, 1] = np.nan  # poisoned input -> NaN loss from epoch 0
+    model = AutoEncoder(
+        kind="feedforward_hourglass",
+        epochs=30,
+        batch_size=16,
+        callbacks=[{"tensorflow.keras.callbacks.TerminateOnNaN": {}}],
+    )
+    model.fit(X, X)
+    losses = model.history_["loss"]
+    assert len(losses) == 1
+    assert not np.isfinite(losses[-1])
+    # direct API too
+    cb = TerminateOnNaN()
+    assert cb.update(0, {"loss": float("nan")}, None)
+    assert not cb.update(0, {"loss": 1.0}, None)
