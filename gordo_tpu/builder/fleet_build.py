@@ -53,7 +53,7 @@ from gordo_tpu.models.anomaly.diff import DiffBasedAnomalyDetector
 from gordo_tpu.models.core import BaseJaxEstimator
 from gordo_tpu.parallel.bucketing import bucket_machines, timestep_bucket
 from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
-from gordo_tpu.parallel.mesh import get_device_mesh
+from gordo_tpu.parallel.mesh import auto_device_mesh
 
 logger = logging.getLogger(__name__)
 
@@ -104,10 +104,7 @@ class FleetModelBuilder:
     ):
         self.machines = machines
         if mesh is None and auto_mesh:
-            import jax
-
-            if len(jax.devices()) > 1:
-                mesh = get_device_mesh()
+            mesh = auto_device_mesh()
         self.mesh = mesh
         self.data_threads = data_threads
 

@@ -19,6 +19,7 @@ from typing import Any, List, Tuple, cast
 
 import click
 import jinja2
+import numpy as np
 import yaml
 
 from gordo_tpu import __version__, serializer
@@ -266,6 +267,132 @@ def get_all_score_strings(machine) -> List[str]:
     return all_scores
 
 
+@click.command("sweep")
+@click.argument("machine-config", envvar="MACHINE", type=yaml.safe_load)
+@click.option(
+    "--param",
+    "grid_params",
+    multiple=True,
+    required=True,
+    help="Hyperparameter grid entry 'name=v1,v2,...' (repeatable; all "
+    "entries must list the same number of values). Names are optax "
+    "optimizer args; the reference dialect's 'lr'/'decay' spellings work.",
+)
+@click.option("--epochs", type=int, default=None, help="Override model epochs")
+@click.option("--batch-size", type=int, default=None, help="Override batch size")
+@click.option(
+    "--exceptions-reporter-file",
+    envvar="EXCEPTIONS_REPORTER_FILE",
+    help="JSON output file for exception information",
+)
+@click.option(
+    "--exceptions-report-level",
+    type=click.Choice(ReportLevel.get_names(), case_sensitive=False),
+    default=ReportLevel.MESSAGE.name,
+    envvar="EXCEPTIONS_REPORT_LEVEL",
+    help="Detail level for exception reporting",
+)
+def sweep_cli(
+    machine_config: dict,
+    grid_params,
+    epochs,
+    batch_size,
+    exceptions_reporter_file,
+    exceptions_report_level,
+):
+    """
+    Tune MACHINE-CONFIG's optimizer hyperparameters: every grid variant
+    trains simultaneously as one vmapped program sharded over the fleet
+    mesh axis (the TPU-native replacement for one-Katib-trial-per-pod),
+    then per-trial losses print in Katib key=value form, best first.
+    Trials train with the SAME epochs/batch-size the build would use
+    (config values, or the build defaults), so rankings transfer.
+    """
+    grid: dict = {}
+    grid_len = None
+    for entry in grid_params:
+        name, _, values = entry.partition("=")
+        if not values:
+            raise click.BadParameter(f"--param needs name=v1,v2,... got {entry!r}")
+        try:
+            parsed = [float(v) for v in values.split(",")]
+        except ValueError:
+            raise click.BadParameter(
+                f"--param values must be numbers, got {entry!r}"
+            )
+        if grid_len is not None and len(parsed) != grid_len:
+            raise click.BadParameter(
+                "--param entries must list the same number of values "
+                f"({grid_len} vs {len(parsed)} in {entry!r})"
+            )
+        grid_len = len(parsed)
+        grid[name.strip()] = parsed
+
+    try:
+        from gordo_tpu.builder.fleet_build import (
+            _find_jax_estimator,
+            _prefix_transformers,
+        )
+        from gordo_tpu.data import _get_dataset
+        from gordo_tpu.parallel import HyperparamSweep, auto_device_mesh
+
+        machine = Machine.from_config(
+            machine_config,
+            project_name=machine_config.get("project_name", "sweep"),
+        )
+        model = serializer.from_definition(machine.model)
+        estimator = _find_jax_estimator(model)
+        if estimator is None:
+            raise click.ClickException(
+                "Sweeps need a JAX estimator in the model config"
+            )
+
+        dataset = _get_dataset(machine.dataset.to_dict())
+        X, y = dataset.get_data()
+        X_t = np.asarray(X, dtype="float32")
+        for transformer in _prefix_transformers(model):
+            X_t = np.asarray(transformer.fit_transform(X_t), dtype="float32")
+        y_t = np.asarray(y, dtype="float32") if y is not None else X_t
+
+        estimator.kwargs.update(
+            {"n_features": X_t.shape[1], "n_features_out": y_t.shape[1]}
+        )
+        spec = estimator._build_spec()
+
+        sweep = HyperparamSweep(
+            spec,
+            grid,
+            lookahead=estimator.lookahead if spec.windowed else 0,
+            mesh=auto_device_mesh(),
+        )
+        # same regime as build/build-fleet (core.py fit defaults), so the
+        # winning hyperparameters transfer to the build that uses them
+        result = sweep.fit(
+            X_t,
+            y_t,
+            epochs=(
+                epochs
+                if epochs is not None
+                else int(estimator.kwargs.get("epochs", 1))
+            ),
+            batch_size=(
+                batch_size
+                if batch_size is not None
+                else int(estimator.kwargs.get("batch_size", 32))
+            ),
+        )
+    except click.ClickException:
+        raise
+    except Exception:
+        _report_and_exit(exceptions_reporter_file, exceptions_report_level)
+    for trial, (hyperparams, loss) in enumerate(result.ranking()):
+        hp = " ".join(f"{k}={v:g}" for k, v in hyperparams.items())
+        print(f"trial-{trial}: {hp} loss={loss}")
+    best = " ".join(f"{k}={v:g}" for k, v in result.best_hyperparams.items())
+    print(f"best: {best}")
+    return 0
+
+
 @click.command("run-server")
 @click.option(
     "--host",
@@ -325,6 +452,7 @@ def run_server_cli(host, port, workers, threads, log_level, with_prometheus):
 gordo.add_command(workflow_cli)
 gordo.add_command(build)
 gordo.add_command(build_fleet)
+gordo.add_command(sweep_cli)
 gordo.add_command(run_server_cli)
 gordo.add_command(gordo_client)
 

@@ -17,7 +17,7 @@ problem, and one compiled program serves the whole bucket.
   windows (Transformer backend)
 """
 
-from .mesh import fleet_sharding, get_device_mesh, replicated_sharding
+from .mesh import auto_device_mesh, fleet_sharding, get_device_mesh, replicated_sharding
 from .fleet import FleetTrainer, StackedData
 from .bucketing import bucket_machines
 from .sequence import (
@@ -32,6 +32,7 @@ from .sweep import HyperparamSweep, SweepResult
 __all__ = [
     "HyperparamSweep",
     "SweepResult",
+    "auto_device_mesh",
     "get_device_mesh",
     "fleet_sharding",
     "replicated_sharding",

@@ -27,6 +27,19 @@ FLEET_AXIS = "fleet"
 DATA_AXIS = "data"
 
 
+def auto_device_mesh() -> Optional[Mesh]:
+    """
+    The default fleet mesh when more than one device is visible, else None
+    (single-device programs skip sharding entirely). The one place the
+    "should this process shard?" policy lives.
+    """
+    import jax
+
+    if len(jax.devices()) > 1:
+        return get_device_mesh()
+    return None
+
+
 def get_device_mesh(
     shape: Optional[Tuple[int, ...]] = None,
     axis_names: Sequence[str] = (FLEET_AXIS,),

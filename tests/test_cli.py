@@ -371,6 +371,44 @@ def test_workflow_unique_tags(runner, project_config_file, tmp_path):
     assert tags == {"tag-0", "tag-1", "tag-2", "tag-3"}
 
 
+def test_sweep_cli(runner):
+    """gordo-tpu sweep trains the grid as one program and ranks trials."""
+    machine_yaml = """
+name: sweep-cli-machine
+project_name: sweep-proj
+dataset:
+  type: RandomDataset
+  train_start_date: 2018-01-01T00:00:00+00:00
+  train_end_date: 2018-01-02T00:00:00+00:00
+  tags: [tag-0, tag-1]
+  asset: gra
+model:
+  gordo_tpu.models.AutoEncoder:
+    kind: feedforward_hourglass
+    epochs: 2
+    batch_size: 16
+"""
+    result = runner.invoke(
+        gordo,
+        ["sweep", machine_yaml, "--param", "lr=0.001,0.01"],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0, result.output
+    lines = result.output.strip().splitlines()
+    assert sum(1 for ln in lines if ln.startswith("trial-")) == 2
+    assert lines[-1].startswith("best: learning_rate=")
+    # ranked best-first
+    losses = [float(ln.rsplit("loss=", 1)[1]) for ln in lines if "loss=" in ln]
+    assert losses == sorted(losses)
+
+
+def test_sweep_cli_bad_grid(runner):
+    result = runner.invoke(
+        gordo, ["sweep", "{name: m, dataset: {}, model: {}}", "--param", "lr"]
+    )
+    assert result.exit_code != 0
+
+
 def test_client_cli_help(runner):
     result = runner.invoke(gordo, ["client", "--help"])
     assert result.exit_code == 0
