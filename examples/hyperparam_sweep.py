@@ -19,7 +19,7 @@ import numpy as np  # noqa: E402
 
 from gordo_tpu.data import RandomDataset  # noqa: E402
 from gordo_tpu.models.factories.feedforward import feedforward_hourglass  # noqa: E402
-from gordo_tpu.parallel import HyperparamSweep, get_device_mesh  # noqa: E402
+from gordo_tpu.parallel import HyperparamSweep, auto_device_mesh  # noqa: E402
 
 
 def main():
@@ -32,9 +32,7 @@ def main():
     X, y = dataset.get_data()
     print(f"data: {X.shape}")
 
-    import jax
-
-    mesh = get_device_mesh() if len(jax.devices()) > 1 else None
+    mesh = auto_device_mesh()
     spec = feedforward_hourglass(n_features=X.shape[1])
     sweep = HyperparamSweep(
         spec,
