@@ -170,3 +170,119 @@ def test_legacy_frequency_normalization():
     assert normalize_frequency("1S") == "1s"
     assert normalize_frequency("3min") == "3min"
     assert normalize_frequency("not-a-freq") == "not-a-freq"
+
+
+# -- resample/join semantics mirrored from the reference suite --------------
+import dateutil.parser
+
+START_DT = dateutil.parser.isoparse(START)
+END_DT = dateutil.parser.isoparse(END)
+
+
+def _series(values, index, name="Tag A"):
+    return pd.Series(values, index=index, name=name)
+
+
+def test_join_timeseries_interpolation_gaps():
+    """Gaps longer than interpolation_limit drop out of the joined frame."""
+    ds = make_dataset()
+    start, end = START_DT, END_DT
+    # 10-min samples with a 12h hole in one tag
+    full_idx = pd.date_range(start, end, freq="10min")
+    holey_idx = full_idx[(full_idx < full_idx[20]) | (full_idx > full_idx[92])]
+    s1 = _series(np.ones(len(full_idx)), full_idx, "Tag A")
+    s2 = _series(np.ones(len(holey_idx)), holey_idx, "Tag B")
+    joined = ds.join_timeseries(
+        [s1, s2], start, end, "10min", interpolation_limit="1h"
+    )
+    # the hole minus 1h of interpolated points is gone
+    assert len(joined) < len(full_idx) - 60
+    assert not joined.isna().any().any()
+
+    ds2 = make_dataset()
+    joined_nolimit = ds2.join_timeseries(
+        [s1.copy(), s2.copy()], start, end, "10min", interpolation_limit=None
+    )
+    assert len(joined_nolimit) > len(joined)
+
+
+def test_join_timeseries_bad_interpolation_args():
+    ds = make_dataset()
+    start, end = START_DT, END_DT
+    idx = pd.date_range(start, end, freq="10min")
+    s = _series(np.ones(len(idx)), idx)
+    with pytest.raises(ValueError, match="Interpolation method"):
+        ds.join_timeseries([s], start, end, "10min", interpolation_method="cubic")
+    with pytest.raises(ValueError, match="Interpolation limit"):
+        ds.join_timeseries([s], start, end, "10min", interpolation_limit="5min")
+
+
+def test_join_timeseries_ffill():
+    """ffill REPEATS the last value across a gap where linear interpolation
+    would produce intermediate values."""
+    start, end = START_DT, END_DT
+    idx = pd.date_range(start, end, freq="10min")
+    # a 2h hole between value plateaus 0.0 and 100.0
+    mask = (idx < idx[30]) | (idx > idx[42])
+    values = np.where(np.arange(len(idx)) < 30, 0.0, 100.0)[mask]
+    holey = _series(values, idx[mask])
+    filled = make_dataset().join_timeseries(
+        [holey.copy()], start, end, "10min", interpolation_method="ffill",
+        interpolation_limit="8h",
+    )
+    linear = make_dataset().join_timeseries(
+        [holey.copy()], start, end, "10min",
+        interpolation_method="linear_interpolation", interpolation_limit="8h",
+    )
+    gap = slice(idx[31], idx[41])
+    assert (filled.loc[gap, "Tag A"] == 0.0).all()  # repeated last value
+    between = linear.loc[gap, "Tag A"]
+    assert ((between > 0) & (between < 100)).any()  # interpolated ramp
+
+
+def test_aggregation_methods_multiindex():
+    """A list of aggregation methods yields (tag, method) MultiIndex columns
+    (reference: test_dataset.py:265)."""
+    ds = make_dataset(aggregation_methods=["mean", "max", "min"])
+    X, y = ds.get_data()
+    assert isinstance(X.columns, pd.MultiIndex)
+    assert set(X.columns.get_level_values("aggregation_method")) == {
+        "mean", "max", "min",
+    }
+    assert set(X.columns.get_level_values("tag")) == set(TAGS)
+
+
+def test_no_resolution_skips_resampling():
+    """resolution=None inner-joins raw series without resampling
+    (reference: test_dataset.py:324). One tag: RandomDataProvider's raw
+    indexes differ per tag, so the multi-tag inner join would be empty."""
+    tag = TAGS[:1]
+    raw, _ = make_dataset(resolution=None, tag_list=tag).get_data()
+    resampled, _ = make_dataset(tag_list=tag).get_data()
+    # the raw index keeps its irregular spacing; the resampled one is a grid
+    assert raw.index.to_series().diff().dropna().nunique() > 1
+    assert resampled.index.to_series().diff().dropna().nunique() == 1
+
+
+def test_join_timeseries_empty_series_is_insufficient():
+    """An empty series surfaces as InsufficientDataError, naming the tag."""
+    ds = make_dataset()
+    start, end = START_DT, END_DT
+    idx = pd.date_range(start, end, freq="10min")
+    good = _series(np.ones(len(idx)), idx, "good-tag")
+    empty = pd.Series([], dtype="float64", name="empty-tag")
+    with pytest.raises(InsufficientDataError, match="empty-tag"):
+        ds.join_timeseries([good, empty], start, end, "10min")
+
+
+def test_join_timeseries_non_utc_start():
+    """Differently-zoned (but equivalent) start/end work (reference:
+    test_dataset.py:141)."""
+    ds = make_dataset()
+    start = dateutil.parser.isoparse("2018-01-01T01:00:00+01:00")
+    end = dateutil.parser.isoparse("2018-01-03T02:00:00+02:00")
+    idx = pd.date_range(START_DT, END_DT, freq="10min")
+    joined = ds.join_timeseries(
+        [_series(np.ones(len(idx)), idx)], start, end, "10min"
+    )
+    assert len(joined) > 0
