@@ -353,6 +353,75 @@ def test_workflow_failure_semantics_rendered(runner, project_config_file):
     assert "readinessProbe" in container
 
 
+def test_workflow_generate_to_file(runner, project_config_file, tmp_path):
+    """--output-file writes the documents instead of stdout
+    (ref: test_workflow_generator.py:157)."""
+    out = tmp_path / "wf.yml"
+    result = runner.invoke(
+        gordo,
+        [
+            "workflow", "generate", "--machine-config", project_config_file,
+            "--project-name", "wf-proj", "--project-revision", "123",
+            "--output-file", str(out),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    docs = list(yaml.safe_load_all(out.read_text()))
+    assert docs and docs[0]["kind"] == "Workflow"
+
+
+def test_workflow_expected_models_env(runner, project_config_file):
+    """The server deployment carries EXPECTED_MODELS so /expected-models
+    serves the project's machine list (ref: test_workflow_generator.py:491)."""
+    docs = _render_workflows(runner, project_config_file)
+    blob = yaml.safe_dump_all(docs)
+    assert "EXPECTED_MODELS" in blob
+    wf = docs[0]
+    server_tpl = next(
+        t
+        for t in wf["spec"]["templates"]
+        if t["name"] == "gordo-server-deployment"
+    )
+    env_blob = json.dumps(server_tpl)
+    for name in ("wf-machine-0", "wf-machine-1", "wf-machine-2"):
+        assert name in env_blob
+
+
+def test_workflow_missing_timezone_rejected(runner, tmp_path):
+    """Naive timestamps in configs are config errors
+    (ref: test_workflow_generator.py:422)."""
+    config = PROJECT_YAML.replace(
+        "'2019-01-01T00:00:00+00:00'", "'2019-01-01T00:00:00'"
+    )
+    path = tmp_path / "naive.yml"
+    path.write_text(config)
+    result = runner.invoke(
+        gordo,
+        [
+            "workflow", "generate", "--machine-config", str(path),
+            "--project-name", "wf-proj",
+        ],
+    )
+    assert result.exit_code != 0
+    assert "timezone" in str(result.exception)
+
+
+def test_workflow_disable_influx(runner, tmp_path):
+    """All machines opting out of influx removes the influx/postgres stack
+    and the reporter wiring (ref: test_workflow_generator.py:326)."""
+    config = PROJECT_YAML.replace(
+        "  runtime:\n    builder:\n      machines_per_pod: 2",
+        "  runtime:\n    builder:\n      machines_per_pod: 2\n"
+        "    influx:\n      enable: false",
+    )
+    path = tmp_path / "no-influx.yml"
+    path.write_text(config)
+    docs = _render_workflows(runner, str(path))
+    blob = yaml.safe_dump_all(docs)
+    assert "gordo-influx" not in blob
+    assert "PostgresReporter" not in blob
+
+
 def test_workflow_unique_tags(runner, project_config_file, tmp_path):
     out = tmp_path / "tags.txt"
     result = runner.invoke(
