@@ -120,3 +120,47 @@ def test_filesystem_provider_year_files_and_status(tmp_path):
     assert provider.can_handle_tag(SensorTag("GRA-A", "gra"))
     (series,) = provider.load_series(START, END, [SensorTag("GRA-A", "gra")])
     assert len(series) == 22  # bad status rows dropped
+
+
+def test_filesystem_provider_prefers_parquet_over_csv(tmp_path):
+    """When both a parquet and a csv year file exist, parquet wins
+    (reference: ncs_reader.py ALL_FILE_LOOKUPS order)."""
+    tag_dir = tmp_path / "gra" / "GRA-B"
+    tag_dir.mkdir(parents=True)
+    index = pd.date_range("2019-01-01", periods=5, freq="1h", tz="UTC")
+    pd.DataFrame({"Time": index, "Value": [1.0] * 5}).to_parquet(
+        tag_dir / "GRA-B_2019.parquet"
+    )
+    pd.DataFrame({"Time": index, "Value": [2.0] * 5}).to_csv(
+        tag_dir / "GRA-B_2019.csv", index=False
+    )
+    provider = FileSystemProvider(base_dir=str(tmp_path))
+    (series,) = provider.load_series(START, END, [SensorTag("GRA-B", "gra")])
+    assert len(series) == 5
+    assert (series == 1.0).all()  # parquet values, not the csv's
+
+
+def test_filesystem_provider_cannot_handle_unknown_tag(tmp_path):
+    provider = FileSystemProvider(base_dir=str(tmp_path))
+    assert not provider.can_handle_tag(SensorTag("NOPE-1", "missing-asset"))
+
+
+def test_filesystem_provider_dry_run(tmp_path, caplog):
+    """dry_run logs what would load (and still yields the series)."""
+    import logging
+
+    tag_dir = tmp_path / "gra" / "GRA-C"
+    tag_dir.mkdir(parents=True)
+    index = pd.date_range("2019-01-01", periods=5, freq="1h", tz="UTC")
+    pd.DataFrame({"Time": index, "Value": [1.0] * 5}).to_parquet(
+        tag_dir / "GRA-C_2019.parquet"
+    )
+    provider = FileSystemProvider(base_dir=str(tmp_path))
+    with caplog.at_level(logging.INFO, logger="gordo_tpu.data.providers.filesystem"):
+        series = list(
+            provider.load_series(
+                START, END, [SensorTag("GRA-C", "gra")], dry_run=True
+            )
+        )
+    assert len(series) == 1 and len(series[0]) == 5
+    assert any("Dry run" in record.message for record in caplog.records)
