@@ -232,3 +232,51 @@ def test_model_from_yaml_definition_legacy_path():
     )
     assert isinstance(model, AutoEncoder)
     assert model.kwargs["epochs"] == 2
+
+
+def test_factory_dim_func_mismatch_raises():
+    """Mismatched dims/funcs raise, like the reference factories
+    (ref: test_feedforward_autoencoder.py:65, test_lstm_autoencoder.py:34)."""
+    from gordo_tpu.models.factories.feedforward import feedforward_model
+    from gordo_tpu.models.factories.lstm import lstm_model
+
+    with pytest.raises(ValueError, match="encoding"):
+        feedforward_model(
+            n_features=4,
+            encoding_dim=(8, 4),
+            encoding_func=("tanh",),  # one func for two dims
+            decoding_dim=(4, 8),
+            decoding_func=("tanh", "tanh"),
+        )
+    with pytest.raises(ValueError, match="decoding"):
+        lstm_model(
+            n_features=4,
+            lookback_window=4,
+            encoding_dim=(8,),
+            encoding_func=("tanh",),
+            decoding_dim=(8, 16),
+            decoding_func=("tanh",),
+        )
+
+
+def test_hourglass_validation_bounds():
+    """compression_factor and encoding_layers bounds are validated
+    (ref: test_feedforward_autoencoder.py:182-196)."""
+    from gordo_tpu.models.factories.utils import hourglass_calc_dims
+
+    with pytest.raises(ValueError, match="compression_factor"):
+        hourglass_calc_dims(1.5, 3, 10)
+    with pytest.raises(ValueError, match="compression_factor"):
+        hourglass_calc_dims(-0.1, 3, 10)
+    with pytest.raises(ValueError, match="encoding_layers"):
+        hourglass_calc_dims(0.5, 0, 10)
+
+
+def test_hourglass_compression_factor_extremes():
+    """compression_factor 1 keeps full width; 0 bottoms out at one unit
+    (ref: test_feedforward_autoencoder.py:138)."""
+    from gordo_tpu.models.factories.utils import hourglass_calc_dims
+
+    assert tuple(hourglass_calc_dims(1.0, 3, 10)) == (10, 10, 10)
+    # factor 0: linear ramp down to a single unit
+    assert tuple(hourglass_calc_dims(0.0, 3, 10)) == (7, 4, 1)
