@@ -262,8 +262,6 @@ def test_factory_dim_func_mismatch_raises():
 def test_hourglass_validation_bounds():
     """compression_factor and encoding_layers bounds are validated
     (ref: test_feedforward_autoencoder.py:182-196)."""
-    from gordo_tpu.models.factories.utils import hourglass_calc_dims
-
     with pytest.raises(ValueError, match="compression_factor"):
         hourglass_calc_dims(1.5, 3, 10)
     with pytest.raises(ValueError, match="compression_factor"):
@@ -275,8 +273,6 @@ def test_hourglass_validation_bounds():
 def test_hourglass_compression_factor_extremes():
     """compression_factor 1 keeps full width; 0 bottoms out at one unit
     (ref: test_feedforward_autoencoder.py:138)."""
-    from gordo_tpu.models.factories.utils import hourglass_calc_dims
-
-    assert tuple(hourglass_calc_dims(1.0, 3, 10)) == (10, 10, 10)
+    assert hourglass_calc_dims(1.0, 3, 10) == (10, 10, 10)
     # factor 0: linear ramp down to a single unit
-    assert tuple(hourglass_calc_dims(0.0, 3, 10)) == (7, 4, 1)
+    assert hourglass_calc_dims(0.0, 3, 10) == (7, 4, 1)
