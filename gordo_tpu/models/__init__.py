@@ -7,6 +7,8 @@ from .base import GordoBase
 from .core import BaseJaxEstimator
 from .models import (
     AutoEncoder,
+    GRUAutoEncoder,
+    GRUForecast,
     KerasAutoEncoder,
     KerasLSTMAutoEncoder,
     KerasLSTMForecast,
@@ -27,6 +29,8 @@ __all__ = [
     "GordoBase",
     "BaseJaxEstimator",
     "AutoEncoder",
+    "GRUAutoEncoder",
+    "GRUForecast",
     "LSTMAutoEncoder",
     "LSTMForecast",
     "LSTMBaseEstimator",

@@ -1,19 +1,26 @@
 """
-LSTM autoencoder / forecast factories (reference parity:
-gordo/machine/model/factories/lstm_autoencoder.py). Registered under both
-LSTMAutoEncoder and LSTMForecast types, like the reference.
+GRU autoencoder / forecast factories — a recurrent family beyond the
+reference's ceiling (its recurrent zoo is LSTM-only,
+gordo/machine/model/factories/lstm_autoencoder.py). GRUs carry 3 gates to
+the LSTM's 4, so the same-size model is ~25% fewer recurrent FLOPs/params
+— often the better fit for the small per-tag models this framework fleets.
+Same windowed many-to-one contract and factory trio as the LSTM family.
 """
 
 from typing import Any, Dict, Optional, Tuple, Union
 
 from gordo_tpu.models.register import register_model_builder
-from gordo_tpu.models.specs import LSTMNet, ModelSpec, resolve_dtype
 
-from .utils import check_dim_func_len, hourglass_calc_dims
+from .lstm import recurrent_spec
+from .utils import hourglass_calc_dims
+
+# re-exported for ModelSpec type hints in signatures below
+from gordo_tpu.models.specs import ModelSpec  # noqa: E402  isort:skip
 
 
-def recurrent_spec(
-    cell: str,
+@register_model_builder(type="GRUAutoEncoder")
+@register_model_builder(type="GRUForecast")
+def gru_model(
     n_features: int,
     n_features_out: Optional[int] = None,
     lookback_window: int = 1,
@@ -26,57 +33,15 @@ def recurrent_spec(
     optimizer_kwargs: Dict[str, Any] = dict(),
     compile_kwargs: Dict[str, Any] = dict(),
     dtype: Union[str, Any] = "float32",
-    fused: bool = False,
-) -> ModelSpec:
-    """Shared builder behind the lstm_* and gru_* factory trios."""
-    n_features_out = n_features_out or n_features
-    check_dim_func_len("encoding", encoding_dim, encoding_func)
-    check_dim_func_len("decoding", decoding_dim, decoding_func)
-
-    module = LSTMNet(
-        layer_dims=tuple(encoding_dim) + tuple(decoding_dim),
-        layer_funcs=tuple(encoding_func) + tuple(decoding_func),
-        out_dim=n_features_out,
-        out_func=out_func,
-        cell=cell,
-        fused=fused,
-        dtype=resolve_dtype(dtype),
-    )
-    return ModelSpec(
-        module=module,
-        optimizer=optimizer,
-        optimizer_kwargs=dict(optimizer_kwargs),
-        loss=dict(compile_kwargs).get("loss", "mse"),
-        windowed=True,
-        lookback_window=lookback_window,
-    )
-
-
-@register_model_builder(type="LSTMAutoEncoder")
-@register_model_builder(type="LSTMForecast")
-def lstm_model(
-    n_features: int,
-    n_features_out: Optional[int] = None,
-    lookback_window: int = 1,
-    encoding_dim: Tuple[int, ...] = (256, 128, 64),
-    encoding_func: Tuple[str, ...] = ("tanh", "tanh", "tanh"),
-    decoding_dim: Tuple[int, ...] = (64, 128, 256),
-    decoding_func: Tuple[str, ...] = ("tanh", "tanh", "tanh"),
-    out_func: str = "linear",
-    optimizer: str = "Adam",
-    optimizer_kwargs: Dict[str, Any] = dict(),
-    compile_kwargs: Dict[str, Any] = dict(),
-    dtype: Union[str, Any] = "float32",
-    fused: bool = False,
     **kwargs,
 ) -> ModelSpec:
-    """
-    Stacked LSTM encoder/decoder with a Dense head on the last timestep.
-    ``fused=True`` hoists input projections out of the time scan
-    (specs.FusedLSTMLayer) — same math, TPU-friendlier schedule.
-    """
+    """Stacked GRU encoder/decoder with a Dense head on the last timestep."""
+    if kwargs.pop("fused", False):
+        # an LSTM config copied over with fused: true must fail loudly, not
+        # silently train unfused
+        raise ValueError("fused input projections are LSTM-only")
     return recurrent_spec(
-        "lstm",
+        "gru",
         n_features,
         n_features_out,
         lookback_window=lookback_window,
@@ -89,13 +54,12 @@ def lstm_model(
         optimizer_kwargs=optimizer_kwargs,
         compile_kwargs=compile_kwargs,
         dtype=dtype,
-        fused=fused,
     )
 
 
-@register_model_builder(type="LSTMAutoEncoder")
-@register_model_builder(type="LSTMForecast")
-def lstm_symmetric(
+@register_model_builder(type="GRUAutoEncoder")
+@register_model_builder(type="GRUForecast")
+def gru_symmetric(
     n_features: int,
     n_features_out: Optional[int] = None,
     lookback_window: int = 1,
@@ -107,10 +71,10 @@ def lstm_symmetric(
     dtype: Union[str, Any] = "float32",
     **kwargs,
 ) -> ModelSpec:
-    """Symmetric stacked-LSTM model."""
+    """Symmetric stacked-GRU model."""
     if len(dims) == 0:
         raise ValueError("Parameter dims must have len > 0")
-    return lstm_model(
+    return gru_model(
         n_features,
         n_features_out,
         lookback_window=lookback_window,
@@ -126,9 +90,9 @@ def lstm_symmetric(
     )
 
 
-@register_model_builder(type="LSTMAutoEncoder")
-@register_model_builder(type="LSTMForecast")
-def lstm_hourglass(
+@register_model_builder(type="GRUAutoEncoder")
+@register_model_builder(type="GRUForecast")
+def gru_hourglass(
     n_features: int,
     n_features_out: Optional[int] = None,
     lookback_window: int = 1,
@@ -141,9 +105,9 @@ def lstm_hourglass(
     dtype: Union[str, Any] = "float32",
     **kwargs,
 ) -> ModelSpec:
-    """Hourglass stacked-LSTM model."""
+    """Hourglass stacked-GRU model."""
     dims = hourglass_calc_dims(compression_factor, encoding_layers, n_features)
-    return lstm_symmetric(
+    return gru_symmetric(
         n_features,
         n_features_out,
         lookback_window=lookback_window,

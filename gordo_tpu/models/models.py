@@ -195,6 +195,27 @@ class TCNForecast(LSTMBaseEstimator):
         return 1
 
 
+class GRUAutoEncoder(LSTMBaseEstimator):
+    """
+    Stacked-GRU window reconstructor — a recurrent family beyond the
+    reference's LSTM-only zoo (3 gates to the LSTM's 4: ~25% fewer
+    recurrent FLOPs/params at equal width). Architecture from
+    factories/gru.py; same windowed contract as LSTMAutoEncoder.
+    """
+
+    @property
+    def lookahead(self) -> int:
+        return 0
+
+
+class GRUForecast(LSTMBaseEstimator):
+    """Stacked-GRU 1-step-ahead forecaster (new backend)."""
+
+    @property
+    def lookahead(self) -> int:
+        return 1
+
+
 # layer path/name -> SequentialNet layer kind
 _RAW_LAYER_KINDS = {
     "dense": "dense",

@@ -223,10 +223,15 @@ class LSTMNet(nn.Module):
     out_dim: int
     out_func: str = "linear"
     fused: bool = False
+    cell: str = "lstm"  # "lstm" | "gru"
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):  # x: (batch, time, features)
+        if self.cell not in ("lstm", "gru"):
+            raise ValueError(f"Unknown recurrent cell {self.cell!r}")
+        if self.fused and self.cell != "lstm":
+            raise ValueError("fused input projections are LSTM-only")
         for dim, func in zip(self.layer_dims, self.layer_funcs):
             if self.fused:
                 x = FusedLSTMLayer(
@@ -235,11 +240,18 @@ class LSTMNet(nn.Module):
                     dtype=self.dtype,
                 )(x)
             else:
-                cell = nn.OptimizedLSTMCell(
-                    dim,
-                    activation_fn=resolve_activation(func),
-                    dtype=self.dtype,
-                )
+                if self.cell == "gru":
+                    cell = nn.GRUCell(
+                        dim,
+                        activation_fn=resolve_activation(func),
+                        dtype=self.dtype,
+                    )
+                else:
+                    cell = nn.OptimizedLSTMCell(
+                        dim,
+                        activation_fn=resolve_activation(func),
+                        dtype=self.dtype,
+                    )
                 x = nn.RNN(cell)(x)
         x = x[:, -1, :]
         x = nn.Dense(self.out_dim, dtype=self.dtype)(x)

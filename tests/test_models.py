@@ -276,3 +276,101 @@ def test_hourglass_compression_factor_extremes():
     assert hourglass_calc_dims(1.0, 3, 10) == (10, 10, 10)
     # factor 0: linear ramp down to a single unit
     assert hourglass_calc_dims(0.0, 3, 10) == (7, 4, 1)
+
+
+# -- GRU models (new recurrent family beyond the reference's LSTM zoo) ------
+@pytest.mark.parametrize("kind", ["gru_model", "gru_symmetric", "gru_hourglass"])
+def test_gru_autoencoder_fit_predict(kind):
+    from gordo_tpu.models import GRUAutoEncoder
+
+    X, y = make_data(n=60, f=3)
+    model = GRUAutoEncoder(kind=kind, lookback_window=5, epochs=1, batch_size=16)
+    model.fit(X, y)
+    assert model.predict(X).shape == (60 - 5 + 1, 3)
+
+
+def test_gru_forecast_and_pickle():
+    from gordo_tpu.models import GRUForecast
+
+    X, _ = make_data(n=40, f=2)
+    model = GRUForecast(kind="gru_symmetric", lookback_window=4, epochs=1,
+                        dims=(8,), funcs=("tanh",))
+    model.fit(X, X)
+    out = model.predict(X)
+    assert out.shape == (40 - 4, 2)  # lookahead 1
+    restored = pickle.loads(pickle.dumps(model))
+    np.testing.assert_allclose(out, restored.predict(X), rtol=1e-5)
+
+
+def test_gru_from_definition():
+    from gordo_tpu.models import GRUAutoEncoder
+    from gordo_tpu.serializer import from_definition, into_definition
+
+    cfg = {
+        "gordo_tpu.models.GRUAutoEncoder": {
+            "kind": "gru_hourglass",
+            "lookback_window": 4,
+            "epochs": 1,
+        }
+    }
+    model = from_definition(cfg)
+    assert isinstance(model, GRUAutoEncoder)
+    expanded = into_definition(model)
+    (path,) = expanded
+    assert path.endswith("GRUAutoEncoder")
+
+
+def test_gru_has_fewer_params_than_lstm():
+    """The family's point: 3 gates vs 4 at equal width."""
+    import jax
+    import jax.numpy as jnp
+
+    from gordo_tpu.models.factories.gru import gru_model
+    from gordo_tpu.models.factories.lstm import lstm_model
+
+    def n_params(spec):
+        params = spec.module.init(jax.random.PRNGKey(0), jnp.zeros((1, 5, 3)))
+        return sum(p.size for p in jax.tree.leaves(params))
+
+    common = dict(n_features=3, lookback_window=5, encoding_dim=(16,),
+                  encoding_func=("tanh",), decoding_dim=(16,),
+                  decoding_func=("tanh",))
+    assert n_params(gru_model(**common)) < n_params(lstm_model(**common))
+
+
+def test_gru_fused_rejected():
+    import jax
+    import jax.numpy as jnp
+
+    from gordo_tpu.models.specs import LSTMNet
+
+    net = LSTMNet(layer_dims=(4,), layer_funcs=("tanh",), out_dim=2,
+                  cell="gru", fused=True)
+    with pytest.raises(ValueError, match="LSTM-only"):
+        net.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 2)))
+
+
+def test_gru_fleet_trains():
+    from gordo_tpu.models.factories.gru import gru_model
+    from gordo_tpu.parallel import FleetTrainer, StackedData
+
+    rng = np.random.default_rng(0)
+    Xs = [rng.random((50, 3)).astype("float32") for _ in range(2)]
+    data = StackedData.from_ragged(Xs, [x.copy() for x in Xs])
+    spec = gru_model(n_features=3, lookback_window=4, encoding_dim=(8,),
+                     encoding_func=("tanh",), decoding_dim=(8,),
+                     decoding_func=("tanh",))
+    trainer = FleetTrainer(spec, lookahead=0)
+    params, losses = trainer.fit(data, trainer.machine_keys(2), epochs=1,
+                                 batch_size=16)
+    assert losses.shape == (1, 2)
+    assert trainer.predict(params, data.X).shape == (2, 47, 3)
+
+
+def test_gru_config_fused_rejected():
+    """An LSTM config copied to the GRU family with fused: true fails
+    loudly instead of silently training unfused."""
+    from gordo_tpu.models.factories.gru import gru_model
+
+    with pytest.raises(ValueError, match="LSTM-only"):
+        gru_model(n_features=3, lookback_window=4, fused=True)
