@@ -10,12 +10,10 @@ Same windowed many-to-one contract and factory trio as the LSTM family.
 from typing import Any, Dict, Optional, Tuple, Union
 
 from gordo_tpu.models.register import register_model_builder
+from gordo_tpu.models.specs import ModelSpec
 
 from .lstm import recurrent_spec
 from .utils import hourglass_calc_dims
-
-# re-exported for ModelSpec type hints in signatures below
-from gordo_tpu.models.specs import ModelSpec  # noqa: E402  isort:skip
 
 
 @register_model_builder(type="GRUAutoEncoder")
