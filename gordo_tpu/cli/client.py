@@ -111,7 +111,7 @@ def client(ctx: click.Context, *args, **kwargs):
     "--fleet/--no-fleet",
     default=False,
     help="Batch groups of machines into single fleet-endpoint requests "
-    "(one vmapped device dispatch per group; JSON transport)",
+    "(one vmapped device dispatch per group; JSON or parquet per --parquet)",
 )
 @click.option(
     "--fleet-group-size",

@@ -264,8 +264,9 @@ class Client:
 
         Falls back to the per-machine path (`predict_single_machine`) for a
         whole group when the fleet endpoint refuses it (e.g. 422: a group
-        containing non-anomaly models). Requests are JSON (the fleet
-        endpoints take per-machine frames in one JSON body).
+        containing non-anomaly models). Requests carry per-machine frames
+        in one JSON body, or as parquet multipart parts when the client
+        was built with ``use_parquet=True``.
 
         Returns the same ``(name, frame, errors)`` list as :meth:`predict`.
         """
@@ -340,6 +341,7 @@ class Client:
         errors: typing.Dict[str, typing.List[str]] = {name: [] for name in data}
         for k in range(n_chunks):
             payload: typing.Dict[str, Any] = {}
+            chunk_names: typing.List[str] = []
             for name, (machine, X, y) in data.items():
                 if k >= len(chunk_bounds[name]):
                     continue
@@ -347,7 +349,23 @@ class Client:
                 Xc = X.iloc[chunk]
                 if not len(Xc):
                     continue
-                if anomaly:
+                chunk_names.append(name)
+                if self.use_parquet:
+                    # multipart parts: <name> (base) / <name>.X + <name>.y
+                    if anomaly:
+                        payload[f"{name}.X"] = (
+                            server_utils.dataframe_into_parquet_bytes(Xc)
+                        )
+                        payload[f"{name}.y"] = (
+                            server_utils.dataframe_into_parquet_bytes(
+                                y.iloc[chunk]
+                            )
+                        )
+                    else:
+                        payload[name] = (
+                            server_utils.dataframe_into_parquet_bytes(Xc)
+                        )
+                elif anomaly:
                     payload[name] = {
                         "X": server_utils.dataframe_to_dict(Xc),
                         "y": server_utils.dataframe_to_dict(y.iloc[chunk]),
@@ -373,8 +391,10 @@ class Client:
                 # mid-stream failure (or a refusal after earlier chunks
                 # were already forwarded): record the failed chunk per
                 # machine — re-running the whole group would duplicate
-                # forwarder side effects and double the retry wall-clock
-                for name in payload:
+                # forwarder side effects and double the retry wall-clock.
+                # (chunk_names, not payload keys: parquet anomaly parts
+                # are keyed '<name>.X'/'<name>.y')
+                for name in chunk_names:
                     (s, e) = chunk_bounds[name][k]
                     errors[name].append(
                         f"Fleet chunk rows {s}:{e} failed for "
@@ -420,14 +440,15 @@ class Client:
 
         410 propagates (deployment revision gone, like the per-machine path).
         """
+        post_kwargs: typing.Dict[str, Any] = {"params": {"revision": revision}}
+        if self.use_parquet:
+            post_kwargs["files"] = payload
+        else:
+            post_kwargs["json"] = {"machines": payload}
         for current_attempt in itertools.count(start=1):
             try:
                 return "ok", handle_response(
-                    self.session.post(
-                        url,
-                        json={"machines": payload},
-                        params={"revision": revision},
-                    )
+                    self.session.post(url, **post_kwargs)
                 )
             except (
                 IOError,

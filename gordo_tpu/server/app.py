@@ -534,14 +534,14 @@ class GordoApp:
         (SURVEY.md §2.10(c); no reference equivalent — the reference's unit
         of serving is one model per POST, views/base.py:107-187).
 
-        Body: ``{"machines": {<name>: <X as dict-of-dicts or list-of-rows>}}``.
+        Body: ``{"machines": {<name>: <X as dict-of-dicts or list-of-rows>}}``
+        as JSON, or multipart with one parquet part per machine name.
         Returns the base-prediction frame per machine (model-input /
         model-output), computed by one vmapped dispatch per architecture
         group rather than one forward per machine.
         """
-        body = request.get_json(silent=True) or {}
-        machines = body.get("machines")
-        if not isinstance(machines, dict) or not machines:
+        machines = self._fleet_request_machines(request, anomaly=False)
+        if machines is None:
             return _json_response(
                 {"error": "Body must contain a non-empty 'machines' mapping."}, 400
             )
@@ -610,12 +610,47 @@ class GordoApp:
 
     @staticmethod
     def _parse_fleet_frame(raw, columns: typing.List[str]) -> pd.DataFrame:
-        """Dict-of-dicts or list-of-rows -> verified DataFrame."""
-        if isinstance(raw, dict):
+        """Dict-of-dicts, list-of-rows, or parquet bytes -> verified frame."""
+        if isinstance(raw, bytes):
+            frame = server_utils.dataframe_from_parquet_bytes(raw)
+        elif isinstance(raw, dict):
             frame = server_utils.dataframe_from_dict(raw)
         else:
             frame = pd.DataFrame(np.asarray(raw, dtype="float64"))
         return server_utils.verify_dataframe(frame, columns)
+
+    @staticmethod
+    def _fleet_request_machines(
+        request: Request, anomaly: bool
+    ) -> typing.Optional[dict]:
+        """
+        The per-machine payloads of a fleet request. JSON bodies carry
+        ``{"machines": {...}}``; multipart carries one parquet part per
+        machine (``<name>`` for base prediction, ``<name>.X`` /
+        ``<name>.y`` for anomaly) — the fleet flavor of the reference's
+        JSON/parquet duality. Returns None when neither form is present.
+        """
+        if request.files:
+            machines: typing.Dict[str, typing.Any] = {}
+            for key, part in request.files.items():
+                if anomaly:
+                    name, _, role = key.rpartition(".")
+                    if role not in ("X", "y") or not name:
+                        raise ApiError(
+                            {
+                                "error": "Anomaly fleet multipart parts "
+                                "must be named '<machine>.X' / "
+                                f"'<machine>.y', got {key!r}"
+                            },
+                            400,
+                        )
+                    machines.setdefault(name, {})[role] = part.read()
+                else:
+                    machines[key] = part.read()
+            return machines or None
+        body = request.get_json(silent=True) or {}
+        machines = body.get("machines")
+        return machines if isinstance(machines, dict) and machines else None
 
     def view_fleet_anomaly_prediction(
         self, ctx, request, gordo_project: str
@@ -624,7 +659,8 @@ class GordoApp:
         Batched multi-machine anomaly scoring (TPU extension; the
         reference's unit is one model per POST, views/anomaly.py:99-147).
 
-        Body: ``{"machines": {<name>: {"X": <frame>, "y": <frame>}}}``.
+        Body: ``{"machines": {<name>: {"X": <frame>, "y": <frame>}}}`` as
+        JSON, or multipart with ``<name>.X`` / ``<name>.y`` parquet parts.
         The base-estimator forwards for all machines run as one vmapped
         dispatch per architecture group from TPU-resident stacked params;
         each machine's anomaly frame (thresholds, confidences, smoothing)
@@ -634,9 +670,8 @@ class GordoApp:
         """
         from gordo_tpu.models.anomaly.base import AnomalyDetectorBase
 
-        body = request.get_json(silent=True) or {}
-        machines = body.get("machines")
-        if not isinstance(machines, dict) or not machines:
+        machines = self._fleet_request_machines(request, anomaly=True)
+        if machines is None:
             return _json_response(
                 {"error": "Body must contain a non-empty 'machines' mapping."}, 400
             )

@@ -128,8 +128,10 @@ def test_predict_fallback_on_non_anomaly_model(ml_server):
     assert client.prediction_path == "/anomaly/prediction"
 
 
-def test_predict_fleet_matches_per_machine(ml_server):
-    """Fleet-batched client results equal the per-machine path's."""
+@pytest.mark.parametrize("use_parquet", [False, True])
+def test_predict_fleet_matches_per_machine(ml_server, use_parquet):
+    """Fleet-batched client results equal the per-machine path's, over
+    both transports (JSON body and parquet multipart)."""
     forwarded = []
 
     def forwarder(predictions=None, machine=None, metadata=dict(), **kwargs):
@@ -143,6 +145,7 @@ def test_predict_fleet_matches_per_machine(ml_server):
         prediction_forwarder=forwarder,
         parallelism=2,
         batch_size=17,  # force several row-chunks per group
+        use_parquet=use_parquet,
     )
     fleet = dict(
         (n, (p, e))
@@ -152,11 +155,28 @@ def test_predict_fleet_matches_per_machine(ml_server):
         (n, (p, e)) for n, p, e in client.predict(START, END, targets=GORDO_TARGETS)
     )
     assert set(fleet) == set(single) == set(GORDO_TARGETS)
+
+    def norm(frame):
+        # JSON dict round-trips label single-child groups ("total-…", "t")
+        # with the group name repeated where parquet keeps "", and parquet
+        # preserves float32 where JSON upcasts; normalize representation,
+        # compare values
+        out = frame.copy()
+        for col in out.columns:
+            if out[col].dtype.kind == "f":
+                out[col] = out[col].astype("float64")
+        out.columns = pd.MultiIndex.from_tuples(
+            [(a, "" if b == a else b) for a, b in frame.columns]
+        )
+        return out
+
     for name in fleet:
         fp, fe = fleet[name]
         sp, se = single[name]
         assert fe == [] and se == []
-        pd.testing.assert_frame_equal(fp, sp, check_exact=False, rtol=1e-4, atol=1e-6)
+        pd.testing.assert_frame_equal(
+            norm(fp), norm(sp), check_exact=False, rtol=1e-4, atol=1e-6
+        )
     assert GORDO_SINGLE_TARGET in forwarded
 
 

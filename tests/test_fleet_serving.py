@@ -332,3 +332,52 @@ def test_fleet_anomaly_requires_y(gordo_ml_server_client, sensor_frame):
     )
     assert resp.status_code == 400
     assert "y" in json.loads(resp.get_data())["message"]
+
+
+def test_fleet_prediction_parquet_multipart(gordo_ml_server_client, sensor_frame):
+    """Fleet endpoints accept one parquet part per machine (the fleet
+    flavor of the reference's JSON/parquet duality)."""
+    import io
+
+    from tests.conftest import GORDO_PROJECT, GORDO_SINGLE_TARGET
+
+    from gordo_tpu.server.utils import dataframe_into_parquet_bytes
+
+    blob = dataframe_into_parquet_bytes(sensor_frame)
+    resp = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/prediction/fleet",
+        data={GORDO_SINGLE_TARGET: (io.BytesIO(blob), GORDO_SINGLE_TARGET)},
+    )
+    assert resp.status_code == 200, resp.get_data()
+    payload = json.loads(resp.get_data())
+    assert GORDO_SINGLE_TARGET in payload["data"]
+
+    # anomaly flavor: <name>.X / <name>.y parts
+    resp = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/anomaly/prediction/fleet",
+        data={
+            f"{GORDO_SINGLE_TARGET}.X": (io.BytesIO(blob), "X"),
+            f"{GORDO_SINGLE_TARGET}.y": (io.BytesIO(blob), "y"),
+        },
+    )
+    assert resp.status_code == 200, resp.get_data()
+    frame = json.loads(resp.get_data())["data"][GORDO_SINGLE_TARGET]
+    assert "total-anomaly-scaled" in frame
+
+
+def test_fleet_anomaly_bad_multipart_key_is_explained(
+    gordo_ml_server_client, sensor_frame
+):
+    import io
+
+    from tests.conftest import GORDO_PROJECT, GORDO_SINGLE_TARGET
+
+    from gordo_tpu.server.utils import dataframe_into_parquet_bytes
+
+    blob = dataframe_into_parquet_bytes(sensor_frame)
+    resp = gordo_ml_server_client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/anomaly/prediction/fleet",
+        data={GORDO_SINGLE_TARGET: (io.BytesIO(blob), "X")},  # missing .X/.y
+    )
+    assert resp.status_code == 400
+    assert ".X" in json.loads(resp.get_data())["error"]
