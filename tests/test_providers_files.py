@@ -164,3 +164,23 @@ def test_filesystem_provider_dry_run(tmp_path, caplog):
         )
     assert len(series) == 1 and len(series[0]) == 5
     assert any("Dry run" in record.message for record in caplog.records)
+
+
+def test_long_format_day_slop_catches_zone_shifted_rows(tmp_path):
+    """Rows living in the previous day's partition (timezone slop) but
+    timestamped inside the window must be found (reference:
+    iroc_reader.py:72-83 walks ±1 day)."""
+    # partition dated 2018-12-31 holding rows timestamped 2019-01-01
+    day_dir = tmp_path / "2018" / "12" / "31"
+    day_dir.mkdir(parents=True)
+    frame = make_long_frame(["GRA-Z"], periods=6, start="2019-01-01", seed=3)
+    frame.to_parquet(day_dir / "readings.parquet")
+    # and a partition dated one day AFTER the window end holding in-window
+    # rows (zones ahead of UTC)
+    late_dir = tmp_path / "2019" / "01" / "03"
+    late_dir.mkdir(parents=True)
+    frame = make_long_frame(["GRA-Z"], periods=4, start="2019-01-02T20:00:00", seed=4)
+    frame.to_parquet(late_dir / "readings.parquet")
+    provider = LongFormatProvider(base_dir=str(tmp_path))
+    (series,) = provider.load_series(START, END, [SensorTag("GRA-Z", "gra")])
+    assert len(series) == 10  # 6 from the -1-day side, 4 from the +1-day side
