@@ -15,11 +15,14 @@ Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
 """
 
 import json
+import os
 import subprocess
 import sys
 import time
 
 import numpy as np
+
+XLA_CACHE_DIR = "/tmp/gordo_tpu_xla_cache"
 
 # workload: "50-tag plant" LSTM-AE (BASELINE.json config #2/#3 shape)
 N_SENSORS = 50
@@ -40,7 +43,7 @@ def bench_jax() -> dict:
 
     try:
         # persistent XLA compile cache: repeat runs skip the ~1-2 min warmup
-        jax.config.update("jax_compilation_cache_dir", "/tmp/gordo_tpu_xla_cache")
+        jax.config.update("jax_compilation_cache_dir", XLA_CACHE_DIR)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
     except Exception as exc:
         log(f"compilation cache unavailable: {exc}")
@@ -88,7 +91,12 @@ def bench_jax() -> dict:
         f"jax: {EPOCHS} epochs x {n_windows} windows in {train_time:.2f}s "
         f"-> {rate:,.0f} sensor-timesteps/s"
     )
-    return {"rate": rate, "train_time": train_time, "platform": dev.platform}
+    return {
+        "rate": rate,
+        "train_time": train_time,
+        "platform": dev.platform,
+        "device_kind": dev.device_kind,
+    }
 
 
 def bench_torch_cpu(step_budget: int = 6) -> float:
@@ -138,16 +146,97 @@ def bench_torch_cpu(step_budget: int = 6) -> float:
     return rate
 
 
-def accelerator_usable(timeout_s: int = 180) -> bool:
+# Per-chip peak dense-matmul FLOP/s (bf16), keyed by jax device_kind.
+# Public figures: v5e 197 TF, v4 275 TF, v5p 459 TF, v6e (Trillium) 918 TF.
+PEAK_BF16_FLOPS = {
+    "TPU v5 lite": 197e12,
+    "TPU v5e": 197e12,
+    "TPU v4": 275e12,
+    "TPU v5": 459e12,
+    "TPU v5p": 459e12,
+    "TPU v6 lite": 918e12,
+    "TPU v6e": 918e12,
+    "TPU v3": 123e12,
+    "TPU v2": 46e12,
+}
+
+
+def training_flops_per_window() -> float:
+    """
+    Analytic FLOPs for one lookback window through one LSTM-AE training step.
+
+    Per LSTM layer per timestep the 4 gate matmuls dominate:
+    2 * (in_dim + hidden) * 4*hidden FLOPs per sample. The dense head runs on
+    the final timestep only. Backward for matmul-dominated nets is ~2x the
+    forward, so a training step is ~3x forward FLOPs.
+    """
+    dims = [N_SENSORS, *ENC, *DEC]
+    fwd_per_timestep = sum(
+        8 * dims[i + 1] * (dims[i] + dims[i + 1]) for i in range(len(dims) - 1)
+    )
+    fwd = fwd_per_timestep * LOOKBACK + 2 * dims[-1] * N_SENSORS
+    return 3.0 * fwd
+
+
+def compute_mfu(rate_windows_per_s: float, device_kind: str):
+    """Achieved training FLOP/s over the chip's peak; None off-TPU."""
+    peak = PEAK_BF16_FLOPS.get(device_kind)
+    if peak is None:
+        return None
+    return rate_windows_per_s * training_flops_per_window() / peak
+
+
+def competing_jax_processes() -> list:
+    """
+    The tunneled chip is exclusive: a second JAX process hangs backend init.
+    Best-effort scan for other live python processes that have libtpu or the
+    jax TPU plugin mapped, so a wedged probe can be explained in the log.
+    """
+    me = os.getpid()
+    hits = []
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/maps") as fh:
+                    maps = fh.read()
+            except OSError:
+                continue
+            if "libtpu" in maps or "pjrt_c_api" in maps:
+                try:
+                    with open(f"/proc/{pid}/cmdline") as fh:
+                        cmd = fh.read().replace("\0", " ").strip()
+                except OSError:
+                    cmd = "?"
+                hits.append((int(pid), cmd[:120]))
+    except OSError:
+        pass
+    return hits
+
+
+def accelerator_usable(timeout_s: int) -> bool:
     """
     Probe backend init in a subprocess with a hard timeout: a wedged TPU
     tunnel hangs jax.devices() forever, which must degrade to a CPU run
     (with a real JSON line) rather than hang the whole benchmark.
 
+    The probe also executes one tiny matmul so "usable" means the full
+    device round-trip works, not just discovery, and it shares the
+    persistent XLA cache so its warmup is not wasted.
     """
+    probe = (
+        "import jax;"
+        "jax.config.update('jax_compilation_cache_dir', %r);"
+        "d = jax.devices()[0];"
+        "print(d.platform);"
+        "import jax.numpy as jnp;"
+        "(jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()"
+        % XLA_CACHE_DIR
+    )
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
+            [sys.executable, "-c", probe],
             timeout=timeout_s,
             capture_output=True,
         )
@@ -155,20 +244,32 @@ def accelerator_usable(timeout_s: int = 180) -> bool:
         log(f"accelerator probe timed out after {timeout_s}s")
         return False
     if proc.returncode != 0:
-        log(f"accelerator probe failed: {proc.stderr.decode()[-200:]}")
-    return proc.returncode == 0
+        log(f"accelerator probe failed: {proc.stderr.decode()[-300:]}")
+        return False
+    platform = proc.stdout.decode().strip().splitlines()[-1:]
+    if platform and platform[0] == "cpu":
+        log("accelerator probe came back on CPU - no accelerator attached")
+        return False
+    return True
+
+
+# The tunneled chip's cold init is slow (first contact has been observed to
+# take >10 minutes including backend setup), so short probes systematically
+# misclassify a healthy-but-cold chip as dead. Escalate instead: a quick
+# probe for the warm case, then two long ones that give a cold tunnel a
+# real chance before conceding to CPU.
+PROBE_BUDGETS_S = (240, 900, 1500)
 
 
 def main():
-    # the TPU tunnel can wedge transiently (hang OR fail fast mid-restart);
-    # give it a few chances before recording a degraded CPU number. Fast
-    # deterministic failures cost at most 2 x 30s of sleep here, while a
-    # wedged-tunnel hang is already bounded by the probe's own timeout.
-    for attempt in range(3):
-        if accelerator_usable():
+    rivals = competing_jax_processes()
+    if rivals:
+        log(f"WARNING: other JAX processes may hold the chip: {rivals}")
+    for attempt, budget in enumerate(PROBE_BUDGETS_S):
+        if accelerator_usable(budget):
             break
-        log(f"accelerator probe attempt {attempt + 1}/3 failed")
-        if attempt < 2:
+        log(f"accelerator probe attempt {attempt + 1}/{len(PROBE_BUDGETS_S)} failed")
+        if attempt < len(PROBE_BUDGETS_S) - 1:
             time.sleep(30)
     else:
         log("falling back to CPU backend")
@@ -183,6 +284,9 @@ def main():
         log(f"baseline failed: {exc}")
         vs_baseline = None
 
+    n_windows = N_TIMESTEPS - LOOKBACK + 1
+    windows_per_s = n_windows * EPOCHS / jax_result["train_time"]
+    mfu = compute_mfu(windows_per_s, jax_result.get("device_kind", ""))
     print(
         json.dumps(
             {
@@ -193,6 +297,12 @@ def main():
                 # make a degraded (CPU-fallback) run distinguishable from a
                 # real TPU number in recorded results
                 "platform": jax_result["platform"],
+                "device_kind": jax_result.get("device_kind"),
+                # achieved/peak bf16 FLOP/s for this chip (None off-TPU):
+                # small-model fleet training is bandwidth/latency bound, so
+                # single-model MFU is expected to be low; see
+                # docs/performance.md for the roofline discussion.
+                "mfu": round(mfu, 4) if mfu is not None else None,
             }
         )
     )

@@ -18,6 +18,7 @@ import os
 import random
 import time
 from datetime import datetime, timezone
+from functools import partial
 from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
@@ -96,46 +97,30 @@ class ModelBuilder:
             str(self.machine.evaluation.get("cv_mode", "")).lower()
             == "cross_val_only"
         )
-        if not model_register_dir:
-            model, machine = self._build()
-        else:
-            self.cached_model_path = self.check_cache(model_register_dir)
+
+        cached = None
+        if model_register_dir:
             if replace_cache:
                 logger.info("replace_cache=True, deleting any existing cache entry")
                 disk_registry.delete_value(model_register_dir, self.cache_key)
-                self.cached_model_path = None
+            else:
+                self.cached_model_path = self.check_cache(model_register_dir)
+                cached = self._restore_cached(model_register_dir)
 
-            machine = None
-            if self.cached_model_path:
-                metadata = serializer.load_metadata(self.cached_model_path)
-                if "metadata" in metadata:
-                    model = serializer.load(self.cached_model_path)
-                    metadata["metadata"]["user_defined"] = (
-                        self.machine.metadata.user_defined
-                    )
-                    metadata["runtime"] = self.machine.runtime
-                    machine = Machine.unvalidated(**metadata)
-                else:
-                    # artifact lost its metadata -> invalidate and rebuild
-                    logger.warning(
-                        "Cached artifact at %s has no metadata; rebuilding",
-                        self.cached_model_path,
-                    )
-                    disk_registry.delete_value(model_register_dir, self.cache_key)
-                    self.cached_model_path = None
-
-            if machine is None:
-                model, machine = self._build()
-                # never cache/persist a cross_val_only result: the model is
-                # unfitted and a later cache hit would serve it as trained
-                if output_dir and not cv_only:
-                    self.cached_model_path = self._save_model(
-                        model=model, machine=machine, output_dir=output_dir
-                    )
-                    logger.info("Built model, deposited at %s", self.cached_model_path)
-                    disk_registry.write_key(
-                        model_register_dir, self.cache_key, str(self.cached_model_path)
-                    )
+        if cached is not None:
+            model, machine = cached
+        else:
+            model, machine = self._build()
+            # never cache/persist a cross_val_only result: the model is
+            # unfitted and a later cache hit would serve it as trained
+            if model_register_dir and output_dir and not cv_only:
+                self.cached_model_path = self._save_model(
+                    model=model, machine=machine, output_dir=output_dir
+                )
+                logger.info("Built model, deposited at %s", self.cached_model_path)
+                disk_registry.write_key(
+                    model_register_dir, self.cache_key, str(self.cached_model_path)
+                )
 
         if (
             output_dir
@@ -147,124 +132,150 @@ class ModelBuilder:
             )
         return model, machine
 
+    def _restore_cached(
+        self, model_register_dir
+    ) -> Optional[Tuple[BaseEstimator, Machine]]:
+        """
+        Rehydrate (model, machine) from a registry hit, grafting the current
+        request's user metadata and runtime onto the stored build metadata.
+        A hit whose artifact lost its metadata is invalidated instead.
+        """
+        if not self.cached_model_path:
+            return None
+        stored = serializer.load_metadata(self.cached_model_path)
+        if "metadata" not in stored:
+            logger.warning(
+                "Cached artifact at %s has no metadata; rebuilding",
+                self.cached_model_path,
+            )
+            disk_registry.delete_value(model_register_dir, self.cache_key)
+            self.cached_model_path = None
+            return None
+        stored["metadata"]["user_defined"] = self.machine.metadata.user_defined
+        stored["runtime"] = self.machine.runtime
+        return serializer.load(self.cached_model_path), Machine.unvalidated(**stored)
+
     def _build(self) -> Tuple[BaseEstimator, Machine]:
         """Run the actual build (reference: build_model.py:160-303),
         profiler-traced when GORDO_TPU_PROFILE_DIR is configured."""
         with maybe_trace(f"build-{self.machine.name}"):
             return self._build_traced()
 
+    DEFAULT_CV = {"sklearn.model_selection.TimeSeriesSplit": {"n_splits": 3}}
+
     def _build_traced(self) -> Tuple[BaseEstimator, Machine]:
-        self.set_seed(seed=self.machine.evaluation.get("seed", 0))
+        evaluation = self.machine.evaluation
+        self.set_seed(seed=evaluation.get("seed", 0))
 
         dataset = _get_dataset(self.machine.dataset.to_dict())
-
         start = time.time()
         with annotate("data-fetch"):
             X, y = dataset.get_data()
-        time_elapsed_data = time.time() - start
+        fetch_secs = time.time() - start
 
         model = serializer.from_definition(self.machine.model)
-        self._inject_seed(model, self.machine.evaluation.get("seed", 0))
+        self._inject_seed(model, evaluation.get("seed", 0))
 
-        cv_duration_sec = None
-        machine = Machine.unvalidated(
-            name=self.machine.name,
-            dataset=self.machine.dataset.to_dict(),
-            metadata=self.machine.metadata,
-            model=self.machine.model,
-            project_name=self.machine.project_name,
-            evaluation=self.machine.evaluation,
-            runtime=self.machine.runtime,
-        )
+        # the returned machine is a working copy that carries build metadata
+        machine = Machine.unvalidated(**self.machine.to_dict())
 
-        split_metadata: Dict[str, Any] = dict()
-        scores: Dict[str, Any] = dict()
-        cv_mode = str(self.machine.evaluation.get("cv_mode", "full_build")).lower()
+        cv_mode = str(evaluation.get("cv_mode", "full_build")).lower()
+        cv_meta = CrossValidationMetaData()
         if cv_mode in ("cross_val_only", "full_build"):
-            metrics_list = self.metrics_from_list(
-                self.machine.evaluation.get("metrics")
-            )
-
-            if hasattr(model, "predict"):
-                start = time.time()
-                scaler = self.machine.evaluation.get("scoring_scaler")
-                metrics_dict = self.build_metrics_dict(metrics_list, y, scaler=scaler)
-
-                split_obj = serializer.from_definition(
-                    self.machine.evaluation.get(
-                        "cv",
-                        {"sklearn.model_selection.TimeSeriesSplit": {"n_splits": 3}},
-                    )
-                )
-                split_metadata = self.build_split_dict(X, split_obj)
-
-                cv_kwargs = dict(
-                    X=X, y=y, scoring=metrics_dict, return_estimator=True, cv=split_obj
-                )
-                with annotate("cross-validation"):
-                    if hasattr(model, "cross_validate"):
-                        cv = model.cross_validate(**cv_kwargs)
-                    else:
-                        cv = cross_validate(model, **cv_kwargs)
-
-                for metric, test_metric in map(lambda k: (k, f"test_{k}"), metrics_dict):
-                    val = {
-                        "fold-mean": cv[test_metric].mean(),
-                        "fold-std": cv[test_metric].std(),
-                        "fold-max": cv[test_metric].max(),
-                        "fold-min": cv[test_metric].min(),
-                    }
-                    val.update(
-                        {
-                            f"fold-{i + 1}": raw_value
-                            for i, raw_value in enumerate(cv[test_metric].tolist())
-                        }
-                    )
-                    scores.update({metric: val})
-                cv_duration_sec = time.time() - start
-            else:
-                logger.debug("Unable to score model; it has no 'predict' attribute")
-
+            cv_meta = self._run_cross_validation(model, X, y)
             if cv_mode == "cross_val_only":
-                machine.metadata.build_metadata = BuildMetadata(
-                    model=ModelBuildMetadata(
-                        cross_validation=CrossValidationMetaData(
-                            cv_duration_sec=cv_duration_sec,
-                            scores=scores,
-                            splits=split_metadata,
-                        )
-                    ),
-                    dataset=DatasetBuildMetadata(
-                        query_duration_sec=time_elapsed_data,
-                        dataset_meta=dataset.get_metadata(),
-                    ),
+                machine.metadata.build_metadata = self._assemble_metadata(
+                    dataset, fetch_secs, cv_meta
                 )
                 return model, machine
 
         start = time.time()
         with annotate("fit"):
             model.fit(X, y)
-        time_elapsed_model = time.time() - start
+        fit_secs = time.time() - start
 
-        machine.metadata.build_metadata = BuildMetadata(
-            model=ModelBuildMetadata(
+        machine.metadata.build_metadata = self._assemble_metadata(
+            dataset, fetch_secs, cv_meta, fitted=(model, X, fit_secs)
+        )
+        return model, machine
+
+    def _run_cross_validation(self, model, X, y) -> CrossValidationMetaData:
+        """
+        Cross-validate with per-tag + aggregate scorers and package the fold
+        scores/splits (behavioral parity: reference build_model.py:203-257).
+        Models without a ``predict`` surface produce empty metadata.
+        """
+        if not hasattr(model, "predict"):
+            logger.debug("Unable to score model; it has no 'predict' attribute")
+            return CrossValidationMetaData()
+
+        start = time.time()
+        evaluation = self.machine.evaluation
+        scorers = self.build_metrics_dict(
+            self.metrics_from_list(evaluation.get("metrics")),
+            y,
+            scaler=evaluation.get("scoring_scaler"),
+        )
+        splitter = serializer.from_definition(evaluation.get("cv", self.DEFAULT_CV))
+
+        # anomaly models own their CV (threshold derivation rides along)
+        run = getattr(model, "cross_validate", None) or partial(cross_validate, model)
+        with annotate("cross-validation"):
+            cv = run(X=X, y=y, scoring=scorers, return_estimator=True, cv=splitter)
+
+        return CrossValidationMetaData(
+            cv_duration_sec=time.time() - start,
+            scores={
+                name: self._fold_stats(cv[f"test_{name}"]) for name in scorers
+            },
+            splits=self.build_split_dict(X, splitter),
+        )
+
+    @staticmethod
+    def _fold_stats(fold_values) -> Dict[str, Any]:
+        """Summary stats plus each fold's raw value for one scorer."""
+        summary = {
+            "fold-mean": fold_values.mean(),
+            "fold-std": fold_values.std(),
+            "fold-max": fold_values.max(),
+            "fold-min": fold_values.min(),
+        }
+        summary.update(
+            {f"fold-{n}": value for n, value in enumerate(fold_values.tolist(), 1)}
+        )
+        return summary
+
+    def _assemble_metadata(
+        self,
+        dataset,
+        fetch_secs: float,
+        cv_meta: CrossValidationMetaData,
+        fitted: Optional[Tuple[BaseEstimator, Any, float]] = None,
+    ) -> BuildMetadata:
+        """
+        BuildMetadata for this build. ``fitted=(model, X, fit_secs)`` adds
+        the trained-model fields (offset, creation date, harvested
+        GordoBase metadata); cross_val_only builds leave them default.
+        """
+        if fitted is None:
+            model_meta = ModelBuildMetadata(cross_validation=cv_meta)
+        else:
+            model, X, fit_secs = fitted
+            model_meta = ModelBuildMetadata(
                 model_offset=self._determine_offset(model, X),
                 model_creation_date=str(datetime.now(timezone.utc).astimezone()),
                 model_builder_version=__version__,
-                model_training_duration_sec=time_elapsed_model,
-                cross_validation=CrossValidationMetaData(
-                    cv_duration_sec=cv_duration_sec,
-                    scores=scores,
-                    splits=split_metadata,
-                ),
+                model_training_duration_sec=fit_secs,
+                cross_validation=cv_meta,
                 model_meta=self._extract_metadata_from_model(model),
-            ),
+            )
+        return BuildMetadata(
+            model=model_meta,
             dataset=DatasetBuildMetadata(
-                query_duration_sec=time_elapsed_data,
+                query_duration_sec=fetch_secs,
                 dataset_meta=dataset.get_metadata(),
             ),
         )
-        return model, machine
 
     @staticmethod
     def set_seed(seed: int):
@@ -327,7 +338,8 @@ class ModelBuilder:
         if scaler:
             if isinstance(scaler, (str, dict)):
                 scaler = serializer.from_definition(scaler)
-            scaler.fit(y)
+            # bare array keeps later ndarray transforms warning-free
+            scaler.fit(np.asarray(y))
 
         def _score_factory(metric_func, col_index):
             def _score_per_tag(y_true, y_pred):
@@ -418,22 +430,22 @@ class ModelBuilder:
     @staticmethod
     def calculate_cache_key(machine: Machine) -> str:
         """
-        sha3_512 over (name, model config, dataset config, evaluation config,
-        framework major.minor) (reference: :525-578).
+        Content hash identifying "the same build": everything that changes
+        the produced model re-keys the cache (name, model config, dataset
+        config, evaluation config, framework major.minor), while
+        runtime/metadata — which don't affect training — deliberately do
+        not. sha3_512 for parity with the reference registry's key width.
         """
-        json_rep = json.dumps(
-            {
-                "name": machine.name,
-                "model_config": machine.model,
-                "data_config": machine.dataset.to_dict(),
-                "evaluation_config": machine.evaluation,
-                "gordo-tpu-major-version": MAJOR_VERSION,
-                "gordo-tpu-minor-version": MINOR_VERSION,
-            },
-            sort_keys=True,
-            default=str,
-        )
-        return hashlib.sha3_512(json_rep.encode("ascii")).hexdigest()
+        fingerprint = {
+            "name": machine.name,
+            "model_config": machine.model,
+            "data_config": machine.dataset.to_dict(),
+            "evaluation_config": machine.evaluation,
+            "gordo-tpu-major-version": MAJOR_VERSION,
+            "gordo-tpu-minor-version": MINOR_VERSION,
+        }
+        payload = json.dumps(fingerprint, sort_keys=True, default=str)
+        return hashlib.sha3_512(payload.encode("ascii")).hexdigest()
 
     def check_cache(
         self, model_register_dir: Union[os.PathLike, str]

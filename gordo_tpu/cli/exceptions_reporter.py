@@ -1,14 +1,21 @@
 """
-Structured failure reporting for build pods (reference parity:
-gordo/cli/exceptions_reporter.py:12-224): map exception class → exit code
-and write a trimmed JSON report sized for the k8s pod termination message
-(≤2024 bytes).
+Structured failure reporting for build pods.
+
+Behavioral parity with the reference's exception→exit-code table and
+trimmed JSON termination message (gordo/cli/exceptions_reporter.py:12-224):
+a failed build exits with a code describing *what kind* of failure occurred,
+and leaves a small JSON document (sized for the 2024-byte k8s
+terminationMessagePath budget) for the workflow layer to surface.
+
+The implementation here is TPU-rebuild-native: exit codes are resolved by
+walking the raised type's MRO against a registration map (most-derived
+registered ancestor wins), and the report payload is assembled from a
+per-level field plan instead of branch-per-level logic.
 """
 
 import json
 import traceback
-from collections import Counter
-from enum import Enum
+from enum import IntEnum
 from types import TracebackType
 from typing import IO, Dict, Iterable, List, Optional, Tuple, Type
 
@@ -16,8 +23,12 @@ from gordo_tpu.utils import replace_all_non_ascii_chars_with_default
 
 DEFAULT_EXIT_CODE = 1
 
+ELLIPSIS = "..."
 
-class ReportLevel(Enum):
+
+class ReportLevel(IntEnum):
+    """How much detail the termination report carries."""
+
     EXIT_CODE = 0
     TYPE = 1
     MESSAGE = 2
@@ -27,30 +38,61 @@ class ReportLevel(Enum):
     def get_by_name(
         cls, name: str, default: Optional["ReportLevel"] = None
     ) -> Optional["ReportLevel"]:
-        for level in cls:
-            if name == level.name:
-                return level
-        return default
+        return cls.__members__.get(name, default)
 
     @classmethod
     def get_names(cls) -> List[str]:
-        return [level.name for level in cls]
+        return list(cls.__members__)
+
+
+def _scrub(text: str) -> str:
+    """Termination messages must be ASCII-safe for k8s; '?' out the rest."""
+    return replace_all_non_ascii_chars_with_default(text, "?")
+
+
+def _clip_message(message: str, budget: int) -> str:
+    """Hard-cap a message, marking truncation; degenerate budgets yield ''."""
+    if len(message) <= budget:
+        return message
+    if budget <= len(ELLIPSIS):
+        return ""
+    return message[: budget - len(ELLIPSIS)] + ELLIPSIS
+
+
+def _clip_traceback_lines(lines: List[str], budget: int) -> List[str]:
+    """
+    Keep as many *trailing* traceback lines as fit (the raise site is the
+    useful end), spending part of the budget on a leading '...\\n' marker
+    whenever anything was dropped.
+    """
+    if sum(map(len, lines)) <= budget:
+        return lines
+    marker = ELLIPSIS + "\n"
+    room = budget - len(marker)
+    tail: List[str] = []
+    for line in reversed(lines):
+        if room - len(line) < 0:
+            break
+        room -= len(line)
+        tail.append(line)
+    return [marker] + tail[::-1]
 
 
 class ExceptionsReporter:
     """
-    Save exception info as JSON (k8s terminationMessagePath consumer) and
-    translate exception types to exit codes.
+    Translate exception types to exit codes and write the JSON report.
 
     Parameters
     ----------
     exceptions
-        (exception class, exit code) pairs. Subclass matches win over base
-        classes regardless of registration order.
+        (exception class, exit code) registrations. When a raised type has
+        several registered ancestors, the most-derived one (per its MRO)
+        decides the code — so specific registrations shadow general ones no
+        matter the registration order.
     default_exit_code
-        Exit code for unregistered exception types.
+        Code for exception types with no registered ancestor.
     traceback_limit
-        Passed to ``traceback.format_exception``.
+        Frame limit handed to ``traceback.format_exception``.
     """
 
     def __init__(
@@ -59,66 +101,55 @@ class ExceptionsReporter:
         default_exit_code: int = DEFAULT_EXIT_CODE,
         traceback_limit: Optional[int] = None,
     ):
-        self.exceptions_items = self.sort_exceptions(exceptions)
+        self._exit_codes: Dict[type, int] = dict(exceptions)
         self.default_exit_code = default_exit_code
         self.traceback_limit = traceback_limit
 
-    @staticmethod
-    def sort_exceptions(
-        exceptions: Iterable[Tuple[Type[Exception], int]]
-    ) -> List[Tuple[Type[Exception], int]]:
-        """
-        Order so the most-derived classes are found first
-        (reference: exceptions_reporter.py:61-77).
-        """
-        exceptions = list(exceptions)
-        inheritance_levels: Dict[Type[BaseException], int] = Counter()
-        for exc, _ in exceptions:
-            for other, _ in exceptions:
-                if other is not exc and issubclass(exc, other):
-                    inheritance_levels[other] += 1
-        return sorted(
-            exceptions, key=lambda item: (inheritance_levels[item[0]], item[1])
-        )
-
-    @staticmethod
-    def trim_message(message: str, max_length: int) -> str:
-        if len(message) > max_length:
-            message = message[: max_length - 3]
-            return "" if len(message) <= 3 else message + "..."
-        return message
-
-    @staticmethod
-    def trim_formatted_traceback(
-        formatted_traceback: List[str], max_length: int
-    ) -> List[str]:
-        """Keep the tail of the traceback within budget, '...'-prefixed."""
-        if sum(len(line) for line in formatted_traceback) <= max_length:
-            return formatted_traceback
-        length = 4
-        result: List[str] = []
-        for line in reversed(formatted_traceback):
-            length += len(line)
-            if length > max_length:
-                result.append("...\n")
-                break
-            result.append(line)
-        return list(reversed(result))
-
-    def found_exception_item(self, exc_type: Type[BaseException]):
-        for item in self.exceptions_items:
-            if issubclass(exc_type, item[0]):
-                return item
+    def _resolve(self, exc_type: Type[BaseException]) -> Optional[type]:
+        """The most-derived registered ancestor of ``exc_type``, if any."""
+        for klass in exc_type.__mro__:
+            if klass in self._exit_codes:
+                return klass
         return None
 
-    def exception_exit_code(
-        self, exc_type: Optional[Type[BaseException]]
-    ) -> int:
-        """Exit code for the exception type (0 for None)."""
+    def is_registered(self, exc_type: Type[BaseException]) -> bool:
+        return self._resolve(exc_type) is not None
+
+    def exception_exit_code(self, exc_type: Optional[Type[BaseException]]) -> int:
+        """Exit code for the exception type (0 for a clean run)."""
         if exc_type is None:
             return 0
-        item = self.found_exception_item(exc_type)
-        return item[1] if item is not None else self.default_exit_code
+        klass = self._resolve(exc_type)
+        return self.default_exit_code if klass is None else self._exit_codes[klass]
+
+    def _describe(
+        self,
+        level: ReportLevel,
+        exc_type: Type[BaseException],
+        exc_value: BaseException,
+        exc_traceback: TracebackType,
+        max_message_len: Optional[int],
+    ) -> Dict[str, str]:
+        """Assemble the report fields this level is entitled to."""
+        fields: Dict[str, str] = {}
+        if level >= ReportLevel.TYPE:
+            fields["type"] = _scrub(exc_type.__name__)
+        if level == ReportLevel.MESSAGE:
+            message = _scrub(str(exc_value))
+            if max_message_len is not None:
+                message = _clip_message(message, max_message_len)
+            fields["message"] = message
+        if level == ReportLevel.TRACEBACK:
+            lines = [
+                _scrub(line)
+                for line in traceback.format_exception(
+                    exc_type, exc_value, exc_traceback, limit=self.traceback_limit
+                )
+            ]
+            if max_message_len is not None:
+                lines = _clip_traceback_lines(lines, max_message_len)
+            fields["traceback"] = "".join(lines)
+        return fields
 
     def report(
         self,
@@ -129,47 +160,21 @@ class ExceptionsReporter:
         report_file: IO[str],
         max_message_len: Optional[int] = None,
     ):
-        """Write the JSON report at the given verbosity."""
-        report: Dict[str, str] = {}
-        if (
+        """
+        Write the JSON report. Unregistered (or absent) exceptions produce an
+        empty document — the exit code alone carries the signal then.
+        """
+        fields: Dict[str, str] = {}
+        have_exception = (
             exc_type is not None
             and exc_value is not None
             and exc_traceback is not None
-            and self.found_exception_item(exc_type) is not None
-        ):
-            if level in (
-                ReportLevel.MESSAGE,
-                ReportLevel.TYPE,
-                ReportLevel.TRACEBACK,
-            ):
-                report["type"] = replace_all_non_ascii_chars_with_default(
-                    exc_type.__name__, "?"
-                )
-            if level == ReportLevel.MESSAGE:
-                report["message"] = replace_all_non_ascii_chars_with_default(
-                    str(exc_value), "?"
-                )
-                if max_message_len is not None:
-                    report["message"] = self.trim_message(
-                        report["message"], max_message_len
-                    )
-            elif level == ReportLevel.TRACEBACK:
-                formatted = traceback.format_exception(
-                    exc_type,
-                    exc_value,
-                    exc_traceback,
-                    limit=self.traceback_limit,
-                )
-                formatted = [
-                    replace_all_non_ascii_chars_with_default(v, "?")
-                    for v in formatted
-                ]
-                if max_message_len is not None:
-                    formatted = self.trim_formatted_traceback(
-                        formatted, max_message_len
-                    )
-                report["traceback"] = "".join(formatted)
-        json.dump(report, report_file)
+        )
+        if have_exception and self.is_registered(exc_type):
+            fields = self._describe(
+                level, exc_type, exc_value, exc_traceback, max_message_len
+            )
+        json.dump(fields, report_file)
 
     def safe_report(
         self,
@@ -180,7 +185,7 @@ class ExceptionsReporter:
         report_file_path: str,
         max_message_len: Optional[int] = None,
     ):
-        """report(), never raising (reference: exceptions_reporter.py:188-224)."""
+        """``report()`` that never raises - failures land on stderr only."""
         try:
             with open(report_file_path, "w") as report_file:
                 self.report(

@@ -152,74 +152,84 @@ class TimeSeriesDataset(GordoBaseDataset):
             )
         return dt
 
-    def get_data(self) -> Tuple[pd.DataFrame, Optional[pd.DataFrame]]:
-        all_tags = list(dict.fromkeys(self.tag_list + self.target_tag_list))
-        series_iter: Iterable[pd.Series] = self.data_provider.load_series(
+    # --- the get_data pipeline, one small method per stage ----------------
+
+    def _fetch_joined(self) -> pd.DataFrame:
+        """Pull every needed tag and land them on one common time grid."""
+        wanted = list(dict.fromkeys(self.tag_list + self.target_tag_list))
+        series: Iterable[pd.Series] = self.data_provider.load_series(
             train_start_date=self.train_start_date,
             train_end_date=self.train_end_date,
-            tag_list=all_tags,
+            tag_list=wanted,
+        )
+        if not self.resolution:
+            return pd.concat(series, axis=1, join="inner")
+        return self.join_timeseries(
+            series,
+            self.train_start_date,
+            self.train_end_date,
+            self.resolution,
+            aggregation_methods=self.aggregation_methods,
+            interpolation_method=self.interpolation_method,
+            interpolation_limit=self.interpolation_limit,
         )
 
-        if self.resolution:
-            data = self.join_timeseries(
-                series_iter,
-                self.train_start_date,
-                self.train_end_date,
-                self.resolution,
-                aggregation_methods=self.aggregation_methods,
-                interpolation_method=self.interpolation_method,
-                interpolation_limit=self.interpolation_limit,
-            )
-        else:
-            data = pd.concat(series_iter, axis=1, join="inner")
+    def _apply_row_filter(self, data: pd.DataFrame) -> pd.DataFrame:
+        return pandas_filter_rows(
+            data, self.row_filter, buffer_size=self.row_filter_buffer_size
+        )
 
-        if len(data) <= self.n_samples_threshold:
-            raise InsufficientDataError(
-                f"The length of the generated DataFrame ({len(data)}) does not "
-                f"exceed the required threshold ({self.n_samples_threshold})."
-            )
+    def _apply_global_bounds(self, data: pd.DataFrame) -> pd.DataFrame:
+        inside = (data > self.low_threshold) & (data < self.high_threshold)
+        return data[inside.all(axis=1)]
 
+    def _apply_period_filter(self, data: pd.DataFrame) -> pd.DataFrame:
+        data, dropped, _ = self.filter_periods.filter_data(data)
+        self._metadata["filtered_periods"] = dropped
+        return data
+
+    def _enabled_filters(self):
+        """(stage label, stage fn, error class) for each configured filter."""
         if self.row_filter:
-            data = pandas_filter_rows(
-                data, self.row_filter, buffer_size=self.row_filter_buffer_size
+            yield (
+                "row filtering",
+                self._apply_row_filter,
+                InsufficientDataAfterRowFilteringError,
             )
-            if len(data) <= self.n_samples_threshold:
-                raise InsufficientDataAfterRowFilteringError(
-                    f"The length of the DataFrame ({len(data)}) does not exceed "
-                    f"the required threshold ({self.n_samples_threshold}) after "
-                    "row filtering."
-                )
-
         if self.low_threshold is not None and self.high_threshold is not None:
-            mask = ((data > self.low_threshold) & (data < self.high_threshold)).all(axis=1)
-            data = data[mask]
-            if len(data) <= self.n_samples_threshold:
-                raise InsufficientDataAfterGlobalFilteringError(
-                    f"The length of the DataFrame ({len(data)}) does not exceed "
-                    f"the required threshold ({self.n_samples_threshold}) after "
-                    "global min/max filtering."
-                )
-
+            yield (
+                "global min/max filtering",
+                self._apply_global_bounds,
+                InsufficientDataAfterGlobalFilteringError,
+            )
         if self.filter_periods:
-            data, drop_periods, _ = self.filter_periods.filter_data(data)
-            self._metadata["filtered_periods"] = drop_periods
-            if len(data) <= self.n_samples_threshold:
-                raise InsufficientDataError(
-                    f"The length of the DataFrame ({len(data)}) does not exceed "
-                    f"the required threshold ({self.n_samples_threshold}) after "
-                    "noisy-period filtering."
-                )
+            yield (
+                "noisy-period filtering",
+                self._apply_period_filter,
+                InsufficientDataError,
+            )
 
-        x_tag_names = [tag.name for tag in self.tag_list]
-        y_tag_names = [tag.name for tag in self.target_tag_list]
+    def _require_rows(self, data: pd.DataFrame, error_cls: type, stage: str):
+        """Every stage must leave more than n_samples_threshold rows behind."""
+        if len(data) <= self.n_samples_threshold:
+            raise error_cls(
+                f"{len(data)} rows remain after {stage}; need more than "
+                f"the configured threshold ({self.n_samples_threshold})."
+            )
 
-        X = data[x_tag_names]
-        y = data[y_tag_names] if self.target_tag_list else None
+    def get_data(self) -> Tuple[pd.DataFrame, Optional[pd.DataFrame]]:
+        data = self._fetch_joined()
+        self._require_rows(data, InsufficientDataError, "resampling/joining")
+        for stage, apply, error_cls in self._enabled_filters():
+            data = apply(data)
+            self._require_rows(data, error_cls, stage)
+
+        X = data[[tag.name for tag in self.tag_list]]
+        y = data[[tag.name for tag in self.target_tag_list]] if self.target_tag_list else None
 
         if len(X):
             self._metadata["train_start_date_actual"] = X.index[0]
             self._metadata["train_end_date_actual"] = X.index[-1]
-
         self._metadata["summary_statistics"] = X.describe().to_dict()
         self._metadata["x_hist"] = self._histograms(X)
         return X, y

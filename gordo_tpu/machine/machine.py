@@ -1,7 +1,12 @@
 """
-The Machine config unit (reference parity: gordo/machine/machine.py:25-202):
+The Machine config unit (behavioral parity: gordo/machine/machine.py:25-202):
 a validated (name, model, dataset, runtime, evaluation, metadata) bundle —
 the atom the whole framework schedules, builds, serves, and reports on.
+
+Config-overlay semantics preserved from the reference: ``runtime`` and
+``evaluation`` start from project globals and are patched by the machine's
+own block, while ``dataset`` is the machine's block patched *by* globals
+(global dataset keys win — same patch_dict argument order as the reference).
 """
 
 import json
@@ -25,6 +30,29 @@ from gordo_tpu.workflow.helpers import patch_dict
 
 logger = logging.getLogger(__name__)
 
+# to_dict()/from_dict() round-trip these attributes, in this order
+_MACHINE_FIELDS = (
+    "name",
+    "dataset",
+    "model",
+    "metadata",
+    "runtime",
+    "project_name",
+    "evaluation",
+)
+
+
+def _as_dataset(value: Union[GordoBaseDataset, dict]) -> GordoBaseDataset:
+    if isinstance(value, GordoBaseDataset):
+        return value
+    return GordoBaseDataset.from_dict(value)
+
+
+def _as_metadata(value: Union[Metadata, dict, None]) -> Metadata:
+    if isinstance(value, Metadata):
+        return value
+    return Metadata.from_dict(value or {})
+
 
 class Machine:
 
@@ -47,24 +75,13 @@ class Machine:
         metadata: Optional[Union[dict, Metadata]] = None,
         runtime: Optional[dict] = None,
     ):
-        if runtime is None:
-            runtime = dict()
-        if not evaluation:  # None or {} -> default CV mode
-            evaluation = dict(cv_mode="full_build")
-        if metadata is None:
-            metadata = dict()
         self.name = name
         self.model = model
-        self.dataset = (
-            dataset
-            if isinstance(dataset, GordoBaseDataset)
-            else GordoBaseDataset.from_dict(dataset)
-        )
-        self.runtime = runtime
-        self.evaluation = evaluation
-        self.metadata = (
-            metadata if isinstance(metadata, Metadata) else Metadata.from_dict(metadata)
-        )
+        self.dataset = _as_dataset(dataset)
+        self.runtime = runtime or {}
+        # None or {} both mean "default evaluation": a plain full build
+        self.evaluation = evaluation or {"cv_mode": "full_build"}
+        self.metadata = _as_metadata(metadata)
         self.project_name = project_name
         self.host = f"gordoserver-{self.project_name}-{self.name}"
 
@@ -77,53 +94,31 @@ class Machine:
     ) -> "Machine":
         """
         Build a Machine from one YAML machine block, overlaying project
-        globals (reference: machine.py:74-126): runtime and evaluation are
-        globals patched by the machine's locals; dataset is the machine's
-        dataset patched *onto* by globals (global dataset keys win, matching
-        the reference's argument order).
+        globals per the module-docstring semantics.
         """
-        if config_globals is None:
-            config_globals = dict()
+        shared = config_globals or {}
 
-        name = config["name"]
-        model = config.get("model") or config_globals.get("model")
+        def block(key: str, source: dict) -> dict:
+            return source.get(key) or {}
 
-        runtime = patch_dict(
-            config_globals.get("runtime", dict()), config.get("runtime", dict())
-        )
-        dataset_config = patch_dict(
-            config.get("dataset", dict()), config_globals.get("dataset", dict())
-        )
-        dataset = GordoBaseDataset.from_dict(dataset_config)
-        evaluation = patch_dict(
-            config_globals.get("evaluation", dict()), config.get("evaluation", dict())
-        )
-        metadata = Metadata(
-            user_defined={
-                "global-metadata": config_globals.get("metadata", dict()),
-                "machine-metadata": config.get("metadata", dict()),
-            }
-        )
         return cls(
-            name,
-            model,
-            dataset,
-            metadata=metadata,
-            runtime=runtime,
+            name=config["name"],
             project_name=project_name,
-            evaluation=evaluation,
+            model=config.get("model") or shared.get("model"),
+            dataset=_as_dataset(
+                patch_dict(block("dataset", config), block("dataset", shared))
+            ),
+            runtime=patch_dict(block("runtime", shared), block("runtime", config)),
+            evaluation=patch_dict(
+                block("evaluation", shared), block("evaluation", config)
+            ),
+            metadata=Metadata(
+                user_defined={
+                    "global-metadata": block("metadata", shared),
+                    "machine-metadata": block("metadata", config),
+                }
+            ),
         )
-
-    def __str__(self):
-        return yaml.dump(self.to_dict())
-
-    def __eq__(self, other):
-        if not isinstance(other, Machine):
-            return NotImplemented
-        return self.to_dict() == other.to_dict()
-
-    def __hash__(self):
-        return hash((self.project_name, self.name))
 
     @classmethod
     def from_dict(cls, d: dict) -> "Machine":
@@ -143,20 +138,25 @@ class Machine:
         return instance
 
     def to_dict(self) -> dict:
-        return {
-            "name": self.name,
-            "dataset": self.dataset.to_dict(),
-            "model": self.model,
-            "metadata": self.metadata.to_dict(),
-            "runtime": self.runtime,
-            "project_name": self.project_name,
-            "evaluation": self.evaluation,
-        }
+        def plain(value):
+            return value.to_dict() if hasattr(value, "to_dict") else value
+
+        return {field: plain(getattr(self, field)) for field in _MACHINE_FIELDS}
+
+    def __str__(self):
+        return yaml.dump(self.to_dict())
+
+    def __eq__(self, other):
+        if not isinstance(other, Machine):
+            return NotImplemented
+        return self.to_dict() == other.to_dict()
+
+    def __hash__(self):
+        return hash((self.project_name, self.name))
 
     def report(self):
         """
-        Run every reporter configured under ``runtime.reporters``
-        (reference: machine.py:157-177)::
+        Run every reporter configured under ``runtime.reporters``::
 
             runtime:
               reporters:
@@ -165,8 +165,8 @@ class Machine:
         """
         from gordo_tpu.reporters.base import BaseReporter
 
-        for reporter_config in self.runtime.get("reporters", []):
-            reporter = BaseReporter.from_dict(reporter_config)
+        for config in self.runtime.get("reporters", []):
+            reporter = BaseReporter.from_dict(config)
             logger.debug("Using reporter: %r", reporter)
             reporter.report(self)
 
@@ -177,8 +177,9 @@ class MachineEncoder(json.JSONEncoder):
     def default(self, obj):
         if isinstance(obj, datetime):
             return obj.strftime("%Y-%m-%d %H:%M:%S.%f%z")
-        if np.issubdtype(type(obj), np.floating):
+        kind = type(obj)
+        if np.issubdtype(kind, np.floating):
             return float(obj)
-        if np.issubdtype(type(obj), np.integer):
+        if np.issubdtype(kind, np.integer):
             return int(obj)
-        return json.JSONEncoder.default(self, obj)
+        return super().default(obj)

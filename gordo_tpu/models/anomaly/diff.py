@@ -126,8 +126,36 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
 
     def fit(self, X, y):
         self.base_estimator.fit(X, y)
-        self.scaler.fit(y)  # used for error scaling in .anomaly()
+        # fitted on the *target* (as a bare array so later ndarray
+        # transforms stay silent); used purely for error scaling
+        self.scaler.fit(np.asarray(y))
         return self
+
+    @staticmethod
+    def _rolled(errors, window: int):
+        """
+        The reference's threshold statistic: the largest rolling-window
+        minimum of an error series — i.e. the level the error *sustained*
+        for a full window somewhere in the fold, robust to single spikes.
+        """
+        return errors.rolling(window).min().max()
+
+    def _fold_errors(self, fold_model, X, y, test_idxs):
+        """
+        Per-timestep test errors for one fitted fold: the aggregate
+        scaled-MSE series and the per-tag absolute-error frame.
+        """
+
+        def rows(frame, idxs):
+            return frame.iloc[idxs] if isinstance(frame, pd.DataFrame) else frame[idxs]
+
+        y_pred = np.asarray(fold_model.predict(rows(X, test_idxs)))
+        # windowed models emit fewer rows than they consume: align to tail
+        y_true = np.asarray(rows(y, test_idxs[-len(y_pred):]))
+
+        in_fold_scale = fold_model.scaler.transform
+        scaled_sq = (in_fold_scale(y_pred) - in_fold_scale(y_true)) ** 2
+        return pd.Series(scaled_sq.mean(axis=1)), pd.DataFrame(np.abs(y_pred - y_true))
 
     def cross_validate(
         self,
@@ -138,80 +166,52 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         **kwargs,
     ):
         """
-        Run sklearn cross-validation, deriving anomaly thresholds from the
-        per-fold models (reference: diff.py:134-224). Returns the raw
-        ``cross_validate`` output.
+        Run sklearn cross-validation and derive the anomaly thresholds from
+        the fold models' test errors (behavioral parity: reference
+        diff.py:134-224). Per fold, aggregate threshold = _rolled(scaled
+        MSE, 6) and per-tag thresholds = _rolled(MAE, 6); the *final*
+        thresholds are simply the last fold's — the fold trained on the
+        most data under TimeSeriesSplit. Returns sklearn's raw output.
         """
-        if cv is None:
-            cv = TimeSeriesSplit(n_splits=3)
-        kwargs.update(dict(return_estimator=True, cv=cv))
+        cv = cv if cv is not None else TimeSeriesSplit(n_splits=3)
+        cv_output = cross_validate(
+            self, X=X, y=y, **{**kwargs, "return_estimator": True, "cv": cv}
+        )
 
-        cv_output = cross_validate(self, X=X, y=y, **kwargs)
+        agg_by_fold: dict = {}
+        tag_by_fold: list = []
+        smooth_agg_by_fold: dict = {}
+        smooth_tag_by_fold: list = []
 
-        self.feature_thresholds_per_fold_ = pd.DataFrame()
-        self.aggregate_thresholds_per_fold_ = {}
-        self.smooth_feature_thresholds_per_fold_ = pd.DataFrame()
-        self.smooth_aggregate_thresholds_per_fold_ = {}
-        smooth_aggregate_threshold_fold = None
-        smooth_tag_thresholds_fold = None
-        tag_thresholds_fold = None
-        aggregate_threshold_fold = None
-
-        for i, ((_, test_idxs), split_model) in enumerate(
+        for fold, ((_, test_idxs), fold_model) in enumerate(
             zip(cv.split(X, y), cv_output["estimator"])
         ):
-            y_pred = split_model.predict(
-                X.iloc[test_idxs] if isinstance(X, pd.DataFrame) else X[test_idxs]
-            )
-            # account for any model output offset (windowed models)
-            test_idxs = test_idxs[-len(y_pred):]
-            y_true = y.iloc[test_idxs] if isinstance(y, pd.DataFrame) else y[test_idxs]
-
-            scaled_mse = self._scaled_mse_per_timestep(split_model, y_true, y_pred)
-            mae = pd.DataFrame(np.abs(np.asarray(y_pred) - np.asarray(y_true)))
-
-            aggregate_threshold_fold = scaled_mse.rolling(6).min().max()
-            self.aggregate_thresholds_per_fold_[f"fold-{i}"] = aggregate_threshold_fold
-
-            tag_thresholds_fold = mae.rolling(6).min().max()
-            tag_thresholds_fold.name = f"fold-{i}"
-            self.feature_thresholds_per_fold_ = pd.concat(
-                [self.feature_thresholds_per_fold_, tag_thresholds_fold.to_frame().T]
-            )
-
+            label = f"fold-{fold}"
+            scaled_mse, mae = self._fold_errors(fold_model, X, y, test_idxs)
+            agg_by_fold[label] = self._rolled(scaled_mse, 6)
+            tag_by_fold.append(self._rolled(mae, 6).rename(label))
             if self.window is not None:
-                smooth_aggregate_threshold_fold = (
-                    scaled_mse.rolling(self.window).min().max()
-                )
-                self.smooth_aggregate_thresholds_per_fold_[f"fold-{i}"] = (
-                    smooth_aggregate_threshold_fold
-                )
-                smooth_tag_thresholds_fold = mae.rolling(self.window).min().max()
-                smooth_tag_thresholds_fold.name = f"fold-{i}"
-                self.smooth_feature_thresholds_per_fold_ = pd.concat(
-                    [
-                        self.smooth_feature_thresholds_per_fold_,
-                        smooth_tag_thresholds_fold.to_frame().T,
-                    ]
+                smooth_agg_by_fold[label] = self._rolled(scaled_mse, self.window)
+                smooth_tag_by_fold.append(
+                    self._rolled(mae, self.window).rename(label)
                 )
 
-        # final thresholds = last fold's (reference: diff.py:214-222)
-        self.feature_thresholds_ = tag_thresholds_fold
-        self.aggregate_threshold_ = aggregate_threshold_fold
-        self.smooth_aggregate_threshold_ = smooth_aggregate_threshold_fold
-        self.smooth_feature_thresholds_ = smooth_tag_thresholds_fold
+        def as_frame(rows: list) -> pd.DataFrame:
+            return pd.DataFrame(rows) if rows else pd.DataFrame()
+
+        self.aggregate_thresholds_per_fold_ = agg_by_fold
+        self.feature_thresholds_per_fold_ = as_frame(tag_by_fold)
+        self.smooth_aggregate_thresholds_per_fold_ = smooth_agg_by_fold
+        self.smooth_feature_thresholds_per_fold_ = as_frame(smooth_tag_by_fold)
+
+        def last(values):
+            return list(values)[-1] if values else None
+
+        self.aggregate_threshold_ = last(agg_by_fold.values())
+        self.feature_thresholds_ = last(tag_by_fold)
+        self.smooth_aggregate_threshold_ = last(smooth_agg_by_fold.values())
+        self.smooth_feature_thresholds_ = last(smooth_tag_by_fold)
         return cv_output
-
-    @staticmethod
-    def _scaled_mse_per_timestep(model, y_true, y_pred) -> pd.Series:
-        scaled_y_true = model.scaler.transform(y_true)
-        scaled_y_pred = model.scaler.transform(
-            np.asarray(y_pred)
-            if not isinstance(y_pred, pd.DataFrame)
-            else y_pred
-        )
-        mse = ((np.asarray(scaled_y_pred) - np.asarray(scaled_y_true)) ** 2).mean(axis=1)
-        return pd.Series(mse)
 
     def anomaly(
         self,
@@ -242,90 +242,48 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
             frequency=frequency,
         )
 
-        model_out_scaled = pd.DataFrame(
-            self.scaler.transform(data["model-output"]),
-            columns=data["model-output"].columns,
-            index=data.index,
-        )
+        def labeled(values: np.ndarray, label: str, columns) -> pd.DataFrame:
+            """A top-level MultiIndex block aligned to the output frame."""
+            return pd.DataFrame(
+                values,
+                index=data.index,
+                columns=pd.MultiIndex.from_product(((label,), list(columns))),
+            )
 
-        # scaled per-tag anomaly, y offset to match (possibly shorter) output
-        scaled_y = self.scaler.transform(y)
-        tag_anomaly_scaled = np.abs(model_out_scaled - scaled_y[-len(data):, :])
-        tag_anomaly_scaled.columns = pd.MultiIndex.from_product(
-            (("tag-anomaly-scaled",), tag_anomaly_scaled.columns)
-        )
-        data = data.join(tag_anomaly_scaled)
-        data["total-anomaly-scaled"] = np.square(data["tag-anomaly-scaled"]).mean(axis=1)
+        output = data["model-output"]
+        # windowed models emit fewer rows than they consume: y aligns to tail
+        y_tail = np.asarray(y)[-len(data):, :]
 
-        unscaled_abs_diff = pd.DataFrame(
-            data=np.abs(
-                data["model-output"].to_numpy() - y.to_numpy()[-len(data):, :]
-            ),
-            index=data.index,
-            columns=pd.MultiIndex.from_product(
-                (("tag-anomaly-unscaled",), list(y.columns))
-            ),
-        )
-        data = data.join(unscaled_abs_diff)
-        data["total-anomaly-unscaled"] = np.square(data["tag-anomaly-unscaled"]).mean(
-            axis=1
-        )
+        # per-tag |error| in scaled space (the scaler absorbs per-tag units)
+        scale = lambda arr: self.scaler.transform(np.asarray(arr))  # noqa: E731
+        scaled_gap = np.abs(scale(output) - scale(y)[-len(data):, :])
+        data = data.join(labeled(scaled_gap, "tag-anomaly-scaled", y.columns))
+        # and in raw engineering units
+        raw_gap = np.abs(output.to_numpy() - y_tail)
+        data = data.join(labeled(raw_gap, "tag-anomaly-unscaled", y.columns))
+        for flavor in ("scaled", "unscaled"):
+            data[f"total-anomaly-{flavor}"] = np.square(
+                data[f"tag-anomaly-{flavor}"]
+            ).mean(axis=1)
 
         if self.window is not None:
-            smooth_tag = tag_anomaly_scaled.rolling(self.window).median()
-            smooth_tag.columns = smooth_tag.columns.set_levels(
-                ["smooth-tag-anomaly-scaled"], level=0
-            )
-            data = data.join(smooth_tag)
-            data["smooth-total-anomaly-scaled"] = (
-                data["total-anomaly-scaled"].rolling(self.window).median()
-            )
-            smooth_unscaled = unscaled_abs_diff.rolling(self.window).median()
-            smooth_unscaled.columns = smooth_unscaled.columns.set_levels(
-                ["smooth-tag-anomaly-unscaled"], level=0
-            )
-            data = data.join(smooth_unscaled)
-            data["smooth-total-anomaly-unscaled"] = (
-                data["total-anomaly-unscaled"].rolling(self.window).median()
-            )
+            # rolling-median smoothing of every anomaly column
+            for flavor in ("scaled", "unscaled"):
+                smooth = (
+                    data[f"tag-anomaly-{flavor}"].rolling(self.window).median()
+                )
+                data = data.join(
+                    labeled(smooth.to_numpy(), f"smooth-tag-anomaly-{flavor}", y.columns)
+                )
+                data[f"smooth-total-anomaly-{flavor}"] = (
+                    data[f"total-anomaly-{flavor}"].rolling(self.window).median()
+                )
 
-        # anomaly confidence = anomaly / threshold
-        confidence, index = None, None
-        if getattr(self, "smooth_feature_thresholds_", None) is not None:
-            confidence = (
-                data["smooth-tag-anomaly-scaled"].to_numpy()
-                / self.smooth_feature_thresholds_.to_numpy()
-            )
-            index = data["smooth-tag-anomaly-scaled"].index
-        elif hasattr(self, "feature_thresholds_"):
-            confidence = tag_anomaly_scaled.values / self.feature_thresholds_.values
-            index = tag_anomaly_scaled.index
+        data = self._join_confidences(data)
 
-        if confidence is not None and index is not None:
-            anomaly_confidence_scores = pd.DataFrame(
-                confidence,
-                index=index,
-                columns=pd.MultiIndex.from_product(
-                    (("anomaly-confidence",), data["model-output"].columns)
-                ),
-            )
-            data = data.join(anomaly_confidence_scores)
-
-        total_anomaly_confidence = None
-        if getattr(self, "smooth_aggregate_threshold_", None) is not None:
-            total_anomaly_confidence = (
-                data["smooth-total-anomaly-scaled"] / self.smooth_aggregate_threshold_
-            )
-        elif hasattr(self, "aggregate_threshold_"):
-            total_anomaly_confidence = (
-                data["total-anomaly-scaled"] / self.aggregate_threshold_
-            )
-        if total_anomaly_confidence is not None:
-            data["total-anomaly-confidence"] = total_anomaly_confidence
-
-        if self.require_thresholds and not any(
-            hasattr(self, attr)
-            for attr in ("feature_thresholds_", "aggregate_threshold_")
+        if self.require_thresholds and not (
+            hasattr(self, "feature_thresholds_")
+            or hasattr(self, "aggregate_threshold_")
         ):
             raise AttributeError(
                 f"`require_thresholds={self.require_thresholds}` however "
@@ -333,4 +291,42 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
                 "these thresholds before calling `.anomaly`"
             )
 
+        return data
+
+    def _join_confidences(self, data: pd.DataFrame) -> pd.DataFrame:
+        """
+        confidence = anomaly / threshold, preferring the smoothed pair when
+        a window was configured and smoothed thresholds exist.
+        """
+        if getattr(self, "smooth_feature_thresholds_", None) is not None:
+            per_tag = (
+                data["smooth-tag-anomaly-scaled"].to_numpy()
+                / self.smooth_feature_thresholds_.to_numpy()
+            )
+        elif hasattr(self, "feature_thresholds_"):
+            per_tag = (
+                data["tag-anomaly-scaled"].to_numpy()
+                / self.feature_thresholds_.to_numpy()
+            )
+        else:
+            per_tag = None
+        if per_tag is not None:
+            data = data.join(
+                pd.DataFrame(
+                    per_tag,
+                    index=data.index,
+                    columns=pd.MultiIndex.from_product(
+                        (("anomaly-confidence",), data["model-output"].columns)
+                    ),
+                )
+            )
+
+        if getattr(self, "smooth_aggregate_threshold_", None) is not None:
+            data["total-anomaly-confidence"] = (
+                data["smooth-total-anomaly-scaled"] / self.smooth_aggregate_threshold_
+            )
+        elif hasattr(self, "aggregate_threshold_"):
+            data["total-anomaly-confidence"] = (
+                data["total-anomaly-scaled"] / self.aggregate_threshold_
+            )
         return data

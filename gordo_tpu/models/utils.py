@@ -26,8 +26,10 @@ def metric_wrapper(metric, scaler: Optional[TransformerMixin] = None):
     @functools.wraps(metric)
     def _wrapper(y_true, y_pred, *args, **kwargs):
         if scaler:
-            y_true = scaler.transform(y_true)
-            y_pred = scaler.transform(y_pred)
+            # bare arrays: mixing frames and ndarrays through one scaler
+            # trips sklearn's feature-name consistency warnings
+            y_true = scaler.transform(np.asarray(y_true))
+            y_pred = scaler.transform(np.asarray(y_pred))
         return metric(y_true[-len(y_pred):], y_pred, *args, **kwargs)
 
     return _wrapper
