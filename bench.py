@@ -226,12 +226,15 @@ def accelerator_usable(timeout_s: int) -> bool:
     persistent XLA cache so its warmup is not wasted.
     """
     probe = (
-        "import jax;"
-        "jax.config.update('jax_compilation_cache_dir', %r);"
-        "d = jax.devices()[0];"
-        "print(d.platform);"
-        "import jax.numpy as jnp;"
-        "(jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()"
+        "import jax\n"
+        "try:\n"
+        "    jax.config.update('jax_compilation_cache_dir', %r)\n"
+        "except Exception:\n"
+        "    pass  # cache is an optimization; never fail the probe over it\n"
+        "d = jax.devices()[0]\n"
+        "print(d.platform)\n"
+        "import jax.numpy as jnp\n"
+        "(jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()\n"
         % XLA_CACHE_DIR
     )
     try:

@@ -73,11 +73,131 @@ def timed_posts(client, url, body, rounds):
     return {**summarize_ms(times), "rounds": rounds}
 
 
+_LIVE_SERVER_SCRIPT = """
+import os
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from gordo_tpu.utils import honor_jax_platforms_env
+honor_jax_platforms_env()
+from gordo_tpu.server.app import run_server
+run_server("127.0.0.1", {port}, workers={workers}, log_level="warning",
+           threads={threads})
+"""
+
+
+def live_throughput(
+    collection: str,
+    workers: int,
+    threads: int,
+    body: dict,
+    n_requests: int = 120,
+    parallel: int = 12,
+) -> dict:
+    """
+    Requests/sec against a real pre-forked server at the given
+    workers/threads setting — the load test demonstrating that the
+    runner's knobs change concurrency (see server/runner.py).
+    """
+    import signal
+    import socket
+    import subprocess
+    import threading
+
+    import requests as http
+
+    probe = socket.create_server(("127.0.0.1", 0))
+    port = probe.getsockname()[1]
+    probe.close()
+
+    env = dict(os.environ, MODEL_COLLECTION_DIR=collection, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [
+            sys.executable,
+            "-c",
+            _LIVE_SERVER_SCRIPT.format(port=port, workers=workers, threads=threads),
+        ],
+        env=env,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    url = f"http://127.0.0.1:{port}/gordo/v0/proj/bench-m0/prediction"
+    try:
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            try:
+                # generous timeout: the first request pays model load + jit
+                if http.post(url, json=body, timeout=120).status_code == 200:
+                    break
+            except http.RequestException:
+                pass
+            time.sleep(0.3)
+        else:
+            raise RuntimeError("live server never came up")
+
+        # parallel warmup burst so EVERY forked worker pays its model
+        # load + jit compile before the timed phase (sequential warmup
+        # would only reliably warm one of them)
+        warm_done = threading.Semaphore(0)
+
+        def warm():
+            try:
+                http.post(url, json=body, timeout=120)
+            finally:
+                warm_done.release()
+
+        n_warm = 4 * max(workers, 1) * 2
+        for _ in range(n_warm):
+            threading.Thread(target=warm, daemon=True).start()
+        for _ in range(n_warm):
+            warm_done.acquire()
+
+        pids, errors = set(), []
+        done = threading.Semaphore(0)
+        per_thread = n_requests // parallel
+
+        def fire():
+            try:
+                for _ in range(per_thread):
+                    resp = http.post(url, json=body, timeout=60)
+                    assert resp.status_code == 200
+                    pids.add(resp.headers.get("X-Gordo-Server-Pid"))
+            except Exception as exc:  # surfaced below
+                errors.append(repr(exc))
+            finally:
+                done.release()
+
+        start = time.perf_counter()
+        for _ in range(parallel):
+            threading.Thread(target=fire, daemon=True).start()
+        for _ in range(parallel):
+            done.acquire()
+        elapsed = time.perf_counter() - start
+        assert not errors, errors[:3]
+        return {
+            "workers": workers,
+            "threads": threads,
+            "requests": per_thread * parallel,
+            "requests_per_s": round(per_thread * parallel / elapsed, 2),
+            "serving_pids": len(pids),
+        }
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=20)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--rounds", type=int, default=100)
     parser.add_argument("--samples", type=int, default=100)
     parser.add_argument("--fleet-machines", type=int, default=8)
+    parser.add_argument(
+        "--concurrency",
+        action="store_true",
+        help="Also load-test a live pre-forked server at several "
+        "workers/threads settings.",
+    )
     args = parser.parse_args()
 
     import numpy as np
@@ -131,6 +251,12 @@ def main():
             fleet["mean_ms"] / args.fleet_machines, 3
         )
         results["fleet_prediction"] = fleet
+
+        if args.concurrency:
+            results["live_concurrency"] = [
+                live_throughput(collection, workers, threads, {"X": X})
+                for workers, threads in ((1, 1), (1, 8), (2, 8))
+            ]
 
         print(json.dumps(results))
 

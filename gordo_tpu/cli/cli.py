@@ -412,19 +412,27 @@ def sweep_cli(
 )
 @click.option(
     "--workers",
-    type=click.IntRange(1, 4),
-    default=2,
+    type=click.IntRange(1, 32),
+    default=1,
     envvar="GORDO_SERVER_WORKERS",
     show_default=True,
-    help="Worker processes (kept for flag parity; the werkzeug server is "
-    "single-process multi-threaded, which keeps one TPU context hot).",
+    help="Pre-forked worker processes sharing one listening socket. Keep "
+    "at 1 for TPU serving (the chip is exclusive to a process); raise "
+    "for CPU-bound deployments.",
 )
 @click.option(
     "--threads",
     type=int,
     default=8,
     envvar="GORDO_SERVER_THREADS",
-    help="Worker threads for handling requests.",
+    help="Per-worker bound on concurrently handled requests.",
+)
+@click.option(
+    "--worker-connections",
+    type=int,
+    default=None,
+    envvar="GORDO_SERVER_WORKER_CONNECTIONS",
+    help="Per-worker bound on simultaneously accepted connections.",
 )
 @click.option(
     "--log-level",
@@ -439,13 +447,21 @@ def sweep_cli(
     is_flag=True,
     help="Enable Prometheus request metrics.",
 )
-def run_server_cli(host, port, workers, threads, log_level, with_prometheus):
+def run_server_cli(
+    host, port, workers, threads, worker_connections, log_level, with_prometheus
+):
     """Run the model server (reference: cli.py:278-374)."""
     from gordo_tpu.server import app as server_app
 
     config = {"ENABLE_PROMETHEUS": True} if with_prometheus else None
     server_app.run_server(
-        host, port, workers, log_level, config=config, threads=threads
+        host,
+        port,
+        workers,
+        log_level,
+        config=config,
+        threads=threads,
+        worker_connections=worker_connections,
     )
 
 

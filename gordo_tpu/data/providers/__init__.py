@@ -6,6 +6,9 @@ Data providers: sources of raw tag series.
   tag (parquet/csv)
 - LongFormatProvider — melted (tag, time, value) files in date-partitioned
   directories, pivoted long→wide (the IROC-reader analogue)
+- ObjectStoreProvider — fsspec-backed remote lake reader (gs/s3/abfs/...)
+  with credential handling; no FUSE mount required
+
 - InfluxDataProvider — InfluxDB reader (requires the ``influxdb`` package)
 - DataLakeProvider  — compat alias accepted in legacy configs; resolves to
   FileSystemProvider semantics against a mounted lake path
@@ -15,6 +18,11 @@ from .base import GordoBaseDataProvider
 from .random_provider import RandomDataProvider
 from .filesystem import FileSystemProvider
 from .longformat import LongFormatProvider
+from .objectstore import (
+    ObjectStoreAuthError,
+    ObjectStoreProvider,
+    resolve_storage_options,
+)
 from .compound import (
     DataLakeProvider,
     NoSuitableDataProviderError,
@@ -33,6 +41,9 @@ __all__ = [
     "RandomDataProvider",
     "FileSystemProvider",
     "LongFormatProvider",
+    "ObjectStoreProvider",
+    "ObjectStoreAuthError",
+    "resolve_storage_options",
     "DataLakeProvider",
     "NoSuitableDataProviderError",
     "providers_for_tags",

@@ -98,6 +98,10 @@ class FileSystemProvider(GordoBaseDataProvider):
             df = pd.read_parquet(path)
         else:
             df = pd.read_csv(path)
+        return self._normalize_frame(df, path)
+
+    def _normalize_frame(self, df: pd.DataFrame, path: Path) -> pd.DataFrame:
+        """Raw file frame -> (Time-indexed, Value) with status filtering."""
         # normalize column names: (Time, Value[, Status]) or first-two-columns
         cols = {c.lower(): c for c in df.columns}
         time_col = cols.get("time", df.columns[0])

@@ -1,0 +1,198 @@
+"""
+Object-store tag reader over fsspec — the cloud-lake data path with real
+credential handling (reference layering: gordo/machine/dataset/
+data_provider/azure_utils.py:14-91 acquires tokens and builds an ADLS
+client that ncs_reader.py:223-259 reads through; here fsspec plays the
+client role so the same provider serves gs://, s3://, abfs://, az://,
+http(s):// or memory:// lakes without a FUSE sidecar mount).
+
+Layout and semantics are inherited from :class:`FileSystemProvider`
+(per-tag per-year files, parquet preferred, thread fan-out, status-code
+drops, keep-last dedup); only path resolution and IO are rebound to the
+remote filesystem. Parquet files are opened as seekable fsspec handles so
+pyarrow fetches column chunks with ranged reads instead of whole objects.
+
+Credential resolution (mirroring the reference's
+``tenant:client_id:secret``-string-from-env pattern, azure_utils.py:14-61)
+feeds fsspec ``storage_options``; precedence:
+
+1. ``credentials``      — dict passed directly (avoid in YAML configs:
+   it round-trips through ``to_dict`` and would land in stored metadata)
+2. ``credentials_file`` — path to a JSON file of storage options
+3. ``credentials_env``  — name of an env var holding JSON storage options
+   (the recommended, secret-free-config option)
+
+Authentication is lazy and lock-guarded: the filesystem is built on first
+use, not at construction (reference: providers.py:158-169), so configs
+validate and serialize without touching the store.
+"""
+
+import json
+import logging
+import os
+import threading
+import typing
+from datetime import datetime
+from pathlib import Path
+
+import pandas as pd
+
+from gordo_tpu.data.providers.filesystem import FileSystemProvider
+from gordo_tpu.data.sensor_tag import SensorTag
+from gordo_tpu.utils import capture_args
+
+logger = logging.getLogger(__name__)
+
+
+class ObjectStoreAuthError(Exception):
+    """Credential material was requested but could not be resolved."""
+
+
+def resolve_storage_options(
+    credentials: typing.Optional[dict] = None,
+    credentials_file: typing.Optional[str] = None,
+    credentials_env: typing.Optional[str] = None,
+) -> dict:
+    """Merge credential sources into fsspec storage_options (see module doc)."""
+    options: typing.Dict[str, typing.Any] = {}
+    if credentials_env:
+        raw = os.environ.get(credentials_env)
+        if raw is None:
+            raise ObjectStoreAuthError(
+                f"credentials_env={credentials_env!r} is not set in the environment"
+            )
+        try:
+            options.update(json.loads(raw))
+        except ValueError as exc:
+            raise ObjectStoreAuthError(
+                f"env var {credentials_env!r} does not hold valid JSON"
+            ) from exc
+    if credentials_file:
+        try:
+            with open(credentials_file) as fh:
+                options.update(json.load(fh))
+        except OSError as exc:
+            raise ObjectStoreAuthError(
+                f"cannot read credentials file {credentials_file!r}"
+            ) from exc
+        except ValueError as exc:
+            raise ObjectStoreAuthError(
+                f"credentials file {credentials_file!r} does not hold valid JSON"
+            ) from exc
+    if credentials:
+        options.update(credentials)
+    return options
+
+
+class ObjectStoreProvider(FileSystemProvider):
+    @capture_args
+    def __init__(
+        self,
+        base_uri: str,
+        credentials: typing.Optional[dict] = None,
+        credentials_file: typing.Optional[str] = None,
+        credentials_env: typing.Optional[str] = None,
+        threads: int = 10,
+        remove_status_codes: typing.Optional[list] = None,
+        dry_run: bool = False,
+        **kwargs,
+    ):
+        # NOTE: not super().__init__() — the parent's capture_args would
+        # overwrite this class's captured params. The inherited fields are
+        # assigned directly; base_dir is unused (path resolution overridden).
+        self.base_dir = Path("")
+        self.threads = threads
+        self.remove_status_codes = remove_status_codes
+        self.dry_run = dry_run
+        self.base_uri = base_uri.rstrip("/")
+        self._credentials = credentials
+        self._credentials_file = credentials_file
+        self._credentials_env = credentials_env
+        self._fs = None
+        self._fs_lock = threading.Lock()
+
+    # --- authenticated filesystem (lazy, lock-guarded) --------------------
+
+    @property
+    def filesystem(self):
+        if self._fs is None:
+            with self._fs_lock:
+                if self._fs is None:
+                    self._fs = self._connect()
+        return self._fs
+
+    def _connect(self):
+        import fsspec
+
+        protocol, _ = fsspec.core.split_protocol(self.base_uri)
+        options = resolve_storage_options(
+            self._credentials, self._credentials_file, self._credentials_env
+        )
+        logger.info(
+            "authenticating %s filesystem (%d storage options)",
+            protocol or "local",
+            len(options),
+        )
+        try:
+            return fsspec.filesystem(protocol or "file", **options)
+        except (ImportError, ValueError) as exc:
+            raise ObjectStoreAuthError(
+                f"cannot build {protocol!r} filesystem: {exc}"
+            ) from exc
+
+    def _strip(self) -> str:
+        """base_uri without its protocol (fsspec paths are protocol-less)."""
+        import fsspec
+
+        _, path = fsspec.core.split_protocol(self.base_uri)
+        return path.rstrip("/")
+
+    # --- path resolution/IO rebound to the remote store -------------------
+
+    def _tag_dir(self, tag: SensorTag) -> typing.Optional[str]:
+        fs = self.filesystem
+        roots = [self._strip()]
+        if tag.asset:
+            roots.insert(0, f"{self._strip()}/{tag.asset}")
+        for root in roots:
+            if fs.isdir(f"{root}/{tag.name}"):
+                return root
+            for suffix in (".parquet", ".csv"):
+                if fs.isfile(f"{root}/{tag.name}{suffix}"):
+                    return root
+        return None
+
+    def _tag_files(
+        self, tag: SensorTag, years: typing.Iterable[int]
+    ) -> typing.List[str]:
+        fs = self.filesystem
+        root = self._tag_dir(tag)
+        if root is None:
+            raise FileNotFoundError(
+                f"No files found for tag {tag.name} under {self.base_uri}"
+            )
+        tag_dir = f"{root}/{tag.name}"
+        files: typing.List[str] = []
+        if fs.isdir(tag_dir):
+            for year in years:
+                for suffix in (".parquet", ".csv"):
+                    candidate = f"{tag_dir}/{tag.name}_{year}{suffix}"
+                    if fs.isfile(candidate):
+                        files.append(candidate)
+                        break
+        else:
+            for suffix in (".parquet", ".csv"):
+                candidate = f"{root}/{tag.name}{suffix}"
+                if fs.isfile(candidate):
+                    files.append(candidate)
+                    break
+        return files
+
+    def _read_file(self, path: str, tag_name: str) -> pd.DataFrame:
+        # seekable handle -> pyarrow issues ranged reads for parquet
+        with self.filesystem.open(path, "rb") as fh:
+            if str(path).endswith(".parquet"):
+                df = pd.read_parquet(fh)
+            else:
+                df = pd.read_csv(fh)
+        return self._normalize_frame(df, Path(str(path)))

@@ -257,6 +257,8 @@ class GordoApp:
             response.headers["revision"] = ctx.revision
         runtime_s = timeit.default_timer() - ctx.start_time
         response.headers["Server-Timing"] = f"request_walltime_s;dur={runtime_s}"
+        # which pre-forked worker served this (see server/runner.py)
+        response.headers["X-Gordo-Server-Pid"] = str(os.getpid())
         if self.prometheus_metrics is not None and request.path not in (
             "/healthcheck",
             "/metrics",  # don't count scrapes as server traffic
@@ -507,7 +509,8 @@ class GordoApp:
         models: typing.Optional[typing.Dict[str, typing.Any]] = None,
     ):
         key = (os.path.realpath(ctx.collection_dir), names)
-        # the server runs threaded (run_simple(threaded=True)): hold the
+        # requests are handled by concurrent threads (ServerRunner's
+        # ThreadedWSGIServer, server/runner.py): hold the
         # lock only for dict reads/writes so warm lookups never stall
         # behind another key's build; two concurrent first requests for the
         # same key may both build (harmless — last insert wins)
@@ -937,22 +940,30 @@ def _warm_model(model) -> bool:
 def run_server(
     host: str,
     port: int,
-    workers: int = 2,
+    workers: int = 1,
     log_level: str = "debug",
     config: typing.Optional[dict] = None,
     threads: typing.Optional[int] = None,
     worker_connections: typing.Optional[int] = None,
-    server_app: str = "gordo_tpu.server.app:build_app()",
 ):
     """
-    Run the server (reference: server/server.py:230-294, which shells out
-    to gunicorn). This stack serves with werkzeug's threaded WSGI server —
-    TPU work is dispatch-bound, so one process with many threads keeps a
-    single device context hot; scale-out is by replica, as in the
-    reference's HPA deployment.
+    Run the server under the native pre-fork runner
+    (reference: server/server.py:230-294, which shells out to gunicorn
+    with the same worker/thread/connection knobs — see server/runner.py
+    for how each is honored here). The default of ONE worker is
+    deliberate for TPU serving: the chip is exclusive to a process, so a
+    single process with many handler threads keeps one device context
+    hot and scale-out happens by replica, as in the reference's HPA
+    deployment.
     """
-    from werkzeug.serving import run_simple
+    from gordo_tpu.server.runner import ServerRunner
 
     logging.getLogger("werkzeug").setLevel(log_level.upper())
-    app = build_app(config)
-    run_simple(host, port, app, threaded=True, use_reloader=False)
+    ServerRunner(
+        app_factory=lambda: build_app(config),
+        host=host,
+        port=port,
+        workers=workers,
+        threads=threads if threads is not None else 8,
+        worker_connections=worker_connections,
+    ).serve_forever()

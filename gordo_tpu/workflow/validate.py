@@ -1,0 +1,305 @@
+"""
+Structural validation of rendered Argo Workflow manifests.
+
+The reference lints generated workflows with the real argo CLI in docker
+(tests/gordo/workflow/test_workflow_generator.py:88-113). That binary is
+unavailable here, so the schema rules argo lint actually trips on are
+vendored as code: a rendered manifest that passes this validator would
+also parse in the argo controller's workflow-spec unmarshalling for every
+construct our template emits. Used by the workflow tests on every
+rendered document (instead of bare ``yaml.safe_load``).
+"""
+
+import re
+import typing
+
+import yaml
+
+_DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9.]{0,251}[a-z0-9])?$")
+
+# a template must declare exactly one of these executors
+TEMPLATE_EXECUTORS = ("dag", "steps", "container", "script", "resource", "suspend")
+
+RESOURCE_ACTIONS = {"create", "apply", "delete", "patch", "replace", "get"}
+
+
+class WorkflowValidationError(AssertionError):
+    """A rendered manifest violates the Argo Workflow structure."""
+
+    def __init__(self, path: str, problem: str):
+        super().__init__(f"{path}: {problem}")
+        self.path = path
+        self.problem = problem
+
+
+def _fail(path: str, problem: str) -> typing.NoReturn:
+    raise WorkflowValidationError(path, problem)
+
+
+def _require(condition, path: str, problem: str):
+    if not condition:
+        _fail(path, problem)
+
+
+def _require_name(value, path: str):
+    _require(isinstance(value, str) and value, path, "must be a non-empty string")
+    _require(
+        _DNS1123.match(value.lower()) is not None,
+        path,
+        f"{value!r} is not a valid kubernetes name",
+    )
+
+
+def validate_manifest(doc, path: str = "manifest"):
+    """Generic k8s-object sanity: apiVersion/kind/metadata.name shape."""
+    _require(isinstance(doc, dict), path, "must be a mapping")
+    for key in ("apiVersion", "kind"):
+        _require(
+            isinstance(doc.get(key), str) and doc[key], f"{path}.{key}", "required"
+        )
+    metadata = doc.get("metadata")
+    _require(isinstance(metadata, dict), f"{path}.metadata", "required mapping")
+    name = metadata.get("name") or metadata.get("generateName")
+    _require_name(name.rstrip("-") if isinstance(name, str) else name,
+                  f"{path}.metadata.name")
+    labels = metadata.get("labels", {})
+    _require(
+        all(isinstance(k, str) and isinstance(v, str) for k, v in labels.items()),
+        f"{path}.metadata.labels",
+        "labels must map strings to strings",
+    )
+
+
+def _validate_parameters(params, path: str):
+    _require(isinstance(params, list), path, "must be a list")
+    seen = set()
+    for i, param in enumerate(params):
+        _require(isinstance(param, dict), f"{path}[{i}]", "must be a mapping")
+        name = param.get("name")
+        _require(isinstance(name, str) and name, f"{path}[{i}].name", "required")
+        _require(name not in seen, f"{path}[{i}].name", f"duplicate {name!r}")
+        seen.add(name)
+
+
+def _validate_container(container, path: str):
+    _require(isinstance(container, dict), path, "must be a mapping")
+    _require(
+        isinstance(container.get("image"), str) and container["image"],
+        f"{path}.image",
+        "required",
+    )
+    for list_field in ("command", "args"):
+        value = container.get(list_field)
+        if value is not None:
+            _require(
+                isinstance(value, list)
+                and all(isinstance(v, str) for v in value),
+                f"{path}.{list_field}",
+                "must be a list of strings",
+            )
+    for env_i, env in enumerate(container.get("env") or []):
+        _require(
+            isinstance(env, dict) and isinstance(env.get("name"), str),
+            f"{path}.env[{env_i}]",
+            "each env entry needs a string name",
+        )
+        _require(
+            "value" in env or "valueFrom" in env,
+            f"{path}.env[{env_i}]",
+            "needs value or valueFrom",
+        )
+
+
+def _validate_dag(dag, path: str, template_names: typing.Set[str]):
+    tasks = dag.get("tasks")
+    _require(isinstance(tasks, list) and tasks, f"{path}.tasks", "non-empty list")
+    names = set()
+    for i, task in enumerate(tasks):
+        tpath = f"{path}.tasks[{i}]"
+        _require(isinstance(task, dict), tpath, "must be a mapping")
+        name = task.get("name")
+        _require(isinstance(name, str) and name, f"{tpath}.name", "required")
+        _require(name not in names, f"{tpath}.name", f"duplicate task {name!r}")
+        names.add(name)
+        has_ref = isinstance(task.get("templateRef"), dict)
+        template = task.get("template")
+        _require(
+            has_ref or (isinstance(template, str) and template),
+            f"{tpath}.template",
+            "task needs template or templateRef",
+        )
+        if template and not has_ref:
+            _require(
+                template in template_names,
+                f"{tpath}.template",
+                f"references unknown template {template!r}",
+            )
+    # second pass: dependencies must point at sibling tasks
+    for i, task in enumerate(tasks):
+        for dep in task.get("dependencies") or []:
+            _require(
+                dep in names,
+                f"{path}.tasks[{i}].dependencies",
+                f"references unknown task {dep!r}",
+            )
+
+
+def _validate_steps(steps, path: str, template_names: typing.Set[str]):
+    _require(isinstance(steps, list) and steps, path, "non-empty list")
+    for i, group in enumerate(steps):
+        group = group if isinstance(group, list) else [group]
+        for j, step in enumerate(group):
+            spath = f"{path}[{i}][{j}]"
+            _require(isinstance(step, dict), spath, "must be a mapping")
+            _require(
+                isinstance(step.get("name"), str) and step["name"],
+                f"{spath}.name",
+                "required",
+            )
+            template = step.get("template")
+            if template and "templateRef" not in step:
+                _require(
+                    template in template_names,
+                    f"{spath}.template",
+                    f"references unknown template {template!r}",
+                )
+
+
+def _validate_resource(resource, path: str):
+    _require(isinstance(resource, dict), path, "must be a mapping")
+    action = resource.get("action")
+    _require(
+        action in RESOURCE_ACTIONS,
+        f"{path}.action",
+        f"{action!r} not one of {sorted(RESOURCE_ACTIONS)}",
+    )
+    manifest = resource.get("manifest")
+    if manifest is not None:
+        _require(isinstance(manifest, str), f"{path}.manifest", "must be a string")
+        try:
+            parsed = yaml.safe_load(manifest)
+        except yaml.YAMLError as exc:
+            # {{workflow.parameters.*}} expressions are substituted by the
+            # argo controller before the manifest must parse; only a
+            # template-free manifest has to be valid YAML already
+            if "{{" not in manifest:
+                _fail(f"{path}.manifest", f"not parseable YAML: {exc}")
+            parsed = None
+        if isinstance(parsed, dict) and "apiVersion" in parsed:
+            validate_manifest(parsed, f"{path}.manifest")
+
+
+def _validate_template(template, path: str, template_names: typing.Set[str]):
+    _require(isinstance(template, dict), path, "must be a mapping")
+    executors = [key for key in TEMPLATE_EXECUTORS if key in template]
+    _require(
+        len(executors) == 1,
+        path,
+        f"template must have exactly one executor, found {executors or 'none'}",
+    )
+    (executor,) = executors
+    epath = f"{path}.{executor}"
+    if executor == "dag":
+        _validate_dag(template["dag"], epath, template_names)
+    elif executor == "steps":
+        _validate_steps(template["steps"], epath, template_names)
+    elif executor == "container":
+        _validate_container(template["container"], epath)
+    elif executor == "script":
+        _validate_container(template["script"], epath)
+        _require(
+            isinstance(template["script"].get("source"), str),
+            f"{epath}.source",
+            "required",
+        )
+    elif executor == "resource":
+        _validate_resource(template["resource"], epath)
+    inputs = template.get("inputs", {})
+    if "parameters" in inputs:
+        _validate_parameters(inputs["parameters"], f"{path}.inputs.parameters")
+    retry = template.get("retryStrategy")
+    if retry is not None:
+        limit = retry.get("limit")
+        _require(
+            limit is None or str(limit).isdigit(),
+            f"{path}.retryStrategy.limit",
+            f"{limit!r} is not an integer",
+        )
+
+
+def validate_workflow(doc) -> None:
+    """
+    Validate one rendered Argo Workflow document; raises
+    :class:`WorkflowValidationError` naming the offending path.
+    """
+    validate_manifest(doc, "workflow")
+    _require(
+        doc.get("apiVersion") == "argoproj.io/v1alpha1",
+        "workflow.apiVersion",
+        f"{doc.get('apiVersion')!r} != 'argoproj.io/v1alpha1'",
+    )
+    _require(
+        doc.get("kind") == "Workflow", "workflow.kind", f"{doc.get('kind')!r}"
+    )
+    spec = doc.get("spec")
+    _require(isinstance(spec, dict), "workflow.spec", "required mapping")
+
+    templates = spec.get("templates")
+    _require(
+        isinstance(templates, list) and templates,
+        "workflow.spec.templates",
+        "non-empty list required",
+    )
+    names: typing.Set[str] = set()
+    for i, template in enumerate(templates):
+        name = isinstance(template, dict) and template.get("name")
+        _require(
+            isinstance(name, str) and bool(name),
+            f"workflow.spec.templates[{i}].name",
+            "required",
+        )
+        _require(name not in names, f"workflow.spec.templates[{i}].name",
+                 f"duplicate template {name!r}")
+        names.add(name)
+
+    entrypoint = spec.get("entrypoint")
+    _require(
+        isinstance(entrypoint, str) and entrypoint,
+        "workflow.spec.entrypoint",
+        "required",
+    )
+    _require(
+        entrypoint in names,
+        "workflow.spec.entrypoint",
+        f"references unknown template {entrypoint!r}",
+    )
+    on_exit = spec.get("onExit")
+    if on_exit:
+        _require(
+            on_exit in names,
+            "workflow.spec.onExit",
+            f"references unknown template {on_exit!r}",
+        )
+    if "arguments" in spec and "parameters" in (spec["arguments"] or {}):
+        _validate_parameters(
+            spec["arguments"]["parameters"], "workflow.spec.arguments.parameters"
+        )
+    for i, template in enumerate(templates):
+        _validate_template(template, f"workflow.spec.templates[{i}]", names)
+
+
+def validate_rendered(documents: typing.Iterable[dict]) -> int:
+    """
+    Validate every non-empty rendered document (Workflows strictly, other
+    k8s kinds generically). Returns how many documents were checked.
+    """
+    count = 0
+    for doc in documents:
+        if doc is None:
+            continue
+        count += 1
+        if isinstance(doc, dict) and doc.get("kind") == "Workflow":
+            validate_workflow(doc)
+        else:
+            validate_manifest(doc)
+    return count

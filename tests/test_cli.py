@@ -15,6 +15,7 @@ from gordo_tpu import __version__, serializer
 from gordo_tpu.cli import gordo
 from gordo_tpu.cli.cli import expand_model, get_all_score_strings
 from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
+from gordo_tpu.workflow.validate import validate_rendered
 
 MACHINE_YAML = """
 name: cli-machine
@@ -219,7 +220,12 @@ def _render_workflows(runner, config_file, *extra):
         ],
     )
     assert result.exit_code == 0, result.output
-    return list(yaml.safe_load_all(result.output))
+    docs = list(yaml.safe_load_all(result.output))
+    # every rendered manifest must be structurally valid Argo/k8s, not
+    # merely parseable YAML (reference lints with the argo CLI image:
+    # tests/gordo/workflow/test_workflow_generator.py:88-113)
+    validate_rendered(docs)
+    return docs
 
 
 def test_workflow_generate_renders_valid_yaml(runner, project_config_file):

@@ -1,0 +1,83 @@
+"""
+Compile-cache stress: O(100) heterogeneous machines must NOT trigger
+per-machine XLA recompilation (SURVEY §7 hard part — "thousands of tiny
+models vs XLA compile time"). Each architecture/shape bucket compiles a
+constant number of programs regardless of how many machines ride in it;
+backend compiles are counted via jax.monitoring.
+"""
+
+import pytest
+
+from gordo_tpu.machine import Machine
+from gordo_tpu.builder.fleet_build import FleetModelBuilder
+
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+
+@pytest.fixture
+def compile_counter():
+    from jax import monitoring
+
+    events = []
+
+    def listen(name, duration, **kwargs):
+        if name == COMPILE_EVENT:
+            events.append(name)
+
+    monitoring.register_event_duration_secs_listener(listen)
+    try:
+        yield events
+    finally:
+        monitoring.unregister_event_duration_listener(listen)
+
+
+def _machine(i: int, n_tags: int, kind: str) -> Machine:
+    return Machine(
+        name=f"stress-{n_tags}-{kind[-6:]}-{i}",
+        model={
+            "gordo_tpu.models.anomaly.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "gordo_tpu.models.AutoEncoder": {"kind": kind, "epochs": 1}
+                }
+            }
+        },
+        dataset={
+            "type": "RandomDataset",
+            "train_start_date": "2017-12-25 06:00:00Z",
+            "train_end_date": "2017-12-26 06:00:00Z",
+            "tags": [[f"Tag {t}", None] for t in range(n_tags)],
+        },
+        project_name="stress-proj",
+    )
+
+
+def _fleet(per_bucket: int):
+    """3 architecture buckets x per_bucket machines each."""
+    machines = []
+    for i in range(per_bucket):
+        machines.append(_machine(i, 3, "feedforward_hourglass"))
+        machines.append(_machine(i, 4, "feedforward_hourglass"))
+        machines.append(_machine(i, 5, "feedforward_symmetric"))
+    return machines
+
+
+def test_compiles_bounded_by_buckets_not_machines(compile_counter):
+    # small fleet: 12 machines over the 3 buckets
+    small = FleetModelBuilder(_fleet(4))
+    results = small.build()
+    assert len(results) == 12
+    small_compiles = len(compile_counter)
+
+    # large fleet: 96 machines over the SAME 3 buckets
+    del compile_counter[:]
+    big = FleetModelBuilder(_fleet(32))
+    results = big.build()
+    assert len(results) == 96
+    big_compiles = len(compile_counter)
+
+    # 8x the machines must not approach 8x the compiles: each bucket's
+    # programs are shared fleet-wide (measured ~187 vs ~213; a per-machine
+    # storm would add >= 3 compiles per extra machine, i.e. +250)
+    extra = big_compiles - small_compiles
+    assert extra < 84, (small_compiles, big_compiles)
+    assert big_compiles < 1.3 * small_compiles, (small_compiles, big_compiles)

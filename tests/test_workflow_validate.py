@@ -1,0 +1,154 @@
+"""
+Tests for the vendored Argo Workflow structural validator — the stand-in
+for reference argo-CLI linting (test_workflow_generator.py:88-113).
+"""
+
+import copy
+
+import pytest
+
+from gordo_tpu.workflow.validate import (
+    WorkflowValidationError,
+    validate_manifest,
+    validate_rendered,
+    validate_workflow,
+)
+
+GOOD = {
+    "apiVersion": "argoproj.io/v1alpha1",
+    "kind": "Workflow",
+    "metadata": {"name": "proj-123", "labels": {"app": "gordo"}},
+    "spec": {
+        "entrypoint": "do-all",
+        "onExit": "cleanup",
+        "arguments": {"parameters": [{"name": "revision", "value": "123"}]},
+        "templates": [
+            {
+                "name": "do-all",
+                "dag": {
+                    "tasks": [
+                        {"name": "build", "template": "builder"},
+                        {
+                            "name": "apply",
+                            "template": "applier",
+                            "dependencies": ["build"],
+                        },
+                    ]
+                },
+            },
+            {
+                "name": "builder",
+                "retryStrategy": {"limit": 2},
+                "container": {
+                    "image": "gordo/builder:1",
+                    "command": ["gordo", "build"],
+                    "env": [{"name": "MACHINE", "value": "{}"}],
+                },
+            },
+            {
+                "name": "applier",
+                "resource": {
+                    "action": "apply",
+                    "manifest": (
+                        "apiVersion: v1\nkind: Service\n"
+                        "metadata:\n  name: gordo-server\n"
+                    ),
+                },
+            },
+            {"name": "cleanup", "container": {"image": "alpine:3"}},
+        ],
+    },
+}
+
+
+def _broken(mutate):
+    doc = copy.deepcopy(GOOD)
+    mutate(doc)
+    return doc
+
+
+def test_good_workflow_passes():
+    validate_workflow(GOOD)
+    assert validate_rendered([GOOD, None]) == 1
+
+
+@pytest.mark.parametrize(
+    "mutate, path_fragment",
+    [
+        (lambda d: d.__setitem__("apiVersion", "v1"), "apiVersion"),
+        (lambda d: d["metadata"].pop("name"), "metadata.name"),
+        (lambda d: d["metadata"].__setitem__("name", "Bad_Name!"), "metadata.name"),
+        (lambda d: d["spec"].pop("entrypoint"), "entrypoint"),
+        (lambda d: d["spec"].__setitem__("entrypoint", "ghost"), "entrypoint"),
+        (lambda d: d["spec"].__setitem__("onExit", "ghost"), "onExit"),
+        (lambda d: d["spec"].__setitem__("templates", []), "templates"),
+        (
+            lambda d: d["spec"]["templates"][0]["dag"]["tasks"][0].__setitem__(
+                "template", "ghost"
+            ),
+            "tasks[0].template",
+        ),
+        (
+            lambda d: d["spec"]["templates"][0]["dag"]["tasks"][1].__setitem__(
+                "dependencies", ["ghost"]
+            ),
+            "dependencies",
+        ),
+        (
+            lambda d: d["spec"]["templates"][1]["container"].pop("image"),
+            "container.image",
+        ),
+        (
+            lambda d: d["spec"]["templates"][1].__setitem__("dag", {"tasks": []}),
+            "exactly one executor",
+        ),
+        (
+            lambda d: d["spec"]["templates"][1].pop("container"),
+            "exactly one executor",
+        ),
+        (
+            lambda d: d["spec"]["templates"][2]["resource"].__setitem__(
+                "action", "explode"
+            ),
+            "action",
+        ),
+        (
+            lambda d: d["spec"]["templates"][2]["resource"].__setitem__(
+                "manifest", "{not: valid: yaml"
+            ),
+            "manifest",
+        ),
+        (
+            lambda d: d["spec"]["templates"][1].__setitem__(
+                "retryStrategy", {"limit": "many"}
+            ),
+            "retryStrategy.limit",
+        ),
+        (
+            lambda d: d["spec"]["templates"].append(
+                {"name": "builder", "container": {"image": "x"}}
+            ),
+            "duplicate",
+        ),
+        (
+            lambda d: d["spec"]["arguments"]["parameters"].append(
+                {"name": "revision"}
+            ),
+            "duplicate",
+        ),
+    ],
+)
+def test_broken_workflows_rejected(mutate, path_fragment):
+    with pytest.raises(WorkflowValidationError) as err:
+        validate_workflow(_broken(mutate))
+    assert path_fragment in str(err.value) or path_fragment in err.value.problem
+
+
+def test_generic_manifest_check():
+    validate_manifest(
+        {"apiVersion": "v1", "kind": "Service", "metadata": {"name": "svc"}}
+    )
+    with pytest.raises(WorkflowValidationError):
+        validate_manifest({"kind": "Service", "metadata": {"name": "svc"}})
+    with pytest.raises(WorkflowValidationError):
+        validate_manifest("not-a-mapping")
