@@ -23,8 +23,8 @@ CONFIG_TPL = """
   - name: fleet-m{i}
     dataset:
       type: RandomDataset
-      tags: [tag-0, tag-1, tag-2, tag-3]
-      target_tag_list: [tag-0, tag-1, tag-2, tag-3]
+      tags: [{tags}]
+      target_tag_list: [{tags}]
       train_start_date: '2019-01-01T00:00:00+00:00'
       train_end_date: '2019-01-03T00:00:00+00:00'
       asset: gra
@@ -37,15 +37,31 @@ CONFIG_TPL = """
 """
 
 
-def make_machines(n: int, epochs: int):
+def make_machines(n: int, epochs: int, buckets: int = 1):
+    """n Machines spread over `buckets` architecture buckets (by tag count)."""
     import yaml
 
     from gordo_tpu.workflow.config_elements.normalized_config import NormalizedConfig
 
-    config = yaml.safe_load(
-        "machines:" + "".join(CONFIG_TPL.format(i=i, epochs=epochs) for i in range(n))
-    )
+    blocks = []
+    for i in range(n):
+        n_tags = 4 + (i % buckets)  # distinct n_features -> distinct bucket
+        tags = ", ".join(f"tag-{t}" for t in range(n_tags))
+        blocks.append(CONFIG_TPL.format(i=i, epochs=epochs, tags=tags))
+    config = yaml.safe_load("machines:" + "".join(blocks))
     return NormalizedConfig(config, project_name="bench").machines
+
+
+def reconstruction_mae(model, machine) -> float:
+    """Mean |y - reconstruction| of a built model on its own training data."""
+    import numpy as np
+
+    from gordo_tpu.data import _get_dataset
+
+    X, y = _get_dataset(machine.dataset.to_dict()).get_data()
+    predicted = model.predict(X)
+    target = np.asarray(y)[-len(predicted):]
+    return float(np.abs(np.asarray(predicted) - target).mean())
 
 
 def main():
@@ -59,22 +75,38 @@ def main():
         help="How many machines to time with the sequential builder "
         "(extrapolated; building all sequentially is the slow case)",
     )
+    parser.add_argument(
+        "--buckets",
+        type=int,
+        default=1,
+        help="Spread machines over this many architecture buckets "
+        "(distinct n_features), exercising the bucketing scheduler.",
+    )
     args = parser.parse_args()
+
+    import jax
 
     from gordo_tpu.builder.build_model import ModelBuilder
     from gordo_tpu.builder.fleet_build import FleetModelBuilder
 
-    machines = make_machines(args.machines, args.epochs)
+    device = jax.devices()[0]
+    machines = make_machines(args.machines, args.epochs, args.buckets)
 
     start = time.perf_counter()
-    FleetModelBuilder(machines).build()
+    fleet_results = FleetModelBuilder(machines).build()
     fleet_s = time.perf_counter() - start
 
-    seq_machines = make_machines(args.sequential_sample, args.epochs)
+    seq_machines = make_machines(args.sequential_sample, args.epochs, args.buckets)
     start = time.perf_counter()
-    for machine in seq_machines:
-        ModelBuilder(machine).build()
+    seq_results = [ModelBuilder(m).build() for m in seq_machines]
     seq_s_per_machine = (time.perf_counter() - start) / len(seq_machines)
+
+    # MAE parity: the SAME machine built both ways must reconstruct its
+    # training data equally well (the product promise of the fleet path)
+    fleet_model, fleet_machine = fleet_results[0]
+    seq_model, seq_machine = seq_results[0]
+    fleet_mae = reconstruction_mae(fleet_model, fleet_machine)
+    seq_mae = reconstruction_mae(seq_model, seq_machine)
 
     fleet_rate = args.machines / fleet_s * 3600
     seq_rate = 3600 / seq_s_per_machine
@@ -82,11 +114,16 @@ def main():
         json.dumps(
             {
                 "machines": args.machines,
+                "buckets": args.buckets,
                 "epochs": args.epochs,
+                "platform": device.platform,
+                "device_kind": device.device_kind,
                 "fleet_build_s": round(fleet_s, 2),
                 "fleet_models_per_hour": round(fleet_rate, 1),
                 "sequential_models_per_hour": round(seq_rate, 1),
                 "speedup": round(fleet_rate / seq_rate, 2),
+                "fleet_reconstruction_mae": round(fleet_mae, 5),
+                "sequential_reconstruction_mae": round(seq_mae, 5),
             }
         )
     )

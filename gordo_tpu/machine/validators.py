@@ -6,7 +6,6 @@ config objects (reference parity: gordo/machine/validators.py).
 import datetime
 import logging
 import re
-from typing import Any
 
 from dateutil.parser import isoparse
 

@@ -20,7 +20,7 @@ metadata) with the engine swapped for Flax + optax under ``jax.jit``:
 import logging
 import math
 from copy import copy
-from typing import Any, Callable, Dict, Optional, Union
+from typing import Callable, Optional, Union
 
 import jax
 import jax.numpy as jnp

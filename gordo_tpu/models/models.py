@@ -19,9 +19,8 @@ from sklearn.exceptions import NotFittedError
 from sklearn.metrics import explained_variance_score
 
 from gordo_tpu.models.core import BaseJaxEstimator
-from gordo_tpu.models.register import register_model_builder
 from gordo_tpu.models.specs import ModelSpec, SequentialNet, make_optimizer, resolve_dtype
-from gordo_tpu.ops.windowing import num_windows, window_sample_indices
+from gordo_tpu.ops.windowing import window_sample_indices
 
 # ensure factories register on import
 from gordo_tpu.models import factories  # noqa: F401

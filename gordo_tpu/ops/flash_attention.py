@@ -1,20 +1,28 @@
 """
-Blockwise (flash-style) attention as a Pallas TPU kernel.
+Blockwise (flash-style) attention as Pallas TPU kernels — forward AND
+backward.
 
 The dense attention path (gordo_tpu/models/specs_seq.py:dense_attention)
-materializes the full (seq, seq) score matrix in HBM; this kernel tiles the
-query axis so only a (block_q, seq) strip ever lives in VMEM, with the
-matmuls hitting the MXU in float32 accumulation. Head_dim and seq are padded
-to lane/sublane multiples (128) outside the kernel — zero-padded key columns
-are masked, zero-padded head dims contribute nothing to the dot products.
+materializes the full (seq, seq) score matrix in HBM. Here both passes
+tile one sequence axis so only an O(block x seq) strip ever lives in
+VMEM, with the matmuls hitting the MXU in float32 accumulation:
 
-Autodiff: Pallas kernels don't get automatic transposition, so training
-runs through ``jax.custom_vjp`` — the forward saves (q, k, v) and the
-backward recomputes attention with the standard closed-form gradients in
-plain XLA einsums (cheap at these window lengths; the win of the kernel is
-the inference/serving path and forward memory).
+- forward: grid over query blocks; emits the output AND the per-row
+  log-sum-exp (LSE) so the backward can recompute probabilities without
+  re-reducing.
+- backward (FlashAttention-2 decomposition): ``delta = rowsum(dO * O)``
+  on the host XLA side (O(s*d)), then one kernel gridded over *query*
+  blocks produces dq and another gridded over *key* blocks produces
+  dk/dv, each rebuilding its probability strip as
+  ``p = exp(scores - lse)``. Residuals are (q, k, v, out, lse) — O(s*d)
+  — so training memory is O(seq), not O(seq^2); no (s, s) tensor exists
+  in the compiled module (pinned by tests/test_seq_models.py).
 
-On non-TPU backends (CPU tests) the kernel runs in interpret mode.
+Head_dim and seq are padded to lane multiples (128) outside the kernels;
+padded key columns are masked to zero probability, padded query rows
+carry zero dO/delta so they contribute nothing to dk/dv.
+
+On non-TPU backends (CPU tests) the kernels run in interpret mode.
 """
 
 import functools
@@ -32,40 +40,46 @@ def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
-def _attn_kernel(q_ref, k_ref, v_ref, o_ref, *, seq_len, causal, block_q, sm_scale):
+def _strip_mask(scores_shape, seq_len, causal, q_offset, k_offset):
+    """Validity mask for a (q rows, k cols) score strip."""
+    kpos = k_offset + jax.lax.broadcasted_iota(jnp.int32, scores_shape, 1)
+    mask = kpos < seq_len
+    if causal:
+        qpos = q_offset + jax.lax.broadcasted_iota(jnp.int32, scores_shape, 0)
+        mask = jnp.logical_and(mask, kpos <= qpos)
+    return mask
+
+
+# --------------------------------------------------------------------------
+# forward
+# --------------------------------------------------------------------------
+
+
+def _attn_kernel(
+    q_ref, k_ref, v_ref, o_ref, lse_ref, *, seq_len, causal, block_q, sm_scale
+):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)  # (block_q, d_pad)
     k = k_ref[0].astype(jnp.float32)  # (seq_pad, d_pad)
     v = v_ref[0].astype(jnp.float32)
 
     scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-    kpos = jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-    mask = kpos < seq_len
-    if causal:
-        qpos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 0)
-        mask = jnp.logical_and(mask, kpos <= qpos)
+    mask = _strip_mask(scores.shape, seq_len, causal, qi * block_q, 0)
     scores = jnp.where(mask, scores, _NEG_INF)
 
     # numerically-stable softmax on the VPU, accumulation in f32
-    scores = scores - jnp.max(scores, axis=-1, keepdims=True)
-    weights = jnp.exp(scores)
-    weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    row_max = jnp.max(scores, axis=-1, keepdims=True)
+    weights = jnp.exp(scores - row_max)
+    row_sum = jnp.sum(weights, axis=-1, keepdims=True)
+    o_ref[0] = jnp.dot(
+        weights / row_sum, v, preferred_element_type=jnp.float32
+    ).astype(o_ref.dtype)
+    # log-sum-exp per query row: the backward's softmax denominator
+    lse_ref[0] = (row_max + jnp.log(row_sum))[:, 0]
 
-    o_ref[0] = jnp.dot(weights, v, preferred_element_type=jnp.float32).astype(
-        o_ref.dtype
-    )
 
-
-def _flash_forward_bhsd(
-    q: jnp.ndarray,
-    k: jnp.ndarray,
-    v: jnp.ndarray,
-    causal: bool,
-    sm_scale: float,
-    block_q: int,
-    interpret: bool,
-) -> jnp.ndarray:
-    """Attention over (batch*heads, seq, head_dim) tensors via pallas_call."""
+def _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret):
+    """Attention over (batch*heads, seq, head_dim); returns (out, lse)."""
     bh, seq, d = q.shape
     seq_pad = _round_up(seq, block_q)
     d_pad = _round_up(d, 128)
@@ -83,7 +97,7 @@ def _flash_forward_bhsd(
         block_q=block_q,
         sm_scale=sm_scale,
     )
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=(bh, n_q_blocks),
         in_specs=[
@@ -91,46 +105,168 @@ def _flash_forward_bhsd(
             pl.BlockSpec((1, seq_pad, d_pad), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, seq_pad, d_pad), lambda b, i: (b, 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, seq_pad, d_pad), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_pad, d_pad), q.dtype),
+            jax.ShapeDtypeStruct((bh, seq_pad), jnp.float32),
+        ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :seq, :d]
+    return out[:, :seq, :d], lse
 
 
-def _dense_weights(q, k, causal, sm_scale):
-    """Recomputed softmax attention weights over (bh, s, d) inputs."""
-    scores = jnp.einsum("bqd,bkd->bqk", q, k).astype(jnp.float32) * sm_scale
-    if causal:
-        s = scores.shape[-1]
-        mask = jnp.tril(jnp.ones((s, s), dtype=bool))
-        scores = jnp.where(mask, scores, _NEG_INF)
-    return jax.nn.softmax(scores, axis=-1)
+# --------------------------------------------------------------------------
+# backward: dq over query blocks, dk/dv over key blocks
+# --------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+    *, seq_len, causal, block_q, sm_scale
+):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)        # (block_q, d_pad)
+    k = k_ref[0].astype(jnp.float32)        # (seq_pad, d_pad)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)      # (block_q, d_pad)
+    lse = lse_ref[0][:, None]               # (block_q, 1)
+    delta = delta_ref[0][:, None]
+
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+    mask = _strip_mask(scores.shape, seq_len, causal, qi * block_q, 0)
+    p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
+    ds = p * (jnp.dot(do, v.T, preferred_element_type=jnp.float32) - delta)
+    dq_ref[0] = (
+        jnp.dot(ds, k, preferred_element_type=jnp.float32) * sm_scale
+    ).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+    *, seq_len, causal, block_k, sm_scale
+):
+    ki = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)        # (seq_pad, d_pad)
+    k = k_ref[0].astype(jnp.float32)        # (block_k, d_pad)
+    v = v_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)      # (seq_pad, d_pad)
+    lse = lse_ref[0][:, None]               # (seq_pad, 1)
+    delta = delta_ref[0][:, None]
+
+    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+    # strip is (q rows, this key block's cols): same mask, transposed roles
+    mask = _strip_mask(scores.shape, seq_len, causal, 0, ki * block_k)
+    p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
+    dv_ref[0] = jnp.dot(
+        p.T, do, preferred_element_type=jnp.float32
+    ).astype(dv_ref.dtype)
+    ds = p * (jnp.dot(do, v.T, preferred_element_type=jnp.float32) - delta)
+    dk_ref[0] = (
+        jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * sm_scale
+    ).astype(dk_ref.dtype)
+
+
+def _flash_backward_bhsd(
+    q, k, v, out, lse, d_out, causal, sm_scale, block_q, interpret
+):
+    bh, seq, d = q.shape
+    seq_pad = _round_up(seq, block_q)
+    d_pad = _round_up(d, 128)
+
+    def pad(x):
+        return jnp.pad(x, ((0, 0), (0, seq_pad - seq), (0, d_pad - d)))
+
+    qp, kp, vp, dop = pad(q), pad(k), pad(v), pad(d_out)
+    lse_p = jnp.pad(lse, ((0, 0), (0, seq_pad - lse.shape[1])))
+    # delta_i = rowsum(dO_i * O_i); zero on padded rows by construction
+    delta = jnp.sum(
+        d_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )
+    delta_p = jnp.pad(delta, ((0, 0), (0, seq_pad - seq)))
+
+    n_blocks = seq_pad // block_q
+    strip = lambda b, i: (b, i, 0)  # noqa: E731
+    whole = lambda b, i: (b, 0, 0)  # noqa: E731
+    row_strip = lambda b, i: (b, i)  # noqa: E731
+    row_whole = lambda b, i: (b, 0)  # noqa: E731
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            seq_len=seq,
+            causal=causal,
+            block_q=block_q,
+            sm_scale=sm_scale,
+        ),
+        grid=(bh, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d_pad), strip),      # q block
+            pl.BlockSpec((1, seq_pad, d_pad), whole),      # all k
+            pl.BlockSpec((1, seq_pad, d_pad), whole),      # all v
+            pl.BlockSpec((1, block_q, d_pad), strip),      # dO block
+            pl.BlockSpec((1, block_q), row_strip),         # lse block
+            pl.BlockSpec((1, block_q), row_strip),         # delta block
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d_pad), strip),
+        out_shape=jax.ShapeDtypeStruct((bh, seq_pad, d_pad), q.dtype),
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta_p)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            seq_len=seq,
+            causal=causal,
+            block_k=block_q,
+            sm_scale=sm_scale,
+        ),
+        grid=(bh, n_blocks),
+        in_specs=[
+            pl.BlockSpec((1, seq_pad, d_pad), whole),      # all q
+            pl.BlockSpec((1, block_q, d_pad), strip),      # k block
+            pl.BlockSpec((1, block_q, d_pad), strip),      # v block
+            pl.BlockSpec((1, seq_pad, d_pad), whole),      # all dO
+            pl.BlockSpec((1, seq_pad), row_whole),         # all lse
+            pl.BlockSpec((1, seq_pad), row_whole),         # all delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d_pad), strip),
+            pl.BlockSpec((1, block_q, d_pad), strip),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, seq_pad, d_pad), k.dtype),
+            jax.ShapeDtypeStruct((bh, seq_pad, d_pad), v.dtype),
+        ],
+        interpret=interpret,
+    )(qp, kp, vp, dop, lse_p, delta_p)
+
+    return dq[:, :seq, :d], dk[:, :seq, :d], dv[:, :seq, :d]
+
+
+# --------------------------------------------------------------------------
+# custom_vjp plumbing + public API
+# --------------------------------------------------------------------------
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash_attention_bhsd(q, k, v, causal, sm_scale, block_q, interpret):
-    return _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret)
+    out, _ = _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret)
+    return out
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q, interpret):
-    out = _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret)
-    return out, (q, k, v)
+    out, lse = _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _bwd(causal, sm_scale, block_q, interpret, residuals, d_out):
-    q, k, v = residuals
-    weights = _dense_weights(q, k, causal, sm_scale)
-    d_out32 = d_out.astype(jnp.float32)
-    v32, q32, k32 = (x.astype(jnp.float32) for x in (v, q, k))
-    w32 = weights.astype(jnp.float32)
-
-    dv = jnp.einsum("bqk,bqd->bkd", w32, d_out32)
-    ds = jnp.einsum("bqd,bkd->bqk", d_out32, v32)
-    dp = w32 * (ds - jnp.sum(ds * w32, axis=-1, keepdims=True))
-    dq = jnp.einsum("bqk,bkd->bqd", dp, k32) * sm_scale
-    dk = jnp.einsum("bqk,bqd->bkd", dp, q32) * sm_scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    q, k, v, out, lse = residuals
+    return _flash_backward_bhsd(
+        q, k, v, out, lse, d_out, causal, sm_scale, block_q, interpret
+    )
 
 
 _flash_attention_bhsd.defvjp(_fwd, _bwd)
@@ -147,7 +283,8 @@ def flash_attention(
 ) -> jnp.ndarray:
     """
     Flash attention over (batch, seq, heads, head_dim) tensors — drop-in for
-    gordo_tpu.models.specs_seq.dense_attention.
+    gordo_tpu.models.specs_seq.dense_attention, O(seq) memory in BOTH
+    passes (see module docstring).
 
     ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
     interpreter elsewhere (so CPU test runs exercise identical kernel code).

@@ -11,7 +11,7 @@ own — no hand-written collectives.
 """
 
 import logging
-from typing import Any, Optional, Tuple
+from typing import Any, Tuple
 
 import jax
 import jax.numpy as jnp

@@ -26,7 +26,6 @@ minibatches, windowed gathers for sequence models.
 import dataclasses
 import logging
 import math
-from functools import partial
 from typing import Any, List, Optional, Tuple
 
 import jax

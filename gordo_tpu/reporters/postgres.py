@@ -11,7 +11,6 @@ runs against Postgres (psycopg2, optional in this image) or stdlib sqlite
 
 import json
 import logging
-from typing import Any, Optional
 
 from gordo_tpu.machine import Machine
 from gordo_tpu.machine.machine import MachineEncoder

@@ -27,7 +27,6 @@ Revision semantics (reference: server.py:164-195): the env var named by
 revision served.
 """
 
-import io
 import json
 import logging
 import os

@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gordo_tpu.models.core import BaseJaxEstimator, _batch_bucket
-from gordo_tpu.ops.windowing import num_windows, window_sample_indices
+from gordo_tpu.ops.windowing import window_sample_indices
 
 logger = logging.getLogger(__name__)
 

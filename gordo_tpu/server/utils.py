@@ -17,7 +17,7 @@ import timeit
 import zlib
 from datetime import datetime
 from functools import lru_cache
-from typing import Any, List, Optional, Tuple, Union
+from typing import Any, List, Optional, Tuple
 
 import pandas as pd
 import pyarrow as pa

@@ -1,0 +1,196 @@
+"""
+Vendored static analysis — the stand-in for the reference's mypy/pyflakes
+pytest plugins (reference pytest.ini:8-9, mypy.ini; neither tool exists in
+this image, and nothing may be installed). Three checks with near-zero
+false-positive rates, applied to every module by tests/test_static.py:
+
+1. unused imports           (pyflakes' highest-value diagnostic)
+2. module-attribute typos   (``module.atr`` that cannot resolve)
+3. call-signature mismatch  (wrong arity / unknown kwarg on calls whose
+                             target resolves statically — the slice of
+                             mypy's checking that needs no annotations)
+"""
+
+import ast
+import builtins
+import importlib
+import inspect
+import re
+import types
+import typing
+
+
+def parse(path) -> ast.Module:
+    with open(path) as fh:
+        return ast.parse(fh.read(), filename=str(path))
+
+
+# --------------------------------------------------------------------------
+# 1. unused imports
+# --------------------------------------------------------------------------
+
+
+def _imported_names(tree: ast.Module):
+    """(local name, node lineno) for every import binding in the module."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                yield name, node.lineno
+        elif isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                yield (alias.asname or alias.name), node.lineno
+
+
+def check_unused_imports(tree: ast.Module, source: str) -> typing.List[str]:
+    """
+    Imports whose bound name never appears again in the source. The "appears
+    again" test is whole-word matching (including inside strings), which
+    forgives __all__ re-exports, doctests and quoted annotations — so a hit
+    here is a genuinely dead import.
+    """
+    problems = []
+    for name, lineno in _imported_names(tree):
+        if name.startswith("_"):
+            continue  # conventional "import for side effects/re-export"
+        uses = len(re.findall(rf"\b{re.escape(name)}\b", source))
+        # one whole-word occurrence is the import statement itself
+        if uses <= 1:
+            problems.append(f"line {lineno}: unused import {name!r}")
+    return problems
+
+
+# --------------------------------------------------------------------------
+# 2 + 3. attribute/call checking against the *imported* module
+# --------------------------------------------------------------------------
+
+_SKIP_SIGNATURE = (types.BuiltinFunctionType, types.BuiltinMethodType, type(print))
+
+
+def _resolve(node: ast.AST, namespace: dict):
+    """Resolve Name/Attribute chains against the live module namespace."""
+    if isinstance(node, ast.Name):
+        return namespace.get(node.id, _UNRESOLVED)
+    if isinstance(node, ast.Attribute):
+        base = _resolve(node.value, namespace)
+        if base is _UNRESOLVED:
+            return _UNRESOLVED
+        try:
+            return getattr(base, node.attr, _UNRESOLVED)
+        except Exception:
+            return _UNRESOLVED
+    return _UNRESOLVED
+
+
+class _Unresolved:
+    pass
+
+
+_UNRESOLVED = _Unresolved()
+
+
+def _locally_rebound_names(tree: ast.Module) -> typing.Set[str]:
+    """
+    Every name that is ever a *store* target or parameter anywhere in the
+    module. Resolution against the module namespace must skip these: a
+    local `json = ...` or `def f(json)` shadows the imported module, and
+    vouching for the module-level object there would be a false positive.
+    """
+    rebound: typing.Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name) and isinstance(node.ctx, (ast.Store, ast.Del)):
+            rebound.add(node.id)
+        elif isinstance(node, ast.arg):
+            rebound.add(node.arg)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            rebound.add(node.name)
+        elif isinstance(node, ast.Global) or isinstance(node, ast.Nonlocal):
+            rebound.update(node.names)
+    return rebound
+
+
+def check_module_attributes(tree: ast.Module, module) -> typing.List[str]:
+    """``some_module.attr`` expressions whose attr does not exist."""
+    namespace = vars(module)
+    rebound = _locally_rebound_names(tree)
+    problems = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)):
+            continue
+        if node.value.id in rebound:
+            continue  # shadowed somewhere; can't vouch for what it refers to
+        base = namespace.get(node.value.id, _UNRESOLVED)
+        # only vouch for real modules: object attributes may be dynamic
+        if not isinstance(base, types.ModuleType):
+            continue
+        if hasattr(base, node.attr):
+            continue
+        # lazily-imported submodules resolve via import, not getattr
+        try:
+            importlib.import_module(f"{base.__name__}.{node.attr}")
+        except Exception:
+            problems.append(
+                f"line {node.lineno}: module {base.__name__!r} has no "
+                f"attribute {node.attr!r}"
+            )
+    return problems
+
+
+def _bindable(callee) -> typing.Optional[inspect.Signature]:
+    if isinstance(callee, _SKIP_SIGNATURE):
+        return None
+    if isinstance(callee, type):
+        if callee.__init__ is object.__init__ and callee.__new__ is object.__new__:
+            return None
+        try:
+            return inspect.signature(callee)
+        except (ValueError, TypeError):
+            return None
+    if callable(callee):
+        try:
+            return inspect.signature(callee)
+        except (ValueError, TypeError):
+            return None
+    return None
+
+
+def check_call_signatures(tree: ast.Module, module) -> typing.List[str]:
+    """
+    Statically-resolvable calls must bind: right arity, known keywords.
+    Calls with *args/**kwargs splats, or whose target can't be resolved
+    to a concrete callable in the module's namespace, are skipped.
+    """
+    namespace = dict(vars(builtins))
+    namespace.update(vars(module))
+    rebound = _locally_rebound_names(tree)
+    problems = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            continue
+        if any(kw.arg is None for kw in node.keywords):  # **splat
+            continue
+        # skip anything rooted in a shadowed/rebound name
+        root = node.func
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if isinstance(root, ast.Name) and root.id in rebound:
+            continue
+        callee = _resolve(node.func, namespace)
+        if callee is _UNRESOLVED:
+            continue
+        signature = _bindable(callee)
+        if signature is None:
+            continue
+        try:
+            signature.bind(
+                *[None] * len(node.args),
+                **{kw.arg: None for kw in node.keywords},
+            )
+        except TypeError as exc:
+            name = ast.unparse(node.func)
+            problems.append(f"line {node.lineno}: call to {name}(): {exc}")
+    return problems

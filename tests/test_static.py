@@ -1,8 +1,9 @@
 """
 Static-health checks — the stand-in for the reference's mypy/pyflakes
 pytest plugins (reference pytest.ini:8-9; neither tool is available in this
-image). Every module must byte-compile and import cleanly, so broken
-imports in rarely-exercised modules fail fast here instead of at runtime.
+image). Every module must byte-compile and import cleanly, and the vendored
+analyzer (tests/static_analysis.py) checks unused imports, module-attribute
+typos and call-signature mismatches across the whole package.
 """
 
 import compileall
@@ -12,7 +13,19 @@ from pathlib import Path
 
 import gordo_tpu
 
+from static_analysis import (
+    check_call_signatures,
+    check_module_attributes,
+    check_unused_imports,
+    parse,
+)
+
 PACKAGE_ROOT = Path(gordo_tpu.__file__).parent
+
+# The ONLY third-party modules allowed to be missing from the image; a
+# ModuleNotFoundError for anything else is a typo'd import, not an
+# optional-dependency gate.
+OPTIONAL_THIRD_PARTY = {"influxdb", "psycopg2", "peewee", "mlflow", "azureml"}
 
 
 def _iter_module_names():
@@ -26,13 +39,52 @@ def test_every_module_imports():
         try:
             importlib.import_module(name)
         except ModuleNotFoundError as exc:
-            # optional-dependency gates (e.g. the influxdb client) are fine
-            # — but a missing gordo_tpu-internal module is always a bug
-            if exc.name and exc.name.startswith("gordo_tpu"):
+            root = (exc.name or "").split(".")[0]
+            if root not in OPTIONAL_THIRD_PARTY:
                 failures[name] = repr(exc)
         except Exception as exc:  # noqa: BLE001 — collecting all failures
             failures[name] = repr(exc)
     assert not failures, f"modules failed to import: {failures}"
+
+
+def _importable_modules():
+    for name in _iter_module_names():
+        try:
+            yield name, importlib.import_module(name)
+        except Exception:  # noqa: BLE001
+            continue  # ANY import failure is test_every_module_imports' job
+
+
+def test_no_unused_imports():
+    problems = {}
+    for name, module in _importable_modules():
+        path = module.__file__
+        if path.endswith("__init__.py"):
+            continue  # package surfaces import purely to re-export
+        with open(path) as fh:
+            source = fh.read()
+        found = check_unused_imports(parse(path), source)
+        if found:
+            problems[name] = found
+    assert not problems, f"unused imports: {problems}"
+
+
+def test_module_attributes_resolve():
+    problems = {}
+    for name, module in _importable_modules():
+        found = check_module_attributes(parse(module.__file__), module)
+        if found:
+            problems[name] = found
+    assert not problems, f"unresolvable module attributes: {problems}"
+
+
+def test_call_signatures_bind():
+    problems = {}
+    for name, module in _importable_modules():
+        found = check_call_signatures(parse(module.__file__), module)
+        if found:
+            problems[name] = found
+    assert not problems, f"mis-bound calls: {problems}"
 
 
 def test_package_byte_compiles():
