@@ -325,17 +325,13 @@ class FleetModelBuilder:
                     cb.mode,
                 )
                 return {}
-            if cb.restore_best_weights:
-                logger.warning(
-                    "Fleet build: restore_best_weights is not supported on "
-                    "the fleet path; a stopped machine keeps its params "
-                    "from the stopping epoch, which may differ from its "
-                    "best-epoch params"
-                )
             return {
                 "early_stopping_patience": int(cb.patience),
                 "early_stopping_min_delta": abs(float(cb.min_delta)),
                 "early_stopping_start_from_epoch": int(cb.start_from_epoch),
+                # per-machine best-epoch snapshot on device, matching the
+                # single-machine path's Keras semantics
+                "restore_best_weights": bool(cb.restore_best_weights),
             }
         return {}
 

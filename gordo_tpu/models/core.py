@@ -17,6 +17,7 @@ metadata) with the engine swapped for Flax + optax under ``jax.jit``:
   (models.py:158-185).
 """
 
+import copy
 import logging
 import math
 from copy import copy
@@ -409,8 +410,16 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
                 f"This {self.__class__.__name__} has not been fitted yet."
             )
         if getattr(self, "_apply_fn", None) is None:
-            module = self.spec_.module
-            self._apply_fn = jax.jit(lambda p, x: module.apply(p, x)[0])
+            # the jitted apply is cached ON the spec: every estimator
+            # sharing a spec (a whole fleet bucket) reuses one compiled
+            # program instead of tracing+compiling per estimator
+            spec = self.spec_
+            shared = getattr(spec, "_shared_apply_fn", None)
+            if shared is None:
+                module = spec.module
+                shared = jax.jit(lambda p, x: module.apply(p, x)[0])
+                spec._shared_apply_fn = shared
+            self._apply_fn = shared
             self._device_params = jax.device_put(self.params_)
         return self._apply_fn
 
@@ -466,6 +475,13 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         state = self.__dict__.copy()
         for attr in _EPHEMERAL_ATTRS:
             state.pop(attr, None)
+        spec = state.get("spec_")
+        if spec is not None and hasattr(spec, "_shared_apply_fn"):
+            # jitted functions don't pickle; shallow-copy so the live
+            # (possibly fleet-shared) spec keeps its cached program
+            spec = copy.copy(spec)
+            del spec._shared_apply_fn
+            state["spec_"] = spec
         if "params_" in state:
             state["params_"] = jax.device_get(state["params_"])
         return state

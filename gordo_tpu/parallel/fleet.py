@@ -335,6 +335,7 @@ class FleetTrainer:
         early_stopping_patience: Optional[int] = None,
         early_stopping_min_delta: float = 0.0,
         early_stopping_start_from_epoch: int = 0,
+        restore_best_weights: bool = False,
     ) -> Tuple[Any, np.ndarray]:
         """
         Train the fleet. Returns (stacked params, losses (epochs, M)).
@@ -360,10 +361,14 @@ class FleetTrainer:
         machine has stopped. This syncs the (M,) losses to host each
         epoch (the cost of the decision), and stopped machines still ride
         along in the compiled program (gated, not compacted). Monitored
-        metric is the training loss; there is no per-machine best-weights
-        restore — a stopped machine keeps the params of its stopping
-        epoch, which (after ``patience`` non-improving epochs) may differ
-        from its best-epoch params.
+        metric is the training loss.
+
+        ``restore_best_weights`` (early stopping only) keeps a device-side
+        per-machine snapshot of the params at each machine's best epoch —
+        one masked tree-select per improving epoch, costing one extra copy
+        of the stacked params in device memory — and returns those instead
+        of the final params, matching Keras
+        ``EarlyStopping(restore_best_weights=True)`` per machine.
         """
         if shuffle is None:
             shuffle = not self.spec.windowed
@@ -433,6 +438,20 @@ class FleetTrainer:
         epoch_fn = self._epoch_fn(
             data.n_timesteps, batch_size, shuffle, gated=early_stopping
         )
+
+        track_best = early_stopping and restore_best_weights
+        best_params = None  # set at the first monitored improvement
+
+        @jax.jit
+        def keep_better(mask, new_tree, old_tree):
+            """Per-machine select over the stacked params' leading axis."""
+
+            def select(new_leaf, old_leaf):
+                shape = (mask.shape[0],) + (1,) * (new_leaf.ndim - 1)
+                return jnp.where(mask.reshape(shape), new_leaf, old_leaf)
+
+            return jax.tree_util.tree_map(select, new_tree, old_tree)
+
         losses = []
         for epoch in range(start_epoch, epochs):
             epoch_keys = jax.vmap(lambda k: jax.random.fold_in(k, epoch))(keys)
@@ -474,6 +493,17 @@ class FleetTrainer:
                     es_state["active"] = es_state["active"] & (
                         es_state["wait"] < es_stop_at
                     )
+                    if track_best and improved.any():
+                        mask = jnp.asarray(improved)
+                        if self.mesh is not None:
+                            mask = jax.device_put(
+                                mask, fleet_sharding(self.mesh)
+                            )
+                        best_params = keep_better(
+                            mask,
+                            params,
+                            params if best_params is None else best_params,
+                        )
             else:
                 losses.append(epoch_loss)
             if checkpointer is not None and (epoch + 1) % max(
@@ -496,6 +526,12 @@ class FleetTrainer:
                 break
         if checkpointer is not None:
             checkpointer.wait()
+        if track_best and best_params is not None:
+            # each machine leaves with the params of its best epoch; a
+            # machine that never hit a monitored epoch (epochs <=
+            # start_from_epoch) was never snapshotted and keeps its final
+            # params via the first keep_better call's fallback
+            params = best_params
         if losses:
             return params, np.stack(jax.device_get(losses))
         return params, np.zeros((0, len(keys)))

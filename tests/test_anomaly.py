@@ -36,6 +36,64 @@ def _data(n=240, n_tags=3, seed=0):
     return X, y
 
 
+def test_fold_parallel_cv_engages_for_jax_base():
+    """JAX base + TimeSeriesSplit must take the vmapped-fold fast path,
+    producing the same sklearn-shaped output and valid thresholds."""
+    from gordo_tpu.models.models import AutoEncoder
+
+    model = DiffBasedAnomalyDetector(
+        base_estimator=AutoEncoder(kind="feedforward_hourglass", epochs=1)
+    )
+    X, _ = _data(n=160)
+    model.fit(X, X)
+
+    taken = {}
+    original = model._fold_parallel_cv
+
+    def spy(*args, **kwargs):
+        taken["fast"] = True
+        return original(*args, **kwargs)
+
+    model._fold_parallel_cv = spy
+    out = model.cross_validate(X=X, y=X)
+    assert taken.get("fast"), "vmapped fold path did not engage"
+    assert len(out["estimator"]) == 3
+    assert np.isfinite(model.aggregate_threshold_)
+    assert np.isfinite(np.asarray(model.feature_thresholds_)).all()
+    # fold estimators predict like any fitted detector
+    pred = out["estimator"][-1].predict(X)
+    assert pred.shape == (len(X), X.shape[1])
+    # scalers are per-fold: earlier folds saw less data
+    assert not np.allclose(
+        out["estimator"][0].scaler.center_, out["estimator"][-1].scaler.center_
+    )
+
+
+def test_fold_parallel_cv_declines_non_contiguous_and_callbacks():
+    from sklearn.model_selection import KFold
+
+    from gordo_tpu.models.models import AutoEncoder
+
+    X, _ = _data(n=120)
+    model = DiffBasedAnomalyDetector(
+        base_estimator=AutoEncoder(kind="feedforward_hourglass", epochs=1)
+    )
+    # shuffled KFold trains on non-contiguous rows: windowing can't mask it
+    assert not model._folds_batchable(
+        X, X, KFold(n_splits=3, shuffle=True, random_state=0), {}
+    )
+    with_cb = DiffBasedAnomalyDetector(
+        base_estimator=AutoEncoder(
+            kind="feedforward_hourglass",
+            epochs=1,
+            callbacks=[{"gordo_tpu.models.callbacks.EarlyStopping": {"patience": 1}}],
+        )
+    )
+    from sklearn.model_selection import TimeSeriesSplit
+
+    assert not with_cb._folds_batchable(X, X, TimeSeriesSplit(3), {})
+
+
 def test_anomaly_requires_thresholds_by_default():
     X, y = _data()
     model = DiffBasedAnomalyDetector(base_estimator=LinearRegression())

@@ -484,6 +484,32 @@ def test_sweep_cli_bad_grid(runner):
     assert result.exit_code != 0
 
 
+def test_run_server_cli_passes_concurrency_knobs(runner, monkeypatch):
+    """--workers/--threads/--worker-connections reach run_server intact."""
+    captured = {}
+
+    def fake_run_server(host, port, workers, log_level, config=None,
+                        threads=None, worker_connections=None):
+        captured.update(
+            host=host, port=port, workers=workers, threads=threads,
+            worker_connections=worker_connections, config=config,
+        )
+
+    from gordo_tpu.server import app as server_app
+
+    monkeypatch.setattr(server_app, "run_server", fake_run_server)
+    result = runner.invoke(
+        gordo,
+        ["run-server", "--host", "127.0.0.1", "--port", "5001",
+         "--workers", "3", "--threads", "5", "--worker-connections", "17"],
+    )
+    assert result.exit_code == 0, result.output
+    assert captured == {
+        "host": "127.0.0.1", "port": 5001, "workers": 3, "threads": 5,
+        "worker_connections": 17, "config": None,
+    }
+
+
 def test_client_cli_help(runner):
     result = runner.invoke(gordo, ["client", "--help"])
     assert result.exit_code == 0

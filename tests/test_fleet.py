@@ -205,6 +205,41 @@ def test_fleet_early_stopping_masks_per_machine():
     assert l2[-1, 1] < l2[np.argmax(m0 == m0[-1]), 1]
 
 
+def test_fleet_restore_best_weights():
+    """With a diverging optimizer the restored params are the best epoch's,
+    not the (worse) stopping epoch's — per machine, on device."""
+    import jax
+    import optax
+
+    Xs, ys = make_fleet_data(m=2, n=80)
+    data = StackedData.from_ragged(Xs, ys)
+    spec = feedforward_hourglass(n_features=3)
+
+    def run(restore):
+        trainer = FleetTrainer(
+            spec, donate=False, optimizer=optax.sgd(2.0)  # diverges
+        )
+        keys = trainer.machine_keys(2)
+        params, losses = trainer.fit(
+            data,
+            keys,
+            epochs=8,
+            batch_size=16,
+            early_stopping_patience=2,
+            restore_best_weights=restore,
+        )
+        preds = trainer.predict(params, data.X)
+        mse = ((preds - np.asarray(jax.device_get(data.y))) ** 2).mean(axis=(1, 2))
+        return losses, mse
+
+    losses, mse_restored = run(True)
+    _, mse_final = run(False)
+    # sanity: training really degraded after its best epoch
+    assert (losses.min(axis=0) < losses[-1]).all(), losses
+    # restored params reconstruct better than the stopping epoch's params
+    assert (mse_restored < mse_final).all(), (mse_restored, mse_final)
+
+
 def test_fleet_build_honors_early_stopping_config():
     """Machines configured with EarlyStopping train fewer epochs."""
     machine = Machine(

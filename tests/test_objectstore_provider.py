@@ -119,6 +119,21 @@ def test_round_trips_through_config(lake):
     assert clone.base_uri == LAKE
 
 
+def test_dispatches_in_compound_provider(lake):
+    """Object-store tags partition onto this provider, the rest elsewhere
+    (first-can_handle_tag-wins, the reference's multi-provider dispatch)."""
+    from gordo_tpu.data.providers import RandomDataProvider, providers_for_tags
+
+    remote = ObjectStoreProvider(base_uri=LAKE)
+    random_provider = RandomDataProvider()
+    assignment = providers_for_tags(
+        [remote, random_provider],
+        [SensorTag("TAG-1", "gra"), SensorTag("anything-else", None)],
+    )
+    assert assignment[remote] == [SensorTag("TAG-1", "gra")]
+    assert assignment[random_provider] == [SensorTag("anything-else", None)]
+
+
 # --- credential resolution ------------------------------------------------
 
 
