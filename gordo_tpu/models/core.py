@@ -20,7 +20,6 @@ metadata) with the engine swapped for Flax + optax under ``jax.jit``:
 import copy
 import logging
 import math
-from copy import copy
 from typing import Callable, Optional, Union
 
 import jax
@@ -156,12 +155,12 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
 
     @classmethod
     def from_definition(cls, definition: dict):
-        definition = copy(definition)
+        definition = copy.copy(definition)
         kind = definition.pop("kind")
         return cls(kind, **definition)
 
     def into_definition(self) -> dict:
-        definition = copy(self.kwargs)
+        definition = copy.copy(self.kwargs)
         if definition.get("callbacks"):
             from gordo_tpu.serializer.into_definition import _decompose_node
 

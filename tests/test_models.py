@@ -121,6 +121,27 @@ def test_autoencoder_pickle_roundtrip():
     np.testing.assert_allclose(before, after, rtol=1e-5)
 
 
+def test_pickle_after_predict_regression():
+    """Round-2 regression: ``from copy import copy`` shadowed the stdlib
+    module in models/core.py, so ``__getstate__``'s ``copy.copy(spec)``
+    raised AttributeError once ``predict()`` had cached a jitted apply fn
+    on the spec — which broke every build-and-save path (ModelBuilder
+    predicts for the offset before serializer.dump). Pin the exact
+    sequence, and that pickling leaves the live spec's cached program
+    intact (reference pickling contract: gordo models.py:158-185).
+    """
+    X, y = make_data()
+    model = AutoEncoder(kind="feedforward_model", epochs=1)
+    model.fit(X, y)
+    before = model.predict(X)
+    assert hasattr(model.spec_, "_shared_apply_fn")
+    restored = pickle.loads(pickle.dumps(model))
+    # the live (possibly fleet-shared) spec keeps its compiled program
+    assert hasattr(model.spec_, "_shared_apply_fn")
+    assert not hasattr(restored.spec_, "_shared_apply_fn")
+    np.testing.assert_allclose(before, restored.predict(X), rtol=1e-5)
+
+
 def test_autoencoder_sklearn_clone():
     from sklearn.base import clone
 
