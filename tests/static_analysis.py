@@ -9,6 +9,10 @@ false-positive rates, applied to every module by tests/test_static.py:
 3. call-signature mismatch  (wrong arity / unknown kwarg on calls whose
                              target resolves statically — the slice of
                              mypy's checking that needs no annotations)
+4. module shadowing         (a plain ``import X`` coexisting with another
+                             binding of ``X`` — ``from X import X``, a
+                             def/class — makes every ``X.attr`` ambiguous;
+                             the exact class of the round-2 ``copy`` bug)
 """
 
 import ast
@@ -134,6 +138,83 @@ def check_module_attributes(tree: ast.Module, module) -> typing.List[str]:
             problems.append(
                 f"line {node.lineno}: module {base.__name__!r} has no "
                 f"attribute {node.attr!r}"
+            )
+    return problems
+
+
+# --------------------------------------------------------------------------
+# 4. module shadowing
+# --------------------------------------------------------------------------
+
+
+def check_module_shadowing(tree: ast.Module) -> typing.List[str]:
+    """
+    A plain ``import X`` whose bound name is ALSO bound by a from-import,
+    def, or class at module scope. Whichever binding executes last
+    wins silently, so every ``X.attr`` in the module is ambiguous — and the
+    attribute checker above must *skip* such names rather than vouch for
+    them, which is exactly how ``import copy`` + ``from copy import copy``
+    slipped through in round 2 (``copy.copy(spec)`` then called the stdlib
+    *function*). Plain assignments are deliberately not flagged: the
+    ``try: import foo / except ImportError: foo = None`` optional-dependency
+    gate is a legitimate rebinding of the same conceptual slot.
+    """
+    def module_scope(root: ast.Module):
+        """Statements executed in MODULE scope only: the body plus the
+        bodies of top-level if/try/with blocks — never function or class
+        bodies, which bind in their own scope (a ``def copy(self)`` method
+        does not shadow a module-level ``import copy``)."""
+        stack = list(root.body)
+        while stack:
+            node = stack.pop()
+            yield node
+            if isinstance(node, (ast.If, ast.Try, ast.With, ast.For, ast.While)):
+                for field in ("body", "orelse", "finalbody", "handlers"):
+                    for child in getattr(node, field, []):
+                        if isinstance(child, ast.ExceptHandler):
+                            stack.extend(child.body)
+                        else:
+                            stack.append(child)
+
+    plain: typing.Dict[str, int] = {}
+    for node in module_scope(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                name = alias.asname or alias.name.split(".")[0]
+                plain.setdefault(name, node.lineno)
+    if not plain:
+        return []
+    problems = []
+    shadowed: typing.Set[str] = set()
+    for node in module_scope(tree):
+        if isinstance(node, ast.ImportFrom):
+            for alias in node.names:
+                name = alias.asname or alias.name
+                if name in plain:
+                    shadowed.add(name)
+                    problems.append(
+                        f"line {node.lineno}: 'from ... import {name}' shadows "
+                        f"'import {name}' (line {plain[name]})"
+                    )
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            if node.name in plain:
+                shadowed.add(node.name)
+                problems.append(
+                    f"line {node.lineno}: definition of {node.name!r} shadows "
+                    f"'import {node.name}' (line {plain[node.name]})"
+                )
+    # use sites: every attribute access through a shadowed module name is
+    # reported too, so the finding points at the code that will misbehave
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id in shadowed
+        ):
+            problems.append(
+                f"line {node.lineno}: attribute access "
+                f"'{node.value.id}.{node.attr}' goes through a shadowed "
+                f"module name"
             )
     return problems
 

@@ -16,6 +16,7 @@ import gordo_tpu
 from static_analysis import (
     check_call_signatures,
     check_module_attributes,
+    check_module_shadowing,
     check_unused_imports,
     parse,
 )
@@ -85,6 +86,32 @@ def test_call_signatures_bind():
         if found:
             problems[name] = found
     assert not problems, f"mis-bound calls: {problems}"
+
+
+def test_no_module_shadowing():
+    problems = {}
+    for name, module in _importable_modules():
+        found = check_module_shadowing(parse(module.__file__))
+        if found:
+            problems[name] = found
+    assert not problems, f"shadowed module imports: {problems}"
+
+
+def test_shadowing_check_catches_round2_copy_bug():
+    """The analyzer must flag the exact bug that broke round 2:
+    ``import copy`` + ``from copy import copy`` + ``copy.copy(x)`` — the
+    attribute call silently hits the stdlib *function*, not the module."""
+    import ast
+
+    source = (
+        "import copy\n"
+        "from copy import copy\n"
+        "def f(x):\n"
+        "    return copy.copy(x)\n"
+    )
+    found = check_module_shadowing(ast.parse(source))
+    assert any("shadows 'import copy'" in p for p in found), found
+    assert any("copy.copy" in p for p in found), found
 
 
 def test_package_byte_compiles():
