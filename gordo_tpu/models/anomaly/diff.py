@@ -96,6 +96,11 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
             )
         if hasattr(self, "window") and self.window is not None:
             metadata["window"] = self.window
+        if hasattr(self, "cv_fast_path_"):
+            # whether CV folds trained as one vmapped device program —
+            # surfaced into BuildMetadata so a silent degradation to the
+            # 3x-slower sequential path is visible in build artifacts
+            metadata["cv-fast-path"] = bool(self.cv_fast_path_)
         if (
             getattr(self, "smooth_feature_thresholds_", None) is not None
         ):
@@ -277,13 +282,20 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         sequential sklearn refits — same scores/thresholds machinery either
         way.
         """
+        import jax.errors
+
         cv = cv if cv is not None else TimeSeriesSplit(n_splits=3)
+        self.cv_fast_path_ = False
         if self._folds_batchable(X, y, cv, kwargs):
+            # Only shape/JAX-runtime failures (ragged-fold masking bugs, OOM)
+            # may degrade to the sequential path; anything else — a genuine
+            # bug in the fleet trainer — must surface, not silently cost 3x.
             try:
                 cv_output = self._fold_parallel_cv(
                     X, y, cv, kwargs.get("scoring")
                 )
-            except Exception:
+                self.cv_fast_path_ = True
+            except (ValueError, TypeError, jax.errors.JaxRuntimeError):
                 logger.exception(
                     "vmapped fold CV failed; falling back to sequential "
                     "sklearn cross-validation"

