@@ -256,16 +256,28 @@ class FleetModelBuilder:
             est.params_ = trainer.unstack_params(params, i)
             est.n_features_ = Xs_grid[i].shape[1]
             est.n_features_out_ = ys_grid[i].shape[1]
+            val_series = getattr(trainer, "val_losses_", None)
+            # a NaN column marks a machine too small for any validation
+            # samples — it has no val_loss history, like the solo path
+            # with n_val == 0
+            machine_val = (
+                val_series[:, i]
+                if val_series is not None and not np.isnan(val_series[:, i]).any()
+                else None
+            )
             est.history_ = {
                 "loss": [float(l[i]) for l in losses],
                 "params": {
                     "epochs": epochs,
                     "batch_size": batch_size,
                     "samples": int(len(Xs_grid[i])),
-                    "metrics": ["loss"],
+                    "metrics": ["loss"]
+                    + (["val_loss"] if machine_val is not None else []),
                     "fleet_size": len(bucket),
                 },
             }
+            if machine_val is not None:
+                est.history_["val_loss"] = [float(x) for x in machine_val]
             if isinstance(model, DiffBasedAnomalyDetector):
                 model.scaler.fit(item["y"])
                 self._apply_thresholds(model, fold_records, i)
@@ -299,15 +311,25 @@ class FleetModelBuilder:
     @staticmethod
     def _early_stopping_kwargs(fit_args: dict) -> dict:
         """
-        Map a bucket's EarlyStopping callback (if configured) onto the
-        fleet trainer's per-machine early stopping. The fleet path has no
-        validation split, so only min-mode loss-family monitors translate;
-        anything else trains the full epoch budget (with a warning, so the
-        divergence from the single-machine path is visible).
+        Map a bucket's fit configuration onto the fleet trainer's kwargs:
+        ``validation_split`` becomes the per-machine holdout (the solo path
+        holds out the last fraction of samples whether or not it early-
+        stops, models/core.py:264-272 — the fleet must too, or it would
+        train on the solo path's validation data), and an EarlyStopping
+        callback becomes the per-machine gate, monitoring the validation
+        loss exactly when the solo callback would (``val_loss`` monitor
+        with a configured split, or its documented fallback to ``loss``).
+        Only min-mode loss-family monitors translate; anything else trains
+        the full epoch budget (with a warning, so the divergence from the
+        single-machine path is visible).
         """
         from gordo_tpu.models.callbacks import EarlyStopping
         from gordo_tpu.models.core import _materialize_callbacks
 
+        out: dict = {}
+        vs = float(fit_args.get("validation_split") or 0.0)
+        if vs > 0.0:
+            out["validation_split"] = vs
         for cb in _materialize_callbacks(fit_args.get("callbacks")):
             if not isinstance(cb, EarlyStopping):
                 logger.warning(
@@ -319,21 +341,25 @@ class FleetModelBuilder:
             if "loss" not in cb.monitor or cb.mode == "max":
                 logger.warning(
                     "Fleet build: EarlyStopping(monitor=%r, mode=%r) does "
-                    "not translate to the fleet path (training loss only); "
-                    "training the full epoch budget",
+                    "not translate to the fleet path (loss-family metrics "
+                    "only); training the full epoch budget",
                     cb.monitor,
                     cb.mode,
                 )
-                return {}
-            return {
-                "early_stopping_patience": int(cb.patience),
-                "early_stopping_min_delta": abs(float(cb.min_delta)),
-                "early_stopping_start_from_epoch": int(cb.start_from_epoch),
-                # per-machine best-epoch snapshot on device, matching the
-                # single-machine path's Keras semantics
-                "restore_best_weights": bool(cb.restore_best_weights),
-            }
-        return {}
+                return out
+            out.update(
+                {
+                    "early_stopping_patience": int(cb.patience),
+                    "early_stopping_min_delta": abs(float(cb.min_delta)),
+                    "early_stopping_start_from_epoch": int(cb.start_from_epoch),
+                    # per-machine best-epoch snapshot on device, matching
+                    # the single-machine path's Keras semantics
+                    "restore_best_weights": bool(cb.restore_best_weights),
+                    "early_stopping_on_val": "val" in cb.monitor and vs > 0.0,
+                }
+            )
+            return out
+        return out
 
     def _run_cv_folds(
         self,

@@ -319,6 +319,136 @@ class FleetTrainer:
         self._epoch_fn_cache[cache_key] = fn
         return fn
 
+    def _val_fn(self, n: int, batch_size: int, lo: int = 0):
+        """
+        Jitted per-machine validation loss over the fleet: deterministic
+        forward, per-sample loss weighted by a (M, n) validation mask —
+        chunked like the training scan so the windowed gather never
+        materializes more than (batch, lb, f) at once (mirrors the solo
+        path's chunked val loss, models/core.py:334-356).
+
+        ``lo`` skips samples below the fleet-wide first validation index:
+        the eval walks only the holdout tail instead of zero-weighting the
+        whole training prefix every epoch.
+        """
+        cache_key = ("val", n, batch_size, lo)
+        if cache_key in self._epoch_fn_cache:
+            return self._epoch_fn_cache[cache_key]
+
+        spec = self.spec
+        lb = spec.lookback_window if spec.windowed else 1
+        la = self.lookahead
+        n_samples = (n - lb + 1 - la) if spec.windowed else n
+        n_eval = max(1, n_samples - lo)
+        n_batches = max(1, math.ceil(n_eval / batch_size))
+        n_pad = n_batches * batch_size
+        sample_ids = np.zeros(n_pad, dtype=np.int32)
+        sample_ids[:n_eval] = lo + np.arange(n_eval, dtype=np.int32)
+        pad_mask = np.zeros(n_pad, dtype=np.float32)
+        pad_mask[:n_eval] = 1.0
+        sel_all = jnp.asarray(sample_ids.reshape(n_batches, batch_size))
+        pm_all = jnp.asarray(pad_mask.reshape(n_batches, batch_size))
+
+        loss_name = spec.loss
+        module = spec.module
+        windowed = spec.windowed
+
+        def machine_val(params, Xi, yi, vi):
+            def one_chunk(args):
+                sel, pm = args
+                if windowed:
+                    rows = sel[:, None] + jnp.arange(lb, dtype=jnp.int32)[None, :]
+                    xb = Xi[rows]
+                    tgt = sel + (lb - 1 + la)
+                    yb = yi[tgt]
+                    wb = jnp.min(vi[rows], axis=1) * vi[tgt]
+                else:
+                    xb = Xi[sel]
+                    yb = yi[sel]
+                    wb = vi[sel]
+                wb = wb * pm
+                out, _ = module.apply(params, xb)
+                per = per_sample_loss(loss_name, out, yb)
+                return jnp.sum(per * wb), jnp.sum(wb)
+
+            sums, ws = jax.lax.map(one_chunk, (sel_all, pm_all))
+            return jnp.sum(sums) / jnp.maximum(jnp.sum(ws), 1.0)
+
+        if self.broadcast_data:
+            fleet_val = jax.vmap(machine_val, in_axes=(0, None, None, None))
+        else:
+            fleet_val = jax.vmap(machine_val, in_axes=(0, 0, 0, 0))
+
+        jit_kwargs: dict = {}
+        if self.mesh is not None:
+            fs = fleet_sharding(self.mesh)
+            rs = replicated_sharding(self.mesh)
+            data_sh = rs if self.broadcast_data else fs
+            jit_kwargs["in_shardings"] = (fs, data_sh, data_sh, data_sh)
+            jit_kwargs["out_shardings"] = fs
+
+        fn = jax.jit(fleet_val, **jit_kwargs)
+        self._epoch_fn_cache[cache_key] = fn
+        return fn
+
+    def _validation_masks(
+        self, w: jnp.ndarray, n: int, validation_split: float
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, np.ndarray, int]:
+        """
+        Per-machine Keras ``validation_split`` semantics as timestep masks:
+        the LAST fraction of each machine's samples (windows, for sequence
+        models) is held out, before any shuffling (models/core.py:264-272).
+
+        For contiguous prefix data the window -> max-row mapping is
+        monotonic, so per-timestep masks express the sample split EXACTLY:
+        a window s trains iff s < n_train (all its rows fall before the
+        train cut) and validates iff s >= n_train with its whole window
+        inside the real region.
+
+        Returns (train_mask, val_mask, has_val, val_lo): the (M, n)
+        float32 masks, a (M,) bool marking machines whose split actually
+        yields validation samples (a machine too small for ``n_val >= 1``
+        has none — its monitored metric must fall back to the training
+        loss, like the solo path with ``n_val == 0``), and the smallest
+        first-validation-sample index across machines (so the eval only
+        walks the holdout tail, not the whole dataset).
+        """
+        lb = self.spec.lookback_window if self.spec.windowed else 1
+        la = self.lookahead
+        w_host = np.asarray(jax.device_get(w), dtype=np.float64)
+        # count rows, not weight mass: fractional sample weights must not
+        # shift the split boundary
+        n_real = (w_host > 0).sum(axis=1).astype(np.int64)
+        n_samples = np.maximum(n_real - lb + 1 - la, 0)
+        n_val = (n_samples * validation_split).astype(np.int64)
+        n_train = n_samples - n_val
+        if np.any((n_samples > 0) & (n_train <= 0)):
+            raise ValueError(
+                f"validation_split={validation_split} leaves no training "
+                "samples for at least one machine"
+            )
+        t = np.arange(n, dtype=np.int64)[None, :]
+        # last timestep a training window touches is s + lb - 1 + la for
+        # s = n_train - 1, so the cut excludes exactly samples >= n_train.
+        # train_mask is the bare cut indicator — the caller multiplies it
+        # into the effective weights, so folding w in here would SQUARE
+        # every non-binary weight
+        train_cut = (n_train + lb - 1 + la)[:, None]
+        train_mask = (t < train_cut).astype(np.float32)
+        # val_mask is used standalone as the eval weight, so it does carry
+        # the effective weights (once)
+        val_mask = (t >= n_train[:, None]).astype(np.float32) * w_host.astype(
+            np.float32
+        )
+        has_val = n_val > 0
+        val_lo = int(n_train[has_val].min()) if has_val.any() else 0
+        return (
+            self._shard(jnp.asarray(train_mask)),
+            self._shard(jnp.asarray(val_mask)),
+            has_val,
+            val_lo,
+        )
+
     # -- public API ------------------------------------------------------
     def fit(
         self,
@@ -336,6 +466,8 @@ class FleetTrainer:
         early_stopping_min_delta: float = 0.0,
         early_stopping_start_from_epoch: int = 0,
         restore_best_weights: bool = False,
+        validation_split: float = 0.0,
+        early_stopping_on_val: Optional[bool] = None,
     ) -> Tuple[Any, np.ndarray]:
         """
         Train the fleet. Returns (stacked params, losses (epochs, M)).
@@ -369,13 +501,45 @@ class FleetTrainer:
         of the stacked params in device memory — and returns those instead
         of the final params, matching Keras
         ``EarlyStopping(restore_best_weights=True)`` per machine.
+
+        ``validation_split`` holds out the LAST fraction of each machine's
+        samples (per-machine, counted over its real rows — Keras
+        semantics, models/core.py:264-272): held-out samples get zero
+        training weight, and a per-machine validation loss is computed
+        every epoch (fetch it from ``self.val_losses_`` after ``fit``,
+        shape (epochs, M)). With early stopping, the monitored metric
+        defaults to the validation loss when a split is configured
+        (``early_stopping_on_val=None``); pass False to monitor the
+        training loss regardless (Keras ``monitor="loss"``).
         """
         if shuffle is None:
             shuffle = not self.spec.windowed
+        if not 0.0 <= float(validation_split) < 1.0:
+            raise ValueError(
+                f"validation_split must be in [0, 1), got {validation_split}"
+            )
         data = self.shard_data(data)
         w = data.sample_weight
         if extra_weight is not None:
             w = w * self._shard(jnp.asarray(extra_weight))
+
+        val_w = None
+        has_val = None
+        val_lo = 0
+        self.val_losses_: Optional[np.ndarray] = None
+        if validation_split > 0.0:
+            # computed from the EFFECTIVE weights so a CV fold's extra
+            # mask shrinks the split's base, exactly like a solo fold fit
+            # on that fold's rows would
+            train_mask, val_w, has_val, val_lo = self._validation_masks(
+                w, data.n_timesteps, float(validation_split)
+            )
+            w = w * train_mask
+        monitor_val = (
+            val_w is not None
+            if early_stopping_on_val is None
+            else bool(early_stopping_on_val) and val_w is not None
+        )
 
         if params is None:
             params = self.init_params(keys, data.X.shape[-1])
@@ -385,6 +549,11 @@ class FleetTrainer:
 
         early_stopping = early_stopping_patience is not None
         m = len(keys)  # the fleet axis (== data.n_machines unless broadcast)
+        if has_val is not None and has_val.shape[0] != m:
+            # broadcast_data: masks are per weight ROW (the one shared
+            # dataset), but monitored metrics and val columns are per
+            # MACHINE — expand so boolean indexing lines up
+            has_val = np.repeat(has_val, m)
         if early_stopping:
             es_state = {
                 "best": np.full(m, np.inf, dtype=np.float64),
@@ -432,11 +601,18 @@ class FleetTrainer:
                     f"(got weight shape {w.shape}); weights must be (1, n)"
                 )
             X_arg, y_arg, w_arg = data.X[0], data.y[0], w[0]
+            val_arg = val_w[0] if val_w is not None else None
         else:
             X_arg, y_arg, w_arg = data.X, data.y, w
+            val_arg = val_w
 
         epoch_fn = self._epoch_fn(
             data.n_timesteps, batch_size, shuffle, gated=early_stopping
+        )
+        val_fn = (
+            self._val_fn(data.n_timesteps, batch_size, lo=val_lo)
+            if val_w is not None
+            else None
         )
 
         track_best = early_stopping and restore_best_weights
@@ -453,6 +629,7 @@ class FleetTrainer:
             return jax.tree_util.tree_map(select, new_tree, old_tree)
 
         losses = []
+        val_losses: list = []
         for epoch in range(start_epoch, epochs):
             epoch_keys = jax.vmap(lambda k: jax.random.fold_in(k, epoch))(keys)
             if early_stopping:
@@ -466,6 +643,8 @@ class FleetTrainer:
                 params, opt_state, epoch_loss = epoch_fn(
                     params, opt_state, epoch_keys, X_arg, y_arg, w_arg
                 )
+            if val_fn is not None:
+                val_losses.append(val_fn(params, X_arg, y_arg, val_arg))
             # keep the loss on device: a host fetch here would sync every
             # epoch and stall the dispatch pipeline (costly over DCN/tunnel
             # links); all losses are pulled in one transfer after the loop
@@ -480,12 +659,24 @@ class FleetTrainer:
                 )
                 losses.append(report)
                 es_state["last_loss"] = report
+                if monitor_val:
+                    val_np = np.asarray(
+                        jax.device_get(val_losses[-1]), dtype=np.float64
+                    )
+                    # a machine too small for any validation samples falls
+                    # back to its training loss (solo path: n_val == 0
+                    # skips val_loss and EarlyStopping monitors loss) —
+                    # monitoring its constant-0.0 val loss would spuriously
+                    # stop it at epoch 0
+                    monitored = np.where(has_val, val_np, loss_np)
+                else:
+                    monitored = loss_np
                 if epoch >= int(early_stopping_start_from_epoch):
                     improved = es_state["active"] & (
-                        loss_np < es_state["best"] - es_delta
+                        monitored < es_state["best"] - es_delta
                     )
                     es_state["best"] = np.where(
-                        improved, loss_np, es_state["best"]
+                        improved, monitored, es_state["best"]
                     )
                     es_state["wait"] = np.where(
                         improved, 0, es_state["wait"] + 1
@@ -532,6 +723,13 @@ class FleetTrainer:
             # start_from_epoch) was never snapshotted and keeps its final
             # params via the first keep_better call's fallback
             params = best_params
+        if val_losses:
+            stacked = np.stack(jax.device_get(val_losses)).astype(np.float64)
+            # machines with no validation samples have no val loss (their
+            # computed 0.0 is an artifact of the empty weight sum)
+            if has_val is not None and not has_val.all():
+                stacked[:, ~has_val] = np.nan
+            self.val_losses_ = stacked
         if losses:
             return params, np.stack(jax.device_get(losses))
         return params, np.zeros((0, len(keys)))

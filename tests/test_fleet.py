@@ -274,6 +274,201 @@ def test_fleet_build_honors_early_stopping_config():
     assert len(history["loss"]) == 2
 
 
+def test_fleet_validation_split_exact_holdout():
+    """validation_split must hold out exactly the last fraction of each
+    machine's samples: training with the split equals training with a
+    hand-built per-machine mask over the same rows (bit-identical params),
+    and val losses land per machine per epoch."""
+    import jax
+
+    Xs, ys = make_fleet_data(m=2, n=100)  # real lengths 100, 95
+    data = StackedData.from_ragged(Xs, ys)
+    spec = feedforward_hourglass(n_features=3)
+    trainer = FleetTrainer(spec, donate=False)
+    keys = trainer.machine_keys(2)
+
+    params_split, _ = trainer.fit(
+        data, keys, epochs=2, batch_size=16, validation_split=0.25
+    )
+    assert trainer.val_losses_ is not None
+    assert trainer.val_losses_.shape == (2, 2)
+    assert np.isfinite(trainer.val_losses_).all()
+
+    # hand-built equivalent: zero weight on the last 25% of REAL rows
+    mask = np.ones((2, 100), dtype=np.float32)
+    for i, x in enumerate(Xs):
+        n_train = len(x) - int(len(x) * 0.25)
+        mask[i, n_train:] = 0.0
+    params_mask, _ = trainer.fit(
+        data, keys, epochs=2, batch_size=16, extra_weight=mask
+    )
+    for a, b in zip(jax.tree.leaves(params_split), jax.tree.leaves(params_mask)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_fleet_validation_split_windowed_masks():
+    """Windowed models: the train/val masks select exactly the sample split
+    the solo path would (windows, not raw rows)."""
+    from gordo_tpu.models.factories.lstm import lstm_model
+
+    spec = lstm_model(n_features=3, lookback_window=5)
+    trainer = FleetTrainer(spec, lookahead=0, donate=False)
+    w = np.zeros((1, 60), dtype=np.float32)
+    w[0, :50] = 1.0  # 50 real rows -> 46 windows
+    import jax.numpy as jnp
+
+    train_m, val_m, has_val, val_lo = trainer._validation_masks(
+        jnp.asarray(w), 60, 0.25
+    )
+    train_m, val_m = np.asarray(train_m), np.asarray(val_m)
+    assert has_val.tolist() == [True]
+    assert val_lo == 35
+    # 46 samples -> n_val=11, n_train=35; train windows need rows < 35+4
+    assert train_m[0, :39].all() and not train_m[0, 39:].any()
+    # val windows start at sample 35, inside the real region
+    assert val_m[0, 35:50].all() and not val_m[0, :35].any()
+    assert not val_m[0, 50:].any()
+
+
+def test_fleet_val_monitored_early_stopping():
+    """val-loss-monitored early stopping stops on validation plateau and
+    restores best-val params per machine (Keras parity for the solo path's
+    EarlyStopping(monitor='val_loss', restore_best_weights=True))."""
+    t = np.linspace(0, 20, 160)
+    X = np.stack([np.sin(t), np.cos(t), np.sin(2 * t)], axis=1).astype("float32")
+    data = StackedData.from_ragged([X], [X.copy()])
+    spec = feedforward_hourglass(n_features=3)
+    trainer = FleetTrainer(spec, donate=False)
+    keys = trainer.machine_keys(1)
+
+    params, losses = trainer.fit(
+        data,
+        keys,
+        epochs=40,
+        batch_size=16,
+        validation_split=0.25,
+        early_stopping_patience=1,
+        early_stopping_min_delta=1e6,  # "never improves" -> stop fast
+        restore_best_weights=True,
+    )
+    # improve@0 (first monitored), wait@1, stop@1 -> 2 epochs ran
+    assert losses.shape[0] == 2
+    assert trainer.val_losses_.shape[0] == 2
+
+
+def test_fleet_validation_split_tiny_machine_falls_back_to_loss():
+    """A machine too small for any validation samples must monitor its
+    TRAINING loss (solo n_val==0 semantics), not a constant-0.0 val loss
+    that would spuriously early-stop it at epoch 0; its val_loss history
+    column is NaN (= absent)."""
+    t = np.linspace(0, 20, 120)
+    X_big = np.stack([np.sin(t), np.cos(t), np.sin(2 * t)], axis=1).astype(
+        "float32"
+    )
+    X_tiny = X_big[:3]  # 3 rows -> int(3 * 0.25) == 0 validation samples
+    data = StackedData.from_ragged(
+        [X_big, X_tiny], [X_big.copy(), X_tiny.copy()]
+    )
+    spec = feedforward_hourglass(n_features=3)
+    trainer = FleetTrainer(spec, donate=False)
+    keys = trainer.machine_keys(2)
+
+    params, losses = trainer.fit(
+        data,
+        keys,
+        epochs=6,
+        batch_size=16,
+        validation_split=0.25,
+        early_stopping_patience=4,
+        early_stopping_min_delta=0.0,
+    )
+    # the tiny machine kept training (its train loss improves epoch over
+    # epoch, so with patience=4 nothing stops within 6 epochs)
+    assert losses.shape[0] == 6
+    assert not np.isnan(trainer.val_losses_[:, 0]).any()
+    assert np.isnan(trainer.val_losses_[:, 1]).all()
+
+
+def test_early_stopping_kwargs_translation():
+    """Solo EarlyStopping configs translate to the fleet gate, including
+    val_loss monitors when a validation_split is configured (no silent
+    train-loss substitution)."""
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder
+
+    translate = FleetModelBuilder._early_stopping_kwargs
+
+    with_val = translate(
+        {
+            "validation_split": 0.2,
+            "callbacks": [
+                {
+                    "keras.callbacks.EarlyStopping": {
+                        "monitor": "val_loss",
+                        "patience": 3,
+                        "restore_best_weights": True,
+                    }
+                }
+            ],
+        }
+    )
+    assert with_val["validation_split"] == 0.2
+    assert with_val["early_stopping_patience"] == 3
+    assert with_val["restore_best_weights"] is True
+    assert with_val["early_stopping_on_val"] is True
+
+    # monitor=val_loss with NO split: Keras falls back to training loss
+    no_split = translate(
+        {
+            "callbacks": [
+                {"keras.callbacks.EarlyStopping": {"monitor": "val_loss"}}
+            ]
+        }
+    )
+    assert "validation_split" not in no_split
+    assert no_split["early_stopping_on_val"] is False
+
+    # a split with no callback still holds out the data (training parity)
+    just_split = translate({"validation_split": 0.1})
+    assert just_split == {"validation_split": 0.1}
+
+
+def test_fleet_build_val_loss_early_stopping(tmp_path):
+    """End-to-end: a machine configured with validation_split + val_loss
+    EarlyStopping fleet-builds with val_loss history and an early stop."""
+    machine = Machine(
+        name="es-val-m0",
+        project_name="p",
+        model={
+            "gordo_tpu.models.AutoEncoder": {
+                "kind": "feedforward_hourglass",
+                "epochs": 40,
+                "batch_size": 16,
+                "validation_split": 0.25,
+                "callbacks": [
+                    {
+                        "keras.callbacks.EarlyStopping": {
+                            "monitor": "val_loss",
+                            "patience": 1,
+                            "min_delta": 1000.0,
+                        }
+                    }
+                ],
+            }
+        },
+        dataset={
+            "type": "RandomDataset",
+            "train_start_date": "2017-12-25 06:00:00Z",
+            "train_end_date": "2017-12-27 06:00:00Z",
+            "tags": [["Tag 1", None], ["Tag 2", None]],
+        },
+    )
+    (model, machine_out), = FleetModelBuilder([machine]).build()
+    history = machine_out.metadata.build_metadata.model.model_meta["history"]
+    assert len(history["loss"]) == 2  # stopped far below the 40-epoch budget
+    assert len(history["val_loss"]) == 2
+    assert "val_loss" in history["params"]["metrics"]
+
+
 def make_machines(n, epochs=2):
     return [
         Machine(
