@@ -1,26 +1,32 @@
 """
 Blockwise (flash-style) attention as Pallas TPU kernels — forward AND
-backward.
+backward, fully tiled in BOTH sequence axes.
 
 The dense attention path (gordo_tpu/models/specs_seq.py:dense_attention)
-materializes the full (seq, seq) score matrix in HBM. Here both passes
-tile one sequence axis so only an O(block x seq) strip ever lives in
-VMEM, with the matmuls hitting the MXU in float32 accumulation:
+materializes the full (seq, seq) score matrix in HBM. Here every pass
+runs on an O(block_q x block_k) tile so VMEM usage is independent of the
+sequence length, with the matmuls hitting the MXU in float32 accumulation:
 
-- forward: grid over query blocks; emits the output AND the per-row
-  log-sum-exp (LSE) so the backward can recompute probabilities without
-  re-reducing.
+- forward: grid (bh, q blocks, k blocks) with FlashAttention-2 online
+  softmax — running row-max / row-sum / output accumulators live in VMEM
+  scratch across the (sequential) k-block axis; the final k step emits
+  the output and the per-row log-sum-exp (LSE).
 - backward (FlashAttention-2 decomposition): ``delta = rowsum(dO * O)``
-  on the host XLA side (O(s*d)), then one kernel gridded over *query*
-  blocks produces dq and another gridded over *key* blocks produces
-  dk/dv, each rebuilding its probability strip as
-  ``p = exp(scores - lse)``. Residuals are (q, k, v, out, lse) — O(s*d)
-  — so training memory is O(seq), not O(seq^2); no (s, s) tensor exists
-  in the compiled module (pinned by tests/test_seq_models.py).
+  on the host XLA side (O(s*d)); one kernel gridded (bh, q blocks,
+  k blocks) accumulates dq, another gridded (bh, k blocks, q blocks)
+  accumulates dk/dv, each rebuilding its (block_q, block_k) probability
+  tile as ``p = exp(scores - lse)``. Residuals are (q, k, v, out, lse) —
+  O(s*d) — so training memory is O(seq) in HBM and O(1) in VMEM; neither
+  a (seq, seq) tensor nor a (block, seq) strip exists in the compiled
+  module (pinned by tests/test_seq_models.py).
 
-Head_dim and seq are padded to lane multiples (128) outside the kernels;
-padded key columns are masked to zero probability, padded query rows
-carry zero dO/delta so they contribute nothing to dk/dv.
+Accumulator scratch persists across grid steps because TPU Pallas grids
+execute sequentially over the innermost axis; outputs indexed by the
+outer axes are written on that axis's last step.
+
+Head_dim is padded to lane multiples (128) and seq to the block size
+outside the kernels; padded key columns are masked to zero probability,
+padded query rows carry zero dO/delta so they contribute nothing to dk/dv.
 
 On non-TPU backends (CPU tests) the kernels run in interpret mode.
 """
@@ -32,20 +38,24 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+# TPU lane width: scratch row-statistics are stored lane-broadcast so the
+# (block_q, 1) logical vectors tile cleanly into VMEM
+_LANES = 128
 
 
 def _round_up(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
-def _strip_mask(scores_shape, seq_len, causal, q_offset, k_offset):
-    """Validity mask for a (q rows, k cols) score strip."""
-    kpos = k_offset + jax.lax.broadcasted_iota(jnp.int32, scores_shape, 1)
+def _tile_mask(shape, seq_len, causal, q_offset, k_offset):
+    """Validity mask for a (q rows, k cols) score tile."""
+    kpos = k_offset + jax.lax.broadcasted_iota(jnp.int32, shape, 1)
     mask = kpos < seq_len
     if causal:
-        qpos = q_offset + jax.lax.broadcasted_iota(jnp.int32, scores_shape, 0)
+        qpos = q_offset + jax.lax.broadcasted_iota(jnp.int32, shape, 0)
         mask = jnp.logical_and(mask, kpos <= qpos)
     return mask
 
@@ -56,62 +66,96 @@ def _strip_mask(scores_shape, seq_len, causal, q_offset, k_offset):
 
 
 def _attn_kernel(
-    q_ref, k_ref, v_ref, o_ref, lse_ref, *, seq_len, causal, block_q, sm_scale
+    q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+    *, seq_len, causal, block_q, block_k, sm_scale
 ):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # (block_q, d_pad)
-    k = k_ref[0].astype(jnp.float32)  # (seq_pad, d_pad)
-    v = v_ref[0].astype(jnp.float32)
+    ki = pl.program_id(2)
 
-    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-    mask = _strip_mask(scores.shape, seq_len, causal, qi * block_q, 0)
-    scores = jnp.where(mask, scores, _NEG_INF)
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full(m_scr.shape, _NEG_INF, dtype=m_scr.dtype)
+        l_scr[...] = jnp.zeros(l_scr.shape, dtype=l_scr.dtype)
+        acc_scr[...] = jnp.zeros(acc_scr.shape, dtype=acc_scr.dtype)
 
-    # numerically-stable softmax on the VPU, accumulation in f32
-    row_max = jnp.max(scores, axis=-1, keepdims=True)
-    weights = jnp.exp(scores - row_max)
-    row_sum = jnp.sum(weights, axis=-1, keepdims=True)
-    o_ref[0] = jnp.dot(
-        weights / row_sum, v, preferred_element_type=jnp.float32
-    ).astype(o_ref.dtype)
-    # log-sum-exp per query row: the backward's softmax denominator
-    lse_ref[0] = (row_max + jnp.log(row_sum))[:, 0]
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)  # (block_q, d_pad)
+        k = k_ref[0].astype(jnp.float32)  # (block_k, d_pad)
+        v = v_ref[0].astype(jnp.float32)
+
+        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        mask = _tile_mask(
+            scores.shape, seq_len, causal, qi * block_q, ki * block_k
+        )
+        scores = jnp.where(mask, scores, _NEG_INF)
+
+        # online softmax: rescale the running sums by exp(m_prev - m_new)
+        m_prev = m_scr[...][:, :1]  # (block_q, 1)
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+        p = jnp.where(mask, jnp.exp(scores - m_new), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_scr[...][:, :1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[...] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    if causal:
+        # tiles entirely above the diagonal are fully masked: skip the MXU
+        # work (roughly half the grid at long seq); init/emit still run
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _emit():
+        m = m_scr[...][:, :1]
+        l = l_scr[...][:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-padded rows
+        o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
+        lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
 
 
-def _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret):
+def _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
     """Attention over (batch*heads, seq, head_dim); returns (out, lse)."""
     bh, seq, d = q.shape
-    seq_pad = _round_up(seq, block_q)
+    seq_pad = _round_up(seq, math.lcm(block_q, block_k))
     d_pad = _round_up(d, 128)
 
     def pad(x):
         return jnp.pad(x, ((0, 0), (0, seq_pad - seq), (0, d_pad - d)))
 
     qp, kp, vp = pad(q), pad(k), pad(v)
-    n_q_blocks = seq_pad // block_q
 
     kernel = functools.partial(
         _attn_kernel,
         seq_len=seq,
         causal=causal,
         block_q=block_q,
+        block_k=block_k,
         sm_scale=sm_scale,
     )
     out, lse = pl.pallas_call(
         kernel,
-        grid=(bh, n_q_blocks),
+        grid=(bh, seq_pad // block_q, seq_pad // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, seq_pad, d_pad), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, seq_pad, d_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d_pad), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d_pad), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+            pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_pad, d_pad), q.dtype),
             jax.ShapeDtypeStruct((bh, seq_pad), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running row max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),  # running row sum
+            pltpu.VMEM((block_q, d_pad), jnp.float32),   # output accumulator
         ],
         interpret=interpret,
     )(qp, kp, vp)
@@ -119,61 +163,93 @@ def _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret):
 
 
 # --------------------------------------------------------------------------
-# backward: dq over query blocks, dk/dv over key blocks
+# backward: dq over (q blocks, k blocks), dk/dv over (k blocks, q blocks)
 # --------------------------------------------------------------------------
 
 
 def _bwd_dq_kernel(
-    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-    *, seq_len, causal, block_q, sm_scale
+    q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_scr,
+    *, seq_len, causal, block_q, block_k, sm_scale
 ):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)        # (block_q, d_pad)
-    k = k_ref[0].astype(jnp.float32)        # (seq_pad, d_pad)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)      # (block_q, d_pad)
-    lse = lse_ref[0][:, None]               # (block_q, 1)
-    delta = delta_ref[0][:, None]
+    ki = pl.program_id(2)
 
-    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-    mask = _strip_mask(scores.shape, seq_len, causal, qi * block_q, 0)
-    p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
-    ds = p * (jnp.dot(do, v.T, preferred_element_type=jnp.float32) - delta)
-    dq_ref[0] = (
-        jnp.dot(ds, k, preferred_element_type=jnp.float32) * sm_scale
-    ).astype(dq_ref.dtype)
+    @pl.when(ki == 0)
+    def _init():
+        acc_scr[...] = jnp.zeros(acc_scr.shape, dtype=acc_scr.dtype)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)        # (block_q, d_pad)
+        k = k_ref[0].astype(jnp.float32)        # (block_k, d_pad)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)      # (block_q, d_pad)
+        lse = lse_ref[0][:, None]               # (block_q, 1)
+        delta = delta_ref[0][:, None]
+
+        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        mask = _tile_mask(
+            scores.shape, seq_len, causal, qi * block_q, ki * block_k
+        )
+        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
+        ds = p * (jnp.dot(do, v.T, preferred_element_type=jnp.float32) - delta)
+        acc_scr[...] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(ki * block_k <= qi * block_q + block_q - 1)(_compute)
+    else:
+        _compute()
+
+    @pl.when(ki == pl.num_programs(2) - 1)
+    def _emit():
+        dq_ref[0] = (acc_scr[...] * sm_scale).astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(
     q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-    *, seq_len, causal, block_k, sm_scale
+    dk_scr, dv_scr,
+    *, seq_len, causal, block_q, block_k, sm_scale
 ):
     ki = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)        # (seq_pad, d_pad)
-    k = k_ref[0].astype(jnp.float32)        # (block_k, d_pad)
-    v = v_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)      # (seq_pad, d_pad)
-    lse = lse_ref[0][:, None]               # (seq_pad, 1)
-    delta = delta_ref[0][:, None]
+    qi = pl.program_id(2)
 
-    scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
-    # strip is (q rows, this key block's cols): same mask, transposed roles
-    mask = _strip_mask(scores.shape, seq_len, causal, 0, ki * block_k)
-    p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
-    dv_ref[0] = jnp.dot(
-        p.T, do, preferred_element_type=jnp.float32
-    ).astype(dv_ref.dtype)
-    ds = p * (jnp.dot(do, v.T, preferred_element_type=jnp.float32) - delta)
-    dk_ref[0] = (
-        jnp.dot(ds.T, q, preferred_element_type=jnp.float32) * sm_scale
-    ).astype(dk_ref.dtype)
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros(dk_scr.shape, dtype=dk_scr.dtype)
+        dv_scr[...] = jnp.zeros(dv_scr.shape, dtype=dv_scr.dtype)
+
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)        # (block_q, d_pad)
+        k = k_ref[0].astype(jnp.float32)        # (block_k, d_pad)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)      # (block_q, d_pad)
+        lse = lse_ref[0][:, None]               # (block_q, 1)
+        delta = delta_ref[0][:, None]
+
+        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
+        mask = _tile_mask(
+            scores.shape, seq_len, causal, qi * block_q, ki * block_k
+        )
+        p = jnp.where(mask, jnp.exp(scores - lse), 0.0)
+        dv_scr[...] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        ds = p * (jnp.dot(do, v.T, preferred_element_type=jnp.float32) - delta)
+        dk_scr[...] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    if causal:
+        pl.when(qi * block_q + block_q - 1 >= ki * block_k)(_compute)
+    else:
+        _compute()
+
+    @pl.when(qi == pl.num_programs(2) - 1)
+    def _emit():
+        dk_ref[0] = (dk_scr[...] * sm_scale).astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
 def _flash_backward_bhsd(
-    q, k, v, out, lse, d_out, causal, sm_scale, block_q, interpret
+    q, k, v, out, lse, d_out, causal, sm_scale, block_q, block_k, interpret
 ):
     bh, seq, d = q.shape
-    seq_pad = _round_up(seq, block_q)
+    seq_pad = _round_up(seq, math.lcm(block_q, block_k))
     d_pad = _round_up(d, 128)
 
     def pad(x):
@@ -187,58 +263,65 @@ def _flash_backward_bhsd(
     )
     delta_p = jnp.pad(delta, ((0, 0), (0, seq_pad - seq)))
 
-    n_blocks = seq_pad // block_q
-    strip = lambda b, i: (b, i, 0)  # noqa: E731
-    whole = lambda b, i: (b, 0, 0)  # noqa: E731
-    row_strip = lambda b, i: (b, i)  # noqa: E731
-    row_whole = lambda b, i: (b, 0)  # noqa: E731
+    n_q = seq_pad // block_q
+    n_k = seq_pad // block_k
+    common = dict(
+        seq_len=seq,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+        sm_scale=sm_scale,
+    )
+
+    q_tile = lambda b, i, j: (b, i, 0)   # noqa: E731 — q-indexed tiles
+    k_tile = lambda b, i, j: (b, j, 0)   # noqa: E731 — k-indexed tiles
+    q_row = lambda b, i, j: (b, i)       # noqa: E731
+    k_row = lambda b, i, j: (b, j)       # noqa: E731
 
     dq = pl.pallas_call(
-        functools.partial(
-            _bwd_dq_kernel,
-            seq_len=seq,
-            causal=causal,
-            block_q=block_q,
-            sm_scale=sm_scale,
-        ),
-        grid=(bh, n_blocks),
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(bh, n_q, n_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d_pad), strip),      # q block
-            pl.BlockSpec((1, seq_pad, d_pad), whole),      # all k
-            pl.BlockSpec((1, seq_pad, d_pad), whole),      # all v
-            pl.BlockSpec((1, block_q, d_pad), strip),      # dO block
-            pl.BlockSpec((1, block_q), row_strip),         # lse block
-            pl.BlockSpec((1, block_q), row_strip),         # delta block
+            pl.BlockSpec((1, block_q, d_pad), q_tile),     # q block
+            pl.BlockSpec((1, block_k, d_pad), k_tile),     # k block
+            pl.BlockSpec((1, block_k, d_pad), k_tile),     # v block
+            pl.BlockSpec((1, block_q, d_pad), q_tile),     # dO block
+            pl.BlockSpec((1, block_q), q_row),             # lse block
+            pl.BlockSpec((1, block_q), q_row),             # delta block
         ],
-        out_specs=pl.BlockSpec((1, block_q, d_pad), strip),
+        out_specs=pl.BlockSpec((1, block_q, d_pad), q_tile),
         out_shape=jax.ShapeDtypeStruct((bh, seq_pad, d_pad), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d_pad), jnp.float32)],
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta_p)
 
+    # dkv grid: k blocks outer, q blocks inner (the accumulation axis)
+    kv_own = lambda b, i, j: (b, i, 0)   # noqa: E731 — this kernel's k block
+    q_inner = lambda b, i, j: (b, j, 0)  # noqa: E731
+    q_inner_row = lambda b, i, j: (b, j)  # noqa: E731
+
     dk, dv = pl.pallas_call(
-        functools.partial(
-            _bwd_dkv_kernel,
-            seq_len=seq,
-            causal=causal,
-            block_k=block_q,
-            sm_scale=sm_scale,
-        ),
-        grid=(bh, n_blocks),
+        functools.partial(_bwd_dkv_kernel, **common),
+        grid=(bh, n_k, n_q),
         in_specs=[
-            pl.BlockSpec((1, seq_pad, d_pad), whole),      # all q
-            pl.BlockSpec((1, block_q, d_pad), strip),      # k block
-            pl.BlockSpec((1, block_q, d_pad), strip),      # v block
-            pl.BlockSpec((1, seq_pad, d_pad), whole),      # all dO
-            pl.BlockSpec((1, seq_pad), row_whole),         # all lse
-            pl.BlockSpec((1, seq_pad), row_whole),         # all delta
+            pl.BlockSpec((1, block_q, d_pad), q_inner),    # q block
+            pl.BlockSpec((1, block_k, d_pad), kv_own),     # k block
+            pl.BlockSpec((1, block_k, d_pad), kv_own),     # v block
+            pl.BlockSpec((1, block_q, d_pad), q_inner),    # dO block
+            pl.BlockSpec((1, block_q), q_inner_row),       # lse block
+            pl.BlockSpec((1, block_q), q_inner_row),       # delta block
         ],
         out_specs=[
-            pl.BlockSpec((1, block_q, d_pad), strip),
-            pl.BlockSpec((1, block_q, d_pad), strip),
+            pl.BlockSpec((1, block_k, d_pad), kv_own),
+            pl.BlockSpec((1, block_k, d_pad), kv_own),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_pad, d_pad), k.dtype),
             jax.ShapeDtypeStruct((bh, seq_pad, d_pad), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
+            pltpu.VMEM((block_k, d_pad), jnp.float32),
         ],
         interpret=interpret,
     )(qp, kp, vp, dop, lse_p, delta_p)
@@ -251,21 +334,25 @@ def _flash_backward_bhsd(
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_attention_bhsd(q, k, v, causal, sm_scale, block_q, interpret):
-    out, _ = _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_attention_bhsd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, _ = _flash_forward_bhsd(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret
+    )
     return out
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, interpret):
-    out, lse = _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, interpret)
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
+    out, lse = _flash_forward_bhsd(
+        q, k, v, causal, sm_scale, block_q, block_k, interpret
+    )
     return out, (q, k, v, out, lse)
 
 
-def _bwd(causal, sm_scale, block_q, interpret, residuals, d_out):
+def _bwd(causal, sm_scale, block_q, block_k, interpret, residuals, d_out):
     q, k, v, out, lse = residuals
     return _flash_backward_bhsd(
-        q, k, v, out, lse, d_out, causal, sm_scale, block_q, interpret
+        q, k, v, out, lse, d_out, causal, sm_scale, block_q, block_k, interpret
     )
 
 
@@ -279,12 +366,13 @@ def flash_attention(
     causal: bool = False,
     sm_scale: Optional[float] = None,
     block_q: int = 128,
+    block_k: int = 128,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """
     Flash attention over (batch, seq, heads, head_dim) tensors — drop-in for
-    gordo_tpu.models.specs_seq.dense_attention, O(seq) memory in BOTH
-    passes (see module docstring).
+    gordo_tpu.models.specs_seq.dense_attention, O(seq) HBM and
+    O(block_q x block_k) VMEM in BOTH passes (see module docstring).
 
     ``interpret=None`` auto-selects: compiled Mosaic kernel on TPU,
     interpreter elsewhere (so CPU test runs exercise identical kernel code).
@@ -299,6 +387,7 @@ def flash_attention(
         return x.transpose(0, 2, 1, 3).reshape(batch * heads, seq, head_dim)
 
     out = _flash_attention_bhsd(
-        to_bhsd(q), to_bhsd(k), to_bhsd(v), causal, sm_scale, block_q, interpret
+        to_bhsd(q), to_bhsd(k), to_bhsd(v),
+        causal, sm_scale, block_q, block_k, interpret,
     )
     return out.reshape(batch, heads, seq, head_dim).transpose(0, 2, 1, 3)

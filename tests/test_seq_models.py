@@ -181,10 +181,10 @@ def test_flash_full_gradients_match_dense(causal):
 
 def test_flash_training_memory_is_linear_in_seq():
     """
-    The backward must not materialize any (seq, seq) tensor: residuals are
-    (q, k, v, out, lse) and both backward kernels rebuild probability
-    strips blockwise. Pinned by inspecting the compiled HLO of the full
-    value-and-grad program for a seq x seq shape.
+    Neither pass may materialize a (seq, seq) tensor NOR an O(block, seq)
+    strip: both axes are tiled, so the largest score-shaped intermediate is
+    (block_q, block_k). Pinned by inspecting the lowered HLO of the full
+    value-and-grad program.
     """
     seq, d, block = 512, 8, 128
     q, k, v = (
@@ -193,15 +193,91 @@ def test_flash_training_memory_is_linear_in_seq():
     )
 
     def loss(q_, k_, v_):
-        return jnp.sum(flash_attention(q_, k_, v_, causal=True, block_q=block) ** 2)
+        return jnp.sum(
+            flash_attention(
+                q_, k_, v_, causal=True, block_q=block, block_k=block
+            )
+            ** 2
+        )
 
     hlo = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, k, v).as_text()
     assert f"{seq},{seq}" not in hlo and f"{seq}x{seq}" not in hlo, (
         "backward materializes a (seq, seq) tensor"
     )
-    # the strip shape (block, seq) IS expected — proves we checked the
-    # right program, not an empty lowering
-    assert f"{block},{seq}" in hlo or f"{block}x{seq}" in hlo
+    # round-2 regression guard: the old kernels kept a (block, seq) strip
+    # (whole-K in VMEM per grid cell), capping single-chip context length
+    assert f"{block},{seq}" not in hlo and f"{block}x{seq}" not in hlo, (
+        "a kernel materializes an O(block, seq) strip"
+    )
+    # the (block, block) tile IS expected — proves we checked the right
+    # program, not an empty lowering
+    assert f"{block},{block}" in hlo or f"{block}x{block}" in hlo
+
+
+def test_flash_long_context_vmem_bounded():
+    """
+    The VERDICT-r2 ceiling case: at seq=16k the old kernels needed an
+    ~8 MB strip + whole K/V in VMEM (past v5e VMEM); the tiled kernels'
+    intermediates stay (block_q, block_k) regardless of seq. Asserted on
+    the lowered HLO, then executed (forward) in interpret mode at a long
+    sequence to prove the grid actually runs.
+    """
+    seq, d, block = 16384, 8, 512
+    q = jax.ShapeDtypeStruct((1, seq, 1, d), jnp.float32)
+
+    def loss(q_, k_, v_):
+        return jnp.sum(
+            flash_attention(
+                q_, k_, v_, causal=True, block_q=block, block_k=block
+            )
+            ** 2
+        )
+
+    hlo = jax.jit(jax.grad(loss, argnums=(0, 1, 2))).lower(q, q, q).as_text()
+    for bad in (f"{seq},{seq}", f"{seq}x{seq}", f"{block},{seq}", f"{block}x{seq}"):
+        assert bad not in hlo, f"unbounded intermediate {bad} in HLO"
+    assert f"{block},{block}" in hlo or f"{block}x{block}" in hlo
+
+    # execute forward at seq=4096 (16k in interpret mode is minutes on a
+    # 1-core CI box; the 16k guarantee above is the lowering, which is
+    # identical code): online-softmax result matches dense attention
+    seq_run = 4096
+    qr, kr, vr = (
+        jnp.asarray(
+            np.random.default_rng(i).normal(size=(1, seq_run, 1, d)),
+            dtype=jnp.float32,
+        )
+        for i in range(3)
+    )
+    out = flash_attention(qr, kr, vr, causal=True, block_q=512, block_k=512)
+    want = dense_attention(qr, kr, vr, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want), atol=2e-3)
+
+
+def test_flash_gradients_multi_block_seq():
+    """Grad parity with dense autodiff when the grid is genuinely 2-D in
+    both sequence axes (several q AND k blocks)."""
+    seq = 1024
+    q, k, v = (
+        jnp.asarray(RNG.normal(size=(1, seq, 1, 8)), dtype=jnp.float32)
+        for _ in range(3)
+    )
+
+    def flash_loss(q_, k_, v_):
+        return jnp.sum(
+            flash_attention(
+                q_, k_, v_, causal=True, block_q=256, block_k=256
+            )
+            ** 2
+        )
+
+    def dense_loss(q_, k_, v_):
+        return jnp.sum(dense_attention(q_, k_, v_, causal=True) ** 2)
+
+    got = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "qkv"):
+        np.testing.assert_allclose(g, w, atol=5e-3, err_msg=f"d{name}")
 
 
 def test_flash_attention_impl_in_estimator():
