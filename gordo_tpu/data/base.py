@@ -25,6 +25,74 @@ class InsufficientDataError(ValueError):
     pass
 
 
+# -- resample pipeline steps (applied per tag by _resample) ------------------
+
+
+def _span_aligned(series: pd.Series, start: datetime, end: datetime) -> pd.Series:
+    """
+    Pin a series to the resampling span: NaN sentinels are planted at the
+    exact span endpoints (when the data starts later / ends earlier) so every
+    tag's resampled index is identical and the sentinels' NaNs die in the
+    post-join ``dropna``. Data OUTSIDE the span is a provider bug -> raise.
+    """
+    tz = series.index[0].tzinfo
+    lo = start.astimezone(tz=tz)
+    hi = end.astimezone(tz=tz)
+
+    if series.index[0] < lo:
+        raise RuntimeError(
+            f"For {series.name}, first timestamp {series.index[0]} is before "
+            f"the resampling start point {lo}"
+        )
+    if series.index[-1] > hi:
+        raise RuntimeError(
+            f"For {series.name}, last timestamp {series.index[-1]} is later "
+            f"than the resampling end point {hi}"
+        )
+
+    def sentinel(point):
+        return pd.Series([np.nan], index=[point], name=series.name)
+
+    parts = (
+        ([sentinel(lo)] if series.index[0] > lo else [])
+        + [series]
+        + ([sentinel(hi)] if series.index[-1] < hi else [])
+    )
+    return pd.concat(parts) if len(parts) > 1 else series
+
+
+def _bucketize(
+    series: pd.Series,
+    resolution: str,
+    aggregation_methods: Union[str, List[str], Callable],
+) -> Union[pd.Series, pd.DataFrame]:
+    """
+    Left-labelled resample + aggregation. Multiple aggregation methods widen
+    the result to a (tag, aggregation_method) MultiIndex column block.
+    """
+    out = series.resample(resolution, label="left").agg(aggregation_methods)
+    if isinstance(out, pd.DataFrame):
+        out.columns = pd.MultiIndex.from_product(
+            [[series.name], out.columns],
+            names=["tag", "aggregation_method"],
+        )
+    return out
+
+
+def _gap_fill_steps(interpolation_limit: Union[str, None], resolution: str):
+    """Interpolation limit as a whole number of resolution steps (None =
+    unlimited); sub-resolution limits are meaningless -> raise."""
+    if interpolation_limit is None:
+        return None
+    ratio = (
+        pd.Timedelta(normalize_frequency(interpolation_limit)).total_seconds()
+        / pd.Timedelta(resolution).total_seconds()
+    )
+    if int(ratio) <= 0:
+        raise ValueError("Interpolation limit must be larger than resolution")
+    return int(ratio)
+
+
 class GordoBaseDataset(abc.ABC):
 
     _params: Dict[Any, Any] = dict()
@@ -113,42 +181,39 @@ class GordoBaseDataset(abc.ABC):
         identical, resampled with ``label="left"``, aggregated, interpolated
         up to a limit, joined, and NaN rows dropped.
         """
-        resampled_series = []
-        missing_data_series = []
+        tag_meta: Dict[Any, Any] = {}
+        self._metadata["tag_loading_metadata"] = tag_meta
 
-        key = "tag_loading_metadata"
-        self._metadata[key] = dict()
-
+        per_tag: List[Union[pd.Series, pd.DataFrame]] = []
+        empty_tags: List[str] = []
         for series in series_iterable:
-            self._metadata[key][series.name] = dict(original_length=len(series))
-            try:
-                resampled = self._resample(
-                    series,
-                    resampling_startpoint=resampling_startpoint,
-                    resampling_endpoint=resampling_endpoint,
-                    resolution=resolution,
-                    aggregation_methods=aggregation_methods,
-                    interpolation_method=interpolation_method,
-                    interpolation_limit=interpolation_limit,
-                )
-            except IndexError:
-                missing_data_series.append(series.name)
-            else:
-                resampled_series.append(resampled)
-                self._metadata[key][series.name]["resampled_length"] = len(resampled)
+            tag_meta[series.name] = dict(original_length=len(series))
+            if len(series) == 0:
+                empty_tags.append(series.name)
+                continue
+            resampled = self._resample(
+                series,
+                resampling_startpoint=resampling_startpoint,
+                resampling_endpoint=resampling_endpoint,
+                resolution=resolution,
+                aggregation_methods=aggregation_methods,
+                interpolation_method=interpolation_method,
+                interpolation_limit=interpolation_limit,
+            )
+            per_tag.append(resampled)
+            tag_meta[series.name]["resampled_length"] = len(resampled)
 
-        if missing_data_series:
+        if empty_tags:
             raise InsufficientDataError(
-                f"The following features are missing data: {missing_data_series}"
+                f"The following features are missing data: {empty_tags}"
             )
 
-        joined_df = pd.concat(resampled_series, axis=1, join="inner")
-        dropped_na = joined_df.dropna()
-
-        self._metadata[key]["aggregate_metadata"] = dict(
-            joined_length=len(joined_df), dropped_na_length=len(dropped_na)
+        joined = pd.concat(per_tag, axis=1, join="inner")
+        cleaned = joined.dropna()
+        tag_meta["aggregate_metadata"] = dict(
+            joined_length=len(joined), dropped_na_length=len(cleaned)
         )
-        return dropped_na
+        return cleaned
 
     @staticmethod
     def _resample(
@@ -161,60 +226,28 @@ class GordoBaseDataset(abc.ABC):
         interpolation_limit: str = "8H",
     ):
         """
-        Resample one series (reference: base.py:176-269). Legacy frequency
-        aliases ("10T", "8H") are normalized for modern pandas.
+        Resample one series: span-align -> left-labelled bucket aggregation ->
+        bounded gap fill -> drop what stayed NaN (reference semantics:
+        base.py:176-269). Legacy frequency aliases ("10T", "8H") are
+        normalized for modern pandas.
         """
         if len(series) == 0:
             raise IndexError("Cannot resample an empty series")
-
-        resolution = normalize_frequency(resolution)
-
-        startpoint_sametz = resampling_startpoint.astimezone(tz=series.index[0].tzinfo)
-        endpoint_sametz = resampling_endpoint.astimezone(tz=series.index[0].tzinfo)
-
-        if series.index[0] > startpoint_sametz:
-            # Pad a NaN at the startpoint so all resampled indexes line up;
-            # the padding-induced NaNs are dropped after the join.
-            startpoint = pd.Series([np.nan], index=[startpoint_sametz], name=series.name)
-            series = pd.concat([startpoint, series])
-        elif series.index[0] < startpoint_sametz:
-            raise RuntimeError(
-                f"For {series.name}, first timestamp {series.index[0]} is before "
-                f"the resampling start point {startpoint_sametz}"
-            )
-
-        if series.index[-1] < endpoint_sametz:
-            endpoint = pd.Series([np.nan], index=[endpoint_sametz], name=series.name)
-            series = pd.concat([series, endpoint])
-        elif series.index[-1] > endpoint_sametz:
-            raise RuntimeError(
-                f"For {series.name}, last timestamp {series.index[-1]} is later "
-                f"than the resampling end point {endpoint_sametz}"
-            )
-
-        resampled = series.resample(resolution, label="left").agg(aggregation_methods)
-        if isinstance(resampled, pd.DataFrame):
-            # several aggregation methods -> (tag, aggregation_method) columns
-            resampled.columns = pd.MultiIndex.from_product(
-                [[series.name], resampled.columns],
-                names=["tag", "aggregation_method"],
-            )
-
         if interpolation_method not in ("linear_interpolation", "ffill"):
             raise ValueError(
-                "Interpolation method should be either linear_interpolation or ffill"
+                "Interpolation method should be either linear_interpolation "
+                "or ffill"
             )
 
-        if interpolation_limit is not None:
-            limit = int(
-                pd.Timedelta(normalize_frequency(interpolation_limit)).total_seconds()
-                / pd.Timedelta(resolution).total_seconds()
-            )
-            if limit <= 0:
-                raise ValueError("Interpolation limit must be larger than resolution")
-        else:
-            limit = None
+        resolution = normalize_frequency(resolution)
+        limit = _gap_fill_steps(interpolation_limit, resolution)
 
-        if interpolation_method == "linear_interpolation":
-            return resampled.interpolate(limit=limit).dropna()
-        return resampled.ffill(limit=limit).dropna()
+        pinned = _span_aligned(series, resampling_startpoint, resampling_endpoint)
+        buckets = _bucketize(pinned, resolution, aggregation_methods)
+
+        filled = (
+            buckets.interpolate(limit=limit)
+            if interpolation_method == "linear_interpolation"
+            else buckets.ffill(limit=limit)
+        )
+        return filled.dropna()
