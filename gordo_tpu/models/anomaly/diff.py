@@ -176,6 +176,7 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         import jax.numpy as jnp
         from sklearn.base import clone
 
+        from gordo_tpu.models.callbacks import fleet_fit_kwargs
         from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
 
         folds = list(cv.split(X, y))
@@ -205,6 +206,9 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
             epochs=int(fit_args.get("epochs", 1)),
             batch_size=int(fit_args.get("batch_size", 32)),
             shuffle=fit_args.get("shuffle"),
+            # the clones' EarlyStopping/validation_split, as the trainer's
+            # per-fold gates (guaranteed translatable by _folds_batchable)
+            **(fleet_fit_kwargs(fit_args) or {}),
         )
         fit_time = (time.perf_counter() - start) / len(folds)
 
@@ -239,6 +243,7 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
 
     def _folds_batchable(self, X, y, cv, kwargs) -> bool:
         """Whether the vmapped fold fast path preserves semantics here."""
+        from gordo_tpu.models.callbacks import fleet_fit_kwargs
         from gordo_tpu.models.core import BaseJaxEstimator
 
         if not isinstance(self.base_estimator, BaseJaxEstimator):
@@ -248,8 +253,8 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         fit_args = self.base_estimator.extract_supported_fit_args(
             self.base_estimator.kwargs
         )
-        if fit_args.get("callbacks") or fit_args.get("validation_split"):
-            return False  # per-fold callback state doesn't vmap
+        if fleet_fit_kwargs(fit_args) is None:
+            return False  # a configured callback has no fleet equivalent
         try:
             folds = list(cv.split(X, y))
         except Exception:

@@ -201,3 +201,45 @@ class EarlyStopping(Callback):
         ):
             return self.best_params
         return params
+
+
+def fleet_fit_kwargs(fit_args: dict) -> typing.Optional[dict]:
+    """
+    Strictly map an estimator's fit configuration (``validation_split``
+    plus its ``callbacks`` list) onto :meth:`FleetTrainer.fit` keyword
+    arguments. Returns None when ANY configured behavior cannot be
+    reproduced exactly by the fleet path — callers must then fall back to
+    the per-machine (solo) training loop, where callbacks run natively.
+
+    Translatable: one EarlyStopping on a min-mode loss-family monitor
+    (``loss``/``val_loss``, the Keras default), with its
+    patience/min_delta/start_from_epoch/restore_best_weights; a
+    validation_split (becomes the trainer's per-machine holdout).
+    """
+    from gordo_tpu.models.core import _materialize_callbacks
+
+    out: dict = {}
+    vs = float(fit_args.get("validation_split") or 0.0)
+    if vs > 0.0:
+        out["validation_split"] = vs
+    for cb in _materialize_callbacks(fit_args.get("callbacks")):
+        if not isinstance(cb, EarlyStopping):
+            return None  # no fleet equivalent (e.g. TerminateOnNaN)
+        if (
+            "loss" not in cb.monitor
+            or cb._direction == "max"
+            or cb.baseline is not None
+        ):
+            return None
+        if "early_stopping_patience" in out:
+            return None  # two gates: the solo loop runs both, we can't
+        out.update(
+            {
+                "early_stopping_patience": int(cb.patience),
+                "early_stopping_min_delta": abs(float(cb.min_delta)),
+                "early_stopping_start_from_epoch": int(cb.start_from_epoch),
+                "restore_best_weights": bool(cb.restore_best_weights),
+                "early_stopping_on_val": "val" in cb.monitor and vs > 0.0,
+            }
+        )
+    return out

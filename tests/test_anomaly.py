@@ -148,16 +148,45 @@ def test_fold_parallel_cv_declines_non_contiguous_and_callbacks():
     assert not model._folds_batchable(
         X, X, KFold(n_splits=3, shuffle=True, random_state=0), {}
     )
-    with_cb = DiffBasedAnomalyDetector(
+    # a callback with no fleet equivalent forces the sequential path,
+    # where it runs natively
+    with_nan_cb = DiffBasedAnomalyDetector(
         base_estimator=AutoEncoder(
             kind="feedforward_hourglass",
             epochs=1,
-            callbacks=[{"gordo_tpu.models.callbacks.EarlyStopping": {"patience": 1}}],
+            callbacks=[{"gordo_tpu.models.callbacks.TerminateOnNaN": {}}],
         )
     )
-    from sklearn.model_selection import TimeSeriesSplit
+    assert not with_nan_cb._folds_batchable(X, X, TimeSeriesSplit(3), {})
 
-    assert not with_cb._folds_batchable(X, X, TimeSeriesSplit(3), {})
+
+def test_fold_parallel_cv_engages_with_early_stopping_config():
+    """An EarlyStopping + validation_split config (the realistic flagship
+    shape) translates to the fleet trainer's per-fold gates, so the fast
+    path engages instead of declining to 3x-slower sequential CV."""
+    from gordo_tpu.models.models import AutoEncoder
+
+    X, _ = _data(n=160)
+    model = DiffBasedAnomalyDetector(
+        base_estimator=AutoEncoder(
+            kind="feedforward_hourglass",
+            epochs=3,
+            validation_split=0.25,
+            callbacks=[
+                {
+                    "gordo_tpu.models.callbacks.EarlyStopping": {
+                        "patience": 2,
+                        "restore_best_weights": True,
+                    }
+                }
+            ],
+        )
+    )
+    model.fit(X, X)
+    assert model._folds_batchable(X, X, TimeSeriesSplit(3), {})
+    model.cross_validate(X=X, y=X)
+    assert model.cv_fast_path_ is True
+    assert np.isfinite(model.aggregate_threshold_)
 
 
 def test_anomaly_requires_thresholds_by_default():
