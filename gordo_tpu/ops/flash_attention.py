@@ -44,6 +44,10 @@ _NEG_INF = -1e30
 # TPU lane width: scratch row-statistics are stored lane-broadcast so the
 # (block_q, 1) logical vectors tile cleanly into VMEM
 _LANES = 128
+# Mosaic requires a block's last two dims to divide (8, 128) or equal the
+# array's; per-row stats (lse, delta) therefore travel as (..., seq, 8)
+# arrays — logical column 0 broadcast across 8 sublane-width lanes
+_STAT_LANES = 8
 
 
 def _round_up(n: int, multiple: int) -> int:
@@ -114,7 +118,9 @@ def _attn_kernel(
         l = l_scr[...][:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-padded rows
         o_ref[0] = (acc_scr[...] / l_safe).astype(o_ref.dtype)
-        lse_ref[0] = (m + jnp.log(l_safe))[:, 0]
+        lse_ref[0] = jnp.broadcast_to(
+            m + jnp.log(l_safe), (m.shape[0], _STAT_LANES)
+        )
 
 
 def _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
@@ -146,11 +152,11 @@ def _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d_pad), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+            pl.BlockSpec((1, block_q, _STAT_LANES), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((bh, seq_pad, d_pad), q.dtype),
-            jax.ShapeDtypeStruct((bh, seq_pad), jnp.float32),
+            jax.ShapeDtypeStruct((bh, seq_pad, _STAT_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),  # running row max
@@ -159,7 +165,7 @@ def _flash_forward_bhsd(q, k, v, causal, sm_scale, block_q, block_k, interpret):
         ],
         interpret=interpret,
     )(qp, kp, vp)
-    return out[:, :seq, :d], lse
+    return out[:, :seq, :d], lse[:, :, 0]
 
 
 # --------------------------------------------------------------------------
@@ -183,8 +189,8 @@ def _bwd_dq_kernel(
         k = k_ref[0].astype(jnp.float32)        # (block_k, d_pad)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)      # (block_q, d_pad)
-        lse = lse_ref[0][:, None]               # (block_q, 1)
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]                 # (block_q, 1) from lane pad
+        delta = delta_ref[0][:, :1]
 
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         mask = _tile_mask(
@@ -222,8 +228,8 @@ def _bwd_dkv_kernel(
         k = k_ref[0].astype(jnp.float32)        # (block_k, d_pad)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)      # (block_q, d_pad)
-        lse = lse_ref[0][:, None]               # (block_q, 1)
-        delta = delta_ref[0][:, None]
+        lse = lse_ref[0][:, :1]                 # (block_q, 1) from lane pad
+        delta = delta_ref[0][:, :1]
 
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         mask = _tile_mask(
@@ -256,12 +262,20 @@ def _flash_backward_bhsd(
         return jnp.pad(x, ((0, 0), (0, seq_pad - seq), (0, d_pad - d)))
 
     qp, kp, vp, dop = pad(q), pad(k), pad(v), pad(d_out)
-    lse_p = jnp.pad(lse, ((0, 0), (0, seq_pad - lse.shape[1])))
     # delta_i = rowsum(dO_i * O_i); zero on padded rows by construction
     delta = jnp.sum(
         d_out.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
     )
-    delta_p = jnp.pad(delta, ((0, 0), (0, seq_pad - seq)))
+
+    def stat_lanes(row_stat, pad_to):
+        """(bh, seq) per-row stat -> lane-broadcast (bh, seq_pad, 8)."""
+        padded = jnp.pad(row_stat, ((0, 0), (0, pad_to - row_stat.shape[1])))
+        return jnp.broadcast_to(
+            padded[:, :, None], padded.shape + (_STAT_LANES,)
+        )
+
+    lse_p = stat_lanes(lse, seq_pad)
+    delta_p = stat_lanes(delta, seq_pad)
 
     n_q = seq_pad // block_q
     n_k = seq_pad // block_k
@@ -275,8 +289,7 @@ def _flash_backward_bhsd(
 
     q_tile = lambda b, i, j: (b, i, 0)   # noqa: E731 — q-indexed tiles
     k_tile = lambda b, i, j: (b, j, 0)   # noqa: E731 — k-indexed tiles
-    q_row = lambda b, i, j: (b, i)       # noqa: E731
-    k_row = lambda b, i, j: (b, j)       # noqa: E731
+    stat_block = (1, block_q, _STAT_LANES)  # lane-broadcast row stats
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, **common),
@@ -286,8 +299,8 @@ def _flash_backward_bhsd(
             pl.BlockSpec((1, block_k, d_pad), k_tile),     # k block
             pl.BlockSpec((1, block_k, d_pad), k_tile),     # v block
             pl.BlockSpec((1, block_q, d_pad), q_tile),     # dO block
-            pl.BlockSpec((1, block_q), q_row),             # lse block
-            pl.BlockSpec((1, block_q), q_row),             # delta block
+            pl.BlockSpec(stat_block, q_tile),              # lse block
+            pl.BlockSpec(stat_block, q_tile),              # delta block
         ],
         out_specs=pl.BlockSpec((1, block_q, d_pad), q_tile),
         out_shape=jax.ShapeDtypeStruct((bh, seq_pad, d_pad), q.dtype),
@@ -298,7 +311,6 @@ def _flash_backward_bhsd(
     # dkv grid: k blocks outer, q blocks inner (the accumulation axis)
     kv_own = lambda b, i, j: (b, i, 0)   # noqa: E731 — this kernel's k block
     q_inner = lambda b, i, j: (b, j, 0)  # noqa: E731
-    q_inner_row = lambda b, i, j: (b, j)  # noqa: E731
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, **common),
@@ -308,8 +320,8 @@ def _flash_backward_bhsd(
             pl.BlockSpec((1, block_k, d_pad), kv_own),     # k block
             pl.BlockSpec((1, block_k, d_pad), kv_own),     # v block
             pl.BlockSpec((1, block_q, d_pad), q_inner),    # dO block
-            pl.BlockSpec((1, block_q), q_inner_row),       # lse block
-            pl.BlockSpec((1, block_q), q_inner_row),       # delta block
+            pl.BlockSpec(stat_block, q_inner),             # lse block
+            pl.BlockSpec(stat_block, q_inner),             # delta block
         ],
         out_specs=[
             pl.BlockSpec((1, block_k, d_pad), kv_own),
