@@ -3,8 +3,8 @@ Click parameter types (reference parity: gordo/cli/custom_types.py:14-73).
 """
 
 import ipaddress
-import os
 import typing
+from pathlib import Path
 
 import click
 import yaml
@@ -19,18 +19,16 @@ class DataProviderParam(click.ParamType):
     name = "data-provider"
 
     def convert(self, value, param, ctx):
-        if os.path.isfile(value):
-            with open(value) as f:
-                kwargs = yaml.safe_load(f)
-        else:
-            kwargs = yaml.safe_load(value)
-        if "type" not in kwargs:
-            self.fail("Cannot create DataProvider without 'type' key defined")
-        kind = kwargs.pop("type")
+        path = Path(value)
+        text = path.read_text() if path.is_file() else value
+        spec = yaml.safe_load(text)
+        if not isinstance(spec, dict) or "type" not in spec:
+            self.fail("a data-provider definition needs a 'type' key")
+        kind = spec.pop("type")
         provider_cls = getattr(providers, kind, None)
         if provider_cls is None:
             self.fail(f"No DataProvider named '{kind}'")
-        return provider_cls(**kwargs)
+        return provider_cls(**spec)
 
 
 class IsoFormatDateTime(click.ParamType):
@@ -42,7 +40,7 @@ class IsoFormatDateTime(click.ParamType):
         try:
             return parser.isoparse(value)
         except ValueError:
-            self.fail(f"Failed to parse date '{value}' as ISO formatted date")
+            self.fail(f"'{value}' is not an ISO-formatted datetime")
 
 
 class HostIP(click.ParamType):
@@ -53,9 +51,9 @@ class HostIP(click.ParamType):
     def convert(self, value, param, ctx):
         try:
             ipaddress.ip_address(value)
-            return value
         except ValueError as e:
             self.fail(str(e))
+        return value
 
 
 def key_value_par(val) -> typing.Tuple[str, str]:

@@ -42,26 +42,23 @@ class InsufficientDataAfterGlobalFilteringError(InsufficientDataError):
     pass
 
 
+# pre-1.0 config spellings still found in deployed YAML
+# (reference: datasets.py:41-63)
+_LEGACY_KEYS = {
+    "from_ts": "train_start_date",
+    "to_ts": "train_end_date",
+    "tags": "tag_list",
+}
+
+
 def compat(init):
-    """
-    Rename legacy config keys onto current kwargs
-    (reference: datasets.py:41-63): ``from_ts``/``to_ts``/``tags`` ->
-    ``train_start_date``/``train_end_date``/``tag_list``.
-    """
+    """Translate legacy kwarg spellings onto their current names."""
 
     @wraps(init)
-    def wrapper(*args, **kwargs):
-        renamings = {
-            "from_ts": "train_start_date",
-            "to_ts": "train_end_date",
-            "tags": "tag_list",
-        }
-        for old, new in renamings.items():
-            if old in kwargs:
-                kwargs[new] = kwargs.pop(old)
-        return init(*args, **kwargs)
+    def renamed(*args, **kwargs):
+        return init(*args, **{_LEGACY_KEYS.get(k, k): v for k, v in kwargs.items()})
 
-    return wrapper
+    return renamed
 
 
 class TimeSeriesDataset(GordoBaseDataset):
@@ -95,46 +92,52 @@ class TimeSeriesDataset(GordoBaseDataset):
         interpolation_limit: str = "8H",
         filter_periods={},
     ):
+        config = locals()
         self._metadata = {}
-        self.train_start_date = self._validate_dt(train_start_date)
-        self.train_end_date = self._validate_dt(train_end_date)
 
-        if self.train_start_date >= self.train_end_date:
+        window = [self._as_aware_datetime(config[k])
+                  for k in ("train_start_date", "train_end_date")]
+        if window[0] >= window[1]:
             raise ValueError(
-                f"train_end_date ({self.train_end_date}) must be after "
-                f"train_start_date ({self.train_start_date})"
+                f"empty training window: start {window[0]} is not before "
+                f"end {window[1]}"
             )
+        self.train_start_date, self.train_end_date = window
 
-        self.tag_list = normalize_sensor_tags(list(tag_list), asset, default_asset)
-        self.target_tag_list = (
-            normalize_sensor_tags(list(target_tag_list), asset, default_asset)
-            if target_tag_list
-            else self.tag_list.copy()
-        )
-        self.resolution = resolution
+        def as_tags(raw):
+            return normalize_sensor_tags(list(raw), asset, default_asset)
+
+        self.tag_list = as_tags(tag_list)
+        self.target_tag_list = as_tags(target_tag_list) if target_tag_list else list(self.tag_list)
+
         if data_provider is None:
             from gordo_tpu.data.providers.compound import DataLakeProvider
 
             data_provider = DataLakeProvider()
-        self.data_provider = (
-            data_provider
-            if not isinstance(data_provider, dict)
-            else GordoBaseDataProvider.from_dict(data_provider)
-        )
-        self.row_filter = row_filter
-        self.aggregation_methods = aggregation_methods
-        self.row_filter_buffer_size = row_filter_buffer_size
-        self.asset = asset
-        self.n_samples_threshold = n_samples_threshold
-        self.low_threshold = low_threshold
-        self.high_threshold = high_threshold
-        self.interpolation_method = interpolation_method
-        self.interpolation_limit = interpolation_limit
-        self.filter_periods = (
-            FilterPeriods(granularity=self.resolution, **filter_periods)
-            if filter_periods
-            else None
-        )
+        elif isinstance(data_provider, dict):
+            data_provider = GordoBaseDataProvider.from_dict(data_provider)
+        self.data_provider = data_provider
+
+        # plain scalar knobs pass straight through onto attributes
+        for knob in (
+            "resolution",
+            "row_filter",
+            "aggregation_methods",
+            "row_filter_buffer_size",
+            "asset",
+            "n_samples_threshold",
+            "low_threshold",
+            "high_threshold",
+            "interpolation_method",
+            "interpolation_limit",
+        ):
+            setattr(self, knob, config[knob])
+
+        self.filter_periods = None
+        if filter_periods:
+            self.filter_periods = FilterPeriods(
+                granularity=resolution, **filter_periods
+            )
 
     def to_dict(self):
         params = super().to_dict()
@@ -144,13 +147,14 @@ class TimeSeriesDataset(GordoBaseDataset):
         return params
 
     @staticmethod
-    def _validate_dt(dt: Union[str, datetime]) -> datetime:
-        dt = dt if isinstance(dt, datetime) else isoparse(dt)
-        if dt.tzinfo is None:
+    def _as_aware_datetime(value: Union[str, datetime]) -> datetime:
+        stamp = isoparse(value) if isinstance(value, str) else value
+        if stamp.tzinfo is None:
             raise ValueError(
-                "Must provide an ISO formatted datetime string with timezone information"
+                f"timezone-naive timestamp {value!r}: training windows must "
+                "carry explicit timezone information"
             )
-        return dt
+        return stamp
 
     # --- the get_data pipeline, one small method per stage ----------------
 
@@ -271,9 +275,9 @@ class RandomDataset(TimeSeriesDataset):
     ):
         kwargs.pop("data_provider", None)
         super().__init__(
+            train_start_date,
+            train_end_date,
+            tag_list,
             data_provider=RandomDataProvider(),
-            train_start_date=train_start_date,
-            train_end_date=train_end_date,
-            tag_list=tag_list,
             **kwargs,
         )

@@ -4,7 +4,7 @@ Model-layer helpers (reference parity: gordo/machine/model/utils.py).
 
 import functools
 import logging
-from datetime import datetime, timedelta
+from datetime import timedelta
 from typing import List, Optional, Union
 
 import numpy as np
@@ -49,51 +49,50 @@ def make_base_dataframe(
     to the (possibly shorter, offset) model output
     (reference: model/utils.py:49-156).
     """
-    target_tag_list = target_tag_list if target_tag_list is not None else tags
+    out = getattr(model_output, "values", model_output)
+    n_rows = len(out)
+    inp = getattr(model_input, "values", model_input)[-n_rows:, :]
+    idx = index[-n_rows:] if index is not None else range(n_rows)
 
-    model_input = getattr(model_input, "values", model_input)[-len(model_output):, :]
-    model_output = getattr(model_output, "values", model_output)
+    # start/end timestamp columns: ISO strings on a DatetimeIndex, else None
+    if isinstance(idx, pd.DatetimeIndex):
+        starts = [stamp.isoformat() for stamp in idx]
+        ends = (
+            [(stamp + frequency).isoformat() for stamp in idx]
+            if frequency is not None
+            else [None] * n_rows
+        )
+    else:
+        starts = ends = [None] * n_rows
 
-    index = (
-        index[-len(model_output):] if index is not None else range(len(model_output))
+    frame = pd.DataFrame(
+        {("start", ""): starts, ("end", ""): ends},
+        columns=pd.MultiIndex.from_product((("start", "end"), ("",))),
+        index=idx,
     )
 
-    start_series = pd.Series(
-        index if isinstance(index, pd.DatetimeIndex) else [None] * len(index),
-        index=index,
+    blocks = (
+        ("model-input", inp, tags),
+        ("model-output", out, target_tag_list if target_tag_list is not None else tags),
     )
-    end_series = start_series.map(
-        lambda start: (start + frequency).isoformat()
-        if isinstance(start, datetime) and frequency is not None
-        else None
-    )
-    start_series = start_series.map(
-        lambda start: start.isoformat() if hasattr(start, "isoformat") else None
-    )
-
-    columns = pd.MultiIndex.from_product((("start", "end"), ("",)))
-    data = pd.DataFrame(
-        {("start", ""): start_series, ("end", ""): end_series},
-        columns=columns,
-        index=index,
-    )
-
-    for name, values in (("model-input", model_input), ("model-output", model_output)):
+    for top_level, values, owners in blocks:
         if values is None:
             continue
-        _tags = tags if name == "model-input" else target_tag_list
-        if values.shape[1] == len(_tags):
-            second_lvl_names = [
-                str(tag.name if isinstance(tag, SensorTag) else tag) for tag in _tags
-            ]
-        else:
-            second_lvl_names = [str(i) for i in range(values.shape[1])]
-        sub_columns = pd.MultiIndex.from_tuples(
-            (name, sub_name) for sub_name in second_lvl_names
+        frame = frame.join(
+            pd.DataFrame(
+                values[-n_rows:],
+                columns=pd.MultiIndex.from_tuples(
+                    (top_level, label)
+                    for label in _second_level_labels(owners, values.shape[1])
+                ),
+                index=idx,
+            )
         )
-        other = pd.DataFrame(
-            values[-len(model_output):], columns=sub_columns, index=index
-        )
-        data = data.join(other)
+    return frame
 
-    return data
+
+def _second_level_labels(tags, width: int) -> List[str]:
+    """Tag names when the block width matches the tag list, else ordinals."""
+    if width == len(tags):
+        return [str(tag.name if isinstance(tag, SensorTag) else tag) for tag in tags]
+    return [str(i) for i in range(width)]
