@@ -1,7 +1,7 @@
 """
 Vendored static analysis — the stand-in for the reference's mypy/pyflakes
 pytest plugins (reference pytest.ini:8-9, mypy.ini; neither tool exists in
-this image, and nothing may be installed). Three checks with near-zero
+this image, and nothing may be installed). Six checks with near-zero
 false-positive rates, applied to every module by tests/test_static.py:
 
 1. unused imports           (pyflakes' highest-value diagnostic)
@@ -13,6 +13,14 @@ false-positive rates, applied to every module by tests/test_static.py:
                              binding of ``X`` — ``from X import X``, a
                              def/class — makes every ``X.attr`` ambiguous;
                              the exact class of the round-2 ``copy`` bug)
+5. annotated-attribute typos (``param.atr`` where ``param`` is annotated
+                             with a statically-resolvable class and the
+                             attribute exists neither on the class nor as
+                             a ``self.atr`` assignment in its methods —
+                             the annotation-driven slice of mypy)
+6. return-annotation drift  (a bare ``return`` in a function annotated
+                             ``-> X`` for non-Optional X, or ``return v``
+                             in one annotated ``-> None``)
 """
 
 import ast
@@ -20,6 +28,8 @@ import builtins
 import importlib
 import inspect
 import re
+import sys
+import textwrap
 import types
 import typing
 
@@ -217,6 +227,279 @@ def check_module_shadowing(tree: ast.Module) -> typing.List[str]:
                 f"module name"
             )
     return problems
+
+
+# --------------------------------------------------------------------------
+# 5. annotation-driven attribute checking (the mypy slice)
+# --------------------------------------------------------------------------
+
+_ATTR_CACHE: typing.Dict[type, typing.Optional[typing.Set[str]]] = {}
+
+
+def _known_attrs(cls: type) -> typing.Optional[typing.Set[str]]:
+    """
+    The statically-knowable attribute surface of ``cls``: everything on the
+    class (dir), declared annotations, plus every ``self.X = ...`` target
+    found in the class's own source. Returns None — "can't vouch" — for
+    classes with dynamic attribute hooks or unreadable source.
+    """
+    if cls in _ATTR_CACHE:
+        return _ATTR_CACHE[cls]
+    result: typing.Optional[typing.Set[str]]
+    if any(
+        "__getattr__" in vars(base) or "__getattribute__" in vars(base)
+        for base in cls.__mro__
+        if base is not object
+    ):
+        result = None
+    else:
+        names = set(dir(cls))
+        for base in cls.__mro__:
+            names.update(getattr(base, "__annotations__", {}))
+            if base is object:
+                continue
+            try:
+                base_tree = ast.parse(textwrap.dedent(inspect.getsource(base)))
+            except (OSError, TypeError, SyntaxError, IndentationError):
+                result = None
+                break
+            dynamic = False
+            for node in ast.walk(base_tree):
+                if (
+                    isinstance(node, ast.Attribute)
+                    and isinstance(node.ctx, ast.Store)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id == "self"
+                ):
+                    names.add(node.attr)
+                elif (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "setattr"
+                    and node.args
+                    and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id == "self"
+                ):
+                    # setattr(self, <name>, ...): a constant name is just
+                    # another attribute; a computed one makes the surface
+                    # dynamic — can't vouch for the class at all
+                    if len(node.args) > 1 and isinstance(
+                        node.args[1], ast.Constant
+                    ) and isinstance(node.args[1].value, str):
+                        names.add(node.args[1].value)
+                    else:
+                        dynamic = True
+                        break
+            if dynamic:
+                result = None
+                break
+        else:
+            result = names
+    _ATTR_CACHE[cls] = result
+    return result
+
+
+def _annotation_classes(node: ast.AST, namespace: dict) -> typing.List[type]:
+    """
+    Resolve an annotation expression to the plain classes it names.
+    ``Optional[X]``/``Union[X, Y]`` yield their non-None members;
+    ``List[X]`` yields ``list``. Unresolvable pieces yield nothing.
+    """
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            node = ast.parse(node.value, mode="eval").body
+        except SyntaxError:
+            return []
+    if isinstance(node, (ast.Name, ast.Attribute)):
+        target = _resolve(node, namespace)
+        if isinstance(target, type):
+            return [target]
+        return []
+    if isinstance(node, ast.Subscript):
+        base = _resolve(node.value, namespace)
+        if base in (typing.Optional, typing.Union):
+            members = (
+                node.slice.elts if isinstance(node.slice, ast.Tuple) else [node.slice]
+            )
+            out: typing.List[type] = []
+            for member in members:
+                if isinstance(member, ast.Constant) and member.value is None:
+                    continue
+                out.extend(_annotation_classes(member, namespace))
+            return out
+        origin = typing.get_origin(base)
+        if isinstance(origin, type):
+            return [origin]
+        if isinstance(base, type):
+            return [base]
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):  # X | None
+        return _annotation_classes(node.left, namespace) + _annotation_classes(
+            node.right, namespace
+        )
+    return []
+
+
+# Nominal typing only applies where the annotations are authoritative: this
+# package and the (typeshed-typed) stdlib. Third-party science libs
+# (sklearn, pandas, jax, ...) ship no stubs — real mypy treats their classes
+# as Any, and annotating a duck-typed estimator parameter as BaseEstimator
+# is idiom, not a contract. `typing` specials (Any, ...) are never vouched.
+_NOMINAL_ROOTS = set(sys.stdlib_module_names) | {"gordo_tpu"}
+
+
+def _nominally_typed(cls: type) -> bool:
+    module_name = getattr(cls, "__module__", "") or ""
+    if module_name == "typing" or cls is object:
+        return False
+    return module_name.split(".")[0] in _NOMINAL_ROOTS
+
+
+def check_annotated_attributes(tree: ast.Module, module) -> typing.List[str]:
+    """
+    For every function parameter annotated with resolvable class(es):
+    attribute reads through that parameter must exist on at least one of
+    the classes (their known surface per ``_known_attrs``). Parameters
+    rebound inside the function are skipped.
+    """
+    namespace = dict(vars(builtins))
+    namespace.update(vars(module))
+    problems = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = fn.args
+        annotated: typing.Dict[str, typing.List[type]] = {}
+        for arg in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+            if arg.annotation is None:
+                continue
+            classes = _annotation_classes(arg.annotation, namespace)
+            if not classes:
+                continue
+            # every named class must be one we can vouch for, else skip
+            if not all(
+                _nominally_typed(cls) and _known_attrs(cls) is not None
+                for cls in classes
+            ):
+                continue
+            annotated[arg.arg] = classes
+        if not annotated:
+            continue
+        rebound = {
+            n.id
+            for n in ast.walk(fn)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del))
+        }
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and isinstance(node.ctx, ast.Load)
+            ):
+                continue
+            param = node.value.id
+            if param not in annotated or param in rebound:
+                continue
+            surfaces = [_known_attrs(cls) for cls in annotated[param]]
+            if any(surface is None or node.attr in surface for surface in surfaces):
+                continue
+            owners = ", ".join(cls.__name__ for cls in annotated[param])
+            problems.append(
+                f"line {node.lineno}: {param}.{node.attr} — no attribute "
+                f"{node.attr!r} on annotated type {owners}"
+            )
+    return problems
+
+
+# --------------------------------------------------------------------------
+# 6. return-annotation drift
+# --------------------------------------------------------------------------
+
+
+def _is_nonelike_annotation(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return node.value is None
+    if isinstance(node, ast.Attribute):  # typing.Any / t.Any spelling
+        return node.attr in ("Any", "object")
+    return isinstance(node, ast.Name) and node.id in ("None", "Any", "object")
+
+
+def _permits_bare_return(node: ast.AST) -> bool:
+    """Optional[...] / ``X | None`` / None / Any annotations allow ``return``."""
+    if _is_nonelike_annotation(node):
+        return True
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        try:
+            return _permits_bare_return(ast.parse(node.value, mode="eval").body)
+        except SyntaxError:
+            return True
+    if isinstance(node, ast.Subscript):
+        head = node.value
+        head_name = head.attr if isinstance(head, ast.Attribute) else (
+            head.id if isinstance(head, ast.Name) else None
+        )
+        if head_name == "Optional":
+            return True
+        if head_name == "Union":
+            members = (
+                node.slice.elts if isinstance(node.slice, ast.Tuple) else [node.slice]
+            )
+            return any(_permits_bare_return(m) for m in members)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
+        return _permits_bare_return(node.left) or _permits_bare_return(node.right)
+    return False
+
+
+def check_return_annotations(tree: ast.Module) -> typing.List[str]:
+    """
+    ``return`` (no value) inside ``def f(...) -> X`` for a concrete
+    non-Optional X, and ``return value`` inside ``-> None`` — both are
+    annotation/behavior drift mypy would flag. Generators are exempt
+    (their annotation describes the generator object, not ``return``).
+    """
+    problems = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if fn.returns is None:
+            continue
+        own_nodes = _own_scope_nodes(fn)
+        if any(isinstance(node, (ast.Yield, ast.YieldFrom)) for node in own_nodes):
+            continue
+        declares_none = (
+            isinstance(fn.returns, ast.Constant) and fn.returns.value is None
+        ) or (isinstance(fn.returns, ast.Name) and fn.returns.id == "None")
+        allows_bare = _permits_bare_return(fn.returns)
+        for node in own_nodes:
+            if not isinstance(node, ast.Return):
+                continue
+            if node.value is None or (
+                isinstance(node.value, ast.Constant) and node.value.value is None
+            ):
+                if not allows_bare:
+                    problems.append(
+                        f"line {node.lineno}: bare return in function "
+                        f"{fn.name!r} annotated -> "
+                        f"{ast.unparse(fn.returns)}"
+                    )
+            elif declares_none:
+                problems.append(
+                    f"line {node.lineno}: function {fn.name!r} annotated "
+                    f"-> None returns a value"
+                )
+    return problems
+
+
+def _own_scope_nodes(fn: ast.AST) -> typing.List[ast.AST]:
+    """All AST nodes in ``fn``'s body excluding nested function/lambda bodies."""
+    out: typing.List[ast.AST] = []
+    stack: typing.List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
 
 
 def _bindable(callee) -> typing.Optional[inspect.Signature]:
