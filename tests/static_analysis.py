@@ -246,9 +246,12 @@ def _known_attrs(cls: type) -> typing.Optional[typing.Set[str]]:
     if cls in _ATTR_CACHE:
         return _ATTR_CACHE[cls]
     result: typing.Optional[typing.Set[str]]
+    # only a PYTHON-level hook makes the surface dynamic; C slots
+    # (tuple.__getattribute__ etc.) are ordinary attribute lookup
     if any(
-        "__getattr__" in vars(base) or "__getattribute__" in vars(base)
+        isinstance(vars(base).get(hook), types.FunctionType)
         for base in cls.__mro__
+        for hook in ("__getattr__", "__getattribute__")
         if base is not object
     ):
         result = None
@@ -260,7 +263,14 @@ def _known_attrs(cls: type) -> typing.Optional[typing.Set[str]]:
                 continue
             try:
                 base_tree = ast.parse(textwrap.dedent(inspect.getsource(base)))
-            except (OSError, TypeError, SyntaxError, IndentationError):
+            except TypeError:
+                # C-implemented base (tuple, Exception, ...): no Python
+                # source means no `self.x = ...` sites to miss — dir()
+                # already covers it, keep going
+                continue
+            except (OSError, SyntaxError, IndentationError):
+                # Python base whose source we cannot read: it may assign
+                # instance attributes we cannot see — can't vouch
                 result = None
                 break
             dynamic = False
@@ -384,12 +394,16 @@ def check_annotated_attributes(tree: ast.Module, module) -> typing.List[str]:
             annotated[arg.arg] = classes
         if not annotated:
             continue
+        # own-scope nodes only: a nested def/lambda is its own scope (its
+        # params may shadow ours) and is visited as its own FunctionDef by
+        # the outer walk
+        own_nodes = _own_scope_nodes(fn)
         rebound = {
             n.id
-            for n in ast.walk(fn)
+            for n in own_nodes
             if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del))
         }
-        for node in ast.walk(fn):
+        for node in own_nodes:
             if not (
                 isinstance(node, ast.Attribute)
                 and isinstance(node.value, ast.Name)
@@ -423,39 +437,70 @@ def _is_nonelike_annotation(node: ast.AST) -> bool:
     return isinstance(node, ast.Name) and node.id in ("None", "Any", "object")
 
 
-def _permits_bare_return(node: ast.AST) -> bool:
+def _permits_bare_return(node: ast.AST, namespace: typing.Optional[dict] = None) -> bool:
     """Optional[...] / ``X | None`` / None / Any annotations allow ``return``."""
     if _is_nonelike_annotation(node):
         return True
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         try:
-            return _permits_bare_return(ast.parse(node.value, mode="eval").body)
+            parsed = ast.parse(node.value, mode="eval").body
         except SyntaxError:
             return True
+        return _permits_bare_return(parsed, namespace)
     if isinstance(node, ast.Subscript):
         head = node.value
         head_name = head.attr if isinstance(head, ast.Attribute) else (
             head.id if isinstance(head, ast.Name) else None
         )
+        # resolve aliases (``from typing import Optional as Opt``) through
+        # the live namespace when we have one; fall back to literal names
+        if namespace is not None:
+            target = _resolve(head, namespace)
+            if target is typing.Optional:
+                head_name = "Optional"
+            elif target is typing.Union:
+                head_name = "Union"
         if head_name == "Optional":
             return True
         if head_name == "Union":
             members = (
                 node.slice.elts if isinstance(node.slice, ast.Tuple) else [node.slice]
             )
-            return any(_permits_bare_return(m) for m in members)
+            return any(_permits_bare_return(m, namespace) for m in members)
     if isinstance(node, ast.BinOp) and isinstance(node.op, ast.BitOr):
-        return _permits_bare_return(node.left) or _permits_bare_return(node.right)
+        return _permits_bare_return(node.left, namespace) or _permits_bare_return(
+            node.right, namespace
+        )
     return False
 
 
-def check_return_annotations(tree: ast.Module) -> typing.List[str]:
+def _declares_none(node: ast.AST) -> bool:
+    """Annotations that literally promise None (quoted form included)."""
+    if isinstance(node, ast.Constant):
+        if node.value is None:
+            return True
+        if isinstance(node.value, str):
+            try:
+                return _declares_none(ast.parse(node.value, mode="eval").body)
+            except SyntaxError:
+                return False
+        return False
+    return isinstance(node, ast.Name) and node.id == "None"
+
+
+def check_return_annotations(tree: ast.Module, module=None) -> typing.List[str]:
     """
     ``return`` (no value) inside ``def f(...) -> X`` for a concrete
     non-Optional X, and ``return value`` inside ``-> None`` — both are
     annotation/behavior drift mypy would flag. Generators are exempt
     (their annotation describes the generator object, not ``return``).
+    With ``module`` given, Optional/Union aliases resolve through its
+    namespace.
     """
+    namespace = None
+    if module is not None:
+        namespace = dict(vars(builtins))
+        namespace.update(vars(module))
     problems = []
     for fn in ast.walk(tree):
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -465,10 +510,8 @@ def check_return_annotations(tree: ast.Module) -> typing.List[str]:
         own_nodes = _own_scope_nodes(fn)
         if any(isinstance(node, (ast.Yield, ast.YieldFrom)) for node in own_nodes):
             continue
-        declares_none = (
-            isinstance(fn.returns, ast.Constant) and fn.returns.value is None
-        ) or (isinstance(fn.returns, ast.Name) and fn.returns.id == "None")
-        allows_bare = _permits_bare_return(fn.returns)
+        declares_none = _declares_none(fn.returns)
+        allows_bare = _permits_bare_return(fn.returns, namespace)
         for node in own_nodes:
             if not isinstance(node, ast.Return):
                 continue

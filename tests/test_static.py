@@ -114,7 +114,7 @@ def test_annotated_attributes_resolve():
 def test_return_annotations_consistent():
     problems = {}
     for name, module in _importable_modules():
-        found = check_return_annotations(parse(module.__file__))
+        found = check_return_annotations(parse(module.__file__), module)
         if found:
             problems[name] = found
     assert not problems, f"return-annotation drift: {problems}"
@@ -155,6 +155,66 @@ def test_annotated_attribute_check_catches_typo():
     finally:
         _NOMINAL_ROOTS.discard(root)
     assert len(found) == 1 and "m.feild" in found[0], found
+
+
+def test_annotated_attribute_check_respects_nested_scopes():
+    """A nested def/lambda parameter shadowing an annotated outer
+    parameter is its own scope — accesses inside it must not be checked
+    against the outer annotation."""
+    import ast as _ast
+    import types as _types
+
+    source = (
+        "def outer(m: Probe):\n"
+        "    def inner(m):\n"
+        "        return m.whatever\n"
+        "    take = lambda m: m.anything\n"
+        "    return inner, take, m.field\n"
+    )
+
+    class Probe:
+        def __init__(self):
+            self.field = 1
+
+    fake = _types.ModuleType("fake")
+    fake.Probe = Probe
+    from static_analysis import _NOMINAL_ROOTS
+
+    root = Probe.__module__.split(".")[0]
+    _NOMINAL_ROOTS.add(root)
+    try:
+        assert check_annotated_attributes(_ast.parse(source), fake) == []
+    finally:
+        _NOMINAL_ROOTS.discard(root)
+
+
+def test_annotated_attribute_check_covers_c_based_classes():
+    """NamedTuples and other classes with C-implemented bases stay
+    vouchable: getsource failing on `tuple` must not blind the check."""
+    from gordo_tpu.data.sensor_tag import SensorTag
+
+    from static_analysis import _known_attrs
+
+    attrs = _known_attrs(SensorTag)
+    assert attrs is not None and "name" in attrs and "asset" in attrs
+
+
+def test_return_annotation_check_resolves_aliases():
+    import ast as _ast
+    import types as _types
+    import typing as _typing
+
+    fake = _types.ModuleType("fake")
+    fake.Opt = _typing.Optional
+    source = (
+        "from typing import Optional as Opt\n"
+        "def fine() -> Opt[int]:\n"
+        "    return\n"
+        "def bad_quoted() -> 'None':\n"
+        "    return 3\n"
+    )
+    found = check_return_annotations(_ast.parse(source), fake)
+    assert len(found) == 1 and "bad_quoted" in found[0], found
 
 
 def test_annotated_attribute_check_skips_dynamic_setattr_classes():
