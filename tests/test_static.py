@@ -8,10 +8,11 @@ typos and call-signature mismatches across the whole package.
 
 import compileall
 import importlib
-import pkgutil
 from pathlib import Path
 
 import gordo_tpu
+
+from tests.utils import package_module_names
 
 from static_analysis import (
     check_annotated_attributes,
@@ -32,8 +33,8 @@ OPTIONAL_THIRD_PARTY = {"influxdb", "psycopg2", "peewee", "mlflow", "azureml"}
 
 
 def _iter_module_names():
-    for info in pkgutil.walk_packages([str(PACKAGE_ROOT)], prefix="gordo_tpu."):
-        yield info.name
+    # filesystem-derived (tests/utils.py): no imports during collection
+    yield from package_module_names()
 
 
 def test_every_module_imports():
