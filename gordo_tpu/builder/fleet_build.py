@@ -249,11 +249,13 @@ class FleetModelBuilder:
         fit_duration = time.time() - start_fit
 
         # -- unstack into per-machine models + metadata -------------------
+        # one bulk device->host transfer for the whole bucket's params
+        host_params = trainer.unstack_all(params, len(fetched))
         out: Dict[str, Tuple[BaseEstimator, Machine]] = {}
         for i, (model, est, item) in enumerate(zip(models, estimators, fetched)):
             machine: Machine = item["machine"]
             est.spec_ = spec
-            est.params_ = trainer.unstack_params(params, i)
+            est.params_ = host_params[i]
             est.n_features_ = Xs_grid[i].shape[1]
             est.n_features_out_ = ys_grid[i].shape[1]
             val_series = getattr(trainer, "val_losses_", None)

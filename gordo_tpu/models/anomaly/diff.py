@@ -216,10 +216,11 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
             return frame.iloc[idxs] if isinstance(frame, pd.DataFrame) else frame[idxs]
 
         output: dict = {"estimator": [], "fit_time": [], "score_time": []}
+        host_params = trainer.unstack_all(params, len(folds))
         for i, (train_idx, test_idx) in enumerate(folds):
             estimator = clone(self.base_estimator)
             estimator.spec_ = spec
-            estimator.params_ = trainer.unstack_params(params, i)
+            estimator.params_ = host_params[i]
             estimator.n_features_ = Xn.shape[1]
             estimator.n_features_out_ = yn.shape[1]
             estimator._apply_fn = None

@@ -816,6 +816,24 @@ class FleetTrainer:
         return jax.tree.map(lambda a: np.asarray(a[index]), params)
 
     @staticmethod
+    def unstack_all(params: Any, n: int) -> List[Any]:
+        """
+        Host-materialize the stacked fleet params with ONE device->host
+        transfer and slice per machine on host. Per-machine
+        ``unstack_params`` pays a separate transfer per machine per leaf —
+        measured 58% of a 200-machine fleet build's wall-clock on a
+        tunneled link (~2,800 roundtrips); this is the bulk path the
+        builder uses instead.
+        """
+        host = jax.device_get(params)
+        # copy each slice: a view would pin the whole padded stack in
+        # memory for as long as any single machine's params live
+        return [
+            jax.tree.map(lambda a: np.ascontiguousarray(a[i]), host)
+            for i in range(n)
+        ]
+
+    @staticmethod
     def pad_fleet_size(n_machines: int, mesh: Optional[Mesh]) -> int:
         if mesh is None:
             return n_machines
