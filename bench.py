@@ -110,12 +110,11 @@ def clean_stale_tpu_locks():
 def bench_jax(n_timesteps: int, epochs: int) -> dict:
     import jax
 
-    try:
-        # persistent XLA compile cache: repeat runs skip the ~1-2 min warmup
-        jax.config.update("jax_compilation_cache_dir", XLA_CACHE_DIR)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-    except Exception as exc:
-        log(f"compilation cache unavailable: {exc}")
+    # persistent XLA compile cache: repeat runs skip the warmup compiles,
+    # including the many ~0.5s eager-op compiles the tunneled backend pays
+    from gordo_tpu.utils import enable_compile_cache
+
+    enable_compile_cache(XLA_CACHE_DIR)
 
     import numpy as np
 

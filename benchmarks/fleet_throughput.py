@@ -15,9 +15,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from gordo_tpu.utils import honor_jax_platforms_env
+from gordo_tpu.utils import enable_compile_cache, honor_jax_platforms_env
 
 honor_jax_platforms_env()
+enable_compile_cache()
 
 CONFIG_TPL = """
   - name: fleet-m{i}

@@ -27,9 +27,10 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from gordo_tpu.utils import honor_jax_platforms_env
+from gordo_tpu.utils import enable_compile_cache, honor_jax_platforms_env
 
 honor_jax_platforms_env()
+enable_compile_cache()
 
 
 def self_serve(tmp: str, port: int, n_machines: int = 1) -> str:

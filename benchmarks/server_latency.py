@@ -17,9 +17,10 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from gordo_tpu.utils import honor_jax_platforms_env
+from gordo_tpu.utils import enable_compile_cache, honor_jax_platforms_env
 
 honor_jax_platforms_env()
+enable_compile_cache()
 
 
 def build_collection(n_machines: int, tmp: str) -> str:

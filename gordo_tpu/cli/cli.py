@@ -22,7 +22,7 @@ import jinja2
 import numpy as np
 import yaml
 
-from gordo_tpu import __version__, serializer
+from gordo_tpu import __version__, serializer, utils
 from gordo_tpu.builder import FleetModelBuilder, ModelBuilder
 from gordo_tpu.cli.client import client as gordo_client
 from gordo_tpu.cli.custom_types import HostIP, key_value_par
@@ -158,6 +158,7 @@ def build(
     (reference: cli.py:80-206; env-driven in pods: MACHINE, OUTPUT_DIR).
     """
     try:
+        utils.enable_compile_cache()
         if model_parameter and isinstance(machine_config["model"], str):
             machine_config["model"] = expand_model(
                 machine_config["model"], dict(model_parameter)
@@ -208,6 +209,7 @@ def build_fleet(
     configs; artifacts land at OUTPUT-DIR/<machine-name>/.
     """
     try:
+        utils.enable_compile_cache()
         machines = []
         for machine_config in machines_config:
             if model_parameter and isinstance(machine_config["model"], str):

@@ -826,10 +826,11 @@ class FleetTrainer:
         builder uses instead.
         """
         host = jax.device_get(params)
-        # copy each slice: a view would pin the whole padded stack in
-        # memory for as long as any single machine's params live
+        # explicit copy per slice: a view would pin the whole padded stack
+        # in memory for as long as any single machine's params live
+        # (ascontiguousarray is a no-op on contiguous slices)
         return [
-            jax.tree.map(lambda a: np.ascontiguousarray(a[i]), host)
+            jax.tree.map(lambda a: np.asarray(a[i]).copy(), host)
             for i in range(n)
         ]
 

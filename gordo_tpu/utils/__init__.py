@@ -4,6 +4,7 @@ Small shared utilities (reference parity: gordo/util/__init__.py:1-3).
 
 from .utils import (
     capture_args,
+    enable_compile_cache,
     honor_jax_platforms_env,
     replace_all_non_ascii_chars_with_default,
 )
@@ -12,6 +13,7 @@ from .compat import normalize_frequency
 
 __all__ = [
     "capture_args",
+    "enable_compile_cache",
     "honor_jax_platforms_env",
     "replace_all_non_ascii_chars_with_default",
     "disk_registry",

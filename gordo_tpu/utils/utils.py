@@ -5,7 +5,10 @@ gordo/util/__init__.py (replace_all_non_ascii_chars).
 
 import functools
 import inspect
+import logging
 import re
+
+logger = logging.getLogger(__name__)
 
 
 def capture_args(init):
@@ -59,3 +62,38 @@ def honor_jax_platforms_env() -> None:
             return
 
         jax.config.update("jax_platforms", "cpu")
+
+
+def enable_compile_cache(
+    directory: "str | None" = None, min_compile_seconds: float = 0.5
+) -> None:
+    """
+    Point JAX's persistent compilation cache at a disk directory so repeat
+    processes skip re-compiling — including the many ~0.5s eager-op
+    compiles a tunneled TPU backend pays per build (sub-second programs
+    fall under JAX's default 1s persistence threshold and recompile every
+    run without this).
+
+    Directory resolution: explicit argument, else ``GORDO_XLA_CACHE_DIR``
+    (set it to the empty string to disable), else a shared temp-dir
+    default. Failures (read-only filesystem, old jax) are logged and
+    ignored — the cache is an optimization, never a requirement.
+    """
+    import os
+    import tempfile
+
+    if directory is None:
+        directory = os.environ.get("GORDO_XLA_CACHE_DIR")
+    if directory == "":
+        return
+    if directory is None:
+        directory = os.path.join(tempfile.gettempdir(), "gordo_tpu_xla_cache")
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", directory)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_compile_seconds)
+        )
+    except Exception as exc:  # noqa: BLE001 - cache is best-effort
+        logger.warning("Persistent XLA compile cache unavailable: %s", exc)
