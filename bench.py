@@ -35,8 +35,6 @@ import subprocess
 import sys
 import time
 
-XLA_CACHE_DIR = "/tmp/gordo_tpu_xla_cache"
-
 # workload: "50-tag plant" LSTM-AE (BASELINE.json config #2/#3 shape)
 N_SENSORS = 50
 LOOKBACK = 64
@@ -114,7 +112,7 @@ def bench_jax(n_timesteps: int, epochs: int) -> dict:
     # including the many ~0.5s eager-op compiles the tunneled backend pays
     from gordo_tpu.utils import enable_compile_cache
 
-    enable_compile_cache(XLA_CACHE_DIR)
+    enable_compile_cache()
 
     import numpy as np
 

@@ -87,7 +87,12 @@ def enable_compile_cache(
     if directory == "":
         return
     if directory is None:
-        directory = os.path.join(tempfile.gettempdir(), "gordo_tpu_xla_cache")
+        # uid-scoped: a world-shared fixed path would let another user on
+        # the host own the directory (losing the cache at best, feeding
+        # this process foreign compiled executables at worst)
+        directory = os.path.join(
+            tempfile.gettempdir(), f"gordo_tpu_xla_cache_{os.getuid()}"
+        )
     try:
         import jax
 

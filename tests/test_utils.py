@@ -79,3 +79,32 @@ def test_replace_non_ascii():
     assert replace_all_non_ascii_chars_with_default("abcæøå123") == "abc---123"
     assert replace_all_non_ascii_chars_with_default("åbc", "_") == "_bc"
     assert replace_all_non_ascii_chars_with_default("plain") == "plain"
+
+
+def test_enable_compile_cache_env_resolution(monkeypatch, tmp_path):
+    """Explicit arg > GORDO_XLA_CACHE_DIR > tempdir default; empty string
+    disables without touching jax config."""
+    import jax
+
+    from gordo_tpu.utils import enable_compile_cache
+
+    prior_dir = jax.config.jax_compilation_cache_dir
+    prior_floor = jax.config.jax_persistent_cache_min_compile_time_secs
+    try:
+        target = str(tmp_path / "cache-a")
+        enable_compile_cache(target)
+        assert jax.config.jax_compilation_cache_dir == target
+
+        env_target = str(tmp_path / "cache-b")
+        monkeypatch.setenv("GORDO_XLA_CACHE_DIR", env_target)
+        enable_compile_cache()
+        assert jax.config.jax_compilation_cache_dir == env_target
+
+        monkeypatch.setenv("GORDO_XLA_CACHE_DIR", "")
+        enable_compile_cache()  # disabled: must leave the previous setting
+        assert jax.config.jax_compilation_cache_dir == env_target
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prior_dir)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prior_floor
+        )
