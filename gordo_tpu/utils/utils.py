@@ -75,9 +75,13 @@ def enable_compile_cache(
     run without this).
 
     Directory resolution: explicit argument, else ``GORDO_XLA_CACHE_DIR``
-    (set it to the empty string to disable), else a shared temp-dir
-    default. Failures (read-only filesystem, old jax) are logged and
-    ignored — the cache is an optimization, never a requirement.
+    (set it to the empty string to disable), else a per-user temp-dir
+    default that is created 0700 and must be OWNED by this uid — an
+    attacker-pre-created directory in sticky /tmp would otherwise feed
+    this process foreign compiled executables, so a foreign-owned default
+    disables the cache instead. Failures (read-only filesystem, old jax)
+    are logged and ignored — the cache is an optimization, never a
+    requirement.
     """
     import os
     import tempfile
@@ -87,12 +91,20 @@ def enable_compile_cache(
     if directory == "":
         return
     if directory is None:
-        # uid-scoped: a world-shared fixed path would let another user on
-        # the host own the directory (losing the cache at best, feeding
-        # this process foreign compiled executables at worst)
         directory = os.path.join(
             tempfile.gettempdir(), f"gordo_tpu_xla_cache_{os.getuid()}"
         )
+        try:
+            os.makedirs(directory, mode=0o700, exist_ok=True)
+            if os.stat(directory).st_uid != os.getuid():
+                logger.warning(
+                    "Compile cache dir %s is owned by another user; "
+                    "skipping the persistent cache", directory,
+                )
+                return
+        except OSError as exc:
+            logger.warning("Cannot prepare compile cache dir: %s", exc)
+            return
     try:
         import jax
 
