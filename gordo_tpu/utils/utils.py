@@ -95,13 +95,21 @@ def enable_compile_cache(
             tempfile.gettempdir(), f"gordo_tpu_xla_cache_{os.getuid()}"
         )
         try:
+            import stat as stat_mod
+
             os.makedirs(directory, mode=0o700, exist_ok=True)
-            if os.stat(directory).st_uid != os.getuid():
+            st = os.lstat(directory)
+            # lstat + S_ISDIR rejects attacker-planted symlinks in sticky
+            # /tmp (stat would follow them into attacker-writable storage)
+            if not stat_mod.S_ISDIR(st.st_mode) or st.st_uid != os.getuid():
                 logger.warning(
-                    "Compile cache dir %s is owned by another user; "
-                    "skipping the persistent cache", directory,
+                    "Compile cache dir %s is a symlink or owned by another "
+                    "user; skipping the persistent cache", directory,
                 )
                 return
+            # tighten a pre-existing dir created under a loose umask
+            if st.st_mode & 0o077:
+                os.chmod(directory, 0o700)
         except OSError as exc:
             logger.warning("Cannot prepare compile cache dir: %s", exc)
             return

@@ -547,3 +547,26 @@ def test_fleet_builder_fallback_non_jax(tmp_path):
     results = FleetModelBuilder(machines).build()
     model, machine = results[0]
     assert machine.metadata.build_metadata.model.model_offset == 0
+
+
+def test_bucket_unstack_uses_one_bulk_transfer(monkeypatch):
+    """Param unstacking must stay ONE device_get per bucket: the
+    per-machine-per-leaf variant cost 58% of a 200-machine build's
+    wall-clock on a tunneled link (docs/performance.md)."""
+    import jax
+    import jax.numpy as jnp
+
+    from gordo_tpu.parallel.fleet import FleetTrainer
+
+    calls = {"n": 0}
+    real_device_get = jax.device_get
+
+    def counting_device_get(tree):
+        calls["n"] += 1
+        return real_device_get(tree)
+
+    monkeypatch.setattr(jax, "device_get", counting_device_get)
+    stacked = {"w": jnp.ones((16, 4, 4)), "b": jnp.zeros((16, 4))}
+    out = FleetTrainer.unstack_all(stacked, 16)
+    assert calls["n"] == 1
+    assert len(out) == 16 and out[3]["w"].shape == (4, 4)

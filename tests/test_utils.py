@@ -108,3 +108,54 @@ def test_enable_compile_cache_env_resolution(monkeypatch, tmp_path):
         jax.config.update(
             "jax_persistent_cache_min_compile_time_secs", prior_floor
         )
+
+
+def _assert_cache_default_skipped(monkeypatch, tmp_path):
+    """Helper: with tempdir redirected at tmp_path, the default-dir path
+    must leave jax's cache config untouched."""
+    import jax
+
+    from gordo_tpu.utils import enable_compile_cache
+
+    monkeypatch.delenv("GORDO_XLA_CACHE_DIR", raising=False)
+    monkeypatch.setattr("tempfile.gettempdir", lambda: str(tmp_path))
+    prior = jax.config.jax_compilation_cache_dir
+    sentinel = "/nonexistent-gordo-sentinel"
+    try:
+        jax.config.update("jax_compilation_cache_dir", sentinel)
+        enable_compile_cache()
+        assert jax.config.jax_compilation_cache_dir == sentinel
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prior)
+
+
+def test_enable_compile_cache_skips_foreign_owned_default(monkeypatch, tmp_path):
+    """A default cache dir owned by another uid must disable the cache,
+    not deserialize foreign compiled executables. Simulated by patching
+    os.lstat so the branch runs for any test uid."""
+    import os
+
+    real_lstat = os.lstat
+
+    def foreign_lstat(path, *a, **kw):
+        st = real_lstat(path, *a, **kw)
+        if str(path).endswith(f"gordo_tpu_xla_cache_{os.getuid()}"):
+            return os.stat_result((st.st_mode, st.st_ino, st.st_dev,
+                                   st.st_nlink, 12345, 12345, st.st_size,
+                                   st.st_atime, st.st_mtime, st.st_ctime))
+        return st
+
+    monkeypatch.setattr("os.lstat", foreign_lstat)
+    _assert_cache_default_skipped(monkeypatch, tmp_path)
+
+
+def test_enable_compile_cache_rejects_symlinked_default(monkeypatch, tmp_path):
+    """An attacker-planted symlink at the default path must disable the
+    cache (lstat sees the link, not the target)."""
+    import os
+
+    target = tmp_path / "attacker-writable"
+    target.mkdir()
+    link = tmp_path / f"gordo_tpu_xla_cache_{os.getuid()}"
+    link.symlink_to(target)
+    _assert_cache_default_skipped(monkeypatch, tmp_path)
