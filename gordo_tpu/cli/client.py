@@ -24,28 +24,74 @@ from gordo_tpu.client.forwarders import ForwardPredictionsIntoInflux
 from gordo_tpu.data.providers import GordoBaseDataProvider
 
 
+def _flags(table):
+    """Apply an option table bottom-up so --help shows table order."""
+
+    def apply(command):
+        for flag, attrs in reversed(table):
+            command = click.option(flag, **attrs)(command)
+        return command
+
+    return apply
+
+
+# every subcommand accepts --target the same way
+_TARGET = ("--target", dict(
+    multiple=True, default=[],
+    help="Machines to target; defaults to all machines in the project"))
+
+_GROUP_FLAGS = [
+    ("--project", dict(help="The project to target")),
+    ("--host", dict(default="localhost", help="The host the server is running on")),
+    ("--port", dict(default=443, help="Port the server is running on")),
+    ("--scheme", dict(default="https", help="tcp/http/https")),
+    ("--batch-size", dict(default=100000, help="How many samples to send")),
+    ("--parallelism", dict(default=10, help="Maximum concurrent jobs to run")),
+    ("--metadata", dict(
+        type=key_value_par, multiple=True, default=(),
+        help="key,value pair sent as metadata labels with forwarded "
+             "predictions; repeatable.")),
+    ("--session-config", dict(
+        type=yaml.safe_load, default="{}",
+        help="JSON/YAML of attributes to set on the requests.Session, e.g. "
+             "auth headers: --session-config \"{'headers': {'API-KEY': 'foo'}}\"")),
+]
+
+_PREDICT_FLAGS = [
+    _TARGET,
+    ("--data-provider", dict(
+        type=DataProviderParam(), envvar="DATA_PROVIDER",
+        help="DataProvider JSON/YAML (requires a 'type' key).")),
+    ("--output-dir", dict(
+        type=click.Path(exists=True),
+        help="Save output prediction dataframes in a directory")),
+    ("--influx-uri", dict(
+        help="<username>:<password>@<host>:<port>/<optional-path>/<db_name>")),
+    ("--influx-api-key", dict(help="Key for the destination influx")),
+    ("--influx-recreate-db", dict(
+        is_flag=True, default=False,
+        help="Recreate the destination DB before writing")),
+    ("--forward-resampled-sensors", dict(
+        is_flag=True, default=False,
+        help="Forward the resampled sensor values")),
+    ("--n-retries", dict(
+        type=int, default=5,
+        help="Times the client should retry failed predictions")),
+    ("--parquet/--no-parquet", dict(
+        default=True, help="Use parquet serialization to/from the server")),
+    ("--fleet/--no-fleet", dict(
+        default=False,
+        help="Batch groups of machines into single fleet-endpoint requests "
+             "(one vmapped device dispatch per group; JSON or parquet per "
+             "--parquet)")),
+    ("--fleet-group-size", dict(
+        type=int, default=8,
+        help="Machines per fleet request when --fleet is given")),
+]
+
+
 @click.group("client")
-@click.option("--project", help="The project to target")
-@click.option("--host", help="The host the server is running on", default="localhost")
-@click.option("--port", help="Port the server is running on", default=443)
-@click.option("--scheme", help="tcp/http/https", default="https")
-@click.option("--batch-size", help="How many samples to send", default=100000)
-@click.option("--parallelism", help="Maximum concurrent jobs to run", default=10)
-@click.option(
-    "--metadata",
-    type=key_value_par,
-    multiple=True,
-    default=(),
-    help="key,value pair sent as metadata labels with forwarded "
-    "predictions; repeatable.",
-)
-@click.option(
-    "--session-config",
-    type=yaml.safe_load,
-    default="{}",
-    help="JSON/YAML of attributes to set on the requests.Session, e.g. "
-    "auth headers: --session-config \"{'headers': {'API-KEY': 'foo'}}\"",
-)
+@_flags(_GROUP_FLAGS)
 @click.pass_context
 def client(ctx: click.Context, *args, **kwargs):
     """Client sub-commands (predict / metadata / download-model)."""
@@ -59,66 +105,14 @@ def client(ctx: click.Context, *args, **kwargs):
     ctx.obj = {"args": args, "kwargs": kwargs}
 
 
+def _make_client(ctx: click.Context) -> Client:
+    return Client(*ctx.obj["args"], **ctx.obj["kwargs"])
+
+
 @click.command("predict")
 @click.argument("start", type=IsoFormatDateTime())
 @click.argument("end", type=IsoFormatDateTime())
-@click.option(
-    "--target",
-    multiple=True,
-    default=[],
-    help="Machines to target; defaults to all machines in the project",
-)
-@click.option(
-    "--data-provider",
-    type=DataProviderParam(),
-    envvar="DATA_PROVIDER",
-    help="DataProvider JSON/YAML (requires a 'type' key).",
-)
-@click.option(
-    "--output-dir",
-    type=click.Path(exists=True),
-    help="Save output prediction dataframes in a directory",
-)
-@click.option(
-    "--influx-uri",
-    help="<username>:<password>@<host>:<port>/<optional-path>/<db_name>",
-)
-@click.option("--influx-api-key", help="Key for the destination influx")
-@click.option(
-    "--influx-recreate-db",
-    is_flag=True,
-    default=False,
-    help="Recreate the destination DB before writing",
-)
-@click.option(
-    "--forward-resampled-sensors",
-    is_flag=True,
-    default=False,
-    help="Forward the resampled sensor values",
-)
-@click.option(
-    "--n-retries",
-    type=int,
-    default=5,
-    help="Times the client should retry failed predictions",
-)
-@click.option(
-    "--parquet/--no-parquet",
-    default=True,
-    help="Use parquet serialization to/from the server",
-)
-@click.option(
-    "--fleet/--no-fleet",
-    default=False,
-    help="Batch groups of machines into single fleet-endpoint requests "
-    "(one vmapped device dispatch per group; JSON or parquet per --parquet)",
-)
-@click.option(
-    "--fleet-group-size",
-    type=int,
-    default=8,
-    help="Machines per fleet request when --fleet is given",
-)
+@_flags(_PREDICT_FLAGS)
 @click.pass_context
 def predict(
     ctx: click.Context,
@@ -138,14 +132,12 @@ def predict(
 ):
     """Run predictions for [START, END] (reference: cli/client.py:60-167)."""
     ctx.obj["kwargs"].update(
-        {
-            "data_provider": data_provider,
-            "forward_resampled_sensors": forward_resampled_sensors,
-            "n_retries": n_retries,
-            "use_parquet": parquet,
-        }
+        data_provider=data_provider,
+        forward_resampled_sensors=forward_resampled_sensors,
+        n_retries=n_retries,
+        use_parquet=parquet,
     )
-    client = Client(*ctx.obj["args"], **ctx.obj["kwargs"])
+    client = _make_client(ctx)
     if influx_uri is not None:
         client.prediction_forwarder = ForwardPredictionsIntoInflux(
             destination_influx_uri=influx_uri,
@@ -169,25 +161,19 @@ def predict(
             click.secho(err_msg, fg="red")
 
     if output_dir is not None:
-        for name, prediction_df, _err_msgs in predictions:
-            prediction_df.to_csv(
+        for name, frame, _err_msgs in predictions:
+            frame.to_csv(
                 os.path.join(output_dir, f"{name}.csv.gz"), compression="gzip"
             )
     sys.exit(exit_code)
 
 
 @click.command("metadata")
-@click.option(
-    "--output-file",
-    type=click.File(mode="w"),
-    help="Optional output file to save metadata",
-)
-@click.option(
-    "--target",
-    multiple=True,
-    default=[],
-    help="Machines to target; defaults to all machines in the project",
-)
+@_flags([
+    ("--output-file", dict(
+        type=click.File(mode="w"), help="Optional output file to save metadata")),
+    _TARGET,
+])
 @click.pass_context
 def metadata(
     ctx: click.Context,
@@ -195,13 +181,11 @@ def metadata(
     target: typing.List[str],
 ):
     """Fetch machine metadata (reference: cli/client.py:170-201)."""
-    client = Client(*ctx.obj["args"], **ctx.obj["kwargs"])
-    meta = {
-        k: v.to_dict() for k, v in client.get_metadata(targets=list(target)).items()
-    }
+    fetched = _make_client(ctx).get_metadata(targets=list(target))
+    meta = {name: record.to_dict() for name, record in fetched.items()}
     if output_file:
         json.dump(meta, output_file)
-        click.secho(f"Saved metadata json to file: '{output_file}'")
+        click.secho(f"Saved metadata json to file: '{output_file.name}'")
     else:
         pprint(meta)
     return meta
@@ -209,17 +193,11 @@ def metadata(
 
 @click.command("download-model")
 @click.argument("output-dir", type=click.Path(exists=True))
-@click.option(
-    "--target",
-    multiple=True,
-    default=[],
-    help="Machines to target; defaults to all machines in the project",
-)
+@_flags([_TARGET])
 @click.pass_context
 def download_model(ctx: click.Context, output_dir: str, target: typing.List[str]):
     """Download models into per-machine dirs (reference: cli/client.py:204-232)."""
-    client = Client(*ctx.obj["args"], **ctx.obj["kwargs"])
-    models = client.download_model(targets=list(target))
+    models = _make_client(ctx).download_model(targets=list(target))
     for model_name, model in models.items():
         model_out_dir = os.path.join(output_dir, model_name)
         os.mkdir(model_out_dir)

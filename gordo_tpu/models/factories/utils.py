@@ -23,14 +23,17 @@ def hourglass_calc_dims(
     >>> hourglass_calc_dims(0.5, 1, 10)
     (5,)
     """
-    if not (1 >= compression_factor >= 0):
-        raise ValueError("compression_factor must be 0 <= compression_factor <= 1")
+    if not 0 <= compression_factor <= 1:
+        raise ValueError(
+            f"compression_factor must lie in [0, 1], got {compression_factor}"
+        )
     if encoding_layers < 1:
-        raise ValueError("encoding_layers must be >= 1")
-    smallest_layer = max(min(math.ceil(compression_factor * n_features), n_features), 1)
-    average_slope = (n_features - smallest_layer) / encoding_layers
+        raise ValueError(f"encoding_layers must be >= 1, got {encoding_layers}")
+    smallest = math.ceil(compression_factor * n_features)
+    smallest = max(1, min(smallest, n_features))
+    step = (n_features - smallest) / encoding_layers
     return tuple(
-        round(n_features - i * average_slope) for i in range(1, encoding_layers + 1)
+        round(n_features - depth * step) for depth in range(1, encoding_layers + 1)
     )
 
 
@@ -38,8 +41,7 @@ def check_dim_func_len(prefix: str, dim: Tuple[int, ...], func: Tuple[str, ...])
     """Dims and activation-function tuples must have equal length."""
     if len(dim) != len(func):
         raise ValueError(
-            f"The length (i.e. the number of network layers) of {prefix}_dim "
-            f"({len(dim)}) and {prefix}_func ({len(func)}) must be equal. If only "
-            f"{prefix}_dim or {prefix}_func was passed, ensure that its length "
-            f"matches that of the {prefix} parameter not passed."
+            f"{prefix}_dim has {len(dim)} layers but {prefix}_func has "
+            f"{len(func)} — each layer needs exactly one activation, so the "
+            f"two tuples must be the same length."
         )

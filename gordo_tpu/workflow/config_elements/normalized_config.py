@@ -16,18 +16,26 @@ from gordo_tpu.machine.validators import fix_runtime
 from gordo_tpu.workflow.helpers import patch_dict
 
 
+def _pod_resources(req_mem: int, req_cpu: int, lim_mem: int, lim_cpu: int) -> dict:
+    """k8s resources block: (requests, limits) × (memory, cpu)."""
+    return {
+        "resources": {
+            "requests": {"memory": req_mem, "cpu": req_cpu},
+            "limits": {"memory": lim_mem, "cpu": lim_cpu},
+        }
+    }
+
+
 def _calculate_influx_resources(nr_of_machines: int) -> dict:
     """Influx sizing scales with machine count (reference: :10-21)."""
-    return {
-        "requests": {
-            "memory": min(3000 + (220 * nr_of_machines), 28000),
-            "cpu": min(500 + (10 * nr_of_machines), 4000),
-        },
-        "limits": {
-            "memory": min(3000 + (220 * nr_of_machines), 48000),
-            "cpu": 10000 + (20 * nr_of_machines),
-        },
-    }
+    memory = 3000 + 220 * nr_of_machines
+    sized = _pod_resources(
+        min(memory, 28000),
+        min(500 + 10 * nr_of_machines, 4000),
+        min(memory, 48000),
+        10000 + 20 * nr_of_machines,
+    )
+    return sized["resources"]
 
 
 class NormalizedConfig:
@@ -35,23 +43,10 @@ class NormalizedConfig:
     DEFAULT_CONFIG_GLOBALS: dict = {
         "runtime": {
             "reporters": [],
-            "server": {
-                "resources": {
-                    "requests": {"memory": 3000, "cpu": 1000},
-                    "limits": {"memory": 6000, "cpu": 2000},
-                }
-            },
-            "prometheus_metrics_server": {
-                "resources": {
-                    "requests": {"memory": 200, "cpu": 100},
-                    "limits": {"memory": 1000, "cpu": 200},
-                }
-            },
+            "server": _pod_resources(3000, 1000, 6000, 2000),
+            "prometheus_metrics_server": _pod_resources(200, 100, 1000, 200),
             "builder": {
-                "resources": {
-                    "requests": {"memory": 3900, "cpu": 1001},
-                    "limits": {"memory": 3900, "cpu": 1001},
-                },
+                **_pod_resources(3900, 1001, 3900, 1001),
                 "remote_logging": {"enable": False},
                 # TPU fleet-builder knobs (no reference equivalent): machines
                 # per build pod and the TPU accelerator type requested for it
@@ -59,10 +54,7 @@ class NormalizedConfig:
                 "tpu": {"enable": False, "accelerator": "v5litepod-16"},
             },
             "client": {
-                "resources": {
-                    "requests": {"memory": 3500, "cpu": 100},
-                    "limits": {"memory": 4000, "cpu": 2000},
-                },
+                **_pod_resources(3500, 100, 4000, 2000),
                 "max_instances": 30,
             },
             "influx": {"enable": True},
