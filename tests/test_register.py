@@ -1,0 +1,63 @@
+"""
+Factory-registry behavior (reference parity:
+tests/gordo/machine/model/test_register.py): registration under a type,
+the n_features signature gate, legacy Keras type-name aliasing, and the
+shipped factories actually being resolvable by kind.
+"""
+
+import pytest
+
+from gordo_tpu.models.register import (
+    TYPE_ALIASES,
+    canonical_type,
+    register_model_builder,
+)
+
+
+def test_register_and_lookup():
+    @register_model_builder(type="AutoEncoder")
+    def probe_architecture(n_features: int, **kwargs):
+        return ("spec", n_features)
+
+    try:
+        registered = register_model_builder.factories["AutoEncoder"]
+        assert registered["probe_architecture"] is probe_architecture
+        assert probe_architecture(n_features=4) == ("spec", 4)
+    finally:
+        del register_model_builder.factories["AutoEncoder"]["probe_architecture"]
+
+
+def test_register_rejects_builder_without_n_features():
+    with pytest.raises(ValueError, match="n_features"):
+
+        @register_model_builder(type="AutoEncoder")
+        def bad_architecture(size: int):
+            return None
+
+
+def test_legacy_type_names_alias_to_new():
+    for legacy, current in TYPE_ALIASES.items():
+        assert canonical_type(legacy) == current
+    assert canonical_type("AutoEncoder") == "AutoEncoder"
+
+    @register_model_builder(type="KerasAutoEncoder")
+    def legacy_registered(n_features: int, **kwargs):
+        return None
+
+    try:
+        # registered under the CANONICAL type, so both dialects resolve it
+        assert (
+            "legacy_registered" in register_model_builder.factories["AutoEncoder"]
+        )
+    finally:
+        del register_model_builder.factories["AutoEncoder"]["legacy_registered"]
+
+
+def test_shipped_factories_are_registered():
+    # importing the factories package populates the registry
+    import gordo_tpu.models.factories  # noqa: F401
+
+    reg = register_model_builder.factories
+    assert "feedforward_hourglass" in reg["AutoEncoder"]
+    assert "lstm_hourglass" in reg["LSTMAutoEncoder"]
+    assert "lstm_hourglass" in reg["LSTMForecast"]
