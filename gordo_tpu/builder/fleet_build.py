@@ -203,6 +203,22 @@ class FleetModelBuilder:
         )
         spec = proto_est._build_spec()
         lookahead = proto_est.lookahead if spec.windowed else 0
+
+        # fail loudly BEFORE training if any machine cannot fill one window
+        # (the solo path fails at its predict; masks would otherwise let a
+        # short machine "train" on nothing and crash only at serve time)
+        if spec.windowed:
+            min_rows = spec.lookback_window + lookahead
+            for item, X_t in zip(fetched, Xs_t):
+                if len(X_t) < min_rows:
+                    from gordo_tpu.data.base import InsufficientDataError
+
+                    raise InsufficientDataError(
+                        f"Machine {item['machine'].name}: {len(X_t)} rows "
+                        f"after transforms; this windowed model needs at "
+                        f"least {min_rows} (lookback {spec.lookback_window} "
+                        f"+ lookahead {lookahead})"
+                    )
         fit_args = proto_est.extract_supported_fit_args(proto_est.kwargs)
         epochs = int(fit_args.get("epochs", 1))
         batch_size = int(fit_args.get("batch_size", 32))
@@ -251,6 +267,7 @@ class FleetModelBuilder:
         # -- unstack into per-machine models + metadata -------------------
         # one bulk device->host transfer for the whole bucket's params
         host_params = trainer.unstack_all(params, len(fetched))
+        bucket_offset: Optional[int] = None
         out: Dict[str, Tuple[BaseEstimator, Machine]] = {}
         for i, (model, est, item) in enumerate(zip(models, estimators, fetched)):
             machine: Machine = item["machine"]
@@ -284,7 +301,14 @@ class FleetModelBuilder:
                 model.scaler.fit(item["y"])
                 self._apply_thresholds(model, fold_records, i)
 
-            offset = ModelBuilder._determine_offset(model, item["X"])
+            # model_offset = rows the prediction is shorter than the input:
+            # pure window arithmetic (lookback/lookahead) for this bucket's
+            # single architecture, independent of params and row count — so
+            # probe it once per bucket instead of paying a full predict
+            # (one device roundtrip per machine on tunneled links)
+            if bucket_offset is None:
+                bucket_offset = ModelBuilder._determine_offset(model, item["X"])
+            offset = bucket_offset
             scores = {
                 metric: folds for metric, folds in fold_records["scores"][i].items()
             }

@@ -29,16 +29,12 @@ def _pod_resources(req_mem: int, req_cpu: int, lim_mem: int, lim_cpu: int) -> di
 def _calculate_influx_resources(nr_of_machines: int) -> dict:
     """Influx sizing scales with machine count (reference: :10-21)."""
     memory = 3000 + 220 * nr_of_machines
-    return {
-        "requests": {
-            "memory": min(memory, 28000),
-            "cpu": min(500 + 10 * nr_of_machines, 4000),
-        },
-        "limits": {
-            "memory": min(memory, 48000),
-            "cpu": 10000 + 20 * nr_of_machines,
-        },
-    }
+    return _pod_resources(
+        min(memory, 28000),
+        min(500 + 10 * nr_of_machines, 4000),
+        min(memory, 48000),
+        10000 + 20 * nr_of_machines,
+    )["resources"]
 
 
 class NormalizedConfig:

@@ -570,3 +570,73 @@ def test_bucket_unstack_uses_one_bulk_transfer(monkeypatch):
     out = FleetTrainer.unstack_all(stacked, 16)
     assert calls["n"] == 1
     assert len(out) == 16 and out[3]["w"].shape == (4, 4)
+
+
+def test_fleet_offset_matches_solo_build():
+    """model_offset is window arithmetic, identical for every machine in a
+    bucket — the fleet builder probes it once per bucket; it must equal
+    what a solo build of the same machine reports (lookback-1 for an
+    LSTM-AE, 0 for the feedforward path)."""
+    from gordo_tpu.builder.build_model import ModelBuilder
+
+    lookback = 6
+    machines = [
+        Machine(
+            name=f"off-m{i}",
+            model={
+                "gordo_tpu.models.LSTMAutoEncoder": {
+                    "kind": "lstm_hourglass",
+                    "lookback_window": lookback,
+                    "epochs": 1,
+                }
+            },
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": "2017-12-25 06:00:00Z",
+                "train_end_date": "2017-12-26 06:00:00Z",
+                "tags": [[f"Tag {t}", None] for t in range(3)],
+            },
+            project_name="t",
+        )
+        for i in range(3)
+    ]
+    fleet_results = FleetModelBuilder(machines).build()
+    solo_model, solo_machine = ModelBuilder(machines[0]).build()
+
+    solo_offset = solo_machine.metadata.build_metadata.model.model_offset
+    assert solo_offset == lookback - 1
+    for _model, machine in fleet_results:
+        assert (
+            machine.metadata.build_metadata.model.model_offset == solo_offset
+        )
+
+
+def test_fleet_build_rejects_machine_too_short_for_window():
+    """A machine whose (resampled) data cannot fill one lookback window
+    must fail the build loudly and by name — regardless of its position
+    in the bucket — not train under masks and crash at serve time."""
+    from gordo_tpu.data.base import InsufficientDataError
+
+    def lstm_machine(name, hours):
+        return Machine(
+            name=name,
+            model={
+                "gordo_tpu.models.LSTMAutoEncoder": {
+                    "kind": "lstm_hourglass",
+                    "lookback_window": 12,
+                    "epochs": 1,
+                }
+            },
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": "2017-12-25 06:00:00Z",
+                "train_end_date": f"2017-12-25 {6 + hours:02d}:00:00Z",
+                "tags": [[f"Tag {t}", None] for t in range(3)],
+            },
+            project_name="t",
+        )
+
+    # second machine: 1 hour of 10-min samples = ~6 rows < lookback 12
+    machines = [lstm_machine("long-enough", 12), lstm_machine("too-short", 1)]
+    with pytest.raises(InsufficientDataError, match="too-short"):
+        FleetModelBuilder(machines).build()
