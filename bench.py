@@ -260,6 +260,33 @@ def compute_mfu(rate_windows_per_s: float, device_kind: str):
     return rate_windows_per_s * training_flops_per_window() / peak
 
 
+def load_tpu_reference():
+    """
+    The checked-in on-chip measurement
+    (benchmarks/results_bench_tpu_r03.json): attached to degraded records
+    so a CPU-fallback line — the accelerator being unreachable THIS run —
+    still points at the real TPU result. Returns None, never raises (the
+    one-JSON-line contract must survive any state of that file).
+    """
+    ref_path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "benchmarks", "results_bench_tpu_r03.json",
+    )
+    try:
+        with open(ref_path) as fh:
+            ref = json.load(fh)
+        return {
+            "value": ref["value"],
+            "vs_baseline": ref["vs_baseline"],
+            "device_kind": ref["device_kind"],
+            "note": "verified on-chip run recorded in "
+                    "benchmarks/results_bench_tpu_r03.json",
+        }
+    except Exception as exc:  # noqa: BLE001 - attachment is best-effort
+        log(f"no TPU reference attachment: {exc}")
+        return None
+
+
 def run_child(mode: str, n_timesteps: int, epochs: int, timeout_s: float):
     """Run one bench attempt in a subprocess with a hard timeout.
 
@@ -348,6 +375,7 @@ def main():
 
     if result is None:
         # absolute last resort: never exit without the JSON line
+        reference = load_tpu_reference()
         print(
             json.dumps(
                 {
@@ -357,6 +385,7 @@ def main():
                     "vs_baseline": None,
                     "platform": "none",
                     "error": "all bench attempts failed within budget",
+                    **({"tpu_reference": reference} if reference else {}),
                 }
             )
         )
@@ -366,6 +395,11 @@ def main():
     n_windows = result["n_timesteps"] - LOOKBACK + 1
     windows_per_s = n_windows * result["epochs"] / result["train_time"]
     mfu = compute_mfu(windows_per_s, result.get("device_kind", ""))
+
+    tpu_reference = (
+        load_tpu_reference() if result["platform"] != "tpu" else None
+    )
+
     print(
         json.dumps(
             {
@@ -388,6 +422,7 @@ def main():
                 # single-model MFU is expected to be low; see
                 # docs/performance.md for the roofline discussion.
                 "mfu": round(mfu, 4) if mfu is not None else None,
+                **({"tpu_reference": tpu_reference} if tpu_reference else {}),
             }
         )
     )
