@@ -184,6 +184,11 @@ class FleetModelBuilder:
                 X_t = np.asarray(transformer.fit_transform(X_t), dtype=np.float32)
             Xs_t.append(X_t)
             ys_np.append(np.asarray(item["y"], dtype=np.float32))
+        # row-count preservation per machine, on its own data: the license
+        # for sharing one model_offset probe across the bucket (below)
+        rows_preserved = all(
+            len(X_t) == len(item["X"]) for item, X_t in zip(fetched, Xs_t)
+        )
 
         # Stack to a common power-of-two grid (so ragged buckets share one
         # compiled program geometry), pad fleet to mesh multiple.
@@ -225,28 +230,21 @@ class FleetModelBuilder:
         es_kwargs = self._early_stopping_kwargs(fit_args)
 
         trainer = FleetTrainer(spec, lookahead=lookahead, mesh=self.mesh)
-        # Per-machine PRNG streams are a pure function of (evaluation seed,
-        # machine name) — independent of fleet composition and identical to a
-        # re-build of the same machine in any bucket.
-        import zlib
-
-        import jax as _jax
-
-        def machine_key(seed: int, name: str):
-            return np.asarray(
-                _jax.random.fold_in(
-                    _jax.random.PRNGKey(seed), zlib.crc32(name.encode()) & 0x7FFFFFFF
-                )
-            )
+        # Per-machine PRNG keys are the SOLO path's init key for the
+        # machine's evaluation seed (models/core.py: solo_init_key) —
+        # independent of fleet composition, and giving the same machine
+        # identical init params whichever builder trains it (quality
+        # parity between the two paths is a product promise).
+        from gordo_tpu.models.core import solo_init_key
 
         keys = np.stack(
             [
-                machine_key(
-                    item["machine"].evaluation.get("seed", 0), item["machine"].name
+                np.asarray(
+                    solo_init_key(item["machine"].evaluation.get("seed", 0))
                 )
                 for item in fetched
             ]
-            + [machine_key(0, f"__pad_{i}") for i in range(m_padded - len(bucket))]
+            + [np.asarray(solo_init_key(0))] * (m_padded - len(bucket))
         )
 
         # -- CV folds as masks: threshold calibration + scores ------------
@@ -303,12 +301,19 @@ class FleetModelBuilder:
 
             # model_offset = rows the prediction is shorter than the input:
             # pure window arithmetic (lookback/lookahead) for this bucket's
-            # single architecture, independent of params and row count — so
-            # probe it once per bucket instead of paying a full predict
-            # (one device roundtrip per machine on tunneled links)
-            if bucket_offset is None:
-                bucket_offset = ModelBuilder._determine_offset(model, item["X"])
-            offset = bucket_offset
+            # single architecture — so probe it once per bucket instead of
+            # paying a full predict per machine (one device roundtrip each
+            # on tunneled links). Sharing is only sound while no prefix
+            # transformer changes row counts (a data-dependent dropper
+            # would make the offset machine-specific); `rows_preserved`
+            # checks exactly that on every machine's own data, falling
+            # back to per-machine probes otherwise.
+            if not rows_preserved:
+                offset = ModelBuilder._determine_offset(model, item["X"])
+            else:
+                if bucket_offset is None:
+                    bucket_offset = ModelBuilder._determine_offset(model, item["X"])
+                offset = bucket_offset
             scores = {
                 metric: folds for metric, folds in fold_records["scores"][i].items()
             }

@@ -195,9 +195,13 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
         data = StackedData.from_ragged(
             [Xn[tr] for tr, _ in folds], [yn[tr] for tr, _ in folds]
         )
-        # every fold clone trains from the SAME seed, like sklearn clones
+        # every fold clone trains from the SAME seed, like sklearn clones —
+        # and from the clone's exact init key (solo_init_key), so fold
+        # models match what sequential refits would produce
+        from gordo_tpu.models.core import solo_init_key
+
         seed = int(template.kwargs.get("seed", 0))
-        keys = jnp.stack([jax.random.PRNGKey(seed)] * len(folds))
+        keys = jnp.stack([solo_init_key(seed)] * len(folds))
 
         start = time.perf_counter()
         params, _ = trainer.fit(

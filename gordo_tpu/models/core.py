@@ -104,6 +104,17 @@ def _batch_bucket(n: int, cap: Optional[int] = None, base: int = 4) -> int:
 DEFAULT_SEED = 0
 
 
+def solo_init_key(seed: int) -> jax.Array:
+    """
+    The param-init PRNG key a solo ``fit`` with this seed uses. The fleet
+    builder derives its per-machine keys through this same function so the
+    same machine initializes with IDENTICAL params on either build path —
+    the reference's global-seed behavior (every pod with the same seed gets
+    the same Keras init for the same architecture).
+    """
+    return jax.random.split(jax.random.PRNGKey(int(seed)))[1]
+
+
 class BaseJaxEstimator(GordoBase, BaseEstimator):
 
     supported_fit_args = [
@@ -250,8 +261,9 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         Xd = jnp.asarray(X, dtype=jnp.float32)
         yd = jnp.asarray(y, dtype=jnp.float32)
 
-        key = jax.random.PRNGKey(seed)
-        key, init_key = jax.random.split(key)
+        # init through the shared derivation so the fleet path can't drift
+        key = jax.random.split(jax.random.PRNGKey(seed))[0]
+        init_key = solo_init_key(seed)
         if spec.windowed:
             example = Xd[:1][:, None, :].repeat(lb, axis=1)  # (1, lb, f)
         else:

@@ -39,6 +39,22 @@ from gordo_tpu.parallel.mesh import fleet_sharding, pad_to_multiple, replicated_
 logger = logging.getLogger(__name__)
 
 
+def host_fetch(x):
+    """
+    device -> host for arrays that may span multiple PROCESSES (multi-host
+    meshes from parallel.distributed): ``jax.device_get`` refuses global
+    arrays with non-addressable shards, so those go through
+    ``process_allgather`` (every host receives the full global value —
+    exactly what the fleet's loss/param fetches need, since every process
+    runs the same control flow on them).
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(x, tiled=True)
+    return jax.device_get(x)
+
+
 @dataclasses.dataclass
 class StackedData:
     """
@@ -179,8 +195,47 @@ class FleetTrainer:
             sample_weight=jax.device_put(data.sample_weight, sharding),
         )
 
+    def _n_samples(self, n: int) -> int:
+        """Grid sample count for ``n`` timesteps (windows for sequence
+        models), failing loudly when the grid cannot fit one window."""
+        lb = self.spec.lookback_window if self.spec.windowed else 1
+        la = self.lookahead
+        n_samples = (n - lb + 1 - la) if self.spec.windowed else n
+        if n_samples <= 0:
+            raise ValueError(
+                f"Not enough timesteps ({n}) for lookback={lb}, lookahead={la}"
+            )
+        return n_samples
+
+    def _sample_cap(self, w_host: np.ndarray, n: int) -> int:
+        """
+        Fleet-wide max of per-machine REAL sample counts, from the
+        effective (M, n) HOST-side weights (fetched once by ``fit``) —
+        the scan-length cap that keeps each machine's optimizer-step
+        count at the solo path's ``ceil(n_train / batch_size)`` instead
+        of the padded grid's. Exact for any weight pattern (a windowed
+        sample counts iff its whole window and target row are real).
+        """
+        lb = self.spec.lookback_window if self.spec.windowed else 1
+        la = self.lookahead
+        n_samples = self._n_samples(n)
+        r = (np.asarray(w_host) > 0).astype(np.int64)
+        if not self.spec.windowed:
+            return max(1, int(r.sum(axis=1).max()))
+        c = np.concatenate([np.zeros((r.shape[0], 1), dtype=np.int64), r.cumsum(axis=1)], axis=1)
+        win_all = (c[:, lb:] - c[:, :-lb]) == lb      # (M, n - lb + 1)
+        valid = win_all[:, :n_samples] & (r[:, lb - 1 + la : lb - 1 + la + n_samples] > 0)
+        return max(1, int(valid.sum(axis=1).max()))
+
     # -- the compiled epoch ---------------------------------------------
-    def _epoch_fn(self, n: int, batch_size: int, shuffle: bool, gated: bool = False):
+    def _epoch_fn(
+        self,
+        n: int,
+        batch_size: int,
+        shuffle: bool,
+        gated: bool = False,
+        sample_cap: Optional[int] = None,
+    ):
         """
         Build (and cache) the jitted fleet-epoch function for a given
         (timesteps, batch_size) geometry. One compiled program per geometry,
@@ -189,8 +244,25 @@ class FleetTrainer:
         ``gated`` variants take a per-machine ``active`` flag (early
         stopping); the ungated program skips the full-tree select so
         ordinary fits don't pay for the feature.
+
+        ``sample_cap`` bounds the scan at ``ceil(cap / batch_size)``
+        optimizer steps — the fleet-wide maximum of REAL samples, computed
+        by ``fit`` from the effective weights. Without it, timestep-grid
+        padding would inflate the step count: each batch's loss is
+        normalized by its own real-weight sum, so every extra batch is a
+        full-magnitude optimizer step and a 288-row machine on a 512-row
+        grid would silently train ~1.8x the steps the solo path
+        (models/core.py: ceil(n_train / batch_size), Keras semantics)
+        takes. Real samples are packed into the leading batches per
+        machine (masked argsort), and a step whose batch holds no real
+        samples leaves params and optimizer state untouched.
         """
-        cache_key = (n, batch_size, shuffle, gated)
+        n_samples = self._n_samples(n)
+        cap = n_samples if sample_cap is None else max(1, min(sample_cap, n_samples))
+        n_batches = max(1, math.ceil(cap / batch_size))
+        # the cap reaches the compiled program only through n_batches, so
+        # caps rounding to the same batch count share one compiled epoch
+        cache_key = (n, batch_size, shuffle, gated, n_batches)
         if cache_key in self._epoch_fn_cache:
             return self._epoch_fn_cache[cache_key]
 
@@ -198,37 +270,40 @@ class FleetTrainer:
         optimizer = self._optimizer
         lb = spec.lookback_window if spec.windowed else 1
         la = self.lookahead
-        n_samples = (n - lb + 1 - la) if spec.windowed else n
-        if n_samples <= 0:
-            raise ValueError(
-                f"Not enough timesteps ({n}) for lookback={lb}, lookahead={la}"
-            )
-        n_batches = max(1, math.ceil(n_samples / batch_size))
         n_pad = n_batches * batch_size
 
-        sample_ids = np.zeros(n_pad, dtype=np.int32)
-        sample_ids[:n_samples] = np.arange(n_samples, dtype=np.int32)
+        # scan-tail overflow (n_pad may exceed the grid's sample count by
+        # up to batch_size - 1): overflow slots repeat sample 0 with a
+        # static zero mask
+        n_take = min(n_pad, n_samples)
         pad_mask = np.zeros(n_pad, dtype=np.float32)
-        pad_mask[:n_samples] = 1.0
+        pad_mask[:n_take] = 1.0
+        pm_all_np = pad_mask.reshape(n_batches, batch_size)
 
         loss_name = spec.loss
         module = spec.module
         windowed = spec.windowed
 
-        def gather(Xi, yi, wi, sel):
+        def sample_weights(wi):
+            """Per-sample effective weight for every grid sample: a window
+            is as real as its least-real row times its target row."""
+            if not windowed:
+                return wi
+            win_min = jax.lax.reduce_window(
+                wi, jnp.inf, jax.lax.min, (lb,), (1,), "valid"
+            )[:n_samples]
+            return win_min * jax.lax.dynamic_slice(wi, (lb - 1 + la,), (n_samples,))
+
+        def gather(Xi, yi, sel):
             # Xi: (n, f); sel: (batch,) window starts / row ids
             if windowed:
                 rows = sel[:, None] + jnp.arange(lb, dtype=jnp.int32)[None, :]
                 xb = Xi[rows]                      # (batch, lb, f)
-                tgt = sel + (lb - 1 + la)
-                yb = yi[tgt]
-                # a sample is valid only if its whole window + target is real
-                wb = jnp.min(wi[rows], axis=1) * wi[tgt]
+                yb = yi[sel + (lb - 1 + la)]
             else:
                 xb = Xi[sel]
                 yb = yi[sel]
-                wb = wi[sel]
-            return xb, yb, wb
+            return xb, yb
 
         def machine_epoch(params, opt_state, key, Xi, yi, wi, active=None):
             """
@@ -241,14 +316,22 @@ class FleetTrainer:
             gradients, optimizer momentum, and weight decay drift the
             params.
             """
-            ids = jnp.asarray(sample_ids)
-            pmask = jnp.asarray(pad_mask)
+            wb_all = sample_weights(wi)            # (n_samples,)
+            real = wb_all > 0
+            ar = jnp.arange(n_samples, dtype=jnp.float32)
             if shuffle:
-                perm = jax.random.permutation(key, n_pad)
-                ids = ids[perm]
-                pmask = pmask[perm]
-            sel_all = ids.reshape(n_batches, batch_size)
-            pm_all = pmask.reshape(n_batches, batch_size)
+                noise = jax.random.uniform(key, (n_samples,))
+                sort_key = jnp.where(real, noise, 2.0 + noise)
+            else:
+                # stable: real samples keep their time order up front
+                sort_key = jnp.where(real, ar, n_samples + ar)
+            order = jnp.argsort(sort_key).astype(jnp.int32)
+            if n_pad > n_samples:
+                order = jnp.concatenate(
+                    [order, jnp.zeros(n_pad - n_samples, dtype=jnp.int32)]
+                )
+            sel_all = order[:n_pad].reshape(n_batches, batch_size)
+            pm_all = jnp.asarray(pm_all_np)
 
             def loss_fn(p, xb, yb, wb, dropout_key):
                 out, penalty = module.apply(
@@ -263,12 +346,22 @@ class FleetTrainer:
             def step(carry, batch):
                 p, o = carry
                 sel, pm, idx = batch
-                xb, yb, wb = gather(Xi, yi, wi, sel)
-                wb = wb * pm
+                xb, yb = gather(Xi, yi, sel)
+                wb = wb_all[sel] * pm
                 dkey = jax.random.fold_in(key, idx)
                 (_, loss_sum), grads = grad_fn(p, xb, yb, wb, dkey)
-                updates, o = optimizer.update(grads, o, p)
-                p = jax.tree.map(lambda a, u: a + u, p, updates)
+                updates, new_o = optimizer.update(grads, o, p)
+                new_p = jax.tree.map(lambda a, u: a + u, p, updates)
+                # an all-padding batch must be a no-op, not a zero-gradient
+                # optimizer step (momentum decay / penalty gradients would
+                # still move the params)
+                has_real = jnp.sum(wb) > 0
+                p = jax.tree.map(
+                    lambda new, old: jnp.where(has_real, new, old), new_p, p
+                )
+                o = jax.tree.map(
+                    lambda new, old: jnp.where(has_real, new, old), new_o, o
+                )
                 return (p, o), (loss_sum, jnp.sum(wb))
 
             step_ids = jnp.arange(n_batches, dtype=jnp.int32)
@@ -338,7 +431,7 @@ class FleetTrainer:
         spec = self.spec
         lb = spec.lookback_window if spec.windowed else 1
         la = self.lookahead
-        n_samples = (n - lb + 1 - la) if spec.windowed else n
+        n_samples = self._n_samples(n)
         n_eval = max(1, n_samples - lo)
         n_batches = max(1, math.ceil(n_eval / batch_size))
         n_pad = n_batches * batch_size
@@ -392,8 +485,8 @@ class FleetTrainer:
         return fn
 
     def _validation_masks(
-        self, w: jnp.ndarray, n: int, validation_split: float
-    ) -> Tuple[jnp.ndarray, jnp.ndarray, np.ndarray, int]:
+        self, w_host: np.ndarray, n: int, validation_split: float
+    ) -> Tuple[jnp.ndarray, jnp.ndarray, np.ndarray, int, np.ndarray]:
         """
         Per-machine Keras ``validation_split`` semantics as timestep masks:
         the LAST fraction of each machine's samples (windows, for sequence
@@ -405,17 +498,21 @@ class FleetTrainer:
         train cut) and validates iff s >= n_train with its whole window
         inside the real region.
 
-        Returns (train_mask, val_mask, has_val, val_lo): the (M, n)
-        float32 masks, a (M,) bool marking machines whose split actually
-        yields validation samples (a machine too small for ``n_val >= 1``
-        has none — its monitored metric must fall back to the training
-        loss, like the solo path with ``n_val == 0``), and the smallest
-        first-validation-sample index across machines (so the eval only
-        walks the holdout tail, not the whole dataset).
+        Returns (train_mask, val_mask, has_val, val_lo, train_mask_host):
+        the (M, n) float32 masks (sharded), a (M,) bool marking machines
+        whose split actually yields validation samples (a machine too
+        small for ``n_val >= 1`` has none — its monitored metric must
+        fall back to the training loss, like the solo path with
+        ``n_val == 0``), the smallest first-validation-sample index
+        across machines (so the eval only walks the holdout tail, not
+        the whole dataset), and the host-side train mask so the caller
+        can keep its host weight copy in sync without a second device
+        fetch. ``w_host`` is the caller's already-fetched effective
+        weights.
         """
         lb = self.spec.lookback_window if self.spec.windowed else 1
         la = self.lookahead
-        w_host = np.asarray(jax.device_get(w), dtype=np.float64)
+        w_host = np.asarray(w_host, dtype=np.float64)
         # count rows, not weight mass: fractional sample weights must not
         # shift the split boundary
         n_real = (w_host > 0).sum(axis=1).astype(np.int64)
@@ -447,6 +544,7 @@ class FleetTrainer:
             self._shard(jnp.asarray(val_mask)),
             has_val,
             val_lo,
+            train_mask,
         )
 
     # -- public API ------------------------------------------------------
@@ -522,6 +620,9 @@ class FleetTrainer:
         w = data.sample_weight
         if extra_weight is not None:
             w = w * self._shard(jnp.asarray(extra_weight))
+        # the ONE device->host weight transfer per fit: the validation
+        # split and the sample cap both work from this copy
+        w_host = np.asarray(host_fetch(w), dtype=np.float64)
 
         val_w = None
         has_val = None
@@ -531,10 +632,13 @@ class FleetTrainer:
             # computed from the EFFECTIVE weights so a CV fold's extra
             # mask shrinks the split's base, exactly like a solo fold fit
             # on that fold's rows would
-            train_mask, val_w, has_val, val_lo = self._validation_masks(
-                w, data.n_timesteps, float(validation_split)
+            train_mask, val_w, has_val, val_lo, train_mask_host = (
+                self._validation_masks(
+                    w_host, data.n_timesteps, float(validation_split)
+                )
             )
             w = w * train_mask
+            w_host = w_host * train_mask_host
         monitor_val = (
             val_w is not None
             if early_stopping_on_val is None
@@ -607,7 +711,11 @@ class FleetTrainer:
             val_arg = val_w
 
         epoch_fn = self._epoch_fn(
-            data.n_timesteps, batch_size, shuffle, gated=early_stopping
+            data.n_timesteps,
+            batch_size,
+            shuffle,
+            gated=early_stopping,
+            sample_cap=self._sample_cap(w_host, data.n_timesteps),
         )
         val_fn = (
             self._val_fn(data.n_timesteps, batch_size, lo=val_lo)
@@ -651,7 +759,7 @@ class FleetTrainer:
             # (except under early stopping, whose per-epoch decision IS a
             # sync)
             if early_stopping:
-                loss_np = np.asarray(jax.device_get(epoch_loss), dtype=np.float64)
+                loss_np = np.asarray(host_fetch(epoch_loss), dtype=np.float64)
                 # a stopped machine's computed loss reflects a discarded
                 # would-be update; report its last active loss instead
                 report = np.where(
@@ -661,8 +769,11 @@ class FleetTrainer:
                 es_state["last_loss"] = report
                 if monitor_val:
                     val_np = np.asarray(
-                        jax.device_get(val_losses[-1]), dtype=np.float64
+                        host_fetch(val_losses[-1]), dtype=np.float64
                     )
+                    # keep the host copy: the end-of-fit stack must not
+                    # re-transfer a history already fetched epoch by epoch
+                    val_losses[-1] = val_np
                     # a machine too small for any validation samples falls
                     # back to its training loss (solo path: n_val == 0
                     # skips val_loss and EarlyStopping monitors loss) —
@@ -724,14 +835,23 @@ class FleetTrainer:
             # params via the first keep_better call's fallback
             params = best_params
         if val_losses:
-            stacked = np.stack(jax.device_get(val_losses)).astype(np.float64)
+            if isinstance(val_losses[0], np.ndarray):
+                stacked = np.stack(val_losses).astype(np.float64)
+            else:
+                stacked = np.stack(host_fetch(val_losses)).astype(np.float64)
             # machines with no validation samples have no val loss (their
             # computed 0.0 is an artifact of the empty weight sum)
             if has_val is not None and not has_val.all():
                 stacked[:, ~has_val] = np.nan
             self.val_losses_ = stacked
         if losses:
-            return params, np.stack(jax.device_get(losses))
+            # early stopping already host-materialized each epoch's losses
+            # (its per-epoch decision IS the sync); fetching them again
+            # would make process_allgather treat the replicated host copy
+            # as per-process data. Everything else is one bulk transfer.
+            if isinstance(losses[0], np.ndarray):
+                return params, np.stack(losses)
+            return params, np.stack(host_fetch(losses))
         return params, np.zeros((0, len(keys)))
 
     def predict(self, params: Any, X: jnp.ndarray, batch_size: int = 8192) -> np.ndarray:
@@ -825,7 +945,7 @@ class FleetTrainer:
         tunneled link (~2,800 roundtrips); this is the bulk path the
         builder uses instead.
         """
-        host = jax.device_get(params)
+        host = host_fetch(params)
         # explicit copy per slice: a view would pin the whole padded stack
         # in memory for as long as any single machine's params live
         # (ascontiguousarray is a no-op on contiguous slices)
