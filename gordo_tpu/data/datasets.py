@@ -92,11 +92,10 @@ class TimeSeriesDataset(GordoBaseDataset):
         interpolation_limit: str = "8H",
         filter_periods={},
     ):
-        config = locals()
         self._metadata = {}
 
-        window = [self._as_aware_datetime(config[k])
-                  for k in ("train_start_date", "train_end_date")]
+        window = [self._as_aware_datetime(v)
+                  for v in (train_start_date, train_end_date)]
         if window[0] >= window[1]:
             raise ValueError(
                 f"empty training window: start {window[0]} is not before "
@@ -118,20 +117,16 @@ class TimeSeriesDataset(GordoBaseDataset):
             data_provider = GordoBaseDataProvider.from_dict(data_provider)
         self.data_provider = data_provider
 
-        # plain scalar knobs pass straight through onto attributes
-        for knob in (
-            "resolution",
-            "row_filter",
-            "aggregation_methods",
-            "row_filter_buffer_size",
-            "asset",
-            "n_samples_threshold",
-            "low_threshold",
-            "high_threshold",
-            "interpolation_method",
-            "interpolation_limit",
-        ):
-            setattr(self, knob, config[knob])
+        self.resolution = resolution
+        self.row_filter = row_filter
+        self.aggregation_methods = aggregation_methods
+        self.row_filter_buffer_size = row_filter_buffer_size
+        self.asset = asset
+        self.n_samples_threshold = n_samples_threshold
+        self.low_threshold = low_threshold
+        self.high_threshold = high_threshold
+        self.interpolation_method = interpolation_method
+        self.interpolation_limit = interpolation_limit
 
         self.filter_periods = None
         if filter_periods:

@@ -64,6 +64,29 @@ def honor_jax_platforms_env() -> None:
         jax.config.update("jax_platforms", "cpu")
 
 
+def _host_cpu_fingerprint() -> str:
+    """
+    Short digest of this host's CPU ISA features, namespacing the default
+    compile-cache dir per machine type. XLA:CPU persists AOT executables
+    compiled for the build host's exact feature set; a workspace moved to
+    a different CPU (fewer features — e.g. avx512/amx gone) would load
+    those artifacts and fault or hang instead of recompiling.
+    """
+    import hashlib
+    import platform
+
+    material = platform.machine()
+    try:
+        with open("/proc/cpuinfo") as fh:
+            for line in fh:
+                if line.startswith(("flags", "Features")):
+                    material += line
+                    break
+    except OSError:
+        material += platform.processor() or ""
+    return hashlib.sha1(material.encode()).hexdigest()[:12]
+
+
 def enable_compile_cache(
     directory: "str | None" = None, min_compile_seconds: float = 0.5
 ) -> None:
@@ -92,24 +115,31 @@ def enable_compile_cache(
         return
     if directory is None:
         directory = os.path.join(
-            tempfile.gettempdir(), f"gordo_tpu_xla_cache_{os.getuid()}"
+            tempfile.gettempdir(),
+            f"gordo_tpu_xla_cache_{os.getuid()}_{_host_cpu_fingerprint()}",
         )
         try:
             import stat as stat_mod
 
             os.makedirs(directory, mode=0o700, exist_ok=True)
-            st = os.lstat(directory)
-            # lstat + S_ISDIR rejects attacker-planted symlinks in sticky
-            # /tmp (stat would follow them into attacker-writable storage)
-            if not stat_mod.S_ISDIR(st.st_mode) or st.st_uid != os.getuid():
-                logger.warning(
-                    "Compile cache dir %s is a symlink or owned by another "
-                    "user; skipping the persistent cache", directory,
-                )
-                return
-            # tighten a pre-existing dir created under a loose umask
-            if st.st_mode & 0o077:
-                os.chmod(directory, 0o700)
+            # verify THROUGH an O_NOFOLLOW fd so the checked inode is the
+            # used one: a plain lstat-then-chmod leaves a window in sticky
+            # /tmp where the dir can be swapped for a symlink between the
+            # check and the use (and chmod follows symlinks)
+            fd = os.open(directory, os.O_RDONLY | os.O_DIRECTORY | os.O_NOFOLLOW)
+            try:
+                st = os.fstat(fd)
+                if not stat_mod.S_ISDIR(st.st_mode) or st.st_uid != os.getuid():
+                    logger.warning(
+                        "Compile cache dir %s is owned by another user; "
+                        "skipping the persistent cache", directory,
+                    )
+                    return
+                # tighten a pre-existing dir created under a loose umask
+                if st.st_mode & 0o077:
+                    os.fchmod(fd, 0o700)
+            finally:
+                os.close(fd)
         except OSError as exc:
             logger.warning("Cannot prepare compile cache dir: %s", exc)
             return

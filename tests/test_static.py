@@ -218,15 +218,34 @@ def test_return_annotation_check_resolves_aliases():
     assert len(found) == 1 and "bad_quoted" in found[0], found
 
 
+class _DynamicKnobs:
+    """A class assigning knobs via a setattr loop (as TimeSeriesDataset
+    did before its knobs became explicit assignments)."""
+
+    def __init__(self, **knobs):
+        for key, value in knobs.items():
+            setattr(self, key, value)
+
+
 def test_annotated_attribute_check_skips_dynamic_setattr_classes():
-    """A class whose __init__ assigns knobs via a setattr loop (e.g.
-    TimeSeriesDataset) has a dynamic surface — the checker must not vouch
-    for it rather than false-flag the loop-assigned attributes."""
+    """A class whose __init__ assigns knobs via a setattr loop has a
+    dynamic surface — the checker must not vouch for it rather than
+    false-flag the loop-assigned attributes."""
+    from static_analysis import _known_attrs
+
+    assert _known_attrs(_DynamicKnobs) is None
+
+
+def test_annotated_attribute_check_vouches_for_explicit_assignments():
+    """TimeSeriesDataset's knobs are explicit ``self.X = ...`` statements;
+    the checker can and should vouch for its full surface now."""
     import gordo_tpu.data.datasets as d
 
     from static_analysis import _known_attrs
 
-    assert _known_attrs(d.TimeSeriesDataset) is None
+    known = _known_attrs(d.TimeSeriesDataset)
+    assert known is not None
+    assert {"resolution", "row_filter", "interpolation_limit"} <= known
 
 
 def test_return_annotation_check_allows_attribute_form_any():

@@ -129,33 +129,66 @@ def _assert_cache_default_skipped(monkeypatch, tmp_path):
         jax.config.update("jax_compilation_cache_dir", prior)
 
 
+def _default_cache_dirname():
+    import os
+
+    from gordo_tpu.utils.utils import _host_cpu_fingerprint
+
+    return f"gordo_tpu_xla_cache_{os.getuid()}_{_host_cpu_fingerprint()}"
+
+
 def test_enable_compile_cache_skips_foreign_owned_default(monkeypatch, tmp_path):
     """A default cache dir owned by another uid must disable the cache,
     not deserialize foreign compiled executables. Simulated by patching
-    os.lstat so the branch runs for any test uid."""
+    os.fstat (the dir is verified through an O_NOFOLLOW fd) so the branch
+    runs for any test uid."""
     import os
 
-    real_lstat = os.lstat
+    real_fstat = os.fstat
 
-    def foreign_lstat(path, *a, **kw):
-        st = real_lstat(path, *a, **kw)
-        if str(path).endswith(f"gordo_tpu_xla_cache_{os.getuid()}"):
-            return os.stat_result((st.st_mode, st.st_ino, st.st_dev,
-                                   st.st_nlink, 12345, 12345, st.st_size,
-                                   st.st_atime, st.st_mtime, st.st_ctime))
-        return st
+    def foreign_fstat(fd):
+        st = real_fstat(fd)
+        return os.stat_result((st.st_mode, st.st_ino, st.st_dev,
+                               st.st_nlink, 12345, 12345, st.st_size,
+                               st.st_atime, st.st_mtime, st.st_ctime))
 
-    monkeypatch.setattr("os.lstat", foreign_lstat)
+    monkeypatch.setattr("os.fstat", foreign_fstat)
     _assert_cache_default_skipped(monkeypatch, tmp_path)
 
 
 def test_enable_compile_cache_rejects_symlinked_default(monkeypatch, tmp_path):
     """An attacker-planted symlink at the default path must disable the
-    cache (lstat sees the link, not the target)."""
-    import os
-
+    cache (O_NOFOLLOW refuses to open through the link, atomically with
+    the use — no lstat-then-use window)."""
     target = tmp_path / "attacker-writable"
     target.mkdir()
-    link = tmp_path / f"gordo_tpu_xla_cache_{os.getuid()}"
+    link = tmp_path / _default_cache_dirname()
     link.symlink_to(target)
     _assert_cache_default_skipped(monkeypatch, tmp_path)
+
+
+def test_default_cache_dir_is_fingerprinted_per_host_cpu(monkeypatch, tmp_path):
+    """The default dir embeds a host-CPU fingerprint: XLA:CPU persists AOT
+    executables for the compiling host's exact feature set, and a workspace
+    moved to a lesser CPU must get a FRESH cache dir, not load artifacts
+    that fault or hang (observed live: round-3 cache on a different host
+    wedged round-4 runs until cleared)."""
+    import jax
+
+    from gordo_tpu.utils import enable_compile_cache
+
+    monkeypatch.delenv("GORDO_XLA_CACHE_DIR", raising=False)
+    monkeypatch.setattr("tempfile.gettempdir", lambda: str(tmp_path))
+    prior = jax.config.jax_compilation_cache_dir
+    try:
+        enable_compile_cache()
+        configured = jax.config.jax_compilation_cache_dir
+        assert configured == str(tmp_path / _default_cache_dirname())
+        # a different host CPU must resolve to a different directory
+        monkeypatch.setattr(
+            "gordo_tpu.utils.utils._host_cpu_fingerprint", lambda: "deadbeef0123"
+        )
+        enable_compile_cache()
+        assert jax.config.jax_compilation_cache_dir != configured
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prior)
