@@ -1,0 +1,69 @@
+"""
+Worker process for the REAL multi-host test: one of N processes in a
+``jax.distributed`` cluster on the CPU backend (4 virtual local devices
+each), running an actual sharded fleet-training step over the GLOBAL mesh.
+
+Launched by tests/test_distributed.py::test_two_process_fleet_step_executes;
+not a pytest file itself (leading underscore keeps collection away).
+
+Usage: python _distributed_worker.py <coordinator_port> <process_id> <num_processes>
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> None:
+    port, process_id, num_processes = sys.argv[1], int(sys.argv[2]), int(sys.argv[3])
+
+    from gordo_tpu.parallel import distributed
+
+    # the real initialize path — no mocks anywhere below
+    distributed.initialize(
+        coordinator_address=f"localhost:{port}",
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    info = distributed.process_info()
+    assert info["process_count"] == num_processes, info
+    assert info["global_device_count"] == 4 * num_processes, info
+    assert info["local_device_count"] == 4, info
+
+    import numpy as np
+
+    from gordo_tpu.models.factories.feedforward import feedforward_hourglass
+    from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
+
+    mesh = distributed.global_mesh()
+    assert mesh.devices.size == 4 * num_processes
+
+    m = mesh.devices.size
+    rng = np.random.default_rng(0)
+    Xs = [rng.random((64, 3)).astype("float32") for _ in range(m)]
+    data = StackedData.from_ragged(Xs, [x.copy() for x in Xs])
+    trainer = FleetTrainer(feedforward_hourglass(n_features=3), mesh=mesh)
+    keys = trainer.machine_keys(m)
+    params, losses = trainer.fit(data, keys, epochs=2, batch_size=16)
+
+    # params really span BOTH processes' devices
+    leaf = jax.tree.leaves(params)[0]
+    assert len(leaf.sharding.device_set) == 4 * num_processes, leaf.sharding
+    assert np.all(np.isfinite(losses))
+    assert np.all(losses[-1] < losses[0])
+
+    # every process sees the same global loss values (host_fetch allgathers)
+    print(f"RESULT {process_id} {losses[-1].sum():.8f}", flush=True)
+    print(f"OK {process_id}", flush=True)
+
+
+if __name__ == "__main__":
+    main()
