@@ -1,0 +1,173 @@
+"""
+``influxdb``-shaped client shim for the in-process live-service suite:
+the surface the framework touches (InfluxDBClient / DataFrameClient with
+create/drop database, query, DataFrame write_points), serializing frames
+to REAL line protocol and speaking HTTP to the tests.support.influx_wire
+server. Loaded by inserting tests/support/fakeshims at the FRONT of
+sys.path (tests/test_live_services_inprocess.py) — never importable from
+production code paths.
+"""
+
+import json
+import urllib.parse
+import urllib.request
+from typing import Dict, Iterable, List, Optional
+
+import pandas as pd
+
+
+def escape_key(text) -> str:
+    """Line-protocol escaping for measurements / tag keys / tag values
+    (kept in sync with tests.support.influx_wire.escape_key — this shim
+    must be importable as top-level ``influxdb`` with no package around)."""
+    return (
+        str(text).replace("\\", "\\\\").replace(",", "\\,")
+        .replace(" ", "\\ ").replace("=", "\\=")
+    )
+
+
+class InfluxDBClientError(Exception):
+    def __init__(self, content, code=None):
+        super().__init__(f"{code}: {content}")
+        self.content = content
+        self.code = code
+
+
+class ResultSet:
+    """The subset of influxdb.resultset.ResultSet the framework uses."""
+
+    def __init__(self, raw: dict):
+        self.raw = raw
+
+    def _series(self) -> List[dict]:
+        out = []
+        for result in self.raw.get("results", []):
+            out.extend(result.get("series", []))
+        return out
+
+    def get_points(self) -> Iterable[dict]:
+        for series in self._series():
+            for row in series["values"]:
+                yield dict(zip(series["columns"], row))
+
+    def __bool__(self) -> bool:
+        return bool(self._series())
+
+    def __len__(self) -> int:
+        return len(self._series())
+
+
+class InfluxDBClient:
+    def __init__(
+        self,
+        host: str = "localhost",
+        port: int = 8086,
+        username: str = "root",
+        password: str = "root",
+        database: Optional[str] = None,
+        ssl: bool = False,
+        path: str = "",
+        proxies: Optional[Dict[str, str]] = None,
+        **kwargs,
+    ):
+        self._database = database
+        self._headers: Dict[str, str] = {}
+        scheme = "https" if ssl else "http"
+        prefix = f"/{path.strip('/')}" if path else ""
+        self._base_url = f"{scheme}://{host}:{port}{prefix}"
+
+    # -- wire --------------------------------------------------------------
+    def _request(self, method: str, endpoint: str, params: dict, body: bytes = b""):
+        url = f"{self._base_url}{endpoint}?{urllib.parse.urlencode(params)}"
+        req = urllib.request.Request(url, data=body or None, method=method)
+        for key, value in self._headers.items():
+            req.add_header(key, value)
+        try:
+            with urllib.request.urlopen(req, timeout=10) as resp:
+                payload = resp.read()
+        except urllib.error.HTTPError as exc:
+            raise InfluxDBClientError(exc.read().decode(), exc.code) from exc
+        return json.loads(payload) if payload else {}
+
+    # -- API ---------------------------------------------------------------
+    def create_database(self, dbname: str) -> None:
+        self._request("POST", "/query", {"q": f'CREATE DATABASE "{dbname}"'})
+
+    def drop_database(self, dbname: str) -> None:
+        self._request("POST", "/query", {"q": f'DROP DATABASE "{dbname}"'})
+
+    def query(self, query: str, **kwargs) -> ResultSet:
+        raw = self._request(
+            "GET", "/query", {"db": self._database or "", "q": query}
+        )
+        return ResultSet(raw)
+
+    def write(self, lines: List[str]) -> None:
+        self._request(
+            "POST",
+            "/write",
+            {"db": self._database or "", "precision": "ns"},
+            "\n".join(lines).encode(),
+        )
+
+    def close(self) -> None:
+        pass
+
+
+def _field_literal(value) -> str:
+    if isinstance(value, str):
+        escaped = value.replace("\\", "\\\\").replace('"', '\\"')
+        return f'"{escaped}"'
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    return repr(float(value))
+
+
+class DataFrameClient(InfluxDBClient):
+    def write_points(
+        self,
+        dataframe: pd.DataFrame,
+        measurement: str,
+        tags: Optional[dict] = None,
+        tag_columns: Optional[list] = None,
+        field_columns: Optional[list] = None,
+        batch_size: Optional[int] = None,
+        **kwargs,
+    ) -> bool:
+        tag_columns = tag_columns or []
+        field_columns = field_columns or [
+            c for c in dataframe.columns if c not in tag_columns
+        ]
+        lines = []
+        for stamp, row in zip(dataframe.index, dataframe.itertuples(index=False)):
+            record = dict(zip(dataframe.columns, row))
+            key = escape_key(measurement)
+            for tag_key, tag_value in sorted((tags or {}).items()):
+                if tag_value not in (None, ""):
+                    key += f",{escape_key(tag_key)}={escape_key(tag_value)}"
+            for col in tag_columns:
+                # the real client omits empty tag values rather than
+                # emitting `key=` (invalid line protocol)
+                if record[col] not in (None, ""):
+                    key += f",{escape_key(col)}={escape_key(record[col])}"
+            fields = ",".join(
+                f"{escape_key(col)}={_field_literal(record[col])}"
+                for col in field_columns
+            )
+            time_ns = int(pd.Timestamp(stamp).value)
+            lines.append(f"{key} {fields} {time_ns}")
+        for start in range(0, len(lines), batch_size or len(lines) or 1):
+            self.write(lines[start : start + (batch_size or len(lines))])
+        return True
+
+    def query(self, query: str, **kwargs) -> Dict[str, pd.DataFrame]:
+        raw = self._request(
+            "GET", "/query", {"db": self._database or "", "q": query}
+        )
+        frames: Dict[str, pd.DataFrame] = {}
+        for result in raw.get("results", []):
+            for series in result.get("series", []):
+                frame = pd.DataFrame(series["values"], columns=series["columns"])
+                frame["time"] = pd.to_datetime(frame["time"], utc=True)
+                frames[series["name"]] = frame.set_index("time")
+        return frames

@@ -1,0 +1,97 @@
+"""
+``psycopg2``-shaped DB-API shim for the in-process live-service suite:
+maps connections onto per-(host, port, dbname) sqlite files so the
+PostgresReporter's ACTUAL SQL — pyformat placeholders, JSONB column
+type, the atomic ``ON CONFLICT (name) DO UPDATE`` upsert — executes on a
+real SQL engine in an image with no postgres server and no libpq.
+sqlite3 accepts arbitrary declared column types (JSONB gets TEXT
+affinity) and implements the same upsert clause, so the statement text
+runs unmodified apart from the %s -> ? placeholder translation psycopg2
+itself performs at the wire layer.
+
+Loaded by inserting tests/support/fakeshims at the FRONT of sys.path —
+never importable from production code paths.
+"""
+
+import os
+import re
+import sqlite3
+import tempfile
+import urllib.parse
+from typing import Optional
+
+_DB_DIR = None
+
+
+def _db_path(host: str, port: int, dbname: str) -> str:
+    global _DB_DIR
+    if _DB_DIR is None:
+        _DB_DIR = tempfile.mkdtemp(prefix="fake_pg_")
+    safe = re.sub(r"[^\w.-]", "_", f"{host}_{port}_{dbname}")
+    return os.path.join(_DB_DIR, f"{safe}.sqlite")
+
+
+class Error(Exception):
+    pass
+
+
+class _Cursor:
+    def __init__(self, cursor: sqlite3.Cursor):
+        self._cursor = cursor
+
+    def execute(self, sql: str, params=()):
+        # psycopg2's pyformat placeholders -> sqlite qmark
+        self._cursor.execute(sql.replace("%s", "?"), tuple(params or ()))
+        return self
+
+    def fetchall(self):
+        return self._cursor.fetchall()
+
+    def fetchone(self):
+        return self._cursor.fetchone()
+
+    def close(self):
+        self._cursor.close()
+
+
+class _Connection:
+    def __init__(self, conn: sqlite3.Connection):
+        self._conn = conn
+
+    def cursor(self) -> _Cursor:
+        return _Cursor(self._conn.cursor())
+
+    # psycopg2 context-manager semantics: commit on success, rollback on
+    # error, connection stays OPEN (sqlite3's own __exit__ matches)
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self._conn.commit()
+        else:
+            self._conn.rollback()
+        return False
+
+    def commit(self):
+        self._conn.commit()
+
+    def close(self):
+        self._conn.close()
+
+
+def connect(
+    dsn: Optional[str] = None,
+    host: str = "localhost",
+    port: int = 5432,
+    user: str = "postgres",
+    password: str = "postgres",
+    dbname: str = "postgres",
+    **kwargs,
+) -> _Connection:
+    if dsn:
+        parts = urllib.parse.urlparse(dsn)
+        host = parts.hostname or host
+        port = parts.port or port
+        dbname = (parts.path or "").lstrip("/") or dbname
+    return _Connection(sqlite3.connect(_db_path(host, port, dbname)))

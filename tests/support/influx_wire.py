@@ -1,0 +1,271 @@
+"""
+In-process InfluxDB 1.x stand-in: a real HTTP server (stdlib) accepting
+REAL line protocol on ``POST /write`` and answering the InfluxQL subset
+the framework emits on ``/query`` with the real JSON response shape.
+
+This is the wire half of the live-service suite's in-image edition
+(tests/test_live_services_inprocess.py): the reference runs
+influxdb:1.7-alpine in docker per test (reference tests/conftest.py:
+217-289); this image has no docker and no influxdb wheel, so the bytes
+on the wire — line-protocol escaping, HTTP query params, the
+results/series/columns/values JSON — are produced and parsed here for
+the framework's forwarder and provider paths to execute end to end.
+"""
+
+import json
+import re
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+
+@dataclass
+class Point:
+    measurement: str
+    tags: Dict[str, str]
+    fields: Dict[str, object]
+    time_ns: int
+
+
+@dataclass
+class InfluxState:
+    databases: Dict[str, List[Point]] = field(default_factory=dict)
+
+
+# -- line protocol ----------------------------------------------------------
+
+def _split_unescaped(text: str, sep: str) -> List[str]:
+    """Split on ``sep`` except where backslash-escaped or inside a quoted
+    field value (line protocol: spaces/commas in quoted strings are
+    literal, quotes themselves escape with a backslash)."""
+    parts, buf, i, in_quotes = [], [], 0, False
+    while i < len(text):
+        ch = text[i]
+        if ch == "\\" and i + 1 < len(text):
+            buf.append(text[i : i + 2])
+            i += 2
+            continue
+        if ch == '"':
+            in_quotes = not in_quotes
+            buf.append(ch)
+        elif ch == sep and not in_quotes:
+            parts.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+        i += 1
+    parts.append("".join(buf))
+    return parts
+
+
+def _unescape(text: str) -> str:
+    return re.sub(r"\\(.)", r"\1", text)
+
+
+def escape_key(text: str) -> str:
+    """Escape measurement names / tag keys / tag values / field keys."""
+    return (
+        str(text).replace("\\", "\\\\").replace(",", "\\,")
+        .replace(" ", "\\ ").replace("=", "\\=")
+    )
+
+
+def _parse_field_value(raw: str) -> object:
+    if raw.startswith('"') and raw.endswith('"'):
+        return raw[1:-1].replace('\\"', '"')
+    if raw.endswith("i"):
+        return int(raw[:-1])
+    if raw in ("t", "T", "true", "True"):
+        return True
+    if raw in ("f", "F", "false", "False"):
+        return False
+    return float(raw)
+
+
+def parse_line_protocol(body: str) -> List[Point]:
+    points = []
+    for line in body.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key_part, field_part, *rest = _split_unescaped(line, " ")
+        series = _split_unescaped(key_part, ",")
+        measurement = _unescape(series[0])
+        tags = {}
+        for tag in series[1:]:
+            k, v = _split_unescaped(tag, "=")
+            tags[_unescape(k)] = _unescape(v)
+        fields = {}
+        for fld in _split_unescaped(field_part, ","):
+            k, v = _split_unescaped(fld, "=")
+            fields[_unescape(k)] = _parse_field_value(v)
+        time_ns = int(rest[0]) if rest and rest[0] else 0
+        points.append(Point(measurement, tags, fields, time_ns))
+    return points
+
+
+# -- the InfluxQL subset the framework emits --------------------------------
+
+_SELECT_RE = re.compile(
+    r'^\s*SELECT\s+(?P<proj>.+?)\s+FROM\s+"(?P<measurement>[^"]+)"'
+    r"(?:\s*WHERE\s*(?P<where>.+?))?\s*$",
+    re.IGNORECASE | re.DOTALL,
+)
+_ALIAS_RE = re.compile(r'^"(?P<field>[^"]+)"\s+as\s+"(?P<alias>[^"]+)"$', re.IGNORECASE)
+_TAG_REGEX_RE = re.compile(r'^\(?\s*"?(?P<key>[\w -]+)"?\s*=~\s*/\^(?P<val>.*?)\$/\s*\)?$')
+_TAG_EQ_RE = re.compile(r"^\(?\s*\"?(?P<key>[\w -]+)\"?\s*=\s*'(?P<val>[^']*)'\s*\)?$")
+_TIME_RE = re.compile(r"^\(?\s*time\s*(?P<op>[<>]=?)\s*(?P<val>\d+)(?P<unit>s|ms|u|ns)?\s*\)?$")
+
+_UNIT_NS = {"s": 10**9, "ms": 10**6, "u": 10**3, "ns": 1, None: 1}
+
+
+def _rfc3339(ns: int) -> str:
+    stamp = datetime.fromtimestamp(ns / 1e9, tz=timezone.utc)
+    return stamp.strftime("%Y-%m-%dT%H:%M:%S.%f").rstrip("0").rstrip(".") + "Z"
+
+
+def run_select(points: List[Point], query: str) -> Optional[dict]:
+    """One SELECT -> an influx ``series`` dict, or None for no rows."""
+    m = _SELECT_RE.match(query)
+    if not m:
+        raise ValueError(f"unsupported query: {query}")
+    measurement = m.group("measurement")
+    rows = [p for p in points if p.measurement == measurement]
+
+    for cond in re.split(r"\s+AND\s+", m.group("where") or "", flags=re.IGNORECASE):
+        cond = cond.strip()
+        if not cond:
+            continue
+        if tm := _TIME_RE.match(cond):
+            bound = int(tm.group("val")) * _UNIT_NS[tm.group("unit")]
+            op = tm.group("op")
+            rows = [
+                p for p in rows
+                if (p.time_ns >= bound if op == ">=" else
+                    p.time_ns <= bound if op == "<=" else
+                    p.time_ns > bound if op == ">" else p.time_ns < bound)
+            ]
+        elif tr := _TAG_REGEX_RE.match(cond):
+            key, val = tr.group("key").strip(), tr.group("val")
+            rows = [p for p in rows if p.tags.get(key) == val]
+        elif te := _TAG_EQ_RE.match(cond):
+            key, val = te.group("key").strip(), te.group("val")
+            rows = [p for p in rows if p.tags.get(key) == val]
+        else:
+            raise ValueError(f"unsupported WHERE clause: {cond!r}")
+
+    if not rows:
+        return None
+    rows.sort(key=lambda p: p.time_ns)
+
+    proj = m.group("proj").strip()
+    if proj == "*":
+        keys = sorted({k for p in rows for k in (*p.tags, *p.fields)})
+        columns = ["time"] + keys
+        values = [
+            [_rfc3339(p.time_ns)] + [p.fields.get(k, p.tags.get(k)) for k in keys]
+            for p in rows
+        ]
+    else:
+        selected: List[Tuple[str, str]] = []
+        for item in proj.split(","):
+            am = _ALIAS_RE.match(item.strip())
+            if am:
+                selected.append((am.group("field"), am.group("alias")))
+            else:
+                bare = item.strip().strip('"')
+                selected.append((bare, bare))
+        rows = [p for p in rows if any(f in p.fields for f, _ in selected)]
+        if not rows:
+            return None
+        columns = ["time"] + [alias for _, alias in selected]
+        values = [
+            [_rfc3339(p.time_ns)] + [p.fields.get(f) for f, _ in selected]
+            for p in rows
+        ]
+    return {"name": measurement, "columns": columns, "values": values}
+
+
+# -- HTTP server ------------------------------------------------------------
+
+class _Handler(BaseHTTPRequestHandler):
+    state: InfluxState  # set by serve()
+
+    def log_message(self, *args):  # quiet
+        pass
+
+    def _respond(self, code: int, payload: dict):
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _params(self) -> dict:
+        parsed = urllib.parse.urlparse(self.path)
+        params = {k: v[-1] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        return params
+
+    def do_GET(self):
+        if self.path.startswith("/ping"):
+            self.send_response(204)
+            self.end_headers()
+            return
+        if self.path.startswith("/query"):
+            return self._handle_query(self._params())
+        self._respond(404, {"error": "not found"})
+
+    def do_POST(self):
+        length = int(self.headers.get("Content-Length", 0))
+        body = self.rfile.read(length).decode()
+        params = self._params()
+        if self.path.startswith("/write"):
+            db = params.get("db", "")
+            try:
+                points = parse_line_protocol(body)
+            except (ValueError, IndexError) as exc:
+                return self._respond(400, {"error": f"unable to parse: {exc}"})
+            self.state.databases.setdefault(db, []).extend(points)
+            self.send_response(204)
+            self.end_headers()
+            return
+        if self.path.startswith("/query"):
+            if body and "q" not in params:
+                params.update(
+                    {k: v[-1] for k, v in urllib.parse.parse_qs(body).items()}
+                )
+            return self._handle_query(params)
+        self._respond(404, {"error": "not found"})
+
+    def _handle_query(self, params: dict):
+        query = params.get("q", "")
+        db = params.get("db", "")
+        if cm := re.match(r'^\s*CREATE DATABASE\s+"?([^"]+)"?\s*$', query, re.I):
+            self.state.databases.setdefault(cm.group(1), [])
+            return self._respond(200, {"results": [{"statement_id": 0}]})
+        if dm := re.match(r'^\s*DROP DATABASE\s+"?([^"]+)"?\s*$', query, re.I):
+            self.state.databases.pop(dm.group(1), None)
+            return self._respond(200, {"results": [{"statement_id": 0}]})
+        try:
+            series = run_select(self.state.databases.get(db, []), query)
+        except ValueError as exc:
+            return self._respond(400, {"error": str(exc)})
+        result: dict = {"statement_id": 0}
+        if series is not None:
+            result["series"] = [series]
+        self._respond(200, {"results": [result]})
+
+
+def serve() -> Tuple[ThreadingHTTPServer, threading.Thread, int]:
+    """Start the stand-in on an ephemeral localhost port; returns
+    (server, thread, port). Call ``server.shutdown()`` when done."""
+    state = InfluxState()
+    handler = type("BoundHandler", (_Handler,), {"state": state})
+    server = ThreadingHTTPServer(("localhost", 0), handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server, thread, server.server_address[1]
