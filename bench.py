@@ -279,8 +279,8 @@ def load_tpu_reference():
             "value": ref["value"],
             "vs_baseline": ref["vs_baseline"],
             "device_kind": ref["device_kind"],
-            "note": "verified on-chip run recorded in "
-                    "benchmarks/results_bench_tpu_r03.json",
+            "note": "builder-recorded on-chip run (not driver-captured), "
+                    "from benchmarks/results_bench_tpu_r03.json",
         }
     except Exception as exc:  # noqa: BLE001 - attachment is best-effort
         log(f"no TPU reference attachment: {exc}")

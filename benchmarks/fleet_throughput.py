@@ -65,6 +65,46 @@ def reconstruction_mae(model, machine) -> float:
     return float(np.abs(np.asarray(predicted) - target).mean())
 
 
+def fleet_mfu(results, build_seconds: float, device) -> "float | None":
+    """
+    Aggregate model-FLOPs utilization of the whole fleet build: analytic
+    training FLOPs actually executed across every machine's CV folds and
+    final fit, over wall-clock x chip peak. This is the measured form of
+    the design's roofline argument (docs/performance.md: one tiny model
+    cannot fill the MXU — the FLEET axis is what scales arithmetic
+    intensity), so it must rise with --machines. None off-TPU.
+
+    Analytic counts: dense fwd ~= 2 x kernel-weight elements per sample;
+    training ~= 3 x fwd; TimeSeriesSplit(3) fold train sizes sum to
+    ~1.5 x n_samples, the final fit adds 1.0 x.
+    """
+    from bench import PEAK_BF16_FLOPS
+
+    from gordo_tpu.builder.fleet_build import _find_jax_estimator
+
+    peak = PEAK_BF16_FLOPS.get(device.device_kind)
+    if peak is None:
+        return None
+    import jax
+
+    total = 0.0
+    for model, _machine in results:
+        est = _find_jax_estimator(model)
+        if est is None or not hasattr(est, "params_"):
+            continue
+        kernel_elems = sum(
+            leaf.size for leaf in jax.tree.leaves(est.params_)
+            if getattr(leaf, "ndim", 0) >= 2
+        )
+        samples = est.history_["params"]["samples"]
+        # EXECUTED epochs (early stopping may end before the configured
+        # budget), not the configured count
+        epochs = len(est.history_["loss"])
+        fwd = 2.0 * kernel_elems
+        total += (1.0 + 1.5) * samples * epochs * 3.0 * fwd
+    return total / build_seconds / peak
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--machines", type=int, default=16)
@@ -111,6 +151,7 @@ def main():
 
     fleet_rate = args.machines / fleet_s * 3600
     seq_rate = 3600 / seq_s_per_machine
+    mfu = fleet_mfu(fleet_results, fleet_s, device)
     print(
         json.dumps(
             {
@@ -125,6 +166,7 @@ def main():
                 "speedup": round(fleet_rate / seq_rate, 2),
                 "fleet_reconstruction_mae": round(fleet_mae, 5),
                 "sequential_reconstruction_mae": round(seq_mae, 5),
+                "mfu": round(mfu, 6) if mfu is not None else None,
             }
         )
     )
