@@ -889,15 +889,49 @@ def _preload_models(app: "GordoApp") -> None:
             len(names),
             capacity,
         )
+    loaded: typing.Dict[str, typing.Any] = {}
     for name in names[:capacity]:
         try:
             model = server_utils.load_model(collection_dir, name)
             warmed = _warm_model(model)
+            loaded[name] = model
             logger.info(
                 "Preloaded model %s%s", name, "" if warmed else " (no warmup)"
             )
         except Exception as exc:  # pragma: no cover - defensive per-model
             logger.warning("Preload failed for %s: %s", name, exc)
+    if loaded:
+        # Also stack the FULL collection's fleet-scoring params now, so the
+        # first whole-collection fleet request doesn't pay the param
+        # stacking + device placement (the per-shape vmap program still
+        # compiles on the first request of each request-shape bucket).
+        # The scorer keeps only the stacked estimator params, independent
+        # of the model LRU — models past the cache capacity are loaded
+        # transiently (serializer.load, not the lru-cached loader, so the
+        # warm cache isn't churned). Key matches the endpoints':
+        # (realpath, sorted names).
+        try:
+            from gordo_tpu import serializer
+            from gordo_tpu.server.fleet_serving import fleet_scorer_from_models
+
+            scorer_models = dict(loaded)
+            for name in names:
+                if name not in scorer_models:
+                    scorer_models[name] = serializer.load(
+                        os.path.join(collection_dir, name)
+                    )
+            built = fleet_scorer_from_models(scorer_models)
+            key = (os.path.realpath(collection_dir), tuple(sorted(scorer_models)))
+            with app._fleet_scorers_lock:
+                app._fleet_scorers[key] = built
+            scorer = built[0]
+            logger.info(
+                "Preloaded fleet scorer: %d machines in %d groups",
+                len(scorer.names) if scorer else 0,
+                scorer.n_groups if scorer else 0,
+            )
+        except Exception as exc:  # pragma: no cover - defensive
+            logger.warning("Fleet-scorer preload failed: %s", exc)
 
 
 def _unwrap_estimators(model) -> typing.Iterable[typing.Any]:

@@ -295,9 +295,22 @@ def test_preload_models_on_startup(model_collection_env, monkeypatch):
 
     server_utils.clear_caches()
     monkeypatch.setenv("GORDO_SERVER_PRELOAD", "true")
-    build_app()
+    app = build_app()
     info = server_utils.load_model.cache_info()
     assert info.currsize > 0  # models already resident
+
+    # the full collection's fleet-scoring params are stacked at preload
+    # (first whole-collection fleet request must not pay the stacking)
+    collection_dir = os.environ["MODEL_COLLECTION_DIR"]
+    all_names = tuple(
+        sorted(
+            n
+            for n in os.listdir(collection_dir)
+            if os.path.isdir(os.path.join(collection_dir, n))
+        )
+    )
+    preload_key = (os.path.realpath(collection_dir), all_names)
+    assert preload_key in app._fleet_scorers
 
     # warmup ran a dummy forward: the jitted apply fn is already built on
     # at least one preloaded jax estimator (it is rebuilt lazily after
