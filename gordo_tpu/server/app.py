@@ -515,6 +515,12 @@ class GordoApp:
         # same key may both build (harmless — last insert wins)
         with self._fleet_scorers_lock:
             cached = self._fleet_scorers.get(key)
+            if cached is not None:
+                # true LRU: refresh on hit, or the startup-preloaded
+                # whole-collection entry (inserted first) would be the
+                # first eviction victim under mixed subset traffic
+                self._fleet_scorers.pop(key)
+                self._fleet_scorers[key] = cached
         if cached is not None:
             return cached
         from gordo_tpu.server.fleet_serving import fleet_scorer_from_models
@@ -901,37 +907,74 @@ def _preload_models(app: "GordoApp") -> None:
         except Exception as exc:  # pragma: no cover - defensive per-model
             logger.warning("Preload failed for %s: %s", name, exc)
     if loaded:
-        # Also stack the FULL collection's fleet-scoring params now, so the
-        # first whole-collection fleet request doesn't pay the param
-        # stacking + device placement (the per-shape vmap program still
-        # compiles on the first request of each request-shape bucket).
-        # The scorer keeps only the stacked estimator params, independent
-        # of the model LRU — models past the cache capacity are loaded
-        # transiently (serializer.load, not the lru-cached loader, so the
-        # warm cache isn't churned). Key matches the endpoints':
-        # (realpath, sorted names).
-        try:
-            from gordo_tpu import serializer
-            from gordo_tpu.server.fleet_serving import fleet_scorer_from_models
+        _preload_fleet_scorer(app, collection_dir, names, loaded)
 
-            scorer_models = dict(loaded)
-            for name in names:
-                if name not in scorer_models:
-                    scorer_models[name] = serializer.load(
-                        os.path.join(collection_dir, name)
-                    )
-            built = fleet_scorer_from_models(scorer_models)
-            key = (os.path.realpath(collection_dir), tuple(sorted(scorer_models)))
-            with app._fleet_scorers_lock:
-                app._fleet_scorers[key] = built
-            scorer = built[0]
-            logger.info(
-                "Preloaded fleet scorer: %d machines in %d groups",
-                len(scorer.names) if scorer else 0,
-                scorer.n_groups if scorer else 0,
+
+def _preload_fleet_scorer(
+    app: "GordoApp",
+    collection_dir: str,
+    names: typing.List[str],
+    loaded: typing.Dict[str, typing.Any],
+) -> None:
+    """
+    Stack the FULL collection's fleet-scoring params at startup, so the
+    first whole-collection fleet request doesn't pay the param stacking +
+    device placement (the per-shape vmap program still compiles on the
+    first request of each request-shape bucket).
+
+    Models past the model-cache capacity are loaded one at a time with
+    ``serializer.load`` (not the lru-cached loader, so the warm cache
+    isn't churned) and only the pieces the scorer serves from — the JAX
+    estimator (whose params the scorer stacks anyway; every machine's
+    params coexisting is inherent to fleet scoring, on the lazy path
+    too) and its host prefix transformers — are kept; the model wrapper
+    objects drop immediately. A model that fails to load or isn't
+    batchable is skipped (logged) rather than aborting the whole
+    preload; the cache key then matches the endpoints' key for the
+    machines that DID stack.
+    """
+    from gordo_tpu import serializer
+    from gordo_tpu.builder.fleet_build import (
+        _find_jax_estimator,
+        _prefix_transformers,
+    )
+    from gordo_tpu.server.fleet_serving import FleetScorer
+
+    estimators: typing.Dict[str, typing.Any] = {}
+    prefixes: typing.Dict[str, typing.List] = {}
+    fallback: typing.Dict[str, typing.Any] = {}
+    for name in names:
+        try:
+            model = loaded.get(name)
+            if model is None:
+                model = serializer.load(os.path.join(collection_dir, name))
+            est = _find_jax_estimator(model)
+            if est is None or not hasattr(est, "params_"):
+                fallback[name] = model
+            else:
+                estimators[name] = est
+                prefixes[name] = _prefix_transformers(model)
+        except Exception as exc:  # noqa: BLE001 - per-model tolerance
+            logger.warning(
+                "Fleet-scorer preload: skipping %s (%s)", name, exc
             )
-        except Exception as exc:  # pragma: no cover - defensive
-            logger.warning("Fleet-scorer preload failed: %s", exc)
+    if not estimators:
+        return
+    try:
+        scorer = FleetScorer(estimators)
+    except Exception as exc:  # pragma: no cover - defensive
+        logger.warning("Fleet-scorer preload failed: %s", exc)
+        return
+    stacked_names = sorted(set(estimators) | set(fallback))
+    key = (os.path.realpath(collection_dir), tuple(stacked_names))
+    with app._fleet_scorers_lock:
+        app._fleet_scorers[key] = (scorer, prefixes, fallback)
+    logger.info(
+        "Preloaded fleet scorer: %d machines in %d groups (%d fallback)",
+        len(scorer.names),
+        scorer.n_groups,
+        len(fallback),
+    )
 
 
 def _unwrap_estimators(model) -> typing.Iterable[typing.Any]:
