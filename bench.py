@@ -139,6 +139,9 @@ def bench_jax(n_timesteps: int, epochs: int) -> dict:
         # instead of a per-step projection — measured 1.75x on v5e, parity
         # pinned by tests/test_fused_lstm.py
         fused=True,
+        # schedule-only time-scan unroll for on-chip sweeps (default 1:
+        # measured counterproductive on XLA-CPU, untested on TPU)
+        time_unroll=int(os.environ.get("BENCH_TIME_UNROLL", "1")),
     )
     trainer = FleetTrainer(spec, lookahead=0, donate=True)
     keys = trainer.machine_keys(1)

@@ -27,6 +27,7 @@ def recurrent_spec(
     compile_kwargs: Dict[str, Any] = dict(),
     dtype: Union[str, Any] = "float32",
     fused: bool = False,
+    time_unroll: int = 1,
 ) -> ModelSpec:
     """Shared builder behind the lstm_* and gru_* factory trios."""
     n_features_out = n_features_out or n_features
@@ -40,6 +41,7 @@ def recurrent_spec(
         out_func=out_func,
         cell=cell,
         fused=fused,
+        time_unroll=int(time_unroll),
         dtype=resolve_dtype(dtype),
     )
     return ModelSpec(
@@ -68,12 +70,16 @@ def lstm_model(
     compile_kwargs: Dict[str, Any] = dict(),
     dtype: Union[str, Any] = "float32",
     fused: bool = False,
+    time_unroll: int = 1,
     **kwargs,
 ) -> ModelSpec:
     """
     Stacked LSTM encoder/decoder with a Dense head on the last timestep.
     ``fused=True`` hoists input projections out of the time scan
     (specs.FusedLSTMLayer) — same math, TPU-friendlier schedule.
+    ``time_unroll`` unrolls the fused layers' time scan (schedule-only;
+    identical math) — XLA then fuses gate math across consecutive steps,
+    cutting per-step carry-copy overhead.
     """
     return recurrent_spec(
         "lstm",
@@ -90,6 +96,7 @@ def lstm_model(
         compile_kwargs=compile_kwargs,
         dtype=dtype,
         fused=fused,
+        time_unroll=time_unroll,
     )
 
 

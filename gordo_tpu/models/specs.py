@@ -167,6 +167,11 @@ class FusedLSTMLayer(nn.Module):
     features: int
     activation_fn: Any = jnp.tanh
     dtype: Any = jnp.float32
+    # time-scan unroll factor: XLA fuses gate math across consecutive
+    # steps, shrinking per-step carry copies (the dominant non-matmul
+    # cost in the CPU fallback's trace) and loop overhead; a pure
+    # schedule knob — the math is step-for-step identical
+    unroll: int = 1
 
     @nn.compact
     def __call__(self, x):  # x: (batch, time, f)
@@ -203,7 +208,9 @@ class FusedLSTMLayer(nn.Module):
             jnp.zeros((batch, h_dim), dtype=jnp.float32),
             jnp.zeros((batch, h_dim), dtype=jnp.float32),
         )
-        _, hs = jax.lax.scan(step, carry0, z.swapaxes(0, 1))
+        _, hs = jax.lax.scan(
+            step, carry0, z.swapaxes(0, 1), unroll=max(1, int(self.unroll))
+        )
         return hs.swapaxes(0, 1).astype(self.dtype)
 
 
@@ -224,6 +231,7 @@ class LSTMNet(nn.Module):
     out_func: str = "linear"
     fused: bool = False
     cell: str = "lstm"  # "lstm" | "gru"
+    time_unroll: int = 1  # fused layers' scan unroll (schedule-only knob)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -237,6 +245,7 @@ class LSTMNet(nn.Module):
                 x = FusedLSTMLayer(
                     dim,
                     activation_fn=resolve_activation(func),
+                    unroll=self.time_unroll,
                     dtype=self.dtype,
                 )(x)
             else:
