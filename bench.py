@@ -86,11 +86,11 @@ def live_tpu_processes() -> list:
     return hits
 
 
-def clean_stale_tpu_locks():
+def clean_stale_tpu_locks(pattern: str = "/tmp/libtpu_lockfile*"):
     """A SIGKILLed TPU process can leave libtpu lockfiles that wedge the
     next attempt's backend init; remove them ONLY when no live process has
     the TPU runtime mapped (a live holder's lock is not stale)."""
-    locks = glob.glob("/tmp/libtpu_lockfile*")
+    locks = glob.glob(pattern)
     if not locks:
         return
     holders = live_tpu_processes()

@@ -601,3 +601,75 @@ def check_call_signatures(tree: ast.Module, module) -> typing.List[str]:
             name = ast.unparse(node.func)
             problems.append(f"line {node.lineno}: call to {name}(): {exc}")
     return problems
+
+
+def check_self_method_calls(tree: ast.Module, module) -> typing.List[str]:
+    """
+    ``self.method(...)`` calls inside a class body must bind to that
+    class's own (or inherited) method signature — the signature-drift
+    class of bug the module-level call check cannot see because the
+    receiver is an instance. Conservative: skips splats, dynamic-surface
+    classes (``__getattr__`` hooks), properties, non-function class
+    attributes, and methods that cannot be resolved statically.
+    """
+    namespace = vars(module)
+    problems: typing.List[str] = []
+
+    def class_scope_nodes(cls_node: ast.ClassDef) -> typing.List[ast.AST]:
+        """All nodes in the class body EXCLUDING nested ClassDef subtrees
+        — a nested class's ``self`` is its own receiver, not ours."""
+        out: typing.List[ast.AST] = []
+        stack: typing.List[ast.AST] = list(ast.iter_child_nodes(cls_node))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.ClassDef):
+                continue
+            out.append(node)
+            stack.extend(ast.iter_child_nodes(node))
+        return out
+
+    for cls_node in ast.walk(tree):
+        if not isinstance(cls_node, ast.ClassDef):
+            continue
+        cls = namespace.get(cls_node.name)
+        if not isinstance(cls, type) or _known_attrs(cls) is None:
+            continue
+        for node in class_scope_nodes(cls_node):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "self"
+            ):
+                continue
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                continue
+            if any(kw.arg is None for kw in node.keywords):  # **splat
+                continue
+            name = node.func.attr
+            try:
+                raw = inspect.getattr_static(cls, name)
+            except AttributeError:
+                continue  # instance attribute (e.g. a callable field)
+            if isinstance(raw, staticmethod):
+                target, implicit = raw.__func__, 0
+            elif isinstance(raw, classmethod):
+                target, implicit = getattr(cls, name), 0  # cls pre-bound
+            elif inspect.isfunction(raw):
+                target, implicit = raw, 1  # self
+            else:
+                continue  # property / descriptor / callable object
+            try:
+                signature = inspect.signature(target)
+            except (ValueError, TypeError):
+                continue
+            try:
+                signature.bind(
+                    *[None] * (implicit + len(node.args)),
+                    **{kw.arg: None for kw in node.keywords},
+                )
+            except TypeError as exc:
+                problems.append(
+                    f"line {node.lineno}: self.{name}(): {exc}"
+                )
+    return problems

