@@ -365,6 +365,16 @@ def main():
         result = run_child("tpu", N_TIMESTEPS, EPOCHS, tpu_timeout)
         if result is None:
             clean_stale_tpu_locks()
+            # a FLAKY (vs dead) tunnel can kill one attempt and serve the
+            # next: retry once, but only with budget for a full-size CPU
+            # fallback still in hand — the one-JSON-line contract always
+            # outranks a second TPU try
+            retry_timeout = min(300.0, remaining() - CPU_FALLBACK_RESERVE_S)
+            if retry_timeout >= 120.0:
+                log("TPU attempt failed; one bounded retry")
+                result = run_child("tpu", N_TIMESTEPS, EPOCHS, retry_timeout)
+                if result is None:
+                    clean_stale_tpu_locks()
     else:
         log(f"skipping TPU attempt: only {remaining():.0f}s left")
 

@@ -603,38 +603,78 @@ def check_call_signatures(tree: ast.Module, module) -> typing.List[str]:
     return problems
 
 
+def _splatted(node: ast.Call) -> bool:
+    """Calls with positional or keyword splats cannot be bound statically."""
+    return any(isinstance(a, ast.Starred) for a in node.args) or any(
+        kw.arg is None for kw in node.keywords
+    )
+
+
+def _bind_probe(signature: inspect.Signature, node: ast.Call, implicit: int = 0):
+    """Bind a call node's arg shape (values as None) against a signature;
+    returns the TypeError on mismatch, else None. ``implicit`` prepends
+    that many positional slots (an unbound method's ``self``)."""
+    try:
+        signature.bind(
+            *[None] * (implicit + len(node.args)),
+            **{kw.arg: None for kw in node.keywords},
+        )
+    except TypeError as exc:
+        return exc
+    return None
+
+
 def check_self_method_calls(tree: ast.Module, module) -> typing.List[str]:
     """
-    ``self.method(...)`` calls inside a class body must bind to that
-    class's own (or inherited) method signature — the signature-drift
-    class of bug the module-level call check cannot see because the
-    receiver is an instance. Conservative: skips splats, dynamic-surface
-    classes (``__getattr__`` hooks), properties, non-function class
-    attributes, and methods that cannot be resolved statically.
+    ``self.method(...)`` calls inside a MODULE-SCOPE class body must bind
+    to that class's own (or inherited) method signature — the
+    signature-drift class of bug the module-level call check cannot see
+    because the receiver is an instance. Conservative: skips splats,
+    dynamic-surface classes (``__getattr__`` hooks), properties,
+    non-function class attributes, function-local classes (their names
+    need not resolve at module scope), and any subtree where a nested
+    function or lambda REBINDS ``self`` (a callback's ``self`` is some
+    other object's).
     """
     namespace = vars(module)
     problems: typing.List[str] = []
 
-    def class_scope_nodes(cls_node: ast.ClassDef) -> typing.List[ast.AST]:
-        """All nodes in the class body EXCLUDING nested ClassDef subtrees
-        — a nested class's ``self`` is its own receiver, not ours."""
+    def rebinds_self(fn: ast.AST) -> bool:
+        args = fn.args
+        return any(
+            a.arg == "self"
+            for a in (
+                *args.posonlyargs, *args.args, *args.kwonlyargs,
+                *([args.vararg] if args.vararg else []),
+                *([args.kwarg] if args.kwarg else []),
+            )
+        )
+
+    def method_scope_nodes(cls_node: ast.ClassDef) -> typing.List[ast.AST]:
+        """Nodes where ``self`` is THIS class's instance: method bodies,
+        minus nested ClassDefs and minus nested functions/lambdas that
+        rebind ``self``."""
         out: typing.List[ast.AST] = []
         stack: typing.List[ast.AST] = list(ast.iter_child_nodes(cls_node))
         while stack:
             node = stack.pop()
             if isinstance(node, ast.ClassDef):
                 continue
+            if isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ) and node is not cls_node and rebinds_self(node) and node not in cls_node.body:
+                continue  # a callback with its own self
             out.append(node)
             stack.extend(ast.iter_child_nodes(node))
         return out
 
-    for cls_node in ast.walk(tree):
+    for cls_node in tree.body:  # module scope only: names resolve reliably
         if not isinstance(cls_node, ast.ClassDef):
             continue
         cls = namespace.get(cls_node.name)
         if not isinstance(cls, type) or _known_attrs(cls) is None:
             continue
-        for node in class_scope_nodes(cls_node):
+        for node in method_scope_nodes(cls_node):
             if not (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
@@ -642,9 +682,7 @@ def check_self_method_calls(tree: ast.Module, module) -> typing.List[str]:
                 and node.func.value.id == "self"
             ):
                 continue
-            if any(isinstance(a, ast.Starred) for a in node.args):
-                continue
-            if any(kw.arg is None for kw in node.keywords):  # **splat
+            if _splatted(node):
                 continue
             name = node.func.attr
             try:
@@ -663,13 +701,7 @@ def check_self_method_calls(tree: ast.Module, module) -> typing.List[str]:
                 signature = inspect.signature(target)
             except (ValueError, TypeError):
                 continue
-            try:
-                signature.bind(
-                    *[None] * (implicit + len(node.args)),
-                    **{kw.arg: None for kw in node.keywords},
-                )
-            except TypeError as exc:
-                problems.append(
-                    f"line {node.lineno}: self.{name}(): {exc}"
-                )
+            error = _bind_probe(signature, node, implicit)
+            if error is not None:
+                problems.append(f"line {node.lineno}: self.{name}(): {error}")
     return problems

@@ -381,3 +381,52 @@ def test_self_method_check_scopes_nested_classes():
     # Inner.run(self, x) makes self.run(1) valid; binding it against
     # Outer.run(self) would false-flag 'too many positional arguments'
     assert check_self_method_calls(_ast.parse(source), module) == []
+
+
+def test_self_method_check_skips_function_local_classes():
+    """A function-local class must not bind against a same-named
+    module-level class (names only resolve reliably at module scope)."""
+    import ast as _ast
+    import types as _types
+
+    from static_analysis import check_self_method_calls
+
+    source = (
+        "class Cfg:\n"
+        "    def load(self, path):\n"
+        "        return path\n"
+        "def factory():\n"
+        "    class Cfg:\n"
+        "        def load(self):\n"
+        "            return 1\n"
+        "        def go(self):\n"
+        "            return self.load()\n"
+        "    return Cfg\n"
+    )
+    module = _types.ModuleType("fake_local_cls")
+    exec(source, module.__dict__)
+    assert check_self_method_calls(_ast.parse(source), module) == []
+
+
+def test_self_method_check_skips_callbacks_rebinding_self():
+    """A nested function whose own parameter is named ``self`` is some
+    other object's receiver — its calls must not bind against the
+    enclosing class."""
+    import ast as _ast
+    import types as _types
+
+    from static_analysis import check_self_method_calls
+
+    source = (
+        "class Widget:\n"
+        "    def draw(self, a, b):\n"
+        "        return a + b\n"
+        "    def wire(self):\n"
+        "        def on_event(self):\n"
+        "            return self.draw(1, 2, 3)\n"
+        "        take = lambda self: self.draw(1, 2, 3, 4)\n"
+        "        return on_event, take, self.draw(1, 2)\n"
+    )
+    module = _types.ModuleType("fake_callback")
+    exec(source, module.__dict__)
+    assert check_self_method_calls(_ast.parse(source), module) == []
