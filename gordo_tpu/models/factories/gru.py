@@ -31,13 +31,17 @@ def gru_model(
     optimizer_kwargs: Dict[str, Any] = dict(),
     compile_kwargs: Dict[str, Any] = dict(),
     dtype: Union[str, Any] = "float32",
+    fused: bool = False,
+    time_unroll: int = 1,
     **kwargs,
 ) -> ModelSpec:
-    """Stacked GRU encoder/decoder with a Dense head on the last timestep."""
-    if kwargs.pop("fused", False):
-        # an LSTM config copied over with fused: true must fail loudly, not
-        # silently train unfused
-        raise ValueError("fused input projections are LSTM-only")
+    """
+    Stacked GRU encoder/decoder with a Dense head on the last timestep.
+    ``fused=True`` hoists the r/z/n input projections out of the time
+    scan (specs.FusedGRULayer) — same math, TPU-friendlier schedule, as
+    for the LSTM family; ``time_unroll`` unrolls the fused layers' scan
+    (schedule-only).
+    """
     return recurrent_spec(
         "gru",
         n_features,
@@ -52,6 +56,8 @@ def gru_model(
         optimizer_kwargs=optimizer_kwargs,
         compile_kwargs=compile_kwargs,
         dtype=dtype,
+        fused=fused,
+        time_unroll=time_unroll,
     )
 
 

@@ -214,15 +214,78 @@ class FusedLSTMLayer(nn.Module):
         return hs.swapaxes(0, 1).astype(self.dtype)
 
 
+class FusedGRULayer(nn.Module):
+    """
+    GRU layer with the input projections hoisted OUT of the time scan:
+    the x@W_[rzn] matmuls for the whole sequence run as one
+    (batch*time, f) x (f, 3h) product (MXU-sized), and the scan carries
+    only the recurrent h-projections. Same math as
+    ``nn.RNN(GRUCell)`` — r/z sigmoid gates, ``activation_fn`` on the
+    candidate, reset gate applied to the PROJECTED hidden state
+    (``n = act(x_n + r * (h@W_hn + b_hn))``), ``h' = (1-z)*n + z*h`` —
+    with the TPU-friendlier schedule of FusedLSTMLayer.
+    """
+
+    features: int
+    activation_fn: Any = jnp.tanh
+    dtype: Any = jnp.float32
+    unroll: int = 1  # see FusedLSTMLayer.unroll
+
+    @nn.compact
+    def __call__(self, x):  # x: (batch, time, f)
+        h_dim = self.features
+        # one big matmul over the full sequence; carries the input-side
+        # biases for r/z/n (the recurrent r/z projections are bias-free,
+        # as in GRUCell's summed-dense convention)
+        z = nn.Dense(
+            3 * h_dim, use_bias=True, dtype=self.dtype, name="input_proj"
+        )(x)
+        w_rz = self.param(
+            "recurrent_kernel_rz",
+            nn.initializers.orthogonal(),
+            (h_dim, 2 * h_dim),
+            jnp.float32,
+        ).astype(self.dtype)
+        w_n = self.param(
+            "recurrent_kernel_n",
+            nn.initializers.orthogonal(),
+            (h_dim, h_dim),
+            jnp.float32,
+        ).astype(self.dtype)
+        b_n = self.param(
+            "recurrent_bias_n", nn.initializers.zeros_init(), (h_dim,), jnp.float32
+        )
+        act = self.activation_fn
+
+        def step(h, z_t):
+            # matmuls in self.dtype (MXU); gate math in float32, matching
+            # GRUCell's float32 carry
+            hd = h.astype(self.dtype)
+            rz = (z_t[..., : 2 * h_dim] + hd @ w_rz).astype(jnp.float32)
+            r, zg = jnp.split(nn.sigmoid(rz), 2, axis=-1)
+            hn = (hd @ w_n).astype(jnp.float32) + b_n
+            n = act(z_t[..., 2 * h_dim :].astype(jnp.float32) + r * hn)
+            h = (1.0 - zg) * n + zg * h
+            return h, h
+
+        batch = x.shape[0]
+        h0 = jnp.zeros((batch, h_dim), dtype=jnp.float32)
+        _, hs = jax.lax.scan(
+            step, h0, z.swapaxes(0, 1), unroll=max(1, int(self.unroll))
+        )
+        return hs.swapaxes(0, 1).astype(self.dtype)
+
+
 class LSTMNet(nn.Module):
     """
     Stacked LSTM -> Dense head (reference shape:
     factories/lstm_autoencoder.py:17-103): every LSTM layer emits its full
     sequence to the next; the Dense head reads the final layer's last
     timestep — identical math to Keras' return_sequences=False on the last
-    recurrent layer. ``fused=True`` swaps each layer for FusedLSTMLayer
-    (input projections hoisted out of the scan; different param tree, so
-    choose it at model definition time).
+    recurrent layer. ``fused=True`` swaps each layer for the cell's fused
+    variant (FusedLSTMLayer / FusedGRULayer — input projections hoisted
+    out of the scan; different param tree, so choose it at model
+    definition time).
     """
 
     layer_dims: Tuple[int, ...]
@@ -238,11 +301,12 @@ class LSTMNet(nn.Module):
     def __call__(self, x, deterministic: bool = True):  # x: (batch, time, features)
         if self.cell not in ("lstm", "gru"):
             raise ValueError(f"Unknown recurrent cell {self.cell!r}")
-        if self.fused and self.cell != "lstm":
-            raise ValueError("fused input projections are LSTM-only")
         for dim, func in zip(self.layer_dims, self.layer_funcs):
             if self.fused:
-                x = FusedLSTMLayer(
+                fused_layer = (
+                    FusedGRULayer if self.cell == "gru" else FusedLSTMLayer
+                )
+                x = fused_layer(
                     dim,
                     activation_fn=resolve_activation(func),
                     unroll=self.time_unroll,

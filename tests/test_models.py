@@ -359,16 +359,52 @@ def test_gru_has_fewer_params_than_lstm():
     assert n_params(gru_model(**common)) < n_params(lstm_model(**common))
 
 
-def test_gru_fused_rejected():
+def test_fused_gru_matches_gru_cell():
+    """FusedGRULayer is math-identical to nn.RNN(GRUCell): hoisting the
+    r/z/n input projections out of the scan must not change a single
+    output (params are transplanted between the two layouts)."""
+    import flax.linen as nn
     import jax
     import jax.numpy as jnp
 
-    from gordo_tpu.models.specs import LSTMNet
+    from gordo_tpu.models.specs import FusedGRULayer
 
-    net = LSTMNet(layer_dims=(4,), layer_funcs=("tanh",), out_dim=2,
-                  cell="gru", fused=True)
-    with pytest.raises(ValueError, match="LSTM-only"):
-        net.init(jax.random.PRNGKey(0), jnp.zeros((1, 3, 2)))
+    h_dim, f = 5, 3
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.random((2, 7, f)).astype("float32"))
+
+    fused = FusedGRULayer(h_dim)
+    fused_params = fused.init(jax.random.PRNGKey(0), x)
+
+    cell = nn.GRUCell(h_dim)
+    plain = nn.RNN(cell)
+    plain_params = plain.init(jax.random.PRNGKey(1), x)
+
+    # transplant fused params into GRUCell's per-gate layout
+    p = fused_params["params"]
+    w_i = np.asarray(p["input_proj"]["kernel"])     # (f, 3h): r | z | n
+    b_i = np.asarray(p["input_proj"]["bias"])       # (3h,)
+    w_rz = np.asarray(p["recurrent_kernel_rz"])     # (h, 2h): r | z
+    w_n = np.asarray(p["recurrent_kernel_n"])       # (h, h)
+    b_n = np.asarray(p["recurrent_bias_n"])         # (h,)
+    cell_params = {
+        "params": {
+            "cell": {
+                "ir": {"kernel": w_i[:, :h_dim], "bias": b_i[:h_dim]},
+                "iz": {"kernel": w_i[:, h_dim:2 * h_dim], "bias": b_i[h_dim:2 * h_dim]},
+                "in": {"kernel": w_i[:, 2 * h_dim:], "bias": b_i[2 * h_dim:]},
+                "hr": {"kernel": w_rz[:, :h_dim]},
+                "hz": {"kernel": w_rz[:, h_dim:]},
+                "hn": {"kernel": w_n, "bias": b_n},
+            }
+        }
+    }
+    jax.tree.map(  # transplant covers the full param tree
+        lambda a, b: None, plain_params, cell_params
+    )
+    out_fused = fused.apply(fused_params, x)
+    out_plain = plain.apply(cell_params, x)
+    np.testing.assert_allclose(out_fused, out_plain, rtol=1e-5, atol=1e-6)
 
 
 def test_gru_fleet_trains():
@@ -388,10 +424,20 @@ def test_gru_fleet_trains():
     assert trainer.predict(params, data.X).shape == (2, 47, 3)
 
 
-def test_gru_config_fused_rejected():
-    """An LSTM config copied to the GRU family with fused: true fails
-    loudly instead of silently training unfused."""
+def test_gru_fused_fleet_trains():
+    """The fused GRU trains through the fleet path like the fused LSTM."""
     from gordo_tpu.models.factories.gru import gru_model
+    from gordo_tpu.parallel import FleetTrainer, StackedData
 
-    with pytest.raises(ValueError, match="LSTM-only"):
-        gru_model(n_features=3, lookback_window=4, fused=True)
+    rng = np.random.default_rng(0)
+    Xs = [rng.random((50, 3)).astype("float32") for _ in range(2)]
+    data = StackedData.from_ragged(Xs, [x.copy() for x in Xs])
+    spec = gru_model(n_features=3, lookback_window=4, encoding_dim=(8,),
+                     encoding_func=("tanh",), decoding_dim=(8,),
+                     decoding_func=("tanh",), fused=True, time_unroll=2)
+    trainer = FleetTrainer(spec, lookahead=0)
+    params, losses = trainer.fit(data, trainer.machine_keys(2), epochs=2,
+                                 batch_size=16)
+    assert losses.shape == (2, 2)
+    assert losses[-1].sum() < losses[0].sum()
+    assert trainer.predict(params, data.X).shape == (2, 47, 3)
