@@ -133,13 +133,25 @@ def test_two_process_fleet_step_executes():
             if proc.poll() is None:
                 proc.kill()
 
-    results = {}
+    results: dict = {}
+    dp_results: dict = {}
     for out in outs:
         for line in out.splitlines():
             if line.startswith("RESULT "):
                 _, pid, value = line.split()
                 results[pid] = value
+            elif line.startswith("DP "):
+                _, pid, value = line.split()
+                dp_results[pid] = value
         assert "OK" in out
+        # cross-process COLLECTIVES executed too: ring attention's
+        # ppermute crossed the process boundary (verified against full
+        # attention inside the worker)
+        assert "RING" in out
     assert len(results) == 2
     # both processes fetched identical GLOBAL losses
     assert results["0"] == results["1"]
+    # and the data-parallel all-reduce produced the same loss on both
+    # sides (a shard-local psum bug would diverge here)
+    assert len(dp_results) == 2
+    assert dp_results["0"] == dp_results["1"]
