@@ -22,7 +22,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from gordo_tpu.models.core import BaseJaxEstimator, _batch_bucket
-from gordo_tpu.ops.windowing import window_sample_indices
 
 logger = logging.getLogger(__name__)
 
@@ -68,9 +67,26 @@ class FleetScorer:
                 lambda *leaves: jnp.stack(leaves), *[e.params_ for e in group_ests]
             )
             spec = group_ests[0].spec_
-            apply_fn = jax.jit(
-                jax.vmap(lambda p, x, module=spec.module: module.apply(p, x)[0])
-            )
+            if spec.windowed:
+                # windows are gathered IN the compiled program from raw
+                # (rows, f) inputs: the host->device transfer carries each
+                # row once instead of lookback times (the gather is HBM
+                # traffic, where it belongs)
+                lb = spec.lookback_window
+                la = group_ests[0].lookahead
+
+                def one(p, x, module=spec.module, lb=lb, la=la):
+                    starts = jnp.arange(
+                        x.shape[0] - lb + 1 - la, dtype=jnp.int32
+                    )
+                    rows = starts[:, None] + jnp.arange(lb, dtype=jnp.int32)
+                    return module.apply(p, x[rows])[0]
+
+                apply_fn = jax.jit(jax.vmap(one))
+            else:
+                apply_fn = jax.jit(
+                    jax.vmap(lambda p, x, module=spec.module: module.apply(p, x)[0])
+                )
             self._groups.append(
                 {
                     "names": names,
@@ -115,22 +131,31 @@ class FleetScorer:
     ) -> Dict[str, np.ndarray]:
         names = list(inputs)
         lb, la = group["lookback"], group["lookahead"]
+        prepared = {
+            name: np.asarray(X, dtype=np.float32) for name, X in inputs.items()
+        }
+        max_len = max(len(x) for x in prepared.values())
         if group["windowed"]:
-            prepared = {}
-            for name, X in inputs.items():
-                X = np.asarray(X, dtype=np.float32)
-                idx = window_sample_indices(len(X), lb, la)
-                prepared[name] = X[idx]  # (windows, lb, f)
-        else:
-            prepared = {
-                name: np.asarray(X, dtype=np.float32) for name, X in inputs.items()
+            # raw rows go to the device; the compiled program gathers the
+            # windows there. n_rows tracks each machine's OUTPUT length —
+            # and a machine that cannot fill ONE window is the same error
+            # the per-model path raises (ops.windowing), not a silent
+            # empty frame
+            for name, x in prepared.items():
+                if len(x) - lb + 1 - la <= 0:
+                    raise ValueError(
+                        f"Not enough timesteps ({len(x)}) for machine "
+                        f"{name!r}: lookback_window={lb}, lookahead={la}"
+                    )
+            n_rows = {
+                name: len(x) - lb + 1 - la for name, x in prepared.items()
             }
-
-        n_rows = {name: len(x) for name, x in prepared.items()}
+        else:
+            n_rows = {name: len(x) for name, x in prepared.items()}
         # bucket BOTH varying axes so jit sees a bounded set of shapes:
         # rows to the next power of two (<=2x padded compute beats a
         # per-request XLA compile), machines likewise capped at group size
-        max_rows = _pow2_bucket(max(n_rows.values()))
+        max_rows = _pow2_bucket(max_len)
         batch = np.stack(
             [
                 np.pad(x, [(0, max_rows - len(x))] + [(0, 0)] * (x.ndim - 1))
