@@ -236,6 +236,10 @@ def check_module_shadowing(tree: ast.Module) -> typing.List[str]:
 _ATTR_CACHE: typing.Dict[type, typing.Optional[typing.Set[str]]] = {}
 
 
+#: attrs seen ONLY as AugAssign targets per class (see _known_attrs)
+_AUG_ONLY_CANDIDATES: typing.Dict[type, typing.Set[str]] = {}
+
+
 def _known_attrs(cls: type) -> typing.Optional[typing.Set[str]]:
     """
     The statically-knowable attribute surface of ``cls``: everything on the
@@ -274,6 +278,15 @@ def _known_attrs(cls: type) -> typing.Optional[typing.Set[str]]:
                 result = None
                 break
             dynamic = False
+            # AugAssign targets are Store-ctx but READ first at runtime
+            # (self.x += 1 on an undefined x raises): they do not define
+            # the surface on their own — check_self_attributes treats a
+            # name ONLY ever aug-assigned as undefined
+            aug_targets = {
+                id(node.target)
+                for node in ast.walk(base_tree)
+                if isinstance(node, ast.AugAssign)
+            }
             for node in ast.walk(base_tree):
                 if (
                     isinstance(node, ast.Attribute)
@@ -281,7 +294,12 @@ def _known_attrs(cls: type) -> typing.Optional[typing.Set[str]]:
                     and isinstance(node.value, ast.Name)
                     and node.value.id == "self"
                 ):
-                    names.add(node.attr)
+                    if id(node) in aug_targets:
+                        _AUG_ONLY_CANDIDATES.setdefault(cls, set()).add(
+                            node.attr
+                        )
+                    else:
+                        names.add(node.attr)
                 elif (
                     isinstance(node, ast.Call)
                     and isinstance(node.func, ast.Name)
@@ -603,6 +621,82 @@ def check_call_signatures(tree: ast.Module, module) -> typing.List[str]:
     return problems
 
 
+def _rebinds_self(fn: ast.AST) -> bool:
+    args = fn.args
+    return any(
+        a.arg == "self"
+        for a in (
+            *args.posonlyargs, *args.args, *args.kwonlyargs,
+            *([args.vararg] if args.vararg else []),
+            *([args.kwarg] if args.kwarg else []),
+        )
+    )
+
+
+def _method_scope_nodes(cls_node: ast.ClassDef) -> typing.List[ast.AST]:
+    """Nodes where ``self`` is THIS class's instance: method bodies, minus
+    nested ClassDefs and minus nested functions/lambdas that rebind
+    ``self`` (a callback's ``self`` is some other object's)."""
+    out: typing.List[ast.AST] = []
+    stack: typing.List[ast.AST] = list(ast.iter_child_nodes(cls_node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, ast.ClassDef):
+            continue
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ) and _rebinds_self(node) and node not in cls_node.body:
+            continue  # a callback with its own self
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+def check_self_attributes(tree: ast.Module, module) -> typing.List[str]:
+    """
+    ``self.attr`` READS inside a module-scope class must name an
+    attribute on the class's statically-knowable surface (class dir +
+    annotations + every ``self.X = ...`` in its own and its bases'
+    source) — the typo'd-state-read slice of mypy. Stores are exempt
+    (they DEFINE the surface), as are dynamic-surface classes.
+    """
+    namespace = vars(module)
+    problems: typing.List[str] = []
+    for cls_node in tree.body:
+        if not isinstance(cls_node, ast.ClassDef):
+            continue
+        cls = namespace.get(cls_node.name)
+        if not isinstance(cls, type):
+            continue
+        known = _known_attrs(cls)
+        if known is None:
+            continue
+        for node in _method_scope_nodes(cls_node):
+            is_read = (
+                isinstance(node, ast.Attribute)
+                and isinstance(node.ctx, ast.Load)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+            )
+            if isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Attribute
+            ):
+                # self.x += 1 READS x before writing: an undefined x
+                # raises at runtime even though the ctx is Store
+                target = node.target
+                is_read = (
+                    isinstance(target.value, ast.Name)
+                    and target.value.id == "self"
+                )
+                node = target
+            if is_read and node.attr not in known:
+                problems.append(
+                    f"line {node.lineno}: self.{node.attr} is not on "
+                    f"{cls_node.name}'s attribute surface"
+                )
+    return problems
+
+
 def _splatted(node: ast.Call) -> bool:
     """Calls with positional or keyword splats cannot be bound statically."""
     return any(isinstance(a, ast.Starred) for a in node.args) or any(
@@ -639,42 +733,13 @@ def check_self_method_calls(tree: ast.Module, module) -> typing.List[str]:
     namespace = vars(module)
     problems: typing.List[str] = []
 
-    def rebinds_self(fn: ast.AST) -> bool:
-        args = fn.args
-        return any(
-            a.arg == "self"
-            for a in (
-                *args.posonlyargs, *args.args, *args.kwonlyargs,
-                *([args.vararg] if args.vararg else []),
-                *([args.kwarg] if args.kwarg else []),
-            )
-        )
-
-    def method_scope_nodes(cls_node: ast.ClassDef) -> typing.List[ast.AST]:
-        """Nodes where ``self`` is THIS class's instance: method bodies,
-        minus nested ClassDefs and minus nested functions/lambdas that
-        rebind ``self``."""
-        out: typing.List[ast.AST] = []
-        stack: typing.List[ast.AST] = list(ast.iter_child_nodes(cls_node))
-        while stack:
-            node = stack.pop()
-            if isinstance(node, ast.ClassDef):
-                continue
-            if isinstance(
-                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
-            ) and node is not cls_node and rebinds_self(node) and node not in cls_node.body:
-                continue  # a callback with its own self
-            out.append(node)
-            stack.extend(ast.iter_child_nodes(node))
-        return out
-
     for cls_node in tree.body:  # module scope only: names resolve reliably
         if not isinstance(cls_node, ast.ClassDef):
             continue
         cls = namespace.get(cls_node.name)
         if not isinstance(cls, type) or _known_attrs(cls) is None:
             continue
-        for node in method_scope_nodes(cls_node):
+        for node in _method_scope_nodes(cls_node):
             if not (
                 isinstance(node, ast.Call)
                 and isinstance(node.func, ast.Attribute)
