@@ -1,17 +1,24 @@
 #!/usr/bin/env bash
 #
-# Refresh both north-star measurements on a healthy TPU:
+# The moment-of-tunnel-return playbook: refresh every on-chip artifact in
+# one run (the tunnel was down for all of rounds 3-4's driver windows).
+#
 #   1. bench.py (headline LSTM-AE sensor-timesteps/s) -> stdout JSON;
 #      copy into benchmarks/results_bench_tpu_r0N.json
 #   2. the 1000-machine fleet batch build -> copy into
-#      benchmarks/results_fleet_tpu_1000_r0N.json
-#
-# Context: the round-3 fleet optimizations (bulk unstack_all, persistent
-# sub-second compile cache, per-bucket offset probe — see
-# docs/performance.md) landed AFTER the checked-in fleet artifacts were
-# recorded, so a re-run on a healthy chip should far exceed the recorded
-# 2,789 models/hour. The tunnel was down from ~06:15 UTC 2026-07-31
-# through end of round 3, which is why this script exists.
+#      benchmarks/results_fleet_tpu_1000_r0N.json. Round-4 context: the
+#      step-count parity fix made CV fold fits ~2-3x cheaper ON TOP of
+#      the round-3 optimizations (bulk unstack_all, persistent compile
+#      cache, per-bucket offset probe), so expect well above the recorded
+#      2,789 models/hour — and fleet/solo reconstruction MAE should now
+#      agree to ~0.1%, with an aggregate mfu field in the JSON.
+#   3. profiler traces (dispatch gaps + device busy fraction) for one
+#      warm headline epoch and one warm fleet-bucket epoch -> paste the
+#      summaries into docs/performance.md next to the MFU figure.
+#   4. fleet-serving scaling 8..256 machines/request -> copy into
+#      benchmarks/results_fleet_serving_scale_tpu_r0N.json.
+#   5. optional time-unroll sweep for the fused LSTM scan (schedule-only
+#      knob; counterproductive on XLA-CPU, untested on TPU).
 
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -28,3 +35,17 @@ BENCH_BUDGET_S="${BENCH_BUDGET_S:-1400}" python bench.py
 echo "=== 1000-machine fleet batch build ===" >&2
 python benchmarks/fleet_throughput.py \
     --machines 1000 --buckets 3 --epochs 5 --sequential-sample 3
+
+echo "=== profiler traces (headline epoch + fleet bucket) ===" >&2
+python benchmarks/profile_trace.py --target bench
+python benchmarks/profile_trace.py --target fleet --machines 64
+
+echo "=== fleet-serving scaling (8..256 machines/request) ===" >&2
+python benchmarks/fleet_serving_scale.py
+
+if [ "${SWEEP_TIME_UNROLL:-0}" = "1" ]; then
+    for unroll in 1 2 4; do
+        echo "=== bench.py with BENCH_TIME_UNROLL=$unroll ===" >&2
+        BENCH_TIME_UNROLL=$unroll BENCH_BUDGET_S=900 python bench.py
+    done
+fi
