@@ -85,7 +85,12 @@ def _materialize_callbacks(raw) -> list:
     return out
 
 # attributes never pickled (compiled/jitted/device state)
-_EPHEMERAL_ATTRS = ("_apply_fn", "_train_epoch_fn", "_device_params")
+_EPHEMERAL_ATTRS = (
+    "_apply_fn",
+    "_train_epoch_fn",
+    "_device_params",
+    "_device_params_stacked",
+)
 
 
 def _batch_bucket(n: int, cap: Optional[int] = None, base: int = 4) -> int:
@@ -412,6 +417,7 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         self.n_features_ = X.shape[-1]
         self.n_features_out_ = y.shape[-1]
         self._apply_fn = None  # rebuilt lazily
+        self._device_params_stacked = None  # ditto (refit must not serve stale params)
         return self
 
     # -- predict ----------------------------------------------------------
@@ -487,11 +493,16 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         for attr in _EPHEMERAL_ATTRS:
             state.pop(attr, None)
         spec = state.get("spec_")
-        if spec is not None and hasattr(spec, "_shared_apply_fn"):
-            # jitted functions don't pickle; shallow-copy so the live
-            # (possibly fleet-shared) spec keeps its cached program
+        if spec is not None and (
+            hasattr(spec, "_shared_apply_fn") or hasattr(spec, "_serving_trainer")
+        ):
+            # jitted functions / compiled-program caches don't pickle;
+            # shallow-copy so the live (possibly fleet-shared) spec keeps
+            # its cached programs
             spec = copy.copy(spec)
-            del spec._shared_apply_fn
+            for attr in ("_shared_apply_fn", "_serving_trainer"):
+                if hasattr(spec, attr):
+                    delattr(spec, attr)
             state["spec_"] = spec
         if "params_" in state:
             state["params_"] = jax.device_get(state["params_"])

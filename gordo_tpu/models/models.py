@@ -18,9 +18,9 @@ from sklearn.base import TransformerMixin
 from sklearn.exceptions import NotFittedError
 from sklearn.metrics import explained_variance_score
 
-from gordo_tpu.models.core import BaseJaxEstimator
+from gordo_tpu.models.core import BaseJaxEstimator, _batch_bucket
 from gordo_tpu.models.specs import ModelSpec, SequentialNet, make_optimizer, resolve_dtype
-from gordo_tpu.ops.windowing import window_sample_indices
+from gordo_tpu.ops.windowing import num_windows
 
 # ensure factories register on import
 from gordo_tpu.models import factories  # noqa: F401
@@ -111,18 +111,55 @@ class LSTMBaseEstimator(BaseJaxEstimator, TransformerMixin):
         Returns (n_samples - lookback_window + 1 - lookahead) x n_features_out
         predictions, aligned so row i predicts the window ending at
         X[i + lookback_window - 1 + lookahead] (reference: models.py:550-595).
+
+        The raw (rows, features) frame ships to the device ONCE and the
+        windows are gathered inside the compiled program (chunked —
+        FleetTrainer's predict machinery with a fleet of one): a host-side
+        gather would transfer every row ``lookback_window`` times, the
+        dominant request cost on tunneled/PCIe links. Rows are padded to a
+        power-of-two bucket so jit sees a bounded set of shapes.
         """
         X = X.values if hasattr(X, "values") else np.asarray(X)
-        X = self._validate_and_fix_size_of_X(X)
-        idx = window_sample_indices(len(X), self.lookback_window, self.lookahead)
-        out_chunks = []
-        chunk = 10000
-        for start in range(0, len(idx), chunk):
-            windows = X[idx[start : start + chunk]]  # (chunk, lb, f)
-            out_chunks.append(self._forward(windows))
-        return (
-            np.concatenate(out_chunks, axis=0) if len(out_chunks) > 1 else out_chunks[0]
-        )
+        X = self._validate_and_fix_size_of_X(X).astype(np.float32, copy=False)
+        n_out = num_windows(len(X), self.lookback_window, self.lookahead)
+        if n_out <= 0:
+            # same loud contract as ops.windowing's index builder
+            raise ValueError(
+                f"Not enough timesteps ({len(X)}) for "
+                f"lookback_window={self.lookback_window}, "
+                f"lookahead={self.lookahead}"
+            )
+        bucket = _batch_bucket(len(X), cap=None, base=2)
+        if bucket > len(X):
+            X = np.pad(X, ((0, bucket - len(X)), (0, 0)))
+        trainer = self._spec_serving_trainer()
+        params = getattr(self, "_device_params_stacked", None)
+        if params is None:
+            import jax
+
+            params = jax.tree.map(lambda a: a[None], jax.device_put(self.params_))
+            self._device_params_stacked = params
+        out = trainer.predict(params, X[None])[0]
+        return np.asarray(out[:n_out])
+
+    def _spec_serving_trainer(self):
+        """
+        A FleetTrainer shared ON the spec (like the solo apply fn,
+        core.py): every estimator of a bucket reuses one set of compiled
+        chunked-window predict programs instead of tracing per estimator.
+        """
+        if not hasattr(self, "params_"):
+            raise NotFittedError(
+                f"This {self.__class__.__name__} has not been fitted yet."
+            )
+        spec = self.spec_
+        trainer = getattr(spec, "_serving_trainer", None)
+        if trainer is None or trainer.lookahead != self.lookahead:
+            from gordo_tpu.parallel.fleet import FleetTrainer
+
+            trainer = FleetTrainer(spec, lookahead=self.lookahead, donate=False)
+            spec._serving_trainer = trainer
+        return trainer
 
     def score(
         self,

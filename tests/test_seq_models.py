@@ -299,3 +299,27 @@ def test_unknown_attention_impl_raises():
             kind="transformer_model", attention_impl="nope", **SMALL_TRANSFORMER
         )
         model.fit(*make_data(60))
+
+
+def test_windowed_refit_serves_new_params():
+    """A refit must invalidate the device-resident stacked-param cache:
+    predictions after fit(X2) must come from the NEW params, not the
+    first fit's (regression guard for _device_params_stacked)."""
+    from gordo_tpu.models.models import LSTMAutoEncoder
+
+    rng = np.random.default_rng(0)
+    X1 = rng.random((60, 3)).astype("float32")
+    X2 = (10.0 + rng.random((60, 3))).astype("float32")
+
+    model = LSTMAutoEncoder(
+        kind="lstm_model", lookback_window=5, encoding_dim=(4,),
+        encoding_func=("tanh",), decoding_dim=(4,), decoding_func=("tanh",),
+        epochs=2,
+    )
+    model.fit(X1, X1)
+    out1 = model.predict(X1)
+    model.fit(X2, X2)
+    out2 = model.predict(X1)
+    # params changed (X2's scale forces different weights); identical
+    # outputs would mean the stale stacked cache served the old model
+    assert not np.allclose(out1, out2)
