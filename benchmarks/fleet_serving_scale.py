@@ -27,22 +27,37 @@ honor_jax_platforms_env()
 enable_compile_cache()
 
 
-def build_estimators(n_machines: int, n_features: int, n_rows: int):
-    """n trained same-architecture AutoEncoders — trained as ONE fleet
-    program (1 epoch; serving cost does not depend on fit quality)."""
+def build_estimators(
+    n_machines: int, n_features: int, n_rows: int, model: str = "hourglass"
+):
+    """n trained same-architecture estimators — trained as ONE fleet
+    program (1 epoch; serving cost does not depend on fit quality).
+    ``model``: "hourglass" (dense AE) or "lstm" (windowed; exercises the
+    on-device window gather in the serving path)."""
     import numpy as np
 
     from gordo_tpu.models.core import solo_init_key
-    from gordo_tpu.models.models import AutoEncoder
+    from gordo_tpu.models.models import AutoEncoder, LSTMAutoEncoder
     from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
 
     rng = np.random.default_rng(0)
     Xs = [rng.random((n_rows, n_features)).astype("float32") for _ in range(n_machines)]
 
-    proto = AutoEncoder(kind="feedforward_hourglass")
+    if model == "lstm":
+        def make():
+            return LSTMAutoEncoder(
+                kind="lstm_model", lookback_window=16,
+                encoding_dim=(32,), encoding_func=("tanh",),
+                decoding_dim=(32,), decoding_func=("tanh",), fused=True,
+            )
+    else:
+        def make():
+            return AutoEncoder(kind="feedforward_hourglass")
+
+    proto = make()
     proto.kwargs.update({"n_features": n_features, "n_features_out": n_features})
     spec = proto._build_spec()
-    trainer = FleetTrainer(spec)
+    trainer = FleetTrainer(spec, lookahead=proto.lookahead if spec.windowed else 0)
     data = StackedData.from_ragged(Xs, [x.copy() for x in Xs])
     keys = np.stack([np.asarray(solo_init_key(0))] * n_machines)
     params, _ = trainer.fit(data, keys, epochs=1, batch_size=64)
@@ -50,7 +65,7 @@ def build_estimators(n_machines: int, n_features: int, n_rows: int):
 
     estimators = {}
     for i in range(n_machines):
-        est = AutoEncoder(kind="feedforward_hourglass")
+        est = make()
         est.kwargs.update({"n_features": n_features, "n_features_out": n_features})
         est.spec_ = spec
         est.params_ = host[i]
@@ -66,6 +81,7 @@ def main():
     parser.add_argument("--rows", type=int, default=100, help="rows per machine")
     parser.add_argument("--features", type=int, default=4)
     parser.add_argument("--rounds", type=int, default=20)
+    parser.add_argument("--model", choices=["hourglass", "lstm"], default="hourglass")
     args = parser.parse_args()
 
     import numpy as np
@@ -78,7 +94,7 @@ def main():
     rng = np.random.default_rng(1)
     table = []
     for size in args.sizes:
-        estimators = build_estimators(size, args.features, 256)
+        estimators = build_estimators(size, args.features, 256, model=args.model)
         scorer = FleetScorer(estimators)  # params stacked + device-resident
         inputs = {
             name: rng.random((args.rows, args.features)).astype("float32")
@@ -89,7 +105,13 @@ def main():
         for _ in range(args.rounds):
             out = scorer.predict(inputs)
         total = time.perf_counter() - start
-        assert len(out) == size and all(len(v) == args.rows for v in out.values())
+        # windowed models emit rows - lookback + 1 - lookahead outputs
+        proto = next(iter(estimators.values()))
+        if getattr(proto.spec_, "windowed", False):
+            expected = args.rows - proto.lookback_window + 1 - proto.lookahead
+        else:
+            expected = args.rows
+        assert len(out) == size and all(len(v) == expected for v in out.values())
         ms_request = total / args.rounds * 1000
         table.append(
             {
@@ -106,6 +128,7 @@ def main():
             {
                 "platform": device.platform,
                 "device_kind": device.device_kind,
+                "model": args.model,
                 "rows_per_machine": args.rows,
                 "rounds": args.rounds,
                 "scaling": table,
