@@ -33,6 +33,29 @@ honor_jax_platforms_env()
 enable_compile_cache()
 
 
+def self_times(evs) -> dict:
+    """Per-op SELF time on one thread lane: each event's duration minus
+    the durations of events nested inside it (same-lane children) — a
+    parent op must not double-count its children."""
+    ordered = sorted(evs, key=lambda e: (e["ts"], -e["dur"]))
+    totals: dict = {}
+    stack: list = []  # (end_ts, name, accumulator index)
+    accum: list = []
+    for ev in ordered:
+        start, end = ev["ts"], ev["ts"] + ev["dur"]
+        while stack and start >= stack[-1][0]:
+            _end, name, idx = stack.pop()
+            totals[name] = totals.get(name, 0.0) + accum[idx]
+        if stack:
+            accum[stack[-1][2]] -= ev["dur"]  # charge child to the parent
+        accum.append(ev["dur"])
+        stack.append((end, ev["name"], len(accum) - 1))
+    while stack:
+        _end, name, idx = stack.pop()
+        totals[name] = totals.get(name, 0.0) + accum[idx]
+    return totals
+
+
 def summarize_chrome_trace(trace_dir: str, top_n: int = 10) -> dict:
     """
     Parse the profiler's ``*.trace.json.gz`` into lane-level busy/gap
@@ -83,19 +106,21 @@ def summarize_chrome_trace(trace_dir: str, top_n: int = 10) -> dict:
         return total
 
     lanes = {}
-    op_totals: dict = {}
     for ev in complete:
         pid, tid = ev.get("pid"), ev.get("tid")
         pname = process_names.get(pid, "")
         tname = thread_names.get((pid, tid), "")
-        # device execution lanes: a device process ("/device:TPU:0" with
-        # its "XLA Ops" threads) or, on the CPU backend, the PjRt client
-        # executor threads ("tf_XLAPjRtCpuClient/...")
-        is_device = pname.startswith("/device:") or "XLA" in tname or "XLA" in pname
+        # device execution lanes, keyed narrowly: a device PROCESS
+        # ("/device:TPU:0", whose threads are the XLA op streams) or, on
+        # the CPU backend, the PjRt executor thread pools specifically —
+        # NOT any thread that merely mentions XLA (host-side launch
+        # threads would inflate the busy fraction)
+        is_device = pname.startswith("/device:") or tname.startswith(
+            ("tf_XLAPjRt", "tf_XLAEigen", "XLA Ops")
+        )
         lanes.setdefault((pid, tid, is_device, pname, tname), []).append(ev)
-        if is_device:
-            op_totals[ev["name"]] = op_totals.get(ev["name"], 0.0) + ev["dur"]
 
+    op_totals: dict = {}
     device_lanes = []
     for (pid, tid, is_device, pname, tname), evs in lanes.items():
         if not is_device:
@@ -110,6 +135,8 @@ def summarize_chrome_trace(trace_dir: str, top_n: int = 10) -> dict:
                 "events": len(evs),
             }
         )
+        for name, self_us in self_times(evs).items():
+            op_totals[name] = op_totals.get(name, 0.0) + self_us
     top_ops = sorted(op_totals.items(), key=lambda kv: -kv[1])[:top_n]
     return {
         "span_us": round(span_us, 1),

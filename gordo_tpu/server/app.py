@@ -899,8 +899,11 @@ def _preload_models(app: "GordoApp") -> None:
     for name in names[:capacity]:
         try:
             model = server_utils.load_model(collection_dir, name)
-            warmed = _warm_model(model)
+            # keep the loaded model even if its warmup forward fails —
+            # dropping it would make the fleet-scorer preload below pay a
+            # second deserialize from disk for an already-resident model
             loaded[name] = model
+            warmed = _warm_model(model)
             logger.info(
                 "Preloaded model %s%s", name, "" if warmed else " (no warmup)"
             )

@@ -77,10 +77,18 @@ def _host_cpu_fingerprint() -> str:
 
     material = platform.machine()
     try:
+        # BOTH the model name and the feature flags: XLA derives target
+        # features from the CPU model (e.g. prefer-no-scatter) that the
+        # flags line alone does not capture, so two hosts with identical
+        # flags but different silicon must still hash apart
+        wanted = {"flags": False, "Features": False, "model name": False}
         with open("/proc/cpuinfo") as fh:
             for line in fh:
-                if line.startswith(("flags", "Features")):
-                    material += line
+                for prefix, seen in wanted.items():
+                    if not seen and line.startswith(prefix):
+                        material += line
+                        wanted[prefix] = True
+                if all(wanted.values()):
                     break
     except OSError:
         material += platform.processor() or ""

@@ -690,9 +690,14 @@ def check_self_attributes(tree: ast.Module, module) -> typing.List[str]:
                 )
                 node = target
             if is_read and node.attr not in known:
+                aug_only = node.attr in _AUG_ONLY_CANDIDATES.get(cls, set())
+                detail = (
+                    " (only ever aug-assigned: self.X += ... reads X "
+                    "before writing)" if aug_only else ""
+                )
                 problems.append(
                     f"line {node.lineno}: self.{node.attr} is not on "
-                    f"{cls_node.name}'s attribute surface"
+                    f"{cls_node.name}'s attribute surface{detail}"
                 )
     return problems
 

@@ -98,13 +98,6 @@ def test_two_process_fleet_step_executes():
     two epochs across both processes' devices, and agree on the global
     losses (fleet.host_fetch allgathers host reads of global arrays).
     """
-    try:
-        with socket.socket() as probe:
-            probe.bind(("localhost", 0))
-            port = probe.getsockname()[1]
-    except OSError as exc:  # no localhost sockets in this sandbox
-        pytest.skip(f"cannot bind localhost sockets: {exc}")
-
     worker = os.path.join(os.path.dirname(__file__), "_distributed_worker.py")
     env = {
         k: v
@@ -112,26 +105,45 @@ def test_two_process_fleet_step_executes():
         # the workers pin their own platform/device-count flags
         if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
     }
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(port), str(pid), "2"],
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            env=env,
-        )
-        for pid in range(2)
-    ]
-    outs = []
-    try:
-        for proc in procs:
-            out, err = proc.communicate(timeout=300)
-            outs.append(out)
-            assert proc.returncode == 0, f"worker failed:\n{out}\n{err[-3000:]}"
-    finally:
-        for proc in procs:
-            if proc.poll() is None:
-                proc.kill()
+
+    def launch_cluster():
+        try:
+            with socket.socket() as probe:
+                probe.bind(("localhost", 0))
+                port = probe.getsockname()[1]
+        except OSError as exc:  # no localhost sockets in this sandbox
+            pytest.skip(f"cannot bind localhost sockets: {exc}")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, worker, str(port), str(pid), "2"],
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                env=env,
+            )
+            for pid in range(2)
+        ]
+        outs, errs, codes = [], [], []
+        try:
+            for proc in procs:
+                out, err = proc.communicate(timeout=300)
+                outs.append(out)
+                errs.append(err)
+                codes.append(proc.returncode)
+        finally:
+            for proc in procs:
+                if proc.poll() is None:
+                    proc.kill()
+        return outs, errs, codes
+
+    outs, errs, codes = launch_cluster()
+    if any(codes):
+        # the probed port can be taken between probe close and the
+        # coordinator bind (parallel suites on one host): one retry with
+        # a fresh port distinguishes that race from a real failure
+        outs, errs, codes = launch_cluster()
+    for out, err, code in zip(outs, errs, codes):
+        assert code == 0, f"worker failed:\n{out}\n{err[-3000:]}"
 
     results: dict = {}
     dp_results: dict = {}

@@ -34,3 +34,31 @@ def test_summarize_chrome_trace_real_capture(tmp_path):
         assert lane["events"] > 0
     assert summary["top_device_ops_us"]
     assert all(op["total_us"] >= 0 for op in summary["top_device_ops_us"])
+
+
+def test_self_times_subtracts_nested_children():
+    """An op's reported time is SELF time: nested child durations are
+    charged to the children, not double-counted into the parent."""
+    from profile_trace import self_times
+
+    lane = [
+        {"ts": 0, "dur": 100, "name": "parent"},
+        {"ts": 10, "dur": 30, "name": "child"},
+        {"ts": 50, "dur": 20, "name": "child"},
+        {"ts": 200, "dur": 40, "name": "parent"},
+    ]
+    totals = self_times(lane)
+    # parent self = (100 - 30 - 20) + 40; children keep their own time
+    assert totals == {"parent": 90.0, "child": 50.0}
+
+
+def test_self_times_nested_grandchildren():
+    """A grandchild is charged to its DIRECT parent only."""
+    from profile_trace import self_times
+
+    lane = [
+        {"ts": 0, "dur": 100, "name": "a"},
+        {"ts": 10, "dur": 50, "name": "b"},
+        {"ts": 20, "dur": 10, "name": "c"},
+    ]
+    assert self_times(lane) == {"a": 50.0, "b": 40.0, "c": 10.0}
