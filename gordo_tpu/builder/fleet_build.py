@@ -529,6 +529,9 @@ class FleetModelBuilder:
 
     @staticmethod
     def _apply_thresholds(model: DiffBasedAnomalyDetector, fold_records: dict, i: int):
+        # observability parity with the solo cv-fast-path flag: this
+        # detector's thresholds came from the bucket's vmapped fold masks
+        model.cv_fleet_masks_ = True
         model.feature_thresholds_ = fold_records["tag_thresholds"][i]
         agg = fold_records["agg_thresholds"][i]
         model.aggregate_threshold_ = float(agg) if agg is not None else None

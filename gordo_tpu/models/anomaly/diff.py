@@ -101,6 +101,11 @@ class DiffBasedAnomalyDetector(AnomalyDetectorBase):
             # surfaced into BuildMetadata so a silent degradation to the
             # 3x-slower sequential path is visible in build artifacts
             metadata["cv-fast-path"] = bool(self.cv_fast_path_)
+        if hasattr(self, "cv_fleet_masks_"):
+            # fleet-built detectors calibrate thresholds via fold masks
+            # inside the bucket's vmapped program (builder/fleet_build.py)
+            # — the fleet counterpart of the solo fast-path flag
+            metadata["cv-fleet-masks"] = bool(self.cv_fleet_masks_)
         if (
             getattr(self, "smooth_feature_thresholds_", None) is not None
         ):

@@ -702,3 +702,14 @@ def test_fleet_build_rejects_machine_too_short_for_window():
     machines = [lstm_machine("long-enough", 12), lstm_machine("too-short", 1)]
     with pytest.raises(InsufficientDataError, match="too-short"):
         FleetModelBuilder(machines).build()
+
+
+def test_fleet_built_detector_records_cv_mode(tmp_path):
+    """Fleet-built anomaly detectors record their CV mode in metadata
+    (cv-fleet-masks), the fleet counterpart of the solo cv-fast-path
+    observability flag."""
+    model, machine = FleetModelBuilder(make_machines(1, epochs=1)).build()[0]
+    meta = model.get_metadata()
+    assert meta.get("cv-fleet-masks") is True
+    build_meta = machine.metadata.build_metadata.model.model_meta
+    assert build_meta.get("cv-fleet-masks") is True
