@@ -381,3 +381,38 @@ def test_fleet_anomaly_bad_multipart_key_is_explained(
     )
     assert resp.status_code == 400
     assert ".X" in json.loads(resp.get_data())["error"]
+
+
+def test_windowed_anomaly_from_fleet_output_matches_direct():
+    """The anomaly frame assembled from a FLEET-precomputed model output
+    (the batched anomaly endpoint's path) must equal the frame the
+    detector builds from its own predict — for WINDOWED models, where the
+    output is shorter than the input and the y tail alignment is the
+    subtle part."""
+    import pandas as pd
+
+    from gordo_tpu.models.anomaly import DiffBasedAnomalyDetector
+
+    est = _train(
+        LSTMAutoEncoder, kind="lstm_hourglass", lookback_window=6, epochs=1
+    )
+    detector = DiffBasedAnomalyDetector(
+        base_estimator=est, require_thresholds=False
+    )
+    rng = np.random.default_rng(5)
+    n = 30
+    idx = pd.date_range("2020-01-01", periods=n, freq="10min", tz="UTC")
+    X = pd.DataFrame(
+        rng.random((n, 4)).astype("float32"),
+        index=idx,
+        columns=[f"t{i}" for i in range(4)],
+    )
+    detector.scaler.fit(X)
+
+    scorer = FleetScorer({"m": est})
+    fleet_out = scorer.predict({"m": X.to_numpy()})["m"]
+    assert len(fleet_out) == n - 6 + 1
+
+    via_fleet = detector.anomaly(X, X, model_output=fleet_out)
+    direct = detector.anomaly(X, X)
+    pd.testing.assert_frame_equal(via_fleet, direct, rtol=1e-4, atol=1e-6)
