@@ -160,14 +160,30 @@ class DataFrameClient(InfluxDBClient):
             self.write(lines[start : start + (batch_size or len(lines))])
         return True
 
-    def query(self, query: str, **kwargs) -> Dict[str, pd.DataFrame]:
+    def query(self, query: str, **kwargs) -> "FrameResult":
         raw = self._request(
             "GET", "/query", {"db": self._database or "", "q": query}
         )
-        frames: Dict[str, pd.DataFrame] = {}
+        frames = FrameResult(raw)
         for result in raw.get("results", []):
             for series in result.get("series", []):
                 frame = pd.DataFrame(series["values"], columns=series["columns"])
-                frame["time"] = pd.to_datetime(frame["time"], utc=True)
-                frames[series["name"]] = frame.set_index("time")
+                if "time" in frame.columns:
+                    frame["time"] = pd.to_datetime(frame["time"], utc=True)
+                    frame = frame.set_index("time")
+                frames[series["name"]] = frame
         return frames
+
+
+class FrameResult(dict):
+    """DataFrameClient query result: measurement -> DataFrame mapping that
+    ALSO answers ``get_points()`` from the raw JSON — the framework's
+    provider uses dict access for SELECTs and point iteration for SHOW
+    TAG VALUES (as the reference does on the real client)."""
+
+    def __init__(self, raw: dict):
+        super().__init__()
+        self._raw = raw
+
+    def get_points(self) -> Iterable[dict]:
+        return ResultSet(self._raw).get_points()

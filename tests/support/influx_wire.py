@@ -250,6 +250,31 @@ class _Handler(BaseHTTPRequestHandler):
         if dm := re.match(r'^\s*DROP DATABASE\s+"?([^"]+)"?\s*$', query, re.I):
             self.state.databases.pop(dm.group(1), None)
             return self._respond(200, {"results": [{"statement_id": 0}]})
+        if sm := re.match(
+            r'^\s*SHOW TAG VALUES(?:\s+ON\s+"?([^"\s]+)"?)?\s+WITH KEY\s*=\s*'
+            r'"?([^"\s]+)"?\s*$',
+            query,
+            re.I,
+        ):
+            on_db, key = sm.group(1) or db, sm.group(2)
+            per_measurement: Dict[str, set] = {}
+            for point in self.state.databases.get(on_db, []):
+                if key in point.tags:
+                    per_measurement.setdefault(point.measurement, set()).add(
+                        point.tags[key]
+                    )
+            series = [
+                {
+                    "name": measurement,
+                    "columns": ["key", "value"],
+                    "values": [[key, v] for v in sorted(values)],
+                }
+                for measurement, values in sorted(per_measurement.items())
+            ]
+            result: dict = {"statement_id": 0}
+            if series:
+                result["series"] = series
+            return self._respond(200, {"results": [result]})
         try:
             series = run_select(self.state.databases.get(db, []), query)
         except ValueError as exc:

@@ -102,3 +102,30 @@ def test_line_protocol_roundtrip_escaping():
     assert point.tags == {"sensor name": "GRA TAG,1=x"}
     assert point.fields == {"value": 1.5, "note": 'a "b"'}
     assert point.time_ns == 1577836800000000000
+
+
+def test_influx_provider_tag_listing_wire(influx_server):
+    """SHOW TAG VALUES over the wire: get_list_of_tags / can_handle_tag
+    execute against the line-protocol store (the reference runs the same
+    .get_points() iteration on its real client)."""
+    import pandas as pd
+
+    from gordo_tpu.data.providers.influx import InfluxDataProvider
+    from gordo_tpu.data.sensor_tag import SensorTag
+    from gordo_tpu.client.utils import influx_client_from_uri
+
+    uri = f"root:root@localhost:{influx_server}/tagdb"
+    client = influx_client_from_uri(uri, dataframe_client=True, recreate=True)
+    idx = pd.date_range("2021-01-01", periods=4, freq="10min", tz="UTC")
+    for tag in ("WIRE-TAG 1", "WIRE-TAG 2"):
+        client.write_points(
+            dataframe=pd.DataFrame({"Value": [1.0] * len(idx), "tag": tag}, index=idx),
+            measurement="sensor-data",
+            tag_columns=["tag"],
+            field_columns=["Value"],
+        )
+
+    provider = InfluxDataProvider(measurement="sensor-data", uri=uri)
+    assert sorted(provider.get_list_of_tags()) == ["WIRE-TAG 1", "WIRE-TAG 2"]
+    assert provider.can_handle_tag(SensorTag("WIRE-TAG 1", None))
+    assert not provider.can_handle_tag(SensorTag("NOPE", None))
