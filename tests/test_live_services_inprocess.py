@@ -129,3 +129,55 @@ def test_influx_provider_tag_listing_wire(influx_server):
     assert sorted(provider.get_list_of_tags()) == ["WIRE-TAG 1", "WIRE-TAG 2"]
     assert provider.can_handle_tag(SensorTag("WIRE-TAG 1", None))
     assert not provider.can_handle_tag(SensorTag("NOPE", None))
+
+
+def test_client_predicts_and_forwards_into_influx_wire(
+    wire_shims, influx_server, model_collection_env
+):
+    """The FULL production chain over real wire formats: Client pulls
+    data, POSTs to a live test server, and forwards every anomaly frame
+    into influx through ForwardPredictionsIntoInflux — then the points
+    are queried back. The reference exercises this chain against
+    dockerized influx (tests/conftest.py fixtures); this is the in-image
+    edition."""
+    import dateutil.parser
+
+    from gordo_tpu.client import Client
+    from gordo_tpu.client.forwarders import ForwardPredictionsIntoInflux
+    from gordo_tpu.client.utils import influx_client_from_uri
+    from gordo_tpu.data.providers import RandomDataProvider
+    from tests.conftest import GORDO_PROJECT, GORDO_SINGLE_TARGET, GORDO_TARGETS
+    from tests.utils import loopback_session
+
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.clear_caches()
+    ml_server = build_app()
+    uri = f"root:root@localhost:{influx_server}/clientdb"
+    forwarder = ForwardPredictionsIntoInflux(
+        destination_influx_uri=uri, destination_influx_recreate=True
+    )
+    client = Client(
+        project=GORDO_PROJECT,
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(ml_server),
+        prediction_forwarder=forwarder,
+        parallelism=2,
+    )
+    results = client.predict(
+        dateutil.parser.isoparse("2019-01-01T00:00:00+00:00"),
+        dateutil.parser.isoparse("2019-01-01T08:00:00+00:00"),
+        targets=GORDO_TARGETS,
+    )
+    (name, predictions, errors) = results[0]
+    assert name == GORDO_SINGLE_TARGET and errors == []
+
+    reader = influx_client_from_uri(uri, dataframe_client=False)
+    points = list(reader.query('SELECT * FROM "model-output"').get_points())
+    assert points, "no forwarded points arrived over the wire"
+    assert all(p["machine"] == GORDO_SINGLE_TARGET for p in points)
+    # every predicted row landed (one point per row per sensor column)
+    sensors = {p["sensor_name"] for p in points}
+    assert len(points) == len(predictions) * len(sensors)
