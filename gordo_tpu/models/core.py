@@ -494,13 +494,13 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
             state.pop(attr, None)
         spec = state.get("spec_")
         if spec is not None and (
-            hasattr(spec, "_shared_apply_fn") or hasattr(spec, "_serving_trainer")
+            hasattr(spec, "_shared_apply_fn") or hasattr(spec, "_serving_trainers")
         ):
             # jitted functions / compiled-program caches don't pickle;
             # shallow-copy so the live (possibly fleet-shared) spec keeps
             # its cached programs
             spec = copy.copy(spec)
-            for attr in ("_shared_apply_fn", "_serving_trainer"):
+            for attr in ("_shared_apply_fn", "_serving_trainers"):
                 if hasattr(spec, attr):
                     delattr(spec, attr)
             state["spec_"] = spec

@@ -153,12 +153,15 @@ class LSTMBaseEstimator(BaseJaxEstimator, TransformerMixin):
                 f"This {self.__class__.__name__} has not been fitted yet."
             )
         spec = self.spec_
-        trainer = getattr(spec, "_serving_trainer", None)
-        if trainer is None or trainer.lookahead != self.lookahead:
+        trainers = getattr(spec, "_serving_trainers", None)
+        if trainers is None:
+            trainers = spec._serving_trainers = {}
+        trainer = trainers.get(self.lookahead)
+        if trainer is None:
             from gordo_tpu.parallel.fleet import FleetTrainer
 
             trainer = FleetTrainer(spec, lookahead=self.lookahead, donate=False)
-            spec._serving_trainer = trainer
+            trainers[self.lookahead] = trainer
         return trainer
 
     def score(

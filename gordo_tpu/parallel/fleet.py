@@ -318,12 +318,14 @@ class FleetTrainer:
             """
             wb_all = sample_weights(wi)            # (n_samples,)
             real = wb_all > 0
-            ar = jnp.arange(n_samples, dtype=jnp.float32)
             if shuffle:
                 noise = jax.random.uniform(key, (n_samples,))
                 sort_key = jnp.where(real, noise, 2.0 + noise)
             else:
-                # stable: real samples keep their time order up front
+                # stable: real samples keep their time order up front.
+                # int32 keys: float32 arange collides above 2^24 samples,
+                # which could misplace a real sample past the scan cap.
+                ar = jnp.arange(n_samples, dtype=jnp.int32)
                 sort_key = jnp.where(real, ar, n_samples + ar)
             order = jnp.argsort(sort_key).astype(jnp.int32)
             if n_pad > n_samples:

@@ -969,8 +969,23 @@ def _preload_fleet_scorer(
         logger.warning("Fleet-scorer preload failed: %s", exc)
         return
     stacked_names = sorted(set(estimators) | set(fallback))
+    if stacked_names != sorted(names):
+        # whole-collection requests name every model dir, so their cache
+        # key won't match this partial one: the entry would sit resident
+        # but unused until a full build replaces it
+        logger.warning(
+            "Fleet-scorer preload is partial (%d of %d models loaded): "
+            "whole-collection requests will rebuild the scorer (missing: %s)",
+            len(stacked_names),
+            len(set(names)),
+            sorted(set(names) - set(stacked_names)),
+        )
     key = (os.path.realpath(collection_dir), tuple(stacked_names))
     with app._fleet_scorers_lock:
+        # same bound as the lazy path; overwriting an existing key needs
+        # no eviction
+        if key not in app._fleet_scorers and len(app._fleet_scorers) >= 16:
+            app._fleet_scorers.pop(next(iter(app._fleet_scorers)))
         app._fleet_scorers[key] = (scorer, prefixes, fallback)
     logger.info(
         "Preloaded fleet scorer: %d machines in %d groups (%d fallback)",

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+#
+# Round-5 tunnel watcher: probe the accelerator every ~9 minutes, appending
+# each result to scripts/tunnel_probe.log (UTC-timestamped, one line per
+# probe). Exits 0 the moment a probe succeeds (so the supervising session is
+# re-invoked to run scripts/remeasure_tpu.sh), exits 3 when the probe budget
+# is exhausted with the tunnel still down.
+#
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+LOG=scripts/tunnel_probe.log
+MAX_PROBES="${MAX_PROBES:-70}"      # ~10.5h at 9-minute spacing
+SLEEP_S="${SLEEP_S:-540}"
+
+for i in $(seq 1 "$MAX_PROBES"); do
+    ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
+    if timeout 90 python -c "import jax; d=jax.devices(); assert d and d[0].platform=='tpu', d; print(d)" >/dev/null 2>&1; then
+        echo "$ts probe $i/$MAX_PROBES: UP" >> "$LOG"
+        exit 0
+    else
+        echo "$ts probe $i/$MAX_PROBES: down" >> "$LOG"
+    fi
+    sleep "$SLEEP_S"
+done
+exit 3
