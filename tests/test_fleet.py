@@ -561,6 +561,16 @@ def test_fleet_model_builder_end_to_end(tmp_path):
         assert loaded.predict(idx).shape == (10, 3)
 
 
+def reconstruction_mae(model, machine):
+    """Window-aligned MAE of a built model on its own training data."""
+    from gordo_tpu.data import _get_dataset
+
+    X, y = _get_dataset(machine.dataset.to_dict()).get_data()
+    predicted = model.predict(X)
+    target = np.asarray(y)[-len(predicted):]
+    return float(np.abs(np.asarray(predicted) - target).mean())
+
+
 def test_fleet_solo_build_quality_parity():
     """
     The SAME machine built solo (ModelBuilder) and via FleetModelBuilder
@@ -570,13 +580,6 @@ def test_fleet_solo_build_quality_parity():
     plus divergent init keys; measured post-fix difference is ~0.1%.)
     """
     from gordo_tpu.builder.build_model import ModelBuilder
-    from gordo_tpu.data import _get_dataset
-
-    def reconstruction_mae(model, machine):
-        X, y = _get_dataset(machine.dataset.to_dict()).get_data()
-        predicted = model.predict(X)
-        target = np.asarray(y)[-len(predicted):]
-        return float(np.abs(np.asarray(predicted) - target).mean())
 
     fleet_model, fleet_machine = FleetModelBuilder(make_machines(1, epochs=3)).build()[0]
     solo_model, solo_machine = ModelBuilder(make_machines(1, epochs=3)[0]).build()
@@ -587,6 +590,60 @@ def test_fleet_solo_build_quality_parity():
     # and the training histories themselves must be in the same regime
     from gordo_tpu.builder.fleet_build import _find_jax_estimator
 
+    fleet_loss = _find_jax_estimator(fleet_model).history_["loss"]
+    solo_loss = _find_jax_estimator(solo_model).history_["loss"]
+    np.testing.assert_allclose(fleet_loss, solo_loss, rtol=0.10)
+
+
+@pytest.mark.parametrize(
+    "model_cls, kind",
+    [
+        # lookahead-0 reconstructor and the fused-GRU family: window counts
+        # interact with batch packing, so these have step-count-sensitive
+        # semantics of their own beyond the feedforward case pinned above
+        ("gordo_tpu.models.LSTMAutoEncoder", "lstm_hourglass"),
+        ("gordo_tpu.models.GRUAutoEncoder", "gru_hourglass"),
+    ],
+)
+def test_fleet_solo_build_quality_parity_windowed(model_cls, kind):
+    """
+    Same contract as test_fleet_solo_build_quality_parity, for the windowed
+    families (reference builds every family through the one path,
+    gordo/builder/build_model.py:160-303): the SAME machine built solo and
+    via the fleet must agree on reconstruction MAE (<=10%) and loss regime.
+    """
+    from gordo_tpu.builder.build_model import ModelBuilder
+    from gordo_tpu.builder.fleet_build import _find_jax_estimator
+
+    def make_machine():
+        return Machine(
+            name="windowed-parity",
+            model={
+                "gordo_tpu.models.anomaly.DiffBasedAnomalyDetector": {
+                    "base_estimator": {
+                        model_cls: {
+                            "kind": kind,
+                            "lookback_window": 6,
+                            "epochs": 3,
+                        }
+                    }
+                }
+            },
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": "2017-12-25 06:00:00Z",
+                "train_end_date": "2017-12-26 06:00:00Z",
+                "tags": [[f"Tag {t}", None] for t in range(3)],
+            },
+            project_name="fleet-proj",
+        )
+
+    fleet_model, fleet_machine = FleetModelBuilder([make_machine()]).build()[0]
+    solo_model, solo_machine = ModelBuilder(make_machine()).build()
+
+    fleet_mae = reconstruction_mae(fleet_model, fleet_machine)
+    solo_mae = reconstruction_mae(solo_model, solo_machine)
+    assert abs(fleet_mae - solo_mae) <= 0.10 * solo_mae
     fleet_loss = _find_jax_estimator(fleet_model).history_["loss"]
     solo_loss = _find_jax_estimator(solo_model).history_["loss"]
     np.testing.assert_allclose(fleet_loss, solo_loss, rtol=0.10)
