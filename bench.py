@@ -142,6 +142,14 @@ def bench_jax(n_timesteps: int, epochs: int) -> dict:
         # schedule-only time-scan unroll for on-chip sweeps (default 1:
         # measured counterproductive on XLA-CPU, untested on TPU)
         time_unroll=int(os.environ.get("BENCH_TIME_UNROLL", "1")),
+        # one-scan streaming schedule off-TPU: XLA:CPU runs the hoisted
+        # skinny-K projections bandwidth-bound (~40 GF/s) while per-step
+        # gemms hit ~121 GF/s, and the inter-layer sequence buffers never
+        # materialize; on TPU the hoisted MXU schedule stays the default.
+        # Math is identical either way (tests/test_fused_lstm.py).
+        schedule=os.environ.get(
+            "BENCH_SCHEDULE", "layer" if on_tpu else "stacked"
+        ),
     )
     trainer = FleetTrainer(spec, lookahead=0, donate=True)
     keys = trainer.machine_keys(1)

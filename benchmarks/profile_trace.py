@@ -173,6 +173,10 @@ def trace_bench_epoch(trace_dir: str, n_timesteps: int) -> dict:
         decoding_func=("tanh",) * len(bench_mod.DEC),
         dtype="bfloat16" if on_tpu else "float32",
         fused=True,
+        time_unroll=int(os.environ.get("BENCH_TIME_UNROLL", "1")),
+        schedule=os.environ.get(
+            "BENCH_SCHEDULE", "layer" if on_tpu else "stacked"
+        ),
     )
     trainer = FleetTrainer(spec, lookahead=0, donate=True)
     keys = trainer.machine_keys(1)

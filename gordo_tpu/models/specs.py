@@ -154,6 +154,37 @@ class FeedForwardNet(nn.Module):
         return resolve_activation(self.out_func)(x).astype(jnp.float32), penalty
 
 
+def lstm_cell_step(c, h, z_t, w_h, b_h, act, dtype):
+    """
+    One LSTM timestep from pre-projected input ``z_t`` (gate order
+    [i, f, g, o], sigmoid gates, ``act`` on g and the cell output):
+    matmul in ``dtype`` (MXU); gate math + cell state in float32, matching
+    OptimizedLSTMCell's float32 (param_dtype) carry. Shared by both the
+    per-layer and the stacked schedules so the cell math lives ONCE.
+    """
+    gates = (z_t + h.astype(dtype) @ w_h + b_h).astype(jnp.float32)
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = nn.sigmoid(i), nn.sigmoid(f), nn.sigmoid(o)
+    c = f * c + i * act(g)
+    h = o * act(c)
+    return c, h
+
+
+def gru_cell_step(h, z_t, w_rz, w_n, b_n, act, dtype, h_dim):
+    """
+    One GRU timestep from pre-projected input ``z_t`` (r/z sigmoid gates,
+    ``act`` on the candidate, reset gate applied to the PROJECTED hidden
+    state, ``h' = (1-z)*n + z*h`` — GRUCell's convention); float32 gate
+    math like lstm_cell_step. Shared by both schedules.
+    """
+    hd = h.astype(dtype)
+    rz = (z_t[..., : 2 * h_dim] + hd @ w_rz).astype(jnp.float32)
+    r, zg = jnp.split(nn.sigmoid(rz), 2, axis=-1)
+    hn = (hd @ w_n).astype(jnp.float32) + b_n
+    n = act(z_t[..., 2 * h_dim :].astype(jnp.float32) + r * hn)
+    return (1.0 - zg) * n + zg * h
+
+
 class FusedLSTMLayer(nn.Module):
     """
     LSTM layer with the input projection hoisted OUT of the time scan: the
@@ -172,15 +203,28 @@ class FusedLSTMLayer(nn.Module):
     # cost in the CPU fallback's trace) and loop overhead; a pure
     # schedule knob — the math is step-for-step identical
     unroll: int = 1
+    # time_major=True: x is (time, batch, f) and the output sequence comes
+    # back (time, batch, h) — the scan consumes/produces that layout
+    # natively, so a stacked time-major net does ZERO per-layer physical
+    # transposes (the round-4 CPU trace showed those copies out-costing
+    # the matmuls, docs/performance.md). Param shapes are identical either
+    # way; batch-major (default) keeps the original contract.
+    time_major: bool = False
 
     @nn.compact
-    def __call__(self, x):  # x: (batch, time, f)
+    def __call__(self, x):  # x: (batch, time, f) or time-major (time, batch, f)
         h_dim = self.features
         # one big matmul over the full sequence (no bias: the recurrent
-        # projection's bias covers it, as in OptimizedLSTMCell)
+        # projection's bias covers it, as in OptimizedLSTMCell). The
+        # explicit 2D reshape matters: a 3D dot_general's backward makes
+        # XLA:CPU materialize 67MB transposes of the sequence to feed its
+        # gemm, while the 2D form's dW = x^T @ dz lowers to a gemm with
+        # transpose flags (no copies) — measured in the round-5 HLO dump.
+        lead = x.shape[:-1]
         z = nn.Dense(
             4 * h_dim, use_bias=False, dtype=self.dtype, name="input_proj"
-        )(x)
+        )(x.reshape(-1, x.shape[-1]))
+        z = z.reshape(*lead, 4 * h_dim)
         w_h = self.param(
             "recurrent_kernel",
             nn.initializers.orthogonal(),
@@ -193,25 +237,22 @@ class FusedLSTMLayer(nn.Module):
         act = self.activation_fn
 
         def step(carry, z_t):
-            c, h = carry
-            # matmul in self.dtype (MXU); gate math + cell state in float32,
-            # matching OptimizedLSTMCell's float32 (param_dtype) carry
-            gates = (z_t + h.astype(self.dtype) @ w_h + b_h).astype(jnp.float32)
-            i, f, g, o = jnp.split(gates, 4, axis=-1)
-            i, f, o = nn.sigmoid(i), nn.sigmoid(f), nn.sigmoid(o)
-            c = f * c + i * act(g)
-            h = o * act(c)
+            c, h = lstm_cell_step(*carry, z_t, w_h, b_h, act, self.dtype)
             return (c, h), h
 
-        batch = x.shape[0]
+        batch = x.shape[1] if self.time_major else x.shape[0]
         carry0 = (
             jnp.zeros((batch, h_dim), dtype=jnp.float32),
             jnp.zeros((batch, h_dim), dtype=jnp.float32),
         )
         _, hs = jax.lax.scan(
-            step, carry0, z.swapaxes(0, 1), unroll=max(1, int(self.unroll))
+            step,
+            carry0,
+            z if self.time_major else z.swapaxes(0, 1),
+            unroll=max(1, int(self.unroll)),
         )
-        return hs.swapaxes(0, 1).astype(self.dtype)
+        hs = hs if self.time_major else hs.swapaxes(0, 1)
+        return hs.astype(self.dtype)
 
 
 class FusedGRULayer(nn.Module):
@@ -230,16 +271,20 @@ class FusedGRULayer(nn.Module):
     activation_fn: Any = jnp.tanh
     dtype: Any = jnp.float32
     unroll: int = 1  # see FusedLSTMLayer.unroll
+    time_major: bool = False  # see FusedLSTMLayer.time_major
 
     @nn.compact
-    def __call__(self, x):  # x: (batch, time, f)
+    def __call__(self, x):  # x: (batch, time, f) or time-major (time, batch, f)
         h_dim = self.features
         # one big matmul over the full sequence; carries the input-side
         # biases for r/z/n (the recurrent r/z projections are bias-free,
-        # as in GRUCell's summed-dense convention)
+        # as in GRUCell's summed-dense convention). 2D reshape around the
+        # projection for the same gemm-layout reason as FusedLSTMLayer.
+        lead = x.shape[:-1]
         z = nn.Dense(
             3 * h_dim, use_bias=True, dtype=self.dtype, name="input_proj"
-        )(x)
+        )(x.reshape(-1, x.shape[-1]))
+        z = z.reshape(*lead, 3 * h_dim)
         w_rz = self.param(
             "recurrent_kernel_rz",
             nn.initializers.orthogonal(),
@@ -258,22 +303,19 @@ class FusedGRULayer(nn.Module):
         act = self.activation_fn
 
         def step(h, z_t):
-            # matmuls in self.dtype (MXU); gate math in float32, matching
-            # GRUCell's float32 carry
-            hd = h.astype(self.dtype)
-            rz = (z_t[..., : 2 * h_dim] + hd @ w_rz).astype(jnp.float32)
-            r, zg = jnp.split(nn.sigmoid(rz), 2, axis=-1)
-            hn = (hd @ w_n).astype(jnp.float32) + b_n
-            n = act(z_t[..., 2 * h_dim :].astype(jnp.float32) + r * hn)
-            h = (1.0 - zg) * n + zg * h
+            h = gru_cell_step(h, z_t, w_rz, w_n, b_n, act, self.dtype, h_dim)
             return h, h
 
-        batch = x.shape[0]
+        batch = x.shape[1] if self.time_major else x.shape[0]
         h0 = jnp.zeros((batch, h_dim), dtype=jnp.float32)
         _, hs = jax.lax.scan(
-            step, h0, z.swapaxes(0, 1), unroll=max(1, int(self.unroll))
+            step,
+            h0,
+            z if self.time_major else z.swapaxes(0, 1),
+            unroll=max(1, int(self.unroll)),
         )
-        return hs.swapaxes(0, 1).astype(self.dtype)
+        hs = hs if self.time_major else hs.swapaxes(0, 1)
+        return hs.astype(self.dtype)
 
 
 class LSTMNet(nn.Module):
@@ -295,24 +337,182 @@ class LSTMNet(nn.Module):
     fused: bool = False
     cell: str = "lstm"  # "lstm" | "gru"
     time_unroll: int = 1  # fused layers' scan unroll (schedule-only knob)
+    # "layer": one time scan per layer, input projections hoisted to big
+    #   (batch*time) matmuls — the MXU-friendly schedule (TPU default).
+    # "stacked": ALL layers stream through ONE time scan (layer l's step
+    #   consumes layer l-1's hidden state of the same timestep), so the
+    #   inter-layer (time, batch, 4h) z/hs sequence buffers never
+    #   materialize and layers >0 run small per-step gemms. On XLA:CPU
+    #   those small gemms hit ~121 GF/s where the hoisted skinny-K gemms
+    #   are bandwidth-bound at ~40 GF/s (round-5 measurements,
+    #   docs/performance.md) — the oneDNN-style streaming schedule.
+    #   Math is step-for-step identical; the param tree differs, so pick
+    #   at model-definition time (parity pinned in tests/test_fused_lstm).
+    schedule: str = "layer"
     dtype: Any = jnp.float32
+
+    def _stacked_scan(self, x):
+        """The one-scan streaming schedule over time-major x (time, batch, f)."""
+        dims = self.layer_dims
+        acts = [resolve_activation(f) for f in self.layer_funcs]
+        n_gates = 4 if self.cell == "lstm" else 3
+        t_dim, b_dim = x.shape[0], x.shape[1]
+
+        # layer 0's input projection still hoists to one big matmul —
+        # x is known ahead of the scan
+        z1 = nn.Dense(
+            n_gates * dims[0],
+            use_bias=(self.cell == "gru"),
+            dtype=self.dtype,
+            name="input_proj_0",
+        )(x.reshape(-1, x.shape[-1]))
+        z1 = z1.reshape(t_dim, b_dim, n_gates * dims[0])
+
+        w_x, b_x, w_h, b_h, w_rz, w_n, b_n = [], [], [], [], [], [], []
+        for layer, d in enumerate(dims):
+            prev = dims[layer - 1] if layer else None
+            if layer:
+                w_x.append(
+                    self.param(
+                        f"input_kernel_{layer}",
+                        nn.initializers.lecun_normal(),
+                        (prev, n_gates * d),
+                        jnp.float32,
+                    ).astype(self.dtype)
+                )
+                b_x.append(
+                    self.param(
+                        f"input_bias_{layer}",
+                        nn.initializers.zeros_init(),
+                        (n_gates * d,),
+                        jnp.float32,
+                    ).astype(self.dtype)
+                    if self.cell == "gru"
+                    else None
+                )
+            if self.cell == "lstm":
+                w_h.append(
+                    self.param(
+                        f"recurrent_kernel_{layer}",
+                        nn.initializers.orthogonal(),
+                        (d, 4 * d),
+                        jnp.float32,
+                    ).astype(self.dtype)
+                )
+                b_h.append(
+                    self.param(
+                        f"recurrent_bias_{layer}",
+                        nn.initializers.zeros_init(),
+                        (4 * d,),
+                        jnp.float32,
+                    ).astype(self.dtype)
+                )
+            else:
+                w_rz.append(
+                    self.param(
+                        f"recurrent_kernel_rz_{layer}",
+                        nn.initializers.orthogonal(),
+                        (d, 2 * d),
+                        jnp.float32,
+                    ).astype(self.dtype)
+                )
+                w_n.append(
+                    self.param(
+                        f"recurrent_kernel_n_{layer}",
+                        nn.initializers.orthogonal(),
+                        (d, d),
+                        jnp.float32,
+                    ).astype(self.dtype)
+                )
+                b_n.append(
+                    self.param(
+                        f"recurrent_bias_n_{layer}",
+                        nn.initializers.zeros_init(),
+                        (d,),
+                        jnp.float32,
+                    )
+                )
+
+        def lstm_step(carry, z1_t):
+            new_carry = []
+            inp = None
+            for layer, (d, act) in enumerate(zip(dims, acts)):
+                c, h = carry[layer]
+                z_t = z1_t if layer == 0 else inp @ w_x[layer - 1]
+                c, h = lstm_cell_step(
+                    c, h, z_t, w_h[layer], b_h[layer], act, self.dtype
+                )
+                new_carry.append((c, h))
+                inp = h.astype(self.dtype)
+            return tuple(new_carry), None
+
+        def gru_step(carry, z1_t):
+            new_carry = []
+            inp = None
+            for layer, (d, act) in enumerate(zip(dims, acts)):
+                z_t = (
+                    z1_t
+                    if layer == 0
+                    else inp @ w_x[layer - 1] + b_x[layer - 1]
+                )
+                h = gru_cell_step(
+                    carry[layer], z_t, w_rz[layer], w_n[layer], b_n[layer],
+                    act, self.dtype, d,
+                )
+                new_carry.append(h)
+                inp = h.astype(self.dtype)
+            return tuple(new_carry), None
+
+        if self.cell == "lstm":
+            init = tuple(
+                (
+                    jnp.zeros((b_dim, d), jnp.float32),
+                    jnp.zeros((b_dim, d), jnp.float32),
+                )
+                for d in dims
+            )
+            step = lstm_step
+        else:
+            init = tuple(jnp.zeros((b_dim, d), jnp.float32) for d in dims)
+            step = gru_step
+        final, _ = jax.lax.scan(
+            step, init, z1, unroll=max(1, int(self.time_unroll))
+        )
+        last = final[-1]
+        h_last = last[1] if self.cell == "lstm" else last
+        return h_last.astype(self.dtype)  # (batch, h_last)
 
     @nn.compact
     def __call__(self, x, deterministic: bool = True):  # x: (batch, time, features)
         if self.cell not in ("lstm", "gru"):
             raise ValueError(f"Unknown recurrent cell {self.cell!r}")
-        for dim, func in zip(self.layer_dims, self.layer_funcs):
-            if self.fused:
-                fused_layer = (
-                    FusedGRULayer if self.cell == "gru" else FusedLSTMLayer
-                )
+        if self.schedule not in ("layer", "stacked"):
+            raise ValueError(f"Unknown schedule {self.schedule!r}")
+        if self.schedule == "stacked" and not self.fused:
+            # silently falling through to the nn.RNN path would train a
+            # different param tree than the caller asked to measure
+            raise ValueError('schedule="stacked" requires fused=True')
+        if self.fused and self.schedule == "stacked":
+            x = self._stacked_scan(x.swapaxes(0, 1))  # -> (batch, h_last)
+        elif self.fused:
+            # time-major through the whole stack: ONE transpose on entry,
+            # none between layers, and none on exit (the head reads the
+            # last timestep, hs[-1]). The round-4 CPU trace showed the
+            # per-layer swapaxes copies out-costing the gate matmuls
+            # (docs/performance.md); param shapes are layout-independent.
+            x = x.swapaxes(0, 1)  # (time, batch, features)
+            fused_layer = FusedGRULayer if self.cell == "gru" else FusedLSTMLayer
+            for dim, func in zip(self.layer_dims, self.layer_funcs):
                 x = fused_layer(
                     dim,
                     activation_fn=resolve_activation(func),
                     unroll=self.time_unroll,
+                    time_major=True,
                     dtype=self.dtype,
                 )(x)
-            else:
+            x = x[-1]  # last timestep: (batch, h)
+        else:
+            for dim, func in zip(self.layer_dims, self.layer_funcs):
                 if self.cell == "gru":
                     cell = nn.GRUCell(
                         dim,
@@ -326,7 +526,7 @@ class LSTMNet(nn.Module):
                         dtype=self.dtype,
                     )
                 x = nn.RNN(cell)(x)
-        x = x[:, -1, :]
+            x = x[:, -1, :]
         x = nn.Dense(self.out_dim, dtype=self.dtype)(x)
         return resolve_activation(self.out_func)(x).astype(jnp.float32), jnp.asarray(
             0.0, dtype=jnp.float32

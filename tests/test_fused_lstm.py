@@ -81,6 +81,77 @@ def test_fused_stacked_layers_match():
     np.testing.assert_allclose(out_fused, out_plain, rtol=1e-5, atol=1e-6)
 
 
+def _map_layer_fused_to_stacked(layer_params, stacked_params, cell="lstm"):
+    """Per-layer fused params -> the stacked one-scan schedule's layout."""
+    sp = jax.tree_util.tree_map(lambda a: a, stacked_params)["params"]
+    lname = "FusedLSTMLayer" if cell == "lstm" else "FusedGRULayer"
+    layer = 0
+    while f"{lname}_{layer}" in layer_params["params"]:
+        lp = layer_params["params"][f"{lname}_{layer}"]
+        if layer == 0:
+            sp["input_proj_0"]["kernel"] = lp["input_proj"]["kernel"]
+            if cell == "gru":
+                sp["input_proj_0"]["bias"] = lp["input_proj"]["bias"]
+        else:
+            sp[f"input_kernel_{layer}"] = lp["input_proj"]["kernel"]
+            if cell == "gru":
+                sp[f"input_bias_{layer}"] = lp["input_proj"]["bias"]
+        if cell == "lstm":
+            sp[f"recurrent_kernel_{layer}"] = lp["recurrent_kernel"]
+            sp[f"recurrent_bias_{layer}"] = lp["recurrent_bias"]
+        else:
+            sp[f"recurrent_kernel_rz_{layer}"] = lp["recurrent_kernel_rz"]
+            sp[f"recurrent_kernel_n_{layer}"] = lp["recurrent_kernel_n"]
+            sp[f"recurrent_bias_n_{layer}"] = lp["recurrent_bias_n"]
+        layer += 1
+    sp["Dense_0"] = layer_params["params"]["Dense_0"]
+    return {"params": sp}
+
+
+def test_stacked_schedule_matches_layer_schedule():
+    """schedule="stacked" (one streaming time scan for all layers — the
+    XLA:CPU-friendly layout) must compute exactly what the per-layer
+    fused schedule computes given the same weights, for both cells."""
+    rng = np.random.default_rng(4)
+    x = jnp.asarray(rng.normal(size=(B, T, F)), jnp.float32)
+    dims, funcs = (H, 4, H), ("tanh", "relu", "tanh")
+
+    for cell in ("lstm", "gru"):
+        layer_net = LSTMNet(
+            layer_dims=dims, layer_funcs=funcs, out_dim=2, fused=True, cell=cell
+        )
+        stacked_net = LSTMNet(
+            layer_dims=dims, layer_funcs=funcs, out_dim=2, fused=True,
+            cell=cell, schedule="stacked",
+        )
+        layer_params = layer_net.init(jax.random.PRNGKey(0), x)
+        stacked_params = stacked_net.init(jax.random.PRNGKey(1), x)
+        stacked_params = _map_layer_fused_to_stacked(
+            layer_params, stacked_params, cell
+        )
+        out_layer, _ = layer_net.apply(layer_params, x)
+        out_stacked, _ = stacked_net.apply(stacked_params, x)
+        np.testing.assert_allclose(out_stacked, out_layer, rtol=1e-5, atol=1e-6)
+
+
+def test_stacked_estimator_trains_and_predicts():
+    rng = np.random.default_rng(5)
+    X = rng.random((80, F)).astype("float32")
+    model = LSTMAutoEncoder(
+        kind="lstm_model",
+        lookback_window=6,
+        encoding_dim=(8,),
+        encoding_func=("tanh",),
+        decoding_dim=(8,),
+        decoding_func=("tanh",),
+        fused=True,
+        schedule="stacked",
+        epochs=2,
+    )
+    model.fit(X, X)
+    assert model.predict(X).shape == (80 - 6 + 1, F)
+
+
 def test_fused_estimator_trains_and_pickles():
     import pickle
 
