@@ -3,19 +3,42 @@ Structural validation of rendered Argo Workflow manifests.
 
 The reference lints generated workflows with the real argo CLI in docker
 (tests/gordo/workflow/test_workflow_generator.py:88-113). That binary is
-unavailable here, so the schema rules argo lint actually trips on are
-vendored as code: a rendered manifest that passes this validator would
-also parse in the argo controller's workflow-spec unmarshalling for every
-construct our template emits. Used by the workflow tests on every
-rendered document (instead of bare ``yaml.safe_load``).
+unavailable here, so validation runs in two layers on every rendered
+document (instead of bare ``yaml.safe_load``):
+
+1. a vendored JSON Schema (``argo_workflow_schema.json``, hand-derived
+   from the Argo v1alpha1 CRD type structure) checks field types and
+   required fields across the whole Workflow surface — the class of
+   error hand-rolled rules miss;
+2. the semantic cross-reference checks below (entrypoint/template/task
+   name resolution, duplicate detection, one-executor-per-template),
+   which a JSON Schema cannot express.
 """
 
+import functools
+import json
+import os
 import re
 import typing
 
 import yaml
 
 _DNS1123 = re.compile(r"^[a-z0-9]([-a-z0-9.]{0,251}[a-z0-9])?$")
+
+_SCHEMA_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "argo_workflow_schema.json"
+)
+
+
+@functools.lru_cache(maxsize=1)
+def _schema_validator():
+    import jsonschema
+
+    with open(_SCHEMA_PATH) as fh:
+        schema = json.load(fh)
+    validator_cls = jsonschema.validators.validator_for(schema)
+    validator_cls.check_schema(schema)
+    return validator_cls(schema)
 
 # a template must declare exactly one of these executors
 TEMPLATE_EXECUTORS = ("dag", "steps", "container", "script", "resource", "suspend")
@@ -227,6 +250,20 @@ def _validate_template(template, path: str, template_names: typing.Set[str]):
         )
 
 
+def validate_schema(doc, path: str = "workflow") -> None:
+    """
+    Validate a rendered Workflow against the vendored Argo CRD schema;
+    raises :class:`WorkflowValidationError` naming the offending JSON
+    path of the deepest (most specific) violation.
+    """
+    from jsonschema.exceptions import best_match
+
+    err = best_match(_schema_validator().iter_errors(doc))
+    if err is not None:
+        where = ".".join(str(p) for p in err.absolute_path) or "(root)"
+        _fail(f"{path}.{where}", f"schema violation: {err.message}")
+
+
 def validate_workflow(doc) -> None:
     """
     Validate one rendered Argo Workflow document; raises
@@ -286,6 +323,11 @@ def validate_workflow(doc) -> None:
         )
     for i, template in enumerate(templates):
         _validate_template(template, f"workflow.spec.templates[{i}]", names)
+    # the vendored CRD schema runs LAST: for violations both layers catch,
+    # the semantic checks' more specific message wins; the schema then
+    # covers the typed surface (env/probe/volume/resource shapes, enums,
+    # int-or-templated-string fields) the hand-rolled rules don't
+    validate_schema(doc)
 
 
 def validate_rendered(documents: typing.Iterable[dict]) -> int:

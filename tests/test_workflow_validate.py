@@ -144,6 +144,58 @@ def test_broken_workflows_rejected(mutate, path_fragment):
     assert path_fragment in str(err.value) or path_fragment in err.value.problem
 
 
+@pytest.mark.parametrize(
+    "mutate, path_fragment",
+    [
+        # violations only the vendored CRD JSON Schema catches — typed
+        # field shapes beyond the hand-rolled semantic rules
+        (
+            lambda d: d["spec"]["templates"][1]["container"]["env"].append(
+                {"name": "PORT", "value": 5555}
+            ),
+            "env",
+        ),
+        (
+            lambda d: d["spec"]["templates"][1]["container"].__setitem__(
+                "volumeMounts", [{"name": "data"}]
+            ),
+            "volumeMounts",
+        ),
+        (
+            lambda d: d["spec"]["templates"][1]["container"].__setitem__(
+                "readinessProbe", {"httpGet": {"path": "/healthz"}}
+            ),
+            "readinessProbe",
+        ),
+        (
+            lambda d: d["spec"].__setitem__("volumes", [{"persistentVolumeClaim": {}}]),
+            "volumes",
+        ),
+        (
+            lambda d: d["spec"]["templates"][1]["retryStrategy"].__setitem__(
+                "retryPolicy", "Sometimes"
+            ),
+            "retryPolicy",
+        ),
+        (
+            lambda d: d["spec"].__setitem__("parallelism", "lots"),
+            "parallelism",
+        ),
+        (
+            lambda d: d["spec"]["arguments"]["parameters"].__setitem__(
+                0, {"name": "revision", "value": ["a", "list"]}
+            ),
+            "parameters",
+        ),
+    ],
+)
+def test_schema_layer_rejects_typed_violations(mutate, path_fragment):
+    with pytest.raises(WorkflowValidationError) as err:
+        validate_workflow(_broken(mutate))
+    assert "schema violation" in err.value.problem
+    assert path_fragment in str(err.value)
+
+
 def test_generic_manifest_check():
     validate_manifest(
         {"apiVersion": "v1", "kind": "Service", "metadata": {"name": "svc"}}
