@@ -33,14 +33,14 @@ honor_jax_platforms_env()
 enable_compile_cache()
 
 
-def self_serve(tmp: str, port: int, n_machines: int = 1) -> str:
+def self_serve(tmp: str, port: int, n_machines: int = 1, model: str = "hourglass") -> str:
     """Train machine(s) on random data and serve them; returns base URL."""
     from werkzeug.serving import make_server
 
     from benchmarks.server_latency import build_collection
     from gordo_tpu.server import build_app
 
-    collection = build_collection(n_machines, tmp)
+    collection = build_collection(n_machines, tmp, model)
     os.environ["MODEL_COLLECTION_DIR"] = collection
     server = make_server("127.0.0.1", port, build_app(), threaded=True)
     threading.Thread(target=server.serve_forever, daemon=True).start()
@@ -104,6 +104,13 @@ def main():
         help="Comma-separated machine names for fleet mode against a real "
         "--base-url deployment (default: the self-serve bench-m<i> names)",
     )
+    parser.add_argument(
+        "--model",
+        choices=["hourglass", "lstm"],
+        default="hourglass",
+        help="Self-serve estimator family (lstm exercises the windowed "
+        "serving path: on-device window gather + chunked predict)",
+    )
     args = parser.parse_args()
 
     import numpy as np
@@ -113,7 +120,9 @@ def main():
     if base_url is None:
         if not args.self_serve:
             parser.error("--base-url or --self-serve required")
-        base_url = self_serve(tmp_ctx.name, args.port, max(1, args.fleet))
+        base_url = self_serve(
+            tmp_ctx.name, args.port, max(1, args.fleet), args.model
+        )
 
     rows = np.random.default_rng(0).random((args.samples, args.features)).tolist()
     if args.fleet:
@@ -172,6 +181,9 @@ def main():
     summary = summarize_ms(latencies) if latencies else {}
     out = {
         "users": args.users,
+        # only self-serve knows what it built; against a --base-url
+        # deployment the family is whatever is deployed there
+        **({"model": args.model} if args.self_serve else {}),
         "duration_s": round(elapsed, 1),
         "requests": len(latencies),
         "errors": len(errors),

@@ -23,7 +23,26 @@ honor_jax_platforms_env()
 enable_compile_cache()
 
 
-def build_collection(n_machines: int, tmp: str) -> str:
+ESTIMATOR_BLOCKS = {
+    "hourglass": """
+          gordo_tpu.models.AutoEncoder:
+            kind: feedforward_hourglass
+            epochs: 1""",
+    # windowed serving edition: on-device window gather + chunked predict
+    "lstm": """
+          gordo_tpu.models.LSTMAutoEncoder:
+            kind: lstm_model
+            lookback_window: 16
+            encoding_dim: [16]
+            encoding_func: [tanh]
+            decoding_dim: [16]
+            decoding_func: [tanh]
+            fused: true
+            epochs: 1""",
+}
+
+
+def build_collection(n_machines: int, tmp: str, model: str = "hourglass") -> str:
     from gordo_tpu import serializer
     from gordo_tpu.builder import local_build
 
@@ -38,18 +57,16 @@ def build_collection(n_machines: int, tmp: str) -> str:
       asset: gra
     model:
       gordo_tpu.models.anomaly.DiffBasedAnomalyDetector:
-        base_estimator:
-          gordo_tpu.models.AutoEncoder:
-            kind: feedforward_hourglass
-            epochs: 1
+        base_estimator:{block}
 """
     config = "machines:" + "".join(
-        machine_tpl.format(i=i) for i in range(n_machines)
+        machine_tpl.format(i=i, block=ESTIMATOR_BLOCKS[model])
+        for i in range(n_machines)
     )
     collection = os.path.join(tmp, "proj", "models", "rev1")
-    for model, machine in local_build(config):
+    for fitted, machine in local_build(config):
         serializer.dump(
-            model, os.path.join(collection, machine.name), metadata=machine.to_dict()
+            fitted, os.path.join(collection, machine.name), metadata=machine.to_dict()
         )
     return collection
 

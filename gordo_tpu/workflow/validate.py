@@ -243,8 +243,13 @@ def _validate_template(template, path: str, template_names: typing.Set[str]):
     retry = template.get("retryStrategy")
     if retry is not None:
         limit = retry.get("limit")
+        # {{workflow.parameters.*}} limits are substituted by the argo
+        # controller before parsing, matching the vendored schema's
+        # int-or-templated-string type
         _require(
-            limit is None or str(limit).isdigit(),
+            limit is None
+            or str(limit).isdigit()
+            or "{{" in str(limit),
             f"{path}.retryStrategy.limit",
             f"{limit!r} is not an integer",
         )

@@ -156,6 +156,12 @@ def test_broken_workflows_rejected(mutate, path_fragment):
             "env",
         ),
         (
+            lambda d: d["spec"]["templates"][1]["container"]["env"].append(
+                {"name": "BOTH", "value": "a", "valueFrom": {"fieldRef": {}}}
+            ),
+            "env",
+        ),
+        (
             lambda d: d["spec"]["templates"][1]["container"].__setitem__(
                 "volumeMounts", [{"name": "data"}]
             ),
