@@ -117,12 +117,14 @@ def main():
 
     tmp_ctx = tempfile.TemporaryDirectory()
     base_url = args.base_url
+    served_locally = False
     if base_url is None:
         if not args.self_serve:
             parser.error("--base-url or --self-serve required")
         base_url = self_serve(
             tmp_ctx.name, args.port, max(1, args.fleet), args.model
         )
+        served_locally = True
 
     rows = np.random.default_rng(0).random((args.samples, args.features)).tolist()
     if args.fleet:
@@ -183,7 +185,7 @@ def main():
         "users": args.users,
         # only self-serve knows what it built; against a --base-url
         # deployment the family is whatever is deployed there
-        **({"model": args.model} if args.self_serve else {}),
+        **({"model": args.model} if served_locally else {}),
         "duration_s": round(elapsed, 1),
         "requests": len(latencies),
         "errors": len(errors),

@@ -245,11 +245,11 @@ def _validate_template(template, path: str, template_names: typing.Set[str]):
         limit = retry.get("limit")
         # {{workflow.parameters.*}} limits are substituted by the argo
         # controller before parsing, matching the vendored schema's
-        # int-or-templated-string type
+        # int-or-templated-string pattern
         _require(
             limit is None
             or str(limit).isdigit()
-            or "{{" in str(limit),
+            or re.search(r"\{\{.*\}\}", str(limit)) is not None,
             f"{path}.retryStrategy.limit",
             f"{limit!r} is not an integer",
         )
@@ -285,6 +285,12 @@ def validate_workflow(doc) -> None:
     )
     spec = doc.get("spec")
     _require(isinstance(spec, dict), "workflow.spec", "required mapping")
+
+    if "workflowTemplateRef" in spec and "templates" not in spec:
+        # a workflowTemplateRef-style spec carries no inline templates or
+        # entrypoint; its shape is the schema's to check
+        validate_schema(doc)
+        return
 
     templates = spec.get("templates")
     _require(

@@ -33,6 +33,11 @@ class Point:
 @dataclass
 class InfluxState:
     databases: Dict[str, List[Point]] = field(default_factory=dict)
+    # fault injection for the failure-path tests: each /write consumes the
+    # front entry — an int becomes that HTTP status, "drop" closes the
+    # connection with no response (a mid-request network failure); when
+    # empty, writes succeed normally
+    write_faults: List = field(default_factory=list)
 
 
 # -- line protocol ----------------------------------------------------------
@@ -224,6 +229,14 @@ class _Handler(BaseHTTPRequestHandler):
         body = self.rfile.read(length).decode()
         params = self._params()
         if self.path.startswith("/write"):
+            if self.state.write_faults:
+                fault = self.state.write_faults.pop(0)
+                if fault == "drop":
+                    self.connection.close()
+                    return
+                return self._respond(
+                    int(fault), {"error": f"injected fault ({fault})"}
+                )
             db = params.get("db", "")
             try:
                 points = parse_line_protocol(body)
@@ -291,6 +304,7 @@ def serve() -> Tuple[ThreadingHTTPServer, threading.Thread, int]:
     state = InfluxState()
     handler = type("BoundHandler", (_Handler,), {"state": state})
     server = ThreadingHTTPServer(("localhost", 0), handler)
+    server.influx_state = state  # fault-injection hook for tests
     thread = threading.Thread(target=server.serve_forever, daemon=True)
     thread.start()
     return server, thread, server.server_address[1]

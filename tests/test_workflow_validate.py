@@ -202,6 +202,25 @@ def test_schema_layer_rejects_typed_violations(mutate, path_fragment):
     assert path_fragment in str(err.value)
 
 
+def test_workflow_template_ref_spec_passes():
+    """A workflowTemplateRef-style Workflow (no inline templates or
+    entrypoint) is valid Argo; its shape is checked by the schema layer."""
+    doc = {
+        "apiVersion": "argoproj.io/v1alpha1",
+        "kind": "Workflow",
+        "metadata": {"name": "from-template"},
+        "spec": {
+            "workflowTemplateRef": {"name": "shared-template"},
+            "arguments": {"parameters": [{"name": "revision", "value": "1"}]},
+        },
+    }
+    validate_workflow(doc)
+    # and its typed surface is still enforced
+    doc["spec"]["workflowTemplateRef"] = {"clusterScope": True}  # name missing
+    with pytest.raises(WorkflowValidationError):
+        validate_workflow(doc)
+
+
 def test_generic_manifest_check():
     validate_manifest(
         {"apiVersion": "v1", "kind": "Service", "metadata": {"name": "svc"}}
