@@ -288,7 +288,13 @@ def validate_workflow(doc) -> None:
 
     if "workflowTemplateRef" in spec and "templates" not in spec:
         # a workflowTemplateRef-style spec carries no inline templates or
-        # entrypoint; its shape is the schema's to check
+        # entrypoint; arguments still get the duplicate-name check (the
+        # schema cannot express uniqueness), the rest is the schema's
+        if "arguments" in spec and "parameters" in (spec["arguments"] or {}):
+            _validate_parameters(
+                spec["arguments"]["parameters"],
+                "workflow.spec.arguments.parameters",
+            )
         validate_schema(doc)
         return
 

@@ -43,6 +43,25 @@ python benchmarks/profile_trace.py --target fleet --machines 64
 echo "=== fleet-serving scaling (8..256 machines/request) ===" >&2
 python benchmarks/fleet_serving_scale.py
 
+echo "=== round-5 additions ===" >&2
+# schedule A/B on-chip: the hoisted per-layer schedule is the TPU default;
+# confirm the CPU-winning stacked one-scan schedule does NOT beat it on the
+# MXU (expectation: hoisted wins on-chip — record whichever is true)
+BENCH_SCHEDULE=stacked BENCH_BUDGET_S=900 python bench.py
+
+# Transformer/TCN backends on-chip (BASELINE config #5; CPU rows are in
+# benchmarks/results_seq_backends_cpu_r05.json + results_fleet_{tcn,
+# transformer}_cpu_r05.json)
+python benchmarks/fleet_throughput.py \
+    --machines 64 --buckets 2 --epochs 5 --sequential-sample 2 --kind transformer
+python benchmarks/fleet_throughput.py \
+    --machines 64 --buckets 2 --epochs 5 --sequential-sample 2 --kind tcn
+
+# full-request-path serving throughput, windowed edition
+python benchmarks/load_test.py --self-serve --model lstm --fleet 8 \
+    --users 8 --duration 30
+python benchmarks/load_test.py --self-serve --model lstm --users 8 --duration 30
+
 if [ "${SWEEP_TIME_UNROLL:-0}" = "1" ]; then
     for unroll in 1 2 4; do
         echo "=== bench.py with BENCH_TIME_UNROLL=$unroll ===" >&2

@@ -1,7 +1,7 @@
 """
 Vendored static analysis — the stand-in for the reference's mypy/pyflakes
 pytest plugins (reference pytest.ini:8-9, mypy.ini; neither tool exists in
-this image, and nothing may be installed). Six checks with near-zero
+this image, and nothing may be installed). Nine checks with near-zero
 false-positive rates, applied to every module by tests/test_static.py:
 
 1. unused imports           (pyflakes' highest-value diagnostic)
@@ -21,6 +21,14 @@ false-positive rates, applied to every module by tests/test_static.py:
 6. return-annotation drift  (a bare ``return`` in a function annotated
                              ``-> X`` for non-Optional X, or ``return v``
                              in one annotated ``-> None``)
+7. self-attribute reads     (``self.atr`` reads against the class's known
+                             surface, incl. AugAssign reads)
+8. self-method-call binding (``self.method(...)`` arity/kwargs against
+                             the class's own or inherited signature)
+9. annotated-receiver calls (``param.method(...)`` where ``param`` is
+                             annotated with vouched class(es): the call
+                             must bind to the class's method signature —
+                             the cross-module signature-drift net)
 """
 
 import ast
@@ -723,6 +731,31 @@ def _bind_probe(signature: inspect.Signature, node: ast.Call, implicit: int = 0)
     return None
 
 
+def _method_bind_error(cls: type, name: str, node: ast.Call):
+    """Resolve ``cls.name`` as a statically-bindable method and bind the
+    call node's arg shape against it: returns the TypeError on mismatch,
+    None when it binds, and ``_UNRESOLVED`` when the attribute is missing
+    or not a plain static/class/instance method (property, descriptor,
+    callable object, C-accelerated signature)."""
+    try:
+        raw = inspect.getattr_static(cls, name)
+    except AttributeError:
+        return _UNRESOLVED
+    if isinstance(raw, staticmethod):
+        target, implicit = raw.__func__, 0
+    elif isinstance(raw, classmethod):
+        target, implicit = getattr(cls, name), 0  # cls pre-bound
+    elif inspect.isfunction(raw):
+        target, implicit = raw, 1  # self
+    else:
+        return _UNRESOLVED
+    try:
+        signature = inspect.signature(target)
+    except (ValueError, TypeError):
+        return _UNRESOLVED
+    return _bind_probe(signature, node, implicit)
+
+
 def check_self_method_calls(tree: ast.Module, module) -> typing.List[str]:
     """
     ``self.method(...)`` calls inside a MODULE-SCOPE class body must bind
@@ -755,23 +788,76 @@ def check_self_method_calls(tree: ast.Module, module) -> typing.List[str]:
             if _splatted(node):
                 continue
             name = node.func.attr
-            try:
-                raw = inspect.getattr_static(cls, name)
-            except AttributeError:
-                continue  # instance attribute (e.g. a callable field)
-            if isinstance(raw, staticmethod):
-                target, implicit = raw.__func__, 0
-            elif isinstance(raw, classmethod):
-                target, implicit = getattr(cls, name), 0  # cls pre-bound
-            elif inspect.isfunction(raw):
-                target, implicit = raw, 1  # self
-            else:
-                continue  # property / descriptor / callable object
-            try:
-                signature = inspect.signature(target)
-            except (ValueError, TypeError):
-                continue
-            error = _bind_probe(signature, node, implicit)
-            if error is not None:
+            error = _method_bind_error(cls, name, node)
+            if error is not None and error is not _UNRESOLVED:
                 problems.append(f"line {node.lineno}: self.{name}(): {error}")
+    return problems
+
+
+def check_annotated_param_method_calls(tree: ast.Module, module) -> typing.List[str]:
+    """
+    ``param.method(...)`` calls where ``param`` is annotated with vouched
+    class(es) must bind to the class's method signature — the
+    cross-module signature-drift net for the receiver-typed calls that
+    ``check_call_signatures`` (module-scope callables) and
+    ``check_self_method_calls`` (``self`` receivers) cannot see. Same
+    conservatism as the attribute check: only nominally-typed classes
+    with a known surface, params never rebound in scope, no splats;
+    with a Union annotation, binding on ANY member passes.
+    """
+    namespace = dict(vars(builtins))
+    namespace.update(vars(module))
+    problems: typing.List[str] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        args = fn.args
+        annotated: typing.Dict[str, typing.List[type]] = {}
+        for arg in [*args.posonlyargs, *args.args, *args.kwonlyargs]:
+            if arg.annotation is None:
+                continue
+            classes = _annotation_classes(arg.annotation, namespace)
+            if not classes:
+                continue
+            if not all(
+                _nominally_typed(cls) and _known_attrs(cls) is not None
+                for cls in classes
+            ):
+                continue
+            annotated[arg.arg] = classes
+        if not annotated:
+            continue
+        own_nodes = _own_scope_nodes(fn)
+        rebound = {
+            n.id
+            for n in own_nodes
+            if isinstance(n, ast.Name) and isinstance(n.ctx, (ast.Store, ast.Del))
+        }
+        for node in own_nodes:
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)
+            ):
+                continue
+            param = node.func.value.id
+            if param not in annotated or param in rebound or _splatted(node):
+                continue
+            name = node.func.attr
+            errors: typing.List[TypeError] = []
+            for cls in annotated[param]:
+                error = _method_bind_error(cls, name, node)
+                if error is None or error is _UNRESOLVED:
+                    # binds on this member, or isn't statically bindable
+                    # (existence is check_annotated_attributes' concern;
+                    # a miss on one Union member may hit on another)
+                    errors = []
+                    break
+                errors.append(error)
+            if errors:
+                owners = ", ".join(cls.__name__ for cls in annotated[param])
+                problems.append(
+                    f"line {node.lineno}: {param}.{name}() "
+                    f"[{param}: {owners}]: {errors[0]}"
+                )
     return problems

@@ -523,3 +523,63 @@ def test_self_attribute_check_allows_defined_augassign():
     module = _types.ModuleType("fake_aug_ok")
     module.Tally = _Tally
     assert check_self_attributes(_ast.parse(source), module) == []
+
+
+def test_annotated_param_method_calls_bind():
+    from static_analysis import check_annotated_param_method_calls
+
+    problems = {}
+    for name, module in _importable_modules():
+        found = check_annotated_param_method_calls(parse(module.__file__), module)
+        if found:
+            problems[name] = found
+    assert not problems, f"mis-bound annotated-receiver calls: {problems}"
+
+
+def test_annotated_param_method_call_check_catches_drift():
+    """The cross-module signature-drift net: a call through an annotated
+    parameter with the wrong arity / unknown kwarg must be flagged, while
+    valid calls, Union fallbacks, rebinding, and splats are skipped."""
+    import ast as ast_mod
+
+    from static_analysis import check_annotated_param_method_calls
+
+    src = (
+        "import typing\n"
+        "def bad_kwarg(m: Probe):\n"
+        "    m.ping(1, nope=2)\n"
+        "def bad_arity(m: Probe):\n"
+        "    m.ping(1, 2, 3)\n"
+        "def fine(m: Probe):\n"
+        "    m.ping(1, flag=True)\n"
+        "def fine_static(m: Probe):\n"
+        "    m.of(1)\n"
+        "def skipped_rebound(m: Probe):\n"
+        "    m = object()\n"
+        "    m.ping(1, 2, 3)\n"
+        "def skipped_splat(m: Probe, a):\n"
+        "    m.ping(*a)\n"
+        "def skipped_union_other_member(m: 'typing.Union[Probe, dict]'):\n"
+        "    m.update(1, 2, 3)\n"
+    )
+
+    class Probe:
+        def ping(self, value, flag=False):
+            return value
+
+        @staticmethod
+        def of(value):
+            return value
+
+    import types as types_mod
+    import typing
+
+    fake = types_mod.ModuleType("fake_param_calls")
+    fake.Probe = Probe
+    fake.typing = typing
+    Probe.__module__ = "gordo_tpu.fake"  # nominally typed
+
+    found = check_annotated_param_method_calls(ast_mod.parse(src), fake)
+    assert len(found) == 2, found
+    assert any("bad" in f or "nope" in f for f in found)
+    assert all("line 3" in f or "line 5" in f for f in found)
