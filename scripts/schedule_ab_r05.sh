@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Repeated hoisted-vs-stacked schedule A/B on-chip (round 5): alternate
+# 3 bench children per schedule (persistent compile cache makes warm
+# children cheap) to separate the ~3% single-run delta from tunnel
+# variance. Child runs skip the torch baseline; value field only.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+for rep in 1 2 3; do
+    for sched in layer stacked; do
+        echo "--- rep $rep schedule=$sched ---"
+        BENCH_SCHEDULE=$sched timeout 600 python bench.py --child tpu 16384 3 \
+            2>/dev/null | tail -1
+    done
+done

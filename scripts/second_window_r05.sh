@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+#
+# Round-5 second-window playbook: the remaining on-chip items if the
+# tunnel gives another usable window after the 09:45Z wedge. Ordered by
+# value-per-chip-minute; each step is isolated and individually probed
+# (first window taught us the worker dies under sustained load).
+#   1. schedule A/B repeats (decides the TPU default schedule for the
+#      driver-gate bench: single-run r05 pair was 270.1M layer vs
+#      278.7M stacked)
+#   2. 500-machine fleet rerun (populates the significant-figure mfu
+#      field; first-window run predates the rounding fix)
+#   3. server latency refresh (r03 numbers predate windowed serving)
+set -uo pipefail
+cd "$(dirname "$0")/.."
+
+probe() {
+    timeout 150 python -c "
+import jax, jax.numpy as jnp
+x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()
+assert jax.devices()[0].platform == 'tpu'
+" >/dev/null 2>&1
+}
+
+echo "=== schedule A/B (3 reps each, alternating) ===" >&2
+for rep in 1 2 3; do
+    for sched in layer stacked; do
+        probe || { echo "chip gone before A/B rep $rep $sched" >&2; continue; }
+        echo "--- rep $rep schedule=$sched ---"
+        BENCH_SCHEDULE=$sched timeout 480 python bench.py --child tpu 16384 3 \
+            2>/dev/null | tail -1
+    done
+done
+
+echo "=== 500-machine fleet rerun (mfu sig-figs) ===" >&2
+probe && timeout 1200 python benchmarks/fleet_throughput.py \
+    --machines 500 --buckets 3 --epochs 5 --sequential-sample 3 \
+    > benchmarks/fleet_tpu_500_mfu_r05.out 2> benchmarks/fleet_tpu_500_mfu_r05.err \
+    || echo "fleet rerun failed/skipped" >&2
+
+echo "=== server latency refresh ===" >&2
+probe && timeout 900 python benchmarks/server_latency.py --rounds 60 \
+    > benchmarks/server_latency_tpu_r05.out 2>&1 \
+    || echo "server latency failed/skipped" >&2
+
+echo "=== second window done ===" >&2

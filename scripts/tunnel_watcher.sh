@@ -15,7 +15,14 @@ SLEEP_S="${SLEEP_S:-540}"
 
 for i in $(seq 1 "$MAX_PROBES"); do
     ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-    if timeout 90 python -c "import jax; d=jax.devices(); assert d and d[0].platform=='tpu', d; print(d)" >/dev/null 2>&1; then
+    # a COMPUTE probe, not just device enumeration: after the 09:45Z
+    # round-5 wedge, jax.devices() kept succeeding while any actual
+    # dispatch hung — metadata liveness is not chip liveness
+    if timeout 150 python -c "
+import jax, jax.numpy as jnp
+x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()
+assert jax.devices()[0].platform == 'tpu'
+" >/dev/null 2>&1; then
         echo "$ts probe $i/$MAX_PROBES: UP" >> "$LOG"
         exit 0
     else

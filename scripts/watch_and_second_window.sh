@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Supervise: probe until the tunnel gives a second window, then run the
+# second-window playbook exactly once.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+until bash scripts/tunnel_watcher.sh; do sleep 60; done
+echo "$(date -u +%FT%TZ) second window opens" >> scripts/tunnel_probe.log
+bash scripts/second_window_r05.sh >> benchmarks/second_window_r05.log 2>&1
+echo "$(date -u +%FT%TZ) second window playbook done" >> scripts/tunnel_probe.log
