@@ -247,7 +247,10 @@ def main():
                 "speedup": round(fleet_rate / seq_rate, 2),
                 "fleet_reconstruction_mae": round(fleet_mae, 5),
                 "sequential_reconstruction_mae": round(seq_mae, 5),
-                "mfu": round(mfu, 6),
+                # significant figures, not fixed decimals: tiny test
+                # machines put fleet MFU in the 1e-7 range on a 394-TFLOP/s
+                # chip, and fixed rounding would floor that to 0.0
+                "mfu": float(f"{mfu:.3g}"),
                 "mfu_peak_source": peak_source,
                 "mfu_note": MFU_NOTE,
             }

@@ -143,6 +143,17 @@ def enable_compile_cache(
                         "skipping the persistent cache", directory,
                     )
                     return
+                # backstop for kernels that ignore O_NOFOLLOW on
+                # directory symlinks (observed under gVisor/runsc, which
+                # reports 4.4.0): a post-open lstat still rejects a
+                # planted link, albeit without the atomicity the flag
+                # provides on a conforming kernel
+                if stat_mod.S_ISLNK(os.lstat(directory).st_mode):
+                    logger.warning(
+                        "Compile cache path %s is a symlink; "
+                        "skipping the persistent cache", directory,
+                    )
+                    return
                 # tighten a pre-existing dir created under a loose umask
                 if st.st_mode & 0o077:
                     os.fchmod(fd, 0o700)

@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Shared TPU liveness probe: a COMPUTE probe, not device enumeration —
+# after the 09:45Z round-5 wedge, jax.devices() kept succeeding while any
+# actual dispatch hung. Exit 0 iff a small matmul completes on a tpu
+# platform within PROBE_TIMEOUT_S (default 150).
+timeout "${PROBE_TIMEOUT_S:-150}" python -c "
+import jax, jax.numpy as jnp
+x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()
+assert jax.devices()[0].platform == 'tpu'
+" >/dev/null 2>&1

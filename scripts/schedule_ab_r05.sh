@@ -1,14 +1,20 @@
 #!/usr/bin/env bash
 # Repeated hoisted-vs-stacked schedule A/B on-chip (round 5): alternate
-# 3 bench children per schedule (persistent compile cache makes warm
-# children cheap) to separate the ~3% single-run delta from tunnel
-# variance. Child runs skip the torch baseline; value field only.
+# AB_REPS bench children per schedule (persistent compile cache makes
+# warm children cheap) to separate the ~3% single-run delta from tunnel
+# variance. Child runs skip the torch baseline; value field only. Each
+# step is gated on scripts/probe_tpu.sh — the first window showed the
+# worker dies under load, and an ungated loop would burn its timeout
+# budget against a wedged chip.
 set -uo pipefail
 cd "$(dirname "$0")/.."
-for rep in 1 2 3; do
+AB_REPS="${AB_REPS:-3}"
+AB_CHILD_TIMEOUT_S="${AB_CHILD_TIMEOUT_S:-480}"
+for rep in $(seq 1 "$AB_REPS"); do
     for sched in layer stacked; do
+        bash scripts/probe_tpu.sh || { echo "chip down before rep $rep $sched" >&2; continue; }
         echo "--- rep $rep schedule=$sched ---"
-        BENCH_SCHEDULE=$sched timeout 600 python bench.py --child tpu 16384 3 \
-            2>/dev/null | tail -1
+        BENCH_SCHEDULE=$sched timeout "$AB_CHILD_TIMEOUT_S" \
+            python bench.py --child tpu 16384 3 2>/dev/null | tail -1
     done
 done

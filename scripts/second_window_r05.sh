@@ -13,23 +13,10 @@
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
-probe() {
-    timeout 150 python -c "
-import jax, jax.numpy as jnp
-x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()
-assert jax.devices()[0].platform == 'tpu'
-" >/dev/null 2>&1
-}
+probe() { bash scripts/probe_tpu.sh; }
 
 echo "=== schedule A/B (3 reps each, alternating) ===" >&2
-for rep in 1 2 3; do
-    for sched in layer stacked; do
-        probe || { echo "chip gone before A/B rep $rep $sched" >&2; continue; }
-        echo "--- rep $rep schedule=$sched ---"
-        BENCH_SCHEDULE=$sched timeout 480 python bench.py --child tpu 16384 3 \
-            2>/dev/null | tail -1
-    done
-done
+bash scripts/schedule_ab_r05.sh
 
 echo "=== 500-machine fleet rerun (mfu sig-figs) ===" >&2
 probe && timeout 1200 python benchmarks/fleet_throughput.py \

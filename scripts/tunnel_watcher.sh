@@ -10,19 +10,13 @@ set -uo pipefail
 cd "$(dirname "$0")/.."
 
 LOG=scripts/tunnel_probe.log
-MAX_PROBES="${MAX_PROBES:-70}"      # ~10.5h at 9-minute spacing
+# worst case ~13.4h: 70 x (540s spacing + up to 150s down-probe)
+MAX_PROBES="${MAX_PROBES:-70}"
 SLEEP_S="${SLEEP_S:-540}"
 
 for i in $(seq 1 "$MAX_PROBES"); do
     ts=$(date -u +%Y-%m-%dT%H:%M:%SZ)
-    # a COMPUTE probe, not just device enumeration: after the 09:45Z
-    # round-5 wedge, jax.devices() kept succeeding while any actual
-    # dispatch hung — metadata liveness is not chip liveness
-    if timeout 150 python -c "
-import jax, jax.numpy as jnp
-x = (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()
-assert jax.devices()[0].platform == 'tpu'
-" >/dev/null 2>&1; then
+    if bash scripts/probe_tpu.sh; then
         echo "$ts probe $i/$MAX_PROBES: UP" >> "$LOG"
         exit 0
     else
