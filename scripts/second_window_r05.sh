@@ -29,4 +29,17 @@ probe && timeout 900 python benchmarks/server_latency.py --rounds 60 \
     > benchmarks/server_latency_tpu_r05.out 2>&1 \
     || echo "server latency failed/skipped" >&2
 
+echo "=== windowed (LSTM) serving scale ===" >&2
+probe && timeout 900 python benchmarks/fleet_serving_scale.py --model lstm \
+    > benchmarks/serving_scale_lstm_tpu_r05.out 2>&1 \
+    || echo "lstm serving scale failed/skipped" >&2
+
+echo "=== time_unroll on-chip sweep (schedule-only knob) ===" >&2
+for u in 2 4; do
+    probe || break
+    echo "--- time_unroll=$u ---"
+    BENCH_TIME_UNROLL=$u timeout 480 python bench.py --child tpu 16384 3 \
+        2>/dev/null | tail -1
+done
+
 echo "=== second window done ===" >&2
