@@ -345,6 +345,23 @@ def run_child(mode: str, n_timesteps: int, epochs: int, timeout_s: float):
 
 
 def child_main(mode: str, n_timesteps: int, epochs: int):
+    # fast min/max (no NaN-propagation semantics) wins every paired A/B
+    # on the XLA:CPU fallback (+1.5% to +22%, host-variance noisy);
+    # gate/clip math parity re-pinned under the flag by
+    # tests/test_fused_lstm.py and the GRU parity tests. Set for BOTH
+    # modes (it only affects the CPU backend) so a tpu-mode child that
+    # comes back on CPU measures the same configuration as the explicit
+    # cpu fallback.
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_enable_fast_min_max" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_cpu_enable_fast_min_max=true"
+        ).strip()
+    if mode == "tpu":
+        # a directly-invoked child (e.g. the on-chip sweep scripts) gets
+        # no lock hygiene from main(); a prior SIGKILLed attempt's
+        # libtpu lockfile would wedge this backend init
+        clean_stale_tpu_locks()
     if mode == "cpu":
         # env alone is not enough: the ambient axon plugin pins the platform
         # via sitecustomize, so override jax.config before backend init too

@@ -15,6 +15,8 @@ for rep in $(seq 1 "$AB_REPS"); do
         bash scripts/probe_tpu.sh || { echo "chip down before rep $rep $sched" >&2; continue; }
         echo "--- rep $rep schedule=$sched ---"
         BENCH_SCHEDULE=$sched timeout "$AB_CHILD_TIMEOUT_S" \
-            python bench.py --child tpu 16384 3 2>/dev/null | tail -1
+            python bench.py --child tpu 16384 3 \
+            2>> benchmarks/schedule_ab_r05.err | tail -1 \
+            || echo "rep $rep $sched child failed/timed out" >&2
     done
 done

@@ -36,10 +36,11 @@ probe && timeout 900 python benchmarks/fleet_serving_scale.py --model lstm \
 
 echo "=== time_unroll on-chip sweep (schedule-only knob) ===" >&2
 for u in 2 4; do
-    probe || break
+    probe || { echo "chip down before time_unroll=$u" >&2; break; }
     echo "--- time_unroll=$u ---"
     BENCH_TIME_UNROLL=$u timeout 480 python bench.py --child tpu 16384 3 \
-        2>/dev/null | tail -1
+        2> "benchmarks/time_unroll_${u}_tpu_r05.err" | tail -1 \
+        || echo "time_unroll=$u child failed/timed out (see benchmarks/time_unroll_${u}_tpu_r05.err)" >&2
 done
 
 echo "=== second window done ===" >&2
