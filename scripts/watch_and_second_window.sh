@@ -1,9 +1,29 @@
 #!/usr/bin/env bash
 # Supervise: probe until the tunnel gives a second window, then run the
-# second-window playbook exactly once.
+# second-window playbook exactly once. A hard deadline keeps BOTH the
+# probing and the playbook clear of the driver's end-of-round bench:
+# probes burn ~150s of a 2-core host each, and a late-started playbook
+# would contend for the chip itself.
 set -uo pipefail
 cd "$(dirname "$0")/.."
-until bash scripts/tunnel_watcher.sh; do sleep 60; done
+DEADLINE_EPOCH="${DEADLINE_EPOCH:-$(date -u -d '2026-08-01T18:30:00Z' +%s)}"
+# short watcher batches (5 probes ~ 1h) so the deadline check between
+# batches runs hourly instead of after the watcher's full 70-probe budget
+export MAX_PROBES="${MAX_PROBES:-5}"
+
+until bash scripts/tunnel_watcher.sh; do
+    if [ "$(date -u +%s)" -ge "$DEADLINE_EPOCH" ]; then
+        echo "$(date -u +%FT%TZ) watcher deadline reached; standing down" \
+            >> scripts/tunnel_probe.log
+        exit 0
+    fi
+    sleep 60
+done
+if [ "$(date -u +%s)" -ge "$DEADLINE_EPOCH" ]; then
+    echo "$(date -u +%FT%TZ) window opened past deadline; NOT running playbook" \
+        >> scripts/tunnel_probe.log
+    exit 0
+fi
 echo "$(date -u +%FT%TZ) second window opens" >> scripts/tunnel_probe.log
 bash scripts/second_window_r05.sh >> benchmarks/second_window_r05.log 2>&1
 echo "$(date -u +%FT%TZ) second window playbook done" >> scripts/tunnel_probe.log
