@@ -43,4 +43,14 @@ for u in 2 4; do
         || echo "time_unroll=$u child failed/timed out (see benchmarks/time_unroll_${u}_tpu_r05.err)" >&2
 done
 
+echo "=== windowed + sequence-family fleet builds on-chip ===" >&2
+for kind_n in lstm:64 transformer:8 tcn:8; do
+    kind="${kind_n%%:*}"; n="${kind_n##*:}"
+    probe || { echo "chip down before fleet(kind=$kind)" >&2; break; }
+    timeout 1500 python benchmarks/fleet_throughput.py \
+        --kind "$kind" --machines "$n" --buckets 2 --epochs 5 --sequential-sample 2 \
+        > "benchmarks/fleet_${kind}_tpu_r05.out" 2> "benchmarks/fleet_${kind}_tpu_r05.err" \
+        || echo "fleet(kind=$kind) failed (see benchmarks/fleet_${kind}_tpu_r05.err)" >&2
+done
+
 echo "=== second window done ===" >&2
