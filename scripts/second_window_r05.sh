@@ -2,14 +2,19 @@
 #
 # Round-5 second-window playbook: the remaining on-chip items if the
 # tunnel gives another usable window after the 09:45Z wedge. Ordered by
-# value-per-chip-minute; each step is isolated and individually probed
-# (first window taught us the worker dies under sustained load).
-#   1. schedule A/B repeats (decides the TPU default schedule for the
-#      driver-gate bench: single-run r05 pair was 270.1M layer vs
-#      278.7M stacked)
-#   2. 500-machine fleet rerun (populates the significant-figure mfu
-#      field; first-window run predates the rounding fix)
-#   3. server latency refresh (r03 numbers predate windowed serving)
+# value-per-chip-minute (the first window lasted ~70 min and the worker
+# dies under sustained load, so the tail may starve); each step is
+# isolated and individually probed.
+#   1. schedule A/B repeats — decides the TPU default schedule for the
+#      driver-gate bench (single-run r05 pair: 270.1M layer vs 278.7M
+#      stacked); actionable only while the session can still flip it
+#   2. 500-machine fleet rerun — populates the significant-figure mfu
+#      field (first-window run predates the rounding fix)
+#   3. windowed + sequence-family fleet builds — the verdict's
+#      "Transformer/TCN on-chip via the playbook" ask, plus LSTM
+#   4. server latency refresh (r03 numbers predate windowed serving)
+#   5. windowed serving scale
+#   6. time_unroll sweep (optional schedule-only knob)
 set -uo pipefail
 cd "$(dirname "$0")/.."
 
@@ -23,6 +28,16 @@ probe && timeout 1200 python benchmarks/fleet_throughput.py \
     --machines 500 --buckets 3 --epochs 5 --sequential-sample 3 \
     > benchmarks/fleet_tpu_500_mfu_r05.out 2> benchmarks/fleet_tpu_500_mfu_r05.err \
     || echo "fleet rerun failed/skipped" >&2
+
+echo "=== windowed + sequence-family fleet builds on-chip ===" >&2
+for kind_n in lstm:64 transformer:8 tcn:8; do
+    kind="${kind_n%%:*}"; n="${kind_n##*:}"
+    probe || { echo "chip down before fleet(kind=$kind)" >&2; break; }
+    timeout 1500 python benchmarks/fleet_throughput.py \
+        --kind "$kind" --machines "$n" --buckets 2 --epochs 5 --sequential-sample 2 \
+        > "benchmarks/fleet_${kind}_tpu_r05.out" 2> "benchmarks/fleet_${kind}_tpu_r05.err" \
+        || echo "fleet(kind=$kind) failed (see benchmarks/fleet_${kind}_tpu_r05.err)" >&2
+done
 
 echo "=== server latency refresh ===" >&2
 probe && timeout 900 python benchmarks/server_latency.py --rounds 60 \
@@ -41,16 +56,6 @@ for u in 2 4; do
     BENCH_TIME_UNROLL=$u timeout 480 python bench.py --child tpu 16384 3 \
         2> "benchmarks/time_unroll_${u}_tpu_r05.err" | tail -1 \
         || echo "time_unroll=$u child failed/timed out (see benchmarks/time_unroll_${u}_tpu_r05.err)" >&2
-done
-
-echo "=== windowed + sequence-family fleet builds on-chip ===" >&2
-for kind_n in lstm:64 transformer:8 tcn:8; do
-    kind="${kind_n%%:*}"; n="${kind_n##*:}"
-    probe || { echo "chip down before fleet(kind=$kind)" >&2; break; }
-    timeout 1500 python benchmarks/fleet_throughput.py \
-        --kind "$kind" --machines "$n" --buckets 2 --epochs 5 --sequential-sample 2 \
-        > "benchmarks/fleet_${kind}_tpu_r05.out" 2> "benchmarks/fleet_${kind}_tpu_r05.err" \
-        || echo "fleet(kind=$kind) failed (see benchmarks/fleet_${kind}_tpu_r05.err)" >&2
 done
 
 echo "=== second window done ===" >&2
