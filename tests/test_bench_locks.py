@@ -80,3 +80,31 @@ def test_tpu_attempt_retries_once_then_falls_back(monkeypatch, capsys):
     record = json.loads(line)
     assert record["platform"] == "cpu"
     assert record["vs_baseline"] == 0.5
+
+
+def test_cpu_child_env_setup(monkeypatch):
+    """The cpu child pins the platform and enables fast-min/max exactly
+    once (a user-supplied ...=false must be respected, not doubled)."""
+    monkeypatch.setattr(bench, "bench_jax", lambda n, e: {"platform": "cpu"})
+    monkeypatch.setattr(bench, "clean_stale_tpu_locks", lambda pattern=None: None)
+
+    monkeypatch.setenv("XLA_FLAGS", "")
+    bench.child_main("cpu", 64, 1)
+    assert os.environ["JAX_PLATFORMS"] == "cpu"
+    assert os.environ["XLA_FLAGS"].count("xla_cpu_enable_fast_min_max") == 1
+
+    monkeypatch.setenv("XLA_FLAGS", "--xla_cpu_enable_fast_min_max=false")
+    bench.child_main("cpu", 64, 1)
+    assert os.environ["XLA_FLAGS"] == "--xla_cpu_enable_fast_min_max=false"
+
+
+def test_tpu_child_cleans_stale_locks(monkeypatch):
+    """Directly-invoked tpu children (sweep scripts bypass main()) must
+    run lock hygiene before backend init."""
+    cleaned = []
+    monkeypatch.setattr(
+        bench, "clean_stale_tpu_locks", lambda pattern=None: cleaned.append(1)
+    )
+    monkeypatch.setattr(bench, "bench_jax", lambda n, e: {"platform": "tpu"})
+    bench.child_main("tpu", 64, 1)
+    assert cleaned
