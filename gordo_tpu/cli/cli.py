@@ -72,6 +72,10 @@ def gordo(gordo_ctx: click.Context, **ctx):
             "[%(name)s.%(funcName)s:%(lineno)d] %(message)s"
         ),
     )
+    # JAX_PLATFORMS=cpu must work for every subcommand even where a TPU
+    # plugin pins jax_platforms via sitecustomize (which silently overrides
+    # the env var — and a wedged accelerator then hangs backend init)
+    utils.honor_jax_platforms_env()
     gordo_ctx.obj = gordo_ctx.params
 
 
@@ -191,10 +195,19 @@ def build(
 @click.command("build-fleet")
 @click.argument("machines-config", envvar="MACHINES", type=yaml.safe_load)
 @click.argument("output-dir", default="/data", envvar="OUTPUT_DIR")
+@click.option(
+    "--resume/--no-resume",
+    default=False,
+    envvar="GORDO_FLEET_RESUME",
+    help="Reuse machines whose artifacts already load from OUTPUT-DIR and "
+    "build only the rest — artifacts flush per bucket, so re-running after "
+    "a runtime crash completes the fleet instead of restarting it.",
+)
 @_with_build_options
 def build_fleet(
     machines_config: list,
     output_dir: str,
+    resume: bool,
     model_register_dir: str,
     print_cv_scores: bool,
     model_parameter: List[Tuple[str, Any]],
@@ -226,7 +239,9 @@ def build_fleet(
         logger.info(
             "Fleet-building %d machines, output at: %s", len(machines), output_dir
         )
-        built = FleetModelBuilder(machines).build(output_dir_base=output_dir)
+        built = FleetModelBuilder(machines).build(
+            output_dir_base=output_dir, resume=resume
+        )
         for _, machine_out in built:
             machine_out.report()
             if print_cv_scores:

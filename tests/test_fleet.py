@@ -770,3 +770,82 @@ def test_fleet_built_detector_records_cv_mode(tmp_path):
     assert meta.get("cv-fleet-masks") is True
     build_meta = machine.metadata.build_metadata.model.model_meta
     assert build_meta.get("cv-fleet-masks") is True
+
+
+def test_fleet_build_crash_resume(tmp_path):
+    """Artifacts flush per bucket, and resume=True reuses them: a runtime
+    crash mid-build (observed live: the tunneled TPU worker died
+    UNAVAILABLE during round-5 1000-machine builds) costs only the
+    in-flight bucket on the re-run."""
+    machines = make_machines(2)
+    # second bucket: distinct tag count -> distinct (n_features) geometry
+    wide_template = make_machines(1)[0].to_dict()
+    extra = []
+    for i in range(2):
+        cfg = dict(wide_template)
+        cfg["name"] = f"machine-wide-{i}"
+        cfg["dataset"] = dict(cfg["dataset"])
+        cfg["dataset"]["tags"] = [[f"Tag {t}", None] for t in range(4)]
+        extra.append(Machine.from_dict(cfg))
+    machines = machines + extra
+    assert len(bucket_machines(machines)) == 2
+
+    class CrashAfterFirstBucket(FleetModelBuilder):
+        calls = 0
+
+        def _build_bucket(self, bucket):
+            type(self).calls += 1
+            if type(self).calls == 2:
+                raise RuntimeError("TPU worker process crashed or restarted")
+            return super()._build_bucket(bucket)
+
+    crashing = CrashAfterFirstBucket(machines)
+    with pytest.raises(RuntimeError, match="crashed or restarted"):
+        crashing.build(output_dir_base=tmp_path)
+
+    # the completed bucket's artifacts were flushed before the crash
+    flushed = sorted(p.name for p in tmp_path.iterdir())
+    assert len(flushed) == 2, flushed
+
+    class CountingBuilder(FleetModelBuilder):
+        calls = 0
+
+        def _build_bucket(self, bucket):
+            type(self).calls += 1
+            return super()._build_bucket(bucket)
+
+    results = CountingBuilder(machines).build(
+        output_dir_base=tmp_path, resume=True
+    )
+    assert CountingBuilder.calls == 1  # only the crashed bucket rebuilt
+    assert [m.name for _, m in results] == [m.name for m in machines]
+    for model, machine in results:
+        # resumed machines carry their stored build metadata
+        scores = machine.metadata.build_metadata.model.cross_validation.scores
+        assert "explained-variance-score" in scores
+        assert model.aggregate_threshold_ is not None
+
+
+def test_fleet_build_resume_requires_output_dir():
+    with pytest.raises(ValueError, match="output_dir_base"):
+        FleetModelBuilder(make_machines(1)).build(resume=True)
+
+
+def test_fleet_build_resume_rejects_changed_config(tmp_path):
+    """--resume must rebuild a machine whose stored artifact was built
+    from a different model/dataset config (identity check, like the
+    reference's sha3-keyed cache) instead of silently reusing it."""
+    FleetModelBuilder(make_machines(1, epochs=2)).build(output_dir_base=tmp_path)
+
+    changed = make_machines(1, epochs=3)  # different configured budget
+
+    class CountingBuilder(FleetModelBuilder):
+        calls = 0
+
+        def _build_bucket(self, bucket):
+            type(self).calls += 1
+            return super()._build_bucket(bucket)
+
+    results = CountingBuilder(changed).build(output_dir_base=tmp_path, resume=True)
+    assert CountingBuilder.calls == 1  # rebuilt, not reused
+    assert len(results) == 1
