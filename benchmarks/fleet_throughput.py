@@ -212,7 +212,8 @@ def main():
     machines = make_machines(args.machines, args.epochs, args.buckets, args.kind)
 
     start = time.perf_counter()
-    fleet_results = FleetModelBuilder(machines).build()
+    fleet_builder = FleetModelBuilder(machines)
+    fleet_results = fleet_builder.build()
     fleet_s = time.perf_counter() - start
 
     seq_machines = make_machines(
@@ -232,9 +233,57 @@ def main():
     fleet_rate = args.machines / fleet_s * 3600
     seq_rate = 3600 / seq_s_per_machine
     mfu, peak_source = fleet_mfu(fleet_results, fleet_s, device)
+
+    # -- internal telemetry (gordo_tpu.observability): the system's OWN
+    # numbers for the same run, so external (this harness) and internal
+    # (registry + telemetry report) throughput can be cross-checked in
+    # the results JSON — a drift between them is itself a finding
+    from gordo_tpu.observability import get_registry
+
+    snapshot = get_registry().snapshot()
+
+    def _counter_total(name: str) -> float:
+        return sum(
+            s["value"] for s in snapshot.get(name, {}).get("series", [])
+        )
+
+    report = fleet_builder.telemetry_report_ or {}
+    bucket_fits = [
+        b.get("fit") or {} for b in report.get("buckets", [])
+    ]
+    fit_rates = [
+        f["sensor_timesteps_per_s"]
+        for f in bucket_fits
+        if f.get("sensor_timesteps_per_s") is not None
+    ]
+    internal = {
+        "internal_models_per_hour": report.get("models_per_hour"),
+        "internal_wall_time_s": report.get("wall_time_s"),
+        # max over the buckets' FINAL-fit rates (one final fit per
+        # bucket); null — not a fake 0.0 — when no fit telemetry landed
+        "internal_max_bucket_fit_sensor_timesteps_per_s": (
+            max(fit_rates) if fit_rates else None
+        ),
+        "internal_compile_time_s": sum(
+            f.get("compile_time_s") or 0.0 for f in bucket_fits
+        ),
+        "internal_peak_hbm_bytes": (report.get("device_memory") or {}).get(
+            "peak_bytes_in_use"
+        ),
+        "registry_train_epochs_total": _counter_total(
+            "gordo_train_epochs_total"
+        ),
+        "registry_train_sensor_timesteps_total": _counter_total(
+            "gordo_train_sensor_timesteps_total"
+        ),
+        "registry_build_models_total": _counter_total(
+            "gordo_build_models_total"
+        ),
+    }
     print(
         json.dumps(
             {
+                **internal,
                 "machines": args.machines,
                 "buckets": args.buckets,
                 "epochs": args.epochs,

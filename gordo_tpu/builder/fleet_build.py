@@ -51,6 +51,12 @@ from gordo_tpu.machine.metadata import (
 )
 from gordo_tpu.models.anomaly.diff import DiffBasedAnomalyDetector
 from gordo_tpu.models.core import BaseJaxEstimator
+from gordo_tpu.observability import (
+    emit_event,
+    get_registry,
+    memory_watermarks,
+    write_telemetry_report,
+)
 from gordo_tpu.parallel.bucketing import bucket_machines, timestep_bucket
 from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
 from gordo_tpu.parallel.mesh import auto_device_mesh
@@ -107,6 +113,11 @@ class FleetModelBuilder:
             mesh = auto_device_mesh()
         self.mesh = mesh
         self.data_threads = data_threads
+        #: per-bucket telemetry accumulated by _build_bucket, assembled
+        #: into telemetry_report_ (and persisted next to artifacts) by
+        #: build()
+        self._bucket_reports: List[dict] = []
+        self.telemetry_report_: Optional[dict] = None
 
     # -- data ------------------------------------------------------------
     def _fetch_one(self, machine: Machine):
@@ -151,6 +162,17 @@ class FleetModelBuilder:
         if resume and output_dir_base is None:
             raise ValueError("resume=True requires output_dir_base")
         base = Path(output_dir_base) if output_dir_base is not None else None
+
+        build_start = time.time()
+        started_iso = str(datetime.now(timezone.utc).astimezone())
+        self._bucket_reports = []
+        self.telemetry_report_ = None
+        emit_event(
+            "build_started",
+            n_machines=len(self.machines),
+            output_dir=str(base) if base is not None else None,
+            resume=bool(resume),
+        )
 
         results: Dict[str, Tuple[BaseEstimator, Machine]] = {}
         to_build = list(self.machines)
@@ -201,6 +223,12 @@ class FleetModelBuilder:
                     "Resume: %d/%d machines already built under %s",
                     len(results), len(to_build), base,
                 )
+                emit_event(
+                    "resume",
+                    n_reused=len(results),
+                    n_total=len(to_build),
+                    output_dir=str(base),
+                )
             to_build = remaining
 
         buckets = bucket_machines(to_build)
@@ -215,31 +243,110 @@ class FleetModelBuilder:
                 ModelBuilder._save_model(
                     model=model, machine=machine, output_dir=base / machine.name
                 )
+            emit_event("bucket_flush", n_models=len(pairs), output_dir=str(base))
 
-        for (model_key, n_feat, n_feat_out), bucket in buckets.items():
-            prototype = serializer.from_definition(bucket[0].model)
-            if _find_jax_estimator(prototype) is None:
-                logger.info(
-                    "Bucket %r has no JAX estimator; falling back to "
-                    "per-machine builds (%d machines)",
-                    model_key[:60],
-                    len(bucket),
-                )
-                for machine in bucket:
-                    results[machine.name] = ModelBuilder(machine).build()
-                    # flush per machine: these unbatched builds are the
-                    # slowest, so the crash-loss window matters most here
-                    _flush([results[machine.name]])
-                continue
-            built_bucket = self._build_bucket(bucket)
-            results.update(built_bucket)
-            _flush(built_bucket.values())
+        try:
+            for (model_key, n_feat, n_feat_out), bucket in buckets.items():
+                prototype = serializer.from_definition(bucket[0].model)
+                if _find_jax_estimator(prototype) is None:
+                    logger.info(
+                        "Bucket %r has no JAX estimator; falling back to "
+                        "per-machine builds (%d machines)",
+                        model_key[:60],
+                        len(bucket),
+                    )
+                    for machine in bucket:
+                        results[machine.name] = ModelBuilder(machine).build()
+                        # flush per machine: these unbatched builds are the
+                        # slowest, so the crash-loss window matters most here
+                        _flush([results[machine.name]])
+                    continue
+                built_bucket = self._build_bucket(bucket)
+                results.update(built_bucket)
+                _flush(built_bucket.values())
+        except BaseException as exc:
+            # the crash context the round-5 worker deaths never left
+            # behind: what was in flight and how memory looked at death
+            emit_event(
+                "build_crashed",
+                error=repr(exc),
+                n_machines_done=len(results),
+                n_machines_total=len(self.machines),
+                device_memory=memory_watermarks(),
+            )
+            raise
 
+        self._finish_telemetry(
+            base=base,
+            build_start=build_start,
+            started_iso=started_iso,
+            n_built=len(to_build),
+            n_resumed=len(self.machines) - len(to_build),
+            n_buckets=len(buckets),
+        )
         return [results[m.name] for m in self.machines]
+
+    def _finish_telemetry(
+        self,
+        base: Optional[Path],
+        build_start: float,
+        started_iso: str,
+        n_built: int,
+        n_resumed: int,
+        n_buckets: int,
+    ) -> None:
+        """Assemble (and persist, when building to disk) the build's
+        telemetry report from the per-bucket records."""
+        wall = time.time() - build_start
+        # rate counts machines BUILT this run: resume-reused artifacts
+        # were loaded, not built, and counting them would inflate the
+        # north-star models/hour ~(total/rebuilt)x on a mostly-warm resume
+        rate = n_built / wall * 3600 if wall > 0 else None
+        report = {
+            "kind": "fleet_build",
+            "started": started_iso,
+            "finished": str(datetime.now(timezone.utc).astimezone()),
+            "wall_time_s": wall,
+            "n_machines": len(self.machines),
+            "n_built": n_built,
+            "n_resumed": n_resumed,
+            "n_buckets": n_buckets,
+            "models_per_hour": rate,
+            "device_memory": memory_watermarks(),
+            "buckets": self._bucket_reports,
+        }
+        self.telemetry_report_ = report
+        reg = get_registry()
+        reg.counter(
+            "gordo_build_models_total", "Models produced by fleet builds"
+        ).inc(n_built)
+        reg.histogram(
+            "gordo_build_seconds", "Whole fleet-build wall time"
+        ).observe(wall)
+        if rate is not None:
+            reg.gauge(
+                "gordo_build_models_per_hour", "Most recent build's rate"
+            ).set(rate)
+        peak = report["device_memory"].get("peak_bytes_in_use")
+        if peak is not None:
+            reg.gauge(
+                "gordo_build_peak_hbm_bytes",
+                "Peak device memory observed across builds",
+            ).set_max(peak)
+        if base is not None:
+            write_telemetry_report(base, report)
+        emit_event(
+            "build_finished",
+            n_machines=len(self.machines),
+            n_resumed=n_resumed,
+            wall_time_s=round(wall, 4),
+            models_per_hour=rate,
+        )
 
     def _build_bucket(
         self, bucket: List[Machine]
     ) -> Dict[str, Tuple[BaseEstimator, Machine]]:
+        bucket_start = time.time()
         fetched = self.fetch_data(bucket)
 
         # Per-machine host-side prep: build the model object, fit prefix
@@ -410,6 +517,47 @@ class FleetModelBuilder:
                 ),
             )
             out[machine.name] = (model, machine_out)
+
+        # -- bucket telemetry: rate, final-fit timings, HBM watermark ------
+        bucket_wall = time.time() - bucket_start
+        bucket_memory = memory_watermarks()
+        self._bucket_reports.append(
+            {
+                "n_machines": len(bucket),
+                "n_machines_padded": int(m_padded),
+                "n_timesteps_grid": int(n_grid),
+                "n_features": int(Xs_grid[0].shape[1]),
+                "epochs": epochs,
+                "batch_size": batch_size,
+                "cv_duration_s": cv_duration,
+                "fit_duration_s": fit_duration,
+                "bucket_wall_s": bucket_wall,
+                "models_per_hour": (
+                    len(bucket) / bucket_wall * 3600 if bucket_wall > 0 else None
+                ),
+                # the final full fit's telemetry (compile split, steady
+                # epoch time, sensor-timesteps/s) — fold fits overwrite
+                # this attribute, the final fit runs last
+                "fit": getattr(trainer, "fit_telemetry_", None),
+                "device_memory": bucket_memory,
+            }
+        )
+        get_registry().histogram(
+            "gordo_build_bucket_seconds",
+            "Per-bucket wall time (data fetch + CV + fit + unstack)",
+        ).observe(bucket_wall)
+        peak = bucket_memory.get("peak_bytes_in_use")
+        if peak is not None:
+            get_registry().gauge(
+                "gordo_build_peak_hbm_bytes",
+                "Peak device memory observed across builds",
+            ).set_max(peak)
+        emit_event(
+            "bucket_finished",
+            n_machines=len(bucket),
+            wall_time_s=round(bucket_wall, 4),
+            peak_bytes_in_use=peak,
+        )
         return out
 
     @staticmethod

@@ -4,14 +4,15 @@ CLI entry points (reference parity: gordo/cli/cli.py).
 Commands: ``build`` (one Machine per process — reference semantics),
 ``build-fleet`` (TPU-native addition: a bucket of Machines trained as one
 vmapped XLA program per architecture bucket — the fleet builder that
-replaces one-pod-per-model), ``run-server``, plus the ``workflow`` and
-``client`` groups.
+replaces one-pod-per-model), ``run-server``, plus the ``workflow``,
+``client`` and ``telemetry`` groups.
 
 Note: the reference snapshot plants a fault raising FileNotFoundError for
 machine names containing "err" (gordo/cli/cli.py:178-179); that is a bug in
 the snapshot and is deliberately not replicated.
 """
 
+import json
 import logging
 import sys
 import traceback
@@ -410,6 +411,40 @@ def sweep_cli(
     return 0
 
 
+@click.group("telemetry")
+def telemetry_cli():
+    """Inspect fleet telemetry: build reports and event logs."""
+
+
+@telemetry_cli.command("summarize")
+@click.argument(
+    "directory", type=click.Path(exists=True, file_okay=False, dir_okay=True)
+)
+@click.option(
+    "--as-json",
+    is_flag=True,
+    help="Emit the collected reports as JSON instead of the human summary.",
+)
+def telemetry_summarize(directory: str, as_json: bool):
+    """
+    Aggregate every ``telemetry_report*.json`` and ``*.jsonl`` event log
+    under DIRECTORY (a build output dir, or a root holding many) into one
+    human-readable fleet summary: machines built, models/hour, compile vs
+    steady-state epoch time, training throughput, peak device memory, and
+    any crash context the event logs captured.
+    """
+    from gordo_tpu.observability.report import load_reports, summarize_directory
+
+    if as_json:
+        payload = [
+            {"path": str(path), "report": report}
+            for path, report in load_reports(directory)
+        ]
+        click.echo(json.dumps(payload, indent=2, default=str))
+    else:
+        click.echo(summarize_directory(directory))
+
+
 @click.command("run-server")
 @click.option(
     "--host",
@@ -488,6 +523,7 @@ gordo.add_command(build_fleet)
 gordo.add_command(sweep_cli)
 gordo.add_command(run_server_cli)
 gordo.add_command(gordo_client)
+gordo.add_command(telemetry_cli)
 
 if __name__ == "__main__":
     gordo()

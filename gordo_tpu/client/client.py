@@ -18,7 +18,7 @@ import logging
 import typing
 from concurrent.futures import ThreadPoolExecutor
 from datetime import datetime
-from time import sleep
+from time import monotonic, sleep
 from typing import Any, Callable, Dict, List, Optional
 
 import pandas as pd
@@ -36,10 +36,35 @@ from gordo_tpu.client.utils import PredictionResult, backoff_seconds, cached_met
 from gordo_tpu.data.providers.base import GordoBaseDataProvider
 from gordo_tpu.machine import Machine
 from gordo_tpu.machine.metadata import Metadata
+from gordo_tpu.observability import get_registry
 from gordo_tpu.server import utils as server_utils
 from gordo_tpu.utils.compat import normalize_frequency
 
 logger = logging.getLogger(__name__)
+
+
+def _observe_request(path: str, outcome: str, seconds: float) -> None:
+    """One prediction POST's latency/outcome into the process registry
+    (path: 'fleet' or 'single'; outcome: ok/io_error/refused/gone)."""
+    reg = get_registry()
+    reg.histogram(
+        "gordo_client_request_seconds",
+        "Client prediction POST latency",
+        ("path", "outcome"),
+    ).observe(seconds, path=path, outcome=outcome)
+    reg.counter(
+        "gordo_client_requests_total",
+        "Client prediction POSTs by outcome",
+        ("path", "outcome"),
+    ).inc(path=path, outcome=outcome)
+
+
+def _count_retry(path: str) -> None:
+    get_registry().counter(
+        "gordo_client_retries_total",
+        "Prediction POST retries after IO errors",
+        ("path",),
+    ).inc(path=path)
 
 
 class Client:
@@ -446,17 +471,24 @@ class Client:
         else:
             post_kwargs["json"] = {"machines": payload}
         for current_attempt in itertools.count(start=1):
+            attempt_start = monotonic()
             try:
-                return "ok", handle_response(
+                result = "ok", handle_response(
                     self.session.post(url, **post_kwargs)
                 )
+                _observe_request("fleet", "ok", monotonic() - attempt_start)
+                return result
             except (
                 IOError,
                 TimeoutError,
                 requests.ConnectionError,
                 requests.HTTPError,
             ) as exc:
+                _observe_request(
+                    "fleet", "io_error", monotonic() - attempt_start
+                )
                 if current_attempt <= self.n_retries:
+                    _count_retry("fleet")
                     time_to_sleep = backoff_seconds(current_attempt)
                     logger.warning(
                         "Fleet chunk failed attempt %d of %d; retrying in %ds",
@@ -469,8 +501,12 @@ class Client:
                 logger.error("Fleet chunk failed after retries: %s", exc)
                 return "io_error", str(exc)
             except ResourceGone:
+                _observe_request("fleet", "gone", monotonic() - attempt_start)
                 raise
             except (HttpUnprocessableEntity, BadGordoRequest, NotFound) as exc:
+                _observe_request(
+                    "fleet", "refused", monotonic() - attempt_start
+                )
                 logger.warning(
                     "Fleet endpoint refused group (%s); falling back to "
                     "per-machine path",
@@ -565,6 +601,7 @@ class Client:
             }
 
         for current_attempt in itertools.count(start=1):
+            attempt_start = monotonic()
             try:
                 try:
                     resp = handle_response(self.session.post(**kwargs))
@@ -580,7 +617,11 @@ class Client:
                 requests.ConnectionError,
                 requests.HTTPError,
             ) as exc:
+                _observe_request(
+                    "single", "io_error", monotonic() - attempt_start
+                )
                 if current_attempt <= self.n_retries:
+                    _count_retry("single")
                     time_to_sleep = backoff_seconds(current_attempt)
                     logger.warning(
                         "Failed attempt %d of %d; retrying in %ds",
@@ -601,6 +642,9 @@ class Client:
             except (HttpUnprocessableEntity, BadGordoRequest, NotFound) as exc:
                 # A second 422 (the fallback /prediction also refused) is a
                 # per-machine failure like any other 4xx — not a run-abort.
+                _observe_request(
+                    "single", "refused", monotonic() - attempt_start
+                )
                 msg = (
                     f"Failed with bad request or not found for dates "
                     f"{start} -> {end} for target: '{machine.name}' Error: {exc}"
@@ -610,8 +654,10 @@ class Client:
                     name=machine.name, predictions=None, error_messages=[msg]
                 )
             except ResourceGone:
+                _observe_request("single", "gone", monotonic() - attempt_start)
                 raise
             else:
+                _observe_request("single", "ok", monotonic() - attempt_start)
                 predictions = self.dataframe_from_response(resp)
                 if self.prediction_forwarder is not None:
                     self.prediction_forwarder(

@@ -1,0 +1,51 @@
+"""
+Fleet-wide telemetry (SURVEY.md §5 gap; ML-goodput direction from
+PAPERS.md arXiv:2502.06982): an in-process, dependency-light metrics
+registry, a structured JSONL event log, and device-memory watermark
+sampling — the data layer every perf / memory-modeling PR stands on.
+
+- :mod:`registry` — thread-safe Counter/Gauge/Histogram metrics,
+  snapshot-able to plain dicts (no ``prometheus_client`` dependency).
+- :mod:`events` — one-JSON-line-per-event emitter (build started/
+  finished, epoch, bucket flush, resume, crash context).
+- :mod:`device_memory` — HBM watermark sampling via
+  ``device.memory_stats()``, degrading gracefully (null bytes) on CPU.
+- :mod:`prom_bridge` — optional export of the registry into a
+  ``prometheus_client`` CollectorRegistry so ``/metrics`` serves it.
+- :mod:`report` — telemetry-report JSON persisted next to build
+  artifacts, plus the aggregation behind ``gordo-tpu telemetry
+  summarize``.
+"""
+
+from .device_memory import (
+    device_memory_stats,
+    memory_watermarks,
+    save_device_memory_profile,
+)
+from .events import EVENT_LOG_ENV_VAR, EventEmitter, emit_event, read_events
+from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+from .report import (
+    TELEMETRY_REPORT_FILENAME,
+    load_reports,
+    summarize_directory,
+    write_telemetry_report,
+)
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "get_registry",
+    "EVENT_LOG_ENV_VAR",
+    "EventEmitter",
+    "emit_event",
+    "read_events",
+    "device_memory_stats",
+    "memory_watermarks",
+    "save_device_memory_profile",
+    "TELEMETRY_REPORT_FILENAME",
+    "write_telemetry_report",
+    "load_reports",
+    "summarize_directory",
+]

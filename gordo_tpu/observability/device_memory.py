@@ -1,0 +1,113 @@
+"""
+Device-memory watermark sampling.
+
+TPU runtimes expose per-device allocator stats through
+``device.memory_stats()`` (bytes_in_use, peak_bytes_in_use,
+bytes_limit); the CPU backend typically returns ``None`` or raises.
+Every function here degrades gracefully to null byte fields, so the
+same instrumentation runs in CPU tests and on-chip — the round-5
+1000-machine builds crashed the TPU worker three times with zero
+memory visibility, and this module is what makes the next such crash
+diagnosable (peak-HBM per bucket lands in the telemetry report).
+"""
+
+import logging
+import typing
+
+logger = logging.getLogger(__name__)
+
+#: memory_stats keys worth reporting, normalized to our field names.
+_STAT_FIELDS = {
+    "bytes_in_use": "bytes_in_use",
+    "peak_bytes_in_use": "peak_bytes_in_use",
+    "bytes_limit": "bytes_limit",
+    "largest_alloc_size": "largest_alloc_size",
+}
+
+
+def device_memory_stats(device=None) -> dict:
+    """
+    One device's allocator stats. Always returns a dict; the byte fields
+    are None when the backend exposes nothing (CPU) — "gracefully null",
+    never an exception.
+    """
+    out: typing.Dict[str, typing.Any] = {
+        field: None for field in _STAT_FIELDS.values()
+    }
+    out.update({"device": None, "platform": None, "supported": False})
+    try:
+        import jax
+
+        if device is None:
+            device = jax.devices()[0]
+    except Exception:  # no usable backend at all
+        logger.debug("device_memory_stats: no jax device", exc_info=True)
+        return out
+    out["device"] = str(device)
+    out["platform"] = getattr(device, "platform", None)
+    try:
+        stats = device.memory_stats()
+    except Exception:
+        stats = None
+    if not stats:
+        return out
+    out["supported"] = True
+    for src, dst in _STAT_FIELDS.items():
+        value = stats.get(src)
+        out[dst] = int(value) if value is not None else None
+    return out
+
+
+def save_device_memory_profile(path: str) -> bool:
+    """
+    Dump a pprof-format device-memory profile via
+    ``jax.profiler.save_device_memory_profile`` — the deep-dive
+    companion to :func:`memory_watermarks` (per-allocation attribution
+    vs. one number). Returns False (logged) instead of raising when the
+    backend cannot produce one.
+    """
+    try:
+        import jax
+
+        jax.profiler.save_device_memory_profile(path)
+        return True
+    except Exception:
+        logger.warning(
+            "Could not save device memory profile to %s", path, exc_info=True
+        )
+        return False
+
+
+def memory_watermarks(devices=None) -> dict:
+    """
+    Fleet-wide memory watermark snapshot: per-device stats plus the max
+    ``peak_bytes_in_use`` across devices (None when no device reports —
+    the CPU case). This is the per-bucket record the fleet builder
+    persists into its telemetry report.
+    """
+    device_stats: typing.List[dict] = []
+    try:
+        import jax
+
+        devices = devices if devices is not None else jax.devices()
+    except Exception:
+        devices = []
+    for device in devices:
+        device_stats.append(device_memory_stats(device))
+    peaks = [
+        s["peak_bytes_in_use"]
+        for s in device_stats
+        if s.get("peak_bytes_in_use") is not None
+    ]
+    in_use = [
+        s["bytes_in_use"]
+        for s in device_stats
+        if s.get("bytes_in_use") is not None
+    ]
+    return {
+        "available": bool(peaks or in_use),
+        "n_devices": len(device_stats),
+        "peak_bytes_in_use": max(peaks) if peaks else None,
+        "bytes_in_use": max(in_use) if in_use else None,
+        "devices": device_stats,
+    }

@@ -1,0 +1,108 @@
+"""
+Structured JSONL event log: one JSON object per line, one line per
+lifecycle event (build started/finished, epoch, bucket flush, resume,
+crash context).
+
+Enabled by pointing ``GORDO_TPU_EVENT_LOG`` at a file path (or passing
+an explicit path to :class:`EventEmitter`); disabled — a cheap no-op —
+otherwise. Emission NEVER raises: telemetry must not be able to crash
+the workload it observes. Writes are O_APPEND per line, so concurrent
+threads (and forked server workers writing to the same file) interleave
+whole records.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+import typing
+from datetime import datetime, timezone
+
+logger = logging.getLogger(__name__)
+
+EVENT_LOG_ENV_VAR = "GORDO_TPU_EVENT_LOG"
+
+
+def _utc_now_iso() -> str:
+    return datetime.now(timezone.utc).isoformat()
+
+
+class EventEmitter:
+    """
+    Emit events to a JSONL file. ``path=None`` defers to the
+    ``GORDO_TPU_EVENT_LOG`` env var at each emit, so one process-wide
+    emitter honors per-run (re)configuration — e.g. tests, or a builder
+    pod whose workflow template injects the path.
+    """
+
+    def __init__(self, path: typing.Optional[str] = None):
+        self._path = path
+        self._lock = threading.Lock()
+
+    def target_path(self) -> str:
+        """The active log path, or '' when event logging is off."""
+        return self._path or os.environ.get(EVENT_LOG_ENV_VAR, "")
+
+    def emit(self, event: str, **fields) -> typing.Optional[dict]:
+        """
+        Append one event line. Returns the record written, or None when
+        logging is disabled or the write failed (logged, never raised).
+        """
+        path = self.target_path()
+        if not path:
+            return None
+        record = {
+            "ts": _utc_now_iso(),
+            "unix_ms": int(time.time() * 1000),
+            "event": str(event),
+            "pid": os.getpid(),
+        }
+        record.update(fields)
+        try:
+            line = json.dumps(record, default=str)
+        except Exception:
+            logger.warning("Unserializable telemetry event %r dropped", event)
+            return None
+        try:
+            parent = os.path.dirname(os.path.abspath(path))
+            os.makedirs(parent, exist_ok=True)
+            with self._lock, open(path, "a") as fh:
+                fh.write(line + "\n")
+        except OSError:
+            logger.warning(
+                "Could not write telemetry event to %s", path, exc_info=True
+            )
+            return None
+        return record
+
+
+#: Process-wide emitter (env-var configured).
+_DEFAULT_EMITTER = EventEmitter()
+
+
+def emit_event(event: str, **fields) -> typing.Optional[dict]:
+    """Emit on the process-wide (env-var configured) emitter."""
+    return _DEFAULT_EMITTER.emit(event, **fields)
+
+
+def read_events(path: str) -> typing.List[dict]:
+    """
+    Parse a JSONL event file back into records, skipping (and counting
+    into logs) malformed lines — a crash mid-write may truncate the last
+    line, and the reader must survive that.
+    """
+    records: typing.List[dict] = []
+    bad = 0
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except ValueError:
+                bad += 1
+    if bad:
+        logger.warning("Skipped %d malformed event lines in %s", bad, path)
+    return records

@@ -1,0 +1,115 @@
+"""
+Optional bridge from the dependency-light observability registry into a
+``prometheus_client`` CollectorRegistry, so the server's ``/metrics``
+exposition serves the training/serving/client series alongside the
+request metrics it already has.
+
+The bridge is a custom collector reading :meth:`MetricsRegistry.snapshot`
+at SCRAPE time — no copying on the hot path, and series registered after
+bridging still show up. ``prometheus_client`` is imported lazily: the
+core registry has zero hard dependency on it.
+"""
+
+import logging
+import threading
+import typing
+
+from gordo_tpu.observability.registry import MetricsRegistry
+
+logger = logging.getLogger(__name__)
+
+#: attribute stamped onto a prom registry listing the MetricsRegistry
+#: objects already bridged into it (re-bridging would double-register
+#: the collector and fail the scrape with duplicate series). Kept on
+#: the prom-registry OBJECT — a module-level id() set would misfire
+#: when a dead registry's id is reused.
+_BRIDGED_ATTR = "_gordo_tpu_bridged_registries"
+_BRIDGED_LOCK = threading.Lock()
+
+
+class RegistryCollector:
+    """prometheus_client custom collector over a MetricsRegistry."""
+
+    def __init__(self, registry: MetricsRegistry):
+        self._registry = registry
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+            HistogramMetricFamily,
+        )
+
+        for name, snap in self._registry.snapshot().items():
+            labelnames = snap["labelnames"]
+            if snap["type"] == "counter":
+                family = CounterMetricFamily(
+                    name, snap["description"] or name, labels=labelnames
+                )
+                for series in snap["series"]:
+                    family.add_metric(
+                        [series["labels"][ln] for ln in labelnames],
+                        series["value"],
+                    )
+            elif snap["type"] == "gauge":
+                family = GaugeMetricFamily(
+                    name, snap["description"] or name, labels=labelnames
+                )
+                for series in snap["series"]:
+                    family.add_metric(
+                        [series["labels"][ln] for ln in labelnames],
+                        series["value"],
+                    )
+            elif snap["type"] == "histogram":
+                family = HistogramMetricFamily(
+                    name, snap["description"] or name, labels=labelnames
+                )
+                for series in snap["series"]:
+                    family.add_metric(
+                        [series["labels"][ln] for ln in labelnames],
+                        buckets=[
+                            (le, count)
+                            for le, count in series["buckets"].items()
+                        ],
+                        sum_value=series["sum"],
+                    )
+            else:  # pragma: no cover - registry only mints the three kinds
+                continue
+            yield family
+
+
+def export_to_prometheus(
+    registry: typing.Optional[MetricsRegistry] = None,
+    prom_registry=None,
+) -> bool:
+    """
+    Register a scrape-time bridge for ``registry`` (default: the
+    process-wide one) on ``prom_registry`` (default: prometheus's global
+    REGISTRY). Idempotent per (registry, prom_registry) pair. Returns
+    False — with a log line, never an exception — when
+    ``prometheus_client`` is unavailable.
+    """
+    from gordo_tpu.observability.registry import get_registry
+
+    if registry is None:
+        registry = get_registry()
+    try:
+        import prometheus_client
+    except ImportError:
+        logger.warning(
+            "prometheus_client not installed; observability registry "
+            "will not be exposed on /metrics"
+        )
+        return False
+    if prom_registry is None:
+        prom_registry = prometheus_client.REGISTRY
+    with _BRIDGED_LOCK:
+        bridged = getattr(prom_registry, _BRIDGED_ATTR, None)
+        if bridged is None:
+            bridged = []
+            setattr(prom_registry, _BRIDGED_ATTR, bridged)
+        if any(existing is registry for existing in bridged):
+            return True
+        prom_registry.register(RegistryCollector(registry))
+        bridged.append(registry)
+    return True

@@ -1,0 +1,237 @@
+"""
+In-process metrics registry: Counter / Gauge / Histogram with labels,
+thread-safe, snapshot-able to plain dicts.
+
+Deliberately dependency-light (stdlib only): the training and client
+layers must be instrumentable without ``prometheus_client`` in the
+image. The server bridges a registry into its Prometheus exposition via
+:mod:`gordo_tpu.observability.prom_bridge` when that package exists.
+
+Naming/label discipline (enforced by tests/static_analysis.py
+``check_metric_registrations``): every metric name carries the
+``gordo_`` prefix, counters end in ``_total``, and label NAMES come
+from the bounded set documented in docs/observability.md — label
+VALUES must be low-cardinality (phase/endpoint/outcome style), never
+raw paths or machine names.
+"""
+
+import math
+import re
+import threading
+import typing
+
+_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+#: Default histogram buckets: latency-flavored seconds, wide enough for
+#: both sub-ms serving dispatches and multi-minute fleet fits.
+DEFAULT_BUCKETS = (
+    0.001, 0.005, 0.025, 0.1, 0.25, 1.0, 5.0, 30.0, 120.0, 600.0,
+)
+
+
+class _Metric:
+    """Shared label plumbing for the three metric kinds."""
+
+    kind = "untyped"
+
+    def __init__(
+        self,
+        name: str,
+        description: str,
+        labelnames: typing.Tuple[str, ...],
+        lock: threading.RLock,
+    ):
+        self.name = name
+        self.description = description
+        self.labelnames = labelnames
+        self._lock = lock
+        self._series: typing.Dict[typing.Tuple[str, ...], typing.Any] = {}
+
+    def _key(self, labels: typing.Dict[str, typing.Any]) -> typing.Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"Metric {self.name!r} takes labels {self.labelnames}, "
+                f"got {tuple(sorted(labels))}"
+            )
+        return tuple(str(labels[ln]) for ln in self.labelnames)
+
+    def _label_dicts(
+        self, key: typing.Tuple[str, ...]
+    ) -> typing.Dict[str, str]:
+        return dict(zip(self.labelnames, key))
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "type": self.kind,
+                "description": self.description,
+                "labelnames": list(self.labelnames),
+                "series": [
+                    {"labels": self._label_dicts(key), **self._series_value(value)}
+                    for key, value in self._series.items()
+                ],
+            }
+
+    def _series_value(self, value) -> dict:
+        return {"value": value}
+
+
+class Counter(_Metric):
+    """Monotonically increasing count."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"Counter {self.name!r} cannot decrease")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(self._key(labels), 0.0))
+
+
+class Gauge(_Metric):
+    """A value that can go up and down (or be set outright)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(amount)
+
+    def dec(self, amount: float = 1.0, **labels) -> None:
+        self.inc(-amount, **labels)
+
+    def set_max(self, value: float, **labels) -> None:
+        """Keep the running maximum — the watermark operation."""
+        key = self._key(labels)
+        with self._lock:
+            current = self._series.get(key)
+            if current is None or float(value) > current:
+                self._series[key] = float(value)
+
+    def value(self, **labels) -> typing.Optional[float]:
+        with self._lock:
+            got = self._series.get(self._key(labels))
+            return None if got is None else float(got)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus semantics)."""
+
+    kind = "histogram"
+
+    def __init__(self, name, description, labelnames, lock, buckets=None):
+        super().__init__(name, description, labelnames, lock)
+        bounds = tuple(sorted(float(b) for b in (buckets or DEFAULT_BUCKETS)))
+        if not bounds:
+            raise ValueError(f"Histogram {self.name!r} needs at least one bucket")
+        self.buckets = bounds + (math.inf,)
+
+    def observe(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        value = float(value)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None:
+                state = {"count": 0, "sum": 0.0, "buckets": [0] * len(self.buckets)}
+                self._series[key] = state
+            state["count"] += 1
+            state["sum"] += value
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    state["buckets"][i] += 1
+
+    def _series_value(self, state) -> dict:
+        return {
+            "count": state["count"],
+            "sum": state["sum"],
+            "buckets": {
+                ("+Inf" if math.isinf(b) else repr(b)): state["buckets"][i]
+                for i, b in enumerate(self.buckets)
+            },
+        }
+
+
+class MetricsRegistry:
+    """
+    Get-or-create home for metrics. ``counter``/``gauge``/``histogram``
+    are idempotent on (name, kind, labelnames): hot paths can call them
+    per use without bookkeeping, and re-registration with a different
+    shape fails loudly.
+    """
+
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._metrics: typing.Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, description, labelnames, **kwargs):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"Invalid metric name {name!r}")
+        labelnames = tuple(labelnames)
+        with self._lock:
+            existing = self._metrics.get(name)
+            if existing is not None:
+                if not isinstance(existing, cls) or existing.labelnames != labelnames:
+                    raise ValueError(
+                        f"Metric {name!r} already registered as "
+                        f"{existing.kind} with labels {existing.labelnames}"
+                    )
+                return existing
+            metric = cls(name, description, labelnames, self._lock, **kwargs)
+            self._metrics[name] = metric
+            return metric
+
+    def counter(
+        self, name: str, description: str = "", labelnames: typing.Sequence[str] = ()
+    ) -> Counter:
+        return self._get_or_create(Counter, name, description, labelnames)
+
+    def gauge(
+        self, name: str, description: str = "", labelnames: typing.Sequence[str] = ()
+    ) -> Gauge:
+        return self._get_or_create(Gauge, name, description, labelnames)
+
+    def histogram(
+        self,
+        name: str,
+        description: str = "",
+        labelnames: typing.Sequence[str] = (),
+        buckets: typing.Optional[typing.Sequence[float]] = None,
+    ) -> Histogram:
+        return self._get_or_create(
+            Histogram, name, description, labelnames, buckets=buckets
+        )
+
+    def snapshot(self) -> typing.Dict[str, dict]:
+        """Every metric's current state as plain (JSON-able) dicts."""
+        with self._lock:
+            metrics = list(self._metrics.items())
+        return {name: metric.snapshot() for name, metric in metrics}
+
+    def reset(self) -> None:
+        """Drop all metrics (test isolation)."""
+        with self._lock:
+            self._metrics.clear()
+
+    def __contains__(self, name: str) -> bool:
+        with self._lock:
+            return name in self._metrics
+
+
+#: The process-wide default registry every layer records into.
+_DEFAULT_REGISTRY = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-wide default registry."""
+    return _DEFAULT_REGISTRY

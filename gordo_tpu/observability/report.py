@@ -1,0 +1,212 @@
+"""
+Telemetry reports: the per-build JSON the fleet builder persists next to
+its artifacts, and the aggregation that renders ``gordo-tpu telemetry
+summarize <dir>`` — the human entry point for "what did this fleet run
+actually do" (models/hour, compile vs steady-state, peak HBM, crashes).
+"""
+
+import json
+import logging
+import os
+import typing
+from pathlib import Path
+
+logger = logging.getLogger(__name__)
+
+TELEMETRY_REPORT_FILENAME = "telemetry_report.json"
+TELEMETRY_REPORT_VERSION = 1
+
+
+def write_telemetry_report(
+    directory: typing.Union[str, Path], report: dict
+) -> Path:
+    """Persist ``report`` as ``<directory>/telemetry_report.json``."""
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    path = directory / TELEMETRY_REPORT_FILENAME
+    payload = {"version": TELEMETRY_REPORT_VERSION}
+    payload.update(report)
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True, default=str)
+        fh.write("\n")
+    return path
+
+
+def load_reports(
+    directory: typing.Union[str, Path]
+) -> typing.List[typing.Tuple[Path, dict]]:
+    """Every parseable ``telemetry_report*.json`` under ``directory``."""
+    out: typing.List[typing.Tuple[Path, dict]] = []
+    for path in sorted(Path(directory).rglob("telemetry_report*.json")):
+        try:
+            with open(path) as fh:
+                out.append((path, json.load(fh)))
+        except (OSError, ValueError):
+            logger.warning("Skipping unreadable telemetry report %s", path)
+    return out
+
+
+def load_event_files(
+    directory: typing.Union[str, Path]
+) -> typing.List[typing.Tuple[Path, typing.List[dict]]]:
+    """Every JSONL file under ``directory`` that holds event records."""
+    from gordo_tpu.observability.events import read_events
+
+    out = []
+    for path in sorted(Path(directory).rglob("*.jsonl")):
+        try:
+            records = read_events(str(path))
+        except OSError:
+            continue
+        if records and all("event" in r for r in records):
+            out.append((path, records))
+    return out
+
+
+def _fmt_rate(value: typing.Optional[float]) -> str:
+    if value is None:
+        return "n/a"
+    if value >= 1e6:
+        return f"{value / 1e6:.2f}M"
+    if value >= 1e3:
+        return f"{value / 1e3:.1f}k"
+    return f"{value:.1f}"
+
+
+def _fmt_bytes(value: typing.Optional[int]) -> str:
+    if value is None:
+        return "n/a"
+    size = float(value)
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if size < 1024 or unit == "TiB":
+            return f"{size:.1f} {unit}"
+        size /= 1024
+    return f"{size:.1f} TiB"  # pragma: no cover - loop always returns
+
+
+def _fmt_seconds(value: typing.Optional[float]) -> str:
+    return "n/a" if value is None else f"{value:.3g} s"
+
+
+def summarize_report(path: Path, report: dict) -> typing.List[str]:
+    """Render one build report as indented human-readable lines."""
+    lines = [f"{path}:"]
+    lines.append(
+        "  fleet build: {m} machines in {b} bucket(s), {w} wall, "
+        "{r} models/hour{res}".format(
+            m=report.get("n_machines", "?"),
+            b=report.get("n_buckets", "?"),
+            w=_fmt_seconds(report.get("wall_time_s")),
+            r=_fmt_rate(report.get("models_per_hour")),
+            res=(
+                f", {report['n_resumed']} resumed"
+                if report.get("n_resumed")
+                else ""
+            ),
+        )
+    )
+    for i, bucket in enumerate(report.get("buckets", [])):
+        fit = bucket.get("fit") or {}
+        lines.append(
+            "  bucket {i}: {m} machines x {n} timesteps, cv {cv} + fit {ft}"
+            .format(
+                i=i,
+                m=bucket.get("n_machines", "?"),
+                n=bucket.get("n_timesteps_grid", "?"),
+                cv=_fmt_seconds(bucket.get("cv_duration_s")),
+                ft=_fmt_seconds(bucket.get("fit_duration_s")),
+            )
+        )
+        lines.append(
+            "    compile {c}, steady epoch {e}, {t} sensor-timesteps/s"
+            .format(
+                c=_fmt_seconds(fit.get("compile_time_s")),
+                e=_fmt_seconds(fit.get("steady_state_epoch_s")),
+                t=_fmt_rate(fit.get("sensor_timesteps_per_s")),
+            )
+        )
+        mem = bucket.get("device_memory") or {}
+        lines.append(
+            "    peak HBM: "
+            + (
+                _fmt_bytes(mem.get("peak_bytes_in_use"))
+                if mem.get("available")
+                else "n/a (backend reports no memory stats)"
+            )
+        )
+    return lines
+
+
+def summarize_directory(directory: typing.Union[str, Path]) -> str:
+    """
+    The ``gordo-tpu telemetry summarize`` body: every telemetry report
+    and event log under ``directory``, aggregated into one fleet view.
+    """
+    directory = Path(directory)
+    reports = load_reports(directory)
+    event_files = load_event_files(directory)
+    lines = [f"Telemetry summary for {directory}"]
+
+    lines.append(f"Reports: {len(reports)}")
+    for path, report in reports:
+        lines.extend(summarize_report(path.relative_to(directory), report))
+    if reports:
+        total_machines = sum(r.get("n_machines") or 0 for _, r in reports)
+        # aggregate rate over machines BUILT (resume-reused ones were
+        # loaded, not built); older reports without n_built fall back to
+        # n_machines
+        total_built = sum(
+            (
+                r["n_built"]
+                if r.get("n_built") is not None
+                else r.get("n_machines")
+            )
+            or 0
+            for _, r in reports
+        )
+        total_wall = sum(r.get("wall_time_s") or 0.0 for _, r in reports)
+        peaks = [
+            (r.get("device_memory") or {}).get("peak_bytes_in_use")
+            for _, r in reports
+        ]
+        peaks = [p for p in peaks if p is not None]
+        lines.append(
+            "Fleet total: {m} machines, {w}; aggregate {r} models/hour; "
+            "peak HBM {p}".format(
+                m=total_machines,
+                w=_fmt_seconds(total_wall),
+                r=_fmt_rate(
+                    total_built / total_wall * 3600 if total_wall else None
+                ),
+                p=_fmt_bytes(max(peaks)) if peaks else "n/a",
+            )
+        )
+
+    n_events = sum(len(records) for _, records in event_files)
+    lines.append(f"Event logs: {len(event_files)} file(s), {n_events} event(s)")
+    counts: typing.Dict[str, int] = {}
+    for _, records in event_files:
+        for record in records:
+            counts[record["event"]] = counts.get(record["event"], 0) + 1
+    for event, count in sorted(counts.items()):
+        lines.append(f"  {event}: {count}")
+    crashes = [
+        record
+        for _, records in event_files
+        for record in records
+        if "crash" in record["event"]
+    ]
+    for crash in crashes:
+        lines.append(
+            "  CRASH CONTEXT: {e} at {ts}: {err}".format(
+                e=crash["event"],
+                ts=crash.get("ts", "?"),
+                err=crash.get("error", "?"),
+            )
+        )
+    if not reports and not event_files:
+        lines.append(
+            "(nothing found — expected telemetry_report*.json or *.jsonl "
+            f"event logs under {os.fspath(directory)})"
+        )
+    return "\n".join(lines)

@@ -26,6 +26,7 @@ minibatches, windowed gathers for sequence models.
 import dataclasses
 import logging
 import math
+import time
 from typing import Any, List, Optional, Tuple
 
 import jax
@@ -34,6 +35,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from gordo_tpu.models.specs import ModelSpec, per_sample_loss
+from gordo_tpu.observability import emit_event, get_registry
 from gordo_tpu.parallel.mesh import fleet_sharding, pad_to_multiple, replicated_sharding
 
 logger = logging.getLogger(__name__)
@@ -612,6 +614,7 @@ class FleetTrainer:
         (``early_stopping_on_val=None``); pass False to monitor the
         training loss regardless (Keras ``monitor="loss"``).
         """
+        fit_start = time.perf_counter()
         if shuffle is None:
             shuffle = not self.spec.windowed
         if not 0.0 <= float(validation_split) < 1.0:
@@ -691,6 +694,9 @@ class FleetTrainer:
                 params, opt_state, done = checkpointer.restore(params, opt_state)
             start_epoch = done + 1
             logger.info("Resuming fleet fit at epoch %d/%d", start_epoch, epochs)
+            emit_event(
+                "fit_resume", path="fleet", start_epoch=start_epoch, epochs=epochs
+            )
 
         if self.broadcast_data:
             if data.n_machines != 1:
@@ -740,7 +746,22 @@ class FleetTrainer:
 
         losses = []
         val_losses: list = []
+        # -- telemetry: the first dispatched epoch is synced ONCE so
+        # compile+first-step cost separates from the steady state; later
+        # epochs keep the async dispatch pipeline intact (their cost is
+        # recovered from the loop total at the end-of-fit sync)
+        first_epoch_s: Optional[float] = None
+        epochs_run = 0
+        timesteps_trained = 0
+        early_stop_epoch: Optional[int] = None
+        if self.broadcast_data:
+            # every fleet member trains on the one shared dataset
+            rows_per_machine = np.full(m, int((w_host > 0).sum()), dtype=np.int64)
+        else:
+            rows_per_machine = (w_host > 0).sum(axis=1).astype(np.int64)
+        loop_start = time.perf_counter()
         for epoch in range(start_epoch, epochs):
+            epoch_start = time.perf_counter()
             epoch_keys = jax.vmap(lambda k: jax.random.fold_in(k, epoch))(keys)
             if early_stopping:
                 active = jnp.asarray(es_state["active"].astype(np.float32))
@@ -753,6 +774,16 @@ class FleetTrainer:
                 params, opt_state, epoch_loss = epoch_fn(
                     params, opt_state, epoch_keys, X_arg, y_arg, w_arg
                 )
+            epochs_run += 1
+            # active ENTERING this epoch (the gate the program just ran)
+            timesteps_trained += int(
+                rows_per_machine[es_state["active"]].sum()
+                if early_stopping
+                else rows_per_machine.sum()
+            )
+            if first_epoch_s is None:
+                jax.block_until_ready(epoch_loss)
+                first_epoch_s = time.perf_counter() - epoch_start
             if val_fn is not None:
                 val_losses.append(val_fn(params, X_arg, y_arg, val_arg))
             # keep the loss on device: a host fetch here would sync every
@@ -810,6 +841,15 @@ class FleetTrainer:
                         )
             else:
                 losses.append(epoch_loss)
+            epoch_fields: dict = {"path": "fleet", "epoch": epoch}
+            if early_stopping:
+                # only the early-stopping path syncs losses per epoch;
+                # elsewhere the epoch event records dispatch, not results
+                epoch_fields.update(
+                    mean_loss=float(np.mean(report)),
+                    n_active=int(es_state["active"].sum()),
+                )
+            emit_event("epoch", **epoch_fields)
             if checkpointer is not None and (epoch + 1) % max(
                 1, checkpoint_every
             ) == 0:
@@ -826,6 +866,10 @@ class FleetTrainer:
                     m,
                     epoch,
                     epochs,
+                )
+                early_stop_epoch = epoch
+                emit_event(
+                    "early_stop", path="fleet", epoch=epoch, n_machines=m
                 )
                 break
         if checkpointer is not None:
@@ -852,9 +896,130 @@ class FleetTrainer:
             # would make process_allgather treat the replicated host copy
             # as per-process data. Everything else is one bulk transfer.
             if isinstance(losses[0], np.ndarray):
-                return params, np.stack(losses)
-            return params, np.stack(host_fetch(losses))
-        return params, np.zeros((0, len(keys)))
+                losses_out = np.stack(losses)
+            else:
+                losses_out = np.stack(host_fetch(losses))
+        else:
+            losses_out = np.zeros((0, len(keys)))
+        # loop time is read AFTER the loss fetch above — that fetch is the
+        # sync that makes the async epochs' wall-clock real
+        self._record_fit_telemetry(
+            wall_time_s=time.perf_counter() - fit_start,
+            loop_time_s=time.perf_counter() - loop_start,
+            first_epoch_s=first_epoch_s,
+            epochs_run=epochs_run,
+            epochs_configured=epochs,
+            start_epoch=start_epoch,
+            timesteps_trained=timesteps_trained,
+            n_machines=m,
+            early_stopping=early_stopping,
+            early_stop_epoch=early_stop_epoch,
+            n_stopped=(
+                int((~es_state["active"]).sum()) if early_stopping else 0
+            ),
+        )
+        return params, losses_out
+
+    def _record_fit_telemetry(
+        self,
+        *,
+        wall_time_s: float,
+        loop_time_s: float,
+        first_epoch_s: Optional[float],
+        epochs_run: int,
+        epochs_configured: int,
+        start_epoch: int,
+        timesteps_trained: int,
+        n_machines: int,
+        early_stopping: bool,
+        early_stop_epoch: Optional[int],
+        n_stopped: int,
+    ) -> None:
+        """
+        Derive and publish one fit's telemetry: ``self.fit_telemetry_``
+        (the builder copies it into bucket reports), the process metrics
+        registry, and a ``fit_finished`` event.
+
+        Compile time is estimated as (first synced epoch) - (steady-state
+        epoch): the first epoch is the only one that pays XLA compilation
+        (per geometry), and all later epochs reuse the program. With a
+        single epoch there is nothing to subtract, so ``compile_time_s``
+        degrades to the first epoch's whole cost (an upper bound).
+        """
+        steady = None
+        if epochs_run > 1 and first_epoch_s is not None:
+            steady = max(0.0, (loop_time_s - first_epoch_s) / (epochs_run - 1))
+        compile_s = None
+        if first_epoch_s is not None:
+            compile_s = (
+                max(0.0, first_epoch_s - steady)
+                if steady is not None
+                else first_epoch_s
+            )
+        throughput = (
+            timesteps_trained / loop_time_s if loop_time_s > 0 else None
+        )
+        # compile-free rate: what the fit would sustain if it ran forever
+        # (the whole-loop rate above amortizes the one-off compile)
+        steady_throughput = None
+        if steady and epochs_run > 0:
+            steady_throughput = (timesteps_trained / epochs_run) / steady
+        self.fit_telemetry_ = {
+            "path": "fleet",
+            "wall_time_s": wall_time_s,
+            "epoch_loop_s": loop_time_s,
+            "first_epoch_s": first_epoch_s,
+            "steady_state_epoch_s": steady,
+            "compile_time_s": compile_s,
+            "epochs_configured": epochs_configured,
+            "epochs_run": epochs_run,
+            "resumed_from_epoch": start_epoch if start_epoch else None,
+            "n_machines": n_machines,
+            "sensor_timesteps_trained": timesteps_trained,
+            "sensor_timesteps_per_s": throughput,
+            "steady_state_sensor_timesteps_per_s": steady_throughput,
+            "early_stopping": early_stopping,
+            "early_stop_epoch": early_stop_epoch,
+            "n_machines_early_stopped": n_stopped,
+        }
+        reg = get_registry()
+        reg.histogram(
+            "gordo_train_fit_seconds", "Fleet fit wall time", ("path",)
+        ).observe(wall_time_s, path="fleet")
+        if compile_s is not None:
+            reg.histogram(
+                "gordo_train_compile_seconds",
+                "Compile + first-step time of a fit's first epoch",
+                ("path",),
+            ).observe(compile_s, path="fleet")
+        if steady is not None:
+            reg.histogram(
+                "gordo_train_epoch_seconds",
+                "Steady-state (post-compile) epoch wall time",
+                ("path",),
+            ).observe(steady, path="fleet")
+        reg.counter(
+            "gordo_train_epochs_total", "Training epochs executed", ("path",)
+        ).inc(epochs_run, path="fleet")
+        reg.counter(
+            "gordo_train_sensor_timesteps_total",
+            "Real sensor-timesteps trained over",
+            ("path",),
+        ).inc(timesteps_trained, path="fleet")
+        if n_stopped:
+            reg.counter(
+                "gordo_train_early_stops_total",
+                "Machines halted by per-machine early stopping",
+                ("path",),
+            ).inc(n_stopped, path="fleet")
+        emit_event(
+            "fit_finished",
+            path="fleet",
+            epochs_run=epochs_run,
+            n_machines=n_machines,
+            wall_time_s=round(wall_time_s, 4),
+            sensor_timesteps_per_s=throughput,
+        )
 
     def predict(self, params: Any, X: jnp.ndarray, batch_size: int = 8192) -> np.ndarray:
         """

@@ -8,18 +8,45 @@ see gordo_tpu.models.core.BaseJaxEstimator.
 """
 
 import bz2
+import json
 import logging
+import math
 import os
 import pickle
 from pathlib import Path
 from typing import Any, Optional, Union
 
-import simplejson
+try:  # optional: images without simplejson fall back to stdlib json
+    import simplejson
+except ImportError:
+    simplejson = None
 
 logger = logging.getLogger(__name__)
 
 MODEL_FILENAME = "model.pkl"
 METADATA_FILENAME = "metadata.json"
+
+
+def _sanitize_nan(obj: Any) -> Any:
+    """
+    Recursively replace NaN/Infinity floats with None — the stdlib-json
+    stand-in for ``simplejson.dump(..., ignore_nan=True)`` (stdlib json
+    would write bare ``NaN`` tokens, which are not valid JSON).
+    """
+    if isinstance(obj, float):
+        return None if (math.isnan(obj) or math.isinf(obj)) else obj
+    if isinstance(obj, dict):
+        return {key: _sanitize_nan(value) for key, value in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_sanitize_nan(value) for value in obj]
+    return obj
+
+
+def _dump_metadata_json(metadata: dict, fh) -> None:
+    if simplejson is not None:
+        simplejson.dump(metadata, fh, default=str, ignore_nan=True)
+    else:
+        json.dump(_sanitize_nan(metadata), fh, default=str)
 
 
 def dumps(model: Any) -> bytes:
@@ -47,7 +74,7 @@ def dump(obj: Any, dest_dir: Union[os.PathLike, str], metadata: Optional[dict] =
         pickle.dump(obj, f)
     if metadata is not None:
         with open(dest_dir / METADATA_FILENAME, "w") as f:
-            simplejson.dump(metadata, f, default=str, ignore_nan=True)
+            _dump_metadata_json(metadata, f)
 
 
 def load(source_dir: Union[os.PathLike, str]) -> Any:
@@ -79,4 +106,5 @@ def load_metadata(source_dir: Union[os.PathLike, str]) -> dict:
         logger.warning("No metadata found in %s", source_dir)
         return {}
     with open(path) as f:
-        return simplejson.load(f)
+        # stdlib json reads everything either writer produced
+        return json.load(f)

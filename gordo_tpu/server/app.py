@@ -45,6 +45,7 @@ from werkzeug.wrappers import Request, Response
 from gordo_tpu import __version__, serializer
 from gordo_tpu.data.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
+from gordo_tpu.observability import get_registry
 from gordo_tpu.server import model_io
 from gordo_tpu.server import utils as server_utils
 from gordo_tpu.server.utils import ApiError
@@ -86,6 +87,18 @@ class RequestContext:
         self.y: typing.Optional[pd.DataFrame] = None
         self.model = None
         self.metadata: typing.Optional[dict] = None
+        #: (phase name, seconds) pairs stamped into Server-Timing
+        self.timings: typing.List[typing.Tuple[str, float]] = []
+
+    def record_phase(self, name: str, seconds: float) -> None:
+        """One request phase: rides the Server-Timing header AND the
+        process metrics registry (bridged onto /metrics)."""
+        self.timings.append((name, seconds))
+        get_registry().histogram(
+            "gordo_server_phase_seconds",
+            "Server request phase durations",
+            ("phase",),
+        ).observe(seconds, phase=name)
 
 
 def _json_response(payload: dict, status: int = 200) -> Response:
@@ -180,6 +193,13 @@ class GordoApp:
                 project=self.config.get("PROJECT"),
                 registry=self.config.get("PROMETHEUS_REGISTRY"),
             )
+            # /metrics also serves the in-process observability registry
+            # (training/serving/client series), bridged at scrape time
+            from gordo_tpu.observability.prom_bridge import export_to_prometheus
+
+            export_to_prometheus(
+                get_registry(), self.prometheus_metrics.registry
+            )
 
     # -- WSGI plumbing -----------------------------------------------------
 
@@ -255,7 +275,18 @@ class GordoApp:
                     pass
             response.headers["revision"] = ctx.revision
         runtime_s = timeit.default_timer() - ctx.start_time
-        response.headers["Server-Timing"] = f"request_walltime_s;dur={runtime_s}"
+        # Server-Timing dur is MILLISECONDS per the spec: the per-phase
+        # entries (ctx.record_phase) and `total` are compliant. The
+        # legacy `request_walltime_s` entry keeps its historical SECONDS
+        # value — compatibility means consumers parsing it keep reading
+        # the unit its name promises; spec-conformant tooling should read
+        # `total`
+        entries = [
+            f"{name};dur={seconds * 1000.0:.2f}" for name, seconds in ctx.timings
+        ]
+        entries.append(f"total;dur={runtime_s * 1000.0:.2f}")
+        entries.append(f"request_walltime_s;dur={runtime_s}")
+        response.headers["Server-Timing"] = ", ".join(entries)
         # which pre-forked worker served this (see server/runner.py)
         response.headers["X-Gordo-Server-Pid"] = str(os.getpid())
         if self.prometheus_metrics is not None and request.path not in (
@@ -273,10 +304,12 @@ class GordoApp:
     # -- model/metadata loading --------------------------------------------
 
     def _get_model(self, ctx: RequestContext, name: str):
+        start = timeit.default_timer()
         try:
             ctx.model = server_utils.load_model(ctx.collection_dir, name)
         except FileNotFoundError:
             raise NotFound(f"Model '{name}' not found in revision {ctx.revision}")
+        ctx.record_phase("model_load", timeit.default_timer() - start)
         return ctx.model
 
     def _get_metadata(self, ctx: RequestContext, name: str) -> dict:
@@ -396,7 +429,14 @@ class GordoApp:
 
     def view_models(self, ctx, request, gordo_project: str) -> Response:
         try:
-            available = os.listdir(ctx.collection_dir)
+            # artifact DIRECTORIES only: fleet builds persist their
+            # telemetry_report.json next to the artifacts, and loose
+            # files in the collection dir are not models
+            available = [
+                name
+                for name in os.listdir(ctx.collection_dir)
+                if os.path.isdir(os.path.join(ctx.collection_dir, name))
+            ]
         except FileNotFoundError:
             available = []
         return _json_response({"models": available})
@@ -478,6 +518,7 @@ class GordoApp:
                 {"error": "Something unexpected happened; check your input data"},
                 400,
             )
+        ctx.record_phase("predict", timeit.default_timer() - start)
         logger.debug(
             "Calculating model output took %.4fs", timeit.default_timer() - start
         )
@@ -580,6 +621,7 @@ class GordoApp:
             inputs[name] = np.asarray(transformed, dtype="float32")
 
         outputs: typing.Dict[str, np.ndarray] = {}
+        predict_start = timeit.default_timer()
         try:
             if scorer is not None and inputs:
                 outputs.update(scorer.predict(inputs))
@@ -597,6 +639,7 @@ class GordoApp:
                 {"error": "Something unexpected happened; check your input data"},
                 400,
             )
+        ctx.record_phase("predict", timeit.default_timer() - predict_start)
 
         data = {}
         for name in names:
@@ -742,6 +785,7 @@ class GordoApp:
 
         outputs: typing.Dict[str, np.ndarray] = {}
         data: typing.Dict[str, typing.Any] = {}
+        predict_start = timeit.default_timer()
         try:
             if scorer is not None and inputs:
                 outputs.update(scorer.predict(inputs))
@@ -771,6 +815,7 @@ class GordoApp:
                 {"error": "Something unexpected happened; check your input data"},
                 400,
             )
+        ctx.record_phase("predict", timeit.default_timer() - predict_start)
         context = {
             "data": data,
             "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
@@ -798,6 +843,7 @@ class GordoApp:
         frequency = pd.tseries.frequencies.to_offset(
             normalize_frequency(metadata["dataset"].get("resolution", "10min"))
         )
+        predict_start = timeit.default_timer()
         try:
             anomaly_df = model.anomaly(ctx.X, ctx.y, frequency=frequency)
         except AttributeError:
@@ -813,6 +859,7 @@ class GordoApp:
             # input trouble, not a server fault (the base-prediction and
             # fleet views report this as 400 too)
             return _json_response({"error": f"ValueError: {err}"}, 400)
+        ctx.record_phase("predict", timeit.default_timer() - predict_start)
 
         if request.args.get("format") == "parquet":
             return Response(

@@ -15,6 +15,7 @@ model forward, where the FLOPs are.
 """
 
 import logging
+import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import jax
@@ -22,6 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gordo_tpu.models.core import BaseJaxEstimator, _batch_bucket
+from gordo_tpu.observability import get_registry
 
 logger = logging.getLogger(__name__)
 
@@ -119,11 +121,31 @@ class FleetScorer:
         if missing:
             raise KeyError(f"No stacked params for machines: {sorted(missing)}")
         out: Dict[str, np.ndarray] = {}
+        reg = get_registry()
         for group in self._groups:
             names = [n for n in group["names"] if n in inputs]
             if not names:
                 continue
+            start = time.perf_counter()
             out.update(self._predict_group(group, {n: inputs[n] for n in names}))
+            elapsed = time.perf_counter() - start
+            windowed = "true" if group["windowed"] else "false"
+            reg.histogram(
+                "gordo_serve_group_latency_seconds",
+                "One vmapped fleet-scoring dispatch (host->device->host)",
+                ("windowed",),
+            ).observe(elapsed, windowed=windowed)
+            reg.histogram(
+                "gordo_serve_group_batch_size",
+                "Machines scored per fleet dispatch",
+                ("windowed",),
+                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+            ).observe(len(names), windowed=windowed)
+            reg.counter(
+                "gordo_serve_machines_scored_total",
+                "Machines scored through the fleet path",
+                ("windowed",),
+            ).inc(len(names), windowed=windowed)
         return out
 
     def _predict_group(

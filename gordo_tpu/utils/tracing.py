@@ -52,7 +52,7 @@ def maybe_trace(name: str, directory: str = ""):
         jax.profiler.start_trace(target)
         started = True
         _active.tracing = True
-    except Exception:  # pragma: no cover - broken jax / profiler quirks
+    except Exception:  # broken jax / profiler quirks / nested traces
         logger.warning("Could not start jax profiler trace", exc_info=True)
     try:
         yield
@@ -64,7 +64,7 @@ def maybe_trace(name: str, directory: str = ""):
 
                 jax.profiler.stop_trace()
                 logger.info("Wrote profiler trace to %s", target)
-            except Exception:  # pragma: no cover
+            except Exception:
                 logger.warning("Could not stop jax profiler trace", exc_info=True)
 
 
@@ -82,7 +82,7 @@ def annotate(name: str):
         import jax
 
         span = jax.profiler.TraceAnnotation(name)
-    except Exception:  # pragma: no cover - broken jax
+    except Exception:  # broken jax
         yield
         return
     with span:

@@ -794,6 +794,96 @@ def check_self_method_calls(tree: ast.Module, module) -> typing.List[str]:
     return problems
 
 
+# --------------------------------------------------------------------------
+# 10. metric-registration discipline (observability registry call sites)
+# --------------------------------------------------------------------------
+
+#: the observability registry's factory methods — every call site
+#: registering a metric goes through one of these
+METRIC_FACTORY_METHODS = frozenset({"counter", "gauge", "histogram"})
+
+#: The documented label vocabulary (docs/observability.md — keep in
+#: sync). Label NAMES outside this set are flagged: an undocumented
+#: label is usually a high-cardinality one (a raw path or machine name)
+#: about to blow up the time-series count.
+ALLOWED_METRIC_LABELS = frozenset(
+    {"path", "phase", "endpoint", "method", "outcome", "windowed", "kind", "status"}
+)
+
+METRIC_NAME_RE = re.compile(r"^gordo_[a-z][a-z0-9_]*$")
+
+
+def check_metric_registrations(tree: ast.Module) -> typing.List[str]:
+    """
+    Every ``<registry>.counter/gauge/histogram("name", ..., labelnames)``
+    registration must use a LITERAL ``gordo_``-prefixed metric name
+    (counters additionally ending ``_total``, Prometheus convention) and
+    a literal label-name tuple drawn from the documented bounded set —
+    so no call site can smuggle raw paths or machine names in as labels,
+    and the bridged /metrics namespace stays collision-free.
+    """
+    problems = []
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in METRIC_FACTORY_METHODS
+        ):
+            continue
+        name_node = node.args[0] if node.args else None
+        if name_node is None:
+            name_node = next(
+                (kw.value for kw in node.keywords if kw.arg == "name"), None
+            )
+        if not (
+            isinstance(name_node, ast.Constant)
+            and isinstance(name_node.value, str)
+        ):
+            # not a statically-vouchable registration (or a different
+            # library's same-named method) — out of scope
+            continue
+        name = name_node.value
+        if not METRIC_NAME_RE.match(name):
+            problems.append(
+                f"line {node.lineno}: metric {name!r} must match "
+                f"'gordo_<lower_snake>'"
+            )
+        elif node.func.attr == "counter" and not name.endswith("_total"):
+            problems.append(
+                f"line {node.lineno}: counter {name!r} must end '_total'"
+            )
+        labels_node = node.args[2] if len(node.args) > 2 else None
+        if labels_node is None:
+            labels_node = next(
+                (kw.value for kw in node.keywords if kw.arg == "labelnames"),
+                None,
+            )
+        if labels_node is None:
+            continue  # unlabeled metric
+        if not isinstance(labels_node, (ast.Tuple, ast.List)):
+            problems.append(
+                f"line {node.lineno}: metric {name!r} labelnames must be a "
+                f"literal tuple/list (got {ast.unparse(labels_node)})"
+            )
+            continue
+        for element in labels_node.elts:
+            if not (
+                isinstance(element, ast.Constant)
+                and isinstance(element.value, str)
+            ):
+                problems.append(
+                    f"line {node.lineno}: metric {name!r} has a non-literal "
+                    f"label name"
+                )
+            elif element.value not in ALLOWED_METRIC_LABELS:
+                problems.append(
+                    f"line {node.lineno}: metric {name!r} label "
+                    f"{element.value!r} is not in the documented label set "
+                    f"{sorted(ALLOWED_METRIC_LABELS)}"
+                )
+    return problems
+
+
 def check_annotated_param_method_calls(tree: ast.Module, module) -> typing.List[str]:
     """
     ``param.method(...)`` calls where ``param`` is annotated with vouched

@@ -69,6 +69,7 @@ def test_fold_parallel_cv_engages_for_jax_base():
     )
 
 
+@pytest.mark.slow
 def test_fold_parallel_cv_parity_with_sequential():
     """The flagship config (hourglass AE + TimeSeriesSplit(3)) must take the
     fast path, record cv-fast-path metadata, and produce the same thresholds

@@ -93,6 +93,7 @@ def test_build_sklearn_model_offset_zero():
     assert machine.metadata.build_metadata.model.model_offset == 0
 
 
+@pytest.mark.slow
 def test_build_lstm_model_offset():
     model, machine = ModelBuilder(
         make_machine(
@@ -109,6 +110,7 @@ def test_build_lstm_model_offset():
     assert machine.metadata.build_metadata.model.model_offset == 4
 
 
+@pytest.mark.slow
 def test_build_cache(tmp_path):
     machine = make_machine()
     output_dir = tmp_path / "model"
@@ -139,6 +141,7 @@ def test_cache_key_stability():
     assert ModelBuilder(other).cache_key != key1
 
 
+@pytest.mark.slow
 def test_determinism_same_seed():
     m1, _ = ModelBuilder(make_machine()).build()
     m2, _ = ModelBuilder(make_machine()).build()

@@ -91,6 +91,59 @@ def test_build(runner, tmp_path):
     assert any("=" in line and "fold" in line for line in result.output.splitlines())
 
 
+def test_build_machine_name_containing_err_succeeds(runner, tmp_path):
+    """Regression guard against the reference's planted fault: its CLI
+    raises FileNotFoundError for any machine whose NAME contains 'err'
+    (reference gordo/cli/cli.py:178-179). Building such a machine — both
+    solo and through the fleet path — must succeed here."""
+    err_yaml = MACHINE_YAML.replace("name: cli-machine", "name: pump-overriderr-7")
+    out_dir = str(tmp_path / "err-out")
+    result = runner.invoke(gordo, ["build", err_yaml, out_dir])
+    assert result.exit_code == 0, result.output
+    assert serializer.load_metadata(out_dir)["name"] == "pump-overriderr-7"
+
+    fleet_out = str(tmp_path / "err-fleet-out")
+    machines = [yaml.safe_load(err_yaml) | {"name": "fleet-err-machine"}]
+    result = runner.invoke(gordo, ["build-fleet", json.dumps(machines), fleet_out])
+    assert result.exit_code == 0, result.output
+    assert os.path.exists(os.path.join(fleet_out, "fleet-err-machine", "model.pkl"))
+
+
+def test_telemetry_summarize_cli(runner, tmp_path):
+    """gordo-tpu telemetry summarize renders a fleet build's telemetry
+    report and event log into the human summary."""
+    from gordo_tpu.observability import write_telemetry_report
+
+    write_telemetry_report(
+        tmp_path / "proj",
+        {
+            "kind": "fleet_build",
+            "n_machines": 4,
+            "n_buckets": 2,
+            "wall_time_s": 10.0,
+            "models_per_hour": 1440.0,
+            "device_memory": {"available": False, "peak_bytes_in_use": None},
+            "buckets": [],
+        },
+    )
+    (tmp_path / "proj" / "events.jsonl").write_text(
+        '{"ts": "t", "event": "build_started"}\n'
+        '{"ts": "t", "event": "build_crashed", "error": "RuntimeError(boom)"}\n'
+    )
+    result = runner.invoke(gordo, ["telemetry", "summarize", str(tmp_path)])
+    assert result.exit_code == 0, result.output
+    assert "4 machines in 2 bucket(s)" in result.output
+    assert "1.4k models/hour" in result.output
+    assert "CRASH CONTEXT" in result.output and "boom" in result.output
+
+    as_json = runner.invoke(
+        gordo, ["telemetry", "summarize", str(tmp_path), "--as-json"]
+    )
+    assert as_json.exit_code == 0, as_json.output
+    payload = json.loads(as_json.output)
+    assert payload[0]["report"]["n_machines"] == 4
+
+
 def test_build_env_vars(runner, tmp_path):
     """MACHINE / OUTPUT_DIR env vars drive the build (pod semantics)."""
     out_dir = str(tmp_path / "out-env")

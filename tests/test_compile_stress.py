@@ -11,6 +11,9 @@ import pytest
 from gordo_tpu.machine import Machine
 from gordo_tpu.builder.fleet_build import FleetModelBuilder
 
+# O(100)-machine builds: a stress tier, not a fast-gate tier
+pytestmark = pytest.mark.slow
+
 COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
 
 
@@ -28,7 +31,19 @@ def compile_counter():
     try:
         yield events
     finally:
-        monitoring.unregister_event_duration_listener(listen)
+        # jax 0.4.x exposes no public unregister-by-callback API: use the
+        # private one (clear_event_listeners would nuke listeners other
+        # code registered), falling back to a public API if it appears
+        unregister = getattr(
+            monitoring, "unregister_event_duration_listener", None
+        )
+        if unregister is None:
+            from jax._src import monitoring as monitoring_impl
+
+            unregister = (
+                monitoring_impl._unregister_event_duration_listener_by_callback
+            )
+        unregister(listen)
 
 
 def _machine(i: int, n_tags: int, kind: str) -> Machine:

@@ -18,7 +18,7 @@ SCRIPTS = [
     "local_build.py",
     "fleet_build_and_serve.py",
     "hyperparam_sweep.py",
-    "long_context_training.py",
+    pytest.param("long_context_training.py", marks=pytest.mark.slow),
 ]
 
 

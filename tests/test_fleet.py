@@ -142,6 +142,7 @@ def test_fleet_step_count_matches_solo_on_padded_grid():
     np.testing.assert_allclose(fleet_losses[:, 0], solo_losses, rtol=0.02)
 
 
+@pytest.mark.slow
 def test_fleet_windowed_lstm():
     from gordo_tpu.models.factories.lstm import lstm_model
 
@@ -155,6 +156,7 @@ def test_fleet_windowed_lstm():
     assert preds.shape == (2, 60 - 5 + 1, 3)
 
 
+@pytest.mark.slow
 def test_fleet_predict_chunked_matches_direct():
     """Chunked windowed predict (n_out > batch_size) equals the direct path."""
     from gordo_tpu.models.factories.lstm import lstm_model
@@ -223,9 +225,14 @@ def test_fleet_early_stopping_masks_per_machine():
     t = np.linspace(0, 6, 60)
     X_sig = np.stack([np.sin(t + i) for i in range(3)], 1).astype("float32")
     d2 = StackedData.from_ragged([X_flat, X_sig], [X_flat.copy(), X_sig.copy()])
+    # min_delta=1e-2: the flat machine's per-epoch improvement decays
+    # through 0.01 around epoch 9 while the signal machine's stays ~2x
+    # above it for all 30 epochs — a wide margin either side, where the
+    # original 1e-3 threshold was never crossed within the budget and the
+    # scenario silently degenerated to no machine stopping
     p2, l2 = trainer.fit(
         d2, keys, epochs=30, batch_size=16,
-        early_stopping_patience=1, early_stopping_min_delta=1e-3,
+        early_stopping_patience=1, early_stopping_min_delta=1e-2,
     )
     m0 = l2[:, 0]
     # frozen reported losses repeat the last active value exactly
@@ -605,6 +612,7 @@ def test_fleet_solo_build_quality_parity():
         ("gordo_tpu.models.GRUAutoEncoder", "gru_hourglass"),
     ],
 )
+@pytest.mark.slow
 def test_fleet_solo_build_quality_parity_windowed(model_cls, kind):
     """
     Same contract as test_fleet_solo_build_quality_parity, for the windowed
@@ -691,6 +699,7 @@ def test_bucket_unstack_uses_one_bulk_transfer(monkeypatch):
     assert len(out) == 16 and out[3]["w"].shape == (4, 4)
 
 
+@pytest.mark.slow
 def test_fleet_offset_matches_solo_build():
     """model_offset is window arithmetic, identical for every machine in a
     bucket — the fleet builder probes it once per bucket; it must equal
@@ -772,6 +781,7 @@ def test_fleet_built_detector_records_cv_mode(tmp_path):
     assert build_meta.get("cv-fleet-masks") is True
 
 
+@pytest.mark.slow
 def test_fleet_build_crash_resume(tmp_path):
     """Artifacts flush per bucket, and resume=True reuses them: a runtime
     crash mid-build (observed live: the tunneled TPU worker died
@@ -831,6 +841,7 @@ def test_fleet_build_resume_requires_output_dir():
         FleetModelBuilder(make_machines(1)).build(resume=True)
 
 
+@pytest.mark.slow
 def test_fleet_build_resume_rejects_changed_config(tmp_path):
     """--resume must rebuild a machine whose stored artifact was built
     from a different model/dataset config (identity check, like the

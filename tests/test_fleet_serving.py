@@ -39,6 +39,7 @@ def test_scorer_matches_per_model_predict():
         )
 
 
+@pytest.mark.slow
 def test_scorer_subset_request_matches_per_model():
     """
     A strict-subset request gathers params (padded to a power-of-2 machine
@@ -70,6 +71,7 @@ def test_scorer_subset_request_matches_per_model():
         )
 
 
+@pytest.mark.slow
 def test_scorer_windowed_and_ragged_lengths():
     models = {
         f"w{i}": _train(
@@ -95,6 +97,7 @@ def test_scorer_windowed_and_ragged_lengths():
         )
 
 
+@pytest.mark.slow
 def test_scorer_mixed_architectures_form_groups():
     models = {
         "dense": _train(AutoEncoder, kind="feedforward_hourglass", epochs=1),
@@ -383,6 +386,7 @@ def test_fleet_anomaly_bad_multipart_key_is_explained(
     assert ".X" in json.loads(resp.get_data())["error"]
 
 
+@pytest.mark.slow
 def test_windowed_anomaly_from_fleet_output_matches_direct():
     """The anomaly frame assembled from a FLEET-precomputed model output
     (the batched anomaly endpoint's path) must equal the frame the

@@ -30,6 +30,7 @@ def make_batch(batch=4, seq=64):
 
 
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.slow
 def test_sharded_loss_matches_local_dense(seq_mesh, impl):
     trainer = LongContextTrainer(
         n_features=N_FEATURES,
@@ -49,6 +50,7 @@ def test_sharded_loss_matches_local_dense(seq_mesh, impl):
     assert abs(float(sharded_loss) - local_loss) < 1e-4
 
 
+@pytest.mark.slow
 def test_training_converges(seq_mesh):
     trainer = LongContextTrainer(
         n_features=N_FEATURES,
@@ -95,6 +97,7 @@ def test_uneven_sequence_raises(seq_mesh):
         trainer.train_step(params, opt_state, windows, targets)
 
 
+@pytest.mark.slow
 def test_remat_matches_plain_training(seq_mesh):
     """
     Gradient checkpointing is a memory/FLOPs layout choice: loss and

@@ -174,6 +174,7 @@ def test_lstm_autoencoder_fit_predict(kind):
     assert out.shape == (60 - 5 + 1, 3)
 
 
+@pytest.mark.slow
 def test_lstm_forecast_output_shape():
     # parity with reference KerasLSTMForecast.predict doctest
     X_train = np.array([[1, 1], [2, 3], [0.5, 0.6], [0.3, 1], [0.6, 0.7]], dtype="float32")
@@ -198,6 +199,7 @@ def test_lstm_metadata_forecast_steps():
     assert model.get_metadata()["forecast_steps"] == 1
 
 
+@pytest.mark.slow
 def test_lstm_pickle_roundtrip():
     X, _ = make_data(n=40, f=2)
     model = LSTMAutoEncoder(kind="lstm_symmetric", lookback_window=4, epochs=1)
@@ -301,6 +303,7 @@ def test_hourglass_compression_factor_extremes():
 
 # -- GRU models (new recurrent family beyond the reference's LSTM zoo) ------
 @pytest.mark.parametrize("kind", ["gru_model", "gru_symmetric", "gru_hourglass"])
+@pytest.mark.slow
 def test_gru_autoencoder_fit_predict(kind):
     from gordo_tpu.models import GRUAutoEncoder
 

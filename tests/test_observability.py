@@ -1,0 +1,516 @@
+"""
+Telemetry subsystem tests: the metrics registry, the JSONL event log,
+device-memory watermarks (gracefully null on CPU), the Prometheus
+bridge, fleet-build telemetry reports end-to-end, and the bridged
+/metrics exposition — the ISSUE-1 acceptance surface.
+"""
+
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+import yaml
+
+from gordo_tpu.observability import (
+    EVENT_LOG_ENV_VAR,
+    EventEmitter,
+    MetricsRegistry,
+    emit_event,
+    get_registry,
+    memory_watermarks,
+    read_events,
+    summarize_directory,
+    write_telemetry_report,
+)
+from tests.conftest import GORDO_PROJECT, GORDO_SINGLE_TARGET
+
+
+# --- registry ---------------------------------------------------------------
+
+
+def test_counter_gauge_histogram_roundtrip():
+    reg = MetricsRegistry()
+    reg.counter("gordo_x_total", "d", ("path",)).inc(3, path="fleet")
+    reg.counter("gordo_x_total", "d", ("path",)).inc(path="fleet")
+    reg.gauge("gordo_g").set(2.5)
+    hist = reg.histogram("gordo_h_seconds", "d", buckets=(0.1, 1.0))
+    hist.observe(0.05)
+    hist.observe(5.0)
+
+    snap = reg.snapshot()
+    assert snap["gordo_x_total"]["series"] == [
+        {"labels": {"path": "fleet"}, "value": 4.0}
+    ]
+    assert snap["gordo_g"]["series"][0]["value"] == 2.5
+    hseries = snap["gordo_h_seconds"]["series"][0]
+    assert hseries["count"] == 2
+    assert hseries["sum"] == pytest.approx(5.05)
+    assert hseries["buckets"] == {"0.1": 1, "1.0": 1, "+Inf": 2}
+    # snapshots are plain JSON-able dicts
+    json.dumps(snap)
+
+
+def test_registry_get_or_create_guards_shape():
+    reg = MetricsRegistry()
+    reg.counter("gordo_a_total", labelnames=("path",))
+    with pytest.raises(ValueError):
+        reg.counter("gordo_a_total", labelnames=("phase",))  # label drift
+    with pytest.raises(ValueError):
+        reg.gauge("gordo_a_total")  # kind drift
+    with pytest.raises(ValueError):
+        reg.counter("not a name!")
+    with pytest.raises(ValueError):
+        reg.counter("gordo_a_total", labelnames=("path",)).inc(-1, path="x")
+    with pytest.raises(ValueError):
+        reg.counter("gordo_a_total", labelnames=("path",)).inc(wrong="x")
+
+
+def test_gauge_set_max_is_watermark():
+    reg = MetricsRegistry()
+    gauge = reg.gauge("gordo_peak")
+    gauge.set_max(10)
+    gauge.set_max(4)
+    assert gauge.value() == 10.0
+    gauge.set_max(12)
+    assert gauge.value() == 12.0
+
+
+def test_registry_thread_safety_smoke():
+    reg = MetricsRegistry()
+    counter = reg.counter("gordo_threads_total")
+
+    def work():
+        for _ in range(500):
+            counter.inc()
+
+    threads = [threading.Thread(target=work) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert counter.value() == 8 * 500
+
+
+# --- events -----------------------------------------------------------------
+
+
+def test_event_emitter_writes_and_reads_jsonl(tmp_path, monkeypatch):
+    path = tmp_path / "events.jsonl"
+    monkeypatch.setenv(EVENT_LOG_ENV_VAR, str(path))
+    record = emit_event("build_started", n_machines=7)
+    assert record["event"] == "build_started"
+    emit_event("epoch", epoch=0)
+    events = read_events(str(path))
+    assert [e["event"] for e in events] == ["build_started", "epoch"]
+    assert events[0]["n_machines"] == 7
+    assert "ts" in events[0] and "pid" in events[0]
+
+
+def test_event_emitter_disabled_is_noop(monkeypatch):
+    monkeypatch.delenv(EVENT_LOG_ENV_VAR, raising=False)
+    assert emit_event("anything") is None
+
+
+def test_event_emitter_never_raises(tmp_path, monkeypatch):
+    # unwritable target: a directory where the file should be
+    emitter = EventEmitter(path=str(tmp_path))
+    assert emitter.emit("oops") is None
+    # unserializable payloads degrade via default=str
+    emitter2 = EventEmitter(path=str(tmp_path / "ok.jsonl"))
+    assert emitter2.emit("weird", obj=object()) is not None
+
+
+def test_read_events_skips_malformed_lines(tmp_path):
+    path = tmp_path / "events.jsonl"
+    path.write_text('{"event": "good"}\n{"event": "trunca')  # crash mid-write
+    events = read_events(str(path))
+    assert [e["event"] for e in events] == ["good"]
+
+
+# --- device memory ----------------------------------------------------------
+
+
+def test_memory_watermarks_graceful_on_cpu():
+    marks = memory_watermarks()
+    assert marks["n_devices"] >= 1
+    assert "peak_bytes_in_use" in marks  # None on CPU, int on TPU
+    assert marks["peak_bytes_in_use"] is None or isinstance(
+        marks["peak_bytes_in_use"], int
+    )
+    for dev in marks["devices"]:
+        assert "bytes_in_use" in dev and "platform" in dev
+    json.dumps(marks)  # report-embeddable
+
+
+def test_save_device_memory_profile(tmp_path):
+    """The pprof memory-profile dump works where the backend supports it
+    and degrades to False (never an exception) where it does not."""
+    from gordo_tpu.observability import save_device_memory_profile
+
+    target = tmp_path / "mem.prof"
+    ok = save_device_memory_profile(str(target))
+    assert ok in (True, False)
+    if ok:
+        assert target.stat().st_size > 0
+
+
+def test_device_memory_stats_handles_broken_device():
+    from gordo_tpu.observability import device_memory_stats
+
+    class Broken:
+        platform = "weird"
+
+        def memory_stats(self):
+            raise RuntimeError("backend gone")
+
+        def __str__(self):
+            return "broken:0"
+
+    stats = device_memory_stats(Broken())
+    assert stats["supported"] is False
+    assert stats["bytes_in_use"] is None
+
+
+# --- prometheus bridge ------------------------------------------------------
+
+
+def test_prometheus_bridge_exports_series():
+    from prometheus_client import CollectorRegistry, generate_latest
+
+    from gordo_tpu.observability.prom_bridge import export_to_prometheus
+
+    reg = MetricsRegistry()
+    reg.counter("gordo_bridge_total", "d", ("path",)).inc(2, path="x")
+    reg.histogram("gordo_bridge_seconds", "d").observe(0.2)
+    reg.gauge("gordo_bridge_gauge").set(7)
+    prom = CollectorRegistry()
+    assert export_to_prometheus(reg, prom)
+    assert export_to_prometheus(reg, prom)  # idempotent re-bridge
+    text = generate_latest(prom).decode()
+    assert 'gordo_bridge_total{path="x"} 2.0' in text
+    assert "gordo_bridge_seconds_bucket" in text
+    assert "gordo_bridge_gauge 7.0" in text
+
+
+# --- fleet build end-to-end -------------------------------------------------
+
+
+FLEET_CONFIG = """
+machines:
+  - name: obs-m-0
+    dataset: &ds
+      type: RandomDataset
+      tags: [tag-0, tag-1]
+      target_tag_list: [tag-0, tag-1]
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-02T00:00:00+00:00'
+      asset: gra
+    model: &mdl
+      gordo_tpu.models.AutoEncoder:
+        kind: feedforward_hourglass
+        epochs: 2
+  - name: obs-m-1
+    dataset: *ds
+    model: *mdl
+"""
+
+
+@pytest.fixture(scope="module")
+def fleet_build_with_telemetry(tmp_path_factory):
+    """One instrumented fleet build shared by the report/event tests."""
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder
+    from gordo_tpu.workflow.config_elements.normalized_config import (
+        NormalizedConfig,
+    )
+
+    out = tmp_path_factory.mktemp("obs-build")
+    events_path = out / "events.jsonl"
+    os.environ[EVENT_LOG_ENV_VAR] = str(events_path)
+    try:
+        machines = NormalizedConfig(
+            yaml.safe_load(FLEET_CONFIG), project_name="obs"
+        ).machines
+        builder = FleetModelBuilder(machines)
+        results = builder.build(output_dir_base=out)
+    finally:
+        os.environ.pop(EVENT_LOG_ENV_VAR, None)
+    return {
+        "out": out,
+        "events_path": events_path,
+        "builder": builder,
+        "results": results,
+        "machines": machines,
+    }
+
+
+def test_fleet_build_writes_telemetry_report(fleet_build_with_telemetry):
+    """ISSUE-1 acceptance: the report JSON carries compile time, per-epoch
+    step time, throughput, and (on CPU) gracefully-null HBM watermarks."""
+    out = fleet_build_with_telemetry["out"]
+    with open(out / "telemetry_report.json") as fh:
+        report = json.load(fh)
+    assert report["kind"] == "fleet_build"
+    assert report["n_machines"] == 2
+    assert report["models_per_hour"] > 0
+    assert report["wall_time_s"] > 0
+    (bucket,) = report["buckets"]
+    fit = bucket["fit"]
+    assert fit["compile_time_s"] > 0
+    assert fit["steady_state_epoch_s"] is not None
+    assert fit["sensor_timesteps_per_s"] > 0
+    assert fit["epochs_run"] == 2
+    # CPU backend: watermark keys PRESENT, byte values null — never a crash
+    mem = bucket["device_memory"]
+    assert "peak_bytes_in_use" in mem
+    assert mem["peak_bytes_in_use"] is None or isinstance(
+        mem["peak_bytes_in_use"], int
+    )
+    # in-memory copy matches what was persisted
+    assert fleet_build_with_telemetry["builder"].telemetry_report_[
+        "n_machines"
+    ] == 2
+
+
+def test_fleet_build_emits_lifecycle_events(fleet_build_with_telemetry):
+    events = read_events(str(fleet_build_with_telemetry["events_path"]))
+    kinds = [e["event"] for e in events]
+    assert kinds[0] == "build_started"
+    assert kinds[-1] == "build_finished"
+    assert "bucket_flush" in kinds
+    assert "fit_finished" in kinds
+    # per-epoch events from every fit (CV folds + final)
+    assert sum(1 for k in kinds if k == "epoch") >= 2
+
+
+def test_fleet_build_populates_registry(fleet_build_with_telemetry):
+    snap = get_registry().snapshot()
+    for name in (
+        "gordo_train_fit_seconds",
+        "gordo_train_compile_seconds",
+        "gordo_train_epoch_seconds",
+        "gordo_train_epochs_total",
+        "gordo_train_sensor_timesteps_total",
+        "gordo_build_models_total",
+        "gordo_build_bucket_seconds",
+    ):
+        assert name in snap, f"missing {name}"
+    epochs = snap["gordo_train_epochs_total"]["series"][0]["value"]
+    assert epochs >= 2
+
+
+def test_fleet_build_resume_telemetry(
+    fleet_build_with_telemetry, tmp_path, monkeypatch
+):
+    """A resumed build records the reused machines in its report and
+    emits a resume event. Resumes from a COPY so the shared build's own
+    telemetry report is not overwritten for the other tests."""
+    import shutil
+
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder
+
+    out = tmp_path / "resume-build"
+    shutil.copytree(fleet_build_with_telemetry["out"], out)
+    events_path = tmp_path / "resume-events.jsonl"
+    monkeypatch.setenv(EVENT_LOG_ENV_VAR, str(events_path))
+    builder = FleetModelBuilder(fleet_build_with_telemetry["machines"])
+    builder.build(output_dir_base=out, resume=True)
+    assert builder.telemetry_report_["n_resumed"] == 2
+    assert builder.telemetry_report_["n_built"] == 0
+    kinds = [e["event"] for e in read_events(str(events_path))]
+    assert "resume" in kinds
+
+
+def test_summarize_renders_fleet_build(fleet_build_with_telemetry):
+    out = fleet_build_with_telemetry["out"]
+    text = summarize_directory(out)
+    assert "fleet build: 2 machines" in text
+    assert "compile" in text and "steady epoch" in text
+    assert "sensor-timesteps/s" in text
+    assert "build_started" in text and "build_finished" in text
+
+
+def test_fleet_build_crash_context_event(tmp_path, monkeypatch):
+    """A crash mid-build leaves a build_crashed event with error and
+    memory context — the visibility the round-5 worker deaths lacked."""
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder
+    from gordo_tpu.workflow.config_elements.normalized_config import (
+        NormalizedConfig,
+    )
+
+    events_path = tmp_path / "crash-events.jsonl"
+    monkeypatch.setenv(EVENT_LOG_ENV_VAR, str(events_path))
+    machines = NormalizedConfig(
+        yaml.safe_load(FLEET_CONFIG), project_name="obs"
+    ).machines
+    builder = FleetModelBuilder(machines)
+    monkeypatch.setattr(
+        FleetModelBuilder,
+        "_build_bucket",
+        lambda self, bucket: (_ for _ in ()).throw(RuntimeError("UNAVAILABLE")),
+    )
+    with pytest.raises(RuntimeError):
+        builder.build(output_dir_base=tmp_path / "out")
+    crash = [
+        e
+        for e in read_events(str(events_path))
+        if e["event"] == "build_crashed"
+    ]
+    assert len(crash) == 1
+    assert "UNAVAILABLE" in crash[0]["error"]
+    assert "device_memory" in crash[0]
+    assert summarize_directory(tmp_path).count("CRASH CONTEXT") == 1
+
+
+# --- reports / summarize ----------------------------------------------------
+
+
+def test_write_and_summarize_empty_directory(tmp_path):
+    text = summarize_directory(tmp_path)
+    assert "nothing found" in text
+    path = write_telemetry_report(tmp_path / "b", {"kind": "fleet_build"})
+    assert path.name == "telemetry_report.json"
+    with open(path) as fh:
+        assert json.load(fh)["version"] == 1
+
+
+# --- serving + /metrics end-to-end ------------------------------------------
+
+
+def test_fleet_serving_metrics_and_bridged_exposition(
+    model_collection_env, sensor_frame
+):
+    """A fleet prediction populates serve metrics, and /metrics (with
+    Prometheus enabled) exposes the bridged training AND serving series
+    next to the request metrics."""
+    from prometheus_client import CollectorRegistry
+    from werkzeug.test import Client
+
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.clear_caches()
+    client = Client(
+        build_app(
+            config={"ENABLE_PROMETHEUS": True, "PROJECT": GORDO_PROJECT},
+            prometheus_registry=CollectorRegistry(),
+        )
+    )
+    from gordo_tpu.server.utils import dataframe_to_dict
+
+    resp = client.post(
+        f"/gordo/v0/{GORDO_PROJECT}/prediction/fleet",
+        json={"machines": {GORDO_SINGLE_TARGET: dataframe_to_dict(sensor_frame)}},
+    )
+    assert resp.status_code == 200, resp.get_data()
+
+    snap = get_registry().snapshot()
+    assert "gordo_serve_group_latency_seconds" in snap
+    assert "gordo_serve_machines_scored_total" in snap
+    scored = sum(
+        s["value"]
+        for s in snap["gordo_serve_machines_scored_total"]["series"]
+    )
+    assert scored >= 1
+
+    metrics = client.get("/metrics")
+    assert metrics.status_code == 200
+    text = metrics.get_data().decode()
+    # request metrics (prometheus-native) AND bridged observability series
+    assert "gordo_server_requests_total" in text
+    assert "gordo_serve_group_latency_seconds" in text
+    assert "gordo_server_phase_seconds" in text
+
+
+# --- client metrics ---------------------------------------------------------
+
+
+def test_client_retry_and_latency_metrics(monkeypatch):
+    """IO failures on the fleet POST path count retries and outcomes into
+    the registry without any server involved."""
+    import requests
+
+    from gordo_tpu.client.client import Client
+
+    import gordo_tpu.client.client as client_mod
+
+    monkeypatch.setattr(client_mod, "sleep", lambda s: None)
+
+    class FailingSession(requests.Session):
+        def post(self, *args, **kwargs):
+            raise requests.ConnectionError("server down")
+
+    client = Client(
+        project="obs-proj", session=FailingSession(), n_retries=1
+    )
+    before = get_registry().snapshot()
+
+    def series_value(snap, name, **labels):
+        for s in snap.get(name, {}).get("series", []):
+            if all(s["labels"].get(k) == v for k, v in labels.items()):
+                return s["value"]
+        return 0.0
+
+    retries_before = series_value(
+        before, "gordo_client_retries_total", path="fleet"
+    )
+    status, _ = client._post_fleet_chunk(
+        "http://x/gordo/v0/obs-proj/prediction/fleet",
+        {"m": {"a": {"0": 1.0}}},
+        "rev",
+    )
+    assert status == "io_error"
+    after = get_registry().snapshot()
+    assert (
+        series_value(after, "gordo_client_retries_total", path="fleet")
+        == retries_before + 1
+    )
+    assert (
+        series_value(
+            after,
+            "gordo_client_requests_total",
+            path="fleet",
+            outcome="io_error",
+        )
+        >= 2  # first attempt + one retry
+    )
+    hist = after["gordo_client_request_seconds"]["series"]
+    assert any(s["labels"]["outcome"] == "io_error" for s in hist)
+
+
+# --- trainer-level early stop telemetry -------------------------------------
+
+
+def test_fit_telemetry_early_stopping(tmp_path, monkeypatch):
+    from gordo_tpu.models.factories.feedforward import feedforward_hourglass
+    from gordo_tpu.parallel import FleetTrainer, StackedData
+
+    events_path = tmp_path / "es-events.jsonl"
+    monkeypatch.setenv(EVENT_LOG_ENV_VAR, str(events_path))
+    rng = np.random.default_rng(3)
+    Xs = [rng.random((60, 3)).astype("float32") for _ in range(2)]
+    data = StackedData.from_ragged(Xs, [x.copy() for x in Xs])
+    trainer = FleetTrainer(feedforward_hourglass(n_features=3))
+    keys = trainer.machine_keys(2)
+    trainer.fit(
+        data,
+        keys,
+        epochs=20,
+        batch_size=16,
+        early_stopping_patience=1,
+        early_stopping_min_delta=1e9,  # nothing ever improves enough
+    )
+    telemetry = trainer.fit_telemetry_
+    assert telemetry["early_stopping"] is True
+    assert telemetry["epochs_run"] < 20
+    assert telemetry["early_stop_epoch"] is not None
+    assert telemetry["n_machines_early_stopped"] == 2
+    assert telemetry["sensor_timesteps_trained"] > 0
+    kinds = [e["event"] for e in read_events(str(events_path))]
+    assert "early_stop" in kinds
+    # synced epochs carry losses in their events
+    epoch_events = [
+        e for e in read_events(str(events_path)) if e["event"] == "epoch"
+    ]
+    assert all("mean_loss" in e for e in epoch_events)

@@ -142,6 +142,7 @@ def test_flash_matches_dense(causal):
     np.testing.assert_allclose(out_flash, out_dense, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_flash_gradients_match_dense():
     q, k, v = (
         jnp.asarray(RNG.normal(size=(1, 24, 2, 8)), dtype=jnp.float32)
@@ -280,6 +281,7 @@ def test_flash_gradients_multi_block_seq():
         np.testing.assert_allclose(g, w, atol=5e-3, err_msg=f"d{name}")
 
 
+@pytest.mark.slow
 def test_flash_attention_impl_in_estimator():
     X, y = make_data(120)
     model = TransformerAutoEncoder(

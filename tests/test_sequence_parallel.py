@@ -33,6 +33,7 @@ def make_qkv(batch=2, seq=64, heads=8, head_dim=16):
 
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
 @pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.slow
 def test_matches_dense_attention(seq_mesh, impl, causal):
     q, k, v = make_qkv()
     out = sequence_sharded_attention(q, k, v, seq_mesh, impl=impl, causal=causal)
@@ -41,6 +42,7 @@ def test_matches_dense_attention(seq_mesh, impl, causal):
 
 
 @pytest.mark.parametrize("impl", ["ring", "ulysses"])
+@pytest.mark.slow
 def test_gradients_match_dense(seq_mesh, impl):
     q, k, v = make_qkv(seq=32, heads=8, head_dim=8)
 

@@ -137,6 +137,54 @@ def test_prediction_json(gordo_ml_server_client, sensor_frame):
     assert len(data) == N_SAMPLES
 
 
+def _server_timing_entries(resp) -> dict:
+    """Parse a Server-Timing header into {name: dur_ms}."""
+    entries = {}
+    for part in resp.headers["Server-Timing"].split(","):
+        name, _, params = part.strip().partition(";")
+        for param in params.split(";"):
+            key, _, value = param.partition("=")
+            if key.strip() == "dur":
+                entries[name.strip()] = float(value)
+    return entries
+
+
+def test_server_timing_header_spec_compliant(gordo_ml_server_client):
+    """Server-Timing ``dur`` values are MILLISECONDS (the spec's unit)
+    for the new entries; the legacy request_walltime_s entry keeps its
+    historical SECONDS value so existing consumers stay correct."""
+    resp = gordo_ml_server_client.get(_url(GORDO_PROJECT, "models"))
+    entries = _server_timing_entries(resp)
+    assert {"total", "request_walltime_s"} <= set(entries)
+    # same wall time, two units: total is ms, the legacy entry seconds
+    assert entries["total"] == pytest.approx(
+        entries["request_walltime_s"] * 1000.0, rel=0.01
+    )
+    # a trivial listing is far under a second but nonzero: the total can
+    # only land in that window when expressed in milliseconds
+    assert 0.0 < entries["total"] < 1000.0
+    assert entries["request_walltime_s"] < 1.0
+
+
+def test_server_timing_prediction_phases(gordo_ml_server_client, sensor_frame):
+    """Prediction responses stamp per-phase entries (model load, predict)
+    from the request's recorded phases, alongside the totals."""
+    resp = gordo_ml_server_client.post(
+        _url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "prediction"),
+        json={"X": server_utils.dataframe_to_dict(sensor_frame)},
+    )
+    assert resp.status_code == 200
+    entries = _server_timing_entries(resp)
+    assert {"model_load", "predict", "total", "request_walltime_s"} <= set(entries)
+    assert entries["predict"] <= entries["total"]
+    # phases also land in the observability registry (bridged to /metrics)
+    from gordo_tpu.observability import get_registry
+
+    snap = get_registry().snapshot()["gordo_server_phase_seconds"]
+    phases = {s["labels"]["phase"] for s in snap["series"]}
+    assert {"model_load", "predict"} <= phases
+
+
 def test_prediction_unlabeled_matrix(gordo_ml_server_client, sensor_frame):
     """Clients may POST bare arrays; column names are assumed from the model."""
     X = pd.DataFrame(sensor_frame.values)  # integer columns

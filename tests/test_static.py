@@ -299,6 +299,58 @@ def test_shadowing_check_catches_round2_copy_bug():
     assert any("copy.copy" in p for p in found), found
 
 
+def test_metric_registrations_disciplined():
+    """Every observability-registry metric registration in the package
+    must carry the gordo_ prefix and draw its label names from the
+    documented bounded set (docs/observability.md) — raw paths or
+    machine names as labels would blow up the series cardinality."""
+    from static_analysis import check_metric_registrations
+
+    problems = {}
+    for name, module in _importable_modules():
+        found = check_metric_registrations(parse(module.__file__))
+        if found:
+            problems[name] = found
+    assert not problems, f"undisciplined metric registrations: {problems}"
+
+
+def test_metric_registration_check_catches_violations():
+    import ast as _ast
+
+    from static_analysis import check_metric_registrations
+
+    source = (
+        "def instrument(reg, machine_name):\n"
+        "    reg.counter('gordo_good_total', 'd', ('path',)).inc(path='x')\n"
+        "    reg.counter('bad_prefix_total', 'd')\n"
+        "    reg.counter('gordo_missing_suffix', 'd')\n"
+        "    reg.gauge('gordo_ok_gauge', 'd', ('machine',))\n"
+        "    reg.histogram('gordo_h_seconds', 'd', labelnames=(machine_name,))\n"
+        "    reg.histogram('gordo_h2_seconds', 'd', machine_name)\n"
+    )
+    found = check_metric_registrations(_ast.parse(source))
+    assert len(found) == 5, found
+    assert any("bad_prefix_total" in p and "gordo_" in p for p in found)
+    assert any("gordo_missing_suffix" in p and "_total" in p for p in found)
+    assert any("'machine'" in p and "documented label set" in p for p in found)
+    assert any("non-literal label name" in p for p in found)
+    assert any("literal tuple/list" in p for p in found)
+
+
+def test_metric_registration_check_skips_foreign_counters():
+    """A call to some other object's .counter() with a non-literal first
+    arg is out of scope — the check only vouches for literal names."""
+    import ast as _ast
+
+    from static_analysis import check_metric_registrations
+
+    source = (
+        "def other(obj, key):\n"
+        "    return obj.counter(key) + obj.gauge(12)\n"
+    )
+    assert check_metric_registrations(_ast.parse(source)) == []
+
+
 def test_package_byte_compiles():
     assert compileall.compile_dir(
         str(PACKAGE_ROOT), quiet=2, force=False
