@@ -204,11 +204,24 @@ def build(
     "build only the rest — artifacts flush per bucket, so re-running after "
     "a runtime crash completes the fleet instead of restarting it.",
 )
+@click.option(
+    "--epoch-chunk",
+    type=click.IntRange(min=1),
+    default=1,
+    envvar="GORDO_EPOCH_CHUNK",
+    show_default=True,
+    help="Fuse this many training epochs into ONE compiled program per "
+    "bucket fit (one host sync per chunk instead of per epoch — the "
+    "lever for tunneled/DCN-attached TPU backends). Results are "
+    "bit-identical to per-epoch dispatch; a machine config may override "
+    "per bucket with an 'epoch_chunk' fit arg.",
+)
 @_with_build_options
 def build_fleet(
     machines_config: list,
     output_dir: str,
     resume: bool,
+    epoch_chunk: int,
     model_register_dir: str,
     print_cv_scores: bool,
     model_parameter: List[Tuple[str, Any]],
@@ -240,7 +253,7 @@ def build_fleet(
         logger.info(
             "Fleet-building %d machines, output at: %s", len(machines), output_dir
         )
-        built = FleetModelBuilder(machines).build(
+        built = FleetModelBuilder(machines, epoch_chunk=epoch_chunk).build(
             output_dir_base=output_dir, resume=resume
         )
         for _, machine_out in built:
@@ -299,6 +312,15 @@ def get_all_score_strings(machine) -> List[str]:
 @click.option("--epochs", type=int, default=None, help="Override model epochs")
 @click.option("--batch-size", type=int, default=None, help="Override batch size")
 @click.option(
+    "--epoch-chunk",
+    type=click.IntRange(min=1),
+    default=None,
+    envvar="GORDO_EPOCH_CHUNK",
+    help="Fuse this many epochs into one compiled program (default: the "
+    "machine config's 'epoch_chunk' fit arg, else per-epoch dispatch). "
+    "Bit-identical results, one host sync per chunk.",
+)
+@click.option(
     "--exceptions-reporter-file",
     envvar="EXCEPTIONS_REPORTER_FILE",
     help="JSON output file for exception information",
@@ -315,6 +337,7 @@ def sweep_cli(
     grid_params,
     epochs,
     batch_size,
+    epoch_chunk,
     exceptions_reporter_file,
     exceptions_report_level,
 ):
@@ -382,6 +405,11 @@ def sweep_cli(
             grid,
             lookahead=estimator.lookahead if spec.windowed else 0,
             mesh=auto_device_mesh(),
+            epoch_chunk=(
+                epoch_chunk
+                if epoch_chunk is not None
+                else int(estimator.kwargs.get("epoch_chunk", 1))
+            ),
         )
         # same regime as build/build-fleet (core.py fit defaults), so the
         # winning hyperparameters transfer to the build that uses them

@@ -129,6 +129,10 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         "callbacks",
         "validation_split",
         "shuffle",
+        # fleet-only scheduling knob (FleetTrainer epoch fusion): listed
+        # here so machine configs can carry it without it leaking into
+        # the model factory's kwargs; the solo per-epoch fit ignores it
+        "epoch_chunk",
         "class_weight",
         "initial_epoch",
         "steps_per_epoch",

@@ -41,6 +41,26 @@ from gordo_tpu.parallel.mesh import fleet_sharding, pad_to_multiple, replicated_
 logger = logging.getLogger(__name__)
 
 
+@jax.jit
+def _keep_better(mask, new_tree, old_tree):
+    """
+    Per-machine select over the stacked params' leading axis.
+
+    Module-level and jitted ONCE: it used to be redefined inside every
+    ``fit`` call, so each fit re-traced it; the jit cache is keyed on
+    tree structure/shapes, so all fits sharing a geometry now reuse one
+    compiled select. This is the host-path early-stopping fallback — the
+    chunked path (``epoch_chunk > 1``) does the same masked snapshot
+    in-program.
+    """
+
+    def select(new_leaf, old_leaf):
+        shape = (mask.shape[0],) + (1,) * (new_leaf.ndim - 1)
+        return jnp.where(mask.reshape(shape), new_leaf, old_leaf)
+
+    return jax.tree_util.tree_map(select, new_tree, old_tree)
+
+
 def host_fetch(x):
     """
     device -> host for arrays that may span multiple PROCESSES (multi-host
@@ -134,6 +154,17 @@ class FleetTrainer:
         (hyperparameter sweeps): ``fit`` takes a single-machine
         StackedData and the epoch vmaps with ``in_axes=None`` for the
         data, so device memory holds one copy instead of M.
+    epoch_chunk
+        Number of epochs fused into ONE compiled program (an outer
+        ``lax.scan`` over the per-epoch program). With the default 1,
+        ``fit`` dispatches one program per epoch from a Python loop;
+        with K > 1 the whole training loop — per-epoch ``fold_in`` key
+        derivation, validation loss, the early-stopping state machine
+        and the ``restore_best_weights`` snapshot — lives on device, and
+        a monitored fit syncs to host once per CHUNK instead of once per
+        epoch (an unmonitored fit syncs only at fit end). Scheduling
+        only: results are bit-identical to ``epoch_chunk=1``; a stopped
+        fleet wastes at most K-1 gated (no-op) epochs of device work.
     """
 
     def __init__(
@@ -145,6 +176,7 @@ class FleetTrainer:
         scan_unroll: int = 1,
         optimizer: Optional[Any] = None,
         broadcast_data: bool = False,
+        epoch_chunk: int = 1,
     ):
         self.spec = spec
         self.lookahead = int(lookahead) if spec.windowed else 0
@@ -152,6 +184,7 @@ class FleetTrainer:
         self.donate = donate
         self.scan_unroll = max(1, int(scan_unroll))
         self.broadcast_data = broadcast_data
+        self.epoch_chunk = max(1, int(epoch_chunk))
         self._optimizer = optimizer if optimizer is not None else spec.make_optimizer()
         self._epoch_fn_cache: dict = {}
         self._predict_fn_cache: dict = {}
@@ -230,6 +263,17 @@ class FleetTrainer:
         return max(1, int(valid.sum(axis=1).max()))
 
     # -- the compiled epoch ---------------------------------------------
+    def _n_batches(
+        self, n: int, batch_size: int, sample_cap: Optional[int]
+    ) -> int:
+        """Optimizer steps per epoch for a geometry: ``ceil(cap /
+        batch_size)``. The cap reaches the compiled program only through
+        this count, so caps rounding to the same batch count share one
+        compiled epoch."""
+        n_samples = self._n_samples(n)
+        cap = n_samples if sample_cap is None else max(1, min(sample_cap, n_samples))
+        return max(1, math.ceil(cap / batch_size))
+
     def _epoch_fn(
         self,
         n: int,
@@ -259,15 +303,44 @@ class FleetTrainer:
         machine (masked argsort), and a step whose batch holds no real
         samples leaves params and optimizer state untouched.
         """
-        n_samples = self._n_samples(n)
-        cap = n_samples if sample_cap is None else max(1, min(sample_cap, n_samples))
-        n_batches = max(1, math.ceil(cap / batch_size))
-        # the cap reaches the compiled program only through n_batches, so
-        # caps rounding to the same batch count share one compiled epoch
+        n_batches = self._n_batches(n, batch_size, sample_cap)
         cache_key = (n, batch_size, shuffle, gated, n_batches)
         if cache_key in self._epoch_fn_cache:
             return self._epoch_fn_cache[cache_key]
 
+        fleet_epoch = self._epoch_callable(n, batch_size, shuffle, gated, n_batches)
+        n_args = 7 if gated else 6
+        jit_kwargs: dict = {}
+        if self.mesh is not None:
+            fs = fleet_sharding(self.mesh)
+            rs = replicated_sharding(self.mesh)
+            data_sh = rs if self.broadcast_data else fs
+            jit_kwargs["in_shardings"] = (
+                fs, fs, fs, data_sh, data_sh, data_sh, fs
+            )[:n_args]
+            jit_kwargs["out_shardings"] = (fs, fs, fs)
+        if self.donate:
+            jit_kwargs["donate_argnums"] = (0, 1)
+
+        fn = jax.jit(fleet_epoch, **jit_kwargs)
+        self._epoch_fn_cache[cache_key] = fn
+        return fn
+
+    def _epoch_callable(
+        self, n: int, batch_size: int, shuffle: bool, gated: bool, n_batches: int
+    ):
+        """
+        The RAW (un-jitted) vmapped fleet-epoch callable for a geometry,
+        cached so the per-epoch jit wrapper (``_epoch_fn``) and the fused
+        multi-epoch chunk program (``_chunk_fn``) trace the IDENTICAL
+        computation — chunking must be a scheduling change, not a
+        numerics change.
+        """
+        cache_key = ("epoch_raw", n, batch_size, shuffle, gated, n_batches)
+        if cache_key in self._epoch_fn_cache:
+            return self._epoch_fn_cache[cache_key]
+
+        n_samples = self._n_samples(n)
         spec = self.spec
         optimizer = self._optimizer
         lb = spec.lookback_window if spec.windowed else 1
@@ -400,25 +473,34 @@ class FleetTrainer:
         else:
             fleet_epoch = jax.vmap(machine_epoch, in_axes=(0,) * n_args)
 
+        self._epoch_fn_cache[cache_key] = fleet_epoch
+        return fleet_epoch
+
+    def _val_fn(self, n: int, batch_size: int, lo: int = 0):
+        """
+        Jitted per-machine validation loss over the fleet (the raw
+        callable, ``_val_callable``, is shared with the chunk program).
+        """
+        cache_key = ("val", n, batch_size, lo)
+        if cache_key in self._epoch_fn_cache:
+            return self._epoch_fn_cache[cache_key]
+
+        fleet_val = self._val_callable(n, batch_size, lo)
         jit_kwargs: dict = {}
         if self.mesh is not None:
             fs = fleet_sharding(self.mesh)
             rs = replicated_sharding(self.mesh)
             data_sh = rs if self.broadcast_data else fs
-            jit_kwargs["in_shardings"] = (
-                fs, fs, fs, data_sh, data_sh, data_sh, fs
-            )[:n_args]
-            jit_kwargs["out_shardings"] = (fs, fs, fs)
-        if self.donate:
-            jit_kwargs["donate_argnums"] = (0, 1)
+            jit_kwargs["in_shardings"] = (fs, data_sh, data_sh, data_sh)
+            jit_kwargs["out_shardings"] = fs
 
-        fn = jax.jit(fleet_epoch, **jit_kwargs)
+        fn = jax.jit(fleet_val, **jit_kwargs)
         self._epoch_fn_cache[cache_key] = fn
         return fn
 
-    def _val_fn(self, n: int, batch_size: int, lo: int = 0):
+    def _val_callable(self, n: int, batch_size: int, lo: int = 0):
         """
-        Jitted per-machine validation loss over the fleet: deterministic
+        The raw vmapped per-machine validation loss: deterministic
         forward, per-sample loss weighted by a (M, n) validation mask —
         chunked like the training scan so the windowed gather never
         materializes more than (batch, lb, f) at once (mirrors the solo
@@ -428,7 +510,7 @@ class FleetTrainer:
         the eval walks only the holdout tail instead of zero-weighting the
         whole training prefix every epoch.
         """
-        cache_key = ("val", n, batch_size, lo)
+        cache_key = ("val_raw", n, batch_size, lo)
         if cache_key in self._epoch_fn_cache:
             return self._epoch_fn_cache[cache_key]
 
@@ -476,15 +558,159 @@ class FleetTrainer:
         else:
             fleet_val = jax.vmap(machine_val, in_axes=(0, 0, 0, 0))
 
-        jit_kwargs: dict = {}
-        if self.mesh is not None:
-            fs = fleet_sharding(self.mesh)
-            rs = replicated_sharding(self.mesh)
-            data_sh = rs if self.broadcast_data else fs
-            jit_kwargs["in_shardings"] = (fs, data_sh, data_sh, data_sh)
-            jit_kwargs["out_shardings"] = fs
+        self._epoch_fn_cache[cache_key] = fleet_val
+        return fleet_val
 
-        fn = jax.jit(fleet_val, **jit_kwargs)
+    def _chunk_fn(
+        self,
+        n: int,
+        batch_size: int,
+        shuffle: bool,
+        *,
+        chunk_len: int,
+        sample_cap: Optional[int],
+        with_val: bool,
+        val_lo: int,
+        gated: bool,
+        track_best: bool,
+        monitor_val: bool,
+        es_delta: float = 0.0,
+        es_stop_at: int = 1,
+        es_start_from: int = 0,
+    ):
+        """
+        Build (and cache) the fused multi-epoch program: an outer
+        ``lax.scan`` over ``chunk_len`` epoch indices around the SAME raw
+        epoch callable the per-epoch path jits, with per-epoch PRNG key
+        derivation (``fold_in``), the validation pass, the early-stopping
+        state machine (``best``/``wait``/``active``/``last_loss`` as
+        device arrays) and the ``restore_best_weights`` masked param
+        snapshot all inside the one jitted program. The host syncs once
+        per chunk (early stopping) or never (plain fits) — see ``fit``.
+
+        The program takes the chunk's absolute epoch ids as a dynamic
+        (chunk_len,) array, so every same-length chunk of a fit reuses
+        one compiled program regardless of position in the schedule.
+        """
+        n_batches = self._n_batches(n, batch_size, sample_cap)
+        cache_key = (
+            "chunk", n, batch_size, shuffle, chunk_len, n_batches, with_val,
+            val_lo, gated, track_best, monitor_val,
+            float(es_delta), int(es_stop_at), int(es_start_from),
+        )
+        if cache_key in self._epoch_fn_cache:
+            return self._epoch_fn_cache[cache_key]
+
+        fleet_epoch = self._epoch_callable(n, batch_size, shuffle, gated, n_batches)
+        fleet_val = self._val_callable(n, batch_size, val_lo) if with_val else None
+
+        def chunk_program(params, opt_state, keys, X, y, w, epoch_ids, *rest):
+            rest = list(rest)
+            val_w = rest.pop(0) if with_val else None
+            carry = {"params": params, "opt": opt_state}
+            has_val = None
+            if gated:
+                carry["es"] = {
+                    "active": rest.pop(0),  # (M,) bool
+                    "best": rest.pop(0),    # (M,) f32
+                    "wait": rest.pop(0),    # (M,) i32
+                    "last": rest.pop(0),    # (M,) f32
+                }
+                if monitor_val:
+                    has_val = rest.pop(0)   # (M,) bool
+            if track_best:
+                carry["best_params"] = rest.pop(0)
+                carry["ever_improved"] = rest.pop(0)  # scalar bool
+
+            def step(carry, epoch_id):
+                # the in-program replica of the host loop's per-epoch key
+                # derivation (fold_in is trace-invariant, so the streams
+                # are bit-identical to the host-side vmap dispatch)
+                epoch_keys = jax.vmap(
+                    lambda k: jax.random.fold_in(k, epoch_id)
+                )(keys)
+                new = dict(carry)
+                outs = {}
+                if gated:
+                    es = carry["es"]
+                    active_f = es["active"].astype(jnp.float32)
+                    p, o, loss = fleet_epoch(
+                        carry["params"], carry["opt"], epoch_keys,
+                        X, y, w, active_f,
+                    )
+                else:
+                    p, o, loss = fleet_epoch(
+                        carry["params"], carry["opt"], epoch_keys, X, y, w
+                    )
+                new["params"], new["opt"] = p, o
+                vloss = None
+                if with_val:
+                    vloss = fleet_val(p, X, y, val_w)
+                    outs["val"] = vloss
+                if gated:
+                    # a stopped machine's computed loss reflects a
+                    # discarded would-be update; report its last active
+                    # loss instead (same select as the host loop)
+                    report = jnp.where(es["active"], loss, es["last"])
+                    monitored = (
+                        jnp.where(has_val, vloss, loss) if monitor_val else loss
+                    )
+                    do_update = epoch_id >= es_start_from
+                    improved = (
+                        es["active"]
+                        & (monitored < es["best"] - es_delta)
+                        & do_update
+                    )
+                    best = jnp.where(improved, monitored, es["best"])
+                    wait = jnp.where(
+                        do_update,
+                        jnp.where(improved, 0, es["wait"] + 1),
+                        es["wait"],
+                    )
+                    active = jnp.where(
+                        do_update, es["active"] & (wait < es_stop_at),
+                        es["active"],
+                    )
+                    new["es"] = {
+                        "active": active, "best": best,
+                        "wait": wait, "last": report,
+                    }
+                    outs["loss"] = report
+                    outs["active"] = active
+                    if track_best:
+                        # host semantics: until the first improving epoch
+                        # best_params "is None" and the fallback for
+                        # non-improved machines is the CURRENT params;
+                        # afterwards it is the carried snapshot
+                        ever = carry["ever_improved"]
+                        base = jax.tree.map(
+                            lambda bp, pl: jnp.where(ever, bp, pl),
+                            carry["best_params"], p,
+                        )
+                        # the same masked per-machine select the host
+                        # path uses (inlines under this trace)
+                        new["best_params"] = _keep_better(improved, p, base)
+                        new["ever_improved"] = ever | improved.any()
+                else:
+                    outs["loss"] = loss
+                return new, outs
+
+            return jax.lax.scan(step, carry, epoch_ids)
+
+        jit_kwargs: dict = {}
+        if self.donate:
+            donate = [0, 1]
+            if track_best:
+                # best_params rides the carry; its input buffer is dead
+                # after the call exactly like params/opt_state
+                donate.append(
+                    7 + (1 if with_val else 0) + 4 + (1 if monitor_val else 0)
+                )
+            jit_kwargs["donate_argnums"] = tuple(donate)
+        # shardings propagate from the committed inputs (params/data are
+        # device_put with fleet/replicated shardings by fit's setup), so
+        # no explicit in_shardings are needed here
+        fn = jax.jit(chunk_program, **jit_kwargs)
         self._epoch_fn_cache[cache_key] = fn
         return fn
 
@@ -592,10 +818,13 @@ class FleetTrainer:
         improved by ``early_stopping_min_delta`` for that many epochs gets
         zero sample weights from then on — its params freeze while the
         rest of the fleet trains — and the loop ends early once every
-        machine has stopped. This syncs the (M,) losses to host each
-        epoch (the cost of the decision), and stopped machines still ride
-        along in the compiled program (gated, not compacted). Monitored
-        metric is the training loss.
+        machine has stopped. With the default ``epoch_chunk=1`` this
+        syncs the (M,) losses to host each epoch (the cost of the
+        decision); with ``epoch_chunk=K`` the state machine runs on
+        device and the sync happens once per K-epoch chunk (at the price
+        of up to K-1 gated no-op epochs after the fleet stops). Stopped
+        machines still ride along in the compiled program (gated, not
+        compacted). Monitored metric is the training loss.
 
         ``restore_best_weights`` (early stopping only) keeps a device-side
         per-machine snapshot of the params at each machine's best epoch —
@@ -718,12 +947,38 @@ class FleetTrainer:
             X_arg, y_arg, w_arg = data.X, data.y, w
             val_arg = val_w
 
+        if self.broadcast_data:
+            # every fleet member trains on the one shared dataset
+            rows_per_machine = np.full(m, int((w_host > 0).sum()), dtype=np.int64)
+        else:
+            rows_per_machine = (w_host > 0).sum(axis=1).astype(np.int64)
+        sample_cap = self._sample_cap(w_host, data.n_timesteps)
+        track_best = early_stopping and restore_best_weights
+
+        if self.epoch_chunk > 1:
+            # device-resident loop: K epochs per compiled program, one
+            # host sync per chunk (early stopping) or per fit (plain)
+            return self._fit_chunked(
+                data=data, keys=keys, epochs=epochs, batch_size=batch_size,
+                shuffle=shuffle, params=params, opt_state=opt_state,
+                X_arg=X_arg, y_arg=y_arg, w_arg=w_arg, val_arg=val_arg,
+                sample_cap=sample_cap, has_val=has_val, val_lo=val_lo,
+                monitor_val=monitor_val, early_stopping=early_stopping,
+                es_state=es_state if early_stopping else None,
+                es_stop_at=es_stop_at if early_stopping else 1,
+                es_delta=es_delta if early_stopping else 0.0,
+                es_start_from=int(early_stopping_start_from_epoch),
+                track_best=track_best, checkpointer=checkpointer,
+                checkpoint_every=checkpoint_every, start_epoch=start_epoch,
+                m=m, rows_per_machine=rows_per_machine, fit_start=fit_start,
+            )
+
         epoch_fn = self._epoch_fn(
             data.n_timesteps,
             batch_size,
             shuffle,
             gated=early_stopping,
-            sample_cap=self._sample_cap(w_host, data.n_timesteps),
+            sample_cap=sample_cap,
         )
         val_fn = (
             self._val_fn(data.n_timesteps, batch_size, lo=val_lo)
@@ -731,18 +986,7 @@ class FleetTrainer:
             else None
         )
 
-        track_best = early_stopping and restore_best_weights
         best_params = None  # set at the first monitored improvement
-
-        @jax.jit
-        def keep_better(mask, new_tree, old_tree):
-            """Per-machine select over the stacked params' leading axis."""
-
-            def select(new_leaf, old_leaf):
-                shape = (mask.shape[0],) + (1,) * (new_leaf.ndim - 1)
-                return jnp.where(mask.reshape(shape), new_leaf, old_leaf)
-
-            return jax.tree_util.tree_map(select, new_tree, old_tree)
 
         losses = []
         val_losses: list = []
@@ -754,11 +998,8 @@ class FleetTrainer:
         epochs_run = 0
         timesteps_trained = 0
         early_stop_epoch: Optional[int] = None
-        if self.broadcast_data:
-            # every fleet member trains on the one shared dataset
-            rows_per_machine = np.full(m, int((w_host > 0).sum()), dtype=np.int64)
-        else:
-            rows_per_machine = (w_host > 0).sum(axis=1).astype(np.int64)
+        n_host_syncs = 1  # the setup's one effective-weights fetch
+        dispatch_times: list = []
         loop_start = time.perf_counter()
         for epoch in range(start_epoch, epochs):
             epoch_start = time.perf_counter()
@@ -774,6 +1015,9 @@ class FleetTrainer:
                 params, opt_state, epoch_loss = epoch_fn(
                     params, opt_state, epoch_keys, X_arg, y_arg, w_arg
                 )
+            # host-side cost of issuing this epoch (key vmap + dispatch);
+            # the async device work itself is not included
+            dispatch_times.append(time.perf_counter() - epoch_start)
             epochs_run += 1
             # active ENTERING this epoch (the gate the program just ran)
             timesteps_trained += int(
@@ -793,6 +1037,7 @@ class FleetTrainer:
             # sync)
             if early_stopping:
                 loss_np = np.asarray(host_fetch(epoch_loss), dtype=np.float64)
+                n_host_syncs += 1
                 # a stopped machine's computed loss reflects a discarded
                 # would-be update; report its last active loss instead
                 report = np.where(
@@ -804,6 +1049,7 @@ class FleetTrainer:
                     val_np = np.asarray(
                         host_fetch(val_losses[-1]), dtype=np.float64
                     )
+                    n_host_syncs += 1
                     # keep the host copy: the end-of-fit stack must not
                     # re-transfer a history already fetched epoch by epoch
                     val_losses[-1] = val_np
@@ -816,8 +1062,16 @@ class FleetTrainer:
                 else:
                     monitored = loss_np
                 if epoch >= int(early_stopping_start_from_epoch):
+                    # the improvement test runs in float32 — the same
+                    # arithmetic the device-resident (epoch_chunk > 1)
+                    # state machine uses — so both paths take bit-identical
+                    # stopping decisions (the state itself stays float64
+                    # for checkpoint-format stability; the values are
+                    # exact float32s either way)
                     improved = es_state["active"] & (
-                        monitored < es_state["best"] - es_delta
+                        monitored.astype(np.float32)
+                        < es_state["best"].astype(np.float32)
+                        - np.float32(es_delta)
                     )
                     es_state["best"] = np.where(
                         improved, monitored, es_state["best"]
@@ -834,7 +1088,7 @@ class FleetTrainer:
                             mask = jax.device_put(
                                 mask, fleet_sharding(self.mesh)
                             )
-                        best_params = keep_better(
+                        best_params = _keep_better(
                             mask,
                             params,
                             params if best_params is None else best_params,
@@ -880,25 +1134,32 @@ class FleetTrainer:
             # start_from_epoch) was never snapshotted and keeps its final
             # params via the first keep_better call's fallback
             params = best_params
+        # early stopping already host-materialized each epoch's losses
+        # (its per-epoch decision IS the sync); fetching them again
+        # would make process_allgather treat the replicated host copy
+        # as per-process data. Everything still on device — the plain
+        # fit's whole loss/val history — is ONE bulk transfer.
+        pending: dict = {}
+        if val_losses and not isinstance(val_losses[0], np.ndarray):
+            pending["val"] = val_losses
+        if losses and not isinstance(losses[0], np.ndarray):
+            pending["loss"] = losses
+        if pending:
+            fetched = host_fetch(pending)
+            n_host_syncs += 1
+            if "val" in fetched:
+                val_losses = list(fetched["val"])
+            if "loss" in fetched:
+                losses = list(fetched["loss"])
         if val_losses:
-            if isinstance(val_losses[0], np.ndarray):
-                stacked = np.stack(val_losses).astype(np.float64)
-            else:
-                stacked = np.stack(host_fetch(val_losses)).astype(np.float64)
+            stacked = np.stack(val_losses).astype(np.float64)
             # machines with no validation samples have no val loss (their
             # computed 0.0 is an artifact of the empty weight sum)
             if has_val is not None and not has_val.all():
                 stacked[:, ~has_val] = np.nan
             self.val_losses_ = stacked
         if losses:
-            # early stopping already host-materialized each epoch's losses
-            # (its per-epoch decision IS the sync); fetching them again
-            # would make process_allgather treat the replicated host copy
-            # as per-process data. Everything else is one bulk transfer.
-            if isinstance(losses[0], np.ndarray):
-                losses_out = np.stack(losses)
-            else:
-                losses_out = np.stack(host_fetch(losses))
+            losses_out = np.stack([np.asarray(l) for l in losses])
         else:
             losses_out = np.zeros((0, len(keys)))
         # loop time is read AFTER the loss fetch above — that fetch is the
@@ -906,8 +1167,10 @@ class FleetTrainer:
         self._record_fit_telemetry(
             wall_time_s=time.perf_counter() - fit_start,
             loop_time_s=time.perf_counter() - loop_start,
-            first_epoch_s=first_epoch_s,
+            first_sync_s=first_epoch_s,
+            first_sync_epochs=1,
             epochs_run=epochs_run,
+            epochs_dispatched=epochs_run,
             epochs_configured=epochs,
             start_epoch=start_epoch,
             timesteps_trained=timesteps_trained,
@@ -917,6 +1180,303 @@ class FleetTrainer:
             n_stopped=(
                 int((~es_state["active"]).sum()) if early_stopping else 0
             ),
+            n_dispatches=epochs_run,
+            n_host_syncs=n_host_syncs,
+            dispatch_times=dispatch_times,
+        )
+        return params, losses_out
+
+    def _fit_chunked(
+        self,
+        *,
+        data: StackedData,
+        keys: jnp.ndarray,
+        epochs: int,
+        batch_size: int,
+        shuffle: bool,
+        params: Any,
+        opt_state: Any,
+        X_arg: Any,
+        y_arg: Any,
+        w_arg: Any,
+        val_arg: Any,
+        sample_cap: int,
+        has_val: Optional[np.ndarray],
+        val_lo: int,
+        monitor_val: bool,
+        early_stopping: bool,
+        es_state: Optional[dict],
+        es_stop_at: int,
+        es_delta: float,
+        es_start_from: int,
+        track_best: bool,
+        checkpointer: Optional[Any],
+        checkpoint_every: int,
+        start_epoch: int,
+        m: int,
+        rows_per_machine: np.ndarray,
+        fit_start: float,
+    ) -> Tuple[Any, np.ndarray]:
+        """
+        The ``epoch_chunk > 1`` fit loop: dispatch ONE fused program per
+        K-epoch chunk (``_chunk_fn``) and sync to host once per chunk
+        (early stopping — the (K, M) reported losses, per-epoch activity
+        and the end-of-chunk ES state come back in a single transfer) or
+        not at all until fit end (no early stopping: chunk dispatches
+        pipeline and the whole loss/val history is one final fetch, so a
+        plain fit performs exactly 2 device->host syncs: the setup's
+        weight fetch and this one).
+
+        A checkpoint boundary forces a chunk boundary, so
+        ``checkpoint_every`` cadence and resume semantics are preserved
+        exactly; an early stop inside a chunk is detected from the
+        per-epoch activity history and the history is truncated at the
+        stop epoch, so reported losses, stop epochs and final params are
+        bit-identical to the per-epoch loop (the chunk's remaining
+        epochs ran gated — all machines inactive — and changed nothing).
+        """
+        with_val = val_arg is not None
+        # the monitored-metric select only exists inside the gated (ES)
+        # program; normalizing here keeps a plain fit-with-validation from
+        # minting a distinct (but identical) compiled chunk program
+        monitor_val = monitor_val and early_stopping
+        n_timesteps = data.n_timesteps
+        chunk = self.epoch_chunk
+        ce = max(1, checkpoint_every)
+
+        def put_fleet(x):
+            arr = jnp.asarray(x)
+            if self.mesh is not None:
+                arr = jax.device_put(arr, fleet_sharding(self.mesh))
+            return arr
+
+        es_dev: Optional[dict] = None
+        has_val_dev = None
+        if early_stopping:
+            es_dev = {
+                "active": put_fleet(es_state["active"]),
+                "best": put_fleet(es_state["best"].astype(np.float32)),
+                "wait": put_fleet(es_state["wait"].astype(np.int32)),
+                "last": put_fleet(es_state["last_loss"].astype(np.float32)),
+            }
+            if monitor_val:
+                has_val_dev = put_fleet(np.asarray(has_val, dtype=bool))
+        best_params_dev = None
+        ever_dev = None
+        ever_improved = False
+        if track_best:
+            # garbage until the first improving epoch (ever_improved
+            # gates its use), but it must be a DISTINCT buffer: params is
+            # donated, and aliasing a donated arg is not allowed
+            best_params_dev = self._shard(
+                jax.tree.map(lambda a: jnp.zeros(a.shape, a.dtype), params)
+            )
+            ever_dev = jnp.asarray(False)
+
+        loss_chunks: list = []
+        val_chunks: list = []
+        first_sync_s: Optional[float] = None
+        first_sync_epochs = 0
+        epochs_run = 0
+        epochs_dispatched = 0
+        timesteps_trained = 0
+        early_stop_epoch: Optional[int] = None
+        n_host_syncs = 1  # the setup's one effective-weights fetch
+        n_dispatches = 0
+        dispatch_times: list = []
+        loop_start = time.perf_counter()
+
+        e = start_epoch
+        while e < epochs:
+            k = min(chunk, epochs - e)
+            if checkpointer is not None:
+                # the next epoch whose completion is a checkpoint: the
+                # chunk must not run past it (checkpoints happen at chunk
+                # boundaries only, so cadence survives chunking exactly)
+                next_cp = ((e + ce) // ce) * ce - 1
+                k = min(k, next_cp - e + 1)
+            chunk_start = time.perf_counter()
+            chunk_fn = self._chunk_fn(
+                n_timesteps, batch_size, shuffle,
+                chunk_len=k, sample_cap=sample_cap, with_val=with_val,
+                val_lo=val_lo, gated=early_stopping, track_best=track_best,
+                monitor_val=monitor_val, es_delta=es_delta,
+                es_stop_at=es_stop_at, es_start_from=es_start_from,
+            )
+            args = [
+                params, opt_state, keys, X_arg, y_arg, w_arg,
+                jnp.arange(e, e + k, dtype=jnp.int32),
+            ]
+            if with_val:
+                args.append(val_arg)
+            if early_stopping:
+                args += [
+                    es_dev["active"], es_dev["best"],
+                    es_dev["wait"], es_dev["last"],
+                ]
+                if monitor_val:
+                    args.append(has_val_dev)
+            if track_best:
+                args += [best_params_dev, ever_dev]
+            final, outs = chunk_fn(*args)
+            params, opt_state = final["params"], final["opt"]
+            if early_stopping:
+                es_dev = final["es"]
+            if track_best:
+                best_params_dev = final["best_params"]
+                ever_dev = final["ever_improved"]
+            dispatch_times.append(time.perf_counter() - chunk_start)
+            n_dispatches += 1
+            epochs_dispatched += k
+
+            if early_stopping:
+                # the ONE host sync per chunk: reported losses, per-epoch
+                # activity, end-of-chunk ES state (and the snapshot flag)
+                # come back in a single transfer
+                fetch = {"loss": outs["loss"], "active": outs["active"],
+                         "es": final["es"]}
+                if with_val:
+                    fetch["val"] = outs["val"]
+                if track_best:
+                    fetch["ever"] = final["ever_improved"]
+                fetched = host_fetch(fetch)
+                n_host_syncs += 1
+                if first_sync_s is None:
+                    first_sync_s = time.perf_counter() - chunk_start
+                    first_sync_epochs = k
+                loss_rep = np.asarray(fetched["loss"], dtype=np.float64)
+                active_out = np.asarray(fetched["active"], dtype=bool)
+                # activity ENTERING each epoch: the chunk-entry state,
+                # then the previous epoch's post-update state
+                active_in = np.concatenate(
+                    [es_state["active"][None, :], active_out[:-1]], axis=0
+                )
+                stopped = ~active_out.any(axis=1)
+                n_rep = int(np.argmax(stopped)) + 1 if stopped.any() else k
+                loss_chunks.append(loss_rep[:n_rep])
+                if with_val:
+                    val_chunks.append(
+                        np.asarray(fetched["val"], dtype=np.float64)[:n_rep]
+                    )
+                if track_best:
+                    ever_improved = bool(fetched["ever"])
+                timesteps_trained += int(
+                    (active_in[:n_rep] * rows_per_machine[None, :]).sum()
+                )
+                epochs_run += n_rep
+                # host mirror of the device ES state (checkpoint extra +
+                # telemetry); when the fleet stopped mid-chunk the mirror
+                # includes the gated no-op tail epochs, but then no
+                # checkpoint is written and only `active` (all False
+                # either way) is read again
+                es_state["best"] = np.asarray(
+                    fetched["es"]["best"], dtype=np.float64
+                )
+                es_state["wait"] = np.asarray(
+                    fetched["es"]["wait"], dtype=np.int64
+                )
+                es_state["active"] = np.asarray(
+                    fetched["es"]["active"], dtype=bool
+                )
+                es_state["last_loss"] = np.asarray(
+                    fetched["es"]["last"], dtype=np.float64
+                )
+                for j in range(n_rep):
+                    emit_event(
+                        "epoch", path="fleet", epoch=e + j,
+                        mean_loss=float(np.mean(loss_rep[j])),
+                        n_active=int(active_out[j].sum()),
+                    )
+                if stopped.any():
+                    early_stop_epoch = e + n_rep - 1
+                    logger.info(
+                        "Fleet early stop: all %d machines stopped at epoch "
+                        "%d/%d (chunked: %d gated no-op epochs discarded)",
+                        m, early_stop_epoch, epochs, k - n_rep,
+                    )
+                    emit_event(
+                        "early_stop", path="fleet",
+                        epoch=early_stop_epoch, n_machines=m,
+                    )
+            else:
+                loss_chunks.append(outs["loss"])
+                if with_val:
+                    val_chunks.append(outs["val"])
+                if first_sync_s is None:
+                    # sync ONCE (a readiness wait, not a transfer) so
+                    # compile+first-chunk cost separates from steady state
+                    jax.block_until_ready(outs["loss"])
+                    first_sync_s = time.perf_counter() - chunk_start
+                    first_sync_epochs = k
+                timesteps_trained += int(rows_per_machine.sum()) * k
+                epochs_run += k
+                for j in range(k):
+                    emit_event("epoch", path="fleet", epoch=e + j)
+
+            if (
+                checkpointer is not None
+                and (e + k) % ce == 0
+                and (early_stop_epoch is None or early_stop_epoch == e + k - 1)
+            ):
+                # chunk boundaries were forced onto the checkpoint cadence
+                # above; a mid-chunk early stop means the per-epoch loop
+                # would have broken before this boundary, so skip it
+                checkpointer.save(
+                    e + k - 1, params, opt_state,
+                    extra=es_state if early_stopping else None,
+                )
+            if early_stop_epoch is not None:
+                break
+            e += k
+
+        if checkpointer is not None:
+            checkpointer.wait()
+        if track_best and ever_improved:
+            params = best_params_dev
+        # the plain fit's ONLY loop sync: the whole (epochs, M) loss/val
+        # history in one transfer
+        pending: dict = {}
+        if loss_chunks and not isinstance(loss_chunks[0], np.ndarray):
+            pending["loss"] = loss_chunks
+        if val_chunks and not isinstance(val_chunks[0], np.ndarray):
+            pending["val"] = val_chunks
+        if pending:
+            fetched = host_fetch(pending)
+            n_host_syncs += 1
+            if "loss" in fetched:
+                loss_chunks = [np.asarray(a) for a in fetched["loss"]]
+            if "val" in fetched:
+                val_chunks = [np.asarray(a) for a in fetched["val"]]
+        if val_chunks:
+            stacked = np.concatenate(val_chunks, axis=0).astype(np.float64)
+            if has_val is not None and not has_val.all():
+                stacked[:, ~has_val] = np.nan
+            self.val_losses_ = stacked
+        if loss_chunks:
+            losses_out = np.concatenate(
+                [np.asarray(a) for a in loss_chunks], axis=0
+            )
+        else:
+            losses_out = np.zeros((0, m))
+        self._record_fit_telemetry(
+            wall_time_s=time.perf_counter() - fit_start,
+            loop_time_s=time.perf_counter() - loop_start,
+            first_sync_s=first_sync_s,
+            first_sync_epochs=first_sync_epochs,
+            epochs_run=epochs_run,
+            epochs_dispatched=epochs_dispatched,
+            epochs_configured=epochs,
+            start_epoch=start_epoch,
+            timesteps_trained=timesteps_trained,
+            n_machines=m,
+            early_stopping=early_stopping,
+            early_stop_epoch=early_stop_epoch,
+            n_stopped=(
+                int((~es_state["active"]).sum()) if early_stopping else 0
+            ),
+            n_dispatches=n_dispatches,
+            n_host_syncs=n_host_syncs,
+            dispatch_times=dispatch_times,
         )
         return params, losses_out
 
@@ -925,8 +1485,10 @@ class FleetTrainer:
         *,
         wall_time_s: float,
         loop_time_s: float,
-        first_epoch_s: Optional[float],
+        first_sync_s: Optional[float],
+        first_sync_epochs: int,
         epochs_run: int,
+        epochs_dispatched: int,
         epochs_configured: int,
         start_epoch: int,
         timesteps_trained: int,
@@ -934,27 +1496,46 @@ class FleetTrainer:
         early_stopping: bool,
         early_stop_epoch: Optional[int],
         n_stopped: int,
+        n_dispatches: int,
+        n_host_syncs: int,
+        dispatch_times: Optional[list] = None,
     ) -> None:
         """
         Derive and publish one fit's telemetry: ``self.fit_telemetry_``
         (the builder copies it into bucket reports), the process metrics
         registry, and a ``fit_finished`` event.
 
-        Compile time is estimated as (first synced epoch) - (steady-state
-        epoch): the first epoch is the only one that pays XLA compilation
-        (per geometry), and all later epochs reuse the program. With a
-        single epoch there is nothing to subtract, so ``compile_time_s``
-        degrades to the first epoch's whole cost (an upper bound).
+        Compile time is estimated as (first synced dispatch unit) -
+        (steady-state cost of that many epochs): the first dispatch — one
+        epoch in the per-epoch loop, one K-epoch chunk under
+        ``epoch_chunk`` — is the only one that pays XLA compilation (per
+        geometry), and all later dispatches reuse the program. When
+        nothing ran after the first unit there is no steady state to
+        subtract, so ``compile_time_s`` degrades to the whole first-unit
+        cost (an upper bound).
+
+        ``dispatch_times`` are the HOST-side seconds spent issuing each
+        dispatch (key derivation + program submission, not the device
+        work): their steady-state mean is ``dispatch_gap_s_mean`` — the
+        per-dispatch host overhead that ``epoch_chunk`` amortizes over K
+        epochs. The first dispatch is excluded (it carries tracing and
+        compile time). ``epochs_per_sync`` is how many epochs each
+        device->host round-trip bought.
         """
         steady = None
-        if epochs_run > 1 and first_epoch_s is not None:
-            steady = max(0.0, (loop_time_s - first_epoch_s) / (epochs_run - 1))
+        if epochs_dispatched > first_sync_epochs and first_sync_s is not None:
+            steady = max(
+                0.0,
+                (loop_time_s - first_sync_s)
+                / (epochs_dispatched - first_sync_epochs),
+            )
         compile_s = None
-        if first_epoch_s is not None:
+        first_epoch_s = first_sync_s if first_sync_epochs == 1 else None
+        if first_sync_s is not None:
             compile_s = (
-                max(0.0, first_epoch_s - steady)
+                max(0.0, first_sync_s - steady * first_sync_epochs)
                 if steady is not None
-                else first_epoch_s
+                else first_sync_s
             )
         throughput = (
             timesteps_trained / loop_time_s if loop_time_s > 0 else None
@@ -964,15 +1545,28 @@ class FleetTrainer:
         steady_throughput = None
         if steady and epochs_run > 0:
             steady_throughput = (timesteps_trained / epochs_run) / steady
+        steady_dispatches = (dispatch_times or [])[1:]
+        dispatch_gap = (
+            sum(steady_dispatches) / len(steady_dispatches)
+            if steady_dispatches
+            else None
+        )
+        dispatch_overhead = sum(dispatch_times or []) or None
+        epochs_per_sync = (
+            epochs_run / n_host_syncs if n_host_syncs else None
+        )
         self.fit_telemetry_ = {
             "path": "fleet",
             "wall_time_s": wall_time_s,
             "epoch_loop_s": loop_time_s,
             "first_epoch_s": first_epoch_s,
+            "first_dispatch_s": first_sync_s,
+            "first_dispatch_epochs": first_sync_epochs,
             "steady_state_epoch_s": steady,
             "compile_time_s": compile_s,
             "epochs_configured": epochs_configured,
             "epochs_run": epochs_run,
+            "epochs_dispatched": epochs_dispatched,
             "resumed_from_epoch": start_epoch if start_epoch else None,
             "n_machines": n_machines,
             "sensor_timesteps_trained": timesteps_trained,
@@ -981,6 +1575,12 @@ class FleetTrainer:
             "early_stopping": early_stopping,
             "early_stop_epoch": early_stop_epoch,
             "n_machines_early_stopped": n_stopped,
+            "epoch_chunk": self.epoch_chunk,
+            "n_dispatches": n_dispatches,
+            "n_host_syncs": n_host_syncs,
+            "epochs_per_sync": epochs_per_sync,
+            "dispatch_overhead_s": dispatch_overhead,
+            "dispatch_gap_s_mean": dispatch_gap,
         }
         reg = get_registry()
         reg.histogram(
@@ -1012,6 +1612,23 @@ class FleetTrainer:
                 "Machines halted by per-machine early stopping",
                 ("path",),
             ).inc(n_stopped, path="fleet")
+        reg.counter(
+            "gordo_train_host_syncs_total",
+            "Device->host synchronizations paid by fits",
+            ("path",),
+        ).inc(n_host_syncs, path="fleet")
+        if epochs_per_sync is not None:
+            reg.gauge(
+                "gordo_train_epochs_per_sync",
+                "Epochs bought per device->host round-trip (last fit)",
+                ("path",),
+            ).set(epochs_per_sync, path="fleet")
+        if dispatch_overhead is not None:
+            reg.histogram(
+                "gordo_train_dispatch_seconds",
+                "Host-side dispatch overhead of one whole fit",
+                ("path",),
+            ).observe(dispatch_overhead, path="fleet")
         emit_event(
             "fit_finished",
             path="fleet",

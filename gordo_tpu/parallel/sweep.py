@@ -45,9 +45,10 @@ class HyperparamSweep:
         share one length (the number of variants). Names must be accepted
         by the underlying optax constructor (e.g. ``learning_rate``,
         ``b1``, ``weight_decay`` for adamw).
-    lookahead, mesh, scan_unroll
+    lookahead, mesh, scan_unroll, epoch_chunk
         Passed through to FleetTrainer — a sweep shards over the mesh's
-        fleet axis like any other fleet.
+        fleet axis like any other fleet, and ``epoch_chunk > 1`` fuses K
+        epochs into one compiled program (one host sync per chunk).
     """
 
     def __init__(
@@ -57,6 +58,7 @@ class HyperparamSweep:
         lookahead: int = 0,
         mesh: Optional[Any] = None,
         scan_unroll: int = 1,
+        epoch_chunk: int = 1,
     ):
         if not grid:
             raise ValueError("grid must name at least one hyperparameter")
@@ -108,6 +110,7 @@ class HyperparamSweep:
             scan_unroll=scan_unroll,
             optimizer=optimizer,
             broadcast_data=True,
+            epoch_chunk=epoch_chunk,
         )
 
     def _inject(self, opt_state: Any) -> Any:
@@ -136,7 +139,18 @@ class HyperparamSweep:
         y = y if y is not None else X.copy()
         # ONE device copy of the data, shared by every variant
         data = StackedData.from_ragged([np.asarray(X)], [np.asarray(y)])
-        keys = self.trainer.machine_keys(self.n_padded, seed=seed)
+        # Every variant trains from the key a STANDALONE single-machine
+        # fit with this seed would use — one shared init/shuffle/dropout
+        # stream, so variants differ ONLY in their hyperparameters and a
+        # sweep trial is exactly "a plain fit at those hyperparameters".
+        # Deriving per-variant keys with split(seed_key, n_variants) broke
+        # that parity (~12% loss drift): threefry's split lays keys out by
+        # the TOTAL count, so variant 0's key — and with it the init and
+        # the shared data's shuffle order — changed with the sweep WIDTH.
+        solo_key = np.asarray(self.trainer.machine_keys(1, seed=seed))[0]
+        keys = np.broadcast_to(
+            solo_key, (self.n_padded,) + solo_key.shape
+        ).copy()
         params = self.trainer.init_params(keys, data.X.shape[-1])
         opt_state = self._inject(self.trainer.init_opt_state(params))
         params, losses = self.trainer.fit(

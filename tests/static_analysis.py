@@ -884,6 +884,38 @@ def check_metric_registrations(tree: ast.Module) -> typing.List[str]:
     return problems
 
 
+def collect_metric_names(tree: ast.Module) -> typing.Set[str]:
+    """
+    Every LITERAL metric name registered through the observability
+    registry's factory methods in this module — the same call sites
+    ``check_metric_registrations`` disciplines. Used by the catalogue
+    sync check (tests/test_static.py): a metric registered in code but
+    absent from docs/observability.md's catalogue is a doc drift, the
+    failure mode that would otherwise let new telemetry (e.g. the
+    epoch-chunk dispatch/sync metrics) ship undocumented.
+    """
+    names: typing.Set[str] = set()
+    for node in ast.walk(tree):
+        if not (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr in METRIC_FACTORY_METHODS
+        ):
+            continue
+        name_node = node.args[0] if node.args else None
+        if name_node is None:
+            name_node = next(
+                (kw.value for kw in node.keywords if kw.arg == "name"), None
+            )
+        if (
+            isinstance(name_node, ast.Constant)
+            and isinstance(name_node.value, str)
+            and METRIC_NAME_RE.match(name_node.value)
+        ):
+            names.add(name_node.value)
+    return names
+
+
 def check_annotated_param_method_calls(tree: ast.Module, module) -> typing.List[str]:
     """
     ``param.method(...)`` calls where ``param`` is annotated with vouched

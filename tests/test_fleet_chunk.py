@@ -1,0 +1,273 @@
+"""
+Device-resident multi-epoch training (``FleetTrainer(epoch_chunk=K)``):
+K epochs fused into ONE compiled program via an outer ``lax.scan``, with
+per-epoch key derivation, validation loss and the early-stopping state
+machine all in-program. Chunking is a SCHEDULING change, so every test
+here pins bit-equality against the per-epoch (``epoch_chunk=1``) loop —
+same loss history, same final params, same stop epochs — plus the host
+sync budget the feature exists to buy: one device->host round-trip per
+chunk under early stopping, and exactly two per fit without it.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+import gordo_tpu.parallel.fleet as fleet_mod
+from gordo_tpu.models.factories.feedforward import feedforward_hourglass
+from gordo_tpu.parallel import FleetTrainer, StackedData, get_device_mesh
+
+F = 3
+
+
+def make_fleet_data(m=3, n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    Xs = [rng.random((n - 5 * i, F)).astype("float32") for i in range(m)]
+    return StackedData.from_ragged(Xs, [x.copy() for x in Xs])
+
+
+def assert_trees_bitequal(a, b):
+    for la, lb in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+
+
+def test_chunked_fit_matches_per_epoch_bitwise():
+    """No-ES fit: epoch_chunk=4 over 6 epochs (a full chunk + a partial
+    tail chunk) must reproduce the per-epoch loop's loss history and
+    final params BIT-exactly."""
+    data = make_fleet_data()
+    spec = feedforward_hourglass(n_features=F)
+
+    t1 = FleetTrainer(spec, donate=False)
+    keys = t1.machine_keys(3)
+    p1, l1 = t1.fit(data, keys, epochs=6, batch_size=16)
+
+    t4 = FleetTrainer(spec, donate=False, epoch_chunk=4)
+    p4, l4 = t4.fit(data, keys, epochs=6, batch_size=16)
+
+    np.testing.assert_array_equal(l1, l4)
+    assert_trees_bitequal(p1, p4)
+
+
+@pytest.mark.parametrize("start_from", [0, 3])
+def test_chunked_early_stopping_parity(start_from):
+    """ES + restore_best_weights + validation_split: the chunked program
+    must stop at the SAME epoch (here mid-chunk — the gated no-op tail
+    epochs are truncated from the history), report identical losses and
+    val losses, and restore identical best params."""
+    data = make_fleet_data()
+    spec = feedforward_hourglass(n_features=F)
+
+    def run(chunk):
+        trainer = FleetTrainer(spec, donate=False, epoch_chunk=chunk)
+        keys = trainer.machine_keys(3)
+        params, losses = trainer.fit(
+            data,
+            keys,
+            epochs=12,
+            batch_size=16,
+            early_stopping_patience=2,
+            early_stopping_min_delta=1e6,  # nothing ever improves enough
+            early_stopping_start_from_epoch=start_from,
+            restore_best_weights=True,
+            validation_split=0.25,
+        )
+        return trainer, params, losses
+
+    tr1, p1, l1 = run(1)
+    tr4, p4, l4 = run(4)
+    # improve@start_from, wait, stop -> start_from + 3 epochs ran, and
+    # with chunk=4 the stop lands MID-chunk for both parametrizations
+    assert l1.shape[0] == start_from + 3
+    np.testing.assert_array_equal(l1, l4)
+    np.testing.assert_array_equal(tr1.val_losses_, tr4.val_losses_)
+    assert_trees_bitequal(p1, p4)
+    assert tr4.fit_telemetry_["early_stop_epoch"] == start_from + 2
+    assert tr1.fit_telemetry_["early_stop_epoch"] == start_from + 2
+
+
+def test_chunked_checkpoint_resume_mid_chunk(tmp_path):
+    """A checkpoint boundary forces a chunk boundary, so checkpoint
+    cadence and resume land on exactly the per-epoch path's epochs: a
+    chunked run interrupted mid-schedule and resumed must finish with
+    the uninterrupted per-epoch run's params and losses, bit-exact."""
+    from gordo_tpu.parallel import FleetCheckpointer
+
+    data = make_fleet_data(m=3, n=64)
+    spec = feedforward_hourglass(n_features=F)
+    t_straight = FleetTrainer(spec, donate=False)
+    keys = t_straight.machine_keys(3)
+    straight_params, straight_losses = t_straight.fit(
+        data, keys, epochs=6, batch_size=16
+    )
+
+    trainer = FleetTrainer(spec, donate=False, epoch_chunk=4)
+    ckpt = FleetCheckpointer(tmp_path / "ckpt", keep=5)
+    # checkpoint_every=2 splits the 4-epoch chunk into 2-epoch chunks;
+    # "preemption" after epoch 3
+    trainer.fit(
+        data, keys, epochs=4, batch_size=16,
+        checkpointer=ckpt, checkpoint_every=2,
+    )
+    assert ckpt.latest_epoch() == 3
+    resumed_params, resumed_losses = trainer.fit(
+        data, keys, epochs=6, batch_size=16,
+        checkpointer=ckpt, checkpoint_every=2,
+    )
+    ckpt.close()
+    assert resumed_losses.shape[0] == 2  # only epochs 4-5 ran
+    np.testing.assert_array_equal(straight_losses[4:], resumed_losses)
+    assert_trees_bitequal(straight_params, resumed_params)
+
+
+def test_chunked_host_sync_budget(monkeypatch):
+    """The regression guard for the feature's whole point: a no-ES fit
+    performs at most 2 device->host syncs REGARDLESS of epoch count (the
+    setup's weight fetch + the end-of-fit history fetch), and an ES fit
+    at most ceil(epochs/K) + 1 (one decision sync per chunk)."""
+    calls = {"n": 0}
+    real = fleet_mod.host_fetch
+
+    def counting(x):
+        calls["n"] += 1
+        return real(x)
+
+    monkeypatch.setattr(fleet_mod, "host_fetch", counting)
+    data = make_fleet_data()
+    spec = feedforward_hourglass(n_features=F)
+
+    trainer = FleetTrainer(spec, donate=False, epoch_chunk=4)
+    keys = trainer.machine_keys(3)
+    trainer.fit(data, keys, epochs=16, batch_size=16)
+    assert calls["n"] <= 2, calls["n"]
+    assert trainer.fit_telemetry_["n_host_syncs"] == calls["n"]
+    assert trainer.fit_telemetry_["epochs_per_sync"] == 16 / calls["n"]
+
+    calls["n"] = 0
+    es_trainer = FleetTrainer(spec, donate=False, epoch_chunk=4)
+    es_trainer.fit(
+        data, keys, epochs=16, batch_size=16,
+        # patience above the budget: nothing stops, all 16 epochs run
+        early_stopping_patience=100, early_stopping_min_delta=0.0,
+    )
+    assert calls["n"] <= 16 // 4 + 1, calls["n"]
+    assert es_trainer.fit_telemetry_["n_host_syncs"] == calls["n"]
+
+
+def test_chunked_over_mesh():
+    """Chunked training under a sharded mesh: bit-parity with the
+    per-epoch mesh path, and params still sharded over the fleet axis."""
+    mesh = get_device_mesh()
+    m_padded = FleetTrainer.pad_fleet_size(5, mesh)
+    rng = np.random.default_rng(1)
+    Xs = [rng.random((80, F)).astype("float32") for _ in range(5)]
+    data = StackedData.from_ragged(
+        Xs, [x.copy() for x in Xs], n_machines_padded=m_padded
+    )
+    spec = feedforward_hourglass(n_features=F)
+
+    t1 = FleetTrainer(spec, mesh=mesh)
+    keys = t1.machine_keys(m_padded)
+    _, l1 = t1.fit(data, keys, epochs=4, batch_size=16)
+    t4 = FleetTrainer(spec, mesh=mesh, epoch_chunk=4)
+    p4, l4 = t4.fit(data, keys, epochs=4, batch_size=16)
+
+    np.testing.assert_array_equal(l1, l4)
+    leaf = jax.tree.leaves(p4)[0]
+    assert len(leaf.sharding.device_set) == 8
+
+
+def test_chunked_sweep_matches_per_epoch():
+    """broadcast_data (sweep) chunking: a chunked HyperparamSweep must
+    reproduce the per-epoch sweep bit-exactly — the one-shared-dataset
+    vmap rides inside the chunk scan like any other fleet."""
+    from gordo_tpu.parallel import HyperparamSweep
+
+    spec = feedforward_hourglass(n_features=4)
+    X = np.random.default_rng(0).random((128, 4)).astype("float32")
+    grid = {"learning_rate": [5e-3, 1e-4]}
+    res1 = HyperparamSweep(spec, grid).fit(X, epochs=6, batch_size=32, seed=7)
+    res3 = HyperparamSweep(spec, grid, epoch_chunk=3).fit(
+        X, epochs=6, batch_size=32, seed=7
+    )
+    np.testing.assert_array_equal(res1.losses, res3.losses)
+    assert_trees_bitequal(res1.params, res3.params)
+
+
+def test_chunked_telemetry_shape():
+    """The new dispatch/sync telemetry: a chunked fit records its chunk
+    size, dispatch count and per-dispatch host overhead, and dispatches
+    strictly fewer programs than the per-epoch loop."""
+    data = make_fleet_data()
+    spec = feedforward_hourglass(n_features=F)
+
+    t1 = FleetTrainer(spec, donate=False)
+    keys = t1.machine_keys(3)
+    t1.fit(data, keys, epochs=8, batch_size=16)
+    t4 = FleetTrainer(spec, donate=False, epoch_chunk=4)
+    t4.fit(data, keys, epochs=8, batch_size=16)
+
+    tel1, tel4 = t1.fit_telemetry_, t4.fit_telemetry_
+    assert tel1["epoch_chunk"] == 1 and tel4["epoch_chunk"] == 4
+    assert tel1["n_dispatches"] == 8 and tel4["n_dispatches"] == 2
+    assert tel4["epochs_dispatched"] == 8
+    # plain fits already synced only at fit end — epochs_per_sync ties;
+    # the chunked SYNC win is on monitored fits (see the budget test).
+    # The dispatch win holds everywhere.
+    assert tel4["epochs_per_sync"] >= tel1["epochs_per_sync"]
+    assert tel4["dispatch_overhead_s"] is not None
+
+    # monitored fits: per-epoch ES syncs every epoch, chunked once per K
+    e1 = FleetTrainer(spec, donate=False)
+    e1.fit(data, keys, epochs=8, batch_size=16,
+           early_stopping_patience=100, early_stopping_min_delta=0.0)
+    e4 = FleetTrainer(spec, donate=False, epoch_chunk=4)
+    e4.fit(data, keys, epochs=8, batch_size=16,
+           early_stopping_patience=100, early_stopping_min_delta=0.0)
+    assert e4.fit_telemetry_["epochs_per_sync"] > e1.fit_telemetry_["epochs_per_sync"]
+    assert e4.fit_telemetry_["n_host_syncs"] < e1.fit_telemetry_["n_host_syncs"]
+    # first dispatch pays compile; the steady-state gap excludes it
+    assert tel4["first_dispatch_s"] is not None
+    assert tel4["first_dispatch_epochs"] == 4
+    for tel in (tel1, tel4):
+        assert tel["n_host_syncs"] >= 1
+        assert tel["steady_state_epoch_s"] is not None
+
+
+def test_fleet_build_epoch_chunk_parity():
+    """Builder plumbing: the SAME machine built with and without epoch
+    chunking must produce an identical training history (chunking is
+    scheduling, not numerics), and the chunk size must reach the bucket
+    fit's telemetry."""
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder, _find_jax_estimator
+    from gordo_tpu.machine import Machine
+
+    def make_machine():
+        return Machine(
+            name="chunk-m0",
+            project_name="p",
+            model={
+                "gordo_tpu.models.AutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "epochs": 3,
+                    "batch_size": 16,
+                }
+            },
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": "2017-12-25 06:00:00Z",
+                "train_end_date": "2017-12-26 06:00:00Z",
+                "tags": [["Tag 1", None], ["Tag 2", None]],
+            },
+        )
+
+    builder_plain = FleetModelBuilder([make_machine()])
+    (model_plain, _), = builder_plain.build()
+    builder_chunked = FleetModelBuilder([make_machine()], epoch_chunk=4)
+    (model_chunked, _), = builder_chunked.build()
+
+    loss_plain = _find_jax_estimator(model_plain).history_["loss"]
+    loss_chunked = _find_jax_estimator(model_chunked).history_["loss"]
+    np.testing.assert_array_equal(loss_plain, loss_chunked)
+    fit_tel = builder_chunked.telemetry_report_["buckets"][0]["fit"]
+    assert fit_tel["epoch_chunk"] == 4

@@ -314,6 +314,28 @@ def test_metric_registrations_disciplined():
     assert not problems, f"undisciplined metric registrations: {problems}"
 
 
+def test_metric_names_documented():
+    """Every literal metric the package registers through the
+    observability registry must appear in docs/observability.md's
+    catalogue — registering telemetry nobody can find (the epoch-chunk
+    dispatch/sync metrics being the newest additions) is how internal
+    numbers go unread."""
+    from static_analysis import collect_metric_names
+
+    registered: set = set()
+    for name, module in _importable_modules():
+        registered |= collect_metric_names(parse(module.__file__))
+    assert registered, "no metric registrations found — collector broken?"
+    docs = (
+        Path(gordo_tpu.__file__).parent.parent / "docs" / "observability.md"
+    ).read_text()
+    undocumented = sorted(m for m in registered if m not in docs)
+    assert not undocumented, (
+        f"metrics registered in code but missing from "
+        f"docs/observability.md: {undocumented}"
+    )
+
+
 def test_metric_registration_check_catches_violations():
     import ast as _ast
 
