@@ -13,12 +13,17 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test bench images push
+.PHONY: all test lint bench images push
 
-all: test
+all: lint test
 
 test:
 	python -m pytest tests/ -q
+
+# the gordo_tpu.analysis static/JAX-discipline checker; exit code is the
+# finding count, so a dirty tree fails the target (docs/static_analysis.md)
+lint:
+	python -m gordo_tpu.cli lint gordo_tpu tests benchmarks
 
 bench:
 	python bench.py

@@ -1,0 +1,108 @@
+"""
+Static analysis as a subsystem (``gordo_tpu.analysis``).
+
+The fleet's correctness and perf story hinges on invariants no Python
+type checker sees — re-traced jitted closures, per-iteration host syncs,
+correlated PRNG streams (PR 2 shipped one of each class). This package
+is the mechanical enforcement: the vendored zero-dependency AST checker
+(grown from ``tests/static_analysis.py``, which remains as a re-export
+shim) promoted to a first-class registry of checks with a CLI
+(``gordo-tpu lint``), inline suppressions, and a committed baseline.
+
+Layout:
+
+- ``checks.py``      the general family: imports, attributes, call
+                     signatures, annotations, metric registrations,
+                     plus the docs-catalogue collectors
+                     (``collect_metric_names``/``collect_event_names``)
+- ``jax_checks.py``  the JAX-discipline family: retrace-risk,
+                     host-sync, prng-reuse, prng-split-width,
+                     traced-branch
+- ``registry.py``    one CheckSpec per check (name, doc, severity,
+                     fixer hint, scope)
+- ``engine.py``      file discovery, dispatch, suppressions, baseline
+
+See docs/static_analysis.md for the full catalogue and CLI usage.
+"""
+
+from gordo_tpu.analysis.checks import (
+    ALLOWED_METRIC_LABELS,
+    METRIC_FACTORY_METHODS,
+    METRIC_NAME_RE,
+    check_annotated_attributes,
+    check_annotated_param_method_calls,
+    check_call_signatures,
+    check_metric_registrations,
+    check_module_attributes,
+    check_module_shadowing,
+    check_return_annotations,
+    check_self_attributes,
+    check_self_method_calls,
+    check_unused_imports,
+    collect_event_names,
+    collect_metric_names,
+    parse,
+)
+from gordo_tpu.analysis.engine import (
+    BASELINE_FILENAME,
+    Finding,
+    LintResult,
+    iter_python_files,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+from gordo_tpu.analysis.jax_checks import (
+    HOT_PATH_PATTERNS,
+    check_host_sync,
+    check_prng_key_reuse,
+    check_prng_split_width,
+    check_retrace_risk,
+    check_traced_branching,
+)
+from gordo_tpu.analysis.registry import (
+    CHECKS,
+    CHECKS_BY_NAME,
+    JAX_CHECK_NAMES,
+    CheckSpec,
+    get_check,
+)
+
+__all__ = [
+    "ALLOWED_METRIC_LABELS",
+    "BASELINE_FILENAME",
+    "CHECKS",
+    "CHECKS_BY_NAME",
+    "CheckSpec",
+    "Finding",
+    "HOT_PATH_PATTERNS",
+    "JAX_CHECK_NAMES",
+    "LintResult",
+    "METRIC_FACTORY_METHODS",
+    "METRIC_NAME_RE",
+    "check_annotated_attributes",
+    "check_annotated_param_method_calls",
+    "check_call_signatures",
+    "check_host_sync",
+    "check_metric_registrations",
+    "check_module_attributes",
+    "check_module_shadowing",
+    "check_prng_key_reuse",
+    "check_prng_split_width",
+    "check_retrace_risk",
+    "check_return_annotations",
+    "check_self_attributes",
+    "check_self_method_calls",
+    "check_traced_branching",
+    "check_unused_imports",
+    "collect_event_names",
+    "collect_metric_names",
+    "get_check",
+    "iter_python_files",
+    "lint_file",
+    "lint_paths",
+    "load_baseline",
+    "parse",
+    "write_baseline",
+]

@@ -1,0 +1,346 @@
+"""
+The lint engine: file discovery, check dispatch, inline suppressions and
+the committed baseline — the machinery behind ``gordo-tpu lint`` and the
+tier-1 parametrization in tests/test_static.py.
+
+Suppressions
+------------
+A finding is suppressed by a ``# lint: disable=<check>[,<check>...]``
+comment on the flagged line or the line directly above it (multi-line
+statements report their first line, where the comment rarely fits)::
+
+    jax.block_until_ready(loss)  # lint: disable=host-sync
+
+Suppressions are for *intentional* violations whose justification lives
+in the adjacent code comment. Grandfathered findings belong in the
+baseline instead.
+
+Baseline
+--------
+``lint_baseline.json`` (committed at the repo root) grandfathers known
+findings so the linter can gate new code at zero findings immediately.
+Every entry MUST carry a non-empty one-line ``justification`` — a
+baseline without reasons is just a mute button::
+
+    {"version": 1, "entries": [
+      {"check": "host-sync", "path": "gordo_tpu/parallel/x.py",
+       "match": "float(loss)",
+       "justification": "legacy per-epoch path; removal tracked in ROADMAP"}
+    ]}
+
+``match`` is a substring of the finding message (line numbers are NOT
+part of the match, so unrelated edits to the file do not invalidate the
+entry).
+"""
+
+import ast
+import dataclasses
+import importlib
+import json
+import re
+import typing
+from pathlib import Path
+
+from gordo_tpu.analysis import jax_checks
+from gordo_tpu.analysis.registry import CHECKS, CheckSpec, get_check
+
+#: directories never linted: bytecode, and the lint fixture corpus whose
+#: files are deliberate violations (they are exercised by tests/test_lint.py,
+#: the way flake8 excludes its own test corpora)
+DEFAULT_EXCLUDES = ("__pycache__", "lint_fixtures")
+
+#: default baseline location, relative to the working directory
+BASELINE_FILENAME = "lint_baseline.json"
+
+_LINE_RE = re.compile(r"^line (\d+):\s*(.*)$", re.DOTALL)
+_SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    check: str
+    severity: str
+    path: str  # POSIX, relative to the lint root where possible
+    line: int
+    message: str
+    fixer: str
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: [{self.check}] {self.message}"
+            f"\n    fix: {self.fixer}"
+        )
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class LintResult:
+    findings: typing.List[Finding]
+    n_files: int = 0
+    n_suppressed: int = 0
+    n_baselined: int = 0
+
+    @property
+    def exit_code(self) -> int:
+        # the CLI contract: exit code == finding count (shells see 8-bit
+        # codes, so cap below the reserved 126+ range)
+        return min(len(self.findings), 125)
+
+    def to_json(self) -> dict:
+        return {
+            "version": 1,
+            "counts": {
+                "files": self.n_files,
+                "findings": len(self.findings),
+                "suppressed": self.n_suppressed,
+                "baselined": self.n_baselined,
+            },
+            "findings": [f.to_dict() for f in self.findings],
+        }
+
+
+def iter_python_files(
+    paths: typing.Sequence[typing.Union[str, Path]],
+    exclude: typing.Sequence[str] = DEFAULT_EXCLUDES,
+) -> typing.List[Path]:
+    out: typing.List[Path] = []
+    seen: typing.Set[Path] = set()
+    for raw in paths:
+        path = Path(raw)
+        candidates = (
+            [path] if path.is_file() else sorted(path.rglob("*.py"))
+        )
+        for candidate in candidates:
+            if candidate.suffix != ".py":
+                continue
+            if any(token in candidate.parts for token in exclude):
+                continue
+            resolved = candidate.resolve()
+            if resolved in seen:
+                continue
+            seen.add(resolved)
+            out.append(candidate)
+    return out
+
+
+def _relpath(path: Path) -> str:
+    try:
+        return path.resolve().relative_to(Path.cwd()).as_posix()
+    except ValueError:
+        return path.as_posix()
+
+
+def is_hot_path(path: typing.Union[str, Path]) -> bool:
+    """Hot-tagged modules (training/serving inner loops): host-sync
+    findings only fire here."""
+    posix = Path(path).resolve().as_posix()
+    return any(pattern in posix for pattern in jax_checks.HOT_PATH_PATTERNS)
+
+
+def module_for_path(path: Path):
+    """The live module for a package file (semantic checks resolve
+    against runtime objects), or None when the file is not an importable
+    package module — then only syntactic checks run. Mirrors
+    tests/test_static.py: import *failures* are that suite's concern,
+    not the linter's."""
+    import gordo_tpu
+
+    package_parent = Path(gordo_tpu.__file__).parent.parent.resolve()
+    try:
+        rel = path.resolve().relative_to(package_parent)
+    except ValueError:
+        return None
+    if rel.parts[0] != "gordo_tpu":
+        return None
+    name = ".".join(rel.with_suffix("").parts)
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    try:
+        return importlib.import_module(name)
+    except Exception:
+        return None
+
+
+def parse_suppressions(source: str) -> typing.Dict[int, typing.Set[str]]:
+    """line number (1-based) -> check names disabled on that line."""
+    out: typing.Dict[int, typing.Set[str]] = {}
+    for lineno, line in enumerate(source.splitlines(), start=1):
+        match = _SUPPRESS_RE.search(line)
+        if match:
+            names = {
+                name.strip()
+                for name in match.group(1).split(",")
+                if name.strip()
+            }
+            out[lineno] = names
+    return out
+
+
+def _suppressed(
+    finding: Finding, suppressions: typing.Dict[int, typing.Set[str]]
+) -> bool:
+    for lineno in (finding.line, finding.line - 1):
+        names = suppressions.get(lineno)
+        if names and (finding.check in names or "all" in names):
+            return True
+    return False
+
+
+# --------------------------------------------------------------------------
+# baseline
+# --------------------------------------------------------------------------
+
+
+class BaselineError(ValueError):
+    """A malformed baseline file — including entries with no
+    justification, which are not allowed to exist."""
+
+
+def load_baseline(path: typing.Union[str, Path]) -> typing.List[dict]:
+    raw = json.loads(Path(path).read_text())
+    if not isinstance(raw, dict) or "entries" not in raw:
+        raise BaselineError(
+            f"{path}: baseline must be an object with an 'entries' list"
+        )
+    entries = raw["entries"]
+    for i, entry in enumerate(entries):
+        missing = {"check", "path", "match"} - set(entry)
+        if missing:
+            raise BaselineError(
+                f"{path}: entry {i} is missing {sorted(missing)}"
+            )
+        if not str(entry.get("justification", "")).strip():
+            raise BaselineError(
+                f"{path}: entry {i} ({entry['check']} in {entry['path']}) "
+                f"has no justification — every grandfathered finding must "
+                f"say why it is allowed to stay"
+            )
+    return entries
+
+
+def write_baseline(
+    findings: typing.Sequence[Finding],
+    path: typing.Union[str, Path],
+    justification: str = "grandfathered at baseline creation — REVIEW ME",
+) -> None:
+    """Serialize findings as a baseline skeleton. The placeholder
+    justification deliberately fails review culture, not the loader —
+    replace it per entry with the actual reason."""
+    payload = {
+        "version": 1,
+        "entries": [
+            {
+                "check": f.check,
+                "path": f.path,
+                "match": f.message,
+                "justification": justification,
+            }
+            for f in findings
+        ],
+    }
+    Path(path).write_text(json.dumps(payload, indent=2) + "\n")
+
+
+def _baselined(finding: Finding, entries: typing.List[dict]) -> bool:
+    return any(
+        entry["check"] == finding.check
+        and entry["path"] == finding.path
+        and entry["match"] in finding.message
+        for entry in entries
+    )
+
+
+# --------------------------------------------------------------------------
+# the runner
+# --------------------------------------------------------------------------
+
+
+def _selected_checks(
+    select: typing.Optional[typing.Sequence[str]],
+) -> typing.List[CheckSpec]:
+    if not select:
+        return list(CHECKS)
+    return [get_check(name) for name in select]
+
+
+def lint_file(
+    path: typing.Union[str, Path],
+    select: typing.Optional[typing.Sequence[str]] = None,
+) -> typing.Tuple[typing.List[Finding], int]:
+    """(unsuppressed findings, raw finding count) for one file."""
+    path = Path(path)
+    source = path.read_text()
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as exc:
+        finding = Finding(
+            check="syntax",
+            severity="error",
+            path=_relpath(path),
+            line=exc.lineno or 0,
+            message=f"file does not parse: {exc.msg}",
+            fixer="fix the syntax error",
+        )
+        return [finding], 1
+    suppressions = parse_suppressions(source)
+    hot = is_hot_path(path)
+    module = None
+    module_resolved = False
+    relpath = _relpath(path)
+    findings: typing.List[Finding] = []
+    for spec in _selected_checks(select):
+        if spec.hot_only and not hot:
+            continue
+        if spec.skip_init and path.name == "__init__.py":
+            continue
+        if spec.scope == "semantic":
+            if not module_resolved:
+                module = module_for_path(path)
+                module_resolved = True
+            if module is None:
+                continue
+        for raw in spec.run(tree, source, module):
+            match = _LINE_RE.match(raw)
+            line = int(match.group(1)) if match else 0
+            message = match.group(2) if match else raw
+            findings.append(
+                Finding(
+                    check=spec.name,
+                    severity=spec.severity,
+                    path=relpath,
+                    line=line,
+                    message=message,
+                    fixer=spec.fixer,
+                )
+            )
+    return [f for f in findings if not _suppressed(f, suppressions)], len(
+        findings
+    )
+
+
+def lint_paths(
+    paths: typing.Sequence[typing.Union[str, Path]],
+    select: typing.Optional[typing.Sequence[str]] = None,
+    baseline: typing.Optional[typing.Union[str, Path]] = None,
+    exclude: typing.Sequence[str] = DEFAULT_EXCLUDES,
+) -> LintResult:
+    """
+    Lint every .py file under ``paths``. ``select`` restricts to the
+    named checks; ``baseline`` (a path, or None) filters grandfathered
+    findings. Findings come back sorted by (path, line).
+    """
+    entries = load_baseline(baseline) if baseline else []
+    files = iter_python_files(paths, exclude=exclude)
+    result = LintResult(findings=[], n_files=len(files))
+    for path in files:
+        kept, raw_count = lint_file(path, select=select)
+        result.n_suppressed += raw_count - len(kept)
+        for finding in kept:
+            if entries and _baselined(finding, entries):
+                result.n_baselined += 1
+            else:
+                result.findings.append(finding)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.check))
+    return result

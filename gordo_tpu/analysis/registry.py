@@ -1,0 +1,207 @@
+"""
+The check registry: one :class:`CheckSpec` per check — name, one-line
+doc, severity, fixer hint, and how to run it. The registry is the single
+source the engine (engine.py), the ``gordo-tpu lint`` CLI, the docs
+catalogue (docs/static_analysis.md) and the suppression syntax
+(``# lint: disable=<name>``) all key on.
+
+Scopes:
+
+- ``syntactic``  AST + source only; runs on ANY .py file (tests and
+                 benchmarks included).
+- ``semantic``   needs the live imported module (the annotation/
+                 signature checks resolve against runtime objects);
+                 runs only on files the engine can import — package
+                 modules — and is skipped elsewhere.
+
+``hot_only`` checks fire only on modules tagged hot
+(``jax_checks.HOT_PATH_PATTERNS``): the training/serving inner loops
+where a per-iteration host sync is a fleet-wide regression, not a
+style nit.
+"""
+
+import dataclasses
+import typing
+
+from gordo_tpu.analysis import checks, jax_checks
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckSpec:
+    name: str  # the id suppressions and the baseline use
+    doc: str
+    severity: str  # "error" | "warning"
+    fixer: str  # one-line hint shown with each finding
+    scope: str  # "syntactic" | "semantic"
+    run: typing.Callable  # (tree, source, module) -> List[str]
+    hot_only: bool = False
+    skip_init: bool = False  # __init__.py re-export surfaces exempt
+
+
+def _syntactic(fn):
+    return lambda tree, source, module: fn(tree)
+
+
+def _with_source(fn):
+    return lambda tree, source, module: fn(tree, source)
+
+
+def _semantic(fn):
+    return lambda tree, source, module: fn(tree, module)
+
+
+CHECKS: typing.Tuple[CheckSpec, ...] = (
+    # -- the general family (grown from tests/static_analysis.py) --------
+    CheckSpec(
+        name="unused-import",
+        doc="imports whose bound name never appears again in the source",
+        severity="error",
+        fixer="delete the import (or prefix with _ for a side-effect import)",
+        scope="syntactic",
+        run=_with_source(checks.check_unused_imports),
+        skip_init=True,
+    ),
+    CheckSpec(
+        name="module-attr",
+        doc="module.attr expressions whose attribute cannot resolve",
+        severity="error",
+        fixer="fix the attribute name (or the import it resolves through)",
+        scope="semantic",
+        run=_semantic(checks.check_module_attributes),
+    ),
+    CheckSpec(
+        name="call-signature",
+        doc="statically-resolvable calls with wrong arity or unknown kwargs",
+        severity="error",
+        fixer="match the call to the target's signature",
+        scope="semantic",
+        run=_semantic(checks.check_call_signatures),
+    ),
+    CheckSpec(
+        name="module-shadowing",
+        doc="a plain `import X` coexisting with another binding of X",
+        severity="error",
+        fixer="rename one binding; every X.attr in the module is ambiguous",
+        scope="syntactic",
+        run=_syntactic(checks.check_module_shadowing),
+    ),
+    CheckSpec(
+        name="annotated-attr",
+        doc="param.attr reads missing from the parameter's annotated class",
+        severity="error",
+        fixer="fix the attribute (or the annotation that vouches for it)",
+        scope="semantic",
+        run=_semantic(checks.check_annotated_attributes),
+    ),
+    CheckSpec(
+        name="return-annotation",
+        doc="bare return under -> X, or returning a value under -> None",
+        severity="error",
+        fixer="align the return statements with the annotation",
+        scope="semantic",
+        run=_semantic(checks.check_return_annotations),
+    ),
+    CheckSpec(
+        name="self-attr",
+        doc="self.attr reads missing from the class's attribute surface",
+        severity="error",
+        fixer="fix the attribute name (or define it in __init__)",
+        scope="semantic",
+        run=_semantic(checks.check_self_attributes),
+    ),
+    CheckSpec(
+        name="self-method-call",
+        doc="self.method(...) calls that do not bind to the class signature",
+        severity="error",
+        fixer="match the call to the method's signature",
+        scope="semantic",
+        run=_semantic(checks.check_self_method_calls),
+    ),
+    CheckSpec(
+        name="annotated-method-call",
+        doc="param.method(...) calls that do not bind to the annotated class",
+        severity="error",
+        fixer="match the call to the annotated class's method signature",
+        scope="semantic",
+        run=_semantic(checks.check_annotated_param_method_calls),
+    ),
+    CheckSpec(
+        name="metric-registration",
+        doc="metric names/labels outside the documented observability set",
+        severity="error",
+        fixer="use a literal gordo_-prefixed name and documented label names",
+        scope="syntactic",
+        run=_syntactic(checks.check_metric_registrations),
+    ),
+    # -- the JAX-discipline family (jax_checks.py) -----------------------
+    CheckSpec(
+        name="retrace-risk",
+        doc="jax.jit of a local closure whose handle never escapes: "
+        "re-traced on every call of the enclosing function",
+        severity="warning",
+        fixer="hoist to a module-level @jax.jit or cache the handle on "
+        "the instance (the PR-2 _keep_better fix)",
+        scope="syntactic",
+        run=_syntactic(jax_checks.check_retrace_risk),
+    ),
+    CheckSpec(
+        name="host-sync",
+        doc="device->host sync primitives inside a hot-module loop body",
+        severity="warning",
+        fixer="batch the fetch after the loop, or route it through the "
+        "accounted host_fetch sync point",
+        scope="syntactic",
+        run=_syntactic(jax_checks.check_host_sync),
+        hot_only=True,
+    ),
+    CheckSpec(
+        name="prng-reuse",
+        doc="a PRNG key consumed >= 2 times without split/fold_in between",
+        severity="warning",
+        fixer="split or fold_in before each consumer (or suppress where "
+        "stream sharing is the documented intent)",
+        scope="syntactic",
+        run=_syntactic(jax_checks.check_prng_key_reuse),
+    ),
+    CheckSpec(
+        name="prng-split-width",
+        doc="indexing into split(key, <non-constant>): stream i depends "
+        "on the split width",
+        severity="warning",
+        fixer="derive per-variant keys with fold_in, or share the "
+        "width-independent solo key (the PR-2 sweep fix)",
+        scope="syntactic",
+        run=_syntactic(jax_checks.check_prng_split_width),
+    ),
+    CheckSpec(
+        name="traced-branch",
+        doc="Python if/while on a value derived from jitted-function "
+        "parameters inside the traced scope",
+        severity="error",
+        fixer="use jnp.where / lax.cond / lax.while_loop (or declare the "
+        "argument static)",
+        scope="syntactic",
+        run=_syntactic(jax_checks.check_traced_branching),
+    ),
+)
+
+CHECKS_BY_NAME: typing.Dict[str, CheckSpec] = {c.name: c for c in CHECKS}
+
+#: the new family, exposed for the tier-1 parametrization in
+#: tests/test_static.py (the general family already runs there check by
+#: check)
+JAX_CHECK_NAMES: typing.Tuple[str, ...] = (
+    "retrace-risk",
+    "host-sync",
+    "prng-reuse",
+    "prng-split-width",
+    "traced-branch",
+)
+
+
+def get_check(name: str) -> CheckSpec:
+    try:
+        return CHECKS_BY_NAME[name]
+    except KeyError:
+        known = ", ".join(sorted(CHECKS_BY_NAME))
+        raise KeyError(f"unknown check {name!r}; known checks: {known}")

@@ -1,0 +1,145 @@
+"""
+``gordo-tpu lint`` — the JAX-discipline and static-health linter
+(gordo_tpu/analysis) as a CLI.
+
+Exit code is the FINDING COUNT (0 == clean; capped at 125 so shell
+conventions for signals/not-found stay unambiguous), which makes the
+command directly usable as a gate::
+
+    gordo-tpu lint gordo_tpu tests benchmarks
+    gordo-tpu lint --format json gordo_tpu | jq '.counts'
+    gordo-tpu lint --select retrace-risk --select host-sync gordo_tpu
+
+A committed ``lint_baseline.json`` (repo root, or ``--baseline PATH``)
+grandfathers old findings — each entry must carry a one-line
+justification. ``--write-baseline`` snapshots the current findings into
+a baseline skeleton to grandfather a legacy tree.
+"""
+
+import json
+import sys
+
+import click
+
+
+@click.command("lint")
+@click.argument("paths", nargs=-1, type=click.Path(exists=True))
+@click.option(
+    "--format",
+    "output_format",
+    type=click.Choice(["text", "json"]),
+    default="text",
+    show_default=True,
+    help="Human-readable findings, or a machine-readable JSON report "
+    "(schema: {version, counts{files,findings,suppressed,baselined}, "
+    "findings[{check,severity,path,line,message,fixer}]}).",
+)
+@click.option(
+    "--baseline",
+    "baseline_path",
+    type=click.Path(exists=True, dir_okay=False),
+    default=None,
+    help="Baseline file of grandfathered findings (default: "
+    "lint_baseline.json in the working directory, when present).",
+)
+@click.option(
+    "--no-baseline",
+    is_flag=True,
+    help="Ignore any baseline file: report every finding.",
+)
+@click.option(
+    "--select",
+    "selected",
+    multiple=True,
+    metavar="CHECK",
+    help="Run only the named check(s); repeatable. See --list-checks.",
+)
+@click.option(
+    "--list-checks",
+    is_flag=True,
+    help="List every registered check (name, severity, scope, doc) and exit.",
+)
+@click.option(
+    "--write-baseline",
+    "write_baseline_path",
+    type=click.Path(dir_okay=False, writable=True),
+    default=None,
+    help="Write the current findings to PATH as a baseline skeleton "
+    "(justifications are placeholders to fill in) and exit 0.",
+)
+def lint_cli(
+    paths,
+    output_format,
+    baseline_path,
+    no_baseline,
+    selected,
+    list_checks,
+    write_baseline_path,
+):
+    """
+    Run the gordo_tpu.analysis checks over PATHS (files or directories;
+    default: the gordo_tpu package). Exit code == number of findings.
+
+    The general family (imports, attributes, signatures, annotations,
+    metric registrations) guards Python health; the JAX family
+    (retrace-risk, host-sync, prng-reuse, prng-split-width,
+    traced-branch) guards the invariants that cost fleets real
+    throughput — see docs/static_analysis.md for the catalogue,
+    suppression syntax, and baseline format.
+    """
+    from pathlib import Path
+
+    from gordo_tpu.analysis import CHECKS, engine, lint_paths, write_baseline
+
+    if list_checks:
+        for spec in CHECKS:
+            hot = " [hot modules only]" if spec.hot_only else ""
+            click.echo(
+                f"{spec.name:22s} {spec.severity:7s} {spec.scope:9s} "
+                f"{spec.doc}{hot}"
+            )
+        return 0
+
+    if not paths:
+        paths = ("gordo_tpu",)
+
+    baseline = baseline_path
+    if baseline is None and not no_baseline:
+        default = Path(engine.BASELINE_FILENAME)
+        if default.is_file():
+            baseline = str(default)
+    if no_baseline:
+        baseline = None
+    if write_baseline_path:
+        # snapshot EVERY current finding: filtering through the old
+        # baseline first would silently drop its grandfathered entries
+        # from the rewritten file
+        baseline = None
+
+    try:
+        result = lint_paths(paths, select=selected or None, baseline=baseline)
+    except KeyError as exc:  # unknown --select name
+        raise click.BadParameter(str(exc.args[0]))
+    except engine.BaselineError as exc:
+        raise click.ClickException(str(exc))
+
+    if write_baseline_path:
+        write_baseline(result.findings, write_baseline_path)
+        click.echo(
+            f"wrote {len(result.findings)} finding(s) to "
+            f"{write_baseline_path} — fill in each entry's justification"
+        )
+        return 0
+
+    if output_format == "json":
+        click.echo(json.dumps(result.to_json(), indent=2))
+    else:
+        for finding in result.findings:
+            click.echo(finding.render())
+        tail = (
+            f"{result.n_files} file(s): {len(result.findings)} finding(s)"
+            f", {result.n_suppressed} suppressed"
+            f", {result.n_baselined} baselined"
+        )
+        click.echo(tail)
+    sys.exit(result.exit_code)

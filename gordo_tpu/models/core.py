@@ -386,10 +386,14 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
             params, opt_state, epoch_loss = train_epoch_jit(
                 params, opt_state, epoch_key, Xd, yd, ids_d, w_d
             )
-            losses.append(float(epoch_loss))
+            # the solo path syncs per epoch BY CONTRACT: the Keras-style
+            # callback protocol below consumes host floats every epoch
+            # (early stopping, checkpoints). The fleet path is the one
+            # that amortizes syncs (FleetTrainer epoch_chunk).
+            losses.append(float(epoch_loss))  # lint: disable=host-sync
             logs = {"loss": losses[-1]}
             if n_val:
-                val_losses.append(float(val_loss_jit(params, Xd, yd)))
+                val_losses.append(float(val_loss_jit(params, Xd, yd)))  # lint: disable=host-sync
                 logs["val_loss"] = val_losses[-1]
             # every callback sees every epoch (no short-circuit): a stop
             # vote from one must not hide this epoch's metrics from others

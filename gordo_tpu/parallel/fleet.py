@@ -1026,7 +1026,9 @@ class FleetTrainer:
                 else rows_per_machine.sum()
             )
             if first_epoch_s is None:
-                jax.block_until_ready(epoch_loss)
+                # guarded to run ONCE per fit (compile-cost telemetry),
+                # not per iteration — the sync budget accounts for it
+                jax.block_until_ready(epoch_loss)  # lint: disable=host-sync
                 first_epoch_s = time.perf_counter() - epoch_start
             if val_fn is not None:
                 val_losses.append(val_fn(params, X_arg, y_arg, val_arg))
@@ -1405,7 +1407,7 @@ class FleetTrainer:
                 if first_sync_s is None:
                     # sync ONCE (a readiness wait, not a transfer) so
                     # compile+first-chunk cost separates from steady state
-                    jax.block_until_ready(outs["loss"])
+                    jax.block_until_ready(outs["loss"])  # lint: disable=host-sync
                     first_sync_s = time.perf_counter() - chunk_start
                     first_sync_epochs = k
                 timesteps_trained += int(rows_per_machine.sum()) * k

@@ -15,6 +15,18 @@ for _ in $(seq "$WAIT_SECONDS"); do
 done
 [ -d "$MOUNT_ROOT" ] || { echo "mount $MOUNT_ROOT never appeared" >&2; exit 1; }
 
+# Static gate: the image must not ship code the JAX-discipline linter
+# rejects (a re-traced closure or per-epoch host sync in the builder
+# costs every pod of the fleet). GORDO_SKIP_LINT=1 opts out for
+# emergency rebuilds; findings print either way.
+if [ "${GORDO_SKIP_LINT:-0}" != "1" ]; then
+    python -m gordo_tpu.cli lint gordo_tpu || {
+        echo "gordo-tpu lint found $? problem(s); fix, suppress with a" \
+             "justifying comment, or set GORDO_SKIP_LINT=1" >&2
+        exit 1
+    }
+fi
+
 if [ -n "${MACHINES:-}" ]; then
     exec python -m gordo_tpu.cli build-fleet
 else

@@ -1,0 +1,27 @@
+"""NEGATIVE (near-miss) fixture for host-sync: host conversions that are
+free (host data, device-side jnp), syncs outside loops, and the
+sanctioned accounted sync point (host_fetch)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def host_fetch(x):
+    return jax.device_get(x)  # sanctioned: outside any loop
+
+
+step_fn = jax.jit(lambda p, x: (p, (p * x).sum()))
+
+
+def train(params, batches, es_state):
+    device_losses = []
+    for batch in batches:
+        params, loss = step_fn(params, batch)
+        device_losses.append(loss)  # stays on device
+        active = jnp.asarray(es_state["active"])  # host->device: free
+        report = np.asarray(host_fetch(loss))  # ONE accounted sync
+        mean = float(np.mean(report))  # host math on host data
+        es_state["mean"] = mean + float(active.shape[0])  # static shape
+    # the one batched sync, after the loop
+    return params, [float(x) for x in jax.device_get(device_losses)]

@@ -1,0 +1,22 @@
+"""POSITIVE fixture for prng-reuse: the same key feeding two consumers
+(correlated streams), and a loop drawing the same stream every
+iteration."""
+
+import jax
+import jax.numpy as jnp
+
+
+def init_twice(seed, shape):
+    key = jax.random.PRNGKey(seed)
+    w = jax.random.normal(key, shape)
+    b = jax.random.uniform(key, shape)  # same stream as w: correlated
+    return w, b
+
+
+def shuffle_every_epoch(data, seed, epochs):
+    key = jax.random.PRNGKey(seed)
+    out = []
+    for _ in range(epochs):
+        # no split/fold_in: every epoch shuffles identically
+        out.append(jax.random.permutation(key, data))
+    return jnp.stack(out)

@@ -1,0 +1,34 @@
+"""NEGATIVE (near-miss) fixture for prng-reuse: the split/fold_in
+discipline the check must accept, plus the dict-``key`` red herring."""
+
+import jax
+import jax.numpy as jnp
+
+
+def init_once(seed, shape):
+    key = jax.random.PRNGKey(seed)
+    key, w_key = jax.random.split(key)
+    w = jax.random.normal(w_key, shape)
+    key, b_key = jax.random.split(key)
+    b = jax.random.uniform(b_key, shape)
+    return w, b
+
+
+def shuffle_per_epoch(data, key, epochs):
+    out = []
+    for epoch in range(epochs):
+        epoch_key = jax.random.fold_in(key, epoch)  # fresh stream
+        out.append(jax.random.permutation(epoch_key, data))
+    return jnp.stack(out)
+
+
+def fleet_epoch_keys(keys, epoch):
+    # vmapped fold_in derives; it does not consume the key block
+    return jax.vmap(lambda k: jax.random.fold_in(k, epoch))(keys)
+
+
+def dict_keys_are_not_prng_keys(mapping):
+    total = 0
+    for key, value in mapping.items():
+        total += len(str(key)) + hash(key)  # consumed twice, harmless
+    return total

@@ -1,0 +1,18 @@
+"""POSITIVE fixture for prng-split-width: the PR-2 sweep bug,
+reconstructed. Per-variant keys come from ``split(key, n_variants)`` and
+are INDEXED — threefry lays keys out by the TOTAL count, so variant 0's
+init/shuffle stream silently changes with the sweep width."""
+
+import jax
+
+
+def sweep_variant_keys(seed, n_variants):
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, n_variants)  # width = sweep width
+    # variant 0's stream now depends on how many variants ride along
+    variant0 = keys[0]
+    return variant0, [keys[i] for i in range(n_variants)]
+
+
+def direct_index(key, n):
+    return jax.random.split(key, n)[0]  # same bug, inline

@@ -1,0 +1,26 @@
+"""NEGATIVE (near-miss) fixture for prng-split-width: constant widths
+may be indexed (the layout cannot drift), and non-constant widths used
+WHOLESALE (the fleet's key block) are exactly what split is for."""
+
+import jax
+
+
+def second_subkey(seed):
+    # constant width: layout is pinned, indexing is safe
+    return jax.random.split(jax.random.PRNGKey(seed))[1]
+
+
+def machine_keys(seed, n_machines):
+    # width-dependent, but consumed wholesale by the vmapped program:
+    # no single machine's stream is singled out by index
+    return jax.random.split(jax.random.PRNGKey(seed), n_machines)
+
+
+def batched_draws(key, n, shape):
+    keys = jax.random.split(key, n)
+    return jax.vmap(lambda k: jax.random.normal(k, shape))(keys)
+
+
+def leading_block(key, n):
+    keys = jax.random.split(key, n)
+    return keys[:2]  # slicing keeps the block; no stream is pinned

@@ -1,0 +1,28 @@
+"""POSITIVE fixture for retrace-risk: the PR-2 ``_keep_better`` bug,
+reconstructed. A pure closure (no free variables from the enclosing
+scope) is jitted inside ``fit`` and its handle only ever *called* — so
+every ``fit`` builds a fresh wrapper and re-traces. This file is lint
+test data (tests/test_lint.py); it is excluded from lint runs."""
+
+import jax
+import jax.numpy as jnp
+
+
+class Trainer:
+    def fit(self, mask, new_tree, old_tree, epochs):
+        # the exact shape PR 2 fixed: a pure select that could live at
+        # module level, re-jitted per fit
+        def keep_better(m, a, b):
+            return jax.tree_util.tree_map(
+                lambda x, y: jnp.where(m, x, y), a, b
+            )
+
+        keep = jax.jit(keep_better)
+        best = old_tree
+        for _ in range(epochs):
+            best = keep(mask, new_tree, best)
+        return best
+
+    def score(self, x):
+        # jit-and-call in one expression: wrapper built and discarded
+        return jax.jit(lambda a: (a * a).sum())(x)

@@ -1,0 +1,42 @@
+"""NEGATIVE (near-miss) fixture for retrace-risk: every cached /
+escaping / genuinely-closing shape the check must NOT flag."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def module_level(mask, a, b):
+    """The PR-2 fix: module-level handle, traced once per geometry."""
+    return jnp.where(mask, a, b)
+
+
+class Cached:
+    def __init__(self):
+        self._fn = None
+        self._cache = {}
+
+    def step(self, x):
+        # instance-cached handle: built once, reused across calls
+        if self._fn is None:
+            self._fn = jax.jit(lambda a: a * 2)
+        return self._fn(x)
+
+    def epoch_fn(self, n):
+        # container-cached handle (the fleet trainer idiom)
+        if n in self._cache:
+            return self._cache[n]
+
+        def fleet_epoch(p):
+            return p * n  # closes over n: not hoistable as-is
+
+        fn = jax.jit(fleet_epoch)
+        self._cache[n] = fn
+        return fn
+
+    def build_step(self, optimizer):
+        def step(p, g):
+            return optimizer(p, g)  # free variable: a real closure
+
+        # returned handle: the caller caches it (long_context idiom)
+        return jax.jit(step)

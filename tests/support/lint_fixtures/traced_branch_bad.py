@@ -1,0 +1,24 @@
+"""POSITIVE fixture for traced-branch: Python control flow on traced
+values — raises TracerBoolConversionError at trace time (or silently
+bakes one path into the compiled program)."""
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clipped_loss(pred, target):
+    err = jnp.abs(pred - target)
+    if err.sum() > 100.0:  # tracer in a Python bool context
+        err = jnp.sqrt(err)
+    return err.mean()
+
+
+def build(threshold):
+    def step(params, grads):
+        update = grads * 0.1
+        while jnp.linalg.norm(update) > threshold:  # traced while
+            update = update / 2
+        return params - update
+
+    return jax.jit(step)

@@ -1,0 +1,41 @@
+"""NEGATIVE (near-miss) fixture for traced-branch: trace-time-static
+branches the check must accept — None tests, isinstance, shape/dtype
+derived values, declared-static arguments, and lax control flow."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@jax.jit
+def padded(x, y=None):
+    if y is None:  # static at trace time
+        y = x
+    if isinstance(y, tuple):  # static at trace time
+        y = y[0]
+    n = x.shape[0]
+    if n % 2:  # shapes are trace-time constants
+        x = jnp.pad(x, (0, 1))
+    if len(x.shape) > 1:  # len() of a static shape
+        x = x.reshape(-1)
+    return x + y.sum()
+
+
+@functools.partial(jax.jit, static_argnames=("training",))
+def forward(params, x, training):
+    if training:  # declared static: a Python bool under the trace
+        x = x * 0.9
+    return params * x
+
+
+@jax.jit
+def clipped(update):
+    # the lax spelling of data-dependent control flow
+    return lax.cond(
+        jnp.linalg.norm(update) > 1.0,
+        lambda u: u / 2,
+        lambda u: u,
+        update,
+    )

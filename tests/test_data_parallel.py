@@ -8,7 +8,7 @@ choice, not a math change).
 import jax
 import numpy as np
 import pytest
-from jax.sharding import NamedSharding, PartitionSpec
+from jax.sharding import PartitionSpec
 
 from gordo_tpu.models.factories.feedforward import feedforward_hourglass
 from gordo_tpu.parallel import get_device_mesh

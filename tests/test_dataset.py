@@ -7,7 +7,6 @@ import pytest
 from gordo_tpu.data import (
     InsufficientDataError,
     RandomDataset,
-    TimeSeriesDataset,
     _get_dataset,
 )
 from gordo_tpu.data.filter_rows import apply_buffer, pandas_filter_rows

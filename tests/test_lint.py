@@ -1,0 +1,357 @@
+"""
+The lint subsystem's own tests (gordo_tpu/analysis): every JAX-discipline
+check against its positive fixture AND its near-miss fixture (the
+false-positive guard), the PR-2 bug reconstructions, suppression
+comments, baseline round-trip, and the CLI contract (exit code ==
+finding count, --format json schema).
+
+Package-wide enforcement — the tier-1 gate that makes lint regressions
+fail CI — lives in tests/test_static.py next to the general checks.
+"""
+
+import ast
+import json
+from pathlib import Path
+
+import pytest
+
+from gordo_tpu.analysis import (
+    check_host_sync,
+    check_prng_key_reuse,
+    check_prng_split_width,
+    check_retrace_risk,
+    check_traced_branching,
+    engine,
+    lint_file,
+    lint_paths,
+    load_baseline,
+    write_baseline,
+)
+from gordo_tpu.analysis.registry import CHECKS, JAX_CHECK_NAMES, get_check
+
+FIXTURES = Path(__file__).parent / "support" / "lint_fixtures"
+
+_CHECKS = {
+    "retrace-risk": check_retrace_risk,
+    "host-sync": check_host_sync,
+    "prng-reuse": check_prng_key_reuse,
+    "prng-split-width": check_prng_split_width,
+    "traced-branch": check_traced_branching,
+}
+
+_FIXTURE_STEMS = {
+    "retrace-risk": "retrace_risk",
+    "host-sync": "host_sync",
+    "prng-reuse": "prng_reuse",
+    "prng-split-width": "prng_split_width",
+    "traced-branch": "traced_branch",
+}
+
+
+def _parse_fixture(stem: str) -> ast.Module:
+    path = FIXTURES / f"{stem}.py"
+    return ast.parse(path.read_text(), filename=str(path))
+
+
+# --------------------------------------------------------------------------
+# golden fixtures: each check flags its bad file, passes its near-miss
+# --------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("check_name", sorted(_CHECKS))
+def test_check_flags_positive_fixture(check_name):
+    tree = _parse_fixture(f"{_FIXTURE_STEMS[check_name]}_bad")
+    found = _CHECKS[check_name](tree)
+    assert found, f"{check_name} missed its positive fixture"
+    assert all("line " in f for f in found), found
+
+
+@pytest.mark.parametrize("check_name", sorted(_CHECKS))
+def test_check_passes_near_miss_fixture(check_name):
+    """The false-positive guard: deliberate near-misses (cached handles,
+    host-data conversions, rebound keys, static branches) stay clean."""
+    tree = _parse_fixture(f"{_FIXTURE_STEMS[check_name]}_ok")
+    found = _CHECKS[check_name](tree)
+    assert found == [], f"{check_name} false-positives: {found}"
+
+
+def test_retrace_check_catches_pr2_keep_better_shape():
+    """The reconstruction of PR 2's first headline bug: a pure closure
+    jitted inside fit, handle only ever called — re-traced per fit."""
+    found = check_retrace_risk(_parse_fixture("retrace_risk_bad"))
+    assert any("keep_better" in f and "never escapes" in f for f in found), found
+    # and the jit-and-call-once form is flagged independently
+    assert any("builds and discards" in f for f in found), found
+
+
+def test_prng_check_catches_pr2_sweep_width_bug():
+    """The reconstruction of PR 2's second headline bug: per-variant
+    streams indexed out of a width-dependent split."""
+    found = check_prng_split_width(_parse_fixture("prng_split_width_bad"))
+    assert len(found) >= 2, found
+    assert all("width" in f.lower() for f in found), found
+
+
+def test_host_sync_fixture_finds_every_primitive():
+    found = check_host_sync(_parse_fixture("host_sync_bad"))
+    rendered = "\n".join(found)
+    for needle in ("float(loss)", "block_until_ready", "device_get", "item", "asarray"):
+        assert needle in rendered, (needle, found)
+
+
+# --------------------------------------------------------------------------
+# engine: hot-path gating, suppressions, baseline
+# --------------------------------------------------------------------------
+
+
+def test_host_sync_is_hot_gated(tmp_path):
+    """host-sync only fires on hot-tagged modules: the same source
+    lints clean elsewhere but is flagged under parallel/."""
+    source = (FIXTURES / "host_sync_bad.py").read_text()
+    cold = tmp_path / "somewhere.py"
+    cold.write_text(source)
+    findings, _ = lint_file(cold, select=["host-sync"])
+    assert findings == []
+    assert engine.is_hot_path("gordo_tpu/parallel/fleet.py")
+    assert engine.is_hot_path("gordo_tpu/models/core.py")
+    assert not engine.is_hot_path("gordo_tpu/models/specs.py")
+
+
+def test_inline_suppression_comment(tmp_path):
+    bad = tmp_path / "unused.py"
+    bad.write_text("import os\nimport sys\n")
+    findings, raw = lint_file(bad, select=["unused-import"])
+    assert len(findings) == 2 and raw == 2
+    suppressed = tmp_path / "suppressed.py"
+    suppressed.write_text(
+        "import os  # lint: disable=unused-import\n"
+        "# lint: disable=unused-import\n"
+        "import sys\n"  # suppressed by the line above
+    )
+    findings, raw = lint_file(suppressed, select=["unused-import"])
+    assert findings == [] and raw == 2  # both found, both suppressed
+
+
+def test_suppression_is_per_check(tmp_path):
+    path = tmp_path / "wrong_name.py"
+    path.write_text("import os  # lint: disable=host-sync\n")
+    findings, _ = lint_file(path, select=["unused-import"])
+    assert len(findings) == 1  # a different check's name does not mute
+
+
+def test_baseline_round_trip(tmp_path):
+    """write_baseline(findings) -> load_baseline -> zero findings on the
+    unchanged tree; a NEW finding still comes through."""
+    target = tmp_path / "legacy.py"
+    target.write_text("import os\n")
+    result = lint_paths([target], select=["unused-import"])
+    assert len(result.findings) == 1
+    baseline = tmp_path / "baseline.json"
+    write_baseline(result.findings, baseline)
+    entries = load_baseline(baseline)
+    assert len(entries) == 1 and entries[0]["check"] == "unused-import"
+    clean = lint_paths([target], select=["unused-import"], baseline=baseline)
+    assert clean.findings == [] and clean.n_baselined == 1
+    # a regression is NOT hidden by the baseline
+    target.write_text("import os\nimport sys\n")
+    regressed = lint_paths([target], select=["unused-import"], baseline=baseline)
+    assert len(regressed.findings) == 1
+    assert "sys" in regressed.findings[0].message
+
+
+def test_syntax_error_is_a_finding_not_a_crash(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    findings, raw = lint_file(broken)
+    assert raw == 1 and len(findings) == 1
+    assert findings[0].check == "syntax"
+    result = lint_paths([broken])  # the batch path must survive it too
+    assert len(result.findings) == 1 and result.findings[0].check == "syntax"
+
+
+def test_split_width_message_carries_no_line_reference(tmp_path):
+    """Baseline `match` substrings must survive unrelated line shifts, so
+    the message referencing the split site names the width expression,
+    never its line number."""
+    source = (
+        "import jax\n"
+        "def f(key, n):\n"
+        "    keys = jax.random.split(key, n)\n"
+        "    return keys[0]\n"
+    )
+    found = check_prng_split_width(ast.parse(source))
+    assert len(found) == 1, found
+    body = found[0].split(":", 1)[1]  # strip the finding's own "line N:"
+    assert "line" not in body, found
+
+
+def test_cli_rewrite_baseline_keeps_grandfathered_entries(cli_runner, tmp_path):
+    """--write-baseline must snapshot EVERY current finding — rewriting
+    an existing baseline must not drop its grandfathered entries."""
+    from gordo_tpu.cli.lint import lint_cli
+
+    bad = tmp_path / "legacy.py"
+    bad.write_text("import os\n")
+    baseline = tmp_path / "baseline.json"
+    write_baseline(
+        lint_paths([bad], select=["unused-import"]).findings, baseline
+    )
+    assert len(load_baseline(baseline)) == 1
+    bad.write_text("import os\nimport sys\n")  # one old + one new finding
+    result = cli_runner.invoke(
+        lint_cli,
+        [
+            "--select",
+            "unused-import",
+            "--baseline",
+            str(baseline),
+            "--write-baseline",
+            str(baseline),
+            str(bad),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    entries = load_baseline(baseline)
+    messages = {e["match"] for e in entries}
+    assert len(entries) == 2 and any("os" in m for m in messages), entries
+
+
+def test_baseline_requires_justification(tmp_path):
+    path = tmp_path / "baseline.json"
+    path.write_text(
+        json.dumps(
+            {
+                "version": 1,
+                "entries": [
+                    {"check": "unused-import", "path": "x.py", "match": "os"}
+                ],
+            }
+        )
+    )
+    with pytest.raises(engine.BaselineError, match="justification"):
+        load_baseline(path)
+
+
+def test_fixture_corpus_is_excluded_from_discovery():
+    """The deliberate-violation corpus must never reach a real lint run
+    (the flake8-excludes-its-own-test-corpus convention)."""
+    files = engine.iter_python_files([FIXTURES.parent.parent])  # tests/
+    assert not any("lint_fixtures" in str(f) for f in files)
+
+
+def test_registry_is_complete_and_documented():
+    names = {spec.name for spec in CHECKS}
+    assert set(JAX_CHECK_NAMES) <= names
+    for spec in CHECKS:
+        assert spec.doc and spec.fixer and spec.severity in ("error", "warning")
+        assert spec.scope in ("syntactic", "semantic")
+    with pytest.raises(KeyError, match="unknown check"):
+        get_check("no-such-check")
+
+
+# --------------------------------------------------------------------------
+# CLI contract
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture
+def cli_runner():
+    from click.testing import CliRunner
+
+    return CliRunner()
+
+
+def test_cli_exit_code_is_finding_count(cli_runner, tmp_path):
+    from gordo_tpu.cli.lint import lint_cli
+
+    bad = tmp_path / "two_findings.py"
+    bad.write_text("import os\nimport sys\n")
+    result = cli_runner.invoke(
+        lint_cli, ["--select", "unused-import", "--no-baseline", str(bad)]
+    )
+    assert result.exit_code == 2, result.output
+    clean = tmp_path / "clean.py"
+    clean.write_text("import os\n\n\nprint(os.name)\n")
+    result = cli_runner.invoke(
+        lint_cli, ["--select", "unused-import", "--no-baseline", str(clean)]
+    )
+    assert result.exit_code == 0, result.output
+
+
+def test_cli_json_format_schema(cli_runner, tmp_path):
+    from gordo_tpu.cli.lint import lint_cli
+
+    bad = tmp_path / "one.py"
+    bad.write_text("import os\n")
+    result = cli_runner.invoke(
+        lint_cli,
+        [
+            "--select",
+            "unused-import",
+            "--no-baseline",
+            "--format",
+            "json",
+            str(bad),
+        ],
+    )
+    assert result.exit_code == 1, result.output
+    payload = json.loads(result.output)
+    assert payload["version"] == 1
+    assert payload["counts"]["findings"] == 1
+    assert payload["counts"]["files"] == 1
+    (finding,) = payload["findings"]
+    assert {
+        "check",
+        "severity",
+        "path",
+        "line",
+        "message",
+        "fixer",
+    } <= set(finding)
+    assert finding["check"] == "unused-import" and finding["line"] == 1
+
+
+def test_cli_list_checks(cli_runner):
+    from gordo_tpu.cli.lint import lint_cli
+
+    result = cli_runner.invoke(lint_cli, ["--list-checks"])
+    assert result.exit_code == 0
+    for name in ("retrace-risk", "host-sync", "prng-reuse", "unused-import"):
+        assert name in result.output
+
+
+def test_cli_rejects_unknown_check(cli_runner, tmp_path):
+    from gordo_tpu.cli.lint import lint_cli
+
+    f = tmp_path / "x.py"
+    f.write_text("\n")
+    result = cli_runner.invoke(lint_cli, ["--select", "bogus", str(f)])
+    assert result.exit_code != 0
+    assert "unknown check" in result.output
+
+
+def test_cli_write_baseline_round_trip(cli_runner, tmp_path):
+    from gordo_tpu.cli.lint import lint_cli
+
+    bad = tmp_path / "legacy.py"
+    bad.write_text("import os\n")
+    baseline = tmp_path / "lint_baseline.json"
+    result = cli_runner.invoke(
+        lint_cli,
+        [
+            "--select",
+            "unused-import",
+            "--no-baseline",
+            "--write-baseline",
+            str(baseline),
+            str(bad),
+        ],
+    )
+    assert result.exit_code == 0, result.output
+    entries = load_baseline(baseline)  # placeholder justifications load
+    assert len(entries) == 1
+    result = cli_runner.invoke(
+        lint_cli,
+        ["--select", "unused-import", "--baseline", str(baseline), str(bad)],
+    )
+    assert result.exit_code == 0, result.output

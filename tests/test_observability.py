@@ -60,7 +60,7 @@ def test_registry_get_or_create_guards_shape():
     with pytest.raises(ValueError):
         reg.gauge("gordo_a_total")  # kind drift
     with pytest.raises(ValueError):
-        reg.counter("not a name!")
+        reg.counter("not a name!")  # lint: disable=metric-registration
     with pytest.raises(ValueError):
         reg.counter("gordo_a_total", labelnames=("path",)).inc(-1, path="x")
     with pytest.raises(ValueError):

@@ -19,7 +19,6 @@ from gordo_tpu.models import (
 )
 from gordo_tpu.models.anomaly import DiffBasedAnomalyDetector
 from gordo_tpu.models.specs_seq import (
-    TransformerNet,
     dense_attention,
     default_dilations,
     receptive_field,

@@ -1,14 +1,19 @@
 """
 Static-health checks — the stand-in for the reference's mypy/pyflakes
 pytest plugins (reference pytest.ini:8-9; neither tool is available in this
-image). Every module must byte-compile and import cleanly, and the vendored
-analyzer (tests/static_analysis.py) checks unused imports, module-attribute
-typos and call-signature mismatches across the whole package.
+image). Every module must byte-compile and import cleanly, and the analyzer
+(gordo_tpu.analysis, re-exported through the tests/static_analysis.py shim)
+checks unused imports, module-attribute typos and call-signature mismatches
+across the whole package — plus, parametrized at the end of this file, the
+JAX-discipline family (retrace/host-sync/PRNG/traced-branch) so a lint
+regression fails tier-1 the same way a broken signature does.
 """
 
 import compileall
 import importlib
 from pathlib import Path
+
+import pytest
 
 import gordo_tpu
 
@@ -657,3 +662,75 @@ def test_annotated_param_method_call_check_catches_drift():
     assert len(found) == 2, found
     assert any("bad" in f or "nope" in f for f in found)
     assert all("line 3" in f or "line 5" in f for f in found)
+
+
+def test_event_names_documented():
+    """Every literal event type the package emits through the
+    observability event log must appear in docs/observability.md's event
+    schema — the sibling of test_metric_names_documented (metrics were
+    enforced since PR 2; events were not, so a new lifecycle event could
+    ship with undocumented fields)."""
+    from static_analysis import collect_event_names
+
+    emitted: set = set()
+    for name, module in _importable_modules():
+        if name == "gordo_tpu.observability.events":
+            continue  # the emitter itself, not an emission site
+        emitted |= collect_event_names(parse(module.__file__))
+    assert emitted, "no event emissions found — collector broken?"
+    docs = (
+        Path(gordo_tpu.__file__).parent.parent / "docs" / "observability.md"
+    ).read_text()
+    undocumented = sorted(e for e in emitted if f"`{e}`" not in docs)
+    assert not undocumented, (
+        f"event types emitted in code but missing from "
+        f"docs/observability.md: {undocumented}"
+    )
+
+
+def test_event_name_collector_reads_both_surfaces():
+    import ast as _ast
+
+    from static_analysis import collect_event_names
+
+    source = (
+        "def f(emitter, dynamic):\n"
+        "    emit_event('build_started', n=1)\n"
+        "    emitter.emit('epoch', epoch=0)\n"
+        "    emit_event(dynamic)\n"  # non-literal: out of scope
+        "    emit_event(event='early_stop')\n"
+    )
+    names = collect_event_names(_ast.parse(source))
+    assert names == {"build_started", "epoch", "early_stop"}
+
+
+# --------------------------------------------------------------------------
+# the JAX-discipline family, package-wide (the tier-1 lint gate)
+# --------------------------------------------------------------------------
+
+_LINT_ROOT = Path(gordo_tpu.__file__).parent.parent
+
+
+@pytest.mark.parametrize(
+    "check_name",
+    ["retrace-risk", "host-sync", "prng-reuse", "prng-split-width", "traced-branch"],
+)
+def test_jax_discipline_package_wide(check_name):
+    """gordo_tpu + tests + benchmarks lint clean for every JAX check —
+    the mechanical enforcement of what PR 2 fixed by hand (re-traced
+    jitted closures; width-dependent PRNG streams). Intentional
+    violations carry inline `# lint: disable=` suppressions next to the
+    comment justifying them; there is nothing in the baseline."""
+    from gordo_tpu.analysis import lint_paths
+
+    targets = [
+        _LINT_ROOT / "gordo_tpu",
+        _LINT_ROOT / "tests",
+        _LINT_ROOT / "benchmarks",
+    ]
+    result = lint_paths([p for p in targets if p.exists()], select=[check_name])
+    rendered = "\n".join(f.render() for f in result.findings)
+    assert not result.findings, (
+        f"[{check_name}] lint regressions (fix them, suppress with a "
+        f"justifying comment, or baseline with a justification):\n{rendered}"
+    )
