@@ -26,12 +26,15 @@ and metadata as ModelBuilder, so serving and clients are oblivious to how
 the model was trained.
 """
 
+import json
 import logging
+import os
 import time
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeoutError
 from datetime import datetime, timezone
 from pathlib import Path
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 import pandas as pd
@@ -41,6 +44,7 @@ from sklearn.pipeline import Pipeline
 
 from gordo_tpu import __version__, serializer
 from gordo_tpu.builder.build_model import ModelBuilder
+from gordo_tpu.client.utils import backoff_seconds
 from gordo_tpu.data import _get_dataset
 from gordo_tpu.machine import Machine
 from gordo_tpu.machine.metadata import (
@@ -62,6 +66,24 @@ from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
 from gordo_tpu.parallel.mesh import auto_device_mesh
 
 logger = logging.getLogger(__name__)
+
+#: Per-build casualty record persisted next to the artifacts; the model
+#: server reads it to 409 predictions against failed/quarantined machines
+#: (docs/robustness.md).
+BUILD_REPORT_FILENAME = "build_report.json"
+
+
+class MachineFetchError(RuntimeError):
+    """One machine's data fetch failed after its retry budget."""
+
+    def __init__(self, machine_name: str, attempts: int, cause: BaseException):
+        super().__init__(
+            f"Data fetch for machine {machine_name!r} failed after "
+            f"{attempts} attempt(s): {cause!r}"
+        )
+        self.machine_name = machine_name
+        self.attempts = attempts
+        self.cause = cause
 
 
 def _find_jax_estimator(model) -> Optional[BaseJaxEstimator]:
@@ -106,6 +128,26 @@ class FleetModelBuilder:
         matters on tunneled/DCN-attached backends. A machine config may
         override it per bucket with an ``epoch_chunk`` fit arg on its
         estimator. Scheduling only; results are bit-identical.
+    on_error
+        Per-machine failure policy (docs/robustness.md). ``"raise"``
+        (default, the reference's semantics): the first machine whose
+        data fetch or build fails aborts the whole build. ``"skip"``:
+        the casualty is recorded — cause and attempt count, in
+        ``build_report.json`` and the telemetry report — and the
+        surviving machines build on; the machine is the fault domain,
+        not the fleet.
+    fetch_retries
+        Retries per machine for the data-fetch phase (exponential
+        backoff between attempts; the fetch that dies three times on a
+        flapping source shouldn't cost the build).
+    fetch_timeout
+        Per-machine cap, in seconds, on waiting for one machine's fetch
+        (all attempts included). None = wait forever. A machine that
+        times out is a fetch failure under ``on_error``.
+    fetch_backoff
+        Seconds to sleep before retry ``attempt`` (1-based); defaults to
+        the client's shared exponential policy
+        (``client.utils.backoff_seconds``).
     """
 
     def __init__(
@@ -115,6 +157,10 @@ class FleetModelBuilder:
         data_threads: int = 8,
         auto_mesh: bool = False,
         epoch_chunk: int = 1,
+        on_error: str = "raise",
+        fetch_retries: int = 2,
+        fetch_timeout: Optional[float] = None,
+        fetch_backoff: Callable[[int], float] = backoff_seconds,
     ):
         self.machines = machines
         if mesh is None and auto_mesh:
@@ -122,14 +168,31 @@ class FleetModelBuilder:
         self.mesh = mesh
         self.data_threads = data_threads
         self.epoch_chunk = max(1, int(epoch_chunk))
+        if on_error not in ("raise", "skip"):
+            raise ValueError(
+                f"on_error must be 'raise' or 'skip', got {on_error!r}"
+            )
+        self.on_error = on_error
+        self.fetch_retries = max(0, int(fetch_retries))
+        self.fetch_timeout = fetch_timeout
+        self.fetch_backoff = fetch_backoff
         #: per-bucket telemetry accumulated by _build_bucket, assembled
         #: into telemetry_report_ (and persisted next to artifacts) by
         #: build()
         self._bucket_reports: List[dict] = []
         self.telemetry_report_: Optional[dict] = None
+        #: casualty records of the last build: machines whose fetch or
+        #: build failed (on_error="skip"), and machines the non-finite
+        #: guard quarantined during training
+        self.build_failures_: List[dict] = []
+        self.quarantined_: List[dict] = []
+        self.build_report_: Optional[dict] = None
 
     # -- data ------------------------------------------------------------
     def _fetch_one(self, machine: Machine):
+        from gordo_tpu.robustness import faults
+
+        faults.inject("fetch", machine.name)
         dataset = _get_dataset(machine.dataset.to_dict())
         start = time.time()
         X, y = dataset.get_data()
@@ -141,9 +204,152 @@ class FleetModelBuilder:
             "query_duration": time.time() - start,
         }
 
-    def fetch_data(self, machines: List[Machine]) -> List[dict]:
-        with ThreadPoolExecutor(max_workers=self.data_threads) as pool:
-            return list(pool.map(self._fetch_one, machines))
+    def _fetch_with_retries(self, machine: Machine):
+        """One machine's fetch with its own retry/backoff budget; raises
+        :class:`MachineFetchError` (cause + attempt count) when spent."""
+        attempts = self.fetch_retries + 1
+        for attempt in range(1, attempts + 1):
+            try:
+                return self._fetch_one(machine)
+            except Exception as exc:
+                if attempt >= attempts:
+                    raise MachineFetchError(machine.name, attempt, exc) from exc
+                delay = self.fetch_backoff(attempt)
+                logger.warning(
+                    "Data fetch for machine %s failed (attempt %d of %d): "
+                    "%r; retrying in %.1fs",
+                    machine.name, attempt, attempts, exc, delay,
+                )
+                time.sleep(delay)
+
+    def fetch_data(
+        self, machines: List[Machine]
+    ) -> Tuple[List[dict], List[dict]]:
+        """
+        Fetch every machine's data concurrently, each machine in its OWN
+        fault domain: per-machine futures with retry/backoff
+        (``fetch_retries`` / ``fetch_backoff``) and an optional
+        per-machine wait cap (``fetch_timeout``).
+
+        Returns ``(fetched, failures)`` — successes in the input order,
+        and one record per casualty (machine, stage, error, attempts).
+        Under ``on_error="raise"`` the first casualty re-raises its
+        ORIGINAL cause (exception types map to pod exit codes,
+        cli.ExceptionsReporter) instead of returning; under ``"skip"``
+        the survivors come back and the casualties are recorded.
+        """
+        failures: List[dict] = []
+        fetched: List[dict] = []
+        pool = ThreadPoolExecutor(max_workers=self.data_threads)
+        hung = False
+
+        def task(machine: Machine, started_at: dict):
+            started_at["t"] = time.monotonic()
+            return self._fetch_with_retries(machine)
+
+        try:
+            futures = []
+            for machine in machines:
+                started_at: dict = {"t": None}
+                futures.append(
+                    (machine, pool.submit(task, machine, started_at), started_at)
+                )
+            # last time ANY machine resolved: while queued fetches wait
+            # behind running ones, this is how _await_fetch tells a
+            # busy pool (keep waiting) from one wedged by hung fetches
+            progress = {"t": time.monotonic()}
+            for machine, future, started_at in futures:
+                try:
+                    fetched.append(
+                        self._await_fetch(future, started_at, progress)
+                    )
+                except FutureTimeoutError:
+                    hung = True  # the worker thread cannot be interrupted
+                    future.cancel()
+                    if self.on_error == "raise":
+                        raise TimeoutError(
+                            f"Data fetch for machine {machine.name!r} "
+                            f"exceeded {self.fetch_timeout}s"
+                        )
+                    failures.append(self._record_failure(
+                        machine.name,
+                        phase="fetch",
+                        error=f"TimeoutError: fetch exceeded "
+                        f"{self.fetch_timeout}s",
+                        attempts=None,
+                    ))
+                except MachineFetchError as exc:
+                    if self.on_error == "raise":
+                        raise exc.cause
+                    failures.append(self._record_failure(
+                        machine.name,
+                        phase="fetch",
+                        error=repr(exc.cause),
+                        attempts=exc.attempts,
+                    ))
+                finally:
+                    progress["t"] = time.monotonic()
+            return fetched, failures
+        finally:
+            # wait=False + cancel: a hung fetch thread must not wedge the
+            # surviving buckets' build at pool teardown
+            pool.shutdown(wait=not hung, cancel_futures=True)
+
+    def _await_fetch(self, future, started_at: dict, progress: dict):
+        """
+        Wait for one machine's fetch, charging ``fetch_timeout`` against
+        the time the fetch has actually been RUNNING — a machine queued
+        behind other fetches must not be falsely recorded as its own
+        timeout while the pool is making progress. When the pool is
+        WEDGED (hung fetches hold every worker and nothing has resolved
+        for a whole ``fetch_timeout``), queued machines time out too —
+        the bound must hold even when the hung feeds outnumber the
+        threads.
+        """
+        if self.fetch_timeout is None:
+            return future.result()
+        while True:
+            start = started_at["t"]
+            if start is None:
+                # still queued: poll without starting the machine's clock,
+                # unless the whole pool has stalled for a full budget
+                if time.monotonic() - progress["t"] > self.fetch_timeout:
+                    raise FutureTimeoutError()
+                try:
+                    return future.result(timeout=0.2)
+                except FutureTimeoutError:
+                    continue
+            remaining = start + self.fetch_timeout - time.monotonic()
+            if remaining <= 0:
+                raise FutureTimeoutError()
+            return future.result(timeout=remaining)
+
+    def _record_failure(
+        self,
+        machine_name: str,
+        phase: str,
+        error: str,
+        attempts: Optional[int],
+    ) -> dict:
+        """One casualty: log + build_failures_ + event + counter."""
+        record = {
+            "machine": machine_name,
+            "phase": phase,
+            "error": error,
+            "attempts": attempts,
+        }
+        self.build_failures_.append(record)
+        logger.error(
+            "Machine %s failed in %s phase (on_error=skip; recorded): %s",
+            machine_name, phase, error,
+        )
+        emit_event("build_machine_failed", **record)
+        get_registry().counter(
+            "gordo_build_machines_failed_total",
+            "Machines dropped from fleet builds by per-machine failures",
+            ("phase",),
+        ).inc(phase=phase)
+        return record
 
     # -- build -----------------------------------------------------------
     def build(
@@ -167,6 +373,13 @@ class FleetModelBuilder:
         sha3 build cache (reference gordo/builder/build_model.py:521-578);
         this is the same idea at the fleet's artifact layer, where the
         crash-unit is a bucket rather than a pod.
+
+        Returns (model, machine) pairs for the machines that BUILT, in
+        the original order — under ``on_error="skip"`` failed machines
+        are absent from the result and recorded in ``build_failures_`` /
+        ``build_report.json`` instead (under the default ``"raise"``
+        every machine builds or the call raises, so the result covers
+        all of them).
         """
         if resume and output_dir_base is None:
             raise ValueError("resume=True requires output_dir_base")
@@ -176,6 +389,9 @@ class FleetModelBuilder:
         started_iso = str(datetime.now(timezone.utc).astimezone())
         self._bucket_reports = []
         self.telemetry_report_ = None
+        self.build_failures_ = []
+        self.quarantined_ = []
+        self.build_report_ = None
         emit_event(
             "build_started",
             n_machines=len(self.machines),
@@ -186,13 +402,30 @@ class FleetModelBuilder:
         results: Dict[str, Tuple[BaseEstimator, Machine]] = {}
         to_build = list(self.machines)
         if resume:
+            # a prior run's casualties must NOT resume: a quarantined
+            # machine's artifact holds frozen last-good params, and
+            # reusing it while this run rewrites build_report.json would
+            # erase the quarantine record and serve those params as
+            # healthy. Rebuild them instead — a clean rebuild clears the
+            # record legitimately, a still-faulting one re-records it.
+            prior_casualties = self._prior_casualties(base)
             remaining = []
             for machine in to_build:
                 art_dir = base / machine.name
-                # the exact crash this feature targets can leave model.pkl
-                # without metadata.json; check the file explicitly so
-                # load_metadata's parent-directory fallback can't pick up an
-                # unrelated metadata.json from OUTPUT_DIR itself
+                if machine.name in prior_casualties:
+                    logger.info(
+                        "Resume: rebuilding %s (recorded as %s by the "
+                        "previous run)",
+                        machine.name, prior_casualties[machine.name],
+                    )
+                    remaining.append(machine)
+                    continue
+                # artifacts flush atomically (serializer.dump renames a
+                # complete temp dir into place), so no torn model.pkl /
+                # metadata.json split can exist; the explicit file check
+                # remains only so load_metadata's parent-directory
+                # fallback can't pick up an unrelated metadata.json from
+                # OUTPUT_DIR itself
                 if not (art_dir / "metadata.json").is_file():
                     remaining.append(machine)
                     continue
@@ -265,12 +498,36 @@ class FleetModelBuilder:
                         len(bucket),
                     )
                     for machine in bucket:
-                        results[machine.name] = ModelBuilder(machine).build()
+                        try:
+                            results[machine.name] = ModelBuilder(machine).build()
+                        except Exception as exc:
+                            if self.on_error == "raise":
+                                raise
+                            self._record_failure(
+                                machine.name, phase="build",
+                                error=repr(exc), attempts=None,
+                            )
+                            continue
                         # flush per machine: these unbatched builds are the
                         # slowest, so the crash-loss window matters most here
                         _flush([results[machine.name]])
                     continue
-                built_bucket = self._build_bucket(bucket)
+                try:
+                    built_bucket = self._build_bucket(bucket)
+                except Exception as exc:
+                    if self.on_error == "raise":
+                        raise
+                    # a training-level failure's blast radius is the
+                    # bucket: record every machine of it not already
+                    # recorded by the finer-grained fetch/precheck paths
+                    already = {f["machine"] for f in self.build_failures_}
+                    for machine in bucket:
+                        if machine.name not in already:
+                            self._record_failure(
+                                machine.name, phase="build",
+                                error=repr(exc), attempts=None,
+                            )
+                    continue
                 results.update(built_bucket)
                 _flush(built_bucket.values())
         except BaseException as exc:
@@ -285,15 +542,16 @@ class FleetModelBuilder:
             )
             raise
 
+        n_resumed = len(self.machines) - len(to_build)
         self._finish_telemetry(
             base=base,
             build_start=build_start,
             started_iso=started_iso,
-            n_built=len(to_build),
-            n_resumed=len(self.machines) - len(to_build),
+            n_built=len(results) - n_resumed,
+            n_resumed=n_resumed,
             n_buckets=len(buckets),
         )
-        return [results[m.name] for m in self.machines]
+        return [results[m.name] for m in self.machines if m.name in results]
 
     def _finish_telemetry(
         self,
@@ -323,8 +581,25 @@ class FleetModelBuilder:
             "models_per_hour": rate,
             "device_memory": memory_watermarks(),
             "buckets": self._bucket_reports,
+            "on_error": self.on_error,
+            "machines_failed": list(self.build_failures_),
+            "machines_quarantined": list(self.quarantined_),
         }
         self.telemetry_report_ = report
+        self.build_report_ = {
+            "version": 1,
+            "kind": "fleet_build_report",
+            "started": started_iso,
+            "finished": report["finished"],
+            "on_error": self.on_error,
+            "n_machines": len(self.machines),
+            "n_built": n_built,
+            "n_resumed": n_resumed,
+            "n_failed": len(self.build_failures_),
+            "n_quarantined": len(self.quarantined_),
+            "failed": list(self.build_failures_),
+            "quarantined": list(self.quarantined_),
+        }
         reg = get_registry()
         reg.counter(
             "gordo_build_models_total", "Models produced by fleet builds"
@@ -344,19 +619,64 @@ class FleetModelBuilder:
             ).set_max(peak)
         if base is not None:
             write_telemetry_report(base, report)
+            self._write_build_report(base)
         emit_event(
             "build_finished",
             n_machines=len(self.machines),
             n_resumed=n_resumed,
+            n_failed=len(self.build_failures_),
+            n_quarantined=len(self.quarantined_),
             wall_time_s=round(wall, 4),
             models_per_hour=rate,
         )
+
+    @staticmethod
+    def _prior_casualties(base: Path) -> Dict[str, str]:
+        """Machine -> status from an earlier run's ``build_report.json``
+        under ``base`` ({} when absent/unreadable)."""
+        path = base / BUILD_REPORT_FILENAME
+        try:
+            with open(path) as fh:
+                report = json.load(fh)
+        except (OSError, ValueError):
+            return {}
+        out: Dict[str, str] = {}
+        for record in report.get("failed") or []:
+            if record.get("machine"):
+                out[record["machine"]] = (
+                    f"{record.get('phase', 'build')}-failed"
+                )
+        for record in report.get("quarantined") or []:
+            if record.get("machine"):
+                out[record["machine"]] = "quarantined"
+        return out
+
+    def _write_build_report(self, base: Path) -> Path:
+        """
+        Persist ``build_report.json`` next to the artifacts — atomically
+        (temp file + ``os.replace``), since the model server polls it to
+        decide which machines to 409.
+        """
+        base.mkdir(parents=True, exist_ok=True)
+        path = base / BUILD_REPORT_FILENAME
+        tmp = base / (BUILD_REPORT_FILENAME + ".tmp")
+        with open(tmp, "w") as fh:
+            json.dump(self.build_report_, fh, indent=2, sort_keys=True, default=str)
+            fh.write("\n")
+        os.replace(tmp, path)
+        return path
 
     def _build_bucket(
         self, bucket: List[Machine]
     ) -> Dict[str, Tuple[BaseEstimator, Machine]]:
         bucket_start = time.time()
-        fetched = self.fetch_data(bucket)
+        fetched, fetch_failures = self.fetch_data(bucket)
+        if fetch_failures:
+            # on_error="skip" (raise already propagated): the casualties
+            # are recorded; the bucket shrinks to the survivors
+            bucket = [item["machine"] for item in fetched]
+            if not bucket:
+                return {}
 
         # Per-machine host-side prep: build the model object, fit prefix
         # transformers, transform X.
@@ -373,6 +693,59 @@ class FleetModelBuilder:
                 X_t = np.asarray(transformer.fit_transform(X_t), dtype=np.float32)
             Xs_t.append(X_t)
             ys_np.append(np.asarray(item["y"], dtype=np.float32))
+
+        # Architecture spec from the first estimator (identical across the
+        # bucket by construction).
+        proto_est = estimators[0]
+        proto_est.kwargs.update(
+            {"n_features": Xs_t[0].shape[1], "n_features_out": ys_np[0].shape[1]}
+        )
+        spec = proto_est._build_spec()
+        lookahead = proto_est.lookahead if spec.windowed else 0
+
+        # fail loudly BEFORE training if any machine cannot fill one window
+        # (the solo path fails at its predict; masks would otherwise let a
+        # short machine "train" on nothing and crash only at serve time) —
+        # under on_error="skip" only THAT machine leaves the bucket
+        if spec.windowed:
+            min_rows = spec.lookback_window + lookahead
+            short = [i for i, X_t in enumerate(Xs_t) if len(X_t) < min_rows]
+            if short:
+                message = (
+                    "{name}: {rows} rows after transforms; this windowed "
+                    f"model needs at least {min_rows} (lookback "
+                    f"{spec.lookback_window} + lookahead {lookahead})"
+                )
+                if self.on_error == "raise":
+                    from gordo_tpu.data.base import InsufficientDataError
+
+                    item, X_t = fetched[short[0]], Xs_t[short[0]]
+                    raise InsufficientDataError(
+                        "Machine "
+                        + message.format(
+                            name=item["machine"].name, rows=len(X_t)
+                        )
+                    )
+                for i in short:
+                    self._record_failure(
+                        fetched[i]["machine"].name,
+                        phase="build",
+                        error="InsufficientDataError: " + message.format(
+                            name=fetched[i]["machine"].name,
+                            rows=len(Xs_t[i]),
+                        ),
+                        attempts=None,
+                    )
+                keep = [i for i in range(len(fetched)) if i not in set(short)]
+                fetched = [fetched[i] for i in keep]
+                models = [models[i] for i in keep]
+                estimators = [estimators[i] for i in keep]
+                Xs_t = [Xs_t[i] for i in keep]
+                ys_np = [ys_np[i] for i in keep]
+                bucket = [item["machine"] for item in fetched]
+                if not bucket:
+                    return {}
+
         # row-count preservation per machine, on its own data: the license
         # for sharing one model_offset probe across the bucket (below)
         rows_preserved = all(
@@ -389,30 +762,6 @@ class FleetModelBuilder:
             Xs_grid, ys_grid, n_machines_padded=m_padded, n_timesteps=n_grid
         )
 
-        # Architecture spec from the first estimator (identical across the
-        # bucket by construction).
-        proto_est = estimators[0]
-        proto_est.kwargs.update(
-            {"n_features": Xs_grid[0].shape[1], "n_features_out": ys_grid[0].shape[1]}
-        )
-        spec = proto_est._build_spec()
-        lookahead = proto_est.lookahead if spec.windowed else 0
-
-        # fail loudly BEFORE training if any machine cannot fill one window
-        # (the solo path fails at its predict; masks would otherwise let a
-        # short machine "train" on nothing and crash only at serve time)
-        if spec.windowed:
-            min_rows = spec.lookback_window + lookahead
-            for item, X_t in zip(fetched, Xs_t):
-                if len(X_t) < min_rows:
-                    from gordo_tpu.data.base import InsufficientDataError
-
-                    raise InsufficientDataError(
-                        f"Machine {item['machine'].name}: {len(X_t)} rows "
-                        f"after transforms; this windowed model needs at "
-                        f"least {min_rows} (lookback {spec.lookback_window} "
-                        f"+ lookahead {lookahead})"
-                    )
         fit_args = proto_est.extract_supported_fit_args(proto_est.kwargs)
         epochs = int(fit_args.get("epochs", 1))
         batch_size = int(fit_args.get("batch_size", 32))
@@ -447,20 +796,44 @@ class FleetModelBuilder:
             + [np.asarray(solo_init_key(0))] * (m_padded - len(bucket))
         )
 
+        machine_names = [item["machine"].name for item in fetched]
+
         # -- CV folds as masks: threshold calibration + scores ------------
         start_cv = time.time()
         fold_records = self._run_cv_folds(
             trainer, data, keys, bucket, Xs_grid, ys_grid, models,
             epochs=epochs, batch_size=batch_size, es_kwargs=es_kwargs,
+            machine_names=machine_names,
         )
         cv_duration = time.time() - start_cv
 
         # -- final full fit ----------------------------------------------
         start_fit = time.time()
         params, losses = trainer.fit(
-            data, keys, epochs=epochs, batch_size=batch_size, **es_kwargs
+            data, keys, epochs=epochs, batch_size=batch_size,
+            machine_names=machine_names, **es_kwargs
         )
         fit_duration = time.time() - start_fit
+
+        # -- quarantine bookkeeping: the FINAL fit's verdict is what the
+        # persisted params reflect (a quarantined machine's artifact
+        # holds its last finite epoch's params — build_report.json names
+        # it so serving can degrade instead of returning garbage)
+        n_bucket_quarantined = 0
+        healthy = getattr(trainer, "healthy_", None)
+        if healthy is not None and not healthy[: len(fetched)].all():
+            q_epochs = trainer.quarantine_epoch_
+            for i in np.flatnonzero(~healthy[: len(fetched)]):
+                name = fetched[i]["machine"].name
+                n_bucket_quarantined += 1
+                self.quarantined_.append(
+                    {"machine": name, "epoch": int(q_epochs[i])}
+                )
+                logger.warning(
+                    "Machine %s was quarantined at epoch %d; its artifact "
+                    "holds the last finite params and serving will 409 it",
+                    name, int(q_epochs[i]),
+                )
 
         # -- unstack into per-machine models + metadata -------------------
         # one bulk device->host transfer for the whole bucket's params
@@ -552,6 +925,7 @@ class FleetModelBuilder:
                 "cv_duration_s": cv_duration,
                 "fit_duration_s": fit_duration,
                 "bucket_wall_s": bucket_wall,
+                "n_machines_quarantined": n_bucket_quarantined,
                 "models_per_hour": (
                     len(bucket) / bucket_wall * 3600 if bucket_wall > 0 else None
                 ),
@@ -646,6 +1020,7 @@ class FleetModelBuilder:
         batch_size: int,
         n_splits: int = 3,
         es_kwargs: Optional[dict] = None,
+        machine_names: Optional[List[str]] = None,
     ) -> dict:
         """
         TimeSeriesSplit folds, trained fleet-wide with per-machine train
@@ -703,6 +1078,7 @@ class FleetModelBuilder:
                 epochs=epochs,
                 batch_size=batch_size,
                 extra_weight=train_mask,
+                machine_names=machine_names,
                 **(es_kwargs or {}),
             )
             preds = trainer.predict(fold_params, data.X)  # (M, n_out, f_out)

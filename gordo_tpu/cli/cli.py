@@ -218,12 +218,44 @@ def build(
     "bit-identical to per-epoch dispatch; a machine config may override "
     "per bucket with an 'epoch_chunk' fit arg.",
 )
+@click.option(
+    "--on-error",
+    type=click.Choice(["raise", "skip"]),
+    default="raise",
+    envvar="GORDO_ON_ERROR",
+    show_default=True,
+    help="Per-machine failure policy: 'raise' aborts the build on the "
+    "first machine whose data fetch or build fails (reference "
+    "semantics); 'skip' records the casualty in build_report.json (and "
+    "the telemetry report) and builds the surviving machines — the "
+    "machine, not the fleet, is the fault domain.",
+)
+@click.option(
+    "--fetch-retries",
+    type=click.IntRange(min=0),
+    default=2,
+    envvar="GORDO_FETCH_RETRIES",
+    show_default=True,
+    help="Per-machine retries for the data-fetch phase (exponential "
+    "backoff between attempts).",
+)
+@click.option(
+    "--fetch-timeout",
+    type=click.FloatRange(min=0, min_open=True),
+    default=None,
+    envvar="GORDO_FETCH_TIMEOUT",
+    help="Per-machine cap, in seconds, on waiting for one machine's "
+    "data fetch (all attempts included); unset waits forever.",
+)
 @_with_build_options
 def build_fleet(
     machines_config: list,
     output_dir: str,
     resume: bool,
     epoch_chunk: int,
+    on_error: str,
+    fetch_retries: int,
+    fetch_timeout: float,
     model_register_dir: str,
     print_cv_scores: bool,
     model_parameter: List[Tuple[str, Any]],
@@ -255,14 +287,29 @@ def build_fleet(
         logger.info(
             "Fleet-building %d machines, output at: %s", len(machines), output_dir
         )
-        built = FleetModelBuilder(machines, epoch_chunk=epoch_chunk).build(
-            output_dir_base=output_dir, resume=resume
+        builder = FleetModelBuilder(
+            machines,
+            epoch_chunk=epoch_chunk,
+            on_error=on_error,
+            fetch_retries=fetch_retries,
+            fetch_timeout=fetch_timeout,
         )
+        built = builder.build(output_dir_base=output_dir, resume=resume)
         for _, machine_out in built:
             machine_out.report()
             if print_cv_scores:
                 for score in get_all_score_strings(machine_out):
                     print(f"{machine_out.name}: {score}")
+        for record in builder.build_failures_:
+            print(
+                f"FAILED {record['machine']} ({record['phase']}): "
+                f"{record['error']}"
+            )
+        for record in builder.quarantined_:
+            print(
+                f"QUARANTINED {record['machine']} at epoch "
+                f"{record['epoch']} (artifact holds last finite params)"
+            )
     except Exception:
         _report_and_exit(exceptions_reporter_file, exceptions_report_level)
     else:

@@ -28,11 +28,17 @@ from gordo_tpu import serializer
 from gordo_tpu.client.io import (
     BadGordoRequest,
     HttpUnprocessableEntity,
+    MachineUnavailable,
     NotFound,
     ResourceGone,
     handle_response,
 )
-from gordo_tpu.client.utils import PredictionResult, backoff_seconds, cached_method
+from gordo_tpu.client.utils import (
+    DEFAULT_RETRY_JITTER,
+    PredictionResult,
+    backoff_seconds,
+    cached_method,
+)
 from gordo_tpu.data.providers.base import GordoBaseDataProvider
 from gordo_tpu.machine import Machine
 from gordo_tpu.machine.metadata import Metadata
@@ -45,7 +51,8 @@ logger = logging.getLogger(__name__)
 
 def _observe_request(path: str, outcome: str, seconds: float) -> None:
     """One prediction POST's latency/outcome into the process registry
-    (path: 'fleet' or 'single'; outcome: ok/io_error/refused/gone)."""
+    (path: 'fleet' or 'single'; outcome:
+    ok/io_error/refused/gone/unavailable)."""
     reg = get_registry()
     reg.histogram(
         "gordo_client_request_seconds",
@@ -364,11 +371,16 @@ class Client:
             name: [] for name in data
         }
         errors: typing.Dict[str, typing.List[str]] = {name: [] for name in data}
-        for k in range(n_chunks):
+        # machines the server declared unavailable (409): a PERMANENT
+        # per-revision condition — they leave the group's payloads, keep
+        # their recorded error, and are never retried
+        excluded: typing.Set[str] = set()
+
+        def build_payload(k: int):
             payload: typing.Dict[str, Any] = {}
             chunk_names: typing.List[str] = []
             for name, (machine, X, y) in data.items():
-                if k >= len(chunk_bounds[name]):
+                if name in excluded or k >= len(chunk_bounds[name]):
                     continue
                 chunk = slice(*chunk_bounds[name][k])
                 Xc = X.iloc[chunk]
@@ -397,20 +409,75 @@ class Client:
                     }
                 else:
                     payload[name] = server_utils.dataframe_to_dict(Xc)
+            return payload, chunk_names
+
+        for k in range(n_chunks):
+            payload, chunk_names = build_payload(k)
             if not payload:
                 continue
-            status, resp = self._post_fleet_chunk(url, payload, revision)
+            while True:
+                status, resp = self._post_fleet_chunk(url, payload, revision)
+                if status != "unavailable":
+                    break
+                # the 409 names the casualties; record each once, drop
+                # them from the group, and re-POST the chunk for the
+                # healthy remainder (a fresh payload, not a retry)
+                named = set(resp.unavailable or {}) & set(data)
+                bad = named - excluded
+                if not bad:
+                    # a 409 naming nothing we sent (unparseable body, a
+                    # proxy's replayed response, or only machines already
+                    # dropped): no progress is possible, so record THIS
+                    # chunk as failed — permanently excluding the whole
+                    # group on unattributed evidence would kill healthy
+                    # machines' predictions
+                    for name in chunk_names:
+                        bounds = chunk_bounds[name][k]
+                        errors[name].append(
+                            f"Fleet chunk rows {bounds[0]}:{bounds[1]} "
+                            f"failed for '{name}': server answered 409 "
+                            "without naming a machine in the payload "
+                            f"({resp})"
+                        )
+                    status = "skipped"
+                    break
+                for name in sorted(bad):
+                    info = (resp.unavailable or {}).get(name) or {}
+                    errors[name].append(
+                        f"Machine '{name}' is unavailable on the server "
+                        f"({info.get('reason', 'unknown')}): permanent for "
+                        "this revision; recorded, not retried"
+                    )
+                excluded |= bad
+                payload, chunk_names = build_payload(k)
+                if not payload:
+                    status = "skipped"
+                    break
+            if status == "skipped":
+                continue
             if status == "refused" and not any(frames.values()):
                 # the endpoint refused the group outright (e.g. 422: it
                 # contains non-anomaly models) before anything succeeded or
                 # was forwarded: score its machines through the per-machine
                 # path (which has its own 422 fallback) and return those
-                # results wholesale
+                # results wholesale (unavailable machines keep their
+                # recorded failures instead of re-POSTing a permanent 409)
                 return [
-                    self.predict_single_machine(
-                        machine=machine, start=start, end=end, revision=revision
+                    (
+                        self.predict_single_machine(
+                            machine=machine,
+                            start=start,
+                            end=end,
+                            revision=revision,
+                        )
+                        if name not in excluded
+                        else PredictionResult(
+                            name=name,
+                            predictions=pd.DataFrame(),
+                            error_messages=errors[name],
+                        )
                     )
-                    for machine, _, _ in data.values()
+                    for name, (machine, _, _) in data.items()
                 ]
             if status != "ok":
                 # mid-stream failure (or a refusal after earlier chunks
@@ -459,6 +526,10 @@ class Client:
         - ``("ok", response_dict)``
         - ``("refused", message)`` — a 4xx the server will repeat (422 mixed
           group, bad input): retrying is pointless, fall back or record
+        - ``("unavailable", MachineUnavailable)`` — a 409: the group
+          contains quarantined/build-failed machines (named in the
+          exception's ``unavailable`` dict); the caller records them as
+          per-machine failures and re-POSTs the healthy remainder
         - ``("io_error", message)`` — retries exhausted: record the failure;
           do NOT re-run the group per-machine (that doubles the backoff
           wall-clock against a server that is already down)
@@ -489,9 +560,14 @@ class Client:
                 )
                 if current_attempt <= self.n_retries:
                     _count_retry("fleet")
-                    time_to_sleep = backoff_seconds(current_attempt)
+                    # jittered: a fleet of clients bounced by one flapped
+                    # server must not re-arrive in lockstep
+                    time_to_sleep = backoff_seconds(
+                        current_attempt, jitter=DEFAULT_RETRY_JITTER
+                    )
                     logger.warning(
-                        "Fleet chunk failed attempt %d of %d; retrying in %ds",
+                        "Fleet chunk failed attempt %d of %d; retrying in "
+                        "%.1fs",
                         current_attempt,
                         self.n_retries,
                         time_to_sleep,
@@ -503,6 +579,16 @@ class Client:
             except ResourceGone:
                 _observe_request("fleet", "gone", monotonic() - attempt_start)
                 raise
+            except MachineUnavailable as exc:
+                _observe_request(
+                    "fleet", "unavailable", monotonic() - attempt_start
+                )
+                logger.warning(
+                    "Fleet endpoint refused group with 409 (unavailable "
+                    "machines: %s)",
+                    sorted(exc.unavailable) or "unnamed",
+                )
+                return "unavailable", exc
             except (HttpUnprocessableEntity, BadGordoRequest, NotFound) as exc:
                 _observe_request(
                     "fleet", "refused", monotonic() - attempt_start
@@ -622,9 +708,11 @@ class Client:
                 )
                 if current_attempt <= self.n_retries:
                     _count_retry("single")
-                    time_to_sleep = backoff_seconds(current_attempt)
+                    time_to_sleep = backoff_seconds(
+                        current_attempt, jitter=DEFAULT_RETRY_JITTER
+                    )
                     logger.warning(
-                        "Failed attempt %d of %d; retrying in %ds",
+                        "Failed attempt %d of %d; retrying in %.1fs",
                         current_attempt,
                         self.n_retries,
                         time_to_sleep,
@@ -634,6 +722,21 @@ class Client:
                 msg = (
                     f"Failed to get predictions for dates {start} -> {end} "
                     f"for target: '{machine.name}' Error: {exc}"
+                )
+                logger.error(msg)
+                return PredictionResult(
+                    name=machine.name, predictions=None, error_messages=[msg]
+                )
+            except MachineUnavailable as exc:
+                # 409: the build recorded this machine as failed or
+                # quarantined — permanent for the revision, so no retry
+                # and no fallback path; one recorded per-machine failure
+                _observe_request(
+                    "single", "unavailable", monotonic() - attempt_start
+                )
+                msg = (
+                    f"Machine '{machine.name}' is unavailable on the "
+                    f"server for dates {start} -> {end}: {exc}"
                 )
                 logger.error(msg)
                 return PredictionResult(

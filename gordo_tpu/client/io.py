@@ -31,6 +31,23 @@ class NotFound(Exception):
     """HTTP 404 (reference: gordo/client/io.py:37-42)."""
 
 
+class MachineUnavailable(Exception):
+    """
+    HTTP 409 — the machine exists but the server refuses predictions for
+    it: its build recorded it as fetch/build-failed or quarantined
+    (docs/robustness.md). PERMANENT for the served revision, so retrying
+    is pointless; callers record a per-machine failure instead.
+
+    ``unavailable`` holds the server's ``{name: {reason, ...}}`` detail
+    when the response carried one (fleet endpoints name every casualty
+    in the refused group).
+    """
+
+    def __init__(self, msg: str, unavailable: Optional[dict] = None):
+        super().__init__(msg)
+        self.unavailable = unavailable or {}
+
+
 def handle_response(
     resp: requests.Response, resource_name: Optional[str] = None
 ) -> Union[dict, bytes]:
@@ -41,8 +58,9 @@ def handle_response(
 
     Raises
     ------
-    HttpUnprocessableEntity, ResourceGone, NotFound, BadGordoRequest
-        For 422 / 410 / 404 / other 4xx respectively.
+    HttpUnprocessableEntity, ResourceGone, NotFound, MachineUnavailable,
+    BadGordoRequest
+        For 422 / 410 / 404 / 409 / other 4xx respectively.
     IOError
         For any 5xx or other unexpected status.
     """
@@ -66,6 +84,12 @@ def handle_response(
         raise ResourceGone(msg)
     if resp.status_code == 404:
         raise NotFound(msg)
+    if resp.status_code == 409:
+        try:
+            detail = resp.json().get("unavailable") or {}
+        except ValueError:
+            detail = {}
+        raise MachineUnavailable(msg, detail)
     if 400 <= resp.status_code <= 499:
         raise BadGordoRequest(msg)
     raise IOError(msg)

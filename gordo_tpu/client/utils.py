@@ -4,6 +4,7 @@ caches (standing in for cachetools, which this stack does not ship), and
 the gated influx client factory (reference parity: gordo/client/utils.py).
 """
 
+import random
 import threading
 import time
 from collections import OrderedDict, namedtuple
@@ -49,15 +50,52 @@ class _BoundedCache:
 _CACHE_MISS = object()
 
 
-def backoff_seconds(attempt: int, cap: int = 300) -> int:
+#: Default jitter fraction for retrying call sites (client POST loops):
+#: each delay lands uniformly in [base*(1-0.25), base], so a fleet of
+#: clients kicked loose by one flapped server desynchronizes instead of
+#: re-arriving as a thundering herd on the exact 8/16/32s marks.
+DEFAULT_RETRY_JITTER = 0.25
+
+#: Process-wide jitter stream; reseed with :func:`seed_backoff_jitter`
+#: for deterministic schedules (tests, reproducible chaos runs).
+_jitter_rng = random.Random()
+
+
+def seed_backoff_jitter(seed: Optional[int]) -> None:
+    """Reseed the shared backoff-jitter stream (None = OS entropy)."""
+    global _jitter_rng
+    _jitter_rng = random.Random(seed)
+
+
+def backoff_seconds(
+    attempt: int,
+    cap: int = 300,
+    jitter: float = 0.0,
+    rng: Optional[random.Random] = None,
+) -> float:
     """
     Shared retry policy: exponential backoff starting at 8s, capped
     (reference: gordo/client/client.py:460-473, forwarders.py:177-215).
 
+    ``jitter`` (fraction in [0, 1]) spreads the delay uniformly over
+    ``[base*(1-jitter), base]`` — retrying herds decorrelate while the
+    cap is still honored. The stream is the module's seedable RNG
+    (:func:`seed_backoff_jitter`) unless ``rng`` overrides it, so tests
+    get deterministic schedules.
+
     >>> [backoff_seconds(n) for n in (1, 2, 3, 7)]
     [8, 16, 32, 300]
+    >>> seed_backoff_jitter(42)
+    >>> a = backoff_seconds(1, jitter=0.25)
+    >>> seed_backoff_jitter(42)
+    >>> a == backoff_seconds(1, jitter=0.25) and 6.0 <= a <= 8.0
+    True
     """
-    return min(2 ** (attempt + 2), cap)
+    base = min(2 ** (attempt + 2), cap)
+    if not jitter:
+        return base
+    source = rng if rng is not None else _jitter_rng
+    return base * (1.0 - jitter * source.random())
 
 
 def cached_method(maxsize: int = 128, ttl: Optional[float] = None):

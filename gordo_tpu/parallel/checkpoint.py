@@ -8,14 +8,27 @@ This module adds the granularity the reference never needed: epoch-level
 checkpoints of the *stacked fleet* (params + optimizer state), so a long
 fleet build on a preemptible TPU slice resumes from the last completed
 epoch instead of refitting every machine from scratch.
+
+Torn-write tolerance (docs/robustness.md): each committed checkpoint
+gets a ``manifest.json`` of file sizes written after the async save
+lands; ``restore`` verifies the manifest and falls back — with a
+warning and a ``checkpoint_fallback`` event — to the previous kept
+epoch when the latest one is torn or otherwise unrestorable, instead of
+crashing the resume. The ``ckpt:torn`` fault-injection spec exercises
+exactly this path.
 """
 
+import json
 import logging
-from typing import Any, Dict, Optional, Tuple
+import os
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
 logger = logging.getLogger(__name__)
+
+MANIFEST_FILENAME = "manifest.json"
 
 
 class FleetCheckpointer:
@@ -35,10 +48,17 @@ class FleetCheckpointer:
             self.directory,
             options=ocp.CheckpointManagerOptions(max_to_keep=keep),
         )
+        #: steps saved but not yet manifest-stamped (saves are async; the
+        #: manifest must describe the COMMITTED files, so it is written
+        #: after wait_until_finished)
+        self._pending_manifest: List[int] = []
 
     def latest_epoch(self) -> Optional[int]:
         """Last checkpointed epoch number, or None."""
         return self._manager.latest_step()
+
+    def _step_dir(self, epoch: int) -> Path:
+        return Path(self.directory) / str(epoch)
 
     def save(
         self,
@@ -49,14 +69,161 @@ class FleetCheckpointer:
     ) -> None:
         """
         ``extra`` is a small dict of host numpy arrays (e.g. the fleet
-        trainer's per-machine early-stopping state) stored inside the
-        orbax payload, so it rides the same cloud-storage/multi-host
-        coordination as the params.
+        trainer's per-machine early-stopping and quarantine state) stored
+        inside the orbax payload, so it rides the same cloud-storage/
+        multi-host coordination as the params.
         """
         payload = {"params": params, "opt_state": opt_state}
         if extra is not None:
             payload["extra"] = {k: np.asarray(v) for k, v in extra.items()}
         self._manager.save(epoch, args=self._ocp.args.StandardSave(payload))
+        self._pending_manifest.append(epoch)
+
+    # -- torn-write verification -----------------------------------------
+
+    def _flush_manifests(self) -> None:
+        """
+        Stamp every landed save with a size manifest (and run the
+        ``ckpt:torn`` injection seam AFTER stamping, so an injected tear
+        is exactly what the verifier is built to catch).
+        """
+        if not self._pending_manifest:
+            return
+        from gordo_tpu.robustness import faults
+
+        self._manager.wait_until_finished()
+        pending, self._pending_manifest = self._pending_manifest, []
+        for epoch in pending:
+            step_dir = self._step_dir(epoch)
+            if not step_dir.is_dir():  # evicted by max_to_keep already
+                continue
+            manifest: Dict[str, int] = {}
+            for root, _, files in os.walk(step_dir):
+                for fname in files:
+                    if fname == MANIFEST_FILENAME:
+                        continue
+                    path = Path(root) / fname
+                    manifest[str(path.relative_to(step_dir))] = (
+                        path.stat().st_size
+                    )
+            tmp = step_dir / (MANIFEST_FILENAME + ".tmp")
+            with open(tmp, "w") as fh:
+                json.dump(manifest, fh)
+            os.replace(tmp, step_dir / MANIFEST_FILENAME)
+            faults.tear_checkpoint_files(step_dir)
+
+    def _verify(self, epoch: int) -> bool:
+        """
+        Check the step's files against its manifest. A checkpoint without
+        a manifest (older layout, or a crash between commit and stamp) is
+        not rejected — restore itself is the arbiter there.
+        """
+        step_dir = self._step_dir(epoch)
+        manifest_path = step_dir / MANIFEST_FILENAME
+        if not manifest_path.is_file():
+            return True
+        try:
+            with open(manifest_path) as fh:
+                manifest = json.load(fh)
+        except ValueError:
+            logger.warning(
+                "Checkpoint %s has an unreadable manifest; treating as torn",
+                step_dir,
+            )
+            return False
+        for rel, size in manifest.items():
+            path = step_dir / rel
+            if not path.is_file() or path.stat().st_size != int(size):
+                logger.warning(
+                    "Checkpoint %s is torn: %s is %s bytes, manifest says %d",
+                    step_dir,
+                    rel,
+                    path.stat().st_size if path.is_file() else "missing",
+                    int(size),
+                )
+                return False
+        return True
+
+    def _candidate_epochs(self, epoch: Optional[int]) -> List[int]:
+        """Requested epoch only, or every kept epoch newest-first."""
+        if epoch is not None:
+            return [epoch]
+        steps = sorted(self._manager.all_steps(), reverse=True)
+        if not steps:
+            raise FileNotFoundError(f"No checkpoints under {self.directory}")
+        return steps
+
+    def _restore_verified(
+        self, templates: List[dict], epoch: Optional[int]
+    ) -> Tuple[dict, int, int]:
+        """
+        Restore the newest checkpoint that verifies AND restores,
+        falling back across kept epochs — a torn latest checkpoint costs
+        the epochs since the previous one, not the whole resume.
+
+        ``templates`` are tried in order at EACH epoch (the with-extra
+        then without-extra layouts), so an older checkpoint saved with a
+        different extra layout still restores at its own epoch. Returns
+        (restored payload, epoch, index of the template that matched).
+        """
+        candidates = self._candidate_epochs(epoch)
+        last_error: Optional[Exception] = None
+        for step in candidates:
+            if not self._verify(step):
+                self._fallback_event(step, "manifest mismatch")
+                self._delete_step(step)
+                continue
+            for index, template in enumerate(templates):
+                try:
+                    restored = self._manager.restore(
+                        step, args=self._ocp.args.StandardRestore(template)
+                    )
+                except Exception as exc:  # layout mismatch or corruption
+                    last_error = exc
+                    continue
+                return restored, step, index
+            # NOT deleted here: a restore exception can be a mere
+            # template/layout mismatch (resuming with different options),
+            # and destroying real data on that evidence would be worse
+            # than the torn write this path defends against — only a
+            # manifest mismatch (confirmed torn files) deletes above
+            logger.warning(
+                "Checkpoint at epoch %d failed to restore (%s); "
+                "falling back to the previous kept epoch",
+                step,
+                last_error,
+            )
+            self._fallback_event(step, repr(last_error))
+        raise FileNotFoundError(
+            f"No restorable checkpoint under {self.directory} "
+            f"(tried epochs {candidates}; last error: {last_error!r})"
+        )
+
+    def _delete_step(self, epoch: int) -> None:
+        """
+        Drop a rejected (torn/unrestorable) checkpoint: the resumed fit
+        will re-reach this epoch and ``save`` refuses a step that still
+        exists — keeping the corpse would just defer the crash to the
+        next checkpoint boundary (and re-reject it on every restore).
+        """
+        import shutil
+
+        logger.warning(
+            "Deleting unrestorable checkpoint at epoch %d so the resumed "
+            "fit can re-save it", epoch,
+        )
+        try:
+            self._manager.delete(epoch)
+        except Exception:
+            shutil.rmtree(self._step_dir(epoch), ignore_errors=True)
+
+    @staticmethod
+    def _fallback_event(epoch: int, reason: str) -> None:
+        from gordo_tpu.observability import emit_event
+
+        emit_event("checkpoint_fallback", epoch=int(epoch), reason=reason)
+
+    # -- restore ----------------------------------------------------------
 
     def restore_with_extra(
         self,
@@ -64,34 +231,56 @@ class FleetCheckpointer:
         opt_state_template: Any,
         extra_template: Dict[str, np.ndarray],
         epoch: Optional[int] = None,
+        optional_extra_keys: Tuple[str, ...] = (),
     ) -> Tuple[Any, Any, int, Optional[Dict[str, np.ndarray]]]:
         """
         Like :meth:`restore`, also recovering the ``extra`` dict. Returns
         extra=None (with params/opt_state still restored) when the
-        checkpoint predates extra-state saving.
+        checkpoint predates extra-state saving or was saved with a
+        different extra layout.
+
+        ``optional_extra_keys`` name template entries a checkpoint may
+        legitimately carry a different subset of (e.g. the quarantine
+        mask, saved by plain fits alone but absent from pre-quarantine
+        early-stopping checkpoints): both the layouts without them and
+        the optional-keys-only layout are tried before giving up on
+        extra entirely, so such a checkpoint still restores the extra
+        state it DOES carry.
         """
-        epoch = self._manager.latest_step() if epoch is None else epoch
-        if epoch is None:
-            raise FileNotFoundError(f"No checkpoints under {self.directory}")
-        template = {
-            "params": params_template,
-            "opt_state": opt_state_template,
-            "extra": {k: np.asarray(v) for k, v in extra_template.items()},
+        self._flush_manifests()
+        plain = {"params": params_template, "opt_state": opt_state_template}
+
+        def with_extra(template: Dict[str, np.ndarray]) -> dict:
+            return dict(
+                plain,
+                extra={k: np.asarray(v) for k, v in template.items()},
+            )
+
+        templates = [with_extra(extra_template)]
+        reduced = dict(extra_template)
+        for key in optional_extra_keys:
+            if key in reduced and len(reduced) > 1:
+                reduced = {k: v for k, v in reduced.items() if k != key}
+                templates.append(with_extra(reduced))
+        optional_only = {
+            k: extra_template[k]
+            for k in optional_extra_keys
+            if k in extra_template
         }
-        try:
-            restored = self._manager.restore(
-                epoch, args=self._ocp.args.StandardRestore(template)
-            )
-            extra = {
-                k: np.asarray(v) for k, v in restored["extra"].items()
-            }
-        except Exception:
-            params, opt_state, epoch = self.restore(
-                params_template, opt_state_template, epoch
-            )
-            return params, opt_state, epoch, None
-        logger.info("Restored fleet checkpoint (+extra state) at epoch %d", epoch)
-        return restored["params"], restored["opt_state"], epoch, extra
+        if optional_only and len(optional_only) < len(extra_template):
+            # e.g. a plain quarantine fit's {"healthy"}-only checkpoint
+            # resumed by an early-stopping fit
+            templates.append(with_extra(optional_only))
+        templates.append(plain)
+        restored, found, which = self._restore_verified(templates, epoch)
+        if which == len(templates) - 1:  # only the bare layout matched
+            logger.info("Restored fleet checkpoint at epoch %d", found)
+            return restored["params"], restored["opt_state"], found, None
+        extra = {k: np.asarray(v) for k, v in restored["extra"].items()}
+        logger.info(
+            "Restored fleet checkpoint (+extra state) at epoch %d", found
+        )
+        return restored["params"], restored["opt_state"], found, extra
 
     def restore(
         self, params_template: Any, opt_state_template: Any, epoch: Optional[int] = None
@@ -99,23 +288,22 @@ class FleetCheckpointer:
         """
         Restore (params, opt_state, epoch). Templates (e.g. freshly
         initialized state) carry the tree structure and shardings the
-        arrays restore into.
+        arrays restore into. A torn/corrupt latest checkpoint falls back
+        to the previous kept epoch (see module docstring) instead of
+        crashing the resume.
         """
-        epoch = self._manager.latest_step() if epoch is None else epoch
-        if epoch is None:
-            raise FileNotFoundError(f"No checkpoints under {self.directory}")
-        restored = self._manager.restore(
+        self._flush_manifests()
+        restored, found, _ = self._restore_verified(
+            [{"params": params_template, "opt_state": opt_state_template}],
             epoch,
-            args=self._ocp.args.StandardRestore(
-                {"params": params_template, "opt_state": opt_state_template}
-            ),
         )
-        logger.info("Restored fleet checkpoint at epoch %d", epoch)
-        return restored["params"], restored["opt_state"], epoch
+        logger.info("Restored fleet checkpoint at epoch %d", found)
+        return restored["params"], restored["opt_state"], found
 
     def wait(self) -> None:
-        """Block until async checkpoint writes land."""
+        """Block until async checkpoint writes land (and stamp them)."""
         self._manager.wait_until_finished()
+        self._flush_manifests()
 
     def close(self) -> None:
         self._manager.close()

@@ -63,12 +63,44 @@ def initialize(
         num_processes=num_processes,
         process_id=process_id,
     )
+    _enable_cpu_collectives()
     _initialized = True
     logger.info(
         "jax.distributed initialized: process %d of %d",
         jax.process_index(),
         jax.process_count(),
     )
+
+
+def _enable_cpu_collectives() -> None:
+    """
+    Multi-process on the CPU backend needs the gloo collectives
+    implementation: without it, XLA:CPU refuses ANY multiprocess
+    computation — including the hidden ``broadcast_one_to_all`` inside
+    ``jax.device_put`` onto a global sharding and the
+    ``process_allgather`` behind ``fleet.host_fetch`` ("Multiprocess
+    computations aren't implemented on the CPU backend"). TPU/GPU
+    backends ignore the setting. Runs AFTER jax.distributed.initialize
+    (gloo needs the live distributed client at backend creation, so a
+    process without one — single host, or a stubbed initialize in tests
+    — must not flip the flag) but before the backend itself
+    initializes, which is why it sits inside :func:`initialize`.
+    """
+    platforms = str(jax.config.jax_platforms or "")
+    if platforms and "cpu" not in platforms.split(","):
+        return  # explicitly pinned to a non-CPU backend
+    try:
+        from jax._src.distributed import global_state
+
+        if global_state.client is None:
+            return  # no live distributed runtime to build collectives on
+    except Exception:
+        return
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        logger.info("CPU backend: enabled gloo cross-process collectives")
+    except Exception as exc:  # jaxlib built without gloo
+        logger.warning("Could not enable CPU gloo collectives: %s", exc)
 
 
 def global_mesh(

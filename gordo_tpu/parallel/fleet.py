@@ -37,6 +37,7 @@ from jax.sharding import Mesh
 from gordo_tpu.models.specs import ModelSpec, per_sample_loss
 from gordo_tpu.observability import emit_event, get_registry
 from gordo_tpu.parallel.mesh import fleet_sharding, pad_to_multiple, replicated_sharding
+from gordo_tpu.robustness import faults as _faults
 
 logger = logging.getLogger(__name__)
 
@@ -59,6 +60,16 @@ def _keep_better(mask, new_tree, old_tree):
         return jnp.where(mask.reshape(shape), new_leaf, old_leaf)
 
     return jax.tree_util.tree_map(select, new_tree, old_tree)
+
+
+def _put_fleet_arr(x, mesh: Optional[Mesh]):
+    """Small per-machine (M,)-shaped array onto the fleet sharding (or
+    the default device when unmeshed) — the flag/state arrays the gated
+    programs take (``active``/``healthy``/injection masks)."""
+    arr = jnp.asarray(x)
+    if mesh is not None:
+        arr = jax.device_put(arr, fleet_sharding(mesh))
+    return arr
 
 
 def host_fetch(x):
@@ -165,6 +176,17 @@ class FleetTrainer:
         epoch (an unmonitored fit syncs only at fit end). Scheduling
         only: results are bit-identical to ``epoch_chunk=1``; a stopped
         fleet wastes at most K-1 gated (no-op) epochs of device work.
+    quarantine_nonfinite
+        In-program non-finite guard (docs/robustness.md): a per-machine
+        ``healthy`` flag rides the compiled program, and a machine whose
+        epoch loss or updated params go non-finite is QUARANTINED — its
+        params roll back to the last finite epoch's values via the same
+        masked select early stopping uses, and it stops updating while
+        the rest of the fleet trains on. The quarantine mask comes back
+        through the existing history fetches (``self.healthy_`` /
+        ``self.quarantine_epoch_``) at zero additional host syncs. For
+        finite-loss machines the guard's selects are identity, so
+        results are bit-identical to running without it.
     """
 
     def __init__(
@@ -177,6 +199,7 @@ class FleetTrainer:
         optimizer: Optional[Any] = None,
         broadcast_data: bool = False,
         epoch_chunk: int = 1,
+        quarantine_nonfinite: bool = True,
     ):
         self.spec = spec
         self.lookahead = int(lookahead) if spec.windowed else 0
@@ -185,6 +208,7 @@ class FleetTrainer:
         self.scan_unroll = max(1, int(scan_unroll))
         self.broadcast_data = broadcast_data
         self.epoch_chunk = max(1, int(epoch_chunk))
+        self.quarantine_nonfinite = bool(quarantine_nonfinite)
         self._optimizer = optimizer if optimizer is not None else spec.make_optimizer()
         self._epoch_fn_cache: dict = {}
         self._predict_fn_cache: dict = {}
@@ -281,6 +305,8 @@ class FleetTrainer:
         shuffle: bool,
         gated: bool = False,
         sample_cap: Optional[int] = None,
+        quarantine: bool = False,
+        inject: bool = False,
     ):
         """
         Build (and cache) the jitted fleet-epoch function for a given
@@ -288,8 +314,22 @@ class FleetTrainer:
         reused across the whole fleet and all epochs/folds.
 
         ``gated`` variants take a per-machine ``active`` flag (early
-        stopping); the ungated program skips the full-tree select so
-        ordinary fits don't pay for the feature.
+        stopping); the ungated program skips ITS full-tree select so
+        ordinary fits don't pay for early stopping.
+
+        ``quarantine`` variants take (and return) a per-machine
+        ``healthy`` flag: a machine whose loss or updated params go
+        non-finite keeps its entering params (the non-finite guard,
+        docs/robustness.md). This is the one feature that IS paid for
+        by default (``quarantine_nonfinite=True``): one isfinite
+        reduction over the updated params and one fused masked select
+        per machine per epoch — element-wise work, a rounding error
+        next to the epoch's matmuls, bought deliberately so a silent
+        NaN can never poison a fleet that didn't opt in to a guard.
+        ``inject`` variants additionally take a per-machine NaN-poison
+        flag — the fault-injection seam, traced into the program ONLY
+        when a ``train:nan`` fault is configured, so fault-free
+        programs stay byte-identical to injection-off builds.
 
         ``sample_cap`` bounds the scan at ``ceil(cap / batch_size)``
         optimizer steps — the fleet-wide maximum of REAL samples, computed
@@ -304,21 +344,24 @@ class FleetTrainer:
         samples leaves params and optimizer state untouched.
         """
         n_batches = self._n_batches(n, batch_size, sample_cap)
-        cache_key = (n, batch_size, shuffle, gated, n_batches)
+        cache_key = (n, batch_size, shuffle, gated, n_batches, quarantine, inject)
         if cache_key in self._epoch_fn_cache:
             return self._epoch_fn_cache[cache_key]
 
-        fleet_epoch = self._epoch_callable(n, batch_size, shuffle, gated, n_batches)
-        n_args = 7 if gated else 6
+        fleet_epoch = self._epoch_callable(
+            n, batch_size, shuffle, gated, n_batches,
+            quarantine=quarantine, inject=inject,
+        )
+        n_args = 6 + int(gated) + int(quarantine) + int(inject)
         jit_kwargs: dict = {}
         if self.mesh is not None:
             fs = fleet_sharding(self.mesh)
             rs = replicated_sharding(self.mesh)
             data_sh = rs if self.broadcast_data else fs
-            jit_kwargs["in_shardings"] = (
-                fs, fs, fs, data_sh, data_sh, data_sh, fs
-            )[:n_args]
-            jit_kwargs["out_shardings"] = (fs, fs, fs)
+            jit_kwargs["in_shardings"] = tuple(
+                data_sh if i in (3, 4, 5) else fs for i in range(n_args)
+            )
+            jit_kwargs["out_shardings"] = (fs,) * (4 if quarantine else 3)
         if self.donate:
             jit_kwargs["donate_argnums"] = (0, 1)
 
@@ -327,7 +370,14 @@ class FleetTrainer:
         return fn
 
     def _epoch_callable(
-        self, n: int, batch_size: int, shuffle: bool, gated: bool, n_batches: int
+        self,
+        n: int,
+        batch_size: int,
+        shuffle: bool,
+        gated: bool,
+        n_batches: int,
+        quarantine: bool = False,
+        inject: bool = False,
     ):
         """
         The RAW (un-jitted) vmapped fleet-epoch callable for a geometry,
@@ -335,8 +385,16 @@ class FleetTrainer:
         multi-epoch chunk program (``_chunk_fn``) trace the IDENTICAL
         computation — chunking must be a scheduling change, not a
         numerics change.
+
+        Per-machine extras ride after the data args in a fixed order:
+        ``active`` (``gated``), ``healthy`` (``quarantine``), and the
+        NaN-poison flag (``inject``); quarantine variants return the
+        updated ``healthy`` as a fourth output.
         """
-        cache_key = ("epoch_raw", n, batch_size, shuffle, gated, n_batches)
+        cache_key = (
+            "epoch_raw", n, batch_size, shuffle, gated, n_batches,
+            quarantine, inject,
+        )
         if cache_key in self._epoch_fn_cache:
             return self._epoch_fn_cache[cache_key]
 
@@ -380,7 +438,7 @@ class FleetTrainer:
                 yb = yi[sel]
             return xb, yb
 
-        def machine_epoch(params, opt_state, key, Xi, yi, wi, active=None):
+        def machine_epoch(params, opt_state, key, Xi, yi, wi, *extras):
             """
             One epoch for ONE machine; vmapped over the fleet axis.
 
@@ -390,7 +448,18 @@ class FleetTrainer:
             zero-weighting alone would still let regularization-penalty
             gradients, optimizer momentum, and weight decay drift the
             params.
+
+            ``healthy`` (scalar bool, quarantine variants) gates the
+            same way, and flips False — permanently, for this fit —
+            when the machine's epoch loss or updated params go
+            non-finite: the faulted epoch's update is discarded, so the
+            machine freezes at its last finite params (the quarantine
+            guard, docs/robustness.md).
             """
+            _extras = list(extras)
+            active = _extras.pop(0) if gated else None
+            healthy = _extras.pop(0) if quarantine else None
+            inj_flag = _extras.pop(0) if inject else None
             wb_all = sample_weights(wi)            # (n_samples,)
             real = wb_all > 0
             if shuffle:
@@ -448,8 +517,21 @@ class FleetTrainer:
                 (sel_all, pm_all, step_ids),
                 unroll=min(self.scan_unroll, n_batches),
             )
-            if gated:
-                keep = active > 0.5
+            epoch_loss = jnp.sum(loss_sums) / jnp.maximum(jnp.sum(w_sums), 1.0)
+            if inject:
+                # the train:nan fault seam: poison this machine's epoch
+                # loss so the guard below sees exactly what a real
+                # divergence produces
+                epoch_loss = jnp.where(inj_flag, jnp.nan, epoch_loss)
+            keep = active > 0.5 if gated else None
+            healthy_out = None
+            if quarantine:
+                finite = jnp.isfinite(epoch_loss)
+                for leaf in jax.tree.leaves(new_params):
+                    finite = finite & jnp.all(jnp.isfinite(leaf))
+                healthy_out = healthy & finite
+                keep = healthy_out if keep is None else keep & healthy_out
+            if keep is not None:
                 params = jax.tree.map(
                     lambda new, old: jnp.where(keep, new, old),
                     new_params,
@@ -462,13 +544,15 @@ class FleetTrainer:
                 )
             else:
                 params, opt_state = new_params, new_opt
-            epoch_loss = jnp.sum(loss_sums) / jnp.maximum(jnp.sum(w_sums), 1.0)
+            if quarantine:
+                return params, opt_state, epoch_loss, healthy_out
             return params, opt_state, epoch_loss
 
-        n_args = 7 if gated else 6
+        n_args = 6 + int(gated) + int(quarantine) + int(inject)
         if self.broadcast_data:
-            # one shared dataset; only params/opt/keys carry the fleet axis
-            in_axes = (0, 0, 0, None, None, None, 0)[:n_args]
+            # one shared dataset; only params/opt/keys (and the
+            # per-machine flags) carry the fleet axis
+            in_axes = tuple(None if i in (3, 4, 5) else 0 for i in range(n_args))
             fleet_epoch = jax.vmap(machine_epoch, in_axes=in_axes)
         else:
             fleet_epoch = jax.vmap(machine_epoch, in_axes=(0,) * n_args)
@@ -577,6 +661,8 @@ class FleetTrainer:
         es_delta: float = 0.0,
         es_stop_at: int = 1,
         es_start_from: int = 0,
+        quarantine: bool = False,
+        inject: bool = False,
     ):
         """
         Build (and cache) the fused multi-epoch program: an outer
@@ -597,11 +683,15 @@ class FleetTrainer:
             "chunk", n, batch_size, shuffle, chunk_len, n_batches, with_val,
             val_lo, gated, track_best, monitor_val,
             float(es_delta), int(es_stop_at), int(es_start_from),
+            quarantine, inject,
         )
         if cache_key in self._epoch_fn_cache:
             return self._epoch_fn_cache[cache_key]
 
-        fleet_epoch = self._epoch_callable(n, batch_size, shuffle, gated, n_batches)
+        fleet_epoch = self._epoch_callable(
+            n, batch_size, shuffle, gated, n_batches,
+            quarantine=quarantine, inject=inject,
+        )
         fleet_val = self._val_callable(n, batch_size, val_lo) if with_val else None
 
         def chunk_program(params, opt_state, keys, X, y, w, epoch_ids, *rest):
@@ -609,6 +699,8 @@ class FleetTrainer:
             val_w = rest.pop(0) if with_val else None
             carry = {"params": params, "opt": opt_state}
             has_val = None
+            if quarantine:
+                carry["healthy"] = rest.pop(0)  # (M,) bool
             if gated:
                 carry["es"] = {
                     "active": rest.pop(0),  # (M,) bool
@@ -618,6 +710,10 @@ class FleetTrainer:
                 }
                 if monitor_val:
                     has_val = rest.pop(0)   # (M,) bool
+            inj_mask = inj_epoch = None
+            if inject:
+                inj_mask = rest.pop(0)      # (M,) bool
+                inj_epoch = rest.pop(0)     # scalar i32
             if track_best:
                 carry["best_params"] = rest.pop(0)
                 carry["ever_improved"] = rest.pop(0)  # scalar bool
@@ -631,17 +727,26 @@ class FleetTrainer:
                 )(keys)
                 new = dict(carry)
                 outs = {}
+                extras = []
                 if gated:
                     es = carry["es"]
-                    active_f = es["active"].astype(jnp.float32)
-                    p, o, loss = fleet_epoch(
-                        carry["params"], carry["opt"], epoch_keys,
-                        X, y, w, active_f,
-                    )
+                    extras.append(es["active"].astype(jnp.float32))
+                if quarantine:
+                    extras.append(carry["healthy"])
+                if inject:
+                    # same per-machine flag the per-epoch loop computes
+                    # on host: poison only at the configured epoch
+                    extras.append(inj_mask & (epoch_id == inj_epoch))
+                result = fleet_epoch(
+                    carry["params"], carry["opt"], epoch_keys,
+                    X, y, w, *extras,
+                )
+                if quarantine:
+                    p, o, loss, healthy_out = result
+                    new["healthy"] = healthy_out
+                    outs["healthy"] = healthy_out
                 else:
-                    p, o, loss = fleet_epoch(
-                        carry["params"], carry["opt"], epoch_keys, X, y, w
-                    )
+                    p, o, loss = result
                 new["params"], new["opt"] = p, o
                 vloss = None
                 if with_val:
@@ -704,7 +809,12 @@ class FleetTrainer:
                 # best_params rides the carry; its input buffer is dead
                 # after the call exactly like params/opt_state
                 donate.append(
-                    7 + (1 if with_val else 0) + 4 + (1 if monitor_val else 0)
+                    7
+                    + (1 if with_val else 0)
+                    + (1 if quarantine else 0)
+                    + 4  # track_best implies gated (the ES state args)
+                    + (1 if monitor_val else 0)
+                    + (2 if inject else 0)
                 )
             jit_kwargs["donate_argnums"] = tuple(donate)
         # shardings propagate from the committed inputs (params/data are
@@ -796,9 +906,21 @@ class FleetTrainer:
         restore_best_weights: bool = False,
         validation_split: float = 0.0,
         early_stopping_on_val: Optional[bool] = None,
+        machine_names: Optional[List[str]] = None,
     ) -> Tuple[Any, np.ndarray]:
         """
         Train the fleet. Returns (stacked params, losses (epochs, M)).
+
+        With ``quarantine_nonfinite`` (the default), a machine whose
+        epoch loss or updated params go non-finite is quarantined
+        in-program: its params roll back to the last finite epoch and
+        freeze while the rest of the fleet trains on. The mask comes
+        back with the history fetches — ``self.healthy_`` (final (M,)
+        mask), ``self.quarantine_epoch_`` ((M,) first faulted epoch, -1
+        for healthy) and ``self.healthy_history_`` — at zero additional
+        host syncs. ``machine_names`` (optional, fleet order) names the
+        casualties in ``machine_quarantined`` events and lets
+        ``GORDO_FAULT_INJECT`` train faults target machines by name.
 
         ``opt_state`` lets callers pre-build/modify the stacked optimizer
         state (e.g. per-machine hyperparameters via inject_hyperparams);
@@ -887,6 +1009,15 @@ class FleetTrainer:
 
         early_stopping = early_stopping_patience is not None
         m = len(keys)  # the fleet axis (== data.n_machines unless broadcast)
+        quarantine = self.quarantine_nonfinite
+        # the train:nan fault seam, resolved ONCE per fit: None unless a
+        # matching GORDO_FAULT_INJECT spec targets this fleet (and then
+        # an ((M,) mask, epoch) pair baked into a distinct program)
+        inj = _faults.train_nan_injection(machine_names, m)
+        healthy_np = np.ones(m, dtype=bool)
+        self.healthy_: Optional[np.ndarray] = None
+        self.quarantine_epoch_: Optional[np.ndarray] = None
+        self.healthy_history_: Optional[np.ndarray] = None
         if has_val is not None and has_val.shape[0] != m:
             # broadcast_data: masks are per weight ROW (the one shared
             # dataset), but monitored metrics and val columns are per
@@ -904,16 +1035,33 @@ class FleetTrainer:
 
         start_epoch = 0
         if checkpointer is not None and checkpointer.latest_epoch() is not None:
+            extra_template: dict = {}
+            if quarantine:
+                extra_template["healthy"] = healthy_np
             if early_stopping:
-                params, opt_state, done, restored_es = (
-                    checkpointer.restore_with_extra(params, opt_state, es_state)
+                extra_template.update(es_state)
+            if extra_template:
+                params, opt_state, done, restored_extra = (
+                    checkpointer.restore_with_extra(
+                        params, opt_state, extra_template,
+                        # a pre-quarantine ES checkpoint lacks "healthy";
+                        # its ES state must still restore
+                        optional_extra_keys=("healthy",),
+                    )
                 )
-                if restored_es is not None:
-                    es_state = {
-                        k: np.asarray(v) for k, v in restored_es.items()
+                if restored_extra is not None:
+                    restored_extra = {
+                        k: np.asarray(v) for k, v in restored_extra.items()
                     }
+                    restored_healthy = restored_extra.pop("healthy", None)
+                    if quarantine and restored_healthy is not None:
+                        healthy_np = restored_healthy.astype(bool)
+                if early_stopping and restored_extra and "active" in restored_extra:
+                    es_state = restored_extra
                     es_state["active"] = es_state["active"].astype(bool)
-                else:
+                elif early_stopping:
+                    # no (or healthy-only) extra: a checkpoint from a
+                    # plain fit or an older layout
                     logger.warning(
                         "Resuming an early-stopping fleet fit without saved "
                         "early-stop state (older checkpoint?): stopped "
@@ -971,6 +1119,8 @@ class FleetTrainer:
                 track_best=track_best, checkpointer=checkpointer,
                 checkpoint_every=checkpoint_every, start_epoch=start_epoch,
                 m=m, rows_per_machine=rows_per_machine, fit_start=fit_start,
+                quarantine=quarantine, inj=inj, healthy_np=healthy_np,
+                machine_names=machine_names,
             )
 
         epoch_fn = self._epoch_fn(
@@ -979,6 +1129,8 @@ class FleetTrainer:
             shuffle,
             gated=early_stopping,
             sample_cap=sample_cap,
+            quarantine=quarantine,
+            inject=inj is not None,
         )
         val_fn = (
             self._val_fn(data.n_timesteps, batch_size, lo=val_lo)
@@ -987,6 +1139,10 @@ class FleetTrainer:
         )
 
         best_params = None  # set at the first monitored improvement
+
+        healthy_entry = healthy_np.copy()
+        healthy_dev = _put_fleet_arr(healthy_np, self.mesh) if quarantine else None
+        healthy_rows: list = []
 
         losses = []
         val_losses: list = []
@@ -1004,17 +1160,28 @@ class FleetTrainer:
         for epoch in range(start_epoch, epochs):
             epoch_start = time.perf_counter()
             epoch_keys = jax.vmap(lambda k: jax.random.fold_in(k, epoch))(keys)
+            extras = []
             if early_stopping:
-                active = jnp.asarray(es_state["active"].astype(np.float32))
-                if self.mesh is not None:
-                    active = jax.device_put(active, fleet_sharding(self.mesh))
-                params, opt_state, epoch_loss = epoch_fn(
-                    params, opt_state, epoch_keys, X_arg, y_arg, w_arg, active
+                extras.append(
+                    _put_fleet_arr(
+                        es_state["active"].astype(np.float32), self.mesh
+                    )
                 )
+            if quarantine:
+                extras.append(healthy_dev)
+            if inj is not None:
+                # the host-side twin of the chunk program's in-scan
+                # flag: poison only at the configured epoch
+                extras.append(
+                    _put_fleet_arr(inj[0] & (epoch == inj[1]), self.mesh)
+                )
+            result = epoch_fn(
+                params, opt_state, epoch_keys, X_arg, y_arg, w_arg, *extras
+            )
+            if quarantine:
+                params, opt_state, epoch_loss, healthy_dev = result
             else:
-                params, opt_state, epoch_loss = epoch_fn(
-                    params, opt_state, epoch_keys, X_arg, y_arg, w_arg
-                )
+                params, opt_state, epoch_loss = result
             # host-side cost of issuing this epoch (key vmap + dispatch);
             # the async device work itself is not included
             dispatch_times.append(time.perf_counter() - epoch_start)
@@ -1037,8 +1204,24 @@ class FleetTrainer:
             # links); all losses are pulled in one transfer after the loop
             # (except under early stopping, whose per-epoch decision IS a
             # sync)
+            if quarantine and not early_stopping:
+                # device-resident history row; the end-of-fit bulk fetch
+                # pulls it with the losses (no extra sync)
+                healthy_rows.append(healthy_dev)
             if early_stopping:
-                loss_np = np.asarray(host_fetch(epoch_loss), dtype=np.float64)
+                if quarantine:
+                    # healthy rides the SAME per-epoch decision sync the
+                    # ES path already pays — one call, one transfer
+                    step_fetch = host_fetch(
+                        {"loss": epoch_loss, "healthy": healthy_dev}
+                    )
+                    loss_np = np.asarray(step_fetch["loss"], dtype=np.float64)
+                    healthy_np = np.asarray(step_fetch["healthy"], dtype=bool)
+                    healthy_rows.append(healthy_np)
+                else:
+                    loss_np = np.asarray(
+                        host_fetch(epoch_loss), dtype=np.float64
+                    )
                 n_host_syncs += 1
                 # a stopped machine's computed loss reflects a discarded
                 # would-be update; report its last active loss instead
@@ -1085,11 +1268,7 @@ class FleetTrainer:
                         es_state["wait"] < es_stop_at
                     )
                     if track_best and improved.any():
-                        mask = jnp.asarray(improved)
-                        if self.mesh is not None:
-                            mask = jax.device_put(
-                                mask, fleet_sharding(self.mesh)
-                            )
+                        mask = _put_fleet_arr(improved, self.mesh)
                         best_params = _keep_better(
                             mask,
                             params,
@@ -1109,12 +1288,21 @@ class FleetTrainer:
             if checkpointer is not None and (epoch + 1) % max(
                 1, checkpoint_every
             ) == 0:
-                checkpointer.save(
-                    epoch,
-                    params,
-                    opt_state,
-                    extra=es_state if early_stopping else None,
-                )
+                extra: Optional[dict] = None
+                if quarantine or early_stopping:
+                    extra = {}
+                    if quarantine:
+                        if not early_stopping:
+                            # plain fits keep healthy on device; the
+                            # checkpoint write is already a sync point
+                            healthy_np = np.asarray(
+                                host_fetch(healthy_dev), dtype=bool
+                            )
+                            n_host_syncs += 1
+                        extra["healthy"] = healthy_np
+                    if early_stopping:
+                        extra.update(es_state)
+                checkpointer.save(epoch, params, opt_state, extra=extra)
             if early_stopping and not es_state["active"].any():
                 logger.info(
                     "Fleet early stop: all %d machines stopped at epoch "
@@ -1146,6 +1334,8 @@ class FleetTrainer:
             pending["val"] = val_losses
         if losses and not isinstance(losses[0], np.ndarray):
             pending["loss"] = losses
+        if healthy_rows and not isinstance(healthy_rows[0], np.ndarray):
+            pending["healthy"] = healthy_rows
         if pending:
             fetched = host_fetch(pending)
             n_host_syncs += 1
@@ -1153,6 +1343,10 @@ class FleetTrainer:
                 val_losses = list(fetched["val"])
             if "loss" in fetched:
                 losses = list(fetched["loss"])
+            if "healthy" in fetched:
+                healthy_rows = [
+                    np.asarray(r, dtype=bool) for r in fetched["healthy"]
+                ]
         if val_losses:
             stacked = np.stack(val_losses).astype(np.float64)
             # machines with no validation samples have no val loss (their
@@ -1164,6 +1358,11 @@ class FleetTrainer:
             losses_out = np.stack([np.asarray(l) for l in losses])
         else:
             losses_out = np.zeros((0, len(keys)))
+        n_quarantined = 0
+        if quarantine:
+            n_quarantined = self._finish_quarantine(
+                healthy_rows, healthy_entry, start_epoch, machine_names, m
+            )
         # loop time is read AFTER the loss fetch above — that fetch is the
         # sync that makes the async epochs' wall-clock real
         self._record_fit_telemetry(
@@ -1185,6 +1384,7 @@ class FleetTrainer:
             n_dispatches=epochs_run,
             n_host_syncs=n_host_syncs,
             dispatch_times=dispatch_times,
+            n_quarantined=n_quarantined,
         )
         return params, losses_out
 
@@ -1218,6 +1418,10 @@ class FleetTrainer:
         m: int,
         rows_per_machine: np.ndarray,
         fit_start: float,
+        quarantine: bool = False,
+        inj: Optional[Tuple[np.ndarray, int]] = None,
+        healthy_np: Optional[np.ndarray] = None,
+        machine_names: Optional[List[str]] = None,
     ) -> Tuple[Any, np.ndarray]:
         """
         The ``epoch_chunk > 1`` fit loop: dispatch ONE fused program per
@@ -1247,11 +1451,17 @@ class FleetTrainer:
         ce = max(1, checkpoint_every)
 
         def put_fleet(x):
-            arr = jnp.asarray(x)
-            if self.mesh is not None:
-                arr = jax.device_put(arr, fleet_sharding(self.mesh))
-            return arr
+            return _put_fleet_arr(x, self.mesh)
 
+        if healthy_np is None:
+            healthy_np = np.ones(m, dtype=bool)
+        healthy_entry = healthy_np.copy()
+        healthy_dev = put_fleet(healthy_np) if quarantine else None
+        healthy_chunks: list = []
+        inj_mask_dev = inj_epoch_dev = None
+        if inj is not None:
+            inj_mask_dev = put_fleet(inj[0])
+            inj_epoch_dev = jnp.asarray(np.int32(inj[1]))
         es_dev: Optional[dict] = None
         has_val_dev = None
         if early_stopping:
@@ -1304,6 +1514,7 @@ class FleetTrainer:
                 val_lo=val_lo, gated=early_stopping, track_best=track_best,
                 monitor_val=monitor_val, es_delta=es_delta,
                 es_stop_at=es_stop_at, es_start_from=es_start_from,
+                quarantine=quarantine, inject=inj is not None,
             )
             args = [
                 params, opt_state, keys, X_arg, y_arg, w_arg,
@@ -1311,6 +1522,8 @@ class FleetTrainer:
             ]
             if with_val:
                 args.append(val_arg)
+            if quarantine:
+                args.append(healthy_dev)
             if early_stopping:
                 args += [
                     es_dev["active"], es_dev["best"],
@@ -1318,10 +1531,14 @@ class FleetTrainer:
                 ]
                 if monitor_val:
                     args.append(has_val_dev)
+            if inj is not None:
+                args += [inj_mask_dev, inj_epoch_dev]
             if track_best:
                 args += [best_params_dev, ever_dev]
             final, outs = chunk_fn(*args)
             params, opt_state = final["params"], final["opt"]
+            if quarantine:
+                healthy_dev = final["healthy"]
             if early_stopping:
                 es_dev = final["es"]
             if track_best:
@@ -1341,6 +1558,8 @@ class FleetTrainer:
                     fetch["val"] = outs["val"]
                 if track_best:
                     fetch["ever"] = final["ever_improved"]
+                if quarantine:
+                    fetch["healthy"] = outs["healthy"]
                 fetched = host_fetch(fetch)
                 n_host_syncs += 1
                 if first_sync_s is None:
@@ -1360,6 +1579,13 @@ class FleetTrainer:
                     val_chunks.append(
                         np.asarray(fetched["val"], dtype=np.float64)[:n_rep]
                     )
+                if quarantine:
+                    healthy_out_rows = np.asarray(
+                        fetched["healthy"], dtype=bool
+                    )[:n_rep]
+                    healthy_chunks.append(healthy_out_rows)
+                    if len(healthy_out_rows):
+                        healthy_np = healthy_out_rows[-1]
                 if track_best:
                     ever_improved = bool(fetched["ever"])
                 timesteps_trained += int(
@@ -1404,6 +1630,10 @@ class FleetTrainer:
                 loss_chunks.append(outs["loss"])
                 if with_val:
                     val_chunks.append(outs["val"])
+                if quarantine:
+                    # device-resident (k, M) history block; the end-of-fit
+                    # bulk fetch pulls it with the losses
+                    healthy_chunks.append(outs["healthy"])
                 if first_sync_s is None:
                     # sync ONCE (a readiness wait, not a transfer) so
                     # compile+first-chunk cost separates from steady state
@@ -1423,10 +1653,21 @@ class FleetTrainer:
                 # chunk boundaries were forced onto the checkpoint cadence
                 # above; a mid-chunk early stop means the per-epoch loop
                 # would have broken before this boundary, so skip it
-                checkpointer.save(
-                    e + k - 1, params, opt_state,
-                    extra=es_state if early_stopping else None,
-                )
+                extra: Optional[dict] = None
+                if quarantine or early_stopping:
+                    extra = {}
+                    if quarantine:
+                        if not early_stopping:
+                            # plain chunked fits keep healthy on device;
+                            # the checkpoint write is already a sync point
+                            healthy_np = np.asarray(
+                                host_fetch(healthy_dev), dtype=bool
+                            )
+                            n_host_syncs += 1
+                        extra["healthy"] = healthy_np
+                    if early_stopping:
+                        extra.update(es_state)
+                checkpointer.save(e + k - 1, params, opt_state, extra=extra)
             if early_stop_epoch is not None:
                 break
             e += k
@@ -1442,6 +1683,8 @@ class FleetTrainer:
             pending["loss"] = loss_chunks
         if val_chunks and not isinstance(val_chunks[0], np.ndarray):
             pending["val"] = val_chunks
+        if healthy_chunks and not isinstance(healthy_chunks[0], np.ndarray):
+            pending["healthy"] = healthy_chunks
         if pending:
             fetched = host_fetch(pending)
             n_host_syncs += 1
@@ -1449,6 +1692,10 @@ class FleetTrainer:
                 loss_chunks = [np.asarray(a) for a in fetched["loss"]]
             if "val" in fetched:
                 val_chunks = [np.asarray(a) for a in fetched["val"]]
+            if "healthy" in fetched:
+                healthy_chunks = [
+                    np.asarray(a, dtype=bool) for a in fetched["healthy"]
+                ]
         if val_chunks:
             stacked = np.concatenate(val_chunks, axis=0).astype(np.float64)
             if has_val is not None and not has_val.all():
@@ -1460,6 +1707,11 @@ class FleetTrainer:
             )
         else:
             losses_out = np.zeros((0, m))
+        n_quarantined = 0
+        if quarantine:
+            n_quarantined = self._finish_quarantine(
+                healthy_chunks, healthy_entry, start_epoch, machine_names, m
+            )
         self._record_fit_telemetry(
             wall_time_s=time.perf_counter() - fit_start,
             loop_time_s=time.perf_counter() - loop_start,
@@ -1479,8 +1731,62 @@ class FleetTrainer:
             n_dispatches=n_dispatches,
             n_host_syncs=n_host_syncs,
             dispatch_times=dispatch_times,
+            n_quarantined=n_quarantined,
         )
         return params, losses_out
+
+    def _finish_quarantine(
+        self,
+        healthy_rows: list,
+        healthy_entry: np.ndarray,
+        start_epoch: int,
+        machine_names: Optional[List[str]],
+        m: int,
+    ) -> int:
+        """
+        Post-fit quarantine bookkeeping from the already-fetched healthy
+        history (rows of (M,) or (k, M) blocks, in epoch order): sets
+        ``healthy_`` / ``quarantine_epoch_`` / ``healthy_history_``,
+        emits one ``machine_quarantined`` event per casualty, and
+        returns how many machines ended the fit quarantined.
+        """
+        if healthy_rows:
+            hist = np.concatenate(
+                [np.atleast_2d(np.asarray(r, dtype=bool)) for r in healthy_rows]
+            )
+        else:
+            hist = np.ones((0, m), dtype=bool)
+        self.healthy_history_ = hist
+        final = hist[-1] if len(hist) else healthy_entry.copy()
+        self.healthy_ = final
+        quarantine_epoch = np.full(m, -1, dtype=np.int64)
+        prev = healthy_entry
+        for j in range(len(hist)):
+            newly = prev & ~hist[j]
+            for i in np.flatnonzero(newly):
+                epoch = start_epoch + j
+                quarantine_epoch[i] = epoch
+                name = (
+                    machine_names[i]
+                    if machine_names is not None and i < len(machine_names)
+                    else None
+                )
+                logger.warning(
+                    "Fleet quarantine: machine %s went non-finite at epoch "
+                    "%d; params rolled back to last finite epoch and frozen",
+                    name if name is not None else f"index {i}",
+                    epoch,
+                )
+                emit_event(
+                    "machine_quarantined",
+                    path="fleet",
+                    machine_index=int(i),
+                    machine=name,
+                    epoch=int(epoch),
+                )
+            prev = hist[j]
+        self.quarantine_epoch_ = quarantine_epoch
+        return int((~final).sum())
 
     def _record_fit_telemetry(
         self,
@@ -1501,6 +1807,7 @@ class FleetTrainer:
         n_dispatches: int,
         n_host_syncs: int,
         dispatch_times: Optional[list] = None,
+        n_quarantined: int = 0,
     ) -> None:
         """
         Derive and publish one fit's telemetry: ``self.fit_telemetry_``
@@ -1577,6 +1884,7 @@ class FleetTrainer:
             "early_stopping": early_stopping,
             "early_stop_epoch": early_stop_epoch,
             "n_machines_early_stopped": n_stopped,
+            "n_machines_quarantined": n_quarantined,
             "epoch_chunk": self.epoch_chunk,
             "n_dispatches": n_dispatches,
             "n_host_syncs": n_host_syncs,
@@ -1614,6 +1922,12 @@ class FleetTrainer:
                 "Machines halted by per-machine early stopping",
                 ("path",),
             ).inc(n_stopped, path="fleet")
+        if self.quarantine_nonfinite:
+            reg.gauge(
+                "gordo_train_quarantined_machines",
+                "Machines quarantined by the non-finite guard (last fit)",
+                ("path",),
+            ).set(n_quarantined, path="fleet")
         reg.counter(
             "gordo_train_host_syncs_total",
             "Device->host synchronizations paid by fits",

@@ -1,0 +1,45 @@
+"""
+Per-machine fault domains (ML-goodput direction, PAPERS.md
+arXiv:2502.06982: recoverable per-unit failures must cost one unit, not
+the job).
+
+The reference inherited its fault domain from Kubernetes — one pod per
+model, so one bad sensor feed killed one pod. The fused ``vmap``/``scan``
+fleet program made the *process* the fault domain: one machine's NaN loss
+or dead data source could take down (or silently poison) the other 999.
+This package holds the machinery that makes the **machine** the fault
+domain again:
+
+- :mod:`faults` — the env-driven fault-injection registry
+  (``GORDO_FAULT_INJECT``) with seams in dataset fetch, the training
+  step, checkpoint writes, and the server; chaos tests drive every
+  degradation path through it.
+
+The degradation paths themselves live where the work happens: non-finite
+quarantine in :mod:`gordo_tpu.parallel.fleet`, isolated fetch/build
+failures in :mod:`gordo_tpu.builder.fleet_build`, torn-checkpoint
+fallback in :mod:`gordo_tpu.parallel.checkpoint`, and degraded serving
+in :mod:`gordo_tpu.server`. See docs/robustness.md.
+"""
+
+from .faults import (
+    FAULT_INJECT_ENV_VAR,
+    FaultSpec,
+    InjectedFault,
+    active_registry,
+    inject,
+    reset,
+    tear_checkpoint_files,
+    train_nan_injection,
+)
+
+__all__ = [
+    "FAULT_INJECT_ENV_VAR",
+    "FaultSpec",
+    "InjectedFault",
+    "active_registry",
+    "inject",
+    "reset",
+    "tear_checkpoint_files",
+    "train_nan_injection",
+]

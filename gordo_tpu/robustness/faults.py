@@ -1,0 +1,293 @@
+"""
+Env-driven fault injection: the chaos harness behind docs/robustness.md.
+
+``GORDO_FAULT_INJECT`` holds a ``;``-separated list of fault specs::
+
+    GORDO_FAULT_INJECT="fetch:raise:machine-3;train:nan:machine-7@epoch:2;ckpt:torn"
+
+One spec is ``site:mode[:target][@key:value ...]``:
+
+- ``site`` — where the seam lives: ``fetch`` (dataset fetch inside the
+  fleet builder), ``train`` (the fleet training step), ``ckpt``
+  (checkpoint write), ``serve`` (the model server's prediction paths).
+- ``mode`` — what happens there: ``raise`` (the seam raises
+  :class:`InjectedFault`), ``nan`` (train only: the named machine's
+  epoch loss goes NaN at ``@epoch:<e>``, driving the quarantine guard),
+  ``torn`` (ckpt only: the just-committed checkpoint's files are
+  truncated, simulating a torn write).
+- ``target`` — a machine name (or a bare fleet index when the seam has
+  no names); omitted = any machine at that site.
+- ``@key:value`` — per-spec parameters: ``@epoch:2`` (train), and
+  ``@attempts:N`` (fail only the first N attempts, then succeed — the
+  retry-path exercise).
+
+Every firing emits a ``fault_injected`` event, so a chaos run's event
+log names exactly which faults actually triggered.
+
+Hot-path discipline: with the env var unset, every seam is a single
+``os.environ.get`` returning None — no parsing, no registry, no state.
+Parsed registries are cached per spec string (fire counts live on the
+cached specs); tests use :func:`reset` between scenarios.
+"""
+
+import dataclasses
+import logging
+import os
+import threading
+import typing
+
+logger = logging.getLogger(__name__)
+
+FAULT_INJECT_ENV_VAR = "GORDO_FAULT_INJECT"
+
+_KNOWN_SITES = frozenset({"fetch", "train", "ckpt", "serve"})
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a seam when a matching ``raise``-mode fault fires."""
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One parsed entry of a ``GORDO_FAULT_INJECT`` string."""
+
+    site: str
+    mode: str
+    target: typing.Optional[str] = None
+    params: typing.Dict[str, str] = dataclasses.field(default_factory=dict)
+    #: times this spec has fired (mutated by the seams; guarded by the
+    #: registry lock so concurrent fetch threads count correctly)
+    fires: int = 0
+
+    def param_int(self, key: str, default: int = 0) -> int:
+        try:
+            return int(self.params.get(key, default))
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"Fault spec parameter @{key} must be an integer, got "
+                f"{self.params.get(key)!r}"
+            )
+
+    def matches_target(self, name: typing.Optional[str]) -> bool:
+        """No target = any machine; else exact-name (or index) match."""
+        if self.target is None:
+            return True
+        return name is not None and str(name) == self.target
+
+
+def parse_spec(spec_string: str) -> typing.List[FaultSpec]:
+    """
+    Parse the ``GORDO_FAULT_INJECT`` grammar. Unknown sites raise — a
+    typo'd chaos run silently injecting nothing is worse than failing.
+    """
+    specs: typing.List[FaultSpec] = []
+    for raw in spec_string.split(";"):
+        raw = raw.strip()
+        if not raw:
+            continue
+        head, *param_parts = raw.split("@")
+        fields = head.strip().split(":")
+        if len(fields) < 2 or len(fields) > 3:
+            raise ValueError(
+                f"Bad fault spec {raw!r}: expected site:mode[:target]"
+            )
+        site, mode = fields[0].strip(), fields[1].strip()
+        if site not in _KNOWN_SITES:
+            raise ValueError(
+                f"Bad fault spec {raw!r}: unknown site {site!r} "
+                f"(known: {sorted(_KNOWN_SITES)})"
+            )
+        target = fields[2].strip() if len(fields) == 3 else None
+        params: typing.Dict[str, str] = {}
+        for part in param_parts:
+            key, sep, value = part.strip().partition(":")
+            if not sep:
+                raise ValueError(
+                    f"Bad fault spec {raw!r}: parameter {part!r} is not "
+                    "key:value"
+                )
+            params[key.strip()] = value.strip()
+        specs.append(FaultSpec(site=site, mode=mode, target=target, params=params))
+    return specs
+
+
+class FaultRegistry:
+    """The parsed specs of one ``GORDO_FAULT_INJECT`` value."""
+
+    def __init__(self, specs: typing.List[FaultSpec]):
+        self.specs = specs
+        self._lock = threading.Lock()
+
+    def find(
+        self, site: str, name: typing.Optional[str] = None
+    ) -> typing.Optional[FaultSpec]:
+        for spec in self.specs:
+            if spec.site == site and spec.matches_target(name):
+                return spec
+        return None
+
+    def fire(self, spec: FaultSpec, **fields) -> int:
+        """
+        Record one firing: bump the spec's count (thread-safe) and emit
+        the ``fault_injected`` event. Returns the 1-based attempt number.
+        """
+        from gordo_tpu.observability import emit_event
+
+        with self._lock:
+            spec.fires += 1
+            count = spec.fires
+        emit_event(
+            "fault_injected",
+            site=spec.site,
+            mode=spec.mode,
+            target=spec.target,
+            fire_count=count,
+            **fields,
+        )
+        return count
+
+
+#: spec string -> parsed registry. Fire counts live on the cached specs,
+#: so a seam retried against the same env value sees its own history.
+_registries: typing.Dict[str, FaultRegistry] = {}
+_registries_lock = threading.Lock()
+
+
+def reset() -> None:
+    """Drop cached registries (and their fire counts). Test seam."""
+    with _registries_lock:
+        _registries.clear()
+
+
+def active_registry() -> typing.Optional[FaultRegistry]:
+    """
+    The registry for the CURRENT env value, or None when unset/empty —
+    the one check every seam starts with (a dict lookup; the strict
+    no-op guarantee when fault injection is off).
+    """
+    value = os.environ.get(FAULT_INJECT_ENV_VAR)
+    if not value:
+        return None
+    with _registries_lock:
+        registry = _registries.get(value)
+        if registry is None:
+            registry = FaultRegistry(parse_spec(value))
+            _registries[value] = registry
+    return registry
+
+
+# -- seams ---------------------------------------------------------------
+
+
+def inject(site: str, name: typing.Optional[str] = None, **fields) -> None:
+    """
+    Generic ``raise``-mode seam: raise :class:`InjectedFault` when a
+    matching spec fires. ``@attempts:N`` limits a spec to its first N
+    firings (then the seam passes — the retry-recovery exercise);
+    without it the fault is permanent.
+    """
+    registry = active_registry()
+    if registry is None:
+        return
+    spec = registry.find(site, name)
+    if spec is None or spec.mode != "raise":
+        return
+    attempts = spec.param_int("attempts", 0)
+    if attempts and spec.fires >= attempts:
+        return
+    count = registry.fire(spec, machine=name, **fields)
+    raise InjectedFault(
+        f"Injected fault at site {site!r}"
+        + (f" for machine {name!r}" if name else "")
+        + f" (firing {count})"
+    )
+
+
+def train_nan_injection(
+    machine_names: typing.Optional[typing.Sequence[str]], n_machines: int
+) -> typing.Optional[typing.Tuple["np.ndarray", int]]:
+    """
+    The training-step seam, resolved ONCE per fit on host: a matching
+    ``train:nan`` spec becomes an ``(M,)`` bool machine mask and the
+    epoch at which those machines' losses go NaN (``@epoch:<e>``,
+    default 0). The fleet trainer bakes the poison into the compiled
+    program only when this returns non-None, so a fault-free fit's
+    program is byte-identical to one built with injection off.
+
+    ``machine_names`` maps targets to fleet indices; with no names, a
+    bare-integer target addresses the fleet index directly.
+    """
+    import numpy as np
+
+    registry = active_registry()
+    if registry is None:
+        return None
+    specs = [s for s in registry.specs if s.site == "train" and s.mode == "nan"]
+    if not specs:
+        return None
+    mask = np.zeros(n_machines, dtype=bool)
+    epoch = 0
+    matched = None
+    for spec in specs:
+        if spec.target is None:
+            mask[:] = True
+        elif machine_names is not None:
+            hits = [i for i, n in enumerate(machine_names) if str(n) == spec.target]
+            if not hits:
+                continue
+            mask[hits] = True
+        else:
+            try:
+                index = int(spec.target)
+            except ValueError:
+                continue
+            if not 0 <= index < n_machines:
+                continue
+            mask[index] = True
+        epoch = spec.param_int("epoch", 0)
+        matched = spec
+    if matched is None or not mask.any():
+        return None
+    registry.fire(
+        matched,
+        n_machines_poisoned=int(mask.sum()),
+        epoch=epoch,
+    )
+    return mask, epoch
+
+
+def tear_checkpoint_files(step_dir: typing.Union[str, os.PathLike]) -> bool:
+    """
+    The checkpoint-write seam: when a ``ckpt:torn`` spec fires, truncate
+    the largest file under the just-committed checkpoint directory to
+    half its size — the on-disk shape of a crash mid-flush. Returns True
+    when a tear happened (``@attempts:N`` limits it to the first N
+    saves, so a run can tear one checkpoint and then write good ones).
+    """
+    registry = active_registry()
+    if registry is None:
+        return False
+    spec = registry.find("ckpt")
+    if spec is None or spec.mode != "torn":
+        return False
+    attempts = spec.param_int("attempts", 0)
+    if attempts and spec.fires >= attempts:
+        return False
+    victim: typing.Optional[str] = None
+    victim_size = -1
+    for root, _, files in os.walk(step_dir):
+        for fname in files:
+            path = os.path.join(root, fname)
+            size = os.path.getsize(path)
+            if size > victim_size:
+                victim, victim_size = path, size
+    if victim is None:
+        return False
+    registry.fire(spec, path=victim, original_size=victim_size)
+    with open(victim, "r+b") as fh:
+        fh.truncate(victim_size // 2)
+    logger.warning(
+        "Fault injection: tore checkpoint file %s (%d -> %d bytes)",
+        victim, victim_size, victim_size // 2,
+    )
+    return True

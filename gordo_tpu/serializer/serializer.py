@@ -13,6 +13,7 @@ import logging
 import math
 import os
 import pickle
+import shutil
 from pathlib import Path
 from typing import Any, Optional, Union
 
@@ -49,6 +50,27 @@ def _dump_metadata_json(metadata: dict, fh) -> None:
         json.dump(_sanitize_nan(metadata), fh, default=str)
 
 
+def _writer_alive(pid_text: str) -> bool:
+    """
+    Whether the pid stamped into a flush temp dir still runs on THIS
+    host (kill -0). Unparseable pids count as alive — when in doubt,
+    leave the directory alone. On shared storage written from several
+    hosts pids are ambiguous; the worst case of counting a foreign pid
+    alive is one skipped cleanup, never a deleted live write.
+    """
+    try:
+        pid = int(pid_text)
+    except ValueError:
+        return True
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        return True
+    return True
+
+
 def dumps(model: Any) -> bytes:
     """Serialize a model to bytes (used by the download-model endpoint)."""
     return bz2.compress(pickle.dumps(model))
@@ -67,14 +89,43 @@ def dump(obj: Any, dest_dir: Union[os.PathLike, str], metadata: Optional[dict] =
     """
     Serialize ``obj`` into ``dest_dir`` as ``model.pkl`` (+ ``metadata.json``
     if metadata given).
+
+    The write is ATOMIC at artifact granularity: both files land in a
+    sibling temp directory which is then renamed into place, so a crash
+    mid-flush (the round-5 worker deaths) can never leave ``model.pkl``
+    without its ``metadata.json`` — an artifact directory either loads
+    completely or does not exist. An existing artifact at ``dest_dir``
+    is replaced wholesale.
     """
     dest_dir = Path(dest_dir)
-    dest_dir.mkdir(parents=True, exist_ok=True)
-    with open(dest_dir / MODEL_FILENAME, "wb") as f:
-        pickle.dump(obj, f)
-    if metadata is not None:
-        with open(dest_dir / METADATA_FILENAME, "w") as f:
-            _dump_metadata_json(metadata, f)
+    dest_dir.parent.mkdir(parents=True, exist_ok=True)
+    # clear temp dirs DEAD writers left behind (crashed mid-flush); a
+    # temp dir whose owning pid is still alive on this host belongs to a
+    # concurrent writer and must not be pulled out from under it. The
+    # server additionally never lists dot-prefixed entries as models.
+    for stale in dest_dir.parent.glob(f".{dest_dir.name}.tmp-*"):
+        if not _writer_alive(stale.name.rpartition("-")[2]):
+            shutil.rmtree(stale, ignore_errors=True)
+    tmp_dir = dest_dir.parent / f".{dest_dir.name}.tmp-{os.getpid()}"
+    if tmp_dir.exists():
+        shutil.rmtree(tmp_dir)
+    tmp_dir.mkdir()
+    try:
+        with open(tmp_dir / MODEL_FILENAME, "wb") as f:
+            pickle.dump(obj, f)
+        if metadata is not None:
+            with open(tmp_dir / METADATA_FILENAME, "w") as f:
+                _dump_metadata_json(metadata, f)
+        if dest_dir.exists():
+            # os.replace cannot rename onto a non-empty directory; the
+            # rmtree+rename pair still cannot produce a TORN artifact —
+            # the worst a crash between them leaves is no artifact,
+            # which the resume path treats as "rebuild"
+            shutil.rmtree(dest_dir)
+        os.replace(tmp_dir, dest_dir)
+    except BaseException:
+        shutil.rmtree(tmp_dir, ignore_errors=True)
+        raise
 
 
 def load(source_dir: Union[os.PathLike, str]) -> Any:

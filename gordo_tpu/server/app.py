@@ -46,10 +46,16 @@ from gordo_tpu import __version__, serializer
 from gordo_tpu.data.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
 from gordo_tpu.observability import get_registry
+from gordo_tpu.robustness import faults
 from gordo_tpu.server import model_io
 from gordo_tpu.server import utils as server_utils
 from gordo_tpu.server.utils import ApiError
 from gordo_tpu.utils.compat import normalize_frequency
+
+#: casualty record the fleet builder persists next to the artifacts
+#: (gordo_tpu.builder.fleet_build.BUILD_REPORT_FILENAME — name duplicated
+#: here so the server never imports the builder stack)
+BUILD_REPORT_FILENAME = "build_report.json"
 
 logger = logging.getLogger(__name__)
 
@@ -183,6 +189,10 @@ class GordoApp:
         # (collection_dir, machine-name tuple) -> (FleetScorer, prefixes, fallback)
         self._fleet_scorers: typing.Dict[tuple, tuple] = {}
         self._fleet_scorers_lock = threading.Lock()
+        # build_report.json path -> (mtime, parsed report): the degraded-
+        # serving source of truth (which machines to 409)
+        self._build_reports: typing.Dict[str, tuple] = {}
+        self._build_reports_lock = threading.Lock()
         self.prometheus_metrics = None
         if self.config.get("ENABLE_PROMETHEUS"):
             from gordo_tpu.server.prometheus.metrics import (
@@ -223,6 +233,10 @@ class GordoApp:
                 response = handler(ctx, request, **url_args)
         except ApiError as exc:
             response = _json_response(exc.payload, exc.status)
+        except faults.InjectedFault as exc:
+            # the serve-site chaos seam: a distinguishable 503, so chaos
+            # tests can tell an injected fault from a real server error
+            response = _json_response({"error": f"Fault injection: {exc}"}, 503)
         except HTTPException as exc:
             response = exc.get_response(request.environ)
         except Exception:
@@ -300,6 +314,78 @@ class GordoApp:
                 duration=runtime_s,
             )
         return response
+
+    # -- degraded serving (docs/robustness.md) -----------------------------
+
+    def _build_report(self, ctx: RequestContext) -> dict:
+        """
+        The served revision's ``build_report.json`` ({} when absent),
+        cached by mtime so request paths pay one stat, not a parse.
+        """
+        path = os.path.join(ctx.collection_dir, BUILD_REPORT_FILENAME)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return {}
+        key = os.path.realpath(path)
+        with self._build_reports_lock:
+            cached = self._build_reports.get(key)
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
+        try:
+            with open(path) as fh:
+                report = json.load(fh)
+        except (OSError, ValueError):
+            logger.warning("Unreadable build report at %s; ignoring", path)
+            report = {}
+        with self._build_reports_lock:
+            self._build_reports[key] = (mtime, report)
+        return report
+
+    def _unavailable_machines(self, ctx: RequestContext) -> typing.Dict[str, dict]:
+        """
+        Machines the build recorded as casualties: fetch/build-failed
+        (no usable artifact) or quarantined by the non-finite guard
+        (artifact holds frozen last-good params). Predictions against
+        them answer a structured 409 rather than garbage.
+        """
+        report = self._build_report(ctx)
+        out: typing.Dict[str, dict] = {}
+        for record in report.get("failed") or []:
+            name = record.get("machine")
+            if name:
+                out[name] = {
+                    "reason": f"{record.get('phase', 'build')}_failed",
+                    "error": record.get("error"),
+                    "attempts": record.get("attempts"),
+                }
+        for record in report.get("quarantined") or []:
+            name = record.get("machine")
+            if name:
+                out[name] = {
+                    "reason": "quarantined",
+                    "epoch": record.get("epoch"),
+                }
+        return out
+
+    def _refuse_unavailable(
+        self, ctx: RequestContext, names: typing.Iterable[str]
+    ) -> None:
+        """409 when any requested machine is a recorded casualty."""
+        unavailable = self._unavailable_machines(ctx)
+        bad = {name: unavailable[name] for name in names if name in unavailable}
+        if bad:
+            raise ApiError(
+                {
+                    "error": "Machine(s) unavailable in this revision: "
+                    + ", ".join(
+                        f"{name} ({info['reason']})"
+                        for name, info in sorted(bad.items())
+                    ),
+                    "unavailable": bad,
+                },
+                409,
+            )
 
     # -- model/metadata loading --------------------------------------------
 
@@ -430,16 +516,29 @@ class GordoApp:
     def view_models(self, ctx, request, gordo_project: str) -> Response:
         try:
             # artifact DIRECTORIES only: fleet builds persist their
-            # telemetry_report.json next to the artifacts, and loose
-            # files in the collection dir are not models
+            # telemetry_report.json / build_report.json next to the
+            # artifacts, and loose files in the collection dir are not
+            # models
+            # dot-prefixed entries are in-flight atomic-flush temp dirs
+            # (serializer.dump), never servable artifacts
             available = [
                 name
                 for name in os.listdir(ctx.collection_dir)
-                if os.path.isdir(os.path.join(ctx.collection_dir, name))
+                if not name.startswith(".")
+                and os.path.isdir(os.path.join(ctx.collection_dir, name))
             ]
         except FileNotFoundError:
             available = []
-        return _json_response({"models": available})
+        # degraded serving: casualties leave the servable list (so
+        # clients never fan predictions onto them) and are surfaced with
+        # their reasons instead of silently vanishing
+        unavailable = self._unavailable_machines(ctx)
+        payload: typing.Dict[str, typing.Any] = {
+            "models": [name for name in available if name not in unavailable]
+        }
+        if unavailable:
+            payload["unavailable"] = unavailable
+        return _json_response(payload)
 
     def view_revisions(self, ctx, request, gordo_project: str) -> Response:
         try:
@@ -490,6 +589,8 @@ class GordoApp:
         self, ctx, request, gordo_project: str, gordo_name: str
     ) -> Response:
         """Reference: views/base.py:107-187."""
+        self._refuse_unavailable(ctx, [gordo_name])
+        faults.inject("serve", gordo_name)
         model = self._get_model(ctx, gordo_name)
         metadata = self._get_metadata(ctx, gordo_name)
         tags = self._tags(metadata)
@@ -596,6 +697,9 @@ class GordoApp:
             )
 
         names = tuple(sorted(machines))
+        self._refuse_unavailable(ctx, names)
+        for name in names:
+            faults.inject("serve", name)
         scorer, prefixes, fallback = self._get_fleet_scorer(ctx, names)
 
         frames: typing.Dict[str, pd.DataFrame] = {}
@@ -728,6 +832,9 @@ class GordoApp:
             )
 
         names = tuple(sorted(machines))
+        self._refuse_unavailable(ctx, names)
+        for name in names:
+            faults.inject("serve", name)
         models = {name: self._get_model(ctx, name) for name in names}
         non_anomaly = [
             name
@@ -826,6 +933,8 @@ class GordoApp:
         self, ctx, request, gordo_project: str, gordo_name: str
     ) -> Response:
         """Reference: views/anomaly.py:99-147."""
+        self._refuse_unavailable(ctx, [gordo_name])
+        faults.inject("serve", gordo_name)
         model = self._get_model(ctx, gordo_name)
         metadata = self._get_metadata(ctx, gordo_name)
         tags = self._tags(metadata)
@@ -925,7 +1034,8 @@ def _preload_models(app: "GordoApp") -> None:
     names = sorted(
         n
         for n in os.listdir(collection_dir)
-        if os.path.isdir(os.path.join(collection_dir, n))
+        if not n.startswith(".")
+        and os.path.isdir(os.path.join(collection_dir, n))
     )
     # preloading past the model-cache capacity would only churn the LRU
     capacity = server_utils.load_model.cache_info().maxsize
