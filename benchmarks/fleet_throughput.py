@@ -317,6 +317,7 @@ def main():
     # (registry + telemetry report) throughput can be cross-checked in
     # the results JSON — a drift between them is itself a finding
     from gordo_tpu.observability import get_registry
+    from gordo_tpu.observability.tracing import measure_overhead
 
     snapshot = get_registry().snapshot()
 
@@ -384,6 +385,11 @@ def main():
                 "mfu": float(f"{mfu:.3g}"),
                 "mfu_peak_source": peak_source,
                 "mfu_note": MFU_NOTE,
+                # span enter/exit cost per regime (disabled/sampled-out/
+                # recording): with per-epoch train.dispatch spans, the
+                # per-epoch tracing tax is one of these numbers — the
+                # justification for the sampling default
+                "tracing_overhead": measure_overhead(samples=1000),
             }
         )
     )

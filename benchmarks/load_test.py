@@ -179,6 +179,7 @@ def main():
     elapsed = time.perf_counter() - start
 
     from benchmarks.server_latency import summarize_ms
+    from gordo_tpu.observability.tracing import measure_overhead
 
     summary = summarize_ms(latencies) if latencies else {}
     out = {
@@ -191,6 +192,10 @@ def main():
         "errors": len(errors),
         "rps": round(len(latencies) / elapsed, 1),
         **summary,
+        # span-machinery cost per enter/exit in each regime (disabled /
+        # sampled-out / recording), so the tracing-sampling default is
+        # justified against the request latencies above by a number
+        "tracing_overhead": measure_overhead(samples=1000),
     }
     if args.fleet:
         # each request scores --fleet machines; the comparable per-machine

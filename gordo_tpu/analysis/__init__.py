@@ -38,9 +38,11 @@ from gordo_tpu.analysis.checks import (
     check_return_annotations,
     check_self_attributes,
     check_self_method_calls,
+    check_span_discipline,
     check_unused_imports,
     collect_event_names,
     collect_metric_names,
+    collect_span_names,
     parse,
 )
 from gordo_tpu.analysis.engine import (
@@ -94,10 +96,12 @@ __all__ = [
     "check_return_annotations",
     "check_self_attributes",
     "check_self_method_calls",
+    "check_span_discipline",
     "check_traced_branching",
     "check_unused_imports",
     "collect_event_names",
     "collect_metric_names",
+    "collect_span_names",
     "get_check",
     "iter_python_files",
     "lint_file",

@@ -963,6 +963,115 @@ def collect_event_names(tree: ast.Module) -> typing.Set[str]:
     return names
 
 
+#: the span-opening surface (observability/tracing.py): context-managed —
+#: a span opened any other way is never closed, so never persisted
+SPAN_OPEN_FUNCTIONS = frozenset({"start_span"})
+#: completed-span recorders: they persist a finished span immediately,
+#: no context manager involved (record_phase is the server's
+#: Server-Timing phase hook, which forwards into record_span)
+SPAN_RECORD_FUNCTIONS = frozenset({"record_span", "record_phase"})
+#: the trace-correlation field names ONLY trace_fields() may spell out
+TRACE_STAMP_KEYS = frozenset({"trace_id", "span_id"})
+
+
+def collect_span_names(tree: ast.Module) -> typing.Set[str]:
+    """
+    Every LITERAL span name this module opens (``start_span``) or
+    records (``record_span`` / ``record_phase``) — the docs-catalogue
+    sync sibling of :func:`collect_metric_names` /
+    :func:`collect_event_names`: a span name emitted in code but absent
+    from docs/observability.md's span catalogue is doc drift.
+    """
+    openers = SPAN_OPEN_FUNCTIONS | SPAN_RECORD_FUNCTIONS
+    names: typing.Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        is_span = (
+            isinstance(node.func, ast.Name) and node.func.id in openers
+        ) or (
+            isinstance(node.func, ast.Attribute) and node.func.attr in openers
+        )
+        if not is_span:
+            continue
+        name_node = node.args[0] if node.args else None
+        if name_node is None:
+            name_node = next(
+                (kw.value for kw in node.keywords if kw.arg == "name"), None
+            )
+        if isinstance(name_node, ast.Constant) and isinstance(
+            name_node.value, str
+        ):
+            names.add(name_node.value)
+    return names
+
+
+def check_span_discipline(tree: ast.Module) -> typing.List[str]:
+    """
+    Tracing hygiene (docs/observability.md "Distributed tracing"):
+
+    - ``start_span(...)`` must be the context expression of a ``with``
+      statement (or handed to an ``ExitStack.enter_context``). A span
+      opened any other way is a LEAK: it is never ended, never
+      persisted, and — had the contextvar been set — would re-parent
+      every later span in the thread.
+    - event emissions must not hand-stamp ``trace_id=`` / ``span_id=``
+      keywords: ``emit_event`` stamps the ambient span itself, and
+      cross-thread sites go through ``**trace_fields(span)`` so the
+      correlation fields keep one spelling everywhere.
+    """
+    managed: typing.Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                managed.add(id(item.context_expr))
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "enter_context"
+        ):
+            for arg in node.args:
+                managed.add(id(arg))
+
+    problems: typing.List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        opens_span = (
+            isinstance(func, ast.Name) and func.id in SPAN_OPEN_FUNCTIONS
+        ) or (
+            isinstance(func, ast.Attribute)
+            and func.attr in SPAN_OPEN_FUNCTIONS
+        )
+        if opens_span and id(node) not in managed:
+            problems.append(
+                f"line {node.lineno}: start_span(...) outside a "
+                "with-statement — the span is never ended or persisted "
+                "(leak risk)"
+            )
+            continue
+        emits_event = (
+            isinstance(func, ast.Name) and func.id in EVENT_EMIT_FUNCTIONS
+        ) or (
+            isinstance(func, ast.Attribute)
+            and func.attr in EVENT_EMIT_METHODS
+        )
+        if emits_event:
+            stamped = sorted(
+                kw.arg
+                for kw in node.keywords
+                if kw.arg in TRACE_STAMP_KEYS
+            )
+            if stamped:
+                problems.append(
+                    f"line {node.lineno}: event emission hand-stamps "
+                    f"{', '.join(stamped)} — stamp trace context via "
+                    "**trace_fields(span) (or rely on the ambient span)"
+                )
+    return problems
+
+
 def check_annotated_param_method_calls(tree: ast.Module, module) -> typing.List[str]:
     """
     ``param.method(...)`` calls where ``param`` is annotated with vouched

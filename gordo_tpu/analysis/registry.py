@@ -133,6 +133,16 @@ CHECKS: typing.Tuple[CheckSpec, ...] = (
         scope="syntactic",
         run=_syntactic(checks.check_metric_registrations),
     ),
+    CheckSpec(
+        name="span-discipline",
+        doc="start_span outside a with-statement (span leak), or events "
+        "hand-stamping trace_id/span_id keywords",
+        severity="error",
+        fixer="wrap start_span in `with ... as span:`; stamp events via "
+        "**trace_fields(span) or the ambient span",
+        scope="syntactic",
+        run=_syntactic(checks.check_span_discipline),
+    ),
     # -- the JAX-discipline family (jax_checks.py) -----------------------
     CheckSpec(
         name="retrace-risk",

@@ -59,6 +59,7 @@ from gordo_tpu.observability import (
     emit_event,
     get_registry,
     memory_watermarks,
+    tracing,
     write_telemetry_report,
 )
 from gordo_tpu.parallel.bucketing import bucket_machines, timestep_bucket
@@ -242,10 +243,16 @@ class FleetModelBuilder:
         fetched: List[dict] = []
         pool = ThreadPoolExecutor(max_workers=self.data_threads)
         hung = False
+        # per-machine fetch spans attach to the bucket/build span through
+        # an explicit parent — pool workers do not inherit the contextvar
+        parent_ctx = tracing.current_context()
 
         def task(machine: Machine, started_at: dict):
             started_at["t"] = time.monotonic()
-            return self._fetch_with_retries(machine)
+            with tracing.start_span(
+                "build.fetch", parent=parent_ctx, machine=machine.name
+            ):
+                return self._fetch_with_retries(machine)
 
         try:
             futures = []
@@ -381,6 +388,20 @@ class FleetModelBuilder:
         every machine builds or the call raises, so the result covers
         all of them).
         """
+        # the whole build is one trace: bucket/fetch/cv/fit/serialize
+        # spans hang off this root, and every event emitted on the build
+        # thread (build_started/bucket_finished/build_crashed/...) is
+        # stamped with its trace id
+        with tracing.start_span(
+            "build.fleet", n_machines=len(self.machines), resume=bool(resume)
+        ):
+            return self._build_all(output_dir_base, resume)
+
+    def _build_all(
+        self,
+        output_dir_base: Optional[Union[str, Path]] = None,
+        resume: bool = False,
+    ) -> List[Tuple[BaseEstimator, Machine]]:
         if resume and output_dir_base is None:
             raise ValueError("resume=True requires output_dir_base")
         base = Path(output_dir_base) if output_dir_base is not None else None
@@ -482,9 +503,14 @@ class FleetModelBuilder:
             if base is None:
                 return
             for model, machine in pairs:
-                ModelBuilder._save_model(
-                    model=model, machine=machine, output_dir=base / machine.name
-                )
+                with tracing.start_span(
+                    "build.serialize", machine=machine.name
+                ):
+                    ModelBuilder._save_model(
+                        model=model,
+                        machine=machine,
+                        output_dir=base / machine.name,
+                    )
             emit_event("bucket_flush", n_models=len(pairs), output_dir=str(base))
 
         try:
@@ -669,6 +695,12 @@ class FleetModelBuilder:
     def _build_bucket(
         self, bucket: List[Machine]
     ) -> Dict[str, Tuple[BaseEstimator, Machine]]:
+        with tracing.start_span("build.bucket", n_machines=len(bucket)):
+            return self._build_bucket_traced(bucket)
+
+    def _build_bucket_traced(
+        self, bucket: List[Machine]
+    ) -> Dict[str, Tuple[BaseEstimator, Machine]]:
         bucket_start = time.time()
         fetched, fetch_failures = self.fetch_data(bucket)
         if fetch_failures:
@@ -800,19 +832,23 @@ class FleetModelBuilder:
 
         # -- CV folds as masks: threshold calibration + scores ------------
         start_cv = time.time()
-        fold_records = self._run_cv_folds(
-            trainer, data, keys, bucket, Xs_grid, ys_grid, models,
-            epochs=epochs, batch_size=batch_size, es_kwargs=es_kwargs,
-            machine_names=machine_names,
-        )
+        with tracing.start_span("build.cv", n_machines=len(bucket)):
+            fold_records = self._run_cv_folds(
+                trainer, data, keys, bucket, Xs_grid, ys_grid, models,
+                epochs=epochs, batch_size=batch_size, es_kwargs=es_kwargs,
+                machine_names=machine_names,
+            )
         cv_duration = time.time() - start_cv
 
         # -- final full fit ----------------------------------------------
         start_fit = time.time()
-        params, losses = trainer.fit(
-            data, keys, epochs=epochs, batch_size=batch_size,
-            machine_names=machine_names, **es_kwargs
-        )
+        with tracing.start_span(
+            "build.fit", n_machines=len(bucket), epochs=epochs
+        ):
+            params, losses = trainer.fit(
+                data, keys, epochs=epochs, batch_size=batch_size,
+                machine_names=machine_names, **es_kwargs
+            )
         fit_duration = time.time() - start_fit
 
         # -- quarantine bookkeeping: the FINAL fit's verdict is what the

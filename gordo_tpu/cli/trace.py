@@ -1,0 +1,87 @@
+"""
+``gordo-tpu trace`` — inspect distributed-tracing span logs
+(``GORDO_TPU_TRACE_LOG`` JSONL files; docs/observability.md
+"Distributed tracing").
+
+- ``summarize``: per-span-name / per-machine totals and the critical
+  path of the slowest traces — which phase, on which machine, on which
+  side of the wire the time went.
+- ``export``: Chrome-trace ("Trace Event Format") JSON, loadable in
+  Perfetto (https://ui.perfetto.dev) or chrome://tracing, with the
+  gordo trace/span ids preserved under each event's ``args``.
+"""
+
+import json
+import os
+import typing
+
+import click
+
+
+def _collect_spans(path: str) -> typing.List[dict]:
+    """Spans from a JSONL file, or from every ``*.jsonl`` under a
+    directory (non-span records — e.g. an event log living next to the
+    span log — are filtered by the reader)."""
+    from gordo_tpu.observability.tracing import read_spans
+
+    if os.path.isdir(path):
+        spans: typing.List[dict] = []
+        for root, _, files in os.walk(path):
+            for fname in sorted(files):
+                if fname.endswith(".jsonl"):
+                    spans.extend(read_spans(os.path.join(root, fname)))
+        return spans
+    return read_spans(path)
+
+
+@click.group("trace")
+def trace_cli():
+    """Inspect distributed-tracing span logs (GORDO_TPU_TRACE_LOG)."""
+
+
+@trace_cli.command("summarize")
+@click.argument("path", type=click.Path(exists=True))
+@click.option(
+    "--top",
+    type=click.IntRange(min=1),
+    default=5,
+    show_default=True,
+    help="How many slowest traces to show the critical path for.",
+)
+def trace_summarize(path: str, top: int):
+    """
+    Summarize the span log at PATH (a JSONL file, or a directory to scan
+    for ``*.jsonl``): per-phase and per-machine totals, error counts,
+    and the critical-path breakdown of the slowest traces.
+    """
+    from gordo_tpu.observability.tracing import summarize_spans
+
+    click.echo(summarize_spans(_collect_spans(path), top=top))
+
+
+@trace_cli.command("export")
+@click.argument("path", type=click.Path(exists=True))
+@click.option(
+    "--output",
+    "-o",
+    type=click.Path(dir_okay=False, writable=True),
+    default=None,
+    help="Write the Chrome-trace JSON here (default: stdout).",
+)
+def trace_export(path: str, output: typing.Optional[str]):
+    """
+    Export the span log at PATH to Chrome-trace JSON for Perfetto /
+    chrome://tracing: one complete event per span, one row per trace.
+    """
+    from gordo_tpu.observability.tracing import spans_to_chrome_trace
+
+    payload = spans_to_chrome_trace(_collect_spans(path))
+    text = json.dumps(payload)
+    if output:
+        with open(output, "w") as fh:
+            fh.write(text + "\n")
+        click.echo(
+            f"wrote {len(payload['traceEvents'])} trace events to {output}"
+        )
+    else:
+        click.echo(text)

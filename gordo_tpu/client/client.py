@@ -42,7 +42,7 @@ from gordo_tpu.client.utils import (
 from gordo_tpu.data.providers.base import GordoBaseDataProvider
 from gordo_tpu.machine import Machine
 from gordo_tpu.machine.metadata import Metadata
-from gordo_tpu.observability import get_registry
+from gordo_tpu.observability import get_registry, tracing
 from gordo_tpu.server import utils as server_utils
 from gordo_tpu.utils.compat import normalize_frequency
 
@@ -270,14 +270,35 @@ class Client:
         """
         _revision = revision or self._get_latest_revision()
         machines = self._get_machines(revision=_revision, machine_names=targets)
-        with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
-            jobs = executor.map(
-                lambda machine: self.predict_single_machine(
-                    machine=machine, start=start, end=end, revision=_revision
-                ),
-                machines,
+        with tracing.start_span(
+            "client.predict", path="single", n_machines=len(machines)
+        ) as span:
+            parent_ctx = span.context
+            with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
+                jobs = executor.map(
+                    lambda machine: self._predict_single_traced(
+                        parent_ctx,
+                        machine=machine,
+                        start=start,
+                        end=end,
+                        revision=_revision,
+                    ),
+                    machines,
+                )
+                return [(j.name, j.predictions, j.error_messages) for j in jobs]
+
+    def _predict_single_traced(
+        self, parent_ctx, machine: Machine, start, end, revision
+    ) -> PredictionResult:
+        """One machine's prediction under a per-machine span attached to
+        the caller's trace (explicit parent: contextvars do not follow
+        ThreadPoolExecutor workers)."""
+        with tracing.start_span(
+            "client.predict_machine", parent=parent_ctx, machine=machine.name
+        ):
+            return self.predict_single_machine(
+                machine=machine, start=start, end=end, revision=revision
             )
-            return [(j.name, j.predictions, j.error_messages) for j in jobs]
 
     def predict_fleet(
         self,
@@ -319,21 +340,44 @@ class Client:
                 (pool[i : i + size], use_base) for i in range(0, len(pool), size)
             )
         results: typing.List[typing.Tuple[str, pd.DataFrame, typing.List[str]]] = []
-        with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
-            for group_results in executor.map(
-                lambda job: self._predict_machine_group(
-                    job[0],
-                    start=start,
-                    end=end,
-                    revision=_revision,
-                    use_base_path=job[1],
-                ),
-                jobs,
-            ):
-                results.extend(
-                    (r.name, r.predictions, r.error_messages) for r in group_results
-                )
+        with tracing.start_span(
+            "client.predict", path="fleet", n_machines=len(machines)
+        ) as span:
+            parent_ctx = span.context
+            with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
+                for group_results in executor.map(
+                    lambda job: self._predict_group_traced(
+                        parent_ctx,
+                        job[0],
+                        start=start,
+                        end=end,
+                        revision=_revision,
+                        use_base_path=job[1],
+                    ),
+                    jobs,
+                ):
+                    results.extend(
+                        (r.name, r.predictions, r.error_messages)
+                        for r in group_results
+                    )
         return results
+
+    def _predict_group_traced(
+        self, parent_ctx, group, start, end, revision, use_base_path
+    ) -> typing.List[PredictionResult]:
+        """One machine group under a span attached to the caller's trace
+        (explicit parent — executor workers do not inherit contextvars);
+        the group's fleet-chunk POSTs nest under it in-thread."""
+        with tracing.start_span(
+            "client.predict_group", parent=parent_ctx, n_machines=len(group)
+        ):
+            return self._predict_machine_group(
+                group,
+                start=start,
+                end=end,
+                revision=revision,
+                use_base_path=use_base_path,
+            )
 
     def _predict_machine_group(
         self,
@@ -521,7 +565,10 @@ class Client:
     ) -> typing.Tuple[str, Any]:
         """
         POST one fleet chunk with the single-machine path's retry/backoff
-        discipline. Returns one of:
+        discipline, under one ``client.request`` span — the SAME span
+        (and so the same trace/span ids in the injected ``traceparent``)
+        across every retry, so one slow or flapping chunk is one trace.
+        Returns one of:
 
         - ``("ok", response_dict)``
         - ``("refused", message)`` — a 4xx the server will repeat (422 mixed
@@ -536,7 +583,17 @@ class Client:
 
         410 propagates (deployment revision gone, like the per-machine path).
         """
+        with tracing.start_span("client.request", path="fleet") as span:
+            return self._post_fleet_chunk_traced(url, payload, revision, span)
+
+    def _post_fleet_chunk_traced(
+        self, url: str, payload: typing.Dict[str, Any], revision: str, span
+    ) -> typing.Tuple[str, Any]:
         post_kwargs: typing.Dict[str, Any] = {"params": {"revision": revision}}
+        headers = tracing.propagation_headers(span)
+        if headers:
+            # constant across retries: same trace id, same parent span
+            post_kwargs["headers"] = headers
         if self.use_parquet:
             post_kwargs["files"] = payload
         else:
@@ -575,7 +632,12 @@ class Client:
                     sleep(time_to_sleep)
                     continue
                 logger.error("Fleet chunk failed after retries: %s", exc)
-                return "io_error", str(exc)
+                message = str(exc)
+                if span.recording:
+                    # the recorded per-machine failure names the trace the
+                    # retries happened under, greppable server-side too
+                    message += f" (trace id: {span.trace_id})"
+                return "io_error", message
             except ResourceGone:
                 _observe_request("fleet", "gone", monotonic() - attempt_start)
                 raise
@@ -615,6 +677,9 @@ class Client:
         chunks = self._row_chunks(
             len(X), self.batch_size, self._min_chunk_rows(machine)
         )
+        # the batch POSTs run on their own pool: hand them the ambient
+        # trace context explicitly (executor workers do not inherit it)
+        parent_ctx = tracing.current_context()
         with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
             jobs = executor.map(
                 lambda bounds: self._send_prediction_request(
@@ -625,6 +690,7 @@ class Client:
                     start=X.index[bounds[0]],
                     end=X.index[bounds[1] - 1],
                     revision=revision,
+                    trace_parent=parent_ctx,
                 ),
                 chunks,
             )
@@ -652,12 +718,39 @@ class Client:
         start: datetime,
         end: datetime,
         revision: str,
+        trace_parent=None,
     ) -> PredictionResult:
         """
         POST one batch; 422 → permanent fallback to /prediction; IO errors →
         exponential backoff (2^(attempt+2) capped 300s); 4xx → give up on the
         batch; 410 → propagate (reference: client.py:391-510).
+
+        The whole batch — fallback POST and every retry included — runs
+        under ONE ``client.request`` span, whose ``traceparent`` rides
+        each attempt: the trace id a failed batch reports is the one the
+        server echoed and logged.
         """
+        with tracing.start_span(
+            "client.request",
+            parent=trace_parent,
+            path="single",
+            machine=machine.name,
+        ) as span:
+            return self._send_prediction_request_traced(
+                X, y, chunk, machine, start, end, revision, span
+            )
+
+    def _send_prediction_request_traced(
+        self,
+        X: pd.DataFrame,
+        y: Optional[pd.DataFrame],
+        chunk: slice,
+        machine: Machine,
+        start: datetime,
+        end: datetime,
+        revision: str,
+        span,
+    ) -> PredictionResult:
         path = (
             "/prediction"
             if machine.name in self._fallback_machines
@@ -667,6 +760,11 @@ class Client:
             url=f"{self.server_endpoint}/{machine.name}{path}",
             params={"format": self.format, "revision": revision},
         )
+        headers = tracing.propagation_headers(span)
+        if headers:
+            # constant across the 422 fallback and every retry: one
+            # batch, one trace id, however many attempts it takes
+            kwargs["headers"] = headers
         if self.use_parquet:
             kwargs["files"] = {
                 "X": server_utils.dataframe_into_parquet_bytes(X.iloc[chunk]),
@@ -723,6 +821,8 @@ class Client:
                     f"Failed to get predictions for dates {start} -> {end} "
                     f"for target: '{machine.name}' Error: {exc}"
                 )
+                if span.recording:
+                    msg += f" (trace id: {span.trace_id})"
                 logger.error(msg)
                 return PredictionResult(
                     name=machine.name, predictions=None, error_messages=[msg]

@@ -8,6 +8,8 @@ from typing import Optional, Union
 
 import requests
 
+from gordo_tpu.observability.tracing import TRACE_ID_RESPONSE_HEADER
+
 
 class HttpUnprocessableEntity(Exception):
     """
@@ -40,12 +42,21 @@ class MachineUnavailable(Exception):
 
     ``unavailable`` holds the server's ``{name: {reason, ...}}`` detail
     when the response carried one (fleet endpoints name every casualty
-    in the refused group).
+    in the refused group). ``trace_id`` is the server's echoed
+    ``X-Gordo-Trace-Id`` when present — the handle that joins this
+    client-side casualty to the server's span log, ``build_report.json``
+    and the event log (docs/observability.md).
     """
 
-    def __init__(self, msg: str, unavailable: Optional[dict] = None):
+    def __init__(
+        self,
+        msg: str,
+        unavailable: Optional[dict] = None,
+        trace_id: Optional[str] = None,
+    ):
         super().__init__(msg)
         self.unavailable = unavailable or {}
+        self.trace_id = trace_id
 
 
 def handle_response(
@@ -78,6 +89,13 @@ def handle_response(
     else:
         msg = f"Failed to get response: {resp.status_code}: {resp.content!r}"
 
+    # the server echoes the request's trace id on every response
+    # (including error paths): surface it in the failure message so the
+    # casualty is greppable in the server-side span/event logs
+    trace_id = resp.headers.get(TRACE_ID_RESPONSE_HEADER)
+    if trace_id:
+        msg += f" (server trace id: {trace_id})"
+
     if resp.status_code == 422:
         raise HttpUnprocessableEntity(msg)
     if resp.status_code == 410:
@@ -89,7 +107,7 @@ def handle_response(
             detail = resp.json().get("unavailable") or {}
         except ValueError:
             detail = {}
-        raise MachineUnavailable(msg, detail)
+        raise MachineUnavailable(msg, detail, trace_id=trace_id)
     if 400 <= resp.status_code <= 499:
         raise BadGordoRequest(msg)
     raise IOError(msg)

@@ -1,13 +1,21 @@
 """
 Fleet-wide telemetry (SURVEY.md §5 gap; ML-goodput direction from
 PAPERS.md arXiv:2502.06982): an in-process, dependency-light metrics
-registry, a structured JSONL event log, and device-memory watermark
-sampling — the data layer every perf / memory-modeling PR stands on.
+registry, a structured JSONL event log, device-memory watermark
+sampling, and distributed tracing — the data layer every perf / memory-
+modeling PR stands on.
 
 - :mod:`registry` — thread-safe Counter/Gauge/Histogram metrics,
   snapshot-able to plain dicts (no ``prometheus_client`` dependency).
 - :mod:`events` — one-JSON-line-per-event emitter (build started/
-  finished, epoch, bucket flush, resume, crash context).
+  finished, epoch, bucket flush, resume, crash context), stamped with
+  the active trace context.
+- :mod:`tracing` — dependency-light span layer with W3C ``traceparent``
+  propagation client→server→fleet, JSONL span persistence, and
+  Chrome-trace (Perfetto) export behind ``gordo-tpu trace``.
+- :mod:`profiler` — ``jax.profiler`` hooks (``maybe_trace`` /
+  ``annotate``) bridging spans onto the device timeline (promoted from
+  ``utils/tracing.py``, where a shim remains).
 - :mod:`device_memory` — HBM watermark sampling via
   ``device.memory_stats()``, degrading gracefully (null bytes) on CPU.
 - :mod:`prom_bridge` — optional export of the registry into a
@@ -23,12 +31,33 @@ from .device_memory import (
     save_device_memory_profile,
 )
 from .events import EVENT_LOG_ENV_VAR, EventEmitter, emit_event, read_events
+from .profiler import PROFILE_DIR_ENV_VAR, annotate, maybe_trace, profile_dir
 from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
 from .report import (
     TELEMETRY_REPORT_FILENAME,
     load_reports,
     summarize_directory,
     write_telemetry_report,
+)
+from .tracing import (
+    TRACE_ID_RESPONSE_HEADER,
+    TRACE_LOG_ENV_VAR,
+    TRACE_SAMPLE_ENV_VAR,
+    TRACEPARENT_HEADER,
+    SpanContext,
+    current_context,
+    current_span,
+    current_traceparent,
+    format_traceparent,
+    parse_traceparent,
+    propagation_headers,
+    read_spans,
+    record_span,
+    spans_to_chrome_trace,
+    start_span,
+    summarize_spans,
+    trace_fields,
+    tracing_enabled,
 )
 
 __all__ = [
@@ -41,6 +70,28 @@ __all__ = [
     "EventEmitter",
     "emit_event",
     "read_events",
+    "PROFILE_DIR_ENV_VAR",
+    "annotate",
+    "maybe_trace",
+    "profile_dir",
+    "TRACE_ID_RESPONSE_HEADER",
+    "TRACE_LOG_ENV_VAR",
+    "TRACE_SAMPLE_ENV_VAR",
+    "TRACEPARENT_HEADER",
+    "SpanContext",
+    "current_context",
+    "current_span",
+    "current_traceparent",
+    "format_traceparent",
+    "parse_traceparent",
+    "propagation_headers",
+    "read_spans",
+    "record_span",
+    "spans_to_chrome_trace",
+    "start_span",
+    "summarize_spans",
+    "trace_fields",
+    "tracing_enabled",
     "device_memory_stats",
     "memory_watermarks",
     "save_device_memory_profile",

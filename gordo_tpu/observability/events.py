@@ -19,6 +19,8 @@ import time
 import typing
 from datetime import datetime, timezone
 
+from gordo_tpu.observability.tracing import trace_fields
+
 logger = logging.getLogger(__name__)
 
 EVENT_LOG_ENV_VAR = "GORDO_TPU_EVENT_LOG"
@@ -59,6 +61,14 @@ class EventEmitter:
             "pid": os.getpid(),
         }
         record.update(fields)
+        # trace correlation: an event emitted inside an active span
+        # carries its trace/span ids, so the event log joins the span
+        # log (and the server's X-Gordo-Trace-Id echoes) on trace_id.
+        # Explicit fields win — cross-thread sites pass
+        # ``**trace_fields(span)`` themselves, contextvars not being
+        # inherited by worker threads.
+        for key, value in trace_fields().items():
+            record.setdefault(key, value)
         try:
             line = json.dumps(record, default=str)
         except Exception:

@@ -35,7 +35,7 @@ import numpy as np
 from jax.sharding import Mesh
 
 from gordo_tpu.models.specs import ModelSpec, per_sample_loss
-from gordo_tpu.observability import emit_event, get_registry
+from gordo_tpu.observability import annotate, emit_event, get_registry, tracing
 from gordo_tpu.parallel.mesh import fleet_sharding, pad_to_multiple, replicated_sharding
 from gordo_tpu.robustness import faults as _faults
 
@@ -1175,9 +1175,16 @@ class FleetTrainer:
                 extras.append(
                     _put_fleet_arr(inj[0] & (epoch == inj[1]), self.mesh)
                 )
-            result = epoch_fn(
-                params, opt_state, epoch_keys, X_arg, y_arg, w_arg, *extras
-            )
+            # span + profiler annotation: the same dispatch shows up in
+            # the distributed trace AND (when a jax.profiler trace is
+            # active) on the XLA device timeline
+            with tracing.start_span(
+                "train.dispatch", epoch=epoch, n_epochs=1
+            ), annotate("train-dispatch"):
+                result = epoch_fn(
+                    params, opt_state, epoch_keys, X_arg, y_arg, w_arg,
+                    *extras
+                )
             if quarantine:
                 params, opt_state, epoch_loss, healthy_dev = result
             else:
@@ -1535,7 +1542,13 @@ class FleetTrainer:
                 args += [inj_mask_dev, inj_epoch_dev]
             if track_best:
                 args += [best_params_dev, ever_dev]
-            final, outs = chunk_fn(*args)
+            # one fused K-epoch program per dispatch: the span (and, when
+            # a jax.profiler trace is active, the device-timeline
+            # annotation) is the unit the sync-budget telemetry counts
+            with tracing.start_span(
+                "train.dispatch", epoch=e, n_epochs=k
+            ), annotate("train-dispatch"):
+                final, outs = chunk_fn(*args)
             params, opt_state = final["params"], final["opt"]
             if quarantine:
                 healthy_dev = final["healthy"]

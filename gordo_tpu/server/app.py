@@ -45,7 +45,7 @@ from werkzeug.wrappers import Request, Response
 from gordo_tpu import __version__, serializer
 from gordo_tpu.data.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
-from gordo_tpu.observability import get_registry
+from gordo_tpu.observability import get_registry, tracing
 from gordo_tpu.robustness import faults
 from gordo_tpu.server import model_io
 from gordo_tpu.server import utils as server_utils
@@ -95,16 +95,22 @@ class RequestContext:
         self.metadata: typing.Optional[dict] = None
         #: (phase name, seconds) pairs stamped into Server-Timing
         self.timings: typing.List[typing.Tuple[str, float]] = []
+        #: trace id of this request (extracted from the client's
+        #: ``traceparent``, or minted by the request span) — echoed in
+        #: the X-Gordo-Trace-Id response header; '' when neither exists
+        self.trace_id: str = ""
 
     def record_phase(self, name: str, seconds: float) -> None:
-        """One request phase: rides the Server-Timing header AND the
-        process metrics registry (bridged onto /metrics)."""
+        """One request phase: rides the Server-Timing header, the
+        process metrics registry (bridged onto /metrics), AND — when
+        tracing is on — the span log, as a child of the request span."""
         self.timings.append((name, seconds))
         get_registry().histogram(
             "gordo_server_phase_seconds",
             "Server request phase durations",
             ("phase",),
         ).observe(seconds, phase=name)
+        tracing.record_span(name, seconds)
 
 
 def _json_response(payload: dict, status: int = 200) -> Response:
@@ -219,9 +225,42 @@ class GordoApp:
         response = self.dispatch(request)
         return response(environ, start_response)
 
+    #: probe endpoints whose per-request spans would be pure noise — the
+    #: same paths the prometheus middleware excludes from request
+    #: counting (a liveness probe + scrape would mint tens of thousands
+    #: of junk single-span traces per worker per day). A probe carrying
+    #: a traceparent still gets its id echoed; it just records nothing.
+    _TRACE_EXEMPT_PATHS = frozenset({"/healthcheck", "/metrics"})
+
     def dispatch(self, request: Request) -> Response:
         ctx = RequestContext()
+        # W3C trace-context extraction: the client's traceparent names
+        # the trace this request belongs to. Parsed only when the header
+        # is present; with tracing disabled the span below is the strict
+        # no-op and only the echo (in _finalize) remains.
+        incoming = tracing.parse_traceparent(
+            request.headers.get(tracing.TRACEPARENT_HEADER)
+        )
         adapter = self.url_map.bind_to_environ(request.environ)
+        if request.path in self._TRACE_EXEMPT_PATHS:
+            ctx.trace_id = incoming.trace_id if incoming is not None else ""
+            return self._dispatch_traced(
+                ctx, request, adapter, tracing.NOOP_SPAN
+            )
+        with tracing.start_span(
+            "server.request",
+            parent=incoming,
+            method=request.method,
+            path=request.path,
+        ) as span:
+            ctx.trace_id = span.trace_id or (
+                incoming.trace_id if incoming is not None else ""
+            )
+            return self._dispatch_traced(ctx, request, adapter, span)
+
+    def _dispatch_traced(
+        self, ctx: RequestContext, request: Request, adapter, span
+    ) -> Response:
         endpoint = None
         try:
             endpoint, url_args = adapter.match()
@@ -236,15 +275,26 @@ class GordoApp:
         except faults.InjectedFault as exc:
             # the serve-site chaos seam: a distinguishable 503, so chaos
             # tests can tell an injected fault from a real server error
-            response = _json_response({"error": f"Fault injection: {exc}"}, 503)
+            response = _json_response(
+                {"error": f"Fault injection: {exc}"}, 503
+            )
         except HTTPException as exc:
             response = exc.get_response(request.environ)
         except Exception:
-            logger.error("Unhandled server error:\n%s", traceback.format_exc())
+            logger.error(
+                "Unhandled server error:\n%s", traceback.format_exc()
+            )
             response = _json_response(
-                {"error": "Something unexpected happened; check your input data"},
+                {
+                    "error": "Something unexpected happened; "
+                    "check your input data"
+                },
                 500,
             )
+        span.set_attribute("endpoint", endpoint or "unmatched")
+        span.set_attribute("status_code", response.status_code)
+        if response.status_code >= 500:
+            span.set_status("error")
         return self._finalize(ctx, request, response, endpoint)
 
     def _resolve_revision(
@@ -303,6 +353,13 @@ class GordoApp:
         response.headers["Server-Timing"] = ", ".join(entries)
         # which pre-forked worker served this (see server/runner.py)
         response.headers["X-Gordo-Server-Pid"] = str(os.getpid())
+        # echo the trace id on EVERY response — 409/503/500 error paths
+        # included — so a casualty reported client-side is greppable in
+        # the server's span/event logs (docs/observability.md). Present
+        # whenever the client sent a traceparent, even with server-side
+        # recording off.
+        if ctx.trace_id:
+            response.headers[tracing.TRACE_ID_RESPONSE_HEADER] = ctx.trace_id
         if self.prometheus_metrics is not None and request.path not in (
             "/healthcheck",
             "/metrics",  # don't count scrapes as server traffic

@@ -1,89 +1,19 @@
 """
-Profiling/trace hooks — the TPU-native analogue of the reference's
-lightweight timing surface (SURVEY.md §5: Server-Timing headers and
-metadata-embedded durations, which this package also keeps).
-
-``maybe_trace`` wraps a region in a ``jax.profiler`` trace when profiling
-is enabled, producing TensorBoard-loadable dumps (XLA op timelines, HBM
-usage) under ``<dir>/<name>-<timestamp>/``. Enable per-process with the
-``GORDO_TPU_PROFILE_DIR`` env var or per-call with an explicit directory.
-
-``annotate`` adds named spans inside an active trace so builder phases
-(data fetch, CV folds, fit) are attributable on the timeline.
+Re-export shim — the jax-profiler trace hooks were promoted into the
+observability subsystem (``gordo_tpu.observability.profiler``), next to
+the distributed-tracing span layer whose dispatch spans bridge onto the
+device timeline through them. Every historical import site (the builder,
+tests, external users) keeps working unchanged; ``_active`` is the SAME
+object as the package's, so test seams that flip it still steer the
+real hooks.
 """
 
-import contextlib
-import logging
-import os
-import threading
-import time
+from gordo_tpu.observability.profiler import (  # noqa: F401  # lint: disable=unused-import
+    PROFILE_DIR_ENV_VAR,
+    _active,
+    annotate,
+    maybe_trace,
+    profile_dir,
+)
 
-logger = logging.getLogger(__name__)
-
-PROFILE_DIR_ENV_VAR = "GORDO_TPU_PROFILE_DIR"
-
-# set while a maybe_trace region is active, so annotate() works for both
-# env-var and explicit-directory tracing
-_active = threading.local()
-
-
-def profile_dir() -> str:
-    """Configured profile dump directory, or '' when profiling is off."""
-    return os.environ.get(PROFILE_DIR_ENV_VAR, "")
-
-
-@contextlib.contextmanager
-def maybe_trace(name: str, directory: str = ""):
-    """
-    Trace the region into ``<directory>/<name>-<unix_ms>`` when a directory
-    is configured (argument wins over env); no-op otherwise. Never lets a
-    profiler failure break the traced workload.
-    """
-    directory = directory or profile_dir()
-    if not directory:
-        yield
-        return
-
-    target = os.path.join(directory, f"{name}-{int(time.time() * 1000)}")
-    started = False
-    try:
-        import jax
-
-        jax.profiler.start_trace(target)
-        started = True
-        _active.tracing = True
-    except Exception:  # broken jax / profiler quirks / nested traces
-        logger.warning("Could not start jax profiler trace", exc_info=True)
-    try:
-        yield
-    finally:
-        if started:
-            _active.tracing = False
-            try:
-                import jax
-
-                jax.profiler.stop_trace()
-                logger.info("Wrote profiler trace to %s", target)
-            except Exception:
-                logger.warning("Could not stop jax profiler trace", exc_info=True)
-
-
-@contextlib.contextmanager
-def annotate(name: str):
-    """
-    Named span inside an active ``maybe_trace`` region. Cheap no-op when no
-    trace is active, and never breaks the annotated workload if the
-    profiler is unusable.
-    """
-    if not getattr(_active, "tracing", False):
-        yield
-        return
-    try:
-        import jax
-
-        span = jax.profiler.TraceAnnotation(name)
-    except Exception:  # broken jax
-        yield
-        return
-    with span:
-        yield
+__all__ = ["PROFILE_DIR_ENV_VAR", "annotate", "maybe_trace", "profile_dir"]

@@ -30,9 +30,11 @@ from gordo_tpu.analysis.checks import (  # noqa: F401  # lint: disable=unused-im
     check_return_annotations,
     check_self_attributes,
     check_self_method_calls,
+    check_span_discipline,
     check_unused_imports,
     collect_event_names,
     collect_metric_names,
+    collect_span_names,
     parse,
 )
 from gordo_tpu.analysis.jax_checks import (  # noqa: F401  # lint: disable=unused-import

@@ -1,0 +1,28 @@
+"""Positive fixture for span-discipline: leaked spans and hand-stamped
+trace fields. Every shape here must be flagged."""
+
+from gordo_tpu.observability import tracing
+from gordo_tpu.observability.events import emit_event
+from gordo_tpu.observability.tracing import start_span
+
+
+def leaked_bare_call():
+    start_span("build.fetch")  # opened, never entered or closed
+
+
+def leaked_assigned_handle():
+    handle = tracing.start_span("client.request", machine="m-1")
+    next(handle)  # manually driven: exit (and the JSONL write) never runs
+    return handle
+
+
+def leaked_passed_along(register):
+    register(start_span("build.bucket"))
+
+
+def hand_stamped_function(span):
+    emit_event("epoch", trace_id=span.trace_id, epoch=0)
+
+
+def hand_stamped_method(emitter, span):
+    emitter.emit("early_stop", span_id=span.span_id)

@@ -20,6 +20,7 @@ from gordo_tpu.analysis import (
     check_prng_key_reuse,
     check_prng_split_width,
     check_retrace_risk,
+    check_span_discipline,
     check_traced_branching,
     engine,
     lint_file,
@@ -37,6 +38,7 @@ _CHECKS = {
     "prng-reuse": check_prng_key_reuse,
     "prng-split-width": check_prng_split_width,
     "traced-branch": check_traced_branching,
+    "span-discipline": check_span_discipline,
 }
 
 _FIXTURE_STEMS = {
@@ -45,6 +47,7 @@ _FIXTURE_STEMS = {
     "prng-reuse": "prng_reuse",
     "prng-split-width": "prng_split_width",
     "traced-branch": "traced_branch",
+    "span-discipline": "span_discipline",
 }
 
 

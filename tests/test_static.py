@@ -704,6 +704,52 @@ def test_event_name_collector_reads_both_surfaces():
     assert names == {"build_started", "epoch", "early_stop"}
 
 
+def test_span_names_documented():
+    """Every literal span name the package opens (start_span) or records
+    (record_span/record_phase) must appear in docs/observability.md's
+    span catalogue — the tracing sibling of the metric/event sync
+    gates: an attribution surface nobody can look up is how slow-phase
+    investigations go back to external re-measurement."""
+    from static_analysis import collect_span_names
+
+    opened: set = set()
+    for name, module in _importable_modules():
+        opened |= collect_span_names(parse(module.__file__))
+    assert opened, "no span names found — collector broken?"
+    docs = (
+        Path(gordo_tpu.__file__).parent.parent / "docs" / "observability.md"
+    ).read_text()
+    undocumented = sorted(s for s in opened if f"`{s}`" not in docs)
+    assert not undocumented, (
+        f"span names opened in code but missing from "
+        f"docs/observability.md: {undocumented}"
+    )
+
+
+def test_span_name_collector_reads_open_and_record_surfaces():
+    import ast as _ast
+
+    from static_analysis import collect_span_names
+
+    source = (
+        "def f(tracing, ctx, dynamic):\n"
+        "    with start_span('client.request', machine='m'):\n"
+        "        pass\n"
+        "    with tracing.start_span('server.request'):\n"
+        "        pass\n"
+        "    tracing.record_span('predict', 0.1)\n"
+        "    ctx.record_phase('model_load', 0.1)\n"
+        "    tracing.record_span(dynamic, 0.1)\n"  # non-literal: out of scope
+    )
+    names = collect_span_names(_ast.parse(source))
+    assert names == {
+        "client.request",
+        "server.request",
+        "predict",
+        "model_load",
+    }
+
+
 # --------------------------------------------------------------------------
 # the JAX-discipline family, package-wide (the tier-1 lint gate)
 # --------------------------------------------------------------------------
@@ -713,7 +759,14 @@ _LINT_ROOT = Path(gordo_tpu.__file__).parent.parent
 
 @pytest.mark.parametrize(
     "check_name",
-    ["retrace-risk", "host-sync", "prng-reuse", "prng-split-width", "traced-branch"],
+    [
+        "retrace-risk",
+        "host-sync",
+        "prng-reuse",
+        "prng-split-width",
+        "traced-branch",
+        "span-discipline",
+    ],
 )
 def test_jax_discipline_package_wide(check_name):
     """gordo_tpu + tests + benchmarks lint clean for every JAX check —
