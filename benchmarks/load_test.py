@@ -13,6 +13,23 @@ Target a deployed server:
 or self-serve a temporary in-process server on random-data artifacts:
 
     python benchmarks/load_test.py --self-serve --users 4 --duration 10
+
+Two arrival modes:
+
+- closed-loop (default): ``--users`` workers send back-to-back — each
+  worker waits for its response before the next request, so offered
+  load self-throttles to the server's capacity and queueing collapse is
+  INVISIBLE (latency grows, arrival rate falls, the queue never melts).
+- ``--open-loop``: Poisson arrivals at ``--rps`` regardless of how the
+  server is doing — the millions-of-users shape. This is the mode that
+  can see what dynamic batching (docs/serving.md#dynamic-batching)
+  fixes: batch sizes converging above 1, queue-wait bounded by the SLO
+  cap, and admission control shedding (503 + Retry-After) instead of
+  unbounded queue melt. Reports p50/p99 latency, achieved vs offered
+  throughput, mean dispatch batch size, and shed rate.
+
+    python benchmarks/load_test.py --self-serve --open-loop --rps 80 \\
+        --duration 20 --fleet 2 --batch-wait-ms 10 --queue-limit 32
 """
 
 import argparse
@@ -33,7 +50,14 @@ honor_jax_platforms_env()
 enable_compile_cache()
 
 
-def self_serve(tmp: str, port: int, n_machines: int = 1, model: str = "hourglass") -> str:
+def self_serve(
+    tmp: str,
+    port: int,
+    n_machines: int = 1,
+    model: str = "hourglass",
+    batch_wait_ms: float = 0.0,
+    queue_limit: int = 64,
+) -> str:
     """Train machine(s) on random data and serve them; returns base URL."""
     from werkzeug.serving import make_server
 
@@ -42,7 +66,10 @@ def self_serve(tmp: str, port: int, n_machines: int = 1, model: str = "hourglass
 
     collection = build_collection(n_machines, tmp, model)
     os.environ["MODEL_COLLECTION_DIR"] = collection
-    server = make_server("127.0.0.1", port, build_app(), threaded=True)
+    app = build_app(
+        {"BATCH_WAIT_MS": batch_wait_ms, "BATCH_QUEUE_LIMIT": queue_limit}
+    )
+    server = make_server("127.0.0.1", port, app, threaded=True)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return f"http://127.0.0.1:{port}"
 
@@ -65,6 +92,94 @@ def worker(url: str, body: bytes, stop_at: float, latencies, errors):
         latencies.append((time.perf_counter() - start) * 1000)
 
 
+def open_loop(url: str, body: bytes, rps: float, duration: float, seed: int):
+    """
+    Poisson arrivals at target ``rps`` for ``duration`` seconds, one
+    thread per in-flight request (arrivals never wait for responses).
+    Returns (latencies_ms, errors, sheds, elapsed_s) — a shed is a 503
+    carrying Retry-After (batching admission control); other failures
+    are errors. ``elapsed_s`` runs from the first arrival to the LAST
+    COMPLETION (not the thread-join return): achieved-throughput math
+    must not be diluted by one straggler's urlopen timeout.
+    """
+    import random
+
+    rng = random.Random(seed)
+    latencies: list = []
+    errors: list = []
+    sheds: list = []
+    done_at: list = []
+
+    def one_request():
+        request = urllib.request.Request(
+            url, data=body, headers={"Content-Type": "application/json"}
+        )
+        start = time.perf_counter()
+        try:
+            try:
+                with urllib.request.urlopen(request, timeout=60) as resp:
+                    resp.read()
+            except urllib.error.HTTPError as err:
+                err.read()
+                retry_after = err.headers.get("Retry-After")
+                if err.code == 503 and retry_after is not None:
+                    sheds.append(float(retry_after))
+                else:
+                    errors.append(err.code)
+                return
+            except Exception:
+                errors.append("exception")
+                return
+            latencies.append((time.perf_counter() - start) * 1000)
+        finally:
+            done_at.append(time.perf_counter())
+
+    threads = []
+    start = time.perf_counter()
+    next_arrival = start
+    while next_arrival - start < duration:
+        next_arrival += rng.expovariate(rps)
+        delay = next_arrival - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        thread = threading.Thread(target=one_request)
+        thread.start()
+        threads.append(thread)
+    for thread in threads:
+        thread.join()
+    elapsed = (max(done_at) if done_at else time.perf_counter()) - start
+    return latencies, errors, sheds, elapsed
+
+
+def batching_registry_stats():
+    """
+    Dispatch batch size / queue wait / shed counts from the in-process
+    observability registry — meaningful only under --self-serve, where
+    the bench and the server share a process (against --base-url the
+    numbers live in the REMOTE server's /metrics).
+    """
+    from gordo_tpu.observability import get_registry
+
+    snap = get_registry().snapshot()
+
+    def first_series(name):
+        series = (snap.get(name) or {}).get("series") or []
+        return series[0] if series else None
+
+    out = {}
+    requests = first_series("gordo_serve_batch_requests")
+    if requests and requests["count"]:
+        out["dispatches"] = requests["count"]
+        out["mean_batch_size"] = round(requests["sum"] / requests["count"], 2)
+    wait = first_series("gordo_serve_batch_queue_wait_seconds")
+    if wait and wait["count"]:
+        out["queue_wait_mean_ms"] = round(wait["sum"] / wait["count"] * 1000, 3)
+    shed = first_series("gordo_serve_batch_shed_total")
+    if shed:
+        out["sheds"] = shed["value"]
+    return out
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--base-url", default=None)
@@ -82,6 +197,39 @@ def main():
     )
     parser.add_argument("--self-serve", action="store_true")
     parser.add_argument("--port", type=int, default=5599)
+    parser.add_argument(
+        "--open-loop",
+        action="store_true",
+        help="Poisson arrivals at --rps instead of closed-loop --users "
+        "workers: offered load does not self-throttle, so queueing "
+        "collapse (and the batching/shedding that prevents it) is "
+        "actually visible",
+    )
+    parser.add_argument(
+        "--rps",
+        type=float,
+        default=50.0,
+        help="Open-loop target arrival rate (requests/second)",
+    )
+    parser.add_argument(
+        "--seed",
+        type=int,
+        default=0,
+        help="Open-loop arrival-process seed (reproducible schedules)",
+    )
+    parser.add_argument(
+        "--batch-wait-ms",
+        type=float,
+        default=0.0,
+        help="Self-serve server's dynamic-batching SLO cap "
+        "(docs/serving.md); 0 = batching disabled",
+    )
+    parser.add_argument(
+        "--queue-limit",
+        type=int,
+        default=64,
+        help="Self-serve server's batching admission-control bound",
+    )
     def _non_negative(value):
         n = int(value)
         if n < 0:
@@ -122,7 +270,12 @@ def main():
         if not args.self_serve:
             parser.error("--base-url or --self-serve required")
         base_url = self_serve(
-            tmp_ctx.name, args.port, max(1, args.fleet), args.model
+            tmp_ctx.name,
+            args.port,
+            max(1, args.fleet),
+            args.model,
+            batch_wait_ms=args.batch_wait_ms,
+            queue_limit=args.queue_limit,
         )
         served_locally = True
 
@@ -162,28 +315,39 @@ def main():
     except urllib.error.URLError as err:
         sys.exit(f"cannot reach {url}: {err.reason}")
 
-    latencies: list = []
-    errors: list = []
-    stop_at = time.perf_counter() + args.duration
-    threads = [
-        threading.Thread(
-            target=worker, args=(url, body, stop_at, latencies, errors)
-        )
-        for _ in range(args.users)
-    ]
+    sheds: list = []
     start = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    elapsed = time.perf_counter() - start
+    if args.open_loop:
+        latencies, errors, sheds, elapsed = open_loop(
+            url, body, args.rps, args.duration, args.seed
+        )
+    else:
+        latencies = []
+        errors = []
+        stop_at = time.perf_counter() + args.duration
+        threads = [
+            threading.Thread(
+                target=worker, args=(url, body, stop_at, latencies, errors)
+            )
+            for _ in range(args.users)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - start
 
     from benchmarks.server_latency import summarize_ms
     from gordo_tpu.observability.tracing import measure_overhead
 
     summary = summarize_ms(latencies) if latencies else {}
     out = {
-        "users": args.users,
+        "mode": "open" if args.open_loop else "closed",
+        **(
+            {"offered_rps": args.rps}
+            if args.open_loop
+            else {"users": args.users}
+        ),
         # only self-serve knows what it built; against a --base-url
         # deployment the family is whatever is deployed there
         **({"model": args.model} if served_locally else {}),
@@ -197,6 +361,18 @@ def main():
         # justified against the request latencies above by a number
         "tracing_overhead": measure_overhead(samples=1000),
     }
+    if args.open_loop:
+        attempts = len(latencies) + len(errors) + len(sheds)
+        out["sheds"] = len(sheds)
+        out["shed_rate"] = round(len(sheds) / attempts, 4) if attempts else 0.0
+        if sheds:
+            out["shed_retry_after_s_max"] = max(sheds)
+    if served_locally:
+        out["batch_wait_ms"] = args.batch_wait_ms
+        out["queue_limit"] = args.queue_limit
+        # the server runs in-process: its dispatch batch sizes and queue
+        # waits are readable straight off the shared registry
+        out.update(batching_registry_stats())
     if args.fleet:
         # each request scores --fleet machines; the comparable per-machine
         # rate against the single-machine mode

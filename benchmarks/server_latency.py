@@ -72,12 +72,13 @@ def build_collection(n_machines: int, tmp: str, model: str = "hourglass") -> str
 
 
 def summarize_ms(times):
-    """mean/p50/p95 summary of a list of millisecond latencies."""
+    """mean/p50/p95/p99 summary of a list of millisecond latencies."""
     ordered = sorted(times)
     return {
         "mean_ms": round(statistics.mean(ordered), 3),
         "p50_ms": round(statistics.median(ordered), 3),
         "p95_ms": round(ordered[max(0, int(0.95 * len(ordered)) - 1)], 3),
+        "p99_ms": round(ordered[max(0, int(0.99 * len(ordered)) - 1)], 3),
     }
 
 

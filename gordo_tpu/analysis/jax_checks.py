@@ -56,8 +56,15 @@ from gordo_tpu.analysis.checks import _own_scope_nodes
 SANCTIONED_SYNC_FUNCTIONS = frozenset({"host_fetch"})
 
 #: modules tagged hot: host-sync findings only fire here (engine.py maps
-#: paths onto this; the check itself is path-agnostic)
-HOT_PATH_PATTERNS = ("gordo_tpu/parallel/", "gordo_tpu/models/core.py")
+#: paths onto this; the check itself is path-agnostic). The server is
+#: hot since dynamic batching: its drainer loop dispatches EVERY
+#: coalesced request, so one accidental per-iteration ``.item()`` there
+#: would stall the whole replica's serving pipeline.
+HOT_PATH_PATTERNS = (
+    "gordo_tpu/parallel/",
+    "gordo_tpu/models/core.py",
+    "gordo_tpu/server/",
+)
 
 
 def _jit_names(tree: ast.Module) -> typing.Set[str]:

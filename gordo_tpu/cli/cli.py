@@ -565,6 +565,26 @@ def telemetry_summarize(directory: str, as_json: bool):
     help="Per-worker bound on simultaneously accepted connections.",
 )
 @click.option(
+    "--batch-wait-ms",
+    type=click.FloatRange(min=0),
+    default=0.0,
+    envvar="GORDO_BATCH_WAIT_MS",
+    show_default=True,
+    help="Dynamic-batching latency-SLO cap: coalesce concurrent fleet "
+    "requests for up to this long into one stacked device dispatch "
+    "(docs/serving.md). 0 disables batching — a strict pass-through of "
+    "the direct-dispatch path.",
+)
+@click.option(
+    "--queue-limit",
+    type=click.IntRange(min=1),
+    default=64,
+    envvar="GORDO_BATCH_QUEUE_LIMIT",
+    show_default=True,
+    help="Batching admission control: requests beyond this many waiting "
+    "in the queue shed with a structured 503 + Retry-After.",
+)
+@click.option(
     "--log-level",
     type=click.Choice(["debug", "info", "warning", "error", "critical"]),
     default="debug",
@@ -578,12 +598,25 @@ def telemetry_summarize(directory: str, as_json: bool):
     help="Enable Prometheus request metrics.",
 )
 def run_server_cli(
-    host, port, workers, threads, worker_connections, log_level, with_prometheus
+    host,
+    port,
+    workers,
+    threads,
+    worker_connections,
+    batch_wait_ms,
+    queue_limit,
+    log_level,
+    with_prometheus,
 ):
     """Run the model server (reference: cli.py:278-374)."""
     from gordo_tpu.server import app as server_app
 
-    config = {"ENABLE_PROMETHEUS": True} if with_prometheus else None
+    config = {
+        "BATCH_WAIT_MS": batch_wait_ms,
+        "BATCH_QUEUE_LIMIT": queue_limit,
+    }
+    if with_prometheus:
+        config["ENABLE_PROMETHEUS"] = True
     server_app.run_server(
         host,
         port,

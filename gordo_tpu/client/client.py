@@ -38,6 +38,7 @@ from gordo_tpu.client.utils import (
     PredictionResult,
     backoff_seconds,
     cached_method,
+    retry_after_seconds,
 )
 from gordo_tpu.data.providers.base import GordoBaseDataProvider
 from gordo_tpu.machine import Machine
@@ -72,6 +73,20 @@ def _count_retry(path: str) -> None:
         "Prediction POST retries after IO errors",
         ("path",),
     ).inc(path=path)
+
+
+def _retry_sleep_seconds(exc: Exception, attempt: int) -> float:
+    """
+    The one retry-delay policy for prediction POSTs: a shedding server's
+    ``Retry-After`` (a :class:`ServerOverloaded` 503 from batching
+    admission control) is honored as the backoff base — jittered UP so
+    the shed herd decorrelates — otherwise exponential backoff, jittered
+    down, as always.
+    """
+    retry_after = getattr(exc, "retry_after", None)
+    if retry_after is not None:
+        return retry_after_seconds(retry_after, jitter=DEFAULT_RETRY_JITTER)
+    return backoff_seconds(attempt, jitter=DEFAULT_RETRY_JITTER)
 
 
 class Client:
@@ -618,10 +633,9 @@ class Client:
                 if current_attempt <= self.n_retries:
                     _count_retry("fleet")
                     # jittered: a fleet of clients bounced by one flapped
-                    # server must not re-arrive in lockstep
-                    time_to_sleep = backoff_seconds(
-                        current_attempt, jitter=DEFAULT_RETRY_JITTER
-                    )
+                    # server must not re-arrive in lockstep; a shed 503's
+                    # Retry-After overrides the exponential base
+                    time_to_sleep = _retry_sleep_seconds(exc, current_attempt)
                     logger.warning(
                         "Fleet chunk failed attempt %d of %d; retrying in "
                         "%.1fs",
@@ -806,9 +820,7 @@ class Client:
                 )
                 if current_attempt <= self.n_retries:
                     _count_retry("single")
-                    time_to_sleep = backoff_seconds(
-                        current_attempt, jitter=DEFAULT_RETRY_JITTER
-                    )
+                    time_to_sleep = _retry_sleep_seconds(exc, current_attempt)
                     logger.warning(
                         "Failed attempt %d of %d; retrying in %.1fs",
                         current_attempt,

@@ -4,6 +4,7 @@ exceptions so callers can branch on failure mode rather than parse
 status ints (reference parity: gordo/client/io.py:8-101).
 """
 
+import math
 from typing import Optional, Union
 
 import requests
@@ -31,6 +32,28 @@ class BadGordoRequest(Exception):
 
 class NotFound(Exception):
     """HTTP 404 (reference: gordo/client/io.py:37-42)."""
+
+
+class ServerOverloaded(IOError):
+    """
+    HTTP 503 carrying a ``Retry-After`` header — the server's
+    dynamic-batching admission control shed the request before its queue
+    melted (docs/serving.md#dynamic-batching). Transient by declaration:
+    the server itself said when to come back, so retry loops honor
+    ``retry_after`` (seconds) as the backoff base instead of
+    exponential guessing. Subclasses :class:`IOError` so existing
+    retry-on-IO-error paths keep catching it.
+    """
+
+    def __init__(
+        self,
+        msg: str,
+        retry_after: Optional[float] = None,
+        trace_id: Optional[str] = None,
+    ):
+        super().__init__(msg)
+        self.retry_after = retry_after
+        self.trace_id = trace_id
 
 
 class MachineUnavailable(Exception):
@@ -110,4 +133,33 @@ def handle_response(
         raise MachineUnavailable(msg, detail, trace_id=trace_id)
     if 400 <= resp.status_code <= 499:
         raise BadGordoRequest(msg)
+    if resp.status_code == 503:
+        # only a parseable delta-seconds Retry-After upgrades the error:
+        # HTTP-dates (rare, clock-skew-prone) and headerless 503s stay
+        # plain IOErrors on the exponential-backoff path
+        retry_after = _parse_retry_after(resp.headers.get("Retry-After"))
+        if retry_after is not None:
+            raise ServerOverloaded(msg, retry_after=retry_after, trace_id=trace_id)
     raise IOError(msg)
+
+
+#: retry sleeps driven by a server's Retry-After are capped at the same
+#: ceiling as the exponential path (utils.backoff_seconds): a broken
+#: proxy advertising "86400" (or "inf", which float() accepts) must not
+#: park a prediction thread for a day
+MAX_RETRY_AFTER_S = 300.0
+
+
+def _parse_retry_after(value: Optional[str]) -> Optional[float]:
+    """Delta-seconds ``Retry-After`` value capped at
+    :data:`MAX_RETRY_AFTER_S`, or None when absent/not a finite
+    non-negative number."""
+    if value is None:
+        return None
+    try:
+        seconds = float(value)
+    except ValueError:
+        return None
+    if not math.isfinite(seconds) or seconds < 0:
+        return None
+    return min(seconds, MAX_RETRY_AFTER_S)

@@ -98,6 +98,34 @@ def backoff_seconds(
     return base * (1.0 - jitter * source.random())
 
 
+def retry_after_seconds(
+    retry_after: float,
+    jitter: float = 0.0,
+    rng: Optional[random.Random] = None,
+) -> float:
+    """
+    Server-directed backoff: a shedding server's ``Retry-After``
+    (docs/serving.md#dynamic-batching) is the FLOOR — ``jitter``
+    (fraction in [0, 1]) spreads the delay uniformly over
+    ``[base, base*(1+jitter)]``, i.e. ABOVE the advertised window, so a
+    shed herd does not re-arrive in lockstep the moment it closes. Same
+    seedable stream as :func:`backoff_seconds`.
+
+    >>> retry_after_seconds(2)
+    2.0
+    >>> seed_backoff_jitter(7)
+    >>> a = retry_after_seconds(2, jitter=0.25)
+    >>> seed_backoff_jitter(7)
+    >>> a == retry_after_seconds(2, jitter=0.25) and 2.0 <= a <= 2.5
+    True
+    """
+    base = max(0.0, float(retry_after))
+    if not jitter:
+        return base
+    source = rng if rng is not None else _jitter_rng
+    return base * (1.0 + jitter * source.random())
+
+
 def cached_method(maxsize: int = 128, ttl: Optional[float] = None):
     """
     Decorator: per-instance memoization of a method on its positional/keyword

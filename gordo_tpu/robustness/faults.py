@@ -9,7 +9,11 @@ One spec is ``site:mode[:target][@key:value ...]``:
 
 - ``site`` — where the seam lives: ``fetch`` (dataset fetch inside the
   fleet builder), ``train`` (the fleet training step), ``ckpt``
-  (checkpoint write), ``serve`` (the model server's prediction paths).
+  (checkpoint write), ``serve`` (the model server's prediction paths),
+  ``batch`` (the dynamic-batching drainer's per-request seam: fires
+  mid-batch for the request naming the target machine, failing ONLY
+  that request's future — the no-poisoned-batch exercise,
+  server/batching.py).
 - ``mode`` — what happens there: ``raise`` (the seam raises
   :class:`InjectedFault`), ``nan`` (train only: the named machine's
   epoch loss goes NaN at ``@epoch:<e>``, driving the quarantine guard),
@@ -40,7 +44,7 @@ logger = logging.getLogger(__name__)
 
 FAULT_INJECT_ENV_VAR = "GORDO_FAULT_INJECT"
 
-_KNOWN_SITES = frozenset({"fetch", "train", "ckpt", "serve"})
+_KNOWN_SITES = frozenset({"fetch", "train", "ckpt", "serve", "batch"})
 
 
 class InjectedFault(RuntimeError):

@@ -47,7 +47,7 @@ from gordo_tpu.data.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
 from gordo_tpu.observability import get_registry, tracing
 from gordo_tpu.robustness import faults
-from gordo_tpu.server import model_io
+from gordo_tpu.server import batching, model_io
 from gordo_tpu.server import utils as server_utils
 from gordo_tpu.server.utils import ApiError
 from gordo_tpu.utils.compat import normalize_frequency
@@ -74,6 +74,15 @@ class Config:
     EXPECTED_MODELS_ENV_VAR = "EXPECTED_MODELS"
     ENABLE_PROMETHEUS = False  # env fallback applied in build_app
     PROJECT: typing.Optional[str] = None
+    #: dynamic batching (docs/serving.md#dynamic-batching): the
+    #: latency-SLO cap on coalescing concurrent fleet requests into one
+    #: stacked dispatch. 0 disables batching entirely — a strict
+    #: pass-through of the direct-dispatch path. Env fallback
+    #: (GORDO_BATCH_WAIT_MS) applied in build_app.
+    BATCH_WAIT_MS = 0.0
+    #: admission control: queued requests beyond this shed with a
+    #: structured 503 + Retry-After (GORDO_BATCH_QUEUE_LIMIT)
+    BATCH_QUEUE_LIMIT = 64
 
     def to_dict(self) -> dict:
         return {
@@ -135,6 +144,9 @@ class GordoApp:
                 # flask-restplus Api serving its specs at a relative URL)
                 Rule("/gordo/v0/specs.json", endpoint="specs", methods=["GET"]),
                 Rule("/healthcheck", endpoint="healthcheck", methods=["GET"]),
+                # readiness (vs /healthcheck liveness): reflects batcher
+                # saturation so a load balancer drains a melting replica
+                Rule("/healthz", endpoint="healthz", methods=["GET"]),
                 Rule("/server-version", endpoint="server_version", methods=["GET"]),
                 Rule("/metrics", endpoint="metrics", methods=["GET"]),
                 Rule(
@@ -195,6 +207,13 @@ class GordoApp:
         # (collection_dir, machine-name tuple) -> (FleetScorer, prefixes, fallback)
         self._fleet_scorers: typing.Dict[tuple, tuple] = {}
         self._fleet_scorers_lock = threading.Lock()
+        # dynamic batching (docs/serving.md#dynamic-batching): one
+        # RequestBatcher per fleet-scorer key, created lazily and ONLY
+        # when BATCH_WAIT_MS > 0 — the disabled path never touches this
+        self.batch_wait_s = float(self.config.get("BATCH_WAIT_MS") or 0.0) / 1000.0
+        self.batch_queue_limit = int(self.config.get("BATCH_QUEUE_LIMIT") or 64)
+        self._batchers: typing.Dict[tuple, batching.RequestBatcher] = {}
+        self._batchers_lock = threading.Lock()
         # build_report.json path -> (mtime, parsed report): the degraded-
         # serving source of truth (which machines to 409)
         self._build_reports: typing.Dict[str, tuple] = {}
@@ -230,7 +249,7 @@ class GordoApp:
     #: counting (a liveness probe + scrape would mint tens of thousands
     #: of junk single-span traces per worker per day). A probe carrying
     #: a traceparent still gets its id echoed; it just records nothing.
-    _TRACE_EXEMPT_PATHS = frozenset({"/healthcheck", "/metrics"})
+    _TRACE_EXEMPT_PATHS = frozenset({"/healthcheck", "/healthz", "/metrics"})
 
     def dispatch(self, request: Request) -> Response:
         ctx = RequestContext()
@@ -272,6 +291,20 @@ class GordoApp:
                 response = handler(ctx, request, **url_args)
         except ApiError as exc:
             response = _json_response(exc.payload, exc.status)
+        except batching.BatchQueueFull as exc:
+            # admission-control shed: a structured 503 the client's
+            # backoff understands — Retry-After says when the queue
+            # should have turned over (docs/serving.md#dynamic-batching)
+            response = _json_response(
+                {
+                    "error": str(exc),
+                    "queue_depth": exc.queue_depth,
+                    "queue_limit": exc.queue_limit,
+                    "retry_after_s": exc.retry_after_s,
+                },
+                503,
+            )
+            response.headers["Retry-After"] = str(exc.retry_after_s)
         except faults.InjectedFault as exc:
             # the serve-site chaos seam: a distinguishable 503, so chaos
             # tests can tell an injected fault from a real server error
@@ -362,6 +395,7 @@ class GordoApp:
             response.headers[tracing.TRACE_ID_RESPONSE_HEADER] = ctx.trace_id
         if self.prometheus_metrics is not None and request.path not in (
             "/healthcheck",
+            "/healthz",  # probes are not traffic either
             "/metrics",  # don't count scrapes as server traffic
         ):
             self.prometheus_metrics.observe(
@@ -494,6 +528,7 @@ class GordoApp:
     _SPEC_SUMMARIES = {
         "specs": "OpenAPI description of this API",
         "healthcheck": "Liveness check",
+        "healthz": "Readiness check (reflects batching-queue saturation)",
         "server_version": "Server version",
         "metrics": "Prometheus metrics exposition",
         "models": "List models in the served revision",
@@ -733,6 +768,123 @@ class GordoApp:
             self._fleet_scorers[key] = built
         return built
 
+    # -- dynamic batching (docs/serving.md#dynamic-batching) ---------------
+
+    def _get_batcher(
+        self, key: tuple, scorer
+    ) -> batching.RequestBatcher:
+        """The RequestBatcher owning ``key``'s queue, rebuilt when the
+        revision's scorer changed; LRU-bounded like the scorer cache."""
+        with self._batchers_lock:
+            existing = self._batchers.get(key)
+            if (
+                existing is not None
+                and existing.scorer is scorer
+                and not existing.stopped
+            ):
+                self._batchers.pop(key)
+                self._batchers[key] = existing  # LRU refresh
+                return existing
+            if existing is not None:
+                existing.stop()  # stale scorer (new revision/rebuild)
+                self._batchers.pop(key)
+            while len(self._batchers) >= 16:  # same bound as the scorers
+                evicted = self._batchers.pop(next(iter(self._batchers)))
+                evicted.stop()
+            batcher = batching.RequestBatcher(
+                scorer, self.batch_wait_s, self.batch_queue_limit
+            )
+            self._batchers[key] = batcher
+            return batcher
+
+    def _fleet_predict(
+        self,
+        ctx: RequestContext,
+        names: typing.Tuple[str, ...],
+        scorer,
+        inputs: typing.Dict[str, typing.Any],
+    ) -> typing.Dict[str, typing.Any]:
+        """
+        One stacked fleet dispatch. Batching off (``BATCH_WAIT_MS`` 0,
+        the default) is a STRICT pass-through — the direct
+        ``scorer.predict`` call, no queue hop, no batcher object ever
+        constructed (pinned by test, like the fault-inject/tracing
+        no-ops). Batching on: enqueue on the per-(collection,
+        machine-set) batcher, block on the future, and stamp the
+        ``queue`` phase (Server-Timing + span) and batch fan-in ids
+        onto the request.
+        """
+        if self.batch_wait_s <= 0:
+            return scorer.predict(inputs)
+        key = (os.path.realpath(ctx.collection_dir), names)
+        for _ in range(8):
+            try:
+                pending = self._get_batcher(key, scorer).submit(
+                    inputs, trace_id=ctx.trace_id
+                )
+                break
+            except batching.BatcherStopped:
+                # lost the lookup-vs-stop race (scorer rebuild or LRU
+                # eviction between _get_batcher and submit): fetch the
+                # key's live batcher and re-enqueue
+                continue
+        else:
+            raise RuntimeError(
+                "Batcher for %r kept stopping under churn" % (names,)
+            )
+        ctx.record_phase("queue", pending.queue_wait_s)
+        span = tracing.current_span()
+        if span is not None:
+            span.set_attribute(
+                "queue_wait_ms", round(pending.queue_wait_s * 1000.0, 3)
+            )
+            if pending.batch_span_id:
+                span.set_attribute("batch_trace_id", pending.batch_trace_id)
+                span.set_attribute("batch_span_id", pending.batch_span_id)
+                span.set_attribute("batch_n_requests", pending.n_coalesced)
+        return pending.outputs
+
+    def _record_predict_phase(
+        self, ctx: RequestContext, elapsed: float
+    ) -> None:
+        """The ``predict`` Server-Timing phase, net of any batching
+        queue wait already stamped as its own ``queue`` phase — the two
+        must not double-count the same wall time."""
+        queued = sum(s for name, s in ctx.timings if name == "queue")
+        ctx.record_phase("predict", max(0.0, elapsed - queued))
+
+    def view_healthz(self, ctx, request) -> Response:
+        """
+        Readiness (``/healthcheck`` stays pure liveness): 200 while this
+        replica can absorb work; 503 + Retry-After when the batching
+        queue is saturated or actively shedding, so an external load
+        balancer drains a melting replica instead of piling onto it.
+        Queue depth and shed counters ride the body either way.
+        """
+        with self._batchers_lock:
+            batchers = list(self._batchers.values())
+        stats = [b.stats() for b in batchers]
+        overloaded = [s for s in stats if s["saturated"] or s["shedding"]]
+        payload = {
+            "status": "overloaded" if overloaded else "ok",
+            "batching": {
+                "enabled": self.batch_wait_s > 0,
+                "batch_wait_ms": self.batch_wait_s * 1000.0,
+                "queue_limit": self.batch_queue_limit,
+                "batchers": len(stats),
+                "queue_depth": sum(s["queue_depth"] for s in stats),
+                "sheds_total": sum(s["sheds_total"] for s in stats),
+                "shedding": any(s["shedding"] for s in stats),
+            },
+        }
+        if overloaded:
+            response = _json_response(payload, 503)
+            response.headers["Retry-After"] = str(
+                max(s["retry_after_s"] for s in overloaded)
+            )
+            return response
+        return _json_response(payload)
+
     def view_fleet_prediction(
         self, ctx, request, gordo_project: str
     ) -> Response:
@@ -785,11 +937,13 @@ class GordoApp:
         predict_start = timeit.default_timer()
         try:
             if scorer is not None and inputs:
-                outputs.update(scorer.predict(inputs))
+                outputs.update(self._fleet_predict(ctx, names, scorer, inputs))
             for name, model in fallback.items():
                 outputs[name] = model_io.get_model_output(
                     model=model, X=frames[name]
                 )
+        except (batching.BatchQueueFull, faults.InjectedFault):
+            raise  # structured 503s, not input errors
         except ValueError as err:
             return _json_response({"error": f"ValueError: {err}"}, 400)
         except Exception:
@@ -800,7 +954,7 @@ class GordoApp:
                 {"error": "Something unexpected happened; check your input data"},
                 400,
             )
-        ctx.record_phase("predict", timeit.default_timer() - predict_start)
+        self._record_predict_phase(ctx, timeit.default_timer() - predict_start)
 
         data = {}
         for name in names:
@@ -952,7 +1106,7 @@ class GordoApp:
         predict_start = timeit.default_timer()
         try:
             if scorer is not None and inputs:
-                outputs.update(scorer.predict(inputs))
+                outputs.update(self._fleet_predict(ctx, names, scorer, inputs))
             for name in names:
                 frequency = pd.tseries.frequencies.to_offset(
                     normalize_frequency(
@@ -969,6 +1123,8 @@ class GordoApp:
                     frames[name], targets[name], frequency=frequency, **kwargs
                 )
                 data[name] = server_utils.dataframe_to_dict(frame)
+        except (batching.BatchQueueFull, faults.InjectedFault):
+            raise  # structured 503s, not input errors
         except ValueError as err:
             return _json_response({"error": f"ValueError: {err}"}, 400)
         except Exception:
@@ -979,7 +1135,7 @@ class GordoApp:
                 {"error": "Something unexpected happened; check your input data"},
                 400,
             )
-        ctx.record_phase("predict", timeit.default_timer() - predict_start)
+        self._record_predict_phase(ctx, timeit.default_timer() - predict_start)
         context = {
             "data": data,
             "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
@@ -1063,6 +1219,14 @@ def build_app(
     config = dict(config or {})
     if "ENABLE_PROMETHEUS" not in config:
         config["ENABLE_PROMETHEUS"] = _env_bool("ENABLE_PROMETHEUS", False)
+    if "BATCH_WAIT_MS" not in config:
+        config["BATCH_WAIT_MS"] = float(
+            os.environ.get("GORDO_BATCH_WAIT_MS") or 0.0
+        )
+    if "BATCH_QUEUE_LIMIT" not in config:
+        config["BATCH_QUEUE_LIMIT"] = int(
+            os.environ.get("GORDO_BATCH_QUEUE_LIMIT") or 64
+        )
     if prometheus_registry is not None:
         if config.get("ENABLE_PROMETHEUS"):
             config["PROMETHEUS_REGISTRY"] = prometheus_registry

@@ -1,0 +1,401 @@
+"""
+Cross-request dynamic batching for the model server (docs/serving.md,
+"Dynamic batching").
+
+The serving path used to be synchronous: every POST ran its own device
+dispatch, so under the pre-fork runner concurrency came only from
+handler threads contending for one device context. Here a
+:class:`RequestBatcher` sits between WSGI and the device, one per
+(collection, machine-set) fleet-scorer key: handler threads enqueue
+their request's inputs plus a future and block on the future, while a
+single drainer thread coalesces every compatible waiting request into
+ONE stacked ``FleetScorer.predict_requests`` dispatch along the
+existing leading machine axis and scatters the per-request outputs
+back through the futures — the per-workload goodput optimization of
+"ML Productivity Goodput" (PAPERS.md, arXiv:2502.06982) applied to
+serving.
+
+Batch formation is event-driven (no fixed ticks: an arrival wakes the
+drainer immediately, an idle batcher burns nothing) and governed by a
+latency-SLO cap: a batch dispatches when it is full (``queue_limit``
+requests) or when the oldest waiter's age reaches ``wait_s`` —
+whichever comes first. A loaded server therefore converges to full
+batches while a lone request never waits past the cap.
+
+On top sits admission control: a submit that would push the queue past
+``queue_limit`` is shed immediately with :class:`BatchQueueFull`
+(surfaced as a structured 503 + ``Retry-After``; the client's
+seeded-jitter backoff honors the header) — shedding at the door beats
+melting the queue into multi-second waits for everyone.
+
+Fault domains (docs/robustness.md): a batch is NOT a blast radius. The
+drainer runs the per-request ``batch`` chaos seam before coalescing,
+and when a coalesced dispatch raises it falls back to re-dispatching
+each member request alone — only the genuinely failing requests'
+futures carry errors; the rest still serve.
+"""
+
+import collections
+import logging
+import math
+import threading
+import time
+import typing
+
+from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.robustness import faults
+
+logger = logging.getLogger(__name__)
+
+#: /healthz reports ``shedding`` for this many multiples of the current
+#: Retry-After after a shed: a replica that just turned clients away
+#: should read not-ready until the window it advertised has passed.
+SHED_READINESS_WINDOW = 1.0
+
+
+class BatcherStopped(Exception):
+    """
+    Internal: this batcher was stopped (its scorer was rebuilt or the
+    LRU evicted it) between the caller's lookup and its ``submit`` —
+    the caller fetches a live batcher for the key and retries, instead
+    of enqueueing onto a queue whose drainer already exited.
+    """
+
+
+class BatchQueueFull(Exception):
+    """
+    Admission control shed: the batcher's bounded queue is at
+    ``queue_limit``, so accepting this request would only grow queue
+    wait past the SLO cap. The server maps it to a structured 503 with
+    ``Retry-After: retry_after_s`` (docs/serving.md).
+    """
+
+    def __init__(self, retry_after_s: int, queue_depth: int, queue_limit: int):
+        super().__init__(
+            f"Batching queue full ({queue_depth}/{queue_limit} waiting); "
+            f"retry after {retry_after_s}s"
+        )
+        self.retry_after_s = retry_after_s
+        self.queue_depth = queue_depth
+        self.queue_limit = queue_limit
+
+
+class _Pending:
+    """One enqueued request: the future the handler thread blocks on."""
+
+    __slots__ = (
+        "inputs",
+        "event",
+        "outputs",
+        "error",
+        "enqueued_perf",
+        "queue_wait_s",
+        "n_coalesced",
+        "trace_id",
+        "batch_trace_id",
+        "batch_span_id",
+    )
+
+    def __init__(self, inputs: typing.Dict[str, typing.Any], trace_id: str = ""):
+        self.inputs = inputs
+        self.event = threading.Event()
+        self.outputs: typing.Optional[typing.Dict[str, typing.Any]] = None
+        self.error: typing.Optional[BaseException] = None
+        self.enqueued_perf = time.perf_counter()
+        self.queue_wait_s = 0.0
+        self.n_coalesced = 1
+        #: the request's own trace id (the server.request span's) — the
+        #: fan-in link recorded on the batch span
+        self.trace_id = trace_id
+        self.batch_trace_id = ""
+        self.batch_span_id = ""
+
+
+#: gordo_serve_batch_queue_depth is ONE process-wide gauge but several
+#: batchers may be live (one per fleet-scorer key): each tracks its own
+#: queue with this shared counter so the gauge reads the SUM, not the
+#: last writer's queue
+_depth_lock = threading.Lock()
+_depth_total = 0
+
+
+def _adjust_depth(delta: int) -> None:
+    global _depth_total
+    with _depth_lock:
+        _depth_total += delta
+        total = _depth_total
+    _metrics()["depth"].set(total)
+
+
+def _metrics():
+    """The batching series of the process registry (idempotent)."""
+    reg = get_registry()
+    return {
+        "depth": reg.gauge(
+            "gordo_serve_batch_queue_depth",
+            "Requests waiting in the dynamic-batching queue",
+        ),
+        "requests": reg.histogram(
+            "gordo_serve_batch_requests",
+            "Requests coalesced per stacked dispatch",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256),
+        ),
+        "queue_wait": reg.histogram(
+            "gordo_serve_batch_queue_wait_seconds",
+            "Enqueue to dispatch-start wait per batched request",
+        ),
+        "dispatch": reg.histogram(
+            "gordo_serve_batch_dispatch_seconds",
+            "One coalesced batch dispatch (device + scatter)",
+        ),
+        "shed": reg.counter(
+            "gordo_serve_batch_shed_total",
+            "Requests shed by batching admission control (503 + Retry-After)",
+        ),
+        "fallback": reg.counter(
+            "gordo_serve_batch_fallbacks_total",
+            "Coalesced dispatches that failed and were re-run per request "
+            "(fault isolation, no poisoned batch)",
+        ),
+    }
+
+
+class RequestBatcher:
+    """
+    One bounded queue + drainer per (collection, machine-set) scorer.
+
+    ``scorer`` must expose ``predict_requests(list_of_inputs)`` (the
+    coalescing entry point of ``FleetScorer``). ``wait_s`` is the
+    latency-SLO cap on batch formation; ``queue_limit`` is both the
+    batch capacity and the admission-control bound.
+    """
+
+    def __init__(self, scorer, wait_s: float, queue_limit: int):
+        self.scorer = scorer
+        self.wait_s = max(0.0, float(wait_s))
+        self.queue_limit = max(1, int(queue_limit))
+        self._pending: typing.Deque[_Pending] = collections.deque()
+        self._lock = threading.Lock()
+        self._arrived = threading.Condition(self._lock)
+        self._stopped = False
+        self._sheds_total = 0
+        self._last_shed_monotonic: typing.Optional[float] = None
+        self._dispatches_total = 0
+        self._requests_total = 0
+        #: EMA of dispatch wall time — the Retry-After estimate's input
+        self._ema_dispatch_s = 0.0
+        self._drainer = threading.Thread(
+            target=self._drain_loop, daemon=True, name="gordo-batch-drainer"
+        )
+        self._drainer.start()
+
+    # -- handler side ------------------------------------------------------
+
+    def submit(
+        self, inputs: typing.Dict[str, typing.Any], trace_id: str = ""
+    ) -> _Pending:
+        """
+        Enqueue one request's (already parsed + host-transformed) inputs
+        and block until the drainer dispatched it. Returns the completed
+        :class:`_Pending` (``outputs``, ``queue_wait_s``, batch fan-in
+        ids) or raises the dispatch's per-request error.
+
+        Raises :class:`BatchQueueFull` without enqueueing when the queue
+        is at ``queue_limit`` — the admission-control shed.
+        """
+        metrics = _metrics()
+        shed = None
+        with self._lock:
+            if self._stopped:
+                raise BatcherStopped(
+                    "Batcher stopped (scorer rebuilt or evicted); retry "
+                    "on a live batcher"
+                )
+            if len(self._pending) >= self.queue_limit:
+                self._sheds_total += 1
+                self._last_shed_monotonic = time.monotonic()
+                shed = (self.retry_after_s(), len(self._pending))
+            else:
+                pending = _Pending(inputs, trace_id=trace_id)
+                self._pending.append(pending)
+                _adjust_depth(1)
+                self._arrived.notify_all()
+        if shed is not None:
+            # metric + event I/O OUTSIDE the lock: a shed storm is
+            # exactly when the drainer and accepting submits must not
+            # queue behind this thread's event-log write
+            retry_after, depth = shed
+            metrics["shed"].inc()
+            emit_event(
+                "request_shed",
+                queue_depth=depth,
+                queue_limit=self.queue_limit,
+                retry_after_s=retry_after,
+            )
+            raise BatchQueueFull(retry_after, depth, self.queue_limit)
+        # the drainer never abandons a popped batch (every exit path sets
+        # the futures), so this only spins if the drainer thread itself
+        # died — then failing loudly beats a hung handler
+        while not pending.event.wait(timeout=60.0):
+            if not self._drainer.is_alive():
+                raise RuntimeError("Batching drainer thread died")
+        if pending.error is not None:
+            raise pending.error
+        return pending
+
+    # -- drainer side ------------------------------------------------------
+
+    def _drain_loop(self) -> None:
+        while True:
+            with self._arrived:
+                while not self._pending and not self._stopped:
+                    self._arrived.wait()
+                if self._stopped and not self._pending:
+                    return
+                # batch formation under the SLO cap: dispatch when full,
+                # or when the oldest waiter's age reaches the cap —
+                # whichever first. Event-driven: arrivals notify, so the
+                # only timed wait is the remaining slice of the cap.
+                while len(self._pending) < self.queue_limit and not self._stopped:
+                    oldest_age = time.perf_counter() - self._pending[0].enqueued_perf
+                    remaining = self.wait_s - oldest_age
+                    if remaining <= 0:
+                        break
+                    self._arrived.wait(timeout=remaining)
+                batch = list(self._pending)
+                self._pending.clear()
+            _adjust_depth(-len(batch))
+            self._dispatch(batch)
+
+    def _dispatch(self, batch: typing.List[_Pending]) -> None:
+        metrics = _metrics()
+        dispatch_start = time.perf_counter()
+        for pending in batch:
+            pending.queue_wait_s = dispatch_start - pending.enqueued_perf
+            pending.n_coalesced = len(batch)
+            metrics["queue_wait"].observe(pending.queue_wait_s)
+        metrics["requests"].observe(len(batch))
+        # fan-in tracing: ONE server.batch span for the coalesced
+        # dispatch, linked to every member request's trace by attribute
+        # (a span has one parent; N requests' traces reference it via
+        # the batch ids stamped back onto their server.request spans)
+        with tracing.start_span(
+            "server.batch",
+            parent=None,
+            n_requests=len(batch),
+            n_machines=sum(len(p.inputs) for p in batch),
+        ) as span:
+            if span.recording:
+                span.set_attribute(
+                    "request_trace_ids",
+                    sorted({p.trace_id for p in batch if p.trace_id}),
+                )
+                for pending in batch:
+                    pending.batch_trace_id = span.trace_id
+                    pending.batch_span_id = span.span_id
+            try:
+                self._dispatch_batch(batch, metrics)
+            except BaseException as exc:  # noqa: BLE001 - future, not thread
+                # a failure of the machinery itself (not of one member
+                # dispatch) still must not strand the handler threads
+                span.set_status("error")
+                for pending in batch:
+                    if pending.error is None and pending.outputs is None:
+                        pending.error = exc
+            finally:
+                elapsed = time.perf_counter() - dispatch_start
+                metrics["dispatch"].observe(elapsed)
+                with self._lock:
+                    self._dispatches_total += 1
+                    self._requests_total += len(batch)
+                    self._ema_dispatch_s = (
+                        elapsed
+                        if self._ema_dispatch_s == 0.0
+                        else 0.8 * self._ema_dispatch_s + 0.2 * elapsed
+                    )
+                for pending in batch:
+                    pending.event.set()
+
+    def _dispatch_batch(
+        self, batch: typing.List[_Pending], metrics: typing.Dict[str, typing.Any]
+    ) -> None:
+        # per-request chaos seam (``batch:raise:<machine>`` in
+        # GORDO_FAULT_INJECT): a fault targeted at one request's machine
+        # fails that future alone, before the coalesced dispatch forms
+        live: typing.List[_Pending] = []
+        for pending in batch:
+            try:
+                for name in pending.inputs:
+                    faults.inject("batch", name)
+                live.append(pending)
+            except BaseException as exc:  # noqa: BLE001 - routed to future
+                pending.error = exc
+        if not live:
+            return
+        try:
+            results = self.scorer.predict_requests([p.inputs for p in live])
+        except BaseException:  # noqa: BLE001 - isolate, don't poison
+            # no poisoned batch: one bad request (short windowed input,
+            # a mid-batch fault) must not fail its batch-mates. Re-run
+            # each member alone; only the culprits keep their errors.
+            metrics["fallback"].inc()
+            results = []
+            for pending in live:
+                try:
+                    results.append(self.scorer.predict_requests([pending.inputs])[0])
+                except BaseException as exc:  # noqa: BLE001 - routed to future
+                    pending.error = exc
+                    results.append(None)
+        for pending, outputs in zip(live, results):
+            if pending.error is None:
+                pending.outputs = outputs
+
+    # -- introspection / lifecycle -----------------------------------------
+
+    @property
+    def stopped(self) -> bool:
+        return self._stopped
+
+    def retry_after_s(self) -> int:
+        """
+        The ``Retry-After`` hint on sheds: about two dispatch EMAs —
+        long enough for the queue to turn over, whole seconds per RFC
+        9110, never less than 1.
+        """
+        return max(1, int(math.ceil(2.0 * self._ema_dispatch_s)))
+
+    def stats(self) -> dict:
+        """The /healthz readiness view of this batcher."""
+        with self._lock:
+            depth = len(self._pending)
+            sheds = self._sheds_total
+            last_shed = self._last_shed_monotonic
+            dispatches = self._dispatches_total
+            requests = self._requests_total
+        retry_after = self.retry_after_s()
+        shedding = (
+            last_shed is not None
+            and time.monotonic() - last_shed < SHED_READINESS_WINDOW * retry_after
+        )
+        return {
+            "queue_depth": depth,
+            "queue_limit": self.queue_limit,
+            "saturated": depth >= self.queue_limit,
+            "sheds_total": sheds,
+            "shedding": shedding,
+            "dispatches_total": dispatches,
+            "requests_total": requests,
+            "mean_batch_size": (
+                round(requests / dispatches, 3) if dispatches else None
+            ),
+            "retry_after_s": retry_after,
+        }
+
+    def stop(self, join: bool = False) -> None:
+        """Stop the drainer once the queue empties (evicted batchers
+        must not leak threads); pending requests still complete."""
+        with self._arrived:
+            self._stopped = True
+            self._arrived.notify_all()
+        if join:
+            self._drainer.join(timeout=30.0)

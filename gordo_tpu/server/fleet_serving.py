@@ -16,7 +16,7 @@ model forward, where the FLOPs are.
 
 import logging
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -26,6 +26,13 @@ from gordo_tpu.models.core import BaseJaxEstimator, _batch_bucket
 from gordo_tpu.observability import get_registry
 
 logger = logging.getLogger(__name__)
+
+#: floor on the per-dispatch machine-axis chunk for coalesced requests
+#: (predict_requests): small groups still coalesce up to this many
+#: entries per dispatch (64 rows of a small model's params are cheap),
+#: while large groups chunk at their own resident-stack size — either
+#: way the gathered-param copy stays O(group), not O(batch)
+_MIN_DISPATCH_ENTRIES = 64
 
 
 def _pow2_bucket(n: int, cap: Optional[int] = None) -> int:
@@ -116,64 +123,103 @@ class FleetScorer:
         (n_rows, n_features); rows may differ per machine — machines are
         zero-padded to the power-of-two bucket above the group's max (so
         jit sees bounded shapes) and sliced back.
+
+        Delegates to :meth:`predict_requests` with a one-request batch:
+        the solo and coalesced (dynamic-batching) paths are ONE code
+        path, so batched vs. unbatched serving cannot drift.
         """
-        missing = set(inputs) - set(self.names)
-        if missing:
-            raise KeyError(f"No stacked params for machines: {sorted(missing)}")
-        out: Dict[str, np.ndarray] = {}
+        return self.predict_requests([inputs])[0]
+
+    def predict_requests(
+        self, requests: Sequence[Dict[str, np.ndarray]]
+    ) -> List[Dict[str, np.ndarray]]:
+        """
+        Coalesced scoring of several requests' inputs — the server's
+        dynamic-batching entry point (``server/batching.py``): all
+        requests' (machine, X) entries stack on the SAME leading machine
+        axis a solo request uses, ONE dispatch per architecture group. A
+        machine named by k requests occupies k rows (its params gathered
+        with repeats — XLA's per-row results are batch-shape-invariant,
+        pinned by test). Returns one ``{name: output}`` dict per request,
+        in request order.
+        """
+        known = set(self.names)
+        for inputs in requests:
+            missing = set(inputs) - known
+            if missing:
+                raise KeyError(
+                    f"No stacked params for machines: {sorted(missing)}"
+                )
+        out: List[Dict[str, np.ndarray]] = [{} for _ in requests]
         reg = get_registry()
         for group in self._groups:
-            names = [n for n in group["names"] if n in inputs]
-            if not names:
+            # per request, entries follow group order — the same order
+            # the solo path has always dispatched in
+            entries = [
+                (ridx, name, inputs[name])
+                for ridx, inputs in enumerate(requests)
+                for name in group["names"]
+                if name in inputs
+            ]
+            if not entries:
                 continue
-            start = time.perf_counter()
-            out.update(self._predict_group(group, {n: inputs[n] for n in names}))
-            elapsed = time.perf_counter() - start
             windowed = "true" if group["windowed"] else "false"
-            reg.histogram(
-                "gordo_serve_group_latency_seconds",
-                "One vmapped fleet-scoring dispatch (host->device->host)",
-                ("windowed",),
-            ).observe(elapsed, windowed=windowed)
-            reg.histogram(
-                "gordo_serve_group_batch_size",
-                "Machines scored per fleet dispatch",
-                ("windowed",),
-                buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
-            ).observe(len(names), windowed=windowed)
-            reg.counter(
-                "gordo_serve_machines_scored_total",
-                "Machines scored through the fleet path",
-                ("windowed",),
-            ).inc(len(names), windowed=windowed)
+            # bound the machine axis per dispatch: duplicate-machine
+            # entries (the normal coalesced case) take the param-GATHER
+            # path below, so device memory per dispatch scales with the
+            # entry count — chunking at ~the resident stack's own size
+            # keeps that at O(group), never O(batch). Solo requests
+            # (entries <= group size) are always one chunk.
+            chunk = max(_MIN_DISPATCH_ENTRIES, len(group["names"]))
+            for cstart in range(0, len(entries), chunk):
+                sub = entries[cstart : cstart + chunk]
+                start = time.perf_counter()
+                results = self._predict_entries(group, sub)
+                elapsed = time.perf_counter() - start
+                for (ridx, name, _), value in zip(sub, results):
+                    out[ridx][name] = value
+                reg.histogram(
+                    "gordo_serve_group_latency_seconds",
+                    "One vmapped fleet-scoring dispatch (host->device->host)",
+                    ("windowed",),
+                ).observe(elapsed, windowed=windowed)
+                reg.histogram(
+                    "gordo_serve_group_batch_size",
+                    "Machines scored per fleet dispatch",
+                    ("windowed",),
+                    buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+                ).observe(len(sub), windowed=windowed)
+                reg.counter(
+                    "gordo_serve_machines_scored_total",
+                    "Machines scored through the fleet path",
+                    ("windowed",),
+                ).inc(len(sub), windowed=windowed)
         return out
 
-    def _predict_group(
-        self, group: dict, inputs: Dict[str, np.ndarray]
-    ) -> Dict[str, np.ndarray]:
-        names = list(inputs)
+    def _predict_entries(
+        self, group: dict, entries: List[Tuple[int, str, np.ndarray]]
+    ) -> List[np.ndarray]:
+        """One stacked dispatch for ``entries`` = [(request_idx, name,
+        X), ...] of one group; returns outputs aligned with entries."""
+        names = [name for _, name, _ in entries]
         lb, la = group["lookback"], group["lookahead"]
-        prepared = {
-            name: np.asarray(X, dtype=np.float32) for name, X in inputs.items()
-        }
-        max_len = max(len(x) for x in prepared.values())
+        prepared = [np.asarray(X, dtype=np.float32) for _, _, X in entries]
+        max_len = max(len(x) for x in prepared)
         if group["windowed"]:
             # raw rows go to the device; the compiled program gathers the
             # windows there. n_rows tracks each machine's OUTPUT length —
             # and a machine that cannot fill ONE window is the same error
             # the per-model path raises (ops.windowing), not a silent
             # empty frame
-            for name, x in prepared.items():
+            for name, x in zip(names, prepared):
                 if len(x) - lb + 1 - la <= 0:
                     raise ValueError(
                         f"Not enough timesteps ({len(x)}) for machine "
                         f"{name!r}: lookback_window={lb}, lookahead={la}"
                     )
-            n_rows = {
-                name: len(x) - lb + 1 - la for name, x in prepared.items()
-            }
+            n_rows = [len(x) - lb + 1 - la for x in prepared]
         else:
-            n_rows = {name: len(x) for name, x in prepared.items()}
+            n_rows = [len(x) for x in prepared]
         # bucket BOTH varying axes so jit sees a bounded set of shapes:
         # rows to the next power of two (<=2x padded compute beats a
         # per-request XLA compile), machines likewise capped at group size
@@ -181,37 +227,75 @@ class FleetScorer:
         batch = np.stack(
             [
                 np.pad(x, [(0, max_rows - len(x))] + [(0, 0)] * (x.ndim - 1))
-                for x in prepared.values()
+                for x in prepared
             ]
         )
 
         group_size = len(group["names"])
-        m_bucket = min(_pow2_bucket(len(names)), group_size)
-        if names == group["names"] or m_bucket == group_size:
-            # full group, or a subset whose bucket rounds up to it: scatter
-            # inputs into group positions (zeros for absent machines) and
-            # reuse the resident stack — no param leaves are copied
-            params = group["params"]
-            row_index = {n: i for i, n in enumerate(group["names"])}
-            full = np.zeros((group_size,) + batch.shape[1:], dtype=batch.dtype)
-            for i, name in enumerate(names):
-                full[row_index[name]] = batch[i]
-            outputs = np.asarray(group["apply"](params, jnp.asarray(full)))
-            return {
-                name: outputs[row_index[name], : n_rows[name]] for name in names
-            }
-        # small subset: gather just those machines' params, padded with
-        # dummy repeats to the machine bucket (sliced off below)
+        if len(set(names)) == len(names) and group_size >= 2:
+            # floor of 2 (see the gather comment below); group_size >= 2
+            # keeps the cap from undoing it
+            m_bucket = min(max(2, _pow2_bucket(len(names))), group_size)
+            if names == group["names"] or m_bucket == group_size:
+                # full group, or a subset whose bucket rounds up to it:
+                # scatter inputs into group positions (zeros for absent
+                # machines) and reuse the resident stack — no param
+                # leaves are copied
+                params = group["params"]
+                row_index = {n: i for i, n in enumerate(group["names"])}
+                full = np.zeros(
+                    (group_size,) + batch.shape[1:], dtype=batch.dtype
+                )
+                for i, name in enumerate(names):
+                    full[row_index[name]] = batch[i]
+                outputs = np.asarray(group["apply"](params, jnp.asarray(full)))
+                return [
+                    outputs[row_index[name], : n_rows[i]]
+                    for i, name in enumerate(names)
+                ]
+        else:
+            # coalesced requests may name one machine several times: the
+            # machine axis holds one row per ENTRY, so the bucket is not
+            # capped at the group size. Floor of 2: XLA compiles a
+            # machine-axis-1 program with last-ulp-different results
+            # than the >=2 shape family (batch-1 special case), so
+            # EVERY gather dispatch — a solo single-machine request
+            # included — pads to >=2 to keep batched == unbatched
+            # bit-identical (pinned by tests/test_batching.py)
+            m_bucket = max(2, _pow2_bucket(len(names)))
+        # subset (or duplicated-entry) dispatch: gather those machines'
+        # params, padded with dummy repeats to the machine bucket
+        # (sliced off below)
         sel = [group["names"].index(n) for n in names]
         sel += [sel[0]] * (m_bucket - len(sel))
-        sel = np.asarray(sel, dtype=np.int32)
-        params = jax.tree_util.tree_map(lambda leaf: leaf[sel], group["params"])
+        if len(set(sel)) == 1:
+            # single-machine groups land here on EVERY request (their
+            # resident stack is axis-1, outside the >=2 shape family):
+            # the repeated-row stack depends only on (bucket, machine),
+            # so cache it instead of re-copying params per request —
+            # the hot path stays zero-copy like the resident one
+            cache = group.setdefault("_repeat_params", {})
+            cache_key = (sel[0], m_bucket)
+            params = cache.get(cache_key)
+            if params is None:
+                while len(cache) >= 128:  # bound resident copies
+                    cache.pop(next(iter(cache)))
+                idx = np.asarray(sel, dtype=np.int32)
+                params = jax.tree_util.tree_map(
+                    lambda leaf: leaf[idx], group["params"]
+                )
+                cache[cache_key] = params
+        else:
+            sel = np.asarray(sel, dtype=np.int32)
+            params = jax.tree_util.tree_map(
+                lambda leaf: leaf[sel], group["params"]
+            )
         if len(batch) < m_bucket:
             batch = np.pad(
                 batch, [(0, m_bucket - len(batch))] + [(0, 0)] * (batch.ndim - 1)
             )
         outputs = np.asarray(group["apply"](params, jnp.asarray(batch)))
-        return {name: outputs[i, : n_rows[name]] for i, name in enumerate(names)}
+        return [outputs[i, : n_rows[i]] for i in range(len(names))]
 
 
 def fleet_scorer_from_models(models: Dict[str, Any]) -> Tuple[

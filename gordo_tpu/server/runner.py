@@ -18,6 +18,13 @@ One worker (the default for TPU serving) short-circuits the fork and
 serves in-process: a single process keeps a single device context hot —
 scale-out on TPU is by replica, not by local workers, since the chip is
 exclusive to one process.
+
+Interplay with dynamic batching (docs/serving.md#dynamic-batching):
+batching is per-process — each worker owns its own request queues and
+drainer. Handler threads BLOCK on their batch futures, so ``threads``
+must stay comfortably above the batching ``--queue-limit``; a too-small
+thread gate serializes requests before they can ever coalesce, capping
+the achievable batch size at the gate width.
 """
 
 import logging

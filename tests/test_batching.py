@@ -1,0 +1,735 @@
+"""
+Dynamic batching (docs/serving.md#dynamic-batching): the RequestBatcher
+must coalesce concurrent fleet requests into one stacked dispatch with
+bit-identical outputs, shed with 503 + Retry-After under admission
+control, keep the disabled path a strict pass-through, and keep the
+machine — not the batch — as the fault domain.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from gordo_tpu.robustness import faults
+from gordo_tpu.server import batching
+from gordo_tpu.server.batching import BatchQueueFull, RequestBatcher
+from tests.conftest import GORDO_BASE_TARGETS, GORDO_PROJECT, GORDO_SINGLE_TARGET
+
+FLEET_URL = f"/gordo/v0/{GORDO_PROJECT}/prediction/fleet"
+
+
+class StubScorer:
+    """predict_requests-shaped stand-in recording every dispatch."""
+
+    def __init__(self, block=None, fail_names=()):
+        self.calls = []
+        self.block = block
+        self.fail_names = set(fail_names)
+        self._lock = threading.Lock()
+
+    def predict_requests(self, requests):
+        with self._lock:
+            self.calls.append([dict(r) for r in requests])
+        if self.block is not None:
+            self.block.wait()
+        for inputs in requests:
+            bad = self.fail_names & set(inputs)
+            if bad:
+                raise ValueError(f"failing machines: {sorted(bad)}")
+        return [
+            {name: np.asarray(x) * 2.0 for name, x in inputs.items()}
+            for inputs in requests
+        ]
+
+
+def _submit_all(batcher, payloads):
+    """Submit each payload from its own thread; returns (results, errors)
+    aligned with payloads."""
+    results = [None] * len(payloads)
+    errors = [None] * len(payloads)
+
+    def run(i):
+        try:
+            results[i] = batcher.submit(payloads[i])
+        except BaseException as exc:  # noqa: BLE001 - recorded for asserts
+            errors[i] = exc
+
+    threads = [
+        threading.Thread(target=run, args=(i,)) for i in range(len(payloads))
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return results, errors
+
+
+# -- RequestBatcher unit behavior ------------------------------------------
+
+
+def test_concurrent_submissions_coalesce_into_one_dispatch():
+    scorer = StubScorer()
+    batcher = RequestBatcher(scorer, wait_s=5.0, queue_limit=2)
+    try:
+        a = {"m0": np.ones((4, 3), dtype=np.float32)}
+        b = {"m1": np.full((4, 3), 3.0, dtype=np.float32)}
+        results, errors = _submit_all(batcher, [a, b])
+        assert errors == [None, None]
+        # batch-full (queue_limit) fired before the 5s cap: ONE dispatch
+        assert len(scorer.calls) == 1
+        assert len(scorer.calls[0]) == 2
+        np.testing.assert_array_equal(results[0].outputs["m0"], a["m0"] * 2)
+        np.testing.assert_array_equal(results[1].outputs["m1"], b["m1"] * 2)
+        assert results[0].n_coalesced == 2
+        assert results[0].queue_wait_s >= 0.0
+        stats = batcher.stats()
+        assert stats["dispatches_total"] == 1
+        assert stats["requests_total"] == 2
+        assert stats["mean_batch_size"] == 2.0
+    finally:
+        batcher.stop(join=True)
+
+
+def test_lone_request_dispatches_at_the_slo_cap():
+    scorer = StubScorer()
+    batcher = RequestBatcher(scorer, wait_s=0.05, queue_limit=8)
+    try:
+        start = time.perf_counter()
+        pending = batcher.submit({"m0": np.ones((2, 2), dtype=np.float32)})
+        elapsed = time.perf_counter() - start
+        assert scorer.calls == [[pending.inputs]]
+        # waited for batch-mates up to the cap, not forever
+        assert 0.04 <= elapsed < 2.0
+        assert pending.n_coalesced == 1
+    finally:
+        batcher.stop(join=True)
+
+
+def test_admission_control_sheds_past_queue_limit():
+    gate = threading.Event()
+    scorer = StubScorer(block=gate)
+    # wait long enough that the first batch only dispatches when full
+    batcher = RequestBatcher(scorer, wait_s=10.0, queue_limit=2)
+    try:
+        payloads = [
+            {f"m{i}": np.ones((2, 2), dtype=np.float32)} for i in range(4)
+        ]
+        results = {}
+        threads = []
+
+        def run(i):
+            try:
+                results[i] = batcher.submit(payloads[i])
+            except BaseException as exc:  # noqa: BLE001
+                results[i] = exc
+
+        # first two fill a batch and dispatch (blocked on the gate)...
+        for i in (0, 1):
+            threads.append(threading.Thread(target=run, args=(i,)))
+            threads[-1].start()
+        deadline = time.monotonic() + 5
+        while len(scorer.calls) < 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert len(scorer.calls) == 1
+        # ...the next two refill the queue to its limit...
+        for i in (2, 3):
+            threads.append(threading.Thread(target=run, args=(i,)))
+            threads[-1].start()
+        deadline = time.monotonic() + 5
+        while batcher.stats()["queue_depth"] < 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert batcher.stats()["queue_depth"] == 2
+        assert batcher.stats()["saturated"]
+        # ...and a fifth is shed at the door with a Retry-After hint
+        with pytest.raises(BatchQueueFull) as excinfo:
+            batcher.submit({"m9": np.ones((2, 2), dtype=np.float32)})
+        assert excinfo.value.retry_after_s >= 1
+        assert excinfo.value.queue_depth == 2
+        stats = batcher.stats()
+        assert stats["sheds_total"] == 1
+        assert stats["shedding"]  # /healthz drain signal window
+        gate.set()
+        for t in threads:
+            t.join()
+        assert all(not isinstance(r, BaseException) for r in results.values())
+    finally:
+        gate.set()
+        batcher.stop(join=True)
+
+
+def test_queue_depth_gauge_sums_across_batchers():
+    """gordo_serve_batch_queue_depth is one process-wide gauge: two live
+    batchers' queues must SUM, not clobber each other last-writer-wins
+    (one idle batcher dispatching must not zero out a melting peer's
+    depth)."""
+
+    def depth_value():
+        [series] = batching._metrics()["depth"].snapshot()["series"]
+        return series["value"]
+
+    gate_a, gate_b = threading.Event(), threading.Event()
+    batcher_a = RequestBatcher(StubScorer(block=gate_a), wait_s=10.0, queue_limit=2)
+    batcher_b = RequestBatcher(StubScorer(block=gate_b), wait_s=10.0, queue_limit=2)
+    threads = []
+    try:
+        baseline = depth_value()
+
+        def submit(batcher, name):
+            batcher.submit({name: np.ones((2, 2), dtype=np.float32)})
+
+        # one waiter in each queue (second slots stay open so neither
+        # dispatches): the gauge must read the sum of both
+        for batcher, name in ((batcher_a, "a0"), (batcher_b, "b0")):
+            t = threading.Thread(target=submit, args=(batcher, name))
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + 5
+        while depth_value() < baseline + 2 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert depth_value() == baseline + 2
+        # b's queue fills and dispatches (blocked on its gate): its
+        # decrement must leave a's waiter counted, not reset to 0
+        t = threading.Thread(target=submit, args=(batcher_b, "b1"))
+        t.start()
+        threads.append(t)
+        deadline = time.monotonic() + 5
+        while depth_value() != baseline + 1 and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert depth_value() == baseline + 1
+    finally:
+        gate_a.set()
+        gate_b.set()
+        for t in threads:
+            t.join()
+        batcher_a.stop(join=True)
+        batcher_b.stop(join=True)
+
+
+def test_submit_after_stop_raises_batcher_stopped():
+    """A stopped batcher (scorer rebuilt / LRU evicted) refuses new
+    work instead of enqueueing onto a dead drainer: the server retries
+    on the key's live batcher."""
+    batcher = RequestBatcher(StubScorer(), wait_s=5.0, queue_limit=2)
+    batcher.stop(join=True)
+    assert batcher.stopped
+    with pytest.raises(batching.BatcherStopped):
+        batcher.submit({"m0": np.ones((2, 2), dtype=np.float32)})
+
+
+def test_server_recovers_from_stopped_batcher(batching_app, sensor_frame):
+    """The lookup-vs-stop race: a request that drew a stopped batcher
+    re-fetches and lands on a fresh one — 200, not a hang or 400."""
+    from werkzeug.test import Client as WerkzeugClient
+
+    stopped = _warm_batcher(batching_app, sensor_frame, [GORDO_SINGLE_TARGET])
+    stopped.stop(join=True)
+    resp = WerkzeugClient(batching_app).post(
+        FLEET_URL, json=_fleet_body(sensor_frame, [GORDO_SINGLE_TARGET])
+    )
+    assert resp.status_code == 200, resp.get_data()
+    [live] = list(batching_app._batchers.values())
+    assert live is not stopped and not live.stopped
+
+
+def test_mid_batch_failure_poisons_only_the_culprit():
+    """A coalesced dispatch that raises falls back to per-request
+    dispatches: the bad request fails, its batch-mates still serve."""
+    scorer = StubScorer(fail_names=("bad",))
+    batcher = RequestBatcher(scorer, wait_s=5.0, queue_limit=2)
+    try:
+        good = {"m0": np.ones((2, 2), dtype=np.float32)}
+        bad = {"bad": np.ones((2, 2), dtype=np.float32)}
+        results, errors = _submit_all(batcher, [good, bad])
+        assert errors[0] is None
+        np.testing.assert_array_equal(results[0].outputs["m0"], good["m0"] * 2)
+        assert isinstance(errors[1], ValueError)
+        # one coalesced try + one per-request retry each
+        assert len(scorer.calls) == 3
+    finally:
+        batcher.stop(join=True)
+
+
+# -- FleetScorer coalescing: bit-identity ----------------------------------
+
+
+def _train_scorer(n_machines=3, rows=60, features=4):
+    from gordo_tpu.models import AutoEncoder
+    from gordo_tpu.server.fleet_serving import FleetScorer
+
+    rng = np.random.default_rng(5)
+    estimators = {}
+    for i in range(n_machines):
+        X = rng.random((rows, features)).astype("float32")
+        model = AutoEncoder(kind="feedforward_hourglass", epochs=1, seed=i)
+        model.fit(X, X.copy())
+        estimators[f"m{i}"] = model
+    return FleetScorer(estimators), rng
+
+
+def test_predict_requests_bitwise_matches_solo_predict():
+    """The coalescing entry point must return the SAME BITS a solo
+    dispatch returns — including when two requests name the same machine
+    (duplicate machine-axis rows) and when row counts differ (padding)."""
+    scorer, rng = _train_scorer()
+    req_a = {
+        "m0": rng.random((40, 4)).astype("float32"),
+        "m1": rng.random((40, 4)).astype("float32"),
+        "m2": rng.random((40, 4)).astype("float32"),
+    }
+    req_b = {
+        "m0": rng.random((17, 4)).astype("float32"),  # different row bucket
+        "m2": rng.random((40, 4)).astype("float32"),
+    }
+    solo_a = scorer.predict(req_a)
+    solo_b = scorer.predict(req_b)
+    coalesced = scorer.predict_requests([req_a, req_b])
+    assert set(coalesced[0]) == set(req_a)
+    assert set(coalesced[1]) == set(req_b)
+    for name in req_a:
+        np.testing.assert_array_equal(coalesced[0][name], solo_a[name])
+    for name in req_b:
+        np.testing.assert_array_equal(coalesced[1][name], solo_b[name])
+
+
+def test_predict_requests_chunks_oversized_batches_bit_identically(
+    monkeypatch,
+):
+    """Entries past the per-dispatch machine-axis bound run as
+    successive dispatches — same bits, bounded gathered-param copy."""
+    from gordo_tpu.server import fleet_serving
+
+    scorer, rng = _train_scorer(n_machines=1)
+    monkeypatch.setattr(fleet_serving, "_MIN_DISPATCH_ENTRIES", 2)
+    reqs = [{"m0": rng.random((20, 4)).astype("float32")} for _ in range(5)]
+    solo = [scorer.predict(r) for r in reqs]
+    coalesced = scorer.predict_requests(reqs)
+    for expect, got in zip(solo, coalesced):
+        np.testing.assert_array_equal(got["m0"], expect["m0"])
+
+
+def test_predict_requests_rejects_unknown_machine():
+    scorer, rng = _train_scorer(n_machines=1)
+    with pytest.raises(KeyError):
+        scorer.predict_requests(
+            [{"m0": np.zeros((4, 4), "float32")}, {"nope": np.zeros((4, 4), "float32")}]
+        )
+
+
+# -- through the server ----------------------------------------------------
+
+
+@pytest.fixture
+def batching_app(model_collection_env):
+    """The real app with batching ON (coalesce up to 2, shed past 2)."""
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    server_utils.clear_caches()
+    return build_app({"BATCH_WAIT_MS": 50.0, "BATCH_QUEUE_LIMIT": 2})
+
+
+def _fleet_body(sensor_frame, names, scale=1.0):
+    rows = (sensor_frame.values * scale).tolist()
+    return {"machines": {name: rows for name in names}}
+
+
+def _warm_batcher(app, sensor_frame, names, wait_s=2.0):
+    """One solo request so the scorer + batcher exist before concurrent
+    traffic (two racing FIRST requests may each build a scorer — both
+    valid, but they would land on different batcher generations and the
+    coalescing assertions below would flake); then widen the formation
+    cap so the next concurrent pair reliably shares a batch."""
+    from werkzeug.test import Client as WerkzeugClient
+
+    resp = WerkzeugClient(app).post(
+        FLEET_URL, json=_fleet_body(sensor_frame, names)
+    )
+    assert resp.status_code == 200, resp.get_data()
+    [batcher] = list(app._batchers.values())
+    batcher.wait_s = wait_s
+    return batcher
+
+
+def _concurrent_posts(app, bodies):
+    """POST each body from its own thread (one test client per thread —
+    werkzeug's Client is not thread-safe); returns responses by key."""
+    from werkzeug.test import Client as WerkzeugClient
+
+    responses = {}
+
+    def post(key, body):
+        responses[key] = WerkzeugClient(app).post(FLEET_URL, json=body)
+
+    threads = [
+        threading.Thread(target=post, args=(key, body))
+        for key, body in bodies.items()
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return responses
+
+
+def test_batching_disabled_is_strict_pass_through(
+    gordo_ml_server_client, sensor_frame, monkeypatch
+):
+    """BATCH_WAIT_MS=0 (default): no queue hop — constructing a batcher
+    at all is a test failure, like the fault-inject/tracing no-ops."""
+
+    def explode(*args, **kwargs):
+        raise AssertionError("RequestBatcher constructed on the disabled path")
+
+    monkeypatch.setattr(batching, "RequestBatcher", explode)
+    resp = gordo_ml_server_client.post(
+        FLEET_URL, json=_fleet_body(sensor_frame, [GORDO_SINGLE_TARGET])
+    )
+    assert resp.status_code == 200, resp.get_data()
+    assert "queue;dur=" not in resp.headers["Server-Timing"]
+
+
+def test_batched_responses_bit_identical_to_unbatched(
+    batching_app, sensor_frame
+):
+    """The acceptance gate: the same two concurrent fleet requests —
+    coalesced into ONE dispatch — must serve byte-for-byte the same
+    prediction data the unbatched server returns."""
+    from werkzeug.test import Client as WerkzeugClient
+
+    from gordo_tpu.server import build_app
+
+    names = [GORDO_SINGLE_TARGET, GORDO_BASE_TARGETS[0]]
+    body_a = _fleet_body(sensor_frame, names, scale=1.0)
+    body_b = _fleet_body(sensor_frame, names, scale=0.5)
+
+    plain = WerkzeugClient(build_app())
+    expect_a = json.loads(plain.post(FLEET_URL, json=body_a).get_data())
+    expect_b = json.loads(plain.post(FLEET_URL, json=body_b).get_data())
+
+    batcher = _warm_batcher(batching_app, sensor_frame, names)
+    base = batcher.stats()
+    responses = _concurrent_posts(batching_app, {"a": body_a, "b": body_b})
+    assert responses["a"].status_code == 200, responses["a"].get_data()
+    assert responses["b"].status_code == 200, responses["b"].get_data()
+    # the two requests really did share ONE dispatch
+    stats = batcher.stats()
+    assert stats["dispatches_total"] == base["dispatches_total"] + 1
+    assert stats["requests_total"] == base["requests_total"] + 2
+    got_a = json.loads(responses["a"].get_data())
+    got_b = json.loads(responses["b"].get_data())
+    assert got_a["data"] == expect_a["data"]
+    assert got_b["data"] == expect_b["data"]
+    # the queue phase rides Server-Timing next to model_load/predict
+    assert "queue;dur=" in responses["a"].headers["Server-Timing"]
+    assert "predict;dur=" in responses["a"].headers["Server-Timing"]
+
+
+def test_sequential_batched_responses_bit_identical(
+    batching_app, sensor_frame, model_collection_env
+):
+    """Solo requests through the batcher (batch size 1) also keep the
+    exact unbatched bytes — the cap only delays, never changes."""
+    from werkzeug.test import Client as WerkzeugClient
+
+    from gordo_tpu.server import build_app
+
+    body = _fleet_body(sensor_frame, [GORDO_SINGLE_TARGET])
+    batched = WerkzeugClient(batching_app).post(FLEET_URL, json=body)
+    plain = WerkzeugClient(build_app()).post(FLEET_URL, json=body)
+    assert batched.status_code == plain.status_code == 200
+    assert (
+        json.loads(batched.get_data())["data"]
+        == json.loads(plain.get_data())["data"]
+    )
+
+
+def test_queue_full_is_structured_503_with_retry_after(
+    batching_app, sensor_frame, monkeypatch
+):
+    from werkzeug.test import Client as WerkzeugClient
+
+    def shed(self, inputs, trace_id=""):
+        raise BatchQueueFull(3, 2, 2)
+
+    monkeypatch.setattr(RequestBatcher, "submit", shed)
+    resp = WerkzeugClient(batching_app).post(
+        FLEET_URL, json=_fleet_body(sensor_frame, [GORDO_SINGLE_TARGET])
+    )
+    assert resp.status_code == 503
+    assert resp.headers["Retry-After"] == "3"
+    payload = json.loads(resp.get_data())
+    assert payload["queue_depth"] == 2
+    assert payload["queue_limit"] == 2
+    assert payload["retry_after_s"] == 3
+    assert "queue full" in payload["error"].lower()
+
+
+def test_batch_of_quarantined_and_healthy_fault_domains(
+    batching_app, sensor_frame, model_collection_env
+):
+    """Batching × PR-4 fault domains: under concurrent batched load, a
+    quarantined machine's request 409s (it never even enqueues) while
+    the healthy peer serves 200."""
+    import os
+
+    report_path = os.path.join(model_collection_env, "build_report.json")
+    with open(report_path, "w") as fh:
+        json.dump(
+            {
+                "version": 1,
+                "kind": "fleet_build_report",
+                "quarantined": [{"machine": GORDO_BASE_TARGETS[0], "epoch": 1}],
+            },
+            fh,
+        )
+    try:
+        responses = _concurrent_posts(
+            batching_app,
+            {
+                "healthy": _fleet_body(sensor_frame, [GORDO_SINGLE_TARGET]),
+                "casualty": _fleet_body(sensor_frame, [GORDO_BASE_TARGETS[0]]),
+            },
+        )
+        assert responses["healthy"].status_code == 200, responses[
+            "healthy"
+        ].get_data()
+        assert responses["casualty"].status_code == 409
+        payload = json.loads(responses["casualty"].get_data())
+        assert GORDO_BASE_TARGETS[0] in payload["unavailable"]
+    finally:
+        os.unlink(report_path)
+
+
+def test_mid_batch_injected_fault_fails_only_affected_future(
+    batching_app, sensor_frame, monkeypatch
+):
+    """batch:raise fires INSIDE the drainer, mid-batch: with
+    @attempts:1 exactly one of two coalesced requests draws the fault —
+    its future carries the 503 while its batch-mate serves 200. No
+    poisoned-batch blast radius."""
+    batcher = _warm_batcher(
+        batching_app, sensor_frame, [GORDO_SINGLE_TARGET]
+    )
+    base = batcher.stats()
+    monkeypatch.setenv(
+        "GORDO_FAULT_INJECT",
+        f"batch:raise:{GORDO_SINGLE_TARGET}@attempts:1",
+    )
+    faults.reset()
+    try:
+        responses = _concurrent_posts(
+            batching_app,
+            {
+                "a": _fleet_body(sensor_frame, [GORDO_SINGLE_TARGET], 1.0),
+                "b": _fleet_body(sensor_frame, [GORDO_SINGLE_TARGET], 0.5),
+            },
+        )
+        codes = sorted(r.status_code for r in responses.values())
+        assert codes == [200, 503], {
+            k: r.get_data() for k, r in responses.items()
+        }
+        faulted = next(
+            r for r in responses.values() if r.status_code == 503
+        )
+        assert "Fault injection" in json.loads(faulted.get_data())["error"]
+        # both rode ONE batch formation: the fault split the futures,
+        # not the batch
+        stats = batcher.stats()
+        assert stats["requests_total"] == base["requests_total"] + 2
+        assert stats["dispatches_total"] == base["dispatches_total"] + 1
+    finally:
+        faults.reset()
+
+
+def test_batch_span_fan_in(
+    batching_app, sensor_frame, monkeypatch, tmp_path
+):
+    """One server.batch span per coalesced dispatch, linked from every
+    member request: the batch span lists the request trace ids, each
+    server.request span carries the batch ids back."""
+    from werkzeug.test import Client as WerkzeugClient
+
+    from gordo_tpu.observability.tracing import read_spans
+
+    # warm the scorer + batcher (and widen the formation cap) BEFORE the
+    # trace log exists: the warm-up's solo batch span stays out of the
+    # assertions, and the concurrent pair below reliably coalesces
+    _warm_batcher(batching_app, sensor_frame, [GORDO_SINGLE_TARGET])
+    span_log = tmp_path / "spans.jsonl"
+    monkeypatch.setenv("GORDO_TPU_TRACE_LOG", str(span_log))
+    monkeypatch.delenv("GORDO_TPU_TRACE_SAMPLE", raising=False)
+    client = WerkzeugClient(batching_app)
+    responses = {}
+
+    def post(key, scale):
+        responses[key] = client.post(
+            FLEET_URL,
+            json=_fleet_body(sensor_frame, [GORDO_SINGLE_TARGET], scale),
+        )
+
+    threads = [
+        threading.Thread(target=post, args=("a", 1.0)),
+        threading.Thread(target=post, args=("b", 0.5)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert responses["a"].status_code == 200
+    assert responses["b"].status_code == 200
+    spans = read_spans(str(span_log))
+    batch_spans = [s for s in spans if s["name"] == "server.batch"]
+    assert len(batch_spans) == 1
+    batch_span = batch_spans[0]
+    assert batch_span["attributes"]["n_requests"] == 2
+    request_spans = [s for s in spans if s["name"] == "server.request"]
+    assert len(request_spans) == 2
+    for request_span in request_spans:
+        attrs = request_span["attributes"]
+        assert attrs["batch_trace_id"] == batch_span["trace_id"]
+        assert attrs["batch_span_id"] == batch_span["span_id"]
+        assert attrs["batch_n_requests"] == 2
+        assert "queue_wait_ms" in attrs
+        assert (
+            request_span["trace_id"]
+            in batch_span["attributes"]["request_trace_ids"]
+        )
+    # the queue phase is its own span under the request, so
+    # `gordo-tpu trace summarize` attributes queue wait separately
+    queue_spans = [s for s in spans if s["name"] == "queue"]
+    assert len(queue_spans) == 2
+    request_ids = {s["span_id"] for s in request_spans}
+    assert all(s["parent_span_id"] in request_ids for s in queue_spans)
+
+
+# -- /healthz readiness ----------------------------------------------------
+
+
+def test_healthz_ok_when_idle(gordo_ml_server_client):
+    resp = gordo_ml_server_client.get("/healthz")
+    assert resp.status_code == 200
+    payload = json.loads(resp.get_data())
+    assert payload["status"] == "ok"
+    assert payload["batching"]["enabled"] is False
+    assert payload["batching"]["queue_depth"] == 0
+
+
+def test_healthz_reports_saturation_as_503(batching_app):
+    from werkzeug.test import Client as WerkzeugClient
+
+    class Saturated:
+        def stats(self):
+            return {
+                "queue_depth": 2,
+                "queue_limit": 2,
+                "saturated": True,
+                "sheds_total": 5,
+                "shedding": True,
+                "dispatches_total": 7,
+                "requests_total": 9,
+                "mean_batch_size": 1.3,
+                "retry_after_s": 2,
+            }
+
+    batching_app._batchers[("fake", ("m",))] = Saturated()
+    resp = WerkzeugClient(batching_app).get("/healthz")
+    assert resp.status_code == 503
+    assert resp.headers["Retry-After"] == "2"
+    payload = json.loads(resp.get_data())
+    assert payload["status"] == "overloaded"
+    assert payload["batching"]["queue_depth"] == 2
+    assert payload["batching"]["sheds_total"] == 5
+    assert payload["batching"]["shedding"] is True
+
+
+# -- client Retry-After honoring -------------------------------------------
+
+
+class _FakeResponse:
+    def __init__(self, status_code, payload=None, headers=None):
+        self.status_code = status_code
+        self.headers = headers or {}
+        self._payload = payload if payload is not None else {}
+        self.content = json.dumps(self._payload).encode()
+
+    def json(self):
+        return self._payload
+
+
+def test_handle_response_maps_503_retry_after_to_server_overloaded():
+    from gordo_tpu.client.io import ServerOverloaded, handle_response
+
+    shed = _FakeResponse(
+        503,
+        {"error": "Batching queue full"},
+        {
+            "Retry-After": "2",
+            "content-type": "application/json",
+            "X-Gordo-Trace-Id": "abc123",
+        },
+    )
+    with pytest.raises(ServerOverloaded) as excinfo:
+        handle_response(shed)
+    assert excinfo.value.retry_after == 2.0
+    assert excinfo.value.trace_id == "abc123"
+    assert isinstance(excinfo.value, IOError)  # retry loops keep catching it
+
+    # headerless (or unparseable) 503s stay plain IOErrors
+    with pytest.raises(IOError) as excinfo:
+        handle_response(_FakeResponse(503, {"error": "down"}))
+    assert not isinstance(excinfo.value, ServerOverloaded)
+    with pytest.raises(IOError) as excinfo:
+        handle_response(
+            _FakeResponse(503, {}, {"Retry-After": "Wed, 21 Oct 2026 07:28:00 GMT"})
+        )
+    assert not isinstance(excinfo.value, ServerOverloaded)
+    # 'inf' parses as a float but must never drive sleep(inf)
+    with pytest.raises(IOError) as excinfo:
+        handle_response(_FakeResponse(503, {}, {"Retry-After": "inf"}))
+    assert not isinstance(excinfo.value, ServerOverloaded)
+    # absurd finite values cap at the exponential path's 300s ceiling
+    with pytest.raises(ServerOverloaded) as excinfo:
+        handle_response(_FakeResponse(503, {}, {"Retry-After": "86400"}))
+    assert excinfo.value.retry_after == 300.0
+
+
+def test_client_honors_retry_after_on_shed(monkeypatch):
+    """A shed 503 re-arrives after the server's Retry-After (jittered
+    UP, decorrelating the herd), not after the 8s exponential base."""
+    from gordo_tpu.client import client as client_module
+    from gordo_tpu.client.client import Client
+    from gordo_tpu.client.utils import seed_backoff_jitter
+
+    sleeps = []
+    monkeypatch.setattr(client_module, "sleep", sleeps.append)
+    seed_backoff_jitter(3)
+
+    shed = _FakeResponse(
+        503,
+        {"error": "Batching queue full"},
+        {"Retry-After": "2", "content-type": "application/json"},
+    )
+    ok = _FakeResponse(
+        200, {"data": {}}, {"content-type": "application/json"}
+    )
+
+    class FakeSession:
+        def __init__(self):
+            self.responses = [shed, shed, ok]
+
+        def post(self, *args, **kwargs):
+            return self.responses.pop(0)
+
+    client = Client(
+        project="proj", host="h", session=FakeSession(), n_retries=3
+    )
+    status, resp = client._post_fleet_chunk(
+        "http://h/gordo/v0/proj/prediction/fleet", {"m": {}}, "rev"
+    )
+    assert status == "ok"
+    assert len(sleeps) == 2
+    # Retry-After floor, jittered up by at most 25% — never the 8s base
+    assert all(2.0 <= s <= 2.5 for s in sleeps)

@@ -559,7 +559,32 @@ def test_run_server_cli_passes_concurrency_knobs(runner, monkeypatch):
     assert result.exit_code == 0, result.output
     assert captured == {
         "host": "127.0.0.1", "port": 5001, "workers": 3, "threads": 5,
-        "worker_connections": 17, "config": None,
+        "worker_connections": 17,
+        # batching defaults ride the config: 0 = disabled (the strict
+        # pass-through path, docs/serving.md#dynamic-batching)
+        "config": {"BATCH_WAIT_MS": 0.0, "BATCH_QUEUE_LIMIT": 64},
+    }
+
+
+def test_run_server_cli_passes_batching_knobs(runner, monkeypatch):
+    """--batch-wait-ms/--queue-limit reach the server config intact."""
+    captured = {}
+
+    def fake_run_server(host, port, workers, log_level, config=None,
+                        threads=None, worker_connections=None):
+        captured.update(config=config)
+
+    from gordo_tpu.server import app as server_app
+
+    monkeypatch.setattr(server_app, "run_server", fake_run_server)
+    result = runner.invoke(
+        gordo,
+        ["run-server", "--batch-wait-ms", "7.5", "--queue-limit", "32"],
+    )
+    assert result.exit_code == 0, result.output
+    assert captured["config"] == {
+        "BATCH_WAIT_MS": 7.5,
+        "BATCH_QUEUE_LIMIT": 32,
     }
 
 
