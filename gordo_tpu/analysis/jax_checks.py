@@ -64,6 +64,10 @@ HOT_PATH_PATTERNS = (
     "gordo_tpu/parallel/",
     "gordo_tpu/models/core.py",
     "gordo_tpu/server/",
+    # the lifecycle daemon loops over the whole fleet every tick: a
+    # per-iteration host sync in drift scoring or shadow scoring would
+    # scale with collection size
+    "gordo_tpu/lifecycle/",
 )
 
 

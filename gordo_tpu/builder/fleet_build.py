@@ -34,7 +34,7 @@ from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeoutError
 from datetime import datetime, timezone
 from pathlib import Path
-from typing import Callable, Dict, List, Optional, Tuple, Union
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 
 import numpy as np
 import pandas as pd
@@ -149,6 +149,22 @@ class FleetModelBuilder:
         Seconds to sleep before retry ``attempt`` (1-based); defaults to
         the client's shared exponential policy
         (``client.utils.backoff_seconds``).
+    initial_params
+        Warm-start initialization (docs/lifecycle.md): machine name →
+        host param pytree (the served artifact's ``est.params_``). A
+        bucket whose machines ALL have an entry trains from those
+        params instead of a fresh init — both the CV fold fits and the
+        final fit, so refit thresholds are calibrated against the same
+        warm trajectory the candidate trains along. A bucket with any
+        machine missing (or a tree that no longer matches the model
+        spec) falls back to cold init with a warning — warm start is an
+        optimization, never a correctness gate.
+    fault_sites
+        ``GORDO_FAULT_INJECT`` sites whose nan-mode specs may poison
+        this build's fits (robustness/faults.py). The default is the
+        ordinary ``("train",)``; lifecycle refits pass
+        ``("train", "refit")`` so ``refit:nan:<machine>`` targets refit
+        builds without touching unrelated training.
     """
 
     def __init__(
@@ -162,6 +178,8 @@ class FleetModelBuilder:
         fetch_retries: int = 2,
         fetch_timeout: Optional[float] = None,
         fetch_backoff: Callable[[int], float] = backoff_seconds,
+        initial_params: Optional[Dict[str, Any]] = None,
+        fault_sites: Tuple[str, ...] = ("train",),
     ):
         self.machines = machines
         if mesh is None and auto_mesh:
@@ -177,6 +195,8 @@ class FleetModelBuilder:
         self.fetch_retries = max(0, int(fetch_retries))
         self.fetch_timeout = fetch_timeout
         self.fetch_backoff = fetch_backoff
+        self.initial_params = initial_params
+        self.fault_sites = tuple(fault_sites)
         #: per-bucket telemetry accumulated by _build_bucket, assembled
         #: into telemetry_report_ (and persisted next to artifacts) by
         #: build()
@@ -809,7 +829,11 @@ class FleetModelBuilder:
         )
 
         trainer = FleetTrainer(
-            spec, lookahead=lookahead, mesh=self.mesh, epoch_chunk=epoch_chunk
+            spec,
+            lookahead=lookahead,
+            mesh=self.mesh,
+            epoch_chunk=epoch_chunk,
+            fault_sites=self.fault_sites,
         )
         # Per-machine PRNG keys are the SOLO path's init key for the
         # machine's evaluation seed (models/core.py: solo_init_key) —
@@ -829,6 +853,7 @@ class FleetModelBuilder:
         )
 
         machine_names = [item["machine"].name for item in fetched]
+        warm_params = self._stack_warm_params(machine_names, int(m_padded))
 
         # -- CV folds as masks: threshold calibration + scores ------------
         start_cv = time.time()
@@ -836,7 +861,7 @@ class FleetModelBuilder:
             fold_records = self._run_cv_folds(
                 trainer, data, keys, bucket, Xs_grid, ys_grid, models,
                 epochs=epochs, batch_size=batch_size, es_kwargs=es_kwargs,
-                machine_names=machine_names,
+                machine_names=machine_names, warm_params=warm_params,
             )
         cv_duration = time.time() - start_cv
 
@@ -847,7 +872,7 @@ class FleetModelBuilder:
         ):
             params, losses = trainer.fit(
                 data, keys, epochs=epochs, batch_size=batch_size,
-                machine_names=machine_names, **es_kwargs
+                machine_names=machine_names, params=warm_params, **es_kwargs
             )
         fit_duration = time.time() - start_fit
 
@@ -962,6 +987,10 @@ class FleetModelBuilder:
                 "fit_duration_s": fit_duration,
                 "bucket_wall_s": bucket_wall,
                 "n_machines_quarantined": n_bucket_quarantined,
+                # lifecycle refits init from the served revision's params
+                # (docs/lifecycle.md); False also covers a refit that FELL
+                # BACK to cold init, so the report never overclaims
+                "warm_start": warm_params is not None,
                 "models_per_hour": (
                     len(bucket) / bucket_wall * 3600 if bucket_wall > 0 else None
                 ),
@@ -989,6 +1018,46 @@ class FleetModelBuilder:
             peak_bytes_in_use=peak,
         )
         return out
+
+    def _stack_warm_params(
+        self, machine_names: List[str], m_padded: int
+    ) -> Optional[Any]:
+        """
+        The bucket's warm-start init (docs/lifecycle.md): stack
+        ``initial_params[name]`` host trees along a leading fleet axis,
+        padding with the first machine's tree (padded rows carry zero
+        sample weight, so their init is inert). None — cold init — when
+        warm start is off, any machine lacks an entry, or the trees no
+        longer share one structure (a changed model config).
+        """
+        if not self.initial_params:
+            return None
+        trees = [self.initial_params.get(name) for name in machine_names]
+        missing = [n for n, t in zip(machine_names, trees) if t is None]
+        if missing:
+            logger.warning(
+                "Warm start: no initial params for %s; bucket falls back "
+                "to cold init",
+                missing,
+            )
+            return None
+        import jax
+
+        trees = trees + [trees[0]] * (m_padded - len(trees))
+        try:
+            return jax.tree_util.tree_map(
+                lambda *leaves: np.stack(
+                    [np.asarray(leaf, dtype=np.float32) for leaf in leaves]
+                ),
+                *trees,
+            )
+        except (ValueError, TypeError) as exc:
+            logger.warning(
+                "Warm start: param trees do not stack (%s); bucket falls "
+                "back to cold init",
+                exc,
+            )
+            return None
 
     @staticmethod
     def _early_stopping_kwargs(fit_args: dict) -> dict:
@@ -1057,6 +1126,7 @@ class FleetModelBuilder:
         n_splits: int = 3,
         es_kwargs: Optional[dict] = None,
         machine_names: Optional[List[str]] = None,
+        warm_params: Optional[Any] = None,
     ) -> dict:
         """
         TimeSeriesSplit folds, trained fleet-wide with per-machine train
@@ -1115,6 +1185,7 @@ class FleetModelBuilder:
                 batch_size=batch_size,
                 extra_weight=train_mask,
                 machine_names=machine_names,
+                params=warm_params,
                 **(es_kwargs or {}),
             )
             preds = trainer.predict(fold_params, data.X)  # (M, n_out, f_out)

@@ -6,7 +6,8 @@ Commands: ``build`` (one Machine per process — reference semantics),
 vmapped XLA program per architecture bucket — the fleet builder that
 replaces one-pod-per-model), ``run-server``, ``lint`` (the
 gordo_tpu.analysis static/JAX-discipline checker), plus the
-``workflow``, ``client``, ``telemetry`` and ``trace`` groups.
+``workflow``, ``client``, ``telemetry``, ``trace`` and ``lifecycle``
+groups.
 
 Note: the reference snapshot plants a fault raising FileNotFoundError for
 machine names containing "err" (gordo/cli/cli.py:178-179); that is a bug in
@@ -29,6 +30,7 @@ from gordo_tpu.builder import FleetModelBuilder, ModelBuilder
 from gordo_tpu.cli.client import client as gordo_client
 from gordo_tpu.cli.custom_types import HostIP, key_value_par
 from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
+from gordo_tpu.cli.lifecycle import lifecycle_cli
 from gordo_tpu.cli.lint import lint_cli
 from gordo_tpu.cli.trace import trace_cli
 from gordo_tpu.cli.workflow_generator import workflow_cli
@@ -637,6 +639,7 @@ gordo.add_command(gordo_client)
 gordo.add_command(telemetry_cli)
 gordo.add_command(trace_cli)
 gordo.add_command(lint_cli)
+gordo.add_command(lifecycle_cli)
 
 if __name__ == "__main__":
     gordo()

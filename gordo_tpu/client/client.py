@@ -281,7 +281,11 @@ class Client:
         Run predictions for [start, end] over all (or ``targets``) machines,
         fanned out over a thread pool (reference: client.py:279-323).
 
-        Returns a list of ``(name, predictions-frame, error-messages)``.
+        Returns a list of :class:`PredictionResult` — each unpacks as the
+        historical ``(name, predictions-frame, error-messages)`` 3-tuple
+        and additionally carries ``.revision``, the revision the server
+        STAMPED on the responses that produced the frame (None when no
+        response carried one, or when batches saw mixed revisions).
         """
         _revision = revision or self._get_latest_revision()
         machines = self._get_machines(revision=_revision, machine_names=targets)
@@ -290,17 +294,18 @@ class Client:
         ) as span:
             parent_ctx = span.context
             with ThreadPoolExecutor(max_workers=self.parallelism) as executor:
-                jobs = executor.map(
-                    lambda machine: self._predict_single_traced(
-                        parent_ctx,
-                        machine=machine,
-                        start=start,
-                        end=end,
-                        revision=_revision,
-                    ),
-                    machines,
+                return list(
+                    executor.map(
+                        lambda machine: self._predict_single_traced(
+                            parent_ctx,
+                            machine=machine,
+                            start=start,
+                            end=end,
+                            revision=_revision,
+                        ),
+                        machines,
+                    )
                 )
-                return [(j.name, j.predictions, j.error_messages) for j in jobs]
 
     def _predict_single_traced(
         self, parent_ctx, machine: Machine, start, end, revision
@@ -336,7 +341,8 @@ class Client:
         in one JSON body, or as parquet multipart parts when the client
         was built with ``use_parquet=True``.
 
-        Returns the same ``(name, frame, errors)`` list as :meth:`predict`.
+        Returns the same :class:`PredictionResult` list as :meth:`predict`
+        (3-tuple-compatible, with the served revision on ``.revision``).
         """
         _revision = revision or self._get_latest_revision()
         machines = self._get_machines(revision=_revision, machine_names=targets)
@@ -354,7 +360,7 @@ class Client:
             jobs.extend(
                 (pool[i : i + size], use_base) for i in range(0, len(pool), size)
             )
-        results: typing.List[typing.Tuple[str, pd.DataFrame, typing.List[str]]] = []
+        results: typing.List[PredictionResult] = []
         with tracing.start_span(
             "client.predict", path="fleet", n_machines=len(machines)
         ) as span:
@@ -371,10 +377,7 @@ class Client:
                     ),
                     jobs,
                 ):
-                    results.extend(
-                        (r.name, r.predictions, r.error_messages)
-                        for r in group_results
-                    )
+                    results.extend(group_results)
         return results
 
     def _predict_group_traced(
@@ -434,6 +437,12 @@ class Client:
         # per-revision condition — they leave the group's payloads, keep
         # their recorded error, and are never retried
         excluded: typing.Set[str] = set()
+        # per-machine revisions the server stamped on the responses that
+        # actually carried this machine's data (PredictionResult.revision:
+        # the one revision seen, or None — a MIX of revisions across
+        # chunks is reported as an error and surfaces None, so stateful
+        # consumers can never attribute the frames to a single revision)
+        served_revisions: typing.Dict[str, set] = {name: set() for name in data}
 
         def build_payload(k: int):
             payload: typing.Dict[str, Any] = {}
@@ -475,7 +484,9 @@ class Client:
             if not payload:
                 continue
             while True:
-                status, resp = self._post_fleet_chunk(url, payload, revision)
+                status, resp, chunk_revision = self._post_fleet_chunk(
+                    url, payload, revision
+                )
                 if status != "unavailable":
                     break
                 # the 409 names the casualties; record each once, drop
@@ -555,6 +566,8 @@ class Client:
             for name, frame_dict in resp["data"].items():
                 frame = server_utils.dataframe_from_dict(frame_dict)
                 frames[name].append(frame)
+                if chunk_revision is not None:
+                    served_revisions[name].add(chunk_revision)
                 if self.prediction_forwarder is not None:
                     self.prediction_forwarder(
                         predictions=frame,
@@ -562,6 +575,12 @@ class Client:
                         metadata=self.metadata,
                     )
 
+        for name, seen in served_revisions.items():
+            if len(seen) > 1:
+                errors[name].append(
+                    f"Chunks for '{name}' were served by MIXED revisions "
+                    f"{sorted(seen)}; result revision recorded as unknown"
+                )
         return [
             PredictionResult(
                 name=name,
@@ -571,6 +590,11 @@ class Client:
                     else pd.DataFrame()
                 ),
                 error_messages=errors[name],
+                revision=(
+                    next(iter(served_revisions[name]))
+                    if len(served_revisions[name]) == 1
+                    else None
+                ),
             )
             for name in data
         ]
@@ -585,16 +609,24 @@ class Client:
         across every retry, so one slow or flapping chunk is one trace.
         Returns one of:
 
-        - ``("ok", response_dict)``
-        - ``("refused", message)`` — a 4xx the server will repeat (422 mixed
-          group, bad input): retrying is pointless, fall back or record
-        - ``("unavailable", MachineUnavailable)`` — a 409: the group
-          contains quarantined/build-failed machines (named in the
-          exception's ``unavailable`` dict); the caller records them as
-          per-machine failures and re-POSTs the healthy remainder
-        - ``("io_error", message)`` — retries exhausted: record the failure;
-          do NOT re-run the group per-machine (that doubles the backoff
-          wall-clock against a server that is already down)
+        - ``("ok", response_dict, served_revision)``
+        - ``("refused", message, served_revision)`` — a 4xx the server will
+          repeat (422 mixed group, bad input): retrying is pointless, fall
+          back or record
+        - ``("unavailable", MachineUnavailable, served_revision)`` — a 409:
+          the group contains quarantined/build-failed machines (named in
+          the exception's ``unavailable`` dict); the caller records them
+          as per-machine failures and re-POSTs the healthy remainder
+        - ``("io_error", message, served_revision)`` — retries exhausted:
+          record the failure; do NOT re-run the group per-machine (that
+          doubles the backoff wall-clock against a server that is already
+          down)
+
+        ``served_revision`` is the ``revision`` header the server stamped
+        on the (last) response, or None when no response arrived — it
+        feeds ``PredictionResult.revision`` so longitudinal consumers
+        (the lifecycle drift monitor) can verify which revision actually
+        answered.
 
         410 propagates (deployment revision gone, like the per-machine path).
         """
@@ -603,7 +635,7 @@ class Client:
 
     def _post_fleet_chunk_traced(
         self, url: str, payload: typing.Dict[str, Any], revision: str, span
-    ) -> typing.Tuple[str, Any]:
+    ) -> typing.Tuple[str, Any, typing.Optional[str]]:
         post_kwargs: typing.Dict[str, Any] = {"params": {"revision": revision}}
         headers = tracing.propagation_headers(span)
         if headers:
@@ -613,12 +645,15 @@ class Client:
             post_kwargs["files"] = payload
         else:
             post_kwargs["json"] = {"machines": payload}
+        served_revision: typing.Optional[str] = None
         for current_attempt in itertools.count(start=1):
             attempt_start = monotonic()
             try:
-                result = "ok", handle_response(
-                    self.session.post(url, **post_kwargs)
-                )
+                raw = self.session.post(url, **post_kwargs)
+                # the revision the server ACTUALLY served: stamped on
+                # every response, error paths included
+                served_revision = raw.headers.get("revision") or served_revision
+                result = "ok", handle_response(raw), served_revision
                 _observe_request("fleet", "ok", monotonic() - attempt_start)
                 return result
             except (
@@ -651,7 +686,7 @@ class Client:
                     # the recorded per-machine failure names the trace the
                     # retries happened under, greppable server-side too
                     message += f" (trace id: {span.trace_id})"
-                return "io_error", message
+                return "io_error", message, served_revision
             except ResourceGone:
                 _observe_request("fleet", "gone", monotonic() - attempt_start)
                 raise
@@ -664,7 +699,7 @@ class Client:
                     "machines: %s)",
                     sorted(exc.unavailable) or "unnamed",
                 )
-                return "unavailable", exc
+                return "unavailable", exc, served_revision
             except (HttpUnprocessableEntity, BadGordoRequest, NotFound) as exc:
                 _observe_request(
                     "fleet", "refused", monotonic() - attempt_start
@@ -674,7 +709,7 @@ class Client:
                     "per-machine path",
                     exc,
                 )
-                return "refused", str(exc)
+                return "refused", str(exc), served_revision
 
     def predict_single_machine(
         self, machine: Machine, start: datetime, end: datetime, revision: str
@@ -710,17 +745,32 @@ class Client:
             )
             prediction_dfs = []
             error_messages: List[str] = []
+            served: typing.Set[str] = set()
             for result in jobs:
                 if result.predictions is not None:
                     prediction_dfs.append(result.predictions)
                 error_messages.extend(result.error_messages)
+                if result.revision is not None:
+                    served.add(result.revision)
             predictions = (
                 pd.concat(prediction_dfs).sort_index()
                 if prediction_dfs
                 else pd.DataFrame()
             )
+        if len(served) > 1:
+            # chunks answered by different revisions (a promotion rolled
+            # latest mid-run): the frames cannot be attributed to ONE
+            # revision, and stateful consumers must see that
+            error_messages.append(
+                f"Batches for '{machine.name}' were served by MIXED "
+                f"revisions {sorted(served)}; result revision recorded as "
+                "unknown"
+            )
         return PredictionResult(
-            name=machine.name, predictions=predictions, error_messages=error_messages
+            name=machine.name,
+            predictions=predictions,
+            error_messages=error_messages,
+            revision=next(iter(served)) if len(served) == 1 else None,
         )
 
     def _send_prediction_request(
@@ -798,17 +848,28 @@ class Client:
                 ),
             }
 
+        served_revision: typing.Optional[str] = None
+
+        def post() -> typing.Any:
+            nonlocal served_revision
+            raw = self.session.post(**kwargs)
+            # the revision the server ACTUALLY served — stamped on every
+            # response (error paths included), parquet bodies carry no
+            # JSON field so the header is the one source
+            served_revision = raw.headers.get("revision") or served_revision
+            return handle_response(raw)
+
         for current_attempt in itertools.count(start=1):
             attempt_start = monotonic()
             try:
                 try:
-                    resp = handle_response(self.session.post(**kwargs))
+                    resp = post()
                 except HttpUnprocessableEntity:
                     self._fallback_machines.add(machine.name)
                     kwargs["url"] = (
                         f"{self.server_endpoint}/{machine.name}/prediction"
                     )
-                    resp = handle_response(self.session.post(**kwargs))
+                    resp = post()
             except (
                 IOError,
                 TimeoutError,
@@ -837,7 +898,8 @@ class Client:
                     msg += f" (trace id: {span.trace_id})"
                 logger.error(msg)
                 return PredictionResult(
-                    name=machine.name, predictions=None, error_messages=[msg]
+                    name=machine.name, predictions=None, error_messages=[msg],
+                    revision=served_revision,
                 )
             except MachineUnavailable as exc:
                 # 409: the build recorded this machine as failed or
@@ -852,7 +914,8 @@ class Client:
                 )
                 logger.error(msg)
                 return PredictionResult(
-                    name=machine.name, predictions=None, error_messages=[msg]
+                    name=machine.name, predictions=None, error_messages=[msg],
+                    revision=served_revision,
                 )
             except (HttpUnprocessableEntity, BadGordoRequest, NotFound) as exc:
                 # A second 422 (the fallback /prediction also refused) is a
@@ -866,7 +929,8 @@ class Client:
                 )
                 logger.error(msg)
                 return PredictionResult(
-                    name=machine.name, predictions=None, error_messages=[msg]
+                    name=machine.name, predictions=None, error_messages=[msg],
+                    revision=served_revision,
                 )
             except ResourceGone:
                 _observe_request("single", "gone", monotonic() - attempt_start)
@@ -881,7 +945,8 @@ class Client:
                         metadata=self.metadata,
                     )
                 return PredictionResult(
-                    name=machine.name, predictions=predictions, error_messages=[]
+                    name=machine.name, predictions=predictions,
+                    error_messages=[], revision=served_revision,
                 )
 
     # -- data --------------------------------------------------------------

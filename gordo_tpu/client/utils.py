@@ -7,12 +7,54 @@ the gated influx client factory (reference parity: gordo/client/utils.py).
 import random
 import threading
 import time
-from collections import OrderedDict, namedtuple
+from collections import OrderedDict
 from functools import wraps
 from typing import Dict, Optional, Tuple
 
-#: Per-machine prediction outcome (reference: gordo/client/utils.py:10).
-PredictionResult = namedtuple("PredictionResult", "name predictions error_messages")
+
+class PredictionResult(tuple):
+    """
+    Per-machine prediction outcome (reference: gordo/client/utils.py:10
+    — a 3-field namedtuple there, and this stays a 3-tuple: it unpacks,
+    indexes and compares as ``(name, predictions, error_messages)``).
+
+    ``revision`` rides as an attribute OUTSIDE the tuple shape: the
+    revision the server actually stamped on the responses (``revision``
+    header/body field), or None when no response carried one (total IO
+    failure). Consumers that feed longitudinal state — the lifecycle
+    drift monitor above all — must check it, so a response served by an
+    unexpected revision is never mistaken for the one they asked about
+    (docs/lifecycle.md).
+    """
+
+    def __new__(cls, name, predictions, error_messages, revision=None):
+        self = super().__new__(cls, (name, predictions, error_messages))
+        self.revision = revision
+        return self
+
+    def __reduce__(self):
+        # tuple's default pickling would pass the whole 3-tuple as ONE
+        # __new__ argument (and drop .revision); rebuild from the four
+        # real fields so pickle/copy round-trip like the namedtuple did
+        return (self.__class__, (*self, self.revision))
+
+    @property
+    def name(self):
+        return self[0]
+
+    @property
+    def predictions(self):
+        return self[1]
+
+    @property
+    def error_messages(self):
+        return self[2]
+
+    def __repr__(self):
+        return (
+            f"PredictionResult(name={self[0]!r}, predictions={self[1]!r}, "
+            f"error_messages={self[2]!r}, revision={self.revision!r})"
+        )
 
 
 class _BoundedCache:

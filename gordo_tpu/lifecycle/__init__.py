@@ -1,0 +1,74 @@
+"""
+Continuous fleet operation (docs/lifecycle.md): the loop that closes
+serving back into building.
+
+The paper's fleet watches live industrial sensors, so models go stale.
+This subsystem keeps a served collection fresh without ever serving a
+bad revision:
+
+- :mod:`gordo_tpu.lifecycle.drift` — :class:`DriftMonitor` consumes the
+  per-machine anomaly statistics serving already computes (the
+  ``/anomaly/prediction`` frame + the calibrated
+  ``DiffBasedAnomalyDetector`` thresholds) and keeps per-machine
+  EWMA / threshold-exceedance state across ticks.
+- :mod:`gordo_tpu.lifecycle.refit` — warm-start refit helpers: served
+  params as fleet-trainer init, and the shadow-scoring gate that
+  compares each refit candidate against the live revision on a holdout
+  window.
+- :mod:`gordo_tpu.lifecycle.promote` — blue/green revision assembly:
+  a new sibling revision directory (staged dot-prefixed, published by
+  one atomic rename) where each machine is promoted, retained
+  bit-identically (hard links), or quarantined; the whole decision
+  trail lands in ``promotion_report.json`` and the ``latest`` symlink
+  flips atomically.
+- :mod:`gordo_tpu.lifecycle.manager` — :class:`LifecycleManager` ties
+  one ``tick`` together: drift scan → refit drifted subset → shadow
+  gate → promote; driven by ``gordo-tpu lifecycle tick|watch|report``.
+
+Unused, the subsystem costs serving and building nothing: no module
+here is imported by the server, builder, or client hot paths.
+"""
+
+from gordo_tpu.lifecycle.drift import (
+    DriftAssessment,
+    DriftMonitor,
+    total_anomaly_series,
+)
+from gordo_tpu.lifecycle.manager import (
+    LifecycleConfig,
+    LifecycleManager,
+    TickResult,
+)
+from gordo_tpu.lifecycle.promote import (
+    PROMOTION_REPORT_FILENAME,
+    TornPromotion,
+    assemble_revision,
+    read_promotion_report,
+    repoint_latest,
+)
+from gordo_tpu.lifecycle.refit import (
+    ShadowVerdict,
+    shadow_gate,
+    shadow_score,
+    warm_params_from_artifacts,
+    warm_params_from_models,
+)
+
+__all__ = [
+    "DriftAssessment",
+    "DriftMonitor",
+    "LifecycleConfig",
+    "LifecycleManager",
+    "PROMOTION_REPORT_FILENAME",
+    "ShadowVerdict",
+    "TickResult",
+    "TornPromotion",
+    "assemble_revision",
+    "read_promotion_report",
+    "repoint_latest",
+    "shadow_gate",
+    "shadow_score",
+    "total_anomaly_series",
+    "warm_params_from_artifacts",
+    "warm_params_from_models",
+]

@@ -1,0 +1,298 @@
+"""
+Drift detection over served anomaly statistics (docs/lifecycle.md).
+
+The server's ``/anomaly/prediction`` frames already measure exactly what
+drift looks like: the ``total-anomaly-scaled`` column against the
+detector's calibrated ``aggregate_threshold_``. :class:`DriftMonitor`
+consumes those per machine and keeps two EWMA statistics across ticks —
+the mean anomaly/threshold ratio, and the fraction of timesteps
+exceeding the threshold — so one noisy window doesn't trigger a refit
+and a sustained shift does.
+
+State persists as one JSON file next to the revision directories (a dot
+path, so it is never mistaken for a revision), letting ``gordo-tpu
+lifecycle tick`` run as independent scheduled invocations. Each
+machine's state is bound to the revision that produced its
+observations: feeding a frame served by a DIFFERENT revision resets
+that machine's state instead of polluting it — the reason
+``Client.predict`` surfaces the served revision (client/utils.py
+``PredictionResult.revision``).
+"""
+
+import dataclasses
+import json
+import logging
+import os
+import tempfile
+import typing
+from datetime import datetime, timezone
+
+import numpy as np
+import pandas as pd
+
+from gordo_tpu.observability import emit_event
+
+logger = logging.getLogger(__name__)
+
+STATE_VERSION = 1
+
+
+def total_anomaly_series(
+    frame: pd.DataFrame, flavor: str = "scaled"
+) -> pd.Series:
+    """
+    The ``total-anomaly-<flavor>`` column of an anomaly frame as a flat
+    float series — whether the frame came straight from
+    ``DiffBasedAnomalyDetector.anomaly`` (MultiIndex columns) or was
+    parsed back from a server response (``dataframe_from_dict``).
+    """
+    column = f"total-anomaly-{flavor}"
+    if column not in frame.columns:
+        raise KeyError(
+            f"Anomaly frame has no {column!r} column (columns: "
+            f"{list(frame.columns)[:8]}...)"
+        )
+    obj = frame[column]
+    if isinstance(obj, pd.DataFrame):
+        obj = obj.iloc[:, 0]
+    return obj.astype(float)
+
+
+@dataclasses.dataclass
+class MachineDriftState:
+    """One machine's accumulated drift statistics."""
+
+    revision: str = ""
+    n_observations: int = 0
+    ewma_ratio: float = 0.0
+    ewma_exceedance: float = 0.0
+    drifted: bool = False
+    last_observed: str = ""
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class DriftAssessment:
+    """What one observation did to a machine's drift state."""
+
+    machine: str
+    ratio: float
+    exceedance: float
+    ewma_ratio: float
+    ewma_exceedance: float
+    drifted: bool
+    n_observations: int
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class DriftMonitor:
+    """
+    Parameters
+    ----------
+    state_path
+        JSON file holding per-machine state across ticks; None keeps
+        state in-memory only (tests, one-shot assessments).
+    ewma_alpha
+        Weight of the newest observation in both EWMAs (1.0 = no
+        memory: each tick judges on its own window).
+    ratio_threshold
+        Drift when the EWMA of mean(total-anomaly / threshold) exceeds
+        this. The anomaly threshold itself is the calibrated "abnormal"
+        line, so 1.0 means "the AVERAGE timestep now looks abnormal".
+    exceedance_threshold
+        Drift when the EWMA of the per-window exceedance fraction
+        (timesteps over threshold) exceeds this.
+    min_observations
+        Observations required before a machine may be declared drifted
+        (guards a cold state file against one bad window).
+    """
+
+    def __init__(
+        self,
+        state_path: typing.Optional[typing.Union[str, os.PathLike]] = None,
+        ewma_alpha: float = 0.3,
+        ratio_threshold: float = 1.0,
+        exceedance_threshold: float = 0.5,
+        min_observations: int = 1,
+    ):
+        if not 0.0 < float(ewma_alpha) <= 1.0:
+            raise ValueError(f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
+        self.state_path = str(state_path) if state_path is not None else None
+        self.ewma_alpha = float(ewma_alpha)
+        self.ratio_threshold = float(ratio_threshold)
+        self.exceedance_threshold = float(exceedance_threshold)
+        self.min_observations = max(1, int(min_observations))
+        self._state: typing.Dict[str, MachineDriftState] = {}
+        if self.state_path is not None:
+            self.load()
+
+    # -- observations ----------------------------------------------------
+
+    def observe(
+        self,
+        machine: str,
+        anomaly_frame: pd.DataFrame,
+        threshold: float,
+        revision: str = "",
+    ) -> DriftAssessment:
+        """
+        Feed one anomaly frame (the ``/anomaly/prediction`` shape) plus
+        the detector's calibrated aggregate threshold; returns the
+        updated assessment. Raises ``ValueError`` for an unusable
+        threshold — a detector without one cannot be drift-monitored.
+        """
+        if threshold is None or not np.isfinite(threshold) or threshold <= 0:
+            raise ValueError(
+                f"Machine {machine!r} has no usable aggregate threshold "
+                f"({threshold!r}); cannot assess drift"
+            )
+        total = total_anomaly_series(anomaly_frame).dropna()
+        return self.observe_ratio(machine, total / float(threshold), revision)
+
+    def observe_ratio(
+        self,
+        machine: str,
+        ratio_series: typing.Union[pd.Series, np.ndarray],
+        revision: str = "",
+    ) -> DriftAssessment:
+        """
+        Core update from a per-timestep anomaly/threshold ratio series
+        (>1 = that timestep looks abnormal).
+        """
+        ratios = np.asarray(ratio_series, dtype=float)
+        ratios = ratios[np.isfinite(ratios)]
+        if ratios.size == 0:
+            raise ValueError(
+                f"Machine {machine!r}: no finite anomaly ratios to observe"
+            )
+        ratio = float(ratios.mean())
+        exceedance = float((ratios > 1.0).mean())
+
+        state = self._state.get(machine)
+        if state is None:
+            state = MachineDriftState()
+            self._state[machine] = state
+        if revision and state.revision and state.revision != revision:
+            # a different revision means different params AND different
+            # thresholds: its statistics are not comparable, so the
+            # machine starts a fresh baseline rather than inheriting a
+            # stale one (the stale-revision-response guard)
+            logger.info(
+                "Drift state for %s reset: revision %s -> %s",
+                machine, state.revision, revision,
+            )
+            state = MachineDriftState()
+            self._state[machine] = state
+        if revision:
+            state.revision = revision
+
+        alpha = self.ewma_alpha
+        if state.n_observations == 0:
+            state.ewma_ratio = ratio
+            state.ewma_exceedance = exceedance
+        else:
+            state.ewma_ratio = alpha * ratio + (1 - alpha) * state.ewma_ratio
+            state.ewma_exceedance = (
+                alpha * exceedance + (1 - alpha) * state.ewma_exceedance
+            )
+        state.n_observations += 1
+        state.last_observed = datetime.now(timezone.utc).isoformat()
+
+        was_drifted = state.drifted
+        state.drifted = state.n_observations >= self.min_observations and (
+            state.ewma_ratio > self.ratio_threshold
+            or state.ewma_exceedance > self.exceedance_threshold
+        )
+        if state.drifted and not was_drifted:
+            emit_event(
+                "machine_drifted",
+                machine=machine,
+                revision=state.revision or None,
+                ewma_ratio=round(state.ewma_ratio, 6),
+                ewma_exceedance=round(state.ewma_exceedance, 6),
+                n_observations=state.n_observations,
+            )
+        return DriftAssessment(
+            machine=machine,
+            ratio=ratio,
+            exceedance=exceedance,
+            ewma_ratio=state.ewma_ratio,
+            ewma_exceedance=state.ewma_exceedance,
+            drifted=state.drifted,
+            n_observations=state.n_observations,
+        )
+
+    # -- queries ---------------------------------------------------------
+
+    def drifted(self) -> typing.List[str]:
+        """Machines currently over a drift criterion, sorted."""
+        return sorted(m for m, s in self._state.items() if s.drifted)
+
+    def state(self, machine: str) -> typing.Optional[MachineDriftState]:
+        return self._state.get(machine)
+
+    def reset(self, machine: typing.Optional[str] = None) -> None:
+        """Forget one machine's state (promotion gives it a fresh
+        baseline under the new revision) — or everything when None."""
+        if machine is None:
+            self._state.clear()
+        else:
+            self._state.pop(machine, None)
+
+    # -- persistence -----------------------------------------------------
+
+    def save(self) -> typing.Optional[str]:
+        """Atomically persist state to ``state_path`` (None = no-op)."""
+        if self.state_path is None:
+            return None
+        payload = {
+            "version": STATE_VERSION,
+            "machines": {m: s.to_dict() for m, s in self._state.items()},
+        }
+        parent = os.path.dirname(os.path.abspath(self.state_path))
+        os.makedirs(parent, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=parent, prefix=".drift-state-")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(payload, fh, indent=2, sort_keys=True)
+                fh.write("\n")
+            os.replace(tmp, self.state_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        return self.state_path
+
+    def load(self) -> None:
+        """Load state from ``state_path``; absent/corrupt = fresh state
+        (a lost state file costs one warm-up tick, never the cycle)."""
+        self._state = {}
+        if self.state_path is None:
+            return
+        try:
+            with open(self.state_path) as fh:
+                payload = json.load(fh)
+        except FileNotFoundError:
+            return
+        except (OSError, ValueError):
+            logger.warning(
+                "Unreadable drift state at %s; starting fresh", self.state_path
+            )
+            return
+        fields = {f.name for f in dataclasses.fields(MachineDriftState)}
+        for machine, record in (payload.get("machines") or {}).items():
+            if not isinstance(record, dict):
+                continue
+            kwargs = {k: v for k, v in record.items() if k in fields}
+            try:
+                self._state[machine] = MachineDriftState(**kwargs)
+            except TypeError:
+                logger.warning(
+                    "Skipping malformed drift state for %s", machine
+                )

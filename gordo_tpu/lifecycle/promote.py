@@ -1,0 +1,239 @@
+"""
+Blue/green revision assembly (docs/lifecycle.md).
+
+A promotion never mutates the served revision. It stages a SIBLING
+revision directory — dot-prefixed while under construction, so the
+server's ``/revisions`` listing and ``latest`` resolution can never see
+a half-built one — where every machine is either:
+
+- **promoted**: the refit candidate's fresh artifact is serialized in,
+- **retained**: the live artifact's files are hard-linked (byte- and
+  inode-identical; copy is the cross-device fallback), or
+- **quarantined**: the live artifact is retained for metadata/download,
+  and the machine is recorded in the new revision's
+  ``build_report.json`` so serving answers its predictions with the
+  structured 409 (docs/robustness.md).
+
+``promotion_report.json`` (the whole decision trail) and
+``build_report.json`` are written into the staging directory BEFORE the
+one ``os.rename`` that publishes it; a crash at any point — exercised
+by the ``promote:torn`` chaos site — leaves only a dot-prefixed
+staging directory that never becomes ``latest``. The ``latest``
+pointer itself is a symlink re-pointed by symlink-swap + ``rename``,
+which the server resolves per request (server/app.py hot roll).
+"""
+
+import json
+import logging
+import os
+import shutil
+import time
+import typing
+from pathlib import Path
+
+from gordo_tpu.robustness import faults
+
+logger = logging.getLogger(__name__)
+
+PROMOTION_REPORT_FILENAME = "promotion_report.json"
+#: duplicated from builder/fleet_build.py (like server/app.py does) so
+#: the lifecycle promoter never has to import the builder stack for a
+#: filename
+BUILD_REPORT_FILENAME = "build_report.json"
+
+#: staging directories are dot-prefixed with this stem; anything the
+#: server lists or resolves skips dot entries, so a torn promotion is
+#: inert garbage, not a servable revision
+STAGING_PREFIX = ".promote-"
+
+
+class TornPromotion(RuntimeError):
+    """
+    Revision assembly died before publication. The staging directory is
+    left exactly as the crash left it (it is dot-prefixed: never listed,
+    never ``latest``); re-running the promotion stages a fresh sibling.
+    """
+
+    def __init__(self, message: str, staging_dir: str):
+        super().__init__(message)
+        self.staging_dir = staging_dir
+
+
+def new_revision_name(parent: typing.Union[str, os.PathLike]) -> str:
+    """
+    The next revision name: epoch milliseconds (the deployment
+    convention), bumped past any existing numeric sibling so revision
+    order by name matches promotion order even inside one millisecond.
+    """
+    candidate = int(time.time() * 1000)
+    try:
+        entries = os.listdir(parent)
+    except FileNotFoundError:
+        entries = []
+    existing = [int(n) for n in entries if n.isdigit()]
+    # leftover staging dirs occupy their number too: a promotion
+    # retried in the SAME millisecond a torn one died in must stage
+    # under a fresh name, not collide with the tear's forensic record
+    existing += [
+        int(n[len(STAGING_PREFIX):])
+        for n in entries
+        if n.startswith(STAGING_PREFIX) and n[len(STAGING_PREFIX):].isdigit()
+    ]
+    if existing:
+        candidate = max(candidate, max(existing) + 1)
+    while os.path.exists(
+        os.path.join(parent, str(candidate))
+    ) or os.path.exists(os.path.join(parent, f"{STAGING_PREFIX}{candidate}")):
+        candidate += 1
+    return str(candidate)
+
+
+def _link_or_copy_tree(src: Path, dst: Path) -> None:
+    """Hard-link every file of ``src`` under ``dst`` (bit-identical
+    retention at zero storage cost); copy2 is the cross-device
+    fallback. Directory structure is preserved."""
+    for root, _, files in os.walk(src):
+        rel = os.path.relpath(root, src)
+        target_root = dst if rel == "." else dst / rel
+        target_root.mkdir(parents=True, exist_ok=True)
+        for fname in files:
+            src_file = os.path.join(root, fname)
+            dst_file = target_root / fname
+            try:
+                os.link(src_file, dst_file)
+            except OSError:
+                shutil.copy2(src_file, dst_file)
+
+
+def _write_json(path: Path, payload: dict) -> None:
+    """Plain write — atomicity comes from the staging-dir rename, not
+    from per-file tricks (nothing reads a dot-prefixed staging dir)."""
+    with open(path, "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True, default=str)
+        fh.write("\n")
+
+
+def assemble_revision(
+    live_dir: typing.Union[str, os.PathLike],
+    decisions: typing.Dict[str, dict],
+    candidates: typing.Dict[str, tuple],
+    build_report: dict,
+    promotion_report: dict,
+) -> Path:
+    """
+    Stage and publish one new sibling revision of ``live_dir``.
+
+    ``decisions`` maps EVERY machine directory of the live revision to
+    its record (``{"decision": "promoted"|"retained"|"quarantined", ...}``
+    — absent machines are retained); ``candidates`` maps promoted
+    machines to their ``(model, Machine)`` refit output. The two report
+    dicts are written into the staging dir (stamped with the new
+    revision name) before the publishing rename. Returns the published
+    revision directory.
+    """
+    live_dir = Path(live_dir)
+    parent = live_dir.parent
+    revision = new_revision_name(parent)
+    staging = parent / f"{STAGING_PREFIX}{revision}"
+    staging.mkdir(parents=True)
+
+    machine_dirs = sorted(
+        name
+        for name in os.listdir(live_dir)
+        if not name.startswith(".") and os.path.isdir(live_dir / name)
+    )
+    try:
+        n_assembled = 0
+        for name in machine_dirs:
+            record = decisions.get(name) or {}
+            if record.get("decision") == "promoted":
+                from gordo_tpu.builder.build_model import ModelBuilder
+
+                model, machine = candidates[name]
+                ModelBuilder._save_model(
+                    model=model, machine=machine, output_dir=staging / name
+                )
+            else:
+                _link_or_copy_tree(live_dir / name, staging / name)
+            n_assembled += 1
+            faults.inject_promotion_tear(n_assembled)
+
+        build_report = dict(build_report)
+        build_report.setdefault("version", 1)
+        build_report["revision"] = revision
+        _write_json(staging / BUILD_REPORT_FILENAME, build_report)
+        promotion_report = dict(promotion_report)
+        promotion_report.setdefault("version", 1)
+        promotion_report["revision"] = revision
+        _write_json(staging / PROMOTION_REPORT_FILENAME, promotion_report)
+    except Exception as exc:
+        # the staging dir stays — it is the forensic record of the tear,
+        # and being dot-prefixed it can never be served or listed.
+        # KeyboardInterrupt/SystemExit pass through UNWRAPPED (the watch
+        # daemon's survive-a-failed-cycle handler must not swallow an
+        # operator's Ctrl-C as an ordinary torn cycle); the staging dir
+        # they abandon is equally inert
+        raise TornPromotion(
+            f"Revision assembly for {revision} died after {n_assembled} "
+            f"machine(s): {exc!r} (staging left at {staging})",
+            staging_dir=str(staging),
+        ) from exc
+
+    final = parent / revision
+    os.rename(staging, final)  # the publication point: atomic
+    logger.info(
+        "Published revision %s (%d machines) next to %s",
+        revision, len(machine_dirs), live_dir.name,
+    )
+    return final
+
+
+def repoint_latest(
+    pointer: typing.Union[str, os.PathLike],
+    target_dir: typing.Union[str, os.PathLike],
+) -> None:
+    """
+    Atomically re-point the ``latest`` symlink at ``target_dir``
+    (symlink-swap + ``rename``; readers see old or new, never neither).
+    Refuses a pointer that exists as a REAL directory — flipping would
+    require deleting served artifacts, and such deployments roll by
+    re-deploying ``MODEL_COLLECTION_DIR`` instead.
+    """
+    pointer = os.path.abspath(str(pointer))
+    if os.path.lexists(pointer) and not os.path.islink(pointer):
+        raise ValueError(
+            f"{pointer} is a real directory, not a latest symlink; "
+            "cannot re-point it (serve the new revision via ?revision= "
+            "or redeploy MODEL_COLLECTION_DIR)"
+        )
+    target_dir = os.path.abspath(str(target_dir))
+    if os.path.dirname(pointer) == os.path.dirname(target_dir):
+        # relative target: the whole collection tree stays relocatable
+        target: str = os.path.basename(target_dir)
+    else:
+        target = target_dir
+    tmp = os.path.join(
+        os.path.dirname(pointer), f".latest-tmp-{os.getpid()}"
+    )
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    os.symlink(target, tmp)
+    os.replace(tmp, pointer)
+
+
+def read_promotion_report(
+    revision_dir: typing.Union[str, os.PathLike]
+) -> typing.Optional[dict]:
+    """The revision's ``promotion_report.json``, or None (a revision
+    produced by a plain build has no promotion trail)."""
+    path = os.path.join(str(revision_dir), PROMOTION_REPORT_FILENAME)
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except FileNotFoundError:
+        return None
+    except (OSError, ValueError):
+        logger.warning("Unreadable promotion report at %s", path)
+        return None

@@ -200,6 +200,7 @@ class FleetTrainer:
         broadcast_data: bool = False,
         epoch_chunk: int = 1,
         quarantine_nonfinite: bool = True,
+        fault_sites: Tuple[str, ...] = ("train",),
     ):
         self.spec = spec
         self.lookahead = int(lookahead) if spec.windowed else 0
@@ -209,6 +210,10 @@ class FleetTrainer:
         self.broadcast_data = broadcast_data
         self.epoch_chunk = max(1, int(epoch_chunk))
         self.quarantine_nonfinite = bool(quarantine_nonfinite)
+        #: GORDO_FAULT_INJECT sites whose nan-mode specs poison this
+        #: trainer's fits ("train" everywhere; lifecycle warm-start
+        #: refits add "refit" so refit:nan targets refit builds only)
+        self.fault_sites = tuple(fault_sites)
         self._optimizer = optimizer if optimizer is not None else spec.make_optimizer()
         self._epoch_fn_cache: dict = {}
         self._predict_fn_cache: dict = {}
@@ -1013,7 +1018,7 @@ class FleetTrainer:
         # the train:nan fault seam, resolved ONCE per fit: None unless a
         # matching GORDO_FAULT_INJECT spec targets this fleet (and then
         # an ((M,) mask, epoch) pair baked into a distinct program)
-        inj = _faults.train_nan_injection(machine_names, m)
+        inj = _faults.train_nan_injection(machine_names, m, sites=self.fault_sites)
         healthy_np = np.ones(m, dtype=bool)
         self.healthy_: Optional[np.ndarray] = None
         self.quarantine_epoch_: Optional[np.ndarray] = None

@@ -13,12 +13,19 @@ One spec is ``site:mode[:target][@key:value ...]``:
   ``batch`` (the dynamic-batching drainer's per-request seam: fires
   mid-batch for the request naming the target machine, failing ONLY
   that request's future — the no-poisoned-batch exercise,
-  server/batching.py).
+  server/batching.py), and the lifecycle seams (docs/lifecycle.md):
+  ``drift`` (the lifecycle drift-scoring fetch), ``refit`` (the
+  warm-start refit build) and ``promote`` (revision assembly).
 - ``mode`` — what happens there: ``raise`` (the seam raises
-  :class:`InjectedFault`), ``nan`` (train only: the named machine's
+  :class:`InjectedFault`), ``nan`` (train/refit: the named machine's
   epoch loss goes NaN at ``@epoch:<e>``, driving the quarantine guard),
-  ``torn`` (ckpt only: the just-committed checkpoint's files are
-  truncated, simulating a torn write).
+  ``torn`` (ckpt: the just-committed checkpoint's files are truncated,
+  simulating a torn write; promote: revision assembly dies mid-copy,
+  leaving a dot-prefixed staging dir that never becomes ``latest``),
+  ``shift`` (drift only: the named machine's fetched inputs and targets
+  are offset by ``@scale:<s>``, simulating sensor drift), ``degrade`` (refit only:
+  the named machine's refit candidate params are perturbed before
+  shadow scoring, exercising the promotion gate).
 - ``target`` — a machine name (or a bare fleet index when the seam has
   no names); omitted = any machine at that site.
 - ``@key:value`` — per-spec parameters: ``@epoch:2`` (train), and
@@ -44,7 +51,9 @@ logger = logging.getLogger(__name__)
 
 FAULT_INJECT_ENV_VAR = "GORDO_FAULT_INJECT"
 
-_KNOWN_SITES = frozenset({"fetch", "train", "ckpt", "serve", "batch"})
+_KNOWN_SITES = frozenset(
+    {"fetch", "train", "ckpt", "serve", "batch", "drift", "refit", "promote"}
+)
 
 
 class InjectedFault(RuntimeError):
@@ -208,7 +217,9 @@ def inject(site: str, name: typing.Optional[str] = None, **fields) -> None:
 
 
 def train_nan_injection(
-    machine_names: typing.Optional[typing.Sequence[str]], n_machines: int
+    machine_names: typing.Optional[typing.Sequence[str]],
+    n_machines: int,
+    sites: typing.Tuple[str, ...] = ("train",),
 ) -> typing.Optional[typing.Tuple["np.ndarray", int]]:
     """
     The training-step seam, resolved ONCE per fit on host: a matching
@@ -219,14 +230,18 @@ def train_nan_injection(
     program is byte-identical to one built with injection off.
 
     ``machine_names`` maps targets to fleet indices; with no names, a
-    bare-integer target addresses the fleet index directly.
+    bare-integer target addresses the fleet index directly. ``sites``
+    names which spec sites this fit listens to: ordinary fits consume
+    ``train:nan`` only, while lifecycle warm-start refits pass
+    ``("train", "refit")`` so ``refit:nan:<machine>`` poisons refit
+    builds without touching unrelated training (docs/lifecycle.md).
     """
     import numpy as np
 
     registry = active_registry()
     if registry is None:
         return None
-    specs = [s for s in registry.specs if s.site == "train" and s.mode == "nan"]
+    specs = [s for s in registry.specs if s.site in sites and s.mode == "nan"]
     if not specs:
         return None
     mask = np.zeros(n_machines, dtype=bool)
@@ -258,6 +273,92 @@ def train_nan_injection(
         epoch=epoch,
     )
     return mask, epoch
+
+
+def _find_mode(
+    registry: FaultRegistry,
+    site: str,
+    mode: str,
+    name: typing.Optional[str],
+) -> typing.Optional[FaultSpec]:
+    """Mode-aware sibling of ``FaultRegistry.find`` — lifecycle sites
+    host several modes (``refit:nan`` + ``refit:degrade``), so matching
+    on site+target alone could shadow one behind the other."""
+    for spec in registry.specs:
+        if spec.site == site and spec.mode == mode and spec.matches_target(name):
+            return spec
+    return None
+
+
+def _scale_for(
+    site: str, mode: str, name: typing.Optional[str], default: float
+) -> typing.Optional[float]:
+    """Shared body of the two ``@scale`` seams: the matching spec's
+    scale (fired and validated), or None when nothing matches."""
+    registry = active_registry()
+    if registry is None:
+        return None
+    spec = _find_mode(registry, site, mode, name)
+    if spec is None:
+        return None
+    try:
+        scale = float(spec.params.get("scale", default))
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"Fault spec parameter @scale must be a number, got "
+            f"{spec.params.get('scale')!r}"
+        )
+    registry.fire(spec, machine=name, scale=scale)
+    return scale
+
+
+def drift_shift_scale(name: typing.Optional[str]) -> typing.Optional[float]:
+    """
+    The lifecycle drift-scoring seam: a matching ``drift:shift`` spec
+    returns the ``@scale`` (default 5.0) by which the named machine's
+    fetched inputs and targets are offset before anomaly scoring — the chaos
+    harness's way of making exactly the targeted machines look drifted
+    (docs/lifecycle.md). None = no shift, and the scoring path is
+    untouched.
+    """
+    return _scale_for("drift", "shift", name, 5.0)
+
+
+def refit_degrade_scale(name: typing.Optional[str]) -> typing.Optional[float]:
+    """
+    The shadow-gate seam: a matching ``refit:degrade`` spec returns the
+    ``@scale`` (default 10.0) by which the named machine's refit
+    candidate params are multiplied before shadow scoring — a
+    deliberately-degraded candidate the promotion gate must reject
+    (docs/lifecycle.md). None = candidate untouched.
+    """
+    return _scale_for("refit", "degrade", name, 10.0)
+
+
+def inject_promotion_tear(n_assembled: int) -> None:
+    """
+    The revision-assembly seam: when a ``promote:torn`` spec fires, the
+    promoter dies mid-copy (raises :class:`InjectedFault`), leaving its
+    dot-prefixed staging directory partial — the crash shape the atomic
+    rename protocol must survive: a torn promotion never becomes
+    ``latest`` and never appears in ``/revisions`` (docs/lifecycle.md).
+    ``@attempts:N`` limits the tear to the first N promotions, so a
+    retried promotion succeeds.
+    """
+    registry = active_registry()
+    if registry is None:
+        return
+    spec = _find_mode(registry, "promote", "torn", None)
+    if spec is None:
+        return
+    attempts = spec.param_int("attempts", 0)
+    if attempts and spec.fires >= attempts:
+        return
+    count = registry.fire(spec, n_assembled=n_assembled)
+    raise InjectedFault(
+        f"Injected fault at site 'promote': revision assembly torn after "
+        f"{n_assembled} machine(s) (firing {count})"
+    )
 
 
 def tear_checkpoint_files(step_dir: typing.Union[str, os.PathLike]) -> bool:

@@ -45,7 +45,7 @@ from werkzeug.wrappers import Request, Response
 from gordo_tpu import __version__, serializer
 from gordo_tpu.data.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
-from gordo_tpu.observability import get_registry, tracing
+from gordo_tpu.observability import emit_event, get_registry, tracing
 from gordo_tpu.robustness import faults
 from gordo_tpu.server import batching, model_io
 from gordo_tpu.server import utils as server_utils
@@ -218,6 +218,12 @@ class GordoApp:
         # serving source of truth (which machines to 409)
         self._build_reports: typing.Dict[str, tuple] = {}
         self._build_reports_lock = threading.Lock()
+        # hot promotion (docs/lifecycle.md): the real path last served as
+        # "latest". When MODEL_COLLECTION_DIR is a `latest` symlink and a
+        # lifecycle promotion re-points it, the first request after the
+        # flip observes the change here and rolls the stale batchers.
+        self._served_latest: typing.Optional[str] = None
+        self._served_latest_lock = threading.Lock()
         self.prometheus_metrics = None
         if self.config.get("ENABLE_PROMETHEUS"):
             from gordo_tpu.server.prometheus.metrics import (
@@ -333,22 +339,111 @@ class GordoApp:
     def _resolve_revision(
         self, ctx: RequestContext, request: Request
     ) -> typing.Optional[Response]:
-        """Reference: server/server.py:164-186."""
-        ctx.collection_dir = os.environ[self.config["MODEL_COLLECTION_DIR_ENV_VAR"]]
+        """Reference: server/server.py:164-186.
+
+        Hot promotion extension (docs/lifecycle.md): the env var may name
+        a ``latest`` SYMLINK into the sibling-revision directory. It is
+        resolved per request, so an atomic re-point by
+        ``gordo-tpu lifecycle tick`` rolls serving to the new revision —
+        model/scorer/batcher cache keys all derive from the resolved
+        path — without a restart. For a plain directory (the reference
+        deployment shape) the one ``islink`` stat is the only addition
+        and the served paths are byte-identical to before.
+        """
+        pointer = os.environ[self.config["MODEL_COLLECTION_DIR_ENV_VAR"]]
+        ctx.collection_dir = pointer
+        # islink on a trailing-slash path stats the link's TARGET, so a
+        # `latest/`-style env value would silently disable hot roll and
+        # split-brain the path-keyed caches; strip for the check only —
+        # the plain-dir path must keep serving the env value verbatim
+        if os.path.islink(pointer.rstrip(os.sep) or os.sep):
+            ctx.collection_dir = os.path.realpath(pointer)
+            self._note_revision_roll(pointer, ctx.collection_dir)
         ctx.current_revision = os.path.basename(ctx.collection_dir)
         requested = request.args.get("revision") or request.headers.get("revision")
         if requested:
+            # dot entries are NOT revisions: in-flight/torn promotion
+            # staging dirs and lifecycle state live there, and serving a
+            # half-copied staging dir would break the torn-promotion
+            # invariant (lifecycle/promote.py). Same 410 as a gone
+            # revision — the name is never servable. "." and ".." would
+            # otherwise alias the live revision / the parent itself.
+            if requested.startswith(".") or "/" in requested or "\\" in requested:
+                return _json_response(
+                    {"error": f"Revision '{requested}' not found."}, 410
+                )
             ctx.revision = requested
             ctx.collection_dir = os.path.join(ctx.collection_dir, "..", requested)
+            # a symlink sibling (the `latest` pointer) is an ALIAS, not
+            # a revision: serving it would key the model caches on the
+            # constant alias path, so routes would keep serving the old
+            # target after a promotion re-points it while stamping a
+            # meaningless "latest" revision header
+            if os.path.islink(ctx.collection_dir):
+                return _json_response(
+                    {"error": f"Revision '{requested}' not found."}, 410
+                )
             try:
                 os.listdir(ctx.collection_dir)
-            except FileNotFoundError:
+            except (FileNotFoundError, NotADirectoryError):
+                # NotADirectoryError: a loose sibling FILE (a report)
+                # named as ?revision= is no more a revision than a
+                # missing name is
                 return _json_response(
                     {"error": f"Revision '{requested}' not found."}, 410
                 )
         else:
             ctx.revision = ctx.current_revision
         return None
+
+    def _note_revision_roll(self, pointer: str, latest_real: str) -> None:
+        """
+        The hot-promotion notice (docs/lifecycle.md): called with the
+        resolved ``latest`` target on every symlink-served request. On
+        the first request after a promotion re-points the link, emit
+        ``revision_rolled``, count it, and stop the batchers still
+        keyed to other revisions — their drainer threads would otherwise
+        idle until LRU eviction (scorer/model caches need no action:
+        their keys carry the resolved path, so the new revision builds
+        fresh entries and the old ones age out; an explicit
+        ``?revision=`` request can still rebuild either lazily).
+        """
+        with self._served_latest_lock:
+            previous = self._served_latest
+            if previous == latest_real:
+                return
+            # a thread that resolved the link BEFORE a flip may get here
+            # AFTER a peer noted the new target; re-reading the link
+            # under the lock means served state only ever moves forward
+            # to the link's current target — a stale observation is
+            # dropped instead of rolling state backwards (and stopping
+            # the new revision's batchers)
+            if previous is not None and os.path.realpath(pointer) != latest_real:
+                return
+            self._served_latest = latest_real
+        if previous is None:
+            return  # first request of the process: nothing rolled
+        stale: typing.List[batching.RequestBatcher] = []
+        with self._batchers_lock:
+            for key in [k for k in self._batchers if k[0] != latest_real]:
+                stale.append(self._batchers.pop(key))
+        for batcher in stale:
+            batcher.stop()
+        get_registry().counter(
+            "gordo_server_revision_rolls_total",
+            "Hot promotions observed by this server (latest symlink flips)",
+        ).inc()
+        emit_event(
+            "revision_rolled",
+            previous=os.path.basename(previous),
+            current=os.path.basename(latest_real),
+            n_batchers_stopped=len(stale),
+        )
+        logger.info(
+            "Revision rolled: now serving %s as latest (was %s); "
+            "%d stale batcher(s) stopped",
+            latest_real, previous, len(stale),
+        )
 
     def _finalize(
         self,
@@ -634,7 +729,20 @@ class GordoApp:
 
     def view_revisions(self, ctx, request, gordo_project: str) -> Response:
         try:
-            available = os.listdir(os.path.join(ctx.collection_dir, ".."))
+            # revisions are sibling REAL directories: dot-prefixed
+            # entries are in-flight promotion staging dirs (lifecycle
+            # state lives in dot dirs too), loose files (reports) are
+            # not revisions, and a symlink (the `latest` pointer living
+            # next to the revisions it points into) is an alias of one —
+            # none may be advertised as selectable
+            parent = os.path.join(ctx.collection_dir, "..")
+            available = [
+                name
+                for name in os.listdir(parent)
+                if not name.startswith(".")
+                and os.path.isdir(os.path.join(parent, name))
+                and not os.path.islink(os.path.join(parent, name))
+            ]
         except FileNotFoundError:
             logger.error(
                 "Attempted to list directories above %s but failed with: %s",

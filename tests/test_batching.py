@@ -726,7 +726,7 @@ def test_client_honors_retry_after_on_shed(monkeypatch):
     client = Client(
         project="proj", host="h", session=FakeSession(), n_retries=3
     )
-    status, resp = client._post_fleet_chunk(
+    status, resp, _ = client._post_fleet_chunk(
         "http://h/gordo/v0/proj/prediction/fleet", {"m": {}}, "rev"
     )
     assert status == "ok"

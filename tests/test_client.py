@@ -384,3 +384,38 @@ def test_influx_forwarder_sensor_data():
 def test_prediction_result_namedtuple():
     pr = PredictionResult("m", None, ["err"])
     assert pr.name == "m" and pr.predictions is None and pr.error_messages == ["err"]
+    # the historical 3-tuple shape is preserved exactly...
+    name, predictions, errors = pr
+    assert (name, predictions, errors) == ("m", None, ["err"])
+    assert pr == ("m", None, ["err"]) and pr[0] == "m"
+    # ...and the served revision rides OUTSIDE it
+    assert pr.revision is None
+    assert PredictionResult("m", None, [], revision="123").revision == "123"
+    # pickle/copy round-trip like the namedtuple did, revision included
+    import copy
+    import pickle
+
+    restored = pickle.loads(pickle.dumps(PredictionResult("m", None, ["e"], "7")))
+    assert restored == ("m", None, ["e"]) and restored.revision == "7"
+    assert copy.copy(restored).revision == "7"
+
+
+def test_predict_surfaces_served_revision(client):
+    """The server stamps every response with the revision it served;
+    the client must hand it to the caller (PredictionResult.revision) —
+    the lifecycle drift monitor refuses frames it cannot attribute to
+    one revision (docs/lifecycle.md)."""
+    results = client.predict(START, END, targets=GORDO_TARGETS)
+    (result,) = results
+    name, frame, errors = result  # unchanged unpacking contract
+    assert not errors and len(frame)
+    assert result.revision == GORDO_REVISION
+
+    fleet_results = client.predict_fleet(
+        START, END, targets=GORDO_TARGETS + GORDO_BASE_TARGETS
+    )
+    assert {r.name for r in fleet_results} == set(
+        GORDO_TARGETS + GORDO_BASE_TARGETS
+    )
+    for result in fleet_results:
+        assert result.revision == GORDO_REVISION, result.name

@@ -1,0 +1,831 @@
+"""
+Lifecycle suite (docs/lifecycle.md): drift detection, warm-start refit,
+shadow gating, blue/green promotion — unit tests per piece, the chaos
+paths (``drift:shift``, ``refit:nan``, ``refit:degrade``,
+``promote:torn``), and the end-to-end acceptance scenario: inject drift
+into k of N machines, one ``tick`` refits exactly those k, the shadow
+gate rejects the deliberately-degraded candidate, and the promoted
+revision serves winners / retains the rest bit-identically / 409s the
+quarantined one with the whole decision trail in
+``promotion_report.json``.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from gordo_tpu.lifecycle import (
+    DriftMonitor,
+    LifecycleConfig,
+    LifecycleManager,
+    TornPromotion,
+    assemble_revision,
+    read_promotion_report,
+    repoint_latest,
+    shadow_gate,
+    shadow_score,
+    total_anomaly_series,
+)
+from gordo_tpu.machine import Machine
+from gordo_tpu.robustness import InjectedFault, faults
+
+SENSORS = [f"tag-{i}" for i in range(3)]
+NAMES = [f"lc-m-{i}" for i in range(4)]
+BASE_REVISION = "1700000000000"
+WINDOW_START = "2019-01-01T00:00:00+00:00"
+WINDOW_END = "2019-01-02T00:00:00+00:00"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_INJECT_ENV_VAR, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def make_lc_machine(name):
+    """An anomaly machine (the drift-monitorable shape: DiffBased with
+    calibrated thresholds) over one day of RandomDataset."""
+    return Machine(
+        name=name,
+        project_name="lifecycle-test",
+        model={
+            "gordo_tpu.models.anomaly.DiffBasedAnomalyDetector": {
+                "base_estimator": {
+                    "sklearn.pipeline.Pipeline": {
+                        "steps": [
+                            "sklearn.preprocessing.MinMaxScaler",
+                            {
+                                "gordo_tpu.models.AutoEncoder": {
+                                    "kind": "feedforward_hourglass",
+                                    "epochs": 2,
+                                    "batch_size": 16,
+                                }
+                            },
+                        ]
+                    }
+                }
+            }
+        },
+        dataset={
+            "type": "RandomDataset",
+            "train_start_date": WINDOW_START,
+            "train_end_date": WINDOW_END,
+            "tags": SENSORS,
+            "target_tag_list": SENSORS,
+            "asset": "gra",
+        },
+    )
+
+
+@pytest.fixture(scope="module")
+def lifecycle_template(tmp_path_factory):
+    """The 4-machine fleet built ONCE per module; tests copy the tree
+    (promotions mutate it) instead of paying a build each."""
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder
+
+    root = tmp_path_factory.mktemp("lifecycle-template")
+    models = root / "models"
+    FleetModelBuilder(
+        [make_lc_machine(n) for n in NAMES], fetch_backoff=lambda a: 0.0
+    ).build(output_dir_base=models / BASE_REVISION)
+    os.symlink(BASE_REVISION, models / "latest")
+    return models
+
+
+@pytest.fixture
+def collection(lifecycle_template, tmp_path):
+    """A private copy of the template tree (latest symlink included)."""
+    models = tmp_path / "models"
+    shutil.copytree(lifecycle_template, models, symlinks=True)
+    return models
+
+
+def _manager(models, **overrides):
+    config = LifecycleConfig(**overrides)
+    return LifecycleManager(str(models / "latest"), config=config)
+
+
+def _revisions(models):
+    return sorted(
+        n
+        for n in os.listdir(models)
+        if not n.startswith(".") and os.path.isdir(models / n) and n != "latest"
+    )
+
+
+# -- DriftMonitor --------------------------------------------------------
+
+
+def _ratio_frame(values):
+    frame = pd.DataFrame({"x": np.asarray(values, dtype=float)})
+    frame.columns = pd.MultiIndex.from_tuples([("total-anomaly-scaled", "")])
+    return frame
+
+
+def test_drift_monitor_thresholds_and_ewma():
+    monitor = DriftMonitor(ewma_alpha=0.5, ratio_threshold=1.0,
+                           exceedance_threshold=0.9)
+    # threshold 10, anomalies ~5: ratio 0.5, no drift
+    a = monitor.observe("m", _ratio_frame([5.0] * 8), threshold=10.0)
+    assert not a.drifted and a.ratio == pytest.approx(0.5)
+    # one hot window: EWMA mean of 0.5 and 3.0 = 1.75 -> drift
+    a = monitor.observe("m", _ratio_frame([30.0] * 8), threshold=10.0)
+    assert a.ewma_ratio == pytest.approx(1.75)
+    assert a.drifted and monitor.drifted() == ["m"]
+    # cooling back down clears the flag (EWMA decays)
+    for _ in range(6):
+        a = monitor.observe("m", _ratio_frame([1.0] * 8), threshold=10.0)
+    assert not a.drifted and monitor.drifted() == []
+
+
+def test_drift_monitor_exceedance_criterion():
+    monitor = DriftMonitor(
+        ewma_alpha=1.0, ratio_threshold=100.0, exceedance_threshold=0.5
+    )
+    # mean ratio stays tiny but 60% of timesteps cross the threshold
+    values = [11.0] * 6 + [0.1] * 4
+    a = monitor.observe("m", _ratio_frame(values), threshold=10.0)
+    assert a.exceedance == pytest.approx(0.6)
+    assert a.drifted
+
+
+def test_drift_monitor_min_observations_guard():
+    monitor = DriftMonitor(ewma_alpha=1.0, min_observations=3)
+    for i in range(3):
+        a = monitor.observe("m", _ratio_frame([50.0] * 4), threshold=1.0)
+        assert a.drifted == (i >= 2)  # only the 3rd observation may flag
+
+
+def test_drift_monitor_revision_mismatch_resets_state():
+    """Statistics from a different revision are not comparable: the
+    machine restarts its baseline instead of inheriting a stale one."""
+    monitor = DriftMonitor(ewma_alpha=0.5, min_observations=2)
+    monitor.observe("m", _ratio_frame([50.0] * 4), threshold=1.0, revision="r1")
+    a = monitor.observe(
+        "m", _ratio_frame([50.0] * 4), threshold=1.0, revision="r2"
+    )
+    assert a.n_observations == 1  # r1's observation did not carry over
+    assert not a.drifted
+
+
+def test_drift_monitor_emits_event_on_transition(monkeypatch, tmp_path):
+    from gordo_tpu.observability import read_events
+
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("GORDO_TPU_EVENT_LOG", str(log))
+    monitor = DriftMonitor(ewma_alpha=1.0)
+    monitor.observe("m", _ratio_frame([50.0] * 4), threshold=1.0, revision="r")
+    monitor.observe("m", _ratio_frame([50.0] * 4), threshold=1.0, revision="r")
+    drift_events = [
+        e for e in read_events(str(log)) if e["event"] == "machine_drifted"
+    ]
+    # transition into drift, not every drifted observation
+    assert len(drift_events) == 1
+    assert drift_events[0]["machine"] == "m"
+    assert drift_events[0]["revision"] == "r"
+
+
+def test_drift_monitor_persistence_roundtrip(tmp_path):
+    path = tmp_path / "state" / "drift.json"
+    monitor = DriftMonitor(state_path=path, ewma_alpha=1.0)
+    monitor.observe("m", _ratio_frame([50.0] * 4), threshold=1.0, revision="r")
+    monitor.save()
+    reloaded = DriftMonitor(state_path=path, ewma_alpha=1.0)
+    assert reloaded.drifted() == ["m"]
+    state = reloaded.state("m")
+    assert state.revision == "r" and state.n_observations == 1
+
+
+def test_drift_monitor_corrupt_state_starts_fresh(tmp_path):
+    path = tmp_path / "drift.json"
+    path.write_text("{not json")
+    monitor = DriftMonitor(state_path=path)
+    assert monitor.drifted() == []
+
+
+def test_drift_monitor_rejects_unusable_threshold():
+    monitor = DriftMonitor()
+    with pytest.raises(ValueError, match="threshold"):
+        monitor.observe("m", _ratio_frame([1.0]), threshold=None)
+    with pytest.raises(ValueError, match="threshold"):
+        monitor.observe("m", _ratio_frame([1.0]), threshold=float("nan"))
+    with pytest.raises(ValueError, match="finite"):
+        monitor.observe_ratio("m", np.array([np.nan, np.inf]))
+
+
+def test_total_anomaly_series_both_frame_shapes():
+    # MultiIndex (straight from DiffBasedAnomalyDetector.anomaly)
+    assert total_anomaly_series(_ratio_frame([1.0, 2.0])).tolist() == [1.0, 2.0]
+    # flat (a server response parsed by dataframe_from_dict)
+    flat = pd.DataFrame({"total-anomaly-scaled": [3.0, 4.0]})
+    assert total_anomaly_series(flat).tolist() == [3.0, 4.0]
+    with pytest.raises(KeyError, match="total-anomaly"):
+        total_anomaly_series(pd.DataFrame({"other": [1.0]}))
+
+
+# -- shadow scoring ------------------------------------------------------
+
+
+class _OffsetModel:
+    """Stub whose output is `bias`-shifted targets, `offset` rows short
+    (the windowed-model shape shadow_score must align)."""
+
+    def __init__(self, y, offset=0, bias=0.0):
+        self._y = np.asarray(y, dtype=float)
+        self.offset = offset
+        self.bias = bias
+
+    def predict(self, X):
+        return self._y[self.offset:] + self.bias
+
+
+def test_shadow_score_aligns_output_offset():
+    y = np.arange(20, dtype=float).reshape(10, 2)
+    assert shadow_score(_OffsetModel(y, offset=3), None, y) == 0.0
+    assert shadow_score(_OffsetModel(y, offset=3, bias=2.0), None, y) == 2.0
+    with pytest.raises(ValueError, match="longer"):
+        shadow_score(_OffsetModel(np.vstack([y, y])), None, y)
+
+
+def test_shadow_gate_semantics():
+    assert shadow_gate(1.0, 1.05, tolerance=0.1)  # within tolerance
+    assert not shadow_gate(1.0, 1.2, tolerance=0.1)  # degraded
+    assert shadow_gate(1.0, 0.5, tolerance=0.0)  # improvement
+    assert not shadow_gate(1.0, float("nan"))  # broken candidate never ships
+    assert not shadow_gate(1.0, float("inf"))
+    # incumbent already broken on this window: any finite candidate wins
+    assert shadow_gate(float("nan"), 123.0)
+
+
+# -- warm start ----------------------------------------------------------
+
+
+def _tiny_trees(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"w": rng.random((3, 2)).astype("float32"), "b": rng.random(2)}
+        for _ in range(n)
+    ]
+
+
+def test_stack_warm_params_stacks_and_pads():
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder
+
+    trees = _tiny_trees(2)
+    builder = FleetModelBuilder(
+        [], initial_params={"a": trees[0], "b": trees[1]}
+    )
+    stacked = builder._stack_warm_params(["a", "b"], m_padded=4)
+    assert stacked["w"].shape == (4, 3, 2)
+    np.testing.assert_array_equal(stacked["w"][0], trees[0]["w"])
+    np.testing.assert_array_equal(stacked["w"][1], trees[1]["w"])
+    # padding replicates the first tree (inert: zero sample weight)
+    np.testing.assert_array_equal(stacked["w"][2], trees[0]["w"])
+
+
+def test_stack_warm_params_falls_back_cold():
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder
+
+    trees = _tiny_trees(2)
+    # no initial params at all
+    assert FleetModelBuilder([])._stack_warm_params(["a"], 1) is None
+    # one machine missing -> whole bucket cold
+    builder = FleetModelBuilder([], initial_params={"a": trees[0]})
+    assert builder._stack_warm_params(["a", "b"], 2) is None
+    # mismatched tree structures -> cold, not a crash
+    builder = FleetModelBuilder(
+        [], initial_params={"a": trees[0], "b": {"other": np.zeros(2)}}
+    )
+    assert builder._stack_warm_params(["a", "b"], 2) is None
+
+
+def test_fleet_trainer_warm_start_continues_from_given_params():
+    """fit(params=...) must TRAIN FROM the given params: one warm epoch
+    from a converged state stays near it, while a cold init does not."""
+    from gordo_tpu.models.factories.feedforward import feedforward_hourglass
+    from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
+
+    rng = np.random.default_rng(0)
+    Xs = [rng.random((64, 3)).astype("float32") for _ in range(2)]
+    data = StackedData.from_ragged(Xs, [x.copy() for x in Xs])
+    spec = feedforward_hourglass(n_features=3)
+    trainer = FleetTrainer(spec, donate=False)
+    keys = trainer.machine_keys(2)
+    params0, losses0 = trainer.fit(data, keys, epochs=3, batch_size=16)
+    host0 = trainer.unstack_all(params0, 2)
+
+    # warm continuation: first-epoch loss ~ the converged loss, far
+    # below a cold run's first epoch
+    import jax
+
+    stacked = jax.tree_util.tree_map(
+        lambda *leaves: np.stack([np.asarray(l) for l in leaves]), *host0
+    )
+    _, warm_losses = trainer.fit(
+        data, keys, epochs=1, batch_size=16, params=stacked
+    )
+    assert warm_losses[0].mean() < losses0[0].mean() * 0.9
+
+
+# -- promotion protocol --------------------------------------------------
+
+
+def _fake_revision(tmp_path, machines=("a", "b"), revision="100"):
+    rev = tmp_path / "models" / revision
+    for name in machines:
+        (rev / name).mkdir(parents=True)
+        (rev / name / "model.pkl").write_bytes(b"pickled-" + name.encode())
+        (rev / name / "metadata.json").write_text(json.dumps({"name": name}))
+    return rev
+
+
+def test_assemble_revision_retains_hard_linked(tmp_path):
+    rev = _fake_revision(tmp_path)
+    out = assemble_revision(
+        rev, decisions={}, candidates={}, build_report={}, promotion_report={}
+    )
+    assert out.parent == rev.parent and out.name.isdigit()
+    assert int(out.name) > int(rev.name)
+    for name in ("a", "b"):
+        assert os.path.samefile(
+            rev / name / "model.pkl", out / name / "model.pkl"
+        )
+    report = read_promotion_report(out)
+    assert report["revision"] == out.name
+    build_report = json.loads((out / "build_report.json").read_text())
+    assert build_report["revision"] == out.name
+    # no staging residue
+    assert not [n for n in os.listdir(rev.parent) if n.startswith(".promote-")]
+
+
+def test_assemble_revision_torn_never_publishes(tmp_path, monkeypatch):
+    """promote:torn kills assembly mid-copy: the staging dir stays
+    dot-prefixed (never latest, never listed) and nothing publishes;
+    a retried promotion (@attempts:1 spent) succeeds — even inside the
+    SAME millisecond as the tear (the leftover staging dir occupies its
+    revision number, so the retry stages under a fresh name)."""
+    import time as time_mod
+
+    monkeypatch.setattr(time_mod, "time", lambda: 1_700_000_123.456)
+    rev = _fake_revision(tmp_path)
+    monkeypatch.setenv(faults.FAULT_INJECT_ENV_VAR, "promote:torn@attempts:1")
+    faults.reset()
+    with pytest.raises(TornPromotion) as err:
+        assemble_revision(
+            rev, decisions={}, candidates={}, build_report={},
+            promotion_report={},
+        )
+    assert isinstance(err.value.__cause__, InjectedFault)
+    staging = [n for n in os.listdir(rev.parent) if n.startswith(".promote-")]
+    assert len(staging) == 1  # the forensic record, dot-prefixed
+    assert _revisions_of(rev.parent) == [rev.name]  # nothing published
+
+    # the tear spec is spent: the retry publishes cleanly
+    out = assemble_revision(
+        rev, decisions={}, candidates={}, build_report={}, promotion_report={}
+    )
+    assert out.name in _revisions_of(rev.parent)
+
+
+def _revisions_of(parent):
+    return sorted(
+        n
+        for n in os.listdir(parent)
+        if not n.startswith(".") and os.path.isdir(os.path.join(parent, n))
+    )
+
+
+def test_repoint_latest_flips_atomically(tmp_path):
+    rev1 = _fake_revision(tmp_path, revision="100")
+    rev2 = _fake_revision(tmp_path, revision="200")
+    models = rev1.parent
+    os.symlink("100", models / "latest")
+    repoint_latest(models / "latest", rev2)
+    assert os.readlink(models / "latest") == "200"  # relative: relocatable
+    # refuses to replace a real directory
+    with pytest.raises(ValueError, match="real directory"):
+        repoint_latest(rev1, rev2)
+
+
+# -- the cycle -----------------------------------------------------------
+
+
+def test_tick_without_drift_is_noop(collection, monkeypatch, tmp_path):
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("GORDO_TPU_EVENT_LOG", str(log))
+    before = _revisions(collection)
+    result = _manager(collection).tick()
+    assert result.noop and result.revision is None
+    assert result.monitored == NAMES and result.drifted == []
+    assert _revisions(collection) == before  # no revision created
+    assert os.readlink(collection / "latest") == BASE_REVISION
+    # drift state persisted under a dot dir (never a listable revision)
+    assert (collection / ".lifecycle" / "drift_state.json").is_file()
+    from gordo_tpu.observability import read_events
+
+    finishes = [
+        e for e in read_events(str(log))
+        if e["event"] == "lifecycle_tick_finished"
+    ]
+    assert finishes and finishes[-1]["n_drifted"] == 0
+    assert finishes[-1]["revision"] is None
+
+
+def test_e2e_drift_refit_shadow_promote(collection, monkeypatch, tmp_path):
+    """THE acceptance scenario: 3 of 4 machines drift; the tick refits
+    exactly those 3 warm-started; the deliberately-degraded candidate is
+    shadow-rejected; the refit-poisoned one quarantines; the new
+    revision serves the promoted machine, retains the rest
+    bit-identically, 409s the quarantined one, and promotion_report.json
+    records every decision."""
+    from gordo_tpu import serializer
+    from gordo_tpu.builder.fleet_build import _find_jax_estimator
+    from gordo_tpu.observability import read_events
+
+    log = tmp_path / "events.jsonl"
+    span_log = tmp_path / "spans.jsonl"
+    monkeypatch.setenv("GORDO_TPU_EVENT_LOG", str(log))
+    monkeypatch.setenv("GORDO_TPU_TRACE_LOG", str(span_log))
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR,
+        "drift:shift:lc-m-1;drift:shift:lc-m-2;drift:shift:lc-m-3;"
+        "refit:degrade:lc-m-2;refit:nan:lc-m-3@epoch:0",
+    )
+    faults.reset()
+
+    result = _manager(collection).tick()
+    assert result.drifted == ["lc-m-1", "lc-m-2", "lc-m-3"]
+    assert result.promoted == ["lc-m-1"]
+    assert result.rejected == ["lc-m-2"]
+    assert result.quarantined == ["lc-m-3"]
+    assert result.revision is not None and not result.noop
+
+    # blue/green: the base revision is untouched, the new one is a
+    # sibling, and latest now points at it
+    assert _revisions(collection) == sorted([BASE_REVISION, result.revision])
+    new_rev = collection / result.revision
+    assert os.readlink(collection / "latest") == result.revision
+
+    # promoted machine: genuinely new params; the rest bit-identical
+    # (hard links) to the base revision
+    old_est = _find_jax_estimator(
+        serializer.load(collection / BASE_REVISION / "lc-m-1")
+    )
+    new_est = _find_jax_estimator(serializer.load(new_rev / "lc-m-1"))
+    assert any(
+        not np.array_equal(np.asarray(a), np.asarray(b))
+        for a, b in zip(
+            _leaves(old_est.params_), _leaves(new_est.params_)
+        )
+    )
+    for name in ("lc-m-0", "lc-m-2"):
+        assert os.path.samefile(
+            collection / BASE_REVISION / name / "model.pkl",
+            new_rev / name / "model.pkl",
+        )
+
+    # decision trail: every machine, with drift/shadow/quarantine detail
+    report = read_promotion_report(new_rev)
+    decisions = report["decisions"]
+    assert decisions["lc-m-0"] == {
+        "decision": "retained", "reason": "no_drift",
+        "drift": decisions["lc-m-0"]["drift"],
+    }
+    assert decisions["lc-m-1"]["decision"] == "promoted"
+    assert decisions["lc-m-1"]["shadow"]["promote"] is True
+    assert decisions["lc-m-2"]["reason"] == "shadow_rejected"
+    assert decisions["lc-m-2"]["shadow"]["candidate_score"] > (
+        decisions["lc-m-2"]["shadow"]["live_score"]
+    )
+    assert decisions["lc-m-3"] == {
+        "decision": "quarantined", "reason": "refit_nonfinite",
+        "drift": decisions["lc-m-3"]["drift"],
+        "quarantine": {"machine": "lc-m-3", "epoch": 0},
+    }
+    assert report["counts"] == {"promoted": 1, "retained": 2, "quarantined": 1}
+
+    # the new revision's build_report 409s the quarantined machine
+    build_report = json.loads((new_rev / "build_report.json").read_text())
+    assert [q["machine"] for q in build_report["quarantined"]] == ["lc-m-3"]
+
+    # serving rolls to the new revision through the latest symlink:
+    # /models lists the survivors, the quarantined machine 409s, the
+    # promoted machine predicts
+    from werkzeug.test import Client as WerkzeugClient
+
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(collection / "latest"))
+    server_utils.clear_caches()
+    http = WerkzeugClient(build_app())
+    resp = http.get("/gordo/v0/lifecycle-test/models")
+    body = json.loads(resp.get_data())
+    assert body["revision"] == result.revision
+    assert set(body["models"]) == {"lc-m-0", "lc-m-1", "lc-m-2"}
+    assert body["unavailable"]["lc-m-3"]["reason"] == "quarantined"
+    resp = http.post(
+        "/gordo/v0/lifecycle-test/lc-m-3/anomaly/prediction", json={}
+    )
+    assert resp.status_code == 409
+
+    # event log: the full story, in order of occurrence
+    events = read_events(str(log))
+    kinds = [e["event"] for e in events]
+    assert {"machine_drifted", "refit_rejected", "revision_promoted",
+            "lifecycle_tick_finished"} <= set(kinds)
+    drifted_machines = {
+        e["machine"] for e in events if e["event"] == "machine_drifted"
+    }
+    assert drifted_machines == {"lc-m-1", "lc-m-2", "lc-m-3"}
+    promoted_event = [e for e in events if e["event"] == "revision_promoted"][-1]
+    assert promoted_event["revision"] == result.revision
+    assert promoted_event["base_revision"] == BASE_REVISION
+
+    # one promotion is ONE trace: every lifecycle phase span — and the
+    # refit's nested build.fleet tree — carries the tick's trace id, and
+    # the lifecycle events are stamped with it
+    spans = [
+        json.loads(l) for l in span_log.read_text().splitlines() if l.strip()
+    ]
+    by_name = {s["name"] for s in spans}
+    assert {
+        "lifecycle.tick", "lifecycle.drift", "lifecycle.refit",
+        "lifecycle.shadow", "lifecycle.promote", "build.fleet",
+    } <= by_name
+    tick_span = [s for s in spans if s["name"] == "lifecycle.tick"][-1]
+    for name in ("lifecycle.drift", "lifecycle.refit", "lifecycle.shadow",
+                 "lifecycle.promote", "build.fleet"):
+        phase = [s for s in spans if s["name"] == name][-1]
+        assert phase["trace_id"] == tick_span["trace_id"]
+    assert all(
+        e.get("trace_id") == tick_span["trace_id"]
+        for e in events
+        if e["event"] in ("machine_drifted", "revision_promoted")
+    )
+
+
+def _leaves(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def test_tick_refit_failure_retains_machine(collection, monkeypatch):
+    """A drifted machine whose refit FETCH dies keeps serving its old
+    params (retained + recorded), unlike the nan-poisoned machine which
+    quarantines: an IO outage is not evidence against the model."""
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR,
+        "drift:shift:lc-m-1;drift:shift:lc-m-2;fetch:raise:lc-m-2",
+    )
+    faults.reset()
+    result = _manager(collection, fetch_retries=0).tick()
+    assert result.drifted == ["lc-m-1", "lc-m-2"]
+    assert result.promoted == ["lc-m-1"]
+    assert result.quarantined == []
+    report = result.report["decisions"]["lc-m-2"]
+    assert report["decision"] == "retained"
+    assert report["reason"] == "refit_failed"
+    assert "InjectedFault" in report["error"]
+    # the retained machine is NOT a casualty in the new revision
+    new_rev = collection / result.revision
+    build_report = json.loads((new_rev / "build_report.json").read_text())
+    assert build_report["quarantined"] == [] and build_report["failed"] == []
+
+
+def test_drift_scan_failure_isolated_to_machine(collection, monkeypatch):
+    """The drift SCAN is per-machine fault-domained too: one machine's
+    window fetch dying (sensor backend outage) is recorded on that
+    machine and the tick continues — every other machine is scored, the
+    drifted one still promotes, and the monitor state that WAS observed
+    persists."""
+    real_fetch = LifecycleManager._fetch_window
+
+    def flaky_fetch(meta, start, end):
+        if meta["name"] == "lc-m-2":
+            raise IOError("sensor backend down")
+        return real_fetch(meta, start, end)
+
+    monkeypatch.setattr(
+        LifecycleManager, "_fetch_window", staticmethod(flaky_fetch)
+    )
+    monkeypatch.setenv(faults.FAULT_INJECT_ENV_VAR, "drift:shift:lc-m-1")
+    faults.reset()
+    # dry run: a promotion would reset the monitor state this test
+    # wants to inspect
+    result = _manager(collection, promote=False).tick()
+    # the scan failure neither aborted the tick nor spread
+    assert result.monitored == ["lc-m-0", "lc-m-1", "lc-m-3"]
+    assert result.drifted == ["lc-m-1"]
+    assert result.promoted == ["lc-m-1"]
+    record = result.report["decisions"]["lc-m-2"]
+    assert record["decision"] == "retained"
+    assert record["reason"] == "drift_scan_failed"
+    assert "sensor backend down" in record["error"]
+    # the observations made around the failure were saved
+    saved = json.loads(
+        (collection / ".lifecycle" / "drift_state.json").read_text()
+    )
+    assert "lc-m-0" in saved["machines"] and "lc-m-2" not in saved["machines"]
+
+
+def test_tick_no_promote_is_dry_run(collection, monkeypatch):
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR, "drift:shift:lc-m-1"
+    )
+    faults.reset()
+    before = _revisions(collection)
+    result = _manager(collection, promote=False).tick()
+    assert result.drifted == ["lc-m-1"]
+    assert result.revision is None
+    assert _revisions(collection) == before
+    # the verdicts were still computed and reported
+    assert result.report["decisions"]["lc-m-1"]["decision"] in (
+        "promoted", "retained"
+    )
+    assert "shadow" in result.report["decisions"]["lc-m-1"]
+
+
+def test_torn_promotion_tick_leaves_latest_untouched(collection, monkeypatch):
+    """promote:torn at the TICK level: the cycle fails, latest still
+    points at the base revision, /revisions lists no half-revision, and
+    the next tick (tear spent) promotes cleanly."""
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR,
+        "drift:shift:lc-m-1;promote:torn@attempts:1",
+    )
+    faults.reset()
+    with pytest.raises(TornPromotion):
+        _manager(collection).tick()
+    assert os.readlink(collection / "latest") == BASE_REVISION
+    assert _revisions(collection) == [BASE_REVISION]
+    assert [n for n in os.listdir(collection) if n.startswith(".promote-")]
+
+    # the retry (fresh manager, same state dir) succeeds
+    result = _manager(collection).tick()
+    assert result.promoted == ["lc-m-1"]
+    assert os.readlink(collection / "latest") == result.revision
+
+
+@pytest.mark.slow
+def test_watch_multi_cycle_converges(collection, monkeypatch):
+    """Two scheduled cycles through the CLI daemon: cycle 1 promotes the
+    drifted machine, cycle 2 (drift gone: the seam only fires while the
+    env spec stands) is a no-op against the NEW revision — the loop
+    converges instead of promoting forever."""
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import gordo
+
+    monkeypatch.setenv(faults.FAULT_INJECT_ENV_VAR, "drift:shift:lc-m-1")
+    faults.reset()
+    first = _manager(collection).tick()
+    assert first.promoted == ["lc-m-1"]
+
+    monkeypatch.delenv(faults.FAULT_INJECT_ENV_VAR)
+    faults.reset()
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo,
+        [
+            "lifecycle", "watch",
+            "--model-collection-dir", str(collection / "latest"),
+            "--interval-s", "0.01",
+            "--max-cycles", "2",
+            # explicit criteria for this fleet: pure-noise models hover
+            # near ratio 1 by construction (they predict nothing), while
+            # the injected drift scores ~30x threshold — real fleets tune
+            # these to their signal, the test separates cleanly
+            "--ratio-threshold", "2.0",
+            "--exceedance-threshold", "0.9",
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    lines = [json.loads(l) for l in result.stdout.splitlines() if l.strip()]
+    assert [l["cycle"] for l in lines] == [1, 2]
+    assert all(l["noop"] for l in lines)
+    assert all(l["base_revision"] == first.revision for l in lines)
+    assert _revisions(collection) == sorted([BASE_REVISION, first.revision])
+
+
+# -- CLI -----------------------------------------------------------------
+
+
+def test_cli_tick_and_report(collection, monkeypatch):
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import gordo
+
+    monkeypatch.setenv(faults.FAULT_INJECT_ENV_VAR, "drift:shift:lc-m-1")
+    faults.reset()
+    runner = CliRunner()
+    result = runner.invoke(
+        gordo,
+        [
+            "lifecycle", "tick",
+            "--model-collection-dir", str(collection / "latest"),
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    summary = json.loads(result.stdout)
+    assert summary["drifted"] == ["lc-m-1"]
+    assert summary["promoted"] == ["lc-m-1"]
+    assert summary["revision"]
+
+    rendered = runner.invoke(
+        gordo,
+        ["lifecycle", "report", str(collection / summary["revision"])],
+        catch_exceptions=False,
+    )
+    assert rendered.exit_code == 0
+    assert "lc-m-1" in rendered.output and "promoted" in rendered.output
+
+    # a plain (non-promoted) revision has no trail: exit 1, stderr note
+    plain = runner.invoke(
+        gordo,
+        ["lifecycle", "report", str(collection / BASE_REVISION)],
+    )
+    assert plain.exit_code == 1
+
+
+def test_cli_watch_stops_when_revision_not_adopted(collection, monkeypatch):
+    """`watch --no-repoint` (or a plain-dir pointer) publishes a
+    revision the pointer never adopts: the daemon must STOP after that
+    cycle instead of republishing a near-identical sibling from the
+    same stale base every interval forever."""
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.cli import gordo
+
+    monkeypatch.setenv(faults.FAULT_INJECT_ENV_VAR, "drift:shift:lc-m-1")
+    faults.reset()
+    before = _revisions(collection)
+    result = CliRunner().invoke(
+        gordo,
+        [
+            "lifecycle", "watch",
+            "--model-collection-dir", str(collection / "latest"),
+            "--no-repoint",
+            "--interval-s", "0",
+            "--max-cycles", "5",
+        ],
+        catch_exceptions=False,
+    )
+    assert result.exit_code == 0
+    cycles = [json.loads(line) for line in result.stdout.splitlines()]
+    assert len(cycles) == 1  # stopped after the unadopted promotion
+    assert cycles[0]["revision"]
+    # exactly ONE new revision was published, not one per cycle
+    assert len(_revisions(collection)) == len(before) + 1
+    assert os.readlink(collection / "latest") == BASE_REVISION
+
+
+# -- fault-spec grammar extensions ---------------------------------------
+
+
+def test_lifecycle_fault_sites_parse_and_match():
+    specs = faults.parse_spec(
+        "drift:shift:m-1@scale:3;refit:nan:m-2@epoch:1;"
+        "refit:degrade:m-3;promote:torn@attempts:1"
+    )
+    assert [(s.site, s.mode, s.target) for s in specs] == [
+        ("drift", "shift", "m-1"),
+        ("refit", "nan", "m-2"),
+        ("refit", "degrade", "m-3"),
+        ("promote", "torn", None),
+    ]
+
+
+def test_drift_shift_and_degrade_scales(monkeypatch):
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR,
+        "drift:shift:m-1@scale:3;refit:degrade:m-2",
+    )
+    faults.reset()
+    assert faults.drift_shift_scale("m-1") == 3.0
+    assert faults.drift_shift_scale("m-2") is None
+    assert faults.refit_degrade_scale("m-2") == 10.0  # default scale
+    assert faults.refit_degrade_scale("m-1") is None
+    # unset env: strict no-op
+    monkeypatch.delenv(faults.FAULT_INJECT_ENV_VAR)
+    assert faults.drift_shift_scale("m-1") is None
+    assert faults.refit_degrade_scale("m-2") is None
+
+
+def test_refit_nan_does_not_poison_ordinary_training(monkeypatch):
+    """A refit:nan spec targets REFIT builds only: an ordinary trainer
+    (fault_sites=('train',)) never consumes it."""
+    monkeypatch.setenv(faults.FAULT_INJECT_ENV_VAR, "refit:nan:m-0")
+    faults.reset()
+    assert faults.train_nan_injection(["m-0"], 1) is None
+    inj = faults.train_nan_injection(["m-0"], 1, sites=("train", "refit"))
+    assert inj is not None and inj[0].tolist() == [True]

@@ -455,7 +455,7 @@ def test_client_retry_and_latency_metrics(monkeypatch):
     retries_before = series_value(
         before, "gordo_client_retries_total", path="fleet"
     )
-    status, _ = client._post_fleet_chunk(
+    status, _, _ = client._post_fleet_chunk(
         "http://x/gordo/v0/obs-proj/prediction/fleet",
         {"m": {"a": {"0": 1.0}}},
         "rev",

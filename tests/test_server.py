@@ -421,3 +421,149 @@ def test_standalone_metrics_app(tmp_path, monkeypatch):
     assert resp.status_code == 200
     assert b"python_info" not in resp.data
     assert resp.data == b""
+
+
+# -- revision listing + hot promotion (docs/lifecycle.md) ----------------
+
+
+def _sibling_layout(trained_model_collection, tmp_path, revisions):
+    """A private revision layout: full copies of the trained collection
+    under each name in ``revisions``."""
+    import shutil
+
+    models = tmp_path / "models"
+    models.mkdir()
+    for revision in revisions:
+        shutil.copytree(trained_model_collection, models / revision)
+    return models
+
+
+def test_revisions_listing_with_siblings_and_torn(
+    trained_model_collection, monkeypatch, tmp_path
+):
+    """/revisions against ≥3 siblings: full revisions and a PARTIAL one
+    list and select; an in-flight dot-prefixed promotion staging dir, a
+    loose report file and the `latest` symlink itself are never
+    advertised as revisions."""
+    import shutil
+
+    from werkzeug.test import Client as WerkzeugClient
+
+    from gordo_tpu.server import build_app
+
+    models = _sibling_layout(trained_model_collection, tmp_path, ["100", "200"])
+    # a partial/torn NON-dot sibling: only one machine made it in
+    (models / "300").mkdir()
+    shutil.copytree(
+        trained_model_collection / GORDO_SINGLE_TARGET,
+        models / "300" / GORDO_SINGLE_TARGET,
+    )
+    # in-flight staging dir + a loose file: not revisions; neither is
+    # the `latest` pointer — a symlink ALIAS of a listed revision
+    (models / ".promote-400" / "m").mkdir(parents=True)
+    (models / "notes.json").write_text("{}")
+    (models / "latest").symlink_to("200")
+
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(models / "200"))
+    server_utils.clear_caches()
+    http = WerkzeugClient(build_app())
+
+    body = json.loads(http.get(_url(GORDO_PROJECT, "revisions")).get_data())
+    assert body["latest"] == "200"
+    assert sorted(body["available-revisions"]) == ["100", "200", "300"]
+
+    # every listed sibling is selectable via ?revision=
+    for revision in ("100", "300"):
+        resp = http.get(
+            _url(GORDO_PROJECT, "models"), query_string={"revision": revision}
+        )
+        assert resp.status_code == 200
+        assert json.loads(resp.get_data())["revision"] == revision
+        assert resp.headers["revision"] == revision
+
+    # the partial sibling serves the machines it has; the missing one 404s
+    resp = http.get(
+        _url(GORDO_PROJECT, "models"), query_string={"revision": "300"}
+    )
+    assert json.loads(resp.get_data())["models"] == [GORDO_SINGLE_TARGET]
+    resp = http.get(
+        _url(GORDO_PROJECT, GORDO_BASE_TARGETS[0], "metadata"),
+        query_string={"revision": "300"},
+    )
+    assert resp.status_code == 404
+
+    # and a revision that does not exist is still 410
+    resp = http.get(
+        _url(GORDO_PROJECT, "models"), query_string={"revision": "999"}
+    )
+    assert resp.status_code == 410
+
+    # dot entries are never servable, even though they exist on disk:
+    # an in-flight/torn promotion staging dir must not serve half-copied
+    # artifacts ("." / traversal names are not revisions either, and
+    # neither is the `latest` symlink — selecting the alias would key
+    # the model caches on a path whose target moves under them)
+    for name in (".promote-400", ".", "..", "../models", "latest", "notes.json"):
+        resp = http.get(
+            _url(GORDO_PROJECT, "models"), query_string={"revision": name}
+        )
+        assert resp.status_code == 410, name
+
+
+def test_latest_symlink_hot_roll(trained_model_collection, monkeypatch, tmp_path):
+    """MODEL_COLLECTION_DIR may be a `latest` symlink: the server
+    resolves it per request, so a lifecycle promotion's atomic re-point
+    rolls the SAME app to the new revision — no restart — emitting one
+    revision_rolled notice; the old revision stays selectable."""
+    from werkzeug.test import Client as WerkzeugClient
+
+    from gordo_tpu.lifecycle import repoint_latest
+    from gordo_tpu.observability import read_events
+    from gordo_tpu.server import build_app
+
+    models = _sibling_layout(trained_model_collection, tmp_path, ["100", "200"])
+    os.symlink("100", models / "latest")
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("GORDO_TPU_EVENT_LOG", str(log))
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(models / "latest"))
+    server_utils.clear_caches()
+    http = WerkzeugClient(build_app())
+
+    resp = http.get(_url(GORDO_PROJECT, "models"))
+    assert resp.headers["revision"] == "100"  # the TARGET, not "latest"
+    body = json.loads(resp.get_data())
+    assert body["revision"] == "100" and body["models"]
+
+    # promotion: flip the symlink; next request serves the new revision
+    repoint_latest(models / "latest", models / "200")
+    resp = http.get(_url(GORDO_PROJECT, "models"))
+    assert resp.headers["revision"] == "200"
+    # predictions load from the new revision's artifacts too
+    resp = http.get(_url(GORDO_PROJECT, GORDO_SINGLE_TARGET, "metadata"))
+    assert resp.status_code == 200
+    assert json.loads(resp.get_data())["revision"] == "200"
+
+    rolls = [
+        e for e in read_events(str(log)) if e["event"] == "revision_rolled"
+    ]
+    assert len(rolls) == 1
+    assert rolls[0]["previous"] == "100" and rolls[0]["current"] == "200"
+
+    # the superseded revision remains explicitly selectable (blue/green:
+    # rollback is a second flip, and in-flight consumers finish on it)
+    resp = http.get(
+        _url(GORDO_PROJECT, "models"), query_string={"revision": "100"}
+    )
+    assert resp.status_code == 200
+    assert json.loads(resp.get_data())["revision"] == "100"
+
+    # a TRAILING-SLASH pointer must hot-roll identically: islink on
+    # "latest/" stats the link's target, so an unstripped check would
+    # silently pin path-keyed caches to the pre-flip revision
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(models / "latest") + os.sep)
+    server_utils.clear_caches()
+    resp = http.get(_url(GORDO_PROJECT, "models"))
+    assert resp.headers["revision"] == "200"
+    repoint_latest(models / "latest", models / "100")
+    resp = http.get(_url(GORDO_PROJECT, "models"))
+    assert resp.headers["revision"] == "100"
