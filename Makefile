@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test lint bench images push
+.PHONY: all test lint bench build-multiworker images push
 
 all: lint test
 
@@ -27,6 +27,14 @@ lint:
 
 bench:
 	python bench.py
+
+# 2-worker crash-tolerant ledger build of the example fleet config
+# (docs/robustness.md "Multi-worker builds") — the smoke proof that N
+# worker processes coordinate through the shared-volume ledger
+build-multiworker:
+	MACHINES="$$(cat examples/machines_fleet.yaml)" \
+	OUTPUT_DIR=$${OUTPUT_DIR:-/tmp/gordo-tpu-multiworker} \
+	python -m gordo_tpu.cli build-fleet --workers 2 --lease-ttl 15
 
 images: $(addprefix image-,$(IMAGES))
 

@@ -68,6 +68,10 @@ HOT_PATH_PATTERNS = (
     # per-iteration host sync in drift scoring or shadow scoring would
     # scale with collection size
     "gordo_tpu/lifecycle/",
+    # the ledger worker's claim/heartbeat loops run for the WHOLE build:
+    # an accidental device sync per scan would serialize every worker
+    # on one device queue
+    "gordo_tpu/builder/ledger.py",
 )
 
 

@@ -28,7 +28,6 @@ the model was trained.
 
 import json
 import logging
-import os
 import time
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeoutError
@@ -65,6 +64,8 @@ from gordo_tpu.observability import (
 from gordo_tpu.parallel.bucketing import bucket_machines, timestep_bucket
 from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
 from gordo_tpu.parallel.mesh import auto_device_mesh
+from gordo_tpu.robustness import faults
+from gordo_tpu.utils import atomic
 
 logger = logging.getLogger(__name__)
 
@@ -211,8 +212,6 @@ class FleetModelBuilder:
 
     # -- data ------------------------------------------------------------
     def _fetch_one(self, machine: Machine):
-        from gordo_tpu.robustness import faults
-
         faults.inject("fetch", machine.name)
         dataset = _get_dataset(machine.dataset.to_dict())
         start = time.time()
@@ -443,64 +442,8 @@ class FleetModelBuilder:
         results: Dict[str, Tuple[BaseEstimator, Machine]] = {}
         to_build = list(self.machines)
         if resume:
-            # a prior run's casualties must NOT resume: a quarantined
-            # machine's artifact holds frozen last-good params, and
-            # reusing it while this run rewrites build_report.json would
-            # erase the quarantine record and serve those params as
-            # healthy. Rebuild them instead — a clean rebuild clears the
-            # record legitimately, a still-faulting one re-records it.
-            prior_casualties = self._prior_casualties(base)
-            remaining = []
-            for machine in to_build:
-                art_dir = base / machine.name
-                if machine.name in prior_casualties:
-                    logger.info(
-                        "Resume: rebuilding %s (recorded as %s by the "
-                        "previous run)",
-                        machine.name, prior_casualties[machine.name],
-                    )
-                    remaining.append(machine)
-                    continue
-                # artifacts flush atomically (serializer.dump renames a
-                # complete temp dir into place), so no torn model.pkl /
-                # metadata.json split can exist; the explicit file check
-                # remains only so load_metadata's parent-directory
-                # fallback can't pick up an unrelated metadata.json from
-                # OUTPUT_DIR itself
-                if not (art_dir / "metadata.json").is_file():
-                    remaining.append(machine)
-                    continue
-                try:
-                    model = serializer.load(art_dir)
-                    stored = serializer.load_metadata(art_dir)
-                    current = machine.to_dict()
-                    if (
-                        stored.get("model") != current.get("model")
-                        or stored.get("dataset") != current.get("dataset")
-                    ):
-                        logger.warning(
-                            "Artifact at %s was built from a different "
-                            "model/dataset config; rebuilding %s",
-                            art_dir, machine.name,
-                        )
-                        remaining.append(machine)
-                        continue
-                    # graft the current request's user metadata/runtime onto
-                    # the stored build metadata, like
-                    # ModelBuilder._restore_cached
-                    stored["metadata"]["user_defined"] = (
-                        machine.metadata.user_defined
-                    )
-                    stored["runtime"] = machine.runtime
-                    restored_machine = Machine.unvalidated(**stored)
-                except Exception:  # partial/corrupt artifact: rebuild
-                    logger.warning(
-                        "Artifact at %s exists but does not load; rebuilding %s",
-                        art_dir, machine.name,
-                    )
-                    remaining.append(machine)
-                    continue
-                results[machine.name] = (model, restored_machine)
+            reused, remaining = self._scan_resumable(to_build, base)
+            results.update(reused)
             if results:
                 logger.info(
                     "Resume: %d/%d machines already built under %s",
@@ -519,63 +462,9 @@ class FleetModelBuilder:
             "Fleet build: %d machines in %d buckets", len(to_build), len(buckets)
         )
 
-        def _flush(pairs):
-            if base is None:
-                return
-            for model, machine in pairs:
-                with tracing.start_span(
-                    "build.serialize", machine=machine.name
-                ):
-                    ModelBuilder._save_model(
-                        model=model,
-                        machine=machine,
-                        output_dir=base / machine.name,
-                    )
-            emit_event("bucket_flush", n_models=len(pairs), output_dir=str(base))
-
         try:
-            for (model_key, n_feat, n_feat_out), bucket in buckets.items():
-                prototype = serializer.from_definition(bucket[0].model)
-                if _find_jax_estimator(prototype) is None:
-                    logger.info(
-                        "Bucket %r has no JAX estimator; falling back to "
-                        "per-machine builds (%d machines)",
-                        model_key[:60],
-                        len(bucket),
-                    )
-                    for machine in bucket:
-                        try:
-                            results[machine.name] = ModelBuilder(machine).build()
-                        except Exception as exc:
-                            if self.on_error == "raise":
-                                raise
-                            self._record_failure(
-                                machine.name, phase="build",
-                                error=repr(exc), attempts=None,
-                            )
-                            continue
-                        # flush per machine: these unbatched builds are the
-                        # slowest, so the crash-loss window matters most here
-                        _flush([results[machine.name]])
-                    continue
-                try:
-                    built_bucket = self._build_bucket(bucket)
-                except Exception as exc:
-                    if self.on_error == "raise":
-                        raise
-                    # a training-level failure's blast radius is the
-                    # bucket: record every machine of it not already
-                    # recorded by the finer-grained fetch/precheck paths
-                    already = {f["machine"] for f in self.build_failures_}
-                    for machine in bucket:
-                        if machine.name not in already:
-                            self._record_failure(
-                                machine.name, phase="build",
-                                error=repr(exc), attempts=None,
-                            )
-                    continue
-                results.update(built_bucket)
-                _flush(built_bucket.values())
+            for bucket in buckets.values():
+                results.update(self._build_bucket_entry(bucket, base))
         except BaseException as exc:
             # the crash context the round-5 worker deaths never left
             # behind: what was in flight and how memory looked at death
@@ -598,6 +487,205 @@ class FleetModelBuilder:
             n_buckets=len(buckets),
         )
         return [results[m.name] for m in self.machines if m.name in results]
+
+    def _scan_resumable(
+        self, machines: List[Machine], base: Path
+    ) -> Tuple[
+        Dict[str, Tuple[BaseEstimator, Machine]], List[Machine]
+    ]:
+        """
+        The resume scan: machines whose artifact under ``base`` already
+        loads AND matches their current model/dataset config come back
+        as reused (model, machine) pairs; the rest need rebuilding.
+        Shared by the whole-fleet resume path and per-unit resume in
+        multi-worker builds (``build_unit(resume=True)``).
+
+        A prior run's casualties must NOT resume: a quarantined
+        machine's artifact holds frozen last-good params, and reusing
+        it while this run rewrites ``build_report.json`` would erase
+        the quarantine record and serve those params as healthy.
+        Rebuild them instead — a clean rebuild clears the record
+        legitimately, a still-faulting one re-records it.
+        """
+        prior_casualties = self._prior_casualties(base)
+        reused: Dict[str, Tuple[BaseEstimator, Machine]] = {}
+        remaining: List[Machine] = []
+        for machine in machines:
+            art_dir = base / machine.name
+            if machine.name in prior_casualties:
+                logger.info(
+                    "Resume: rebuilding %s (recorded as %s by the "
+                    "previous run)",
+                    machine.name, prior_casualties[machine.name],
+                )
+                remaining.append(machine)
+                continue
+            # artifacts flush atomically (serializer.dump renames a
+            # complete temp dir into place), so no torn model.pkl /
+            # metadata.json split can exist; the explicit file check
+            # remains only so load_metadata's parent-directory
+            # fallback can't pick up an unrelated metadata.json from
+            # OUTPUT_DIR itself
+            if not (art_dir / "metadata.json").is_file():
+                remaining.append(machine)
+                continue
+            try:
+                model = serializer.load(art_dir)
+                stored = serializer.load_metadata(art_dir)
+                current = machine.to_dict()
+                if (
+                    stored.get("model") != current.get("model")
+                    or stored.get("dataset") != current.get("dataset")
+                ):
+                    logger.warning(
+                        "Artifact at %s was built from a different "
+                        "model/dataset config; rebuilding %s",
+                        art_dir, machine.name,
+                    )
+                    remaining.append(machine)
+                    continue
+                # graft the current request's user metadata/runtime onto
+                # the stored build metadata, like
+                # ModelBuilder._restore_cached
+                stored["metadata"]["user_defined"] = (
+                    machine.metadata.user_defined
+                )
+                stored["runtime"] = machine.runtime
+                restored_machine = Machine.unvalidated(**stored)
+            except Exception:  # partial/corrupt artifact: rebuild
+                logger.warning(
+                    "Artifact at %s exists but does not load; rebuilding %s",
+                    art_dir, machine.name,
+                )
+                remaining.append(machine)
+                continue
+            reused[machine.name] = (model, restored_machine)
+        return reused, remaining
+
+    def _flush_pairs(self, pairs, base: Optional[Path]) -> None:
+        """Serialize (model, machine) pairs under ``base`` — one atomic
+        artifact directory per machine — and emit the flush event."""
+        if base is None:
+            return
+        pairs = list(pairs)
+        for model, machine in pairs:
+            with tracing.start_span("build.serialize", machine=machine.name):
+                ModelBuilder._save_model(
+                    model=model,
+                    machine=machine,
+                    output_dir=base / machine.name,
+                )
+        emit_event("bucket_flush", n_models=len(pairs), output_dir=str(base))
+
+    def _build_bucket_entry(
+        self, bucket: List[Machine], base: Optional[Path]
+    ) -> Dict[str, Tuple[BaseEstimator, Machine]]:
+        """
+        One bucket end to end: the vmapped fleet path when the bucket
+        has a JAX estimator, the per-machine :class:`ModelBuilder`
+        fallback otherwise — artifacts flushed as they complete, and
+        per-machine casualties recorded under ``on_error="skip"``. Both
+        the whole-fleet loop and the multi-worker ledger (one bucket =
+        one work unit, builder/ledger.py) build through here.
+        """
+        results: Dict[str, Tuple[BaseEstimator, Machine]] = {}
+        prototype = serializer.from_definition(bucket[0].model)
+        if _find_jax_estimator(prototype) is None:
+            logger.info(
+                "Bucket of %d machine(s) has no JAX estimator; falling "
+                "back to per-machine builds",
+                len(bucket),
+            )
+            for machine in bucket:
+                try:
+                    results[machine.name] = ModelBuilder(machine).build()
+                except Exception as exc:
+                    if self.on_error == "raise":
+                        raise
+                    self._record_failure(
+                        machine.name, phase="build",
+                        error=repr(exc), attempts=None,
+                    )
+                    continue
+                # flush per machine: these unbatched builds are the
+                # slowest, so the crash-loss window matters most here
+                self._flush_pairs([results[machine.name]], base)
+            return results
+        try:
+            built_bucket = self._build_bucket(bucket)
+        except Exception as exc:
+            if self.on_error == "raise":
+                raise
+            # a training-level failure's blast radius is the
+            # bucket: record every machine of it not already
+            # recorded by the finer-grained fetch/precheck paths
+            already = {f["machine"] for f in self.build_failures_}
+            for machine in bucket:
+                if machine.name not in already:
+                    self._record_failure(
+                        machine.name, phase="build",
+                        error=repr(exc), attempts=None,
+                    )
+            return results
+        results.update(built_bucket)
+        self._flush_pairs(built_bucket.values(), base)
+        return results
+
+    def build_unit(
+        self,
+        unit_machines: List[Machine],
+        output_dir_base: Union[str, Path],
+        resume: bool = False,
+    ) -> Tuple[dict, Dict[str, Tuple[BaseEstimator, Machine]]]:
+        """
+        Build ONE ledger work unit — the machines of a single bucket —
+        flushing artifacts under ``output_dir_base`` and returning
+        ``(unit_report, built)``: the JSON-serializable record the
+        ledger commits (built/resumed/failed/quarantined machine lists
+        + bucket telemetry) and the in-memory (model, machine) pairs.
+
+        ``resume`` reuses machines whose artifacts already load — the
+        same artifact-level scan the whole-fleet resume path runs, so a
+        multi-worker ``--resume`` skips committed units at the LEDGER
+        level and already-flushed machines of uncommitted units here.
+
+        Per-unit state is reset on entry, so one builder instance can
+        build many units in sequence; the global ``build_report.json``
+        is assembled by the ledger's finalize step from the committed
+        unit records, not here (builder/ledger.py).
+        """
+        base = Path(output_dir_base)
+        self._bucket_reports = []
+        self.build_failures_ = []
+        self.quarantined_ = []
+        reused: Dict[str, Tuple[BaseEstimator, Machine]] = {}
+        to_build = list(unit_machines)
+        if resume:
+            reused, to_build = self._scan_resumable(to_build, base)
+            if reused:
+                logger.info(
+                    "Resume: %d/%d machines of this unit already built "
+                    "under %s",
+                    len(reused), len(unit_machines), base,
+                )
+                emit_event(
+                    "resume",
+                    n_reused=len(reused),
+                    n_total=len(unit_machines),
+                    output_dir=str(base),
+                )
+        built = (
+            self._build_bucket_entry(to_build, base) if to_build else {}
+        )
+        results = {**reused, **built}
+        report = {
+            "built": sorted(results),
+            "resumed": sorted(reused),
+            "failed": [dict(r) for r in self.build_failures_],
+            "quarantined": [dict(r) for r in self.quarantined_],
+            "buckets": [dict(r) for r in self._bucket_reports],
+        }
+        return report, results
 
     def _finish_telemetry(
         self,
@@ -699,18 +787,16 @@ class FleetModelBuilder:
 
     def _write_build_report(self, base: Path) -> Path:
         """
-        Persist ``build_report.json`` next to the artifacts — atomically
-        (temp file + ``os.replace``), since the model server polls it to
-        decide which machines to 409.
+        Persist ``build_report.json`` next to the artifacts — atomically,
+        since the model server polls it to decide which machines to 409.
         """
-        base.mkdir(parents=True, exist_ok=True)
-        path = base / BUILD_REPORT_FILENAME
-        tmp = base / (BUILD_REPORT_FILENAME + ".tmp")
-        with open(tmp, "w") as fh:
-            json.dump(self.build_report_, fh, indent=2, sort_keys=True, default=str)
-            fh.write("\n")
-        os.replace(tmp, path)
-        return path
+        return atomic.atomic_write_json(
+            base / BUILD_REPORT_FILENAME,
+            self.build_report_,
+            indent=2,
+            sort_keys=True,
+            default=str,
+        )
 
     def _build_bucket(
         self, bucket: List[Machine]
@@ -722,6 +808,9 @@ class FleetModelBuilder:
         self, bucket: List[Machine]
     ) -> Dict[str, Tuple[BaseEstimator, Machine]]:
         bucket_start = time.time()
+        # chaos seam: a `worker:die:fetch` spec kills THIS process here —
+        # lease held, nothing published (robustness/faults.py)
+        faults.worker_die("fetch")
         fetched, fetch_failures = self.fetch_data(bucket)
         if fetch_failures:
             # on_error="skip" (raise already propagated): the casualties
@@ -866,6 +955,9 @@ class FleetModelBuilder:
         cv_duration = time.time() - start_cv
 
         # -- final full fit ----------------------------------------------
+        # chaos seam: `worker:die:train` dies mid-train — CV done, final
+        # fit unstarted, no artifacts flushed
+        faults.worker_die("train")
         start_fit = time.time()
         with tracing.start_span(
             "build.fit", n_machines=len(bucket), epochs=epochs

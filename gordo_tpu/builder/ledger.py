@@ -1,0 +1,1195 @@
+"""
+Crash-tolerant global work ledger: ``build-fleet`` as an N-worker job.
+
+The reference ran "thousands of models" by having Argo fan out one
+container per model; our rebuild is fleet-parallel inside a single
+process, so one host crash lost the whole build and one slow bucket
+stalled everything. This module shards the build's BUCKETS (the
+existing compilation units, parallel/bucketing.py) across multiple
+worker processes that coordinate **only through the shared artifact
+volume** — no coordinator process, no message bus, the
+fault-tolerant-execution discipline large-model fleets treat as table
+stakes (TensorFlow, arXiv:1605.08695 §4.2; "ML Productivity Goodput",
+arXiv:2502.06982: recoverable interruptions dominate fleet goodput).
+
+Protocol (every mutation is an atomic filesystem primitive):
+
+- **Plan.** Every worker derives the same unit list from the same
+  machines config (bucketing is config-deterministic); the first to
+  create ``plan.json`` (exclusive link, utils/atomic.py) publishes it,
+  the rest verify their plan hash against it and refuse to join a
+  ledger built from a different config.
+- **Claim.** A worker claims a unit by creating its lease file with
+  ``os.open(O_CREAT | O_EXCL)`` — exactly one creator wins. The lease
+  body names the worker, its attempt number, and a random token; the
+  file's **mtime is the heartbeat** (``os.utime`` on a bounded
+  interval), so a torn lease body — the crash window between create
+  and write — still carries liveness.
+- **Steal.** A lease whose mtime is older than the TTL is presumed
+  dead: any live worker renames it to a numbered tombstone (atomic;
+  one renamer wins) and claims a fresh lease. Tombstones ARE the
+  attempt count — it survives torn lease bodies and worker deaths.
+  A unit whose tombstone count reaches ``max_attempts`` is **poisoned**
+  instead of re-leased: its machines become build-report casualties
+  (phase ``build``), not a crash loop.
+- **Commit.** The worker builds the unit (artifacts publish atomically,
+  serializer.dump), then commits by exclusively creating the unit's
+  ``done`` record — commit is the LAST step, so a death anywhere before
+  it costs one unit of rework and nothing else. A stalled worker that
+  wakes to find its lease stolen does not commit (and the exclusive
+  done record guarantees at most one commit even if it tried).
+- **Finalize.** When every unit is done or a casualty, any worker
+  merges the committed unit records — deterministically, sorted by
+  unit — into the same ``build_report.json`` / telemetry report a
+  single-worker build writes, so ``--on-error skip``, ``--resume`` and
+  degraded serving (docs/robustness.md) work identically.
+
+Clock discipline: steal decisions compare the lease file's mtime
+against this worker's clock on the SAME filesystem; a skewed writer
+whose mtimes land in the future reads as "fresh" (age clamps to zero),
+so skew can delay a steal but never triggers one early.
+
+Each worker stays a single-process JAX fleet (its own device set, its
+own compiled programs) — the ledger parallelizes ACROSS programs, the
+mesh inside one (docs/parallelism.md).
+"""
+
+import errno
+import hashlib
+import json
+import logging
+import os
+import shutil
+import subprocess
+import sys
+import threading
+import time
+import typing
+from datetime import datetime, timezone
+from pathlib import Path
+
+from gordo_tpu.machine import Machine
+from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.parallel.bucketing import bucket_machines
+from gordo_tpu.robustness import faults
+from gordo_tpu.utils import atomic
+
+logger = logging.getLogger(__name__)
+
+#: ledger root under the build output dir — dot-prefixed, so the model
+#: server's listings and the revision machinery never mistake it for an
+#: artifact directory
+LEDGER_DIRNAME = ".ledger"
+
+PLAN_FILENAME = "plan.json"
+ABORTED_FILENAME = "aborted.json"
+FINALIZED_FILENAME = "finalized"
+
+DEFAULT_LEASE_TTL_S = 60.0
+DEFAULT_MAX_ATTEMPTS = 3
+
+LEASE_TTL_ENV_VAR = "GORDO_LEASE_TTL"
+MAX_ATTEMPTS_ENV_VAR = "GORDO_MAX_ATTEMPTS"
+WORKERS_ENV_VAR = "GORDO_BUILD_WORKERS"
+
+
+class LedgerPlanMismatch(RuntimeError):
+    """The on-disk plan was built from a different machines config."""
+
+
+class FleetBuildAborted(RuntimeError):
+    """A worker failed under ``on_error="raise"`` and aborted the job."""
+
+
+class WorkUnit(typing.NamedTuple):
+    """One ledger work unit: the machines of one architecture bucket."""
+
+    uid: str
+    machines: typing.Tuple[str, ...]
+
+
+class ClaimedUnit(typing.NamedTuple):
+    """A unit this worker holds the lease for."""
+
+    uid: str
+    machines: typing.Tuple[str, ...]
+    attempt: int
+    stolen: bool
+
+
+def plan_units(machines: typing.List[Machine]) -> typing.List[WorkUnit]:
+    """
+    The deterministic work plan: one unit per bucket, identified by a
+    digest of the bucket key AND its machine names — every worker
+    derives the identical list from the identical config, which is what
+    lets N processes coordinate through lease files alone.
+    """
+    digests = []
+    for (model_key, n_feat, n_feat_out), bucket in bucket_machines(
+        machines
+    ).items():
+        names = tuple(m.name for m in bucket)
+        digest = hashlib.sha1(
+            json.dumps(
+                [model_key, n_feat, n_feat_out, list(names)], sort_keys=True
+            ).encode()
+        ).hexdigest()
+        digests.append((digest, names))
+    digests.sort()
+    return [
+        WorkUnit(uid=f"u{index:03d}-{digest[:10]}", machines=names)
+        for index, (digest, names) in enumerate(digests)
+    ]
+
+
+def plan_fingerprint(units: typing.List[WorkUnit]) -> str:
+    """Hash of the whole plan (unit ids + machine rosters)."""
+    return hashlib.sha1(
+        json.dumps([[u.uid, list(u.machines)] for u in units]).encode()
+    ).hexdigest()
+
+
+def _utcnow_iso() -> str:
+    return str(datetime.now(timezone.utc).astimezone())
+
+
+class Ledger:
+    """
+    One worker's handle on the shared ledger under
+    ``<output_dir>/.ledger``. All coordination is lease/tombstone/done
+    files in ``units/`` — see the module docstring for the protocol.
+    """
+
+    def __init__(
+        self,
+        output_dir: typing.Union[str, os.PathLike],
+        worker_id: typing.Union[str, int],
+        lease_ttl: float = DEFAULT_LEASE_TTL_S,
+        max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+    ):
+        self.output_dir = Path(output_dir)
+        self.base = self.output_dir / LEDGER_DIRNAME
+        self.units_dir = self.base / "units"
+        self.workers_dir = self.base / "workers"
+        self.worker_id = str(worker_id)
+        self.lease_ttl = float(lease_ttl)
+        if self.lease_ttl <= 0:
+            raise ValueError(f"lease_ttl must be positive, got {lease_ttl}")
+        self.max_attempts = max(1, int(max_attempts))
+        #: this worker's fencing token: commit/heartbeat verify the lease
+        #: body still carries it, so a stolen lease is detected
+        self.token = os.urandom(8).hex()
+        self._units: typing.List[WorkUnit] = []
+        #: unit ids this worker currently holds a lease on
+        self._held: typing.Dict[str, ClaimedUnit] = {}
+        self._lock = threading.Lock()
+        self._heartbeat: typing.Optional[_HeartbeatThread] = None
+
+    # -- paths ------------------------------------------------------------
+
+    def _lease_path(self, uid: str) -> Path:
+        return self.units_dir / f"{uid}.lease"
+
+    def _done_path(self, uid: str) -> Path:
+        return self.units_dir / f"{uid}.done"
+
+    def _casualty_path(self, uid: str) -> Path:
+        return self.units_dir / f"{uid}.casualty"
+
+    def _new_tombstone_path(self, uid: str, index: int) -> Path:
+        # UNIQUE per steal: two stealers racing the same expired lease
+        # must never rename onto the same destination — os.rename would
+        # silently replace the first tombstone and undercount deaths,
+        # letting a crash-looping unit outlive max_attempts
+        return self.units_dir / (
+            f"{uid}.tombstone-{index}-{os.urandom(4).hex()}"
+        )
+
+    def _tombstone_count(self, uid: str) -> int:
+        prefix = f"{uid}.tombstone-"
+        try:
+            return sum(
+                1
+                for name in os.listdir(self.units_dir)
+                if name.startswith(prefix)
+            )
+        except FileNotFoundError:
+            return 0
+
+    # -- plan -------------------------------------------------------------
+
+    def ensure_plan(self, units: typing.List[WorkUnit]) -> None:
+        """
+        Publish the work plan, or join the one already on disk — which
+        must fingerprint-match this worker's (building a DIFFERENT
+        config against a live ledger would corrupt both builds).
+        """
+        self.units_dir.mkdir(parents=True, exist_ok=True)
+        self.workers_dir.mkdir(parents=True, exist_ok=True)
+        fingerprint = plan_fingerprint(units)
+        payload = {
+            "version": 1,
+            "created": _utcnow_iso(),
+            "created_by": self.worker_id,
+            "plan_hash": fingerprint,
+            "n_units": len(units),
+            "n_machines": sum(len(u.machines) for u in units),
+            "units": [
+                {"id": u.uid, "machines": list(u.machines)} for u in units
+            ],
+        }
+        try:
+            atomic.atomic_create_json(
+                self.base / PLAN_FILENAME, payload, indent=2, sort_keys=True
+            )
+        except FileExistsError:
+            existing = self.read_plan()
+            if existing.get("plan_hash") != fingerprint:
+                raise LedgerPlanMismatch(
+                    f"Ledger at {self.base} was planned from a different "
+                    f"machines config (plan hash "
+                    f"{existing.get('plan_hash')!r} != {fingerprint!r}); "
+                    "remove the ledger directory to start a fresh build"
+                )
+        self._units = list(units)
+
+    def read_plan(self) -> dict:
+        with open(self.base / PLAN_FILENAME) as fh:
+            return json.load(fh)
+
+    def _loaded_units(self) -> typing.List[WorkUnit]:
+        if not self._units:
+            self._units = [
+                WorkUnit(uid=u["id"], machines=tuple(u["machines"]))
+                for u in self.read_plan()["units"]
+            ]
+        return self._units
+
+    # -- heartbeat --------------------------------------------------------
+
+    def register_worker(self) -> None:
+        atomic.atomic_write_json(
+            self.workers_dir / f"{self.worker_id}.json",
+            {
+                "worker": self.worker_id,
+                "pid": os.getpid(),
+                "started": _utcnow_iso(),
+                "lease_ttl_s": self.lease_ttl,
+            },
+        )
+
+    def beat(self) -> None:
+        """
+        One heartbeat: refresh this worker's liveness file and every
+        held lease's mtime — unless a ``lease:stall`` chaos spec says
+        this worker has gone silent. A held lease whose body no longer
+        carries our token (or is gone) was STOLEN: it is dropped from
+        the held set here, so the build loop learns before commit does.
+        """
+        if faults.lease_stall(self.worker_id):
+            return
+        now = time.time()
+        try:
+            os.utime(self.workers_dir / f"{self.worker_id}.json", (now, now))
+        except OSError:
+            pass
+        with self._lock:
+            held = list(self._held)
+        for uid in held:
+            lease = self._lease_path(uid)
+            body = _read_json(lease)
+            if body is None or body.get("token") != self.token:
+                self._observe_lease_lost(uid, at="heartbeat")
+                continue
+            try:
+                os.utime(lease, (now, now))
+            except OSError:
+                continue
+        get_registry().counter(
+            "gordo_ledger_heartbeats_total",
+            "Lease/worker heartbeats written by ledger workers",
+        ).inc()
+
+    def start_heartbeat(self) -> "_HeartbeatThread":
+        self.register_worker()
+        self._heartbeat = _HeartbeatThread(self)
+        self._heartbeat.start()
+        return self._heartbeat
+
+    def stop_heartbeat(self) -> None:
+        if self._heartbeat is not None:
+            self._heartbeat.stop()
+            self._heartbeat = None
+
+    def _observe_lease_lost(self, uid: str, at: str) -> None:
+        with self._lock:
+            claimed = self._held.pop(uid, None)
+        if claimed is None:
+            return
+        logger.warning(
+            "Worker %s: lease on %s was stolen (observed at %s); "
+            "abandoning the unit without committing",
+            self.worker_id, uid, at,
+        )
+        emit_event(
+            "lease_lost", unit=uid, worker=self.worker_id, observed_at=at
+        )
+
+    # -- claim / steal ----------------------------------------------------
+
+    def claim_next(self) -> typing.Optional[ClaimedUnit]:
+        """
+        Claim one unclaimed unit, or steal one whose lease has expired;
+        None when nothing is currently claimable (all resolved, or
+        every open unit is under a live lease). Workers scan the plan
+        from an offset derived from their id, so N workers starting
+        together mostly try DIFFERENT units first and the O_EXCL race
+        is the tiebreak, not the common path.
+        """
+        units = self._loaded_units()
+        if not units:
+            return None
+        offset = int(
+            hashlib.sha1(self.worker_id.encode()).hexdigest(), 16
+        ) % len(units)
+        rotated = units[offset:] + units[:offset]
+        expired: typing.List[WorkUnit] = []
+        for unit in rotated:
+            if self._resolved(unit.uid):
+                continue
+            lease = self._lease_path(unit.uid)
+            try:
+                age = time.time() - lease.stat().st_mtime
+            except FileNotFoundError:
+                claimed = self._try_fresh_claim(unit)
+                if claimed is not None:
+                    return claimed
+                continue
+            # a skewed writer's future mtime clamps to age 0: clock skew
+            # can delay a steal, never cause one early
+            if max(0.0, age) > self.lease_ttl:
+                expired.append(unit)
+        for unit in expired:
+            claimed = self._try_steal(unit)
+            if claimed is not None:
+                return claimed
+        return None
+
+    def _resolved(self, uid: str) -> bool:
+        return self._done_path(uid).exists() or self._casualty_path(
+            uid
+        ).exists()
+
+    def _write_lease(self, unit: WorkUnit, attempt: int) -> bool:
+        """Create the lease file exclusively; False when someone else
+        already holds it."""
+        lease = self._lease_path(unit.uid)
+        try:
+            fd = os.open(lease, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+        except FileExistsError:
+            return False
+        body = {
+            "unit": unit.uid,
+            "worker": self.worker_id,
+            "token": self.token,
+            "attempt": attempt,
+            "claimed_at": _utcnow_iso(),
+            "lease_ttl_s": self.lease_ttl,
+        }
+        with os.fdopen(fd, "w") as fh:
+            json.dump(body, fh)
+            fh.write("\n")
+        return True
+
+    def _poison(
+        self,
+        unit: WorkUnit,
+        attempts: int,
+        last_worker: typing.Optional[str],
+    ) -> None:
+        """Record the unit as a poisoned-unit casualty: every machine of
+        it becomes a build-report casualty instead of a crash loop."""
+        error = (
+            f"unit poisoned: {attempts} worker attempt(s) died without "
+            f"committing (last worker: {last_worker or 'unknown'})"
+        )
+        record = {
+            "version": 1,
+            "unit": unit.uid,
+            "machines": list(unit.machines),
+            "attempts": attempts,
+            "last_worker": last_worker,
+            "error": error,
+            "recorded_by": self.worker_id,
+            "recorded_at": _utcnow_iso(),
+        }
+        try:
+            atomic.atomic_create_json(
+                self._casualty_path(unit.uid), record, indent=2, sort_keys=True
+            )
+        except FileExistsError:
+            return
+        logger.error("Unit %s: %s", unit.uid, error)
+        emit_event(
+            "unit_poisoned",
+            unit=unit.uid,
+            attempts=attempts,
+            n_machines=len(unit.machines),
+            last_worker=last_worker,
+        )
+        get_registry().counter(
+            "gordo_ledger_units_poisoned_total",
+            "Work units abandoned after max_attempts worker deaths",
+        ).inc()
+
+    def _try_fresh_claim(self, unit: WorkUnit) -> typing.Optional[ClaimedUnit]:
+        with tracing.start_span("ledger.claim", unit=unit.uid) as span:
+            attempts_dead = self._tombstone_count(unit.uid)
+            if attempts_dead >= self.max_attempts:
+                # a stealer died between tombstoning and poisoning:
+                # finish its sentence
+                self._poison(unit, attempts_dead, last_worker=None)
+                return None
+            attempt = attempts_dead + 1
+            if not self._write_lease(unit, attempt):
+                return None
+            span.set_attribute("attempt", attempt)
+            claimed = ClaimedUnit(
+                uid=unit.uid,
+                machines=unit.machines,
+                attempt=attempt,
+                stolen=attempts_dead > 0,
+            )
+            with self._lock:
+                self._held[unit.uid] = claimed
+            get_registry().counter(
+                "gordo_ledger_claims_total",
+                "Work-unit claims by ledger workers",
+                ("kind",),
+            ).inc(kind="fresh")
+            logger.info(
+                "Worker %s claimed unit %s (%d machines, attempt %d)",
+                self.worker_id, unit.uid, len(unit.machines), attempt,
+            )
+            return claimed
+
+    def _try_steal(self, unit: WorkUnit) -> typing.Optional[ClaimedUnit]:
+        """
+        Steal an expired lease: rename it to the next tombstone (atomic
+        — exactly one stealer wins), then either poison the unit or
+        re-claim it with a bumped attempt count.
+        """
+        with tracing.start_span("ledger.steal", unit=unit.uid) as span:
+            lease = self._lease_path(unit.uid)
+            stale = _read_json(lease)  # None for a torn/empty lease body
+            try:
+                age = time.time() - lease.stat().st_mtime
+            except FileNotFoundError:
+                return None
+            if max(0.0, age) <= self.lease_ttl:
+                return None  # heartbeat landed since the scan
+            tombstones = self._tombstone_count(unit.uid)
+            tomb = self._new_tombstone_path(unit.uid, tombstones)
+            try:
+                os.rename(lease, tomb)
+            except FileNotFoundError:
+                return None  # another stealer (or a commit) won
+            except OSError as exc:
+                if exc.errno == errno.EEXIST:
+                    return None
+                raise
+            # fencing re-check on what we ACTUALLY moved: between the
+            # expiry scan and the rename, a faster stealer may have
+            # tombstoned the stale lease and written a FRESH one (or a
+            # delayed heartbeat may have revived it) — the mtime rides
+            # the rename, so a fresh one betrays itself here. Restore it
+            # exclusively (os.link fails if yet another lease appeared)
+            # and walk away.
+            try:
+                fresh_age = time.time() - tomb.stat().st_mtime
+            except OSError:
+                fresh_age = None
+            if fresh_age is not None and max(0.0, fresh_age) <= self.lease_ttl:
+                try:
+                    os.link(tomb, lease)
+                except (FileExistsError, OSError):
+                    pass
+                try:
+                    os.unlink(tomb)
+                except OSError:
+                    pass
+                return None
+            if self._resolved(unit.uid):
+                # the "stalled" holder was alive after all and committed
+                # between our scan and the rename: the unit is DONE, and
+                # re-leasing it would rebuild a committed unit for
+                # nothing (the stray tombstone is harmless forensics)
+                return None
+            dead_worker = (stale or {}).get("worker")
+            attempts_dead = tombstones + 1
+            span.set_attribute("attempt", attempts_dead + 1)
+            emit_event(
+                "worker_died",
+                unit=unit.uid,
+                worker=dead_worker,
+                lease_age_s=round(age, 3),
+                attempts_dead=attempts_dead,
+                observed_by=self.worker_id,
+            )
+            logger.warning(
+                "Worker %s: lease on %s by worker %s expired "
+                "(%.1fs > ttl %.1fs); stealing (death %d of %d allowed)",
+                self.worker_id, unit.uid, dead_worker, age,
+                self.lease_ttl, attempts_dead, self.max_attempts,
+            )
+            if attempts_dead >= self.max_attempts:
+                self._poison(unit, attempts_dead, last_worker=dead_worker)
+                return None
+            if not self._write_lease(unit, attempts_dead + 1):
+                return None
+            emit_event(
+                "lease_stolen",
+                unit=unit.uid,
+                worker=self.worker_id,
+                previous_worker=dead_worker,
+                attempt=attempts_dead + 1,
+            )
+            claimed = ClaimedUnit(
+                uid=unit.uid,
+                machines=unit.machines,
+                attempt=attempts_dead + 1,
+                stolen=True,
+            )
+            with self._lock:
+                self._held[unit.uid] = claimed
+            get_registry().counter(
+                "gordo_ledger_claims_total",
+                "Work-unit claims by ledger workers",
+                ("kind",),
+            ).inc(kind="steal")
+            return claimed
+
+    # -- commit / release -------------------------------------------------
+
+    def commit(self, uid: str, report: dict) -> bool:
+        """
+        Commit the unit's result — the LAST step of a unit build.
+        Returns False without committing when the lease was stolen (the
+        double-commit guard: the stalled worker's artifacts are
+        bit-identical and already atomically published, but the STEALER
+        owns the unit's record now), or when a done record already
+        exists (the exclusive create is the backstop that makes "both
+        commit" impossible even under arbitrary interleavings).
+        """
+        with tracing.start_span("ledger.commit", unit=uid) as span:
+            with self._lock:
+                claimed = self._held.get(uid)
+            lease = self._lease_path(uid)
+            body = _read_json(lease)
+            if body is None or body.get("token") != self.token:
+                self._observe_lease_lost(uid, at="commit")
+                span.set_attribute("committed", False)
+                return False
+            record = {
+                "version": 1,
+                "unit": uid,
+                "worker": self.worker_id,
+                "attempt": claimed.attempt if claimed else body.get("attempt"),
+                "finished": _utcnow_iso(),
+                "report": report,
+            }
+            try:
+                atomic.atomic_create_json(
+                    self._done_path(uid), record, indent=2, sort_keys=True
+                )
+            except FileExistsError:
+                self._observe_lease_lost(uid, at="commit")
+                span.set_attribute("committed", False)
+                return False
+            with self._lock:
+                self._held.pop(uid, None)
+            try:
+                os.unlink(lease)
+            except OSError:
+                pass
+            span.set_attribute("committed", True)
+            if claimed is not None:
+                get_registry().histogram(
+                    "gordo_ledger_unit_attempts",
+                    "Attempts a work unit took to commit (1 = no deaths)",
+                    buckets=(1, 2, 3, 4, 5, 8),
+                ).observe(claimed.attempt)
+            logger.info(
+                "Worker %s committed unit %s", self.worker_id, uid
+            )
+            return True
+
+    def owns(self, uid: str) -> bool:
+        """Whether this worker's token is still on the unit's lease."""
+        body = _read_json(self._lease_path(uid))
+        return body is not None and body.get("token") == self.token
+
+    def release(self, uid: str) -> None:
+        """Give a held lease back cleanly (an aborting worker must not
+        make its peers wait out the TTL)."""
+        with self._lock:
+            self._held.pop(uid, None)
+        lease = self._lease_path(uid)
+        body = _read_json(lease)
+        if body is not None and body.get("token") == self.token:
+            try:
+                os.unlink(lease)
+            except OSError:
+                pass
+
+    # -- job state --------------------------------------------------------
+
+    def all_resolved(self) -> bool:
+        return all(self._resolved(u.uid) for u in self._loaded_units())
+
+    def mark_aborted(self, error: str) -> None:
+        """Raise the abort flag every worker's loop checks: a worker
+        failing under ``on_error="raise"`` stops the JOB, not just
+        itself (reference semantics: the first failure aborts)."""
+        try:
+            atomic.atomic_create_json(
+                self.base / ABORTED_FILENAME,
+                {
+                    "worker": self.worker_id,
+                    "error": error,
+                    "at": _utcnow_iso(),
+                },
+            )
+        except FileExistsError:
+            pass
+
+    def aborted_info(self) -> typing.Optional[dict]:
+        return _read_json(self.base / ABORTED_FILENAME)
+
+    # -- finalize ---------------------------------------------------------
+
+    def finalize(self, on_error: str) -> typing.Optional[dict]:
+        """
+        Merge the committed unit records into the global
+        ``build_report.json`` + telemetry report (atomic writes, unit
+        order — every worker that finalizes writes the same content
+        modulo timestamps, so concurrent finalizers are harmless; the
+        exclusive marker only dedupes the event/metrics). None when
+        units are still unresolved.
+        """
+        units = self._loaded_units()
+        if not self.all_resolved():
+            return None
+        plan = self.read_plan()
+        built: typing.List[str] = []
+        resumed: typing.List[str] = []
+        failed: typing.List[dict] = []
+        quarantined: typing.List[dict] = []
+        bucket_reports: typing.List[dict] = []
+        attempts_total = 0
+        steals = 0
+        for unit in units:
+            done = _read_json(self._done_path(unit.uid))
+            if done is not None:
+                report = done.get("report") or {}
+                built.extend(report.get("built") or [])
+                resumed.extend(report.get("resumed") or [])
+                failed.extend(report.get("failed") or [])
+                quarantined.extend(report.get("quarantined") or [])
+                bucket_reports.extend(report.get("buckets") or [])
+                attempt = int(done.get("attempt") or 1)
+                attempts_total += attempt
+                steals += max(0, attempt - 1)
+                continue
+            casualty = _read_json(self._casualty_path(unit.uid))
+            if casualty is not None:
+                attempts_total += int(casualty.get("attempts") or 0)
+                for name in casualty.get("machines") or list(unit.machines):
+                    failed.append(
+                        {
+                            "machine": name,
+                            "phase": "build",
+                            "error": casualty.get("error")
+                            or "unit poisoned",
+                            "attempts": casualty.get("attempts"),
+                        }
+                    )
+        failed.sort(key=lambda r: str(r.get("machine")))
+        quarantined.sort(key=lambda r: str(r.get("machine")))
+        started = plan.get("created") or _utcnow_iso()
+        finished = _utcnow_iso()
+        n_machines = int(plan.get("n_machines") or 0)
+        # "built" includes resumed reuses (they are in the final
+        # revision); n_built counts machines built THIS run, matching
+        # the single-worker report's n_built/n_resumed split
+        n_resumed = len(resumed)
+        n_built = len(built) - n_resumed
+        build_report = {
+            "version": 1,
+            "kind": "fleet_build_report",
+            "started": started,
+            "finished": finished,
+            "on_error": on_error,
+            "n_machines": n_machines,
+            "n_built": n_built,
+            "n_resumed": n_resumed,
+            "n_failed": len(failed),
+            "n_quarantined": len(quarantined),
+            "failed": failed,
+            "quarantined": quarantined,
+        }
+        atomic.atomic_write_json(
+            self.output_dir / "build_report.json",
+            build_report,
+            indent=2,
+            sort_keys=True,
+            default=str,
+        )
+        wall = _elapsed_since_iso(started)
+        rate = (
+            n_built / wall * 3600 if wall is not None and wall > 0 else None
+        )
+        telemetry = {
+            "kind": "fleet_build",
+            "started": started,
+            "finished": finished,
+            "wall_time_s": wall,
+            "n_machines": n_machines,
+            "n_built": n_built,
+            "n_resumed": n_resumed,
+            "n_buckets": len(units),
+            "models_per_hour": rate,
+            "buckets": bucket_reports,
+            "on_error": on_error,
+            "machines_failed": failed,
+            "machines_quarantined": quarantined,
+            "ledger": {
+                "n_units": len(units),
+                "n_workers_seen": len(
+                    {w for w in self._worker_files()}
+                ),
+                "attempts_total": attempts_total,
+                "steals": steals,
+                "units_poisoned": sum(
+                    1
+                    for u in units
+                    if self._casualty_path(u.uid).exists()
+                ),
+            },
+        }
+        from gordo_tpu.observability import write_telemetry_report
+
+        write_telemetry_report(self.output_dir, telemetry)
+        try:
+            fd = os.open(
+                self.base / FINALIZED_FILENAME,
+                os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+            )
+            os.close(fd)
+        except FileExistsError:
+            return build_report
+        emit_event(
+            "ledger_finalized",
+            n_units=len(units),
+            n_built=n_built,
+            n_resumed=n_resumed,
+            n_failed=len(failed),
+            n_quarantined=len(quarantined),
+            steals=steals,
+            wall_time_s=wall,
+        )
+        reg = get_registry()
+        reg.counter(
+            "gordo_build_models_total", "Models produced by fleet builds"
+        ).inc(n_built)
+        if rate is not None:
+            reg.gauge(
+                "gordo_build_models_per_hour", "Most recent build's rate"
+            ).set(rate)
+        return build_report
+
+    # -- status -----------------------------------------------------------
+
+    def _worker_files(self) -> typing.List[str]:
+        try:
+            return [
+                p[: -len(".json")]
+                for p in os.listdir(self.workers_dir)
+                if p.endswith(".json")
+            ]
+        except FileNotFoundError:
+            return []
+
+    def status(self) -> dict:
+        """
+        The whole ledger's state, for ``--ledger-status``. Expiry and
+        stall verdicts use the TTL each lease/worker RECORDED at claim
+        time, not this probe's configured TTL — the operator inspecting
+        a build run with ``--lease-ttl 15`` must not need to repeat the
+        flag to get correct EXPIRED/STALLED markers.
+        """
+        now = time.time()
+        finalized = (self.base / FINALIZED_FILENAME).exists()
+        units = []
+        for unit in self._loaded_units():
+            entry: dict = {
+                "unit": unit.uid,
+                "n_machines": len(unit.machines),
+                "machines": list(unit.machines),
+                "attempts_dead": self._tombstone_count(unit.uid),
+            }
+            done = _read_json(self._done_path(unit.uid))
+            casualty = _read_json(self._casualty_path(unit.uid))
+            lease = self._lease_path(unit.uid)
+            if done is not None:
+                entry.update(
+                    state="done",
+                    worker=done.get("worker"),
+                    attempt=done.get("attempt"),
+                )
+            elif casualty is not None:
+                entry.update(
+                    state="casualty",
+                    attempts=casualty.get("attempts"),
+                    error=casualty.get("error"),
+                )
+            elif lease.exists():
+                body = _read_json(lease) or {}
+                try:
+                    age = max(0.0, now - lease.stat().st_mtime)
+                except FileNotFoundError:
+                    age = None
+                try:
+                    lease_ttl = float(body.get("lease_ttl_s"))
+                except (TypeError, ValueError):
+                    lease_ttl = self.lease_ttl  # torn body: best effort
+                entry.update(
+                    state="leased",
+                    worker=body.get("worker"),
+                    attempt=body.get("attempt"),
+                    lease_ttl_s=lease_ttl,
+                    heartbeat_age_s=(
+                        round(age, 3) if age is not None else None
+                    ),
+                    expired=(age is not None and age > lease_ttl),
+                )
+            else:
+                entry.update(state="pending")
+            units.append(entry)
+        workers = {}
+        for wid in sorted(self._worker_files()):
+            path = self.workers_dir / f"{wid}.json"
+            body = _read_json(path) or {}
+            try:
+                age = max(0.0, now - path.stat().st_mtime)
+            except FileNotFoundError:
+                continue
+            try:
+                worker_ttl = float(body.get("lease_ttl_s"))
+            except (TypeError, ValueError):
+                worker_ttl = self.lease_ttl
+            workers[wid] = {
+                "pid": body.get("pid"),
+                "started": body.get("started"),
+                "lease_ttl_s": worker_ttl,
+                "last_heartbeat_age_s": round(age, 3),
+                # a finished build's workers exited cleanly — flagging
+                # them all stalled would train operators to ignore the
+                # one signal this flag exists for
+                "stalled": (not finalized) and age > worker_ttl,
+            }
+        counts = {"pending": 0, "leased": 0, "done": 0, "casualty": 0}
+        for entry in units:
+            counts[entry["state"]] += 1
+        return {
+            "ledger_dir": str(self.base),
+            "lease_ttl_s": self.lease_ttl,
+            "max_attempts": self.max_attempts,
+            "aborted": self.aborted_info(),
+            "finalized": finalized,
+            "counts": counts,
+            "units": units,
+            "workers": workers,
+        }
+
+
+class _HeartbeatThread(threading.Thread):
+    """Bounded-interval heartbeats for one worker's ledger handle."""
+
+    def __init__(self, ledger: Ledger):
+        super().__init__(name=f"ledger-heartbeat-{ledger.worker_id}", daemon=True)
+        self.ledger = ledger
+        # NB: not `_stop` — threading.Thread has a private method of
+        # that name, and shadowing it breaks Thread.join
+        self.interval = min(max(ledger.lease_ttl / 4.0, 0.05), 15.0)
+        self._stop_event = threading.Event()
+
+    def run(self) -> None:
+        while not self._stop_event.wait(self.interval):
+            try:
+                self.ledger.beat()
+            except Exception:
+                logger.warning("Ledger heartbeat failed", exc_info=True)
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        self.join(timeout=5.0)
+
+
+def _read_json(path: typing.Union[str, os.PathLike]) -> typing.Optional[dict]:
+    """A JSON file's dict, or None when absent/torn/unparseable — every
+    ledger reader must survive a peer's crash mid-write."""
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def _elapsed_since_iso(started_iso: str) -> typing.Optional[float]:
+    try:
+        started = datetime.fromisoformat(started_iso)
+        return max(
+            0.0,
+            (datetime.now(timezone.utc) - started.astimezone(timezone.utc))
+            .total_seconds(),
+        )
+    except (ValueError, TypeError):
+        return None
+
+
+# -- the worker loop -----------------------------------------------------
+
+
+def resolve_workers(value: typing.Union[str, int]) -> int:
+    """``--workers auto|N`` → N. ``auto`` sizes to the host: half the
+    cores, capped at 4 — each worker is a whole JAX process with its own
+    compile pipeline, and past a few of them compilation and the data
+    fetch pool saturate a dev box."""
+    if isinstance(value, str) and value.strip().lower() == "auto":
+        return max(1, min(4, (os.cpu_count() or 2) // 2))
+    n = int(value)
+    if n < 1:
+        raise ValueError(f"--workers must be >= 1 or 'auto', got {value!r}")
+    return n
+
+
+def run_worker(
+    builder,
+    output_dir: typing.Union[str, os.PathLike],
+    worker_id: typing.Union[str, int],
+    *,
+    lease_ttl: float = DEFAULT_LEASE_TTL_S,
+    max_attempts: int = DEFAULT_MAX_ATTEMPTS,
+    resume: bool = False,
+    poll_interval: typing.Optional[float] = None,
+    on_unit_built: typing.Optional[typing.Callable] = None,
+) -> dict:
+    """
+    One worker's whole life: join (or publish) the plan, then
+    claim/steal → build → commit until every unit is resolved, then
+    finalize. ``builder`` is a ready :class:`FleetModelBuilder` over the
+    FULL machine list (the plan is derived from it); ``on_unit_built``
+    is called with each committed unit's (model, machine) dict — the
+    CLI uses it for per-machine reporting.
+
+    Returns the merged ``build_report.json`` payload.
+    """
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder  # noqa: F401
+
+    # the chaos seams (worker:die / lease:stall @worker) target workers
+    # by this env var; orchestrated children inherit it pre-set
+    os.environ[faults.WORKER_ID_ENV_VAR] = str(worker_id)
+    machines = builder.machines
+    by_name = {m.name: m for m in machines}
+    units = plan_units(machines)
+    ledger = Ledger(
+        output_dir,
+        worker_id,
+        lease_ttl=lease_ttl,
+        max_attempts=max_attempts,
+    )
+    ledger.ensure_plan(units)
+    poll = (
+        poll_interval
+        if poll_interval is not None
+        else min(max(lease_ttl / 10.0, 0.05), 2.0)
+    )
+    started = time.time()
+    n_committed = 0
+    emit_event(
+        "worker_started",
+        worker=str(worker_id),
+        n_units=len(units),
+        n_machines=len(machines),
+        lease_ttl_s=lease_ttl,
+    )
+    ledger.start_heartbeat()
+    try:
+        with tracing.start_span(
+            "build.fleet",
+            n_machines=len(machines),
+            worker=str(worker_id),
+            resume=bool(resume),
+        ):
+            while True:
+                aborted = ledger.aborted_info()
+                if aborted is not None:
+                    raise FleetBuildAborted(
+                        f"Fleet build aborted by worker "
+                        f"{aborted.get('worker')}: {aborted.get('error')}"
+                    )
+                claimed = ledger.claim_next()
+                if claimed is None:
+                    if ledger.all_resolved():
+                        break
+                    time.sleep(poll)
+                    continue
+                unit_machines = [by_name[n] for n in claimed.machines]
+                try:
+                    report, built = builder.build_unit(
+                        unit_machines, output_dir, resume=resume
+                    )
+                except Exception as exc:
+                    if not ledger.owns(claimed.uid):
+                        # the lease was stolen mid-build (a stall): the
+                        # stealer is rebuilding this unit, and racing it
+                        # on the artifact directories is exactly how a
+                        # flush can fail — the STALLED worker abandons,
+                        # it does not abort the job the stealer is
+                        # healing
+                        logger.warning(
+                            "Worker %s: unit %s build failed after its "
+                            "lease was stolen (%r); abandoning the unit",
+                            worker_id, claimed.uid, exc,
+                        )
+                        continue
+                    # on_error="raise" semantics (skip-mode failures are
+                    # recorded INSIDE the unit report, not raised): this
+                    # worker aborts the whole job, releasing its lease so
+                    # peers fail fast instead of waiting out the TTL
+                    ledger.mark_aborted(repr(exc))
+                    ledger.release(claimed.uid)
+                    raise
+                except BaseException:
+                    # KeyboardInterrupt/SystemExit kill THIS worker, not
+                    # the job: release the lease so a peer steals the
+                    # unit immediately instead of waiting out the TTL
+                    ledger.release(claimed.uid)
+                    raise
+                # chaos seam: die AFTER the artifacts flushed but BEFORE
+                # the done record — the steal-then-rebuild idempotency
+                # exercise (rebuilt artifacts are bit-identical)
+                faults.worker_die("commit")
+                if ledger.commit(claimed.uid, report):
+                    n_committed += 1
+                    if on_unit_built is not None:
+                        on_unit_built(built)
+    finally:
+        ledger.stop_heartbeat()
+    final = ledger.finalize(on_error=builder.on_error)
+    emit_event(
+        "worker_finished",
+        worker=str(worker_id),
+        n_units_committed=n_committed,
+        wall_time_s=round(time.time() - started, 4),
+    )
+    return final if final is not None else {}
+
+
+def clear_ledger(output_dir: typing.Union[str, os.PathLike]) -> None:
+    """Remove a previous run's ledger (a NON-resume build starts from a
+    clean plan; artifacts are the builder's business, not the ledger's)."""
+    shutil.rmtree(Path(output_dir) / LEDGER_DIRNAME, ignore_errors=True)
+
+
+def orchestrate(
+    n_workers: int,
+    machines_config: typing.List[dict],
+    output_dir: str,
+    worker_args: typing.List[str],
+    *,
+    resume: bool = False,
+    on_error: str = "raise",
+    env_overrides: typing.Optional[typing.Dict[str, str]] = None,
+) -> dict:
+    """
+    Parent-side fan-out: spawn ``n_workers`` ``build-fleet`` worker
+    processes (each a single-process JAX fleet) against one shared
+    ledger, wait for them, and judge the JOB by the ledger — a dead
+    worker is fine as long as the survivors resolved every unit (that
+    is the point), an unresolved or aborted ledger is a failed build
+    whatever the exit codes said.
+
+    The machines config travels to the children as a FILE on the shared
+    ledger directory (``--machines-from``), never as one argv/env
+    string — Linux caps each exec string at 128KB (``MAX_ARG_STRLEN``),
+    which a thousand-machine config blows straight through.
+    """
+    if not resume:
+        clear_ledger(output_dir)
+    ledger_base = Path(output_dir) / LEDGER_DIRNAME
+    ledger_base.mkdir(parents=True, exist_ok=True)
+    config_path = atomic.atomic_write_json(
+        ledger_base / "machines.json", machines_config
+    )
+    env = os.environ.copy()
+    env.pop("MACHINES", None)  # the file wins; a stale env var must not
+    env["OUTPUT_DIR"] = str(output_dir)
+    env.update(env_overrides or {})
+    procs = []
+    for wid in range(n_workers):
+        child_env = dict(env)
+        child_env[faults.WORKER_ID_ENV_VAR] = str(wid)
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "gordo_tpu.cli",
+                    "build-fleet",
+                    "--worker-id",
+                    str(wid),
+                    "--machines-from",
+                    str(config_path),
+                    *worker_args,
+                ],
+                env=child_env,
+            )
+        )
+    codes = [proc.wait() for proc in procs]
+    probe = Ledger(output_dir, worker_id="orchestrator")
+    aborted = probe.aborted_info()
+    if aborted is not None:
+        raise FleetBuildAborted(
+            f"Fleet build aborted by worker {aborted.get('worker')}: "
+            f"{aborted.get('error')} (worker exit codes: {codes})"
+        )
+    try:
+        resolved = probe.all_resolved()
+    except (OSError, KeyError, ValueError):
+        resolved = False
+    if not resolved:
+        raise FleetBuildAborted(
+            f"Fleet build did not complete: every worker exited (codes "
+            f"{codes}) with unresolved ledger units under "
+            f"{Path(output_dir) / LEDGER_DIRNAME}"
+        )
+    # finalize from the LEDGER, never trust a report already on disk: a
+    # worker can die between its last commit and finalize (the build is
+    # complete, the merge just never ran), and a stale report from an
+    # earlier run must not masquerade as this one's. finalize is
+    # idempotent and deterministic, so re-running it here is safe.
+    report = probe.finalize(on_error=on_error)
+    if report is None:
+        raise FleetBuildAborted(
+            f"Fleet build did not complete (worker exit codes {codes})"
+        )
+    if any(codes):
+        logger.warning(
+            "Fleet build completed via lease steal despite worker "
+            "death(s) (exit codes %s) — goodput retained, see "
+            "--ledger-status",
+            codes,
+        )
+    return report

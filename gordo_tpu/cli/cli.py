@@ -27,6 +27,7 @@ import yaml
 
 from gordo_tpu import __version__, serializer, utils
 from gordo_tpu.builder import FleetModelBuilder, ModelBuilder
+from gordo_tpu.builder import ledger as fleet_ledger
 from gordo_tpu.cli.client import client as gordo_client
 from gordo_tpu.cli.custom_types import HostIP, key_value_par
 from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
@@ -199,8 +200,73 @@ def build(
 
 
 @click.command("build-fleet")
-@click.argument("machines-config", envvar="MACHINES", type=yaml.safe_load)
+@click.argument(
+    "machines-config",
+    envvar="MACHINES",
+    type=yaml.safe_load,
+    required=False,
+    default=None,
+)
 @click.argument("output-dir", default="/data", envvar="OUTPUT_DIR")
+@click.option(
+    "--workers",
+    default="1",
+    envvar="GORDO_BUILD_WORKERS",
+    show_default=True,
+    help="Shard the build's buckets across this many worker PROCESSES "
+    "coordinated through a crash-tolerant work ledger on the shared "
+    "output volume ('auto' sizes to the host). 1 (the default) is the "
+    "plain single-process build — no ledger, no lease files. See "
+    "docs/robustness.md 'Multi-worker builds'.",
+)
+@click.option(
+    "--worker-id",
+    type=int,
+    default=None,
+    envvar="GORDO_WORKER_ID",
+    help="Run as ONE worker of a multi-worker build (joins the ledger "
+    "under OUTPUT-DIR instead of spawning workers). Normally set by "
+    "the orchestrator; set it yourself to run workers across hosts "
+    "sharing the output volume.",
+)
+@click.option(
+    "--lease-ttl",
+    type=click.FloatRange(min=0, min_open=True),
+    default=fleet_ledger.DEFAULT_LEASE_TTL_S,
+    envvar="GORDO_LEASE_TTL",
+    show_default=True,
+    help="Seconds a work unit's lease may go without a heartbeat before "
+    "a live worker steals it (a SIGKILL'd worker costs one unit of "
+    "rework, not the build).",
+)
+@click.option(
+    "--max-attempts",
+    type=click.IntRange(min=1),
+    default=fleet_ledger.DEFAULT_MAX_ATTEMPTS,
+    envvar="GORDO_MAX_ATTEMPTS",
+    show_default=True,
+    help="Worker deaths a unit survives before it is poisoned: recorded "
+    "as a per-machine casualty in build_report.json instead of "
+    "crash-looping the fleet.",
+)
+@click.option(
+    "--machines-from",
+    type=click.Path(exists=True, dir_okay=False),
+    default=None,
+    help="Read MACHINES-CONFIG from this JSON/YAML file instead of the "
+    "argument/env var — Linux caps each exec string at 128KB, which "
+    "thousand-machine configs outgrow; the multi-worker orchestrator "
+    "hands its workers their config this way via the ledger directory.",
+)
+@click.option(
+    "--ledger-status",
+    "ledger_status_dir",
+    type=click.Path(exists=False, file_okay=False, dir_okay=True),
+    default=None,
+    help="Print the multi-worker ledger's state under this build output "
+    "directory — unit states, attempts, per-worker last-heartbeat age "
+    "(spot a stalled worker BEFORE its lease expires) — and exit.",
+)
 @click.option(
     "--resume/--no-resume",
     default=False,
@@ -259,6 +325,12 @@ def build_fleet(
     on_error: str,
     fetch_retries: int,
     fetch_timeout: float,
+    workers: str,
+    worker_id: int,
+    lease_ttl: float,
+    max_attempts: int,
+    machines_from: str,
+    ledger_status_dir: str,
     model_register_dir: str,
     print_cv_scores: bool,
     model_parameter: List[Tuple[str, Any]],
@@ -271,8 +343,69 @@ def build_fleet(
     (TPU-native replacement for the reference's one-pod-per-machine fan-out;
     SURVEY.md §2.10/§7.6). MACHINES-CONFIG is a YAML list of machine
     configs; artifacts land at OUTPUT-DIR/<machine-name>/.
+
+    With ``--workers N`` (or ``--worker-id`` on N hosts sharing the
+    output volume) the buckets shard across N worker processes
+    coordinated through a crash-tolerant work ledger: a killed worker's
+    units are lease-stolen and rebuilt by the survivors, costing one
+    unit of rework instead of the build (docs/robustness.md).
     """
     try:
+        if ledger_status_dir is not None:
+            _print_ledger_status(
+                ledger_status_dir, lease_ttl=lease_ttl,
+                max_attempts=max_attempts,
+            )
+            return 0
+        if machines_from is not None:
+            with open(machines_from) as fh:
+                machines_config = yaml.safe_load(fh)
+        if machines_config is None:
+            raise click.UsageError(
+                "MACHINES-CONFIG is required (argument or MACHINES env var)"
+            )
+        n_workers = 1
+        if str(workers).strip().lower() != "1":
+            n_workers = fleet_ledger.resolve_workers(workers)
+        if worker_id is None and n_workers > 1:
+            # orchestrator: the children parse/expand the config
+            # themselves, so pass it through verbatim (via env — large
+            # configs outgrow argv)
+            # no positionals: the children read MACHINES and OUTPUT_DIR
+            # from the env (orchestrate sets both); a positional here
+            # would bind to the child's machines-config slot
+            worker_args = [
+                "--workers", str(n_workers),
+                "--lease-ttl", str(lease_ttl),
+                "--max-attempts", str(max_attempts),
+                "--epoch-chunk", str(epoch_chunk),
+                "--on-error", on_error,
+                "--fetch-retries", str(fetch_retries),
+            ]
+            if fetch_timeout is not None:
+                worker_args += ["--fetch-timeout", str(fetch_timeout)]
+            if resume:
+                worker_args += ["--resume"]
+            if print_cv_scores:
+                worker_args += ["--print-cv-scores"]
+            for key, value in model_parameter:
+                worker_args += ["--model-parameter", f"{key},{value}"]
+            logger.info(
+                "Fleet-building %d machines with %d ledger workers, "
+                "output at: %s",
+                len(machines_config), n_workers, output_dir,
+            )
+            report = fleet_ledger.orchestrate(
+                n_workers,
+                machines_config,
+                str(output_dir),
+                worker_args,
+                resume=resume,
+                on_error=on_error,
+            )
+            _print_casualties(report)
+            return 0
+
         utils.enable_compile_cache()
         machines = []
         for machine_config in machines_config:
@@ -287,9 +420,6 @@ def build_fleet(
                 serializer.from_definition(machine.model)
             )
             machines.append(machine)
-        logger.info(
-            "Fleet-building %d machines, output at: %s", len(machines), output_dir
-        )
         builder = FleetModelBuilder(
             machines,
             epoch_chunk=epoch_chunk,
@@ -297,26 +427,134 @@ def build_fleet(
             fetch_retries=fetch_retries,
             fetch_timeout=fetch_timeout,
         )
+
+        if worker_id is not None:
+            logger.info(
+                "Fleet worker %d joining the ledger under %s "
+                "(%d machines total)",
+                worker_id, output_dir, len(machines),
+            )
+
+            def _report_unit(built):
+                for _, machine_out in built.values():
+                    machine_out.report()
+                    if print_cv_scores:
+                        for score in get_all_score_strings(machine_out):
+                            print(f"{machine_out.name}: {score}")
+
+            report = fleet_ledger.run_worker(
+                builder,
+                output_dir,
+                worker_id,
+                lease_ttl=lease_ttl,
+                max_attempts=max_attempts,
+                resume=resume,
+                on_unit_built=_report_unit,
+            )
+            _print_casualties(report)
+            return 0
+
+        logger.info(
+            "Fleet-building %d machines, output at: %s", len(machines), output_dir
+        )
         built = builder.build(output_dir_base=output_dir, resume=resume)
         for _, machine_out in built:
             machine_out.report()
             if print_cv_scores:
                 for score in get_all_score_strings(machine_out):
                     print(f"{machine_out.name}: {score}")
-        for record in builder.build_failures_:
-            print(
-                f"FAILED {record['machine']} ({record['phase']}): "
-                f"{record['error']}"
-            )
-        for record in builder.quarantined_:
-            print(
-                f"QUARANTINED {record['machine']} at epoch "
-                f"{record['epoch']} (artifact holds last finite params)"
-            )
+        _print_casualties(
+            {
+                "failed": builder.build_failures_,
+                "quarantined": builder.quarantined_,
+            }
+        )
+    except click.ClickException:
+        raise
     except Exception:
         _report_and_exit(exceptions_reporter_file, exceptions_report_level)
     else:
         return 0
+
+
+def _print_casualties(report: dict) -> None:
+    """The FAILED/QUARANTINED stdout lines of a ledger build, from the
+    merged ``build_report.json`` (the in-process casualty attributes
+    only cover THIS worker's units)."""
+    for record in report.get("failed") or []:
+        print(
+            f"FAILED {record.get('machine')} ({record.get('phase')}): "
+            f"{record.get('error')}"
+        )
+    for record in report.get("quarantined") or []:
+        print(
+            f"QUARANTINED {record.get('machine')} at epoch "
+            f"{record.get('epoch')} (artifact holds last finite params)"
+        )
+
+
+def _print_ledger_status(
+    output_dir: str, lease_ttl: float, max_attempts: int
+) -> None:
+    """Human-readable ``--ledger-status`` report: unit states plus
+    per-worker last-heartbeat age, so an operator can spot a stalled
+    worker BEFORE its lease expires (cross-linked from the lifecycle
+    ``watch`` runbook, docs/lifecycle.md)."""
+    probe = fleet_ledger.Ledger(
+        output_dir, worker_id="status",
+        lease_ttl=lease_ttl, max_attempts=max_attempts,
+    )
+    try:
+        status = probe.status()
+    except FileNotFoundError:
+        click.echo(
+            f"No ledger under {output_dir} (single-worker builds keep none)"
+        )
+        return
+    counts = status["counts"]
+    click.echo(
+        f"Ledger {status['ledger_dir']}: "
+        f"{counts['done']} done / {counts['leased']} leased / "
+        f"{counts['pending']} pending / {counts['casualty']} poisoned "
+        f"(lease TTL {status['lease_ttl_s']}s, "
+        f"max attempts {status['max_attempts']})"
+    )
+    for unit in status["units"]:
+        state = unit["state"]
+        line = f"  {unit['unit']}  {state:<8} ({unit['n_machines']} machines)"
+        if state == "leased":
+            age = unit.get("heartbeat_age_s")
+            line += (
+                f"  worker {unit.get('worker')}  attempt "
+                f"{unit.get('attempt')}  heartbeat "
+                f"{age if age is not None else '?'}s ago"
+            )
+            if unit.get("expired"):
+                line += "  ** EXPIRED: steal imminent **"
+        elif state == "done":
+            line += (
+                f"  worker {unit.get('worker')}  attempt {unit.get('attempt')}"
+            )
+        elif state == "casualty":
+            line += f"  poisoned after {unit.get('attempts')} attempt(s)"
+        click.echo(line)
+    if status["workers"]:
+        click.echo("Workers:")
+        for wid, info in status["workers"].items():
+            line = (
+                f"  {wid}  pid {info.get('pid')}  last heartbeat "
+                f"{info['last_heartbeat_age_s']}s ago"
+            )
+            if info.get("stalled"):
+                line += (
+                    f"  ** STALLED (> TTL "
+                    f"{info.get('lease_ttl_s', status['lease_ttl_s'])}s) **"
+                )
+            click.echo(line)
+    if status.get("aborted"):
+        click.echo(f"ABORTED: {status['aborted']}")
+    if status.get("finalized"):
+        click.echo("Finalized: build_report.json written")
 
 
 def expand_model(model_config: str, model_parameters: dict):

@@ -23,7 +23,6 @@ import dataclasses
 import json
 import logging
 import os
-import tempfile
 import typing
 from datetime import datetime, timezone
 
@@ -31,6 +30,7 @@ import numpy as np
 import pandas as pd
 
 from gordo_tpu.observability import emit_event
+from gordo_tpu.utils import atomic
 
 logger = logging.getLogger(__name__)
 
@@ -253,20 +253,9 @@ class DriftMonitor:
             "version": STATE_VERSION,
             "machines": {m: s.to_dict() for m, s in self._state.items()},
         }
-        parent = os.path.dirname(os.path.abspath(self.state_path))
-        os.makedirs(parent, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=parent, prefix=".drift-state-")
-        try:
-            with os.fdopen(fd, "w") as fh:
-                json.dump(payload, fh, indent=2, sort_keys=True)
-                fh.write("\n")
-            os.replace(tmp, self.state_path)
-        except BaseException:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+        atomic.atomic_write_json(
+            self.state_path, payload, indent=2, sort_keys=True
+        )
         return self.state_path
 
     def load(self) -> None:

@@ -32,6 +32,7 @@ import typing
 from pathlib import Path
 
 from gordo_tpu.robustness import faults
+from gordo_tpu.utils import atomic
 
 logger = logging.getLogger(__name__)
 
@@ -212,15 +213,7 @@ def repoint_latest(
         target: str = os.path.basename(target_dir)
     else:
         target = target_dir
-    tmp = os.path.join(
-        os.path.dirname(pointer), f".latest-tmp-{os.getpid()}"
-    )
-    try:
-        os.unlink(tmp)
-    except OSError:
-        pass
-    os.symlink(target, tmp)
-    os.replace(tmp, pointer)
+    atomic.atomic_symlink_swap(target, pointer)
 
 
 def read_promotion_report(

@@ -26,6 +26,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from gordo_tpu.utils import atomic
+
 logger = logging.getLogger(__name__)
 
 MANIFEST_FILENAME = "manifest.json"
@@ -106,10 +108,9 @@ class FleetCheckpointer:
                     manifest[str(path.relative_to(step_dir))] = (
                         path.stat().st_size
                     )
-            tmp = step_dir / (MANIFEST_FILENAME + ".tmp")
-            with open(tmp, "w") as fh:
-                json.dump(manifest, fh)
-            os.replace(tmp, step_dir / MANIFEST_FILENAME)
+            atomic.atomic_write_json(
+                step_dir / MANIFEST_FILENAME, manifest, trailing_newline=False
+            )
             faults.tear_checkpoint_files(step_dir)
 
     def _verify(self, epoch: int) -> bool:

@@ -152,4 +152,7 @@ class SqliteReporter(SqlReporter):
     def _connect(self):
         import sqlite3
 
-        return sqlite3.connect(self.path)
+        # generous busy timeout: concurrent upserts (the wire-shim race
+        # tests) must wait out a peer's write transaction on a loaded CI
+        # host instead of surfacing a spurious "database is locked"
+        return sqlite3.connect(self.path, timeout=30.0)

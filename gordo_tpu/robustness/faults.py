@@ -13,9 +13,15 @@ One spec is ``site:mode[:target][@key:value ...]``:
   ``batch`` (the dynamic-batching drainer's per-request seam: fires
   mid-batch for the request naming the target machine, failing ONLY
   that request's future — the no-poisoned-batch exercise,
-  server/batching.py), and the lifecycle seams (docs/lifecycle.md):
+  server/batching.py), the lifecycle seams (docs/lifecycle.md):
   ``drift`` (the lifecycle drift-scoring fetch), ``refit`` (the
-  warm-start refit build) and ``promote`` (revision assembly).
+  warm-start refit build) and ``promote`` (revision assembly), and the
+  multi-worker ledger seams (docs/robustness.md "Multi-worker builds"):
+  ``worker`` (``worker:die:<stage>`` — kill this worker process
+  outright at ``fetch``/``train``/``commit``, scoped by
+  ``@worker:<id>``) and ``lease`` (``lease:stall:<worker-id>`` — stop
+  heartbeating without dying, so the lease is stolen out from under a
+  live build).
 - ``mode`` — what happens there: ``raise`` (the seam raises
   :class:`InjectedFault`), ``nan`` (train/refit: the named machine's
   epoch loss goes NaN at ``@epoch:<e>``, driving the quarantine guard),
@@ -52,8 +58,16 @@ logger = logging.getLogger(__name__)
 FAULT_INJECT_ENV_VAR = "GORDO_FAULT_INJECT"
 
 _KNOWN_SITES = frozenset(
-    {"fetch", "train", "ckpt", "serve", "batch", "drift", "refit", "promote"}
+    {
+        "fetch", "train", "ckpt", "serve", "batch", "drift", "refit",
+        "promote", "worker", "lease",
+    }
 )
+
+#: the worker identity the ``worker``/``lease`` seams match ``@worker``
+#: params against — set by the multi-worker ledger (builder/ledger.py)
+#: and inherited by orchestrator-spawned worker processes
+WORKER_ID_ENV_VAR = "GORDO_WORKER_ID"
 
 
 class InjectedFault(RuntimeError):
@@ -333,6 +347,70 @@ def refit_degrade_scale(name: typing.Optional[str]) -> typing.Optional[float]:
     (docs/lifecycle.md). None = candidate untouched.
     """
     return _scale_for("refit", "degrade", name, 10.0)
+
+
+def worker_die(stage: str) -> None:
+    """
+    The worker-death seam (site ``worker``, mode ``die``): a matching
+    spec kills THIS process on the spot — ``os._exit``, no cleanup, no
+    atexit, the SIGKILL shape the work ledger's lease/steal protocol
+    must absorb (docs/robustness.md "Multi-worker builds"). ``target``
+    names the stage the death fires at (``fetch`` — lease held, nothing
+    fetched; ``train`` — CV done, final fit unstarted; ``commit`` —
+    artifacts flushed, done record unwritten; omitted = every stage),
+    and ``@worker:<id>`` scopes it to ONE worker of a multi-worker
+    build, matched against ``GORDO_WORKER_ID`` — without it every
+    worker that reaches the stage dies, which with a bounded
+    ``max_attempts`` is exactly the poisoned-unit crash loop.
+    ``@attempts:N`` limits the spec to its first N firings **across
+    processes that share a ledger only by luck** — each worker process
+    parses its own registry, so attempts budgets are per-process here.
+
+    The ``fault_injected`` event is emitted BEFORE the exit, so a chaos
+    run's event log records the death the dead worker itself cannot.
+    """
+    registry = active_registry()
+    if registry is None:
+        return
+    spec = _find_mode(registry, "worker", "die", stage)
+    if spec is None:
+        return
+    worker_id = os.environ.get(WORKER_ID_ENV_VAR)
+    want = spec.params.get("worker")
+    if want is not None and want != (worker_id or ""):
+        return
+    attempts = spec.param_int("attempts", 0)
+    if attempts and spec.fires >= attempts:
+        return
+    registry.fire(spec, stage=stage, worker=worker_id)
+    logger.warning(
+        "Fault injection: worker %s dying at stage %r (os._exit)",
+        worker_id, stage,
+    )
+    os._exit(137)
+
+
+def lease_stall(worker_id: typing.Union[str, int]) -> bool:
+    """
+    The heartbeat seam (site ``lease``, mode ``stall``): when a spec
+    targets this worker (``lease:stall:<worker-id>``; no target = every
+    worker), its heartbeat thread SKIPS the beat — the worker keeps
+    building, but to its peers it looks dead, so its lease expires and
+    is stolen while the work is still running. The double-commit guard
+    (the stalled worker wakes, finds its lease gone, and must NOT
+    commit) is exactly what this site exists to prove
+    (builder/ledger.py). Fires the ``fault_injected`` event once, on
+    the first skipped beat.
+    """
+    registry = active_registry()
+    if registry is None:
+        return False
+    spec = _find_mode(registry, "lease", "stall", str(worker_id))
+    if spec is None:
+        return False
+    if spec.fires == 0:
+        registry.fire(spec, worker=str(worker_id))
+    return True
 
 
 def inject_promotion_tear(n_assembled: int) -> None:

@@ -17,6 +17,8 @@ import shutil
 from pathlib import Path
 from typing import Any, Optional, Union
 
+from gordo_tpu.utils import atomic
+
 try:  # optional: images without simplejson fall back to stdlib json
     import simplejson
 except ImportError:
@@ -116,13 +118,7 @@ def dump(obj: Any, dest_dir: Union[os.PathLike, str], metadata: Optional[dict] =
         if metadata is not None:
             with open(tmp_dir / METADATA_FILENAME, "w") as f:
                 _dump_metadata_json(metadata, f)
-        if dest_dir.exists():
-            # os.replace cannot rename onto a non-empty directory; the
-            # rmtree+rename pair still cannot produce a TORN artifact —
-            # the worst a crash between them leaves is no artifact,
-            # which the resume path treats as "rebuild"
-            shutil.rmtree(dest_dir)
-        os.replace(tmp_dir, dest_dir)
+        atomic.atomic_publish_dir(tmp_dir, dest_dir)
     except BaseException:
         shutil.rmtree(tmp_dir, ignore_errors=True)
         raise

@@ -8,7 +8,7 @@ from .utils import (
     honor_jax_platforms_env,
     replace_all_non_ascii_chars_with_default,
 )
-from . import disk_registry
+from . import atomic, disk_registry
 from .compat import normalize_frequency
 
 __all__ = [
@@ -16,6 +16,7 @@ __all__ = [
     "enable_compile_cache",
     "honor_jax_platforms_env",
     "replace_all_non_ascii_chars_with_default",
+    "atomic",
     "disk_registry",
     "normalize_frequency",
 ]

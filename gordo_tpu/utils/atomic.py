@@ -1,0 +1,146 @@
+"""
+Atomic filesystem publication, in one place.
+
+Four subsystems grew their own copy of the write-temp-then-``os.replace``
+discipline (the serializer's artifact flush, the builder's
+``build_report.json``, the checkpoint manifest, the lifecycle ``latest``
+pointer) and the lifecycle drift state made five. The shapes differ —
+file, directory, symlink, create-exclusive — but the invariant is one:
+a reader (the model server polling a report, a resuming build loading an
+artifact, a peer worker scanning the ledger) must see the OLD complete
+state or the NEW complete state, never a torn intermediate.
+
+All helpers stage in the destination's own directory (``os.replace`` and
+``os.link`` are only atomic within one filesystem) and clean their
+staging entry up on failure, so a crash leaves at worst a dot/tmp file
+the next run ignores.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+import typing
+from pathlib import Path
+
+
+def atomic_write_json(
+    path: typing.Union[str, os.PathLike],
+    payload: typing.Any,
+    *,
+    indent: typing.Optional[int] = None,
+    sort_keys: bool = False,
+    default: typing.Optional[typing.Callable] = None,
+    trailing_newline: bool = True,
+) -> Path:
+    """
+    Publish ``payload`` as JSON at ``path`` atomically: serialize into a
+    sibling temp file, then ``os.replace`` it into place. Readers see
+    the previous file or the new one, never a partial write. Parent
+    directories are created as needed.
+    """
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.tmp-")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(
+                payload, fh, indent=indent, sort_keys=sort_keys, default=default
+            )
+            if trailing_newline:
+                fh.write("\n")
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
+def atomic_create_json(
+    path: typing.Union[str, os.PathLike],
+    payload: typing.Any,
+    *,
+    indent: typing.Optional[int] = None,
+    sort_keys: bool = False,
+    default: typing.Optional[typing.Callable] = None,
+) -> Path:
+    """
+    Create-exclusive sibling of :func:`atomic_write_json`: publish the
+    complete JSON file at ``path`` ONLY if nothing exists there, raising
+    :class:`FileExistsError` otherwise — and never exposing a partial
+    file to concurrent readers (the temp file is finished first, then
+    ``os.link``-ed into place; the link either lands whole or fails).
+
+    The first-writer-wins primitive the work ledger's done/casualty
+    records are built on: N racing workers may each assemble a record,
+    exactly one publication succeeds.
+    """
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.tmp-")
+    try:
+        with os.fdopen(fd, "w") as fh:
+            json.dump(
+                payload, fh, indent=indent, sort_keys=sort_keys, default=default
+            )
+            fh.write("\n")
+        os.link(tmp, path)  # atomic + exclusive: EEXIST if path exists
+    finally:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return path
+
+
+def atomic_publish_dir(
+    tmp_dir: typing.Union[str, os.PathLike],
+    dest_dir: typing.Union[str, os.PathLike],
+) -> Path:
+    """
+    Publish a fully-assembled staging DIRECTORY at ``dest_dir`` via one
+    ``os.replace``. An existing destination is removed first —
+    ``os.replace`` cannot rename onto a non-empty directory — which
+    still cannot produce a torn result: the worst a crash between the
+    two steps leaves is no directory at all, which readers (the resume
+    scan, the ledger's rebuild-on-steal) treat as "not built".
+    """
+    tmp_dir, dest_dir = Path(tmp_dir), Path(dest_dir)
+    if dest_dir.exists():
+        shutil.rmtree(dest_dir)
+    os.replace(tmp_dir, dest_dir)
+    return dest_dir
+
+
+def atomic_symlink_swap(
+    target: typing.Union[str, os.PathLike],
+    pointer: typing.Union[str, os.PathLike],
+) -> None:
+    """
+    Re-point the symlink at ``pointer`` to ``target`` atomically: a
+    fresh sibling symlink is created and ``os.replace``-d over the
+    pointer, so readers resolve the old target or the new one, never a
+    missing link. (``os.replace`` onto a symlink replaces the LINK, not
+    what it points at.)
+    """
+    pointer = str(pointer)
+    tmp = os.path.join(
+        os.path.dirname(pointer) or ".",
+        f".{os.path.basename(pointer)}-tmp-{os.getpid()}",
+    )
+    try:
+        os.unlink(tmp)
+    except OSError:
+        pass
+    os.symlink(str(target), tmp)
+    try:
+        os.replace(tmp, pointer)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise

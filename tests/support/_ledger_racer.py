@@ -1,0 +1,88 @@
+"""
+One racing worker process for the ledger's concurrent-claims tests: no
+JAX, no model builds — the "build" of a unit is a marker line in this
+worker's output file, so N real processes can hammer one ledger's
+claim/steal/commit protocol in seconds.
+
+The ``worker:die:commit`` chaos seam is honored between "build" and
+commit, so a parent can SIGKILL-shape one racer at the worst moment and
+assert the survivors steal and finish the plan.
+
+Usage::
+
+    python _ledger_racer.py <output_dir> <worker_id> <n_units> \
+        <out_file> <lease_ttl> <max_attempts> [<build_sleep_s>]
+
+Output file: one line per action — ``CLAIM <uid> <attempt>`` and
+``COMMIT <uid> <True|False>`` — then ``DONE`` on a clean exit.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+
+from gordo_tpu.builder.ledger import Ledger, WorkUnit  # noqa: E402
+from gordo_tpu.robustness import faults  # noqa: E402
+
+
+def main() -> None:
+    output_dir, worker_id, n_units, out_file = sys.argv[1:5]
+    lease_ttl = float(sys.argv[5])
+    max_attempts = int(sys.argv[6])
+    build_sleep = float(sys.argv[7]) if len(sys.argv) > 7 else 0.01
+
+    os.environ[faults.WORKER_ID_ENV_VAR] = str(worker_id)
+    units = [
+        WorkUnit(uid=f"u{i:03d}-racer", machines=(f"m-{i}",))
+        for i in range(int(n_units))
+    ]
+    ledger = Ledger(
+        output_dir, worker_id, lease_ttl=lease_ttl, max_attempts=max_attempts
+    )
+    ledger.ensure_plan(units)
+
+    # start barrier: interpreter startup skew must not let one racer
+    # finish the whole plan before its peer exists — announce readiness,
+    # then wait for the parent's "go" file before claiming anything
+    ready = os.path.join(output_dir, f".racer-ready-{worker_id}")
+    go = os.path.join(output_dir, ".racer-go")
+    open(ready, "w").close()
+    deadline = time.time() + 60.0
+    while not os.path.exists(go):
+        if time.time() > deadline:
+            raise TimeoutError("parent never released the start barrier")
+        time.sleep(0.01)
+
+    ledger.start_heartbeat()
+    out = open(out_file, "a", buffering=1)
+    try:
+        while True:
+            claimed = ledger.claim_next()
+            if claimed is None:
+                if ledger.all_resolved():
+                    break
+                time.sleep(min(0.05, lease_ttl / 10))
+                continue
+            out.write(f"CLAIM {claimed.uid} {claimed.attempt}\n")
+            time.sleep(build_sleep)  # the "build"
+            faults.worker_die("commit")
+            committed = ledger.commit(
+                claimed.uid,
+                {
+                    "built": list(claimed.machines),
+                    "failed": [],
+                    "quarantined": [],
+                    "buckets": [],
+                },
+            )
+            out.write(f"COMMIT {claimed.uid} {committed}\n")
+    finally:
+        ledger.stop_heartbeat()
+    out.write("DONE\n")
+    out.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -10,6 +10,7 @@ import os
 import socket
 import subprocess
 import sys
+import time
 
 import pytest
 
@@ -90,6 +91,28 @@ def test_process_info_single_host():
     assert info["local_device_count"] == 8
 
 
+def _probe_coordinator_port(attempt: int) -> int:
+    """
+    Deterministic port selection for the gloo coordinator: a base
+    derived from THIS pid (so parallel suites on one host probe
+    disjoint ranges instead of all racing the same ephemeral port the
+    kernel just handed out — the observed flake shape), scanned for a
+    currently-bindable port. ``attempt`` shifts the base so a retry
+    never re-probes the port that just collided.
+    """
+    span = 20000  # ports 20000-39999
+    base = (os.getpid() * 211 + attempt * 4099) % span
+    for offset in range(100):
+        port = 20000 + (base + offset * 97) % span
+        try:
+            with socket.socket() as probe:
+                probe.bind(("localhost", port))
+        except OSError:
+            continue
+        return port
+    pytest.skip("no bindable localhost port found")
+
+
 def test_two_process_fleet_step_executes():
     """
     ``jax.distributed.initialize`` must actually RUN, not just be wrapper
@@ -105,28 +128,36 @@ def test_two_process_fleet_step_executes():
         # the workers pin their own platform/device-count flags
         if k not in ("XLA_FLAGS", "JAX_PLATFORMS")
     }
+    try:
+        with socket.socket() as probe:
+            probe.bind(("localhost", 0))
+    except OSError as exc:  # no localhost sockets in this sandbox
+        pytest.skip(f"cannot bind localhost sockets: {exc}")
 
-    def launch_cluster():
-        try:
-            with socket.socket() as probe:
-                probe.bind(("localhost", 0))
-                port = probe.getsockname()[1]
-        except OSError as exc:  # no localhost sockets in this sandbox
-            pytest.skip(f"cannot bind localhost sockets: {exc}")
-        procs = [
-            subprocess.Popen(
-                [sys.executable, worker, str(port), str(pid), "2"],
-                stdout=subprocess.PIPE,
-                stderr=subprocess.PIPE,
-                text=True,
-                env=env,
+    def launch_cluster(attempt):
+        port = _probe_coordinator_port(attempt)
+        procs = []
+        for pid in range(2):
+            procs.append(
+                subprocess.Popen(
+                    [sys.executable, worker, str(port), str(pid), "2"],
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.PIPE,
+                    text=True,
+                    env=env,
+                )
             )
-            for pid in range(2)
-        ]
+            if pid == 0:
+                # stagger: give the coordinator process a head start
+                # toward binding before its client starts dialing
+                time.sleep(0.5)
         outs, errs, codes = [], [], []
         try:
             for proc in procs:
-                out, err = proc.communicate(timeout=300)
+                try:
+                    out, err = proc.communicate(timeout=240)
+                except subprocess.TimeoutExpired:
+                    out, err = "", "worker timed out after 240s"
                 outs.append(out)
                 errs.append(err)
                 codes.append(proc.returncode)
@@ -134,14 +165,17 @@ def test_two_process_fleet_step_executes():
             for proc in procs:
                 if proc.poll() is None:
                     proc.kill()
+                    proc.wait()
         return outs, errs, codes
 
-    outs, errs, codes = launch_cluster()
-    if any(codes):
-        # the probed port can be taken between probe close and the
-        # coordinator bind (parallel suites on one host): one retry with
-        # a fresh port distinguishes that race from a real failure
-        outs, errs, codes = launch_cluster()
+    # bounded retries: the probed port can still be taken between probe
+    # close and the coordinator bind, and a loaded host can starve the
+    # cluster handshake — a fresh attempt on a fresh port distinguishes
+    # those races from a real failure
+    for attempt in range(3):
+        outs, errs, codes = launch_cluster(attempt)
+        if not any(code != 0 or code is None for code in codes):
+            break
     for out, err, code in zip(outs, errs, codes):
         assert code == 0, f"worker failed:\n{out}\n{err[-3000:]}"
 
