@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test lint bench build-multiworker images push
+.PHONY: all test lint bench bench-cold-start build-multiworker images push
 
 all: lint test
 
@@ -27,6 +27,11 @@ lint:
 
 bench:
 	python bench.py
+
+# time-to-first-prediction for a freshly exec'd server, cold trace vs
+# the build-time AOT executable cache (docs/performance.md)
+bench-cold-start:
+	python benchmarks/cold_start.py --machines 6 --model lstm --repeats 2
 
 # 2-worker crash-tolerant ledger build of the example fleet config
 # (docs/robustness.md "Multi-worker builds") — the smoke proof that N

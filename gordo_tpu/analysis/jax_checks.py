@@ -72,6 +72,10 @@ HOT_PATH_PATTERNS = (
     # an accidental device sync per scan would serialize every worker
     # on one device queue
     "gordo_tpu/builder/ledger.py",
+    # the program cache sits on EVERY dispatch path (trainer epochs,
+    # fleet-scoring requests): a host sync in a lookup loop would stall
+    # the very cold-start path the subsystem exists to remove
+    "gordo_tpu/programs/",
 )
 
 

@@ -181,6 +181,7 @@ class FleetModelBuilder:
         fetch_backoff: Callable[[int], float] = backoff_seconds,
         initial_params: Optional[Dict[str, Any]] = None,
         fault_sites: Tuple[str, ...] = ("train",),
+        aot_cache: bool = False,
     ):
         self.machines = machines
         if mesh is None and auto_mesh:
@@ -198,6 +199,13 @@ class FleetModelBuilder:
         self.fetch_backoff = fetch_backoff
         self.initial_params = initial_params
         self.fault_sites = tuple(fault_sites)
+        #: AOT-compile + serialize the built collection's SERVING
+        #: programs beside the artifacts (<output>/.programs/), so a
+        #: fresh server's cold start is a deserialize instead of a
+        #: retrace (docs/performance.md "AOT executable cache"). Off by
+        #: default at the API layer (tests build thousands of tiny
+        #: fleets); the build-fleet CLI defaults it ON.
+        self.aot_cache = bool(aot_cache)
         #: per-bucket telemetry accumulated by _build_bucket, assembled
         #: into telemetry_report_ (and persisted next to artifacts) by
         #: build()
@@ -438,6 +446,7 @@ class FleetModelBuilder:
             output_dir=str(base) if base is not None else None,
             resume=bool(resume),
         )
+        self._compile_cache_start_bytes = self._sample_compile_cache()
 
         results: Dict[str, Tuple[BaseEstimator, Machine]] = {}
         to_build = list(self.machines)
@@ -478,6 +487,8 @@ class FleetModelBuilder:
             raise
 
         n_resumed = len(self.machines) - len(to_build)
+        if base is not None and self.aot_cache:
+            self._export_aot_programs(base, results)
         self._finish_telemetry(
             base=base,
             build_start=build_start,
@@ -687,6 +698,47 @@ class FleetModelBuilder:
         }
         return report, results
 
+    def _sample_compile_cache(self) -> Optional[int]:
+        """
+        Sample the persistent XLA compile cache's on-disk size into the
+        ``gordo_compile_cache_dir_bytes`` gauge — called at build start
+        AND end; the returned size lets ``_build_all`` stash the start
+        value so the persisted telemetry report records the GROWTH (the
+        gauge alone is last-write-wins and would only show the end).
+        Null-graceful when no cache is enabled (CPU tests,
+        ``GORDO_XLA_CACHE_DIR=""``), like the HBM watermark fields.
+        """
+        from gordo_tpu.utils import compile_cache_dir_bytes
+
+        size = compile_cache_dir_bytes()
+        if size is None:
+            return None
+        get_registry().gauge(
+            "gordo_compile_cache_dir_bytes",
+            "On-disk bytes of the persistent XLA compile cache",
+        ).set(size)
+        return size
+
+    def _export_aot_programs(
+        self, base: Path, results: Dict[str, Tuple[BaseEstimator, Machine]]
+    ) -> None:
+        """
+        Build-time AOT: compile + serialize the collection's serving
+        programs beside the artifacts from the models still in memory.
+        Best-effort end to end — the artifacts are already flushed, and
+        a failed export only costs the next server its instant cold
+        start, never the build.
+        """
+        from gordo_tpu.programs import export_serving_programs
+
+        try:
+            export_serving_programs(
+                base,
+                models={name: pair[0] for name, pair in results.items()},
+            )
+        except Exception as exc:  # noqa: BLE001 - export is best-effort
+            logger.warning("AOT serving-program export failed: %s", exc)
+
     def _finish_telemetry(
         self,
         base: Optional[Path],
@@ -751,6 +803,16 @@ class FleetModelBuilder:
                 "gordo_build_peak_hbm_bytes",
                 "Peak device memory observed across builds",
             ).set_max(peak)
+        end_bytes = self._sample_compile_cache()
+        if end_bytes is not None:
+            start_bytes = getattr(self, "_compile_cache_start_bytes", None)
+            report["compile_cache"] = {
+                "start_bytes": start_bytes,
+                "end_bytes": end_bytes,
+                "grown_bytes": (
+                    end_bytes - start_bytes if start_bytes is not None else None
+                ),
+            }
         if base is not None:
             write_telemetry_report(base, report)
             self._write_build_report(base)

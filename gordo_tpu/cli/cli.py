@@ -316,6 +316,17 @@ def build(
     help="Per-machine cap, in seconds, on waiting for one machine's "
     "data fetch (all attempts included); unset waits forever.",
 )
+@click.option(
+    "--aot-cache/--no-aot-cache",
+    default=True,
+    envvar="GORDO_AOT_CACHE",
+    show_default=True,
+    help="AOT-compile + serialize the built collection's serving "
+    "programs beside the artifacts (OUTPUT-DIR/.programs) with a "
+    "jax/backend/device compatibility manifest, so a fresh server's "
+    "cold start deserializes instead of re-tracing "
+    "(docs/performance.md 'AOT executable cache').",
+)
 @_with_build_options
 def build_fleet(
     machines_config: list,
@@ -325,6 +336,7 @@ def build_fleet(
     on_error: str,
     fetch_retries: int,
     fetch_timeout: float,
+    aot_cache: bool,
     workers: str,
     worker_id: int,
     lease_ttl: float,
@@ -404,6 +416,21 @@ def build_fleet(
                 on_error=on_error,
             )
             _print_casualties(report)
+            if aot_cache:
+                # serving groups span work units, so the export runs
+                # once over the finalized collection (reloading from
+                # the just-flushed artifacts), not per worker. Same
+                # contract as the single-worker export: best-effort —
+                # a failed cache export never fails a completed build
+                from gordo_tpu.programs import export_serving_programs
+
+                utils.enable_compile_cache()
+                try:
+                    export_serving_programs(output_dir)
+                except Exception as exc:  # noqa: BLE001
+                    logger.warning(
+                        "AOT serving-program export failed: %s", exc
+                    )
             return 0
 
         utils.enable_compile_cache()
@@ -426,6 +453,10 @@ def build_fleet(
             on_error=on_error,
             fetch_retries=fetch_retries,
             fetch_timeout=fetch_timeout,
+            # worker processes skip the export: serving groups span
+            # units, so the orchestrator exports over the finalized
+            # collection instead
+            aot_cache=aot_cache and worker_id is None,
         )
 
         if worker_id is not None:
@@ -434,6 +465,16 @@ def build_fleet(
                 "(%d machines total)",
                 worker_id, output_dir, len(machines),
             )
+            if aot_cache:
+                # manual multi-host mode has no orchestrator process to
+                # export over the finalized collection — say so instead
+                # of silently dropping the flag
+                logger.warning(
+                    "--aot-cache has no effect on a --worker-id build "
+                    "(serving groups span work units); run `gordo-tpu "
+                    "programs compile %s` after the build completes",
+                    output_dir,
+                )
 
             def _report_unit(built):
                 for _, machine_out in built.values():
@@ -729,6 +770,53 @@ def sweep_cli(
     return 0
 
 
+@click.group("programs")
+def programs_cli():
+    """The AOT executable cache (docs/performance.md): compile/inspect
+    a built collection's serialized serving programs."""
+
+
+@programs_cli.command("compile")
+@click.argument(
+    "directory", type=click.Path(exists=True, file_okay=False, dir_okay=True)
+)
+@click.option(
+    "--row-buckets",
+    default=None,
+    help="Comma-separated request row buckets to compile "
+    "(default: GORDO_AOT_ROW_BUCKETS or 128,256).",
+)
+def programs_compile(directory: str, row_buckets: str):
+    """
+    (Re-)export DIRECTORY's serving programs into DIRECTORY/.programs:
+    for an existing collection built elsewhere (multi-host ledger
+    workers, a collection moved to a new jax/backend, or a pre-AOT
+    build). Loads every artifact, stacks the fleet-serving groups
+    exactly as the server will, and serializes one executable per
+    (group, row-bucket) with the compatibility manifest.
+    """
+    from gordo_tpu.programs import export_serving_programs
+
+    utils.enable_compile_cache()
+    buckets = None
+    if row_buckets:
+        try:
+            buckets = [
+                int(part) for part in row_buckets.split(",") if part.strip()
+            ]
+        except ValueError:
+            raise click.BadParameter(
+                f"--row-buckets must be comma-separated integers, got "
+                f"{row_buckets!r}"
+            )
+    report = export_serving_programs(directory, row_buckets=buckets)
+    print(
+        f"exported {report['n_programs']} program(s) for "
+        f"{report['n_machines']} machine(s) -> {report['directory']}"
+    )
+    return 0
+
+
 @click.group("telemetry")
 def telemetry_cli():
     """Inspect fleet telemetry: build reports and event logs."""
@@ -825,6 +913,28 @@ def telemetry_summarize(directory: str, as_json: bool):
     "in the queue shed with a structured 503 + Retry-After.",
 )
 @click.option(
+    "--scorer-cache-size",
+    type=click.IntRange(min=1),
+    default=16,
+    envvar="GORDO_SCORER_CACHE_SIZE",
+    show_default=True,
+    help="Count bound on the resident fleet-scorer (and batcher) LRU "
+    "caches when the device reports no memory stats (CPU/null "
+    "backends). On accelerators with memory stats the bound is the "
+    "HBM watermark sampler's measured headroom instead "
+    "(docs/performance.md 'AOT executable cache').",
+)
+@click.option(
+    "--aot-cache/--no-aot-cache",
+    default=True,
+    envvar="GORDO_AOT_CACHE",
+    show_default=True,
+    help="Map build-time AOT-serialized serving executables "
+    "(<collection>/.programs) in at preload/first-use instead of "
+    "re-tracing; any missing/incompatible/corrupt entry silently "
+    "falls back to a retrace.",
+)
+@click.option(
     "--log-level",
     type=click.Choice(["debug", "info", "warning", "error", "critical"]),
     default="debug",
@@ -845,6 +955,8 @@ def run_server_cli(
     worker_connections,
     batch_wait_ms,
     queue_limit,
+    scorer_cache_size,
+    aot_cache,
     log_level,
     with_prometheus,
 ):
@@ -854,6 +966,8 @@ def run_server_cli(
     config = {
         "BATCH_WAIT_MS": batch_wait_ms,
         "BATCH_QUEUE_LIMIT": queue_limit,
+        "SCORER_CACHE_SIZE": scorer_cache_size,
+        "AOT_CACHE": aot_cache,
     }
     if with_prometheus:
         config["ENABLE_PROMETHEUS"] = True
@@ -874,6 +988,7 @@ gordo.add_command(build_fleet)
 gordo.add_command(sweep_cli)
 gordo.add_command(run_server_cli)
 gordo.add_command(gordo_client)
+gordo.add_command(programs_cli)
 gordo.add_command(telemetry_cli)
 gordo.add_command(trace_cli)
 gordo.add_command(lint_cli)

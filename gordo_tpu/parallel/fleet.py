@@ -37,6 +37,7 @@ from jax.sharding import Mesh
 from gordo_tpu.models.specs import ModelSpec, per_sample_loss
 from gordo_tpu.observability import annotate, emit_event, get_registry, tracing
 from gordo_tpu.parallel.mesh import fleet_sharding, pad_to_multiple, replicated_sharding
+from gordo_tpu.programs import ProgramCache
 from gordo_tpu.robustness import faults as _faults
 
 logger = logging.getLogger(__name__)
@@ -215,8 +216,11 @@ class FleetTrainer:
         #: refits add "refit" so refit:nan targets refit builds only)
         self.fault_sites = tuple(fault_sites)
         self._optimizer = optimizer if optimizer is not None else spec.make_optimizer()
-        self._epoch_fn_cache: dict = {}
-        self._predict_fn_cache: dict = {}
+        # ALL compiled/raw program handles (epoch, val, chunk, predict)
+        # live in the one ProgramCache (docs/performance.md "AOT
+        # executable cache") — LRU + HBM-aware bounded, hit/miss/evict
+        # telemetry for free, and no per-site ad-hoc dicts
+        self._programs = ProgramCache("trainer")
 
     # -- setup -----------------------------------------------------------
     def machine_keys(self, n_machines: int, seed: int = 0) -> jnp.ndarray:
@@ -350,29 +354,27 @@ class FleetTrainer:
         """
         n_batches = self._n_batches(n, batch_size, sample_cap)
         cache_key = (n, batch_size, shuffle, gated, n_batches, quarantine, inject)
-        if cache_key in self._epoch_fn_cache:
-            return self._epoch_fn_cache[cache_key]
 
-        fleet_epoch = self._epoch_callable(
-            n, batch_size, shuffle, gated, n_batches,
-            quarantine=quarantine, inject=inject,
-        )
-        n_args = 6 + int(gated) + int(quarantine) + int(inject)
-        jit_kwargs: dict = {}
-        if self.mesh is not None:
-            fs = fleet_sharding(self.mesh)
-            rs = replicated_sharding(self.mesh)
-            data_sh = rs if self.broadcast_data else fs
-            jit_kwargs["in_shardings"] = tuple(
-                data_sh if i in (3, 4, 5) else fs for i in range(n_args)
+        def build():
+            fleet_epoch = self._epoch_callable(
+                n, batch_size, shuffle, gated, n_batches,
+                quarantine=quarantine, inject=inject,
             )
-            jit_kwargs["out_shardings"] = (fs,) * (4 if quarantine else 3)
-        if self.donate:
-            jit_kwargs["donate_argnums"] = (0, 1)
+            n_args = 6 + int(gated) + int(quarantine) + int(inject)
+            jit_kwargs: dict = {}
+            if self.mesh is not None:
+                fs = fleet_sharding(self.mesh)
+                rs = replicated_sharding(self.mesh)
+                data_sh = rs if self.broadcast_data else fs
+                jit_kwargs["in_shardings"] = tuple(
+                    data_sh if i in (3, 4, 5) else fs for i in range(n_args)
+                )
+                jit_kwargs["out_shardings"] = (fs,) * (4 if quarantine else 3)
+            if self.donate:
+                jit_kwargs["donate_argnums"] = (0, 1)
+            return jax.jit(fleet_epoch, **jit_kwargs)
 
-        fn = jax.jit(fleet_epoch, **jit_kwargs)
-        self._epoch_fn_cache[cache_key] = fn
-        return fn
+        return self._programs.get_or_build(cache_key, build)
 
     def _epoch_callable(
         self,
@@ -400,9 +402,25 @@ class FleetTrainer:
             "epoch_raw", n, batch_size, shuffle, gated, n_batches,
             quarantine, inject,
         )
-        if cache_key in self._epoch_fn_cache:
-            return self._epoch_fn_cache[cache_key]
+        return self._programs.get_or_build(
+            cache_key,
+            lambda: self._build_epoch_callable(
+                n, batch_size, shuffle, gated, n_batches,
+                quarantine=quarantine, inject=inject,
+            ),
+        )
 
+    def _build_epoch_callable(
+        self,
+        n: int,
+        batch_size: int,
+        shuffle: bool,
+        gated: bool,
+        n_batches: int,
+        quarantine: bool = False,
+        inject: bool = False,
+    ):
+        """The uncached body of :meth:`_epoch_callable`."""
         n_samples = self._n_samples(n)
         spec = self.spec
         optimizer = self._optimizer
@@ -562,7 +580,6 @@ class FleetTrainer:
         else:
             fleet_epoch = jax.vmap(machine_epoch, in_axes=(0,) * n_args)
 
-        self._epoch_fn_cache[cache_key] = fleet_epoch
         return fleet_epoch
 
     def _val_fn(self, n: int, batch_size: int, lo: int = 0):
@@ -571,21 +588,19 @@ class FleetTrainer:
         callable, ``_val_callable``, is shared with the chunk program).
         """
         cache_key = ("val", n, batch_size, lo)
-        if cache_key in self._epoch_fn_cache:
-            return self._epoch_fn_cache[cache_key]
 
-        fleet_val = self._val_callable(n, batch_size, lo)
-        jit_kwargs: dict = {}
-        if self.mesh is not None:
-            fs = fleet_sharding(self.mesh)
-            rs = replicated_sharding(self.mesh)
-            data_sh = rs if self.broadcast_data else fs
-            jit_kwargs["in_shardings"] = (fs, data_sh, data_sh, data_sh)
-            jit_kwargs["out_shardings"] = fs
+        def build():
+            fleet_val = self._val_callable(n, batch_size, lo)
+            jit_kwargs: dict = {}
+            if self.mesh is not None:
+                fs = fleet_sharding(self.mesh)
+                rs = replicated_sharding(self.mesh)
+                data_sh = rs if self.broadcast_data else fs
+                jit_kwargs["in_shardings"] = (fs, data_sh, data_sh, data_sh)
+                jit_kwargs["out_shardings"] = fs
+            return jax.jit(fleet_val, **jit_kwargs)
 
-        fn = jax.jit(fleet_val, **jit_kwargs)
-        self._epoch_fn_cache[cache_key] = fn
-        return fn
+        return self._programs.get_or_build(cache_key, build)
 
     def _val_callable(self, n: int, batch_size: int, lo: int = 0):
         """
@@ -600,9 +615,12 @@ class FleetTrainer:
         whole training prefix every epoch.
         """
         cache_key = ("val_raw", n, batch_size, lo)
-        if cache_key in self._epoch_fn_cache:
-            return self._epoch_fn_cache[cache_key]
+        return self._programs.get_or_build(
+            cache_key, lambda: self._build_val_callable(n, batch_size, lo)
+        )
 
+    def _build_val_callable(self, n: int, batch_size: int, lo: int = 0):
+        """The uncached body of :meth:`_val_callable`."""
         spec = self.spec
         lb = spec.lookback_window if spec.windowed else 1
         la = self.lookahead
@@ -647,7 +665,6 @@ class FleetTrainer:
         else:
             fleet_val = jax.vmap(machine_val, in_axes=(0, 0, 0, 0))
 
-        self._epoch_fn_cache[cache_key] = fleet_val
         return fleet_val
 
     def _chunk_fn(
@@ -690,9 +707,38 @@ class FleetTrainer:
             float(es_delta), int(es_stop_at), int(es_start_from),
             quarantine, inject,
         )
-        if cache_key in self._epoch_fn_cache:
-            return self._epoch_fn_cache[cache_key]
+        return self._programs.get_or_build(
+            cache_key,
+            lambda: self._build_chunk_fn(
+                n, batch_size, shuffle,
+                chunk_len=chunk_len, n_batches=n_batches, with_val=with_val,
+                val_lo=val_lo, gated=gated, track_best=track_best,
+                monitor_val=monitor_val, es_delta=es_delta,
+                es_stop_at=es_stop_at, es_start_from=es_start_from,
+                quarantine=quarantine, inject=inject,
+            ),
+        )
 
+    def _build_chunk_fn(
+        self,
+        n: int,
+        batch_size: int,
+        shuffle: bool,
+        *,
+        chunk_len: int,
+        n_batches: int,
+        with_val: bool,
+        val_lo: int,
+        gated: bool,
+        track_best: bool,
+        monitor_val: bool,
+        es_delta: float,
+        es_stop_at: int,
+        es_start_from: int,
+        quarantine: bool,
+        inject: bool,
+    ):
+        """The uncached body of :meth:`_chunk_fn`."""
         fleet_epoch = self._epoch_callable(
             n, batch_size, shuffle, gated, n_batches,
             quarantine=quarantine, inject=inject,
@@ -825,9 +871,7 @@ class FleetTrainer:
         # shardings propagate from the committed inputs (params/data are
         # device_put with fleet/replicated shardings by fit's setup), so
         # no explicit in_shardings are needed here
-        fn = jax.jit(chunk_program, **jit_kwargs)
-        self._epoch_fn_cache[cache_key] = fn
-        return fn
+        return jax.jit(chunk_program, **jit_kwargs)
 
     def _validation_masks(
         self, w_host: np.ndarray, n: int, validation_split: float
@@ -2000,10 +2044,19 @@ class FleetTrainer:
         # the direct (un-chunked) program is independent of batch_size, so
         # all large-enough batch_sizes share one cache entry
         chunked = spec.windowed and num_windows(n, lb, la) > batch_size
-        cache_key = (n, batch_size if chunked else None)
-        if cache_key in self._predict_fn_cache:
-            return self._predict_fn_cache[cache_key]
+        cache_key = ("predict", n, batch_size if chunked else None)
+        return self._programs.get_or_build(
+            cache_key,
+            lambda: self._build_predict_fn(n, batch_size, chunked),
+        )
 
+    def _build_predict_fn(self, n: int, batch_size: int, chunked: bool):
+        """The uncached body of :meth:`_predict_fn`."""
+        from gordo_tpu.ops.windowing import window_sample_indices
+
+        spec = self.spec
+        lb = spec.lookback_window if spec.windowed else 1
+        la = self.lookahead
         if spec.windowed:
             rows_np = window_sample_indices(n, lb, la)  # (n_out, lb)
             n_out = len(rows_np)
@@ -2045,7 +2098,6 @@ class FleetTrainer:
             )
         else:
             fleet_apply = jax.jit(fleet_apply)
-        self._predict_fn_cache[cache_key] = fleet_apply
         return fleet_apply
 
     @staticmethod

@@ -1,0 +1,397 @@
+"""
+ProgramCache: the one in-memory home for compiled XLA programs.
+
+Before this module the tree had three ad-hoc cache sites — the fleet
+trainer's ``_epoch_fn_cache``/``_predict_fn_cache`` dicts, the fleet
+scorer's per-group ``jax.jit`` handles, and the server's hand-rolled
+16-entry scorer LRU — each with its own eviction (or none) and zero
+telemetry. Every one of them now routes through a :class:`ProgramCache`:
+get-or-build semantics with LRU refresh, AOT executables loaded from a
+:class:`~gordo_tpu.programs.store.ProgramStore` preferred over a fresh
+trace, and eviction bounded by the HBM watermark sampler's *measured*
+headroom when the device reports real numbers (falling back to a count
+bound on CPU/null devices, where program memory is host heap).
+
+Telemetry contract (docs/observability.md): ``program_cache_hit`` /
+``program_cache_miss`` / ``program_cache_evict`` /
+``program_cache_fallback`` events (hit/miss/fallback deduplicated to
+first occurrence per key per process — the trainer touches its epoch
+program once per epoch and per-epoch hit events would drown the log),
+``gordo_program_cache_*`` metrics (hits/misses/evictions count every
+occurrence; fallback rungs are memoized per key, so a steady stream of
+requests on an uncovered-but-healthy shape reads as ONE fallback, not
+permanent degradation), and a ``program.load`` span around each AOT
+deserialize.
+"""
+
+import logging
+import os
+import threading
+import typing
+
+from gordo_tpu.observability import emit_event, get_registry, tracing
+
+logger = logging.getLogger(__name__)
+
+#: count bound used when the device reports no memory stats (CPU/null
+#: backends): program handles there are host-heap objects and a count is
+#: the only meaningful bound. Overridden per-cache; env knob
+#: GORDO_PROGRAM_CACHE_SIZE.
+DEFAULT_CAPACITY = 128
+
+#: evict until at least this fraction of device memory is free when the
+#: watermark sampler reports real numbers (GORDO_PROGRAM_MIN_HEADROOM).
+DEFAULT_MIN_HEADROOM = 0.1
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ[name])
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ[name])
+    except (KeyError, TypeError, ValueError):
+        return default
+
+
+def hbm_headroom() -> typing.Optional[float]:
+    """
+    Fraction of the default device's memory still free, per the PR-1
+    watermark sampler (``observability.device_memory``) — or None when
+    the backend reports nothing (the CPU case), which callers must treat
+    as "no memory signal", not "no memory".
+    """
+    from gordo_tpu.observability import device_memory_stats
+
+    stats = device_memory_stats()
+    limit = stats.get("bytes_limit")
+    in_use = stats.get("bytes_in_use")
+    if not limit or in_use is None:
+        return None
+    return max(0.0, (limit - in_use) / limit)
+
+
+def evict_lru(
+    cache: typing.Dict[typing.Any, typing.Any],
+    bound: int,
+    *,
+    on_evict: typing.Optional[typing.Callable[[typing.Any, typing.Any], None]] = None,
+    headroom: typing.Optional[typing.Callable[[], typing.Optional[float]]] = hbm_headroom,
+    min_headroom: typing.Optional[float] = None,
+) -> typing.List[typing.Tuple[typing.Any, typing.Any]]:
+    """
+    Evict oldest-inserted entries from an insertion-ordered dict (the
+    LRU discipline every cache here shares: hits pop-and-reinsert, so
+    iteration order IS recency order). The shared helper behind both the
+    server's scorer/batcher caches and :class:`ProgramCache`.
+
+    Policy: when ``headroom()`` reports a real fraction (an accelerator
+    with memory stats), the measured watermark governs GROWTH — the
+    cache may hold any number of entries while free memory stays above
+    ``min_headroom``, and under pressure it sheds back down to
+    ``bound``. It never sheds BELOW the bound: device pressure is
+    usually caused by training data / resident param stacks, not by
+    program handles (and dropping a reference frees nothing until
+    in-flight dispatches release it), so evicting to near-zero would
+    only thrash retraces without recovering memory. When headroom is
+    None (CPU/null device), the plain count bound applies. At least one
+    entry always survives.
+
+    Returns the evicted (key, value) pairs so callers can stop/close
+    them; ``on_evict`` (if given) also runs per eviction, inside the
+    caller's lock.
+    """
+    if min_headroom is None:
+        min_headroom = _env_float(
+            "GORDO_PROGRAM_MIN_HEADROOM", DEFAULT_MIN_HEADROOM
+        )
+    free = headroom() if headroom is not None else None
+    if free is not None and free >= min_headroom:
+        return []  # memory is fine: let the cache grow past the bound
+    evicted: typing.List[typing.Tuple[typing.Any, typing.Any]] = []
+    while len(cache) > max(1, bound):
+        key = next(iter(cache))
+        value = cache.pop(key)
+        if on_evict is not None:
+            on_evict(key, value)
+        evicted.append((key, value))
+    return evicted
+
+
+class ProgramCache:
+    """
+    Named get-or-build cache of callables (jitted handles, raw traced
+    callables, AOT-loaded executables) with LRU + HBM-aware eviction.
+
+    ``name`` labels the cache's metric series (``kind=<name>``) and must
+    be low-cardinality ("trainer", "serving").
+    """
+
+    def __init__(
+        self,
+        name: str,
+        capacity: typing.Optional[int] = None,
+        min_headroom: typing.Optional[float] = None,
+    ):
+        self.name = str(name)
+        self.capacity = (
+            capacity
+            if capacity is not None
+            else _env_int("GORDO_PROGRAM_CACHE_SIZE", DEFAULT_CAPACITY)
+        )
+        self._min_headroom = min_headroom
+        self._entries: typing.Dict[typing.Any, typing.Any] = {}
+        self._lock = threading.RLock()
+        #: keys whose first hit / miss / fallback was already evented —
+        #: metrics count every occurrence, events only the first
+        self._evented: typing.Set[typing.Tuple[str, typing.Any]] = set()
+        #: AOT keys whose store load failed: retrace forever instead of
+        #: re-paying a doomed deserialize per dispatch
+        self._aot_failed: typing.Set[typing.Any] = set()
+        #: AOT keys the store simply does not hold (uncovered shapes —
+        #: subset machine buckets, odd row buckets): memoized like
+        #: failures, so steady traffic on a healthy-but-uncovered shape
+        #: neither re-probes the store nor inflates the fallback
+        #: counter per dispatch. Per-revision stores mint new keys
+        #: (params digest changes), so staleness self-resolves.
+        self._aot_missing: typing.Set[typing.Any] = set()
+
+    # -- telemetry ------------------------------------------------------
+    def _count_hit(self, outcome: str) -> None:
+        get_registry().counter(
+            "gordo_program_cache_hits_total",
+            "ProgramCache hits (outcome: memory-resident vs AOT-loaded)",
+            ("kind", "outcome"),
+        ).inc(kind=self.name, outcome=outcome)
+
+    def _count_miss(self) -> None:
+        get_registry().counter(
+            "gordo_program_cache_misses_total",
+            "ProgramCache misses (a fresh trace/jit build)",
+            ("kind",),
+        ).inc(kind=self.name)
+
+    def _count_eviction(self, outcome: str) -> None:
+        get_registry().counter(
+            "gordo_program_cache_evictions_total",
+            "Programs evicted from a ProgramCache (outcome: hbm vs lru)",
+            ("kind", "outcome"),
+        ).inc(kind=self.name, outcome=outcome)
+
+    def _count_fallback(self, outcome: str) -> None:
+        get_registry().counter(
+            "gordo_program_cache_fallbacks_total",
+            "AOT lookups that degraded to a retrace",
+            ("kind", "outcome"),
+        ).inc(kind=self.name, outcome=outcome)
+
+    def _event_once(self, event: str, key: typing.Any, **fields) -> None:
+        marker = (event, key)
+        with self._lock:
+            if marker in self._evented:
+                return
+            self._evented.add(marker)
+        emit_event(event, cache=self.name, key=_key_repr(key), **fields)
+
+    def _set_size_gauge(self) -> None:
+        get_registry().gauge(
+            "gordo_program_cache_programs",
+            "Live programs resident in a ProgramCache",
+            ("kind",),
+        ).set(len(self._entries), kind=self.name)
+
+    # -- core API -------------------------------------------------------
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._evented.clear()
+            self._aot_failed.clear()
+            self._aot_missing.clear()
+        self._set_size_gauge()
+
+    def lookup(self, key: typing.Any) -> typing.Optional[typing.Callable]:
+        """Memory hit (LRU-refreshed) or None — no build, no store."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.pop(key)
+                self._entries[key] = entry
+        if entry is not None:
+            self._count_hit("memory")
+        return entry
+
+    def get_or_build(
+        self, key: typing.Any, build: typing.Callable[[], typing.Callable]
+    ) -> typing.Callable:
+        """
+        The trainer-shaped entry point: return the cached callable for
+        ``key``, else ``build()`` one, insert it, and evict as needed.
+        Two concurrent first calls may both build (harmless — last
+        insert wins), mirroring the server's historical scorer cache.
+        """
+        cached = self.lookup(key)
+        if cached is not None:
+            self._event_once("program_cache_hit", key, outcome="memory")
+            return cached
+        self._count_miss()
+        self._event_once("program_cache_miss", key)
+        built = build()
+        self.insert(key, built)
+        return built
+
+    def insert(self, key: typing.Any, program: typing.Callable) -> None:
+        with self._lock:
+            self._entries.pop(key, None)
+            self._entries[key] = program
+            evicted = evict_lru(
+                self._entries,
+                self.capacity,
+                min_headroom=self._min_headroom,
+            )
+        self._set_size_gauge()
+        if not evicted:
+            return
+        # one probe decides the attribution: evict_lru ran in headroom
+        # mode iff the device reports memory stats at all
+        outcome = "hbm" if hbm_headroom() is not None else "lru"
+        for evicted_key, _ in evicted:
+            self._count_eviction(outcome)
+            emit_event(
+                "program_cache_evict",
+                cache=self.name,
+                key=_key_repr(evicted_key),
+                outcome=outcome,
+            )
+            # an evicted key may be re-built later; let its lifecycle
+            # events re-emit rather than vanish
+            with self._lock:
+                self._evented = {
+                    m for m in self._evented if m[1] != evicted_key
+                }
+
+    def evict(self, key: typing.Any) -> bool:
+        """Drop one entry (tests, revision rollover). True if present."""
+        with self._lock:
+            present = self._entries.pop(key, None) is not None
+            if present:
+                self._evented = {m for m in self._evented if m[1] != key}
+        if present:
+            self._set_size_gauge()
+        return present
+
+    # -- AOT integration ------------------------------------------------
+    def aot_program(
+        self, key_dict: typing.Dict[str, typing.Any], store
+    ) -> typing.Optional[typing.Callable]:
+        """
+        An exact-shape AOT executable for ``key_dict``, from memory or
+        deserialized out of ``store`` — or None, meaning the caller must
+        take its retrace path. EVERY failure mode lands on None: missing
+        store, missing entry, corrupt payload, deserialize error. Each
+        emits a ``program_cache_fallback`` event (first occurrence per
+        key) + metric with the reason in ``outcome``.
+        """
+        from gordo_tpu.programs.store import program_key_digest
+
+        key = ("aot", program_key_digest(key_dict))
+        cached = self.lookup(key)
+        if cached is not None:
+            self._event_once("program_cache_hit", key, outcome="memory")
+            return cached
+        if store is None:
+            # no store attached (tests, storeless scorers): a silent
+            # memory miss — the "missing cache" fallback is accounted
+            # once at store-open time by the server, not per dispatch
+            return None
+        with self._lock:
+            if key in self._aot_failed or key in self._aot_missing:
+                return None
+        if not store.has(key_dict):
+            with self._lock:
+                self._aot_missing.add(key)
+            self._fallback(key, "missing")
+            return None
+        try:
+            with tracing.start_span(
+                "program.load", cache=self.name, key=_key_repr(key)
+            ):
+                program = store.load(key_dict)
+        except Exception as exc:  # noqa: BLE001 - ANY load failure retraces
+            with self._lock:
+                self._aot_failed.add(key)
+            logger.warning(
+                "AOT program load failed for %s (%s); falling back to "
+                "retrace",
+                _key_repr(key),
+                exc,
+            )
+            self._fallback(key, "deserialize_error")
+            return None
+        self.insert(key, program)
+        self._count_hit("aot")
+        self._event_once("program_cache_hit", key, outcome="aot")
+        return program
+
+    def discard_aot(
+        self, key_dict: typing.Dict[str, typing.Any], reason: str
+    ) -> None:
+        """An AOT executable that loaded but failed at dispatch: drop it,
+        pin the key failed (no reload attempts), account the fallback."""
+        from gordo_tpu.programs.store import program_key_digest
+
+        key = ("aot", program_key_digest(key_dict))
+        self.evict(key)
+        with self._lock:
+            self._aot_failed.add(key)
+        self._set_size_gauge()
+        self._fallback(key, reason)
+
+    def report_fallback(self, key: typing.Any, reason: str) -> None:
+        """Fallback accounting for conditions detected OUTSIDE the cache
+        — e.g. the server finding a collection with no AOT store at all
+        ("missing cache" in the acceptance ladder)."""
+        self._fallback(("aot", str(key)), reason)
+
+    def _fallback(self, key: typing.Any, reason: str) -> None:
+        self._count_fallback(reason)
+        self._event_once("program_cache_fallback", key, outcome=reason)
+
+
+def _key_repr(key: typing.Any) -> str:
+    """Bounded, JSON-safe rendition of a cache key for events/logs."""
+    text = repr(key)
+    return text if len(text) <= 200 else text[:197] + "..."
+
+
+_serving_cache: typing.Optional[ProgramCache] = None
+_serving_cache_lock = threading.Lock()
+
+
+def serving_program_cache() -> ProgramCache:
+    """
+    The process-wide serving cache: every FleetScorer (and the server
+    preload) shares it, so the HBM bound applies to the process's whole
+    serving program population, not per-scorer slices.
+    """
+    global _serving_cache
+    with _serving_cache_lock:
+        if _serving_cache is None:
+            _serving_cache = ProgramCache("serving")
+        return _serving_cache
+
+
+def reset_serving_program_cache() -> None:
+    """Tests and revision rollover: drop the process-wide cache."""
+    global _serving_cache
+    with _serving_cache_lock:
+        if _serving_cache is not None:
+            _serving_cache.clear()
+        _serving_cache = None

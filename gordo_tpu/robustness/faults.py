@@ -21,7 +21,10 @@ One spec is ``site:mode[:target][@key:value ...]``:
   outright at ``fetch``/``train``/``commit``, scoped by
   ``@worker:<id>``) and ``lease`` (``lease:stall:<worker-id>`` — stop
   heartbeating without dying, so the lease is stolen out from under a
-  live build).
+  live build), and ``program`` (``program:corrupt[:digest-prefix]`` —
+  the AOT executable-cache load seam, docs/performance.md: the stored
+  payload is mangled so deserialization fails and serving falls back
+  to a retrace).
 - ``mode`` — what happens there: ``raise`` (the seam raises
   :class:`InjectedFault`), ``nan`` (train/refit: the named machine's
   epoch loss goes NaN at ``@epoch:<e>``, driving the quarantine guard),
@@ -60,7 +63,7 @@ FAULT_INJECT_ENV_VAR = "GORDO_FAULT_INJECT"
 _KNOWN_SITES = frozenset(
     {
         "fetch", "train", "ckpt", "serve", "batch", "drift", "refit",
-        "promote", "worker", "lease",
+        "promote", "worker", "lease", "program",
     }
 )
 
@@ -437,6 +440,59 @@ def inject_promotion_tear(n_assembled: int) -> None:
         f"Injected fault at site 'promote': revision assembly torn after "
         f"{n_assembled} machine(s) (firing {count})"
     )
+
+
+def corrupt_program_payload(
+    blob: bytes, digest: typing.Optional[str] = None
+) -> bytes:
+    """
+    The AOT-program-load seam (``program:corrupt``): when a matching
+    spec fires, return ``blob`` with its payload bytes mangled — the
+    shape a torn disk write or partial artifact rsync produces — so the
+    ProgramCache's deserialize fails and the dispatch falls back to a
+    retrace (docs/performance.md "AOT executable cache": the fallback
+    ladder must absorb this with zero request failures). The optional
+    ``target`` in the spec matches against the program's digest prefix,
+    so a chaos run can corrupt one program and leave its siblings
+    loadable. ``@attempts:N`` limits the corruption to the first N
+    loads (then the store serves clean bytes — the eviction-and-reload
+    exercise).
+    """
+    registry = active_registry()
+    if registry is None:
+        return blob
+    # target semantics here are a digest PREFIX, not a machine name, so
+    # match manually instead of through matches_target
+    spec = next(
+        (
+            s
+            for s in registry.specs
+            if s.site == "program"
+            and s.mode == "corrupt"
+            and (
+                s.target is None
+                or str(digest or "").startswith(s.target)
+            )
+        ),
+        None,
+    )
+    if spec is None:
+        return blob
+    attempts = spec.param_int("attempts", 0)
+    if attempts and spec.fires >= attempts:
+        return blob
+    registry.fire(spec, digest=digest, n_bytes=len(blob))
+    logger.warning(
+        "Fault injection: corrupting AOT program payload %s (%d bytes)",
+        digest, len(blob),
+    )
+    # flip bytes mid-payload: still parses as "some bytes" so the
+    # failure lands in unpickle/deserialize, the layer a real torn
+    # write would break
+    mangled = bytearray(blob)
+    for i in range(len(mangled) // 3, min(len(mangled), len(mangled) // 3 + 64)):
+        mangled[i] ^= 0xFF
+    return bytes(mangled)
 
 
 def tear_checkpoint_files(step_dir: typing.Union[str, os.PathLike]) -> bool:

@@ -46,6 +46,9 @@ from gordo_tpu import __version__, serializer
 from gordo_tpu.data.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
 from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.programs import evict_lru, open_store, serving_program_cache
+from gordo_tpu.programs import store as programs_store
+from gordo_tpu.programs import hbm_headroom as programs_headroom
 from gordo_tpu.robustness import faults
 from gordo_tpu.server import batching, model_io
 from gordo_tpu.server import utils as server_utils
@@ -83,6 +86,18 @@ class Config:
     #: admission control: queued requests beyond this shed with a
     #: structured 503 + Retry-After (GORDO_BATCH_QUEUE_LIMIT)
     BATCH_QUEUE_LIMIT = 64
+    #: count bound on the fleet-scorer / batcher LRU caches when the
+    #: device reports no memory stats (CPU/null backends). On a real
+    #: accelerator the bound is the HBM watermark sampler's headroom
+    #: instead (gordo_tpu.programs.evict_lru). Env fallback
+    #: (GORDO_SCORER_CACHE_SIZE) applied in build_app; CLI:
+    #: run-server --scorer-cache-size.
+    SCORER_CACHE_SIZE = 16
+    #: map build-time AOT-serialized executables into serving
+    #: (docs/performance.md "AOT executable cache"). False retraces
+    #: everything — the cold-start benchmark's control arm
+    #: (GORDO_AOT_CACHE).
+    AOT_CACHE = True
 
     def to_dict(self) -> dict:
         return {
@@ -214,6 +229,14 @@ class GordoApp:
         self.batch_queue_limit = int(self.config.get("BATCH_QUEUE_LIMIT") or 64)
         self._batchers: typing.Dict[tuple, batching.RequestBatcher] = {}
         self._batchers_lock = threading.Lock()
+        #: CPU/null-device count bound for the scorer/batcher LRUs; on
+        #: devices with memory stats the HBM headroom governs instead
+        self.scorer_cache_size = int(self.config.get("SCORER_CACHE_SIZE") or 16)
+        self.aot_cache_enabled = bool(self.config.get("AOT_CACHE", True))
+        # realpath(collection dir) -> opened ProgramStore (or None:
+        # absent/incompatible — retrace); opened once per revision dir
+        self._program_stores: typing.Dict[str, typing.Any] = {}
+        self._program_stores_lock = threading.Lock()
         # build_report.json path -> (mtime, parsed report): the degraded-
         # serving source of truth (which machines to 409)
         self._build_reports: typing.Dict[str, tuple] = {}
@@ -843,6 +866,71 @@ class GordoApp:
         }
         return _json_response(context, 200)
 
+    def _insert_lru(
+        self,
+        cache: typing.Dict,
+        key,
+        value,
+        on_evict: typing.Optional[typing.Callable] = None,
+        device_resident: bool = True,
+    ) -> None:
+        """
+        Insert into one of the serving LRU caches and bound it through
+        the ONE shared eviction policy (``gordo_tpu.programs.evict_lru``).
+        ``device_resident=True`` (scorers — stacked param trees in
+        device memory): the HBM watermark's headroom governs growth on
+        devices that report memory, with ``--scorer-cache-size`` as the
+        CPU/null-device count bound. ``device_resident=False``
+        (batchers — each owns a drainer THREAD — and program stores):
+        host-side objects the HBM signal never measures, so the count
+        bound applies on every backend. Caller holds the cache's lock.
+        """
+        cache.pop(key, None)
+        cache[key] = value
+        evict_lru(
+            cache,
+            self.scorer_cache_size,
+            on_evict=on_evict,
+            headroom=programs_headroom if device_resident else None,
+        )
+
+    def _program_store(self, collection_dir: str):
+        """
+        The collection's AOT program store, opened (and compatibility-
+        verified) once per revision directory; None — absent store,
+        manifest mismatch, or ``AOT_CACHE`` off — means every dispatch
+        retraces. The "missing cache" rung of the fallback ladder is
+        accounted here, once per directory, not per request.
+        """
+        if not self.aot_cache_enabled:
+            return None
+        key = os.path.realpath(collection_dir)
+        with self._program_stores_lock:
+            if key in self._program_stores:
+                return self._program_stores[key]
+        store = open_store(key)
+        if store is None:
+            store_dir = os.path.join(key, programs_store.PROGRAMS_DIRNAME)
+            if not os.path.isdir(store_dir):
+                # truly absent (pre-AOT build)
+                serving_program_cache().report_fallback(key, "missing")
+            elif not os.path.isfile(
+                os.path.join(store_dir, programs_store.MANIFEST_FILENAME)
+            ):
+                # a .programs dir WITHOUT a manifest: the torn-export
+                # shape (killed between save() and write_manifest()) —
+                # must not degrade silently
+                serving_program_cache().report_fallback(
+                    key, "manifest_error"
+                )
+            # else: open_store already accounted its own
+            # manifest_mismatch / manifest_error rung — don't double-count
+        with self._program_stores_lock:
+            self._insert_lru(
+                self._program_stores, key, store, device_resident=False
+            )
+        return store
+
     def _get_fleet_scorer(
         self,
         ctx,
@@ -869,11 +957,11 @@ class GordoApp:
 
         if models is None:
             models = {name: self._get_model(ctx, name) for name in names}
-        built = fleet_scorer_from_models(models)
+        built = fleet_scorer_from_models(
+            models, store=self._program_store(ctx.collection_dir)
+        )
         with self._fleet_scorers_lock:
-            if len(self._fleet_scorers) >= 16:  # bound param-stack memory
-                self._fleet_scorers.pop(next(iter(self._fleet_scorers)))
-            self._fleet_scorers[key] = built
+            self._insert_lru(self._fleet_scorers, key, built)
         return built
 
     # -- dynamic batching (docs/serving.md#dynamic-batching) ---------------
@@ -896,13 +984,19 @@ class GordoApp:
             if existing is not None:
                 existing.stop()  # stale scorer (new revision/rebuild)
                 self._batchers.pop(key)
-            while len(self._batchers) >= 16:  # same bound as the scorers
-                evicted = self._batchers.pop(next(iter(self._batchers)))
-                evicted.stop()
             batcher = batching.RequestBatcher(
                 scorer, self.batch_wait_s, self.batch_queue_limit
             )
-            self._batchers[key] = batcher
+            # same count bound as the scorers' CPU bound, on EVERY
+            # backend (device_resident=False): a batcher owns a drainer
+            # thread — host capacity the HBM signal never measures, so
+            # headroom must not let the population grow unbounded.
+            # Evicted batchers stop.
+            self._insert_lru(
+                self._batchers, key, batcher,
+                on_evict=lambda _key, evicted: evicted.stop(),
+                device_resident=False,
+            )
             return batcher
 
     def _fleet_predict(
@@ -1335,6 +1429,12 @@ def build_app(
         config["BATCH_QUEUE_LIMIT"] = int(
             os.environ.get("GORDO_BATCH_QUEUE_LIMIT") or 64
         )
+    if "SCORER_CACHE_SIZE" not in config:
+        config["SCORER_CACHE_SIZE"] = int(
+            os.environ.get("GORDO_SCORER_CACHE_SIZE") or 16
+        )
+    if "AOT_CACHE" not in config:
+        config["AOT_CACHE"] = _env_bool("GORDO_AOT_CACHE", True)
     if prometheus_registry is not None:
         if config.get("ENABLE_PROMETHEUS"):
             config["PROMETHEUS_REGISTRY"] = prometheus_registry
@@ -1450,7 +1550,19 @@ def _preload_fleet_scorer(
     if not estimators:
         return
     try:
-        scorer = FleetScorer(estimators)
+        # the AOT path: with a compatible .programs store beside the
+        # artifacts, the scorer's dispatch programs DESERIALIZE here —
+        # behind the readiness probe — instead of tracing+compiling on
+        # the first request (docs/performance.md "AOT executable cache")
+        store = app._program_store(collection_dir)
+        scorer = FleetScorer(estimators, store=store)
+        if store is not None:
+            n_loaded = scorer.warm_from_store()
+            logger.info(
+                "Preload mapped %d AOT serving program(s) from %s",
+                n_loaded,
+                store.directory,
+            )
     except Exception as exc:  # pragma: no cover - defensive
         logger.warning("Fleet-scorer preload failed: %s", exc)
         return
@@ -1468,11 +1580,10 @@ def _preload_fleet_scorer(
         )
     key = (os.path.realpath(collection_dir), tuple(stacked_names))
     with app._fleet_scorers_lock:
-        # same bound as the lazy path; overwriting an existing key needs
-        # no eviction
-        if key not in app._fleet_scorers and len(app._fleet_scorers) >= 16:
-            app._fleet_scorers.pop(next(iter(app._fleet_scorers)))
-        app._fleet_scorers[key] = (scorer, prefixes, fallback)
+        # same shared bound as the lazy path
+        app._insert_lru(
+            app._fleet_scorers, key, (scorer, prefixes, fallback)
+        )
     logger.info(
         "Preloaded fleet scorer: %d machines in %d groups (%d fallback)",
         len(scorer.names),

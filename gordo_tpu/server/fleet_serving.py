@@ -14,7 +14,10 @@ host — they're cheap and heterogeneous; the batched device program is the
 model forward, where the FLOPs are.
 """
 
+import hashlib
+import json
 import logging
+import re
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -23,9 +26,14 @@ import jax.numpy as jnp
 import numpy as np
 
 from gordo_tpu.models.core import BaseJaxEstimator, _batch_bucket
-from gordo_tpu.observability import get_registry
+from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.programs import ProgramCache, serving_program_cache
 
 logger = logging.getLogger(__name__)
+
+#: memory addresses inside reprs (bound methods, lambdas) — stripped
+#: before hashing so a program identity is stable across processes
+_ADDR_RE = re.compile(r"0x[0-9a-fA-F]+")
 
 #: floor on the per-dispatch machine-axis chunk for coalesced requests
 #: (predict_requests): small groups still coalesce up to this many
@@ -53,6 +61,29 @@ def _group_key(est: BaseJaxEstimator) -> Tuple:
     )
 
 
+def _fn_digest(key: Tuple) -> str:
+    """
+    Cross-process identity of a group's scoring FUNCTION (module
+    architecture + window geometry + feature widths): the build-time AOT
+    export and the serving process must derive the same digest from the
+    same artifacts, so the module repr is canonicalized (addresses
+    stripped) before hashing.
+    """
+    canonical = [_ADDR_RE.sub("0x0", key[0])] + [str(part) for part in key[1:]]
+    return hashlib.sha1(json.dumps(canonical).encode()).hexdigest()[:16]
+
+
+def _params_digest(stacked: Any) -> str:
+    """Per-machine param structure digest (leaf paths + shapes MINUS the
+    leading machine axis + dtypes): the machine axis is the dispatch's
+    ``m`` and varies per program, so it stays out of the identity."""
+    leaves = [
+        (jax.tree_util.keystr(path), tuple(leaf.shape[1:]), str(leaf.dtype))
+        for path, leaf in jax.tree_util.tree_leaves_with_path(stacked)
+    ]
+    return hashlib.sha1(json.dumps(leaves, sort_keys=True).encode()).hexdigest()[:16]
+
+
 class FleetScorer:
     """
     Batched scorer over a set of *trained* estimators.
@@ -60,12 +91,29 @@ class FleetScorer:
     Estimators are grouped by architecture (module structure + window
     geometry + feature widths); each group's param pytrees are stacked on a
     leading machine axis and applied via one jitted ``vmap`` program.
+
+    Compiled programs route through the process-wide serving
+    :class:`~gordo_tpu.programs.ProgramCache` — never an ad-hoc per-group
+    jit cache: the jit HANDLE is shared across scorer rebuilds of the
+    same architecture (a revision roll with unchanged architecture pays
+    no recompile), and when ``store`` names a build-time AOT
+    :class:`~gordo_tpu.programs.ProgramStore`, exact-shape serialized
+    executables are preferred over a fresh trace (docs/performance.md
+    "AOT executable cache"). Every store/executable failure degrades to
+    the traced path — a scorer never errors because a cache did.
     """
 
-    def __init__(self, estimators: Dict[str, BaseJaxEstimator]):
+    def __init__(
+        self,
+        estimators: Dict[str, BaseJaxEstimator],
+        store=None,
+        cache: Optional[ProgramCache] = None,
+    ):
         for name, est in estimators.items():
             if not hasattr(est, "params_"):
                 raise ValueError(f"Estimator for {name!r} is not fitted")
+        self._store = store
+        self._cache = cache if cache is not None else serving_program_cache()
         self._groups: List[dict] = []
         by_key: Dict[Tuple, List[str]] = {}
         for name, est in estimators.items():
@@ -76,6 +124,7 @@ class FleetScorer:
                 lambda *leaves: jnp.stack(leaves), *[e.params_ for e in group_ests]
             )
             spec = group_ests[0].spec_
+            fn_digest = _fn_digest(key)
             if spec.windowed:
                 # windows are gathered IN the compiled program from raw
                 # (rows, f) inputs: the host->device transfer carries each
@@ -91,22 +140,58 @@ class FleetScorer:
                     rows = starts[:, None] + jnp.arange(lb, dtype=jnp.int32)
                     return module.apply(p, x[rows])[0]
 
-                apply_fn = jax.jit(jax.vmap(one))
+                # the handle key is the RAW group key (repr unstripped):
+                # within a process, two modules share a handle only if
+                # they'd have grouped together anyway — the stripped
+                # fn_digest is for CROSS-process AOT identity only
+                apply_fn = self._cache.get_or_build(
+                    ("scorer_jit", key),
+                    lambda fn=one: jax.jit(jax.vmap(fn)),
+                )
             else:
-                apply_fn = jax.jit(
-                    jax.vmap(lambda p, x, module=spec.module: module.apply(p, x)[0])
+                apply_fn = self._cache.get_or_build(
+                    ("scorer_jit", key),
+                    lambda module=spec.module: jax.jit(
+                        jax.vmap(lambda p, x: module.apply(p, x)[0])
+                    ),
                 )
             self._groups.append(
                 {
                     "names": names,
                     "params": stacked,
                     "apply": apply_fn,
+                    "fn_digest": fn_digest,
+                    "params_digest": _params_digest(stacked),
+                    "aot_ok": True,
                     "windowed": spec.windowed,
                     "lookback": spec.lookback_window if spec.windowed else 1,
                     "lookahead": group_ests[0].lookahead if spec.windowed else 0,
+                    "n_features": group_ests[0].n_features_,
                     "n_features_out": group_ests[0].n_features_out_,
                 }
             )
+        # digest-collision guard: two DISTINCT groups whose identities
+        # collapse to the same (fn, params) digest — possible only when
+        # their module reprs differ solely inside stripped 0x… address
+        # tokens (e.g. two different lambdas) — would share one stored
+        # executable and silently serve each other's program. Disable
+        # AOT for the colliding groups (export skips them, dispatch
+        # never loads for them); the jitted path serves them correctly.
+        by_identity: Dict[Tuple[str, str], List[dict]] = {}
+        for group in self._groups:
+            by_identity.setdefault(
+                (group["fn_digest"], group["params_digest"]), []
+            ).append(group)
+        for identity, colliding in by_identity.items():
+            if len(colliding) > 1:
+                logger.warning(
+                    "AOT disabled for %d scorer groups sharing program "
+                    "identity %s (address-stripped repr collision); they "
+                    "will trace instead",
+                    len(colliding), identity,
+                )
+                for group in colliding:
+                    group["aot_ok"] = False
 
     @property
     def names(self) -> List[str]:
@@ -115,6 +200,108 @@ class FleetScorer:
     @property
     def n_groups(self) -> int:
         return len(self._groups)
+
+    def _aot_targets(
+        self, row_buckets: Sequence[int]
+    ) -> List[Tuple[dict, int, int]]:
+        """(group, m, rows) for every program worth shipping: the
+        resident full-group machine axis (floored at 2 — single-machine
+        groups dispatch through the >=2-padded gather path on every
+        request, fleet_serving's bit-identity floor), × each row bucket
+        a request can pad into (windowed groups skip buckets too short
+        for one window — the per-model path's own error case)."""
+        targets = []
+        for group in self._groups:
+            if not group["aot_ok"]:
+                continue
+            m = max(2, len(group["names"]))
+            for rows in sorted(set(int(r) for r in row_buckets)):
+                if (
+                    group["windowed"]
+                    and rows - group["lookback"] + 1 - group["lookahead"] <= 0
+                ):
+                    continue
+                targets.append((group, m, rows))
+        return targets
+
+    def export_programs(
+        self, store, row_buckets: Optional[Sequence[int]] = None
+    ) -> List[dict]:
+        """
+        Build-time AOT: lower + compile each serving program at its
+        exact dispatch shapes and serialize into ``store``
+        (docs/performance.md "AOT executable cache"). Returns the
+        exported shape keys; the caller owns writing the manifest's
+        sibling artifacts. Best-effort per program: one architecture
+        failing to serialize skips that program, never the build.
+        """
+        from gordo_tpu.programs.aot import serving_row_buckets
+
+        if row_buckets is None:
+            row_buckets = serving_row_buckets()
+        exported: List[dict] = []
+        for group, m, rows in self._aot_targets(row_buckets):
+            key = self._aot_key(group, m, rows)
+            params_struct = jax.tree_util.tree_map(
+                lambda leaf: jax.ShapeDtypeStruct(
+                    (m,) + leaf.shape[1:], leaf.dtype
+                ),
+                group["params"],
+            )
+            batch_struct = jax.ShapeDtypeStruct(
+                (m, rows, group["n_features"]), jnp.float32
+            )
+            try:
+                with tracing.start_span(
+                    "program.compile", m=m, rows=rows, fn=group["fn_digest"]
+                ):
+                    compiled = group["apply"].lower(
+                        params_struct, batch_struct
+                    ).compile()
+                store.save(key, compiled)
+            except Exception as exc:  # noqa: BLE001 - export is best-effort
+                logger.warning(
+                    "AOT export skipped for %s (m=%d rows=%d): %s",
+                    group["fn_digest"], m, rows, exc,
+                )
+                continue
+            exported.append(key)
+        store.write_manifest()
+        emit_event(
+            "program_cache_export",
+            n_programs=len(exported),
+            output_dir=str(store.directory),
+        )
+        return exported
+
+    def warm_from_store(self) -> int:
+        """
+        Eagerly deserialize every stored executable matching this
+        scorer's groups (the preload path: pay the loads behind the
+        readiness probe, not the first request). Returns programs now
+        resident; load failures fall back silently per program.
+        """
+        if self._store is None:
+            return 0
+        # identity AND dispatch-shape match: a store built for a larger
+        # stack of the same architecture (machine axis m differs) holds
+        # programs this scorer can never dispatch — loading them would
+        # only burn memory
+        identities = {
+            (g["fn_digest"], g["params_digest"], max(2, len(g["names"])))
+            for g in self._groups
+            if g["aot_ok"]
+        }
+        loaded = 0
+        for key in self._store.keys():
+            if key.get("kind") != "fleet_scorer":
+                continue
+            identity = (key.get("fn"), key.get("params"), key.get("m"))
+            if identity not in identities:
+                continue
+            if self._cache.aot_program(key, self._store) is not None:
+                loaded += 1
+        return loaded
 
     def predict(self, inputs: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
         """
@@ -196,6 +383,47 @@ class FleetScorer:
                 ).inc(len(sub), windowed=windowed)
         return out
 
+    def _aot_key(self, group: dict, m: int, rows: int) -> Dict[str, Any]:
+        """The cross-process shape key one compiled dispatch is stored
+        under: program identity (function + per-machine param structure)
+        plus this dispatch's exact (machine-axis, row-bucket) shape."""
+        return {
+            "kind": "fleet_scorer",
+            "fn": group["fn_digest"],
+            "params": group["params_digest"],
+            "m": int(m),
+            "rows": int(rows),
+        }
+
+    def _dispatch(
+        self, group: dict, params: Any, batch: np.ndarray, m: int, rows: int
+    ) -> np.ndarray:
+        """
+        One device dispatch of ``m`` machine rows × ``rows`` padded
+        timesteps: an exact-shape AOT executable when the program cache
+        (or attached store) has one, else the group's jitted handle —
+        which traces/compiles on first use, the graceful floor every
+        cache failure lands on. An executable that LOADS but fails to
+        execute (shape drift, runtime error) is evicted and the request
+        retraces — degraded latency, never a serving error.
+        """
+        exe = (
+            self._cache.aot_program(self._aot_key(group, m, rows), self._store)
+            if group["aot_ok"]
+            else None
+        )
+        if exe is not None:
+            try:
+                return np.asarray(exe(params, jnp.asarray(batch)))
+            except Exception as exc:  # noqa: BLE001 - ANY failure retraces
+                logger.warning(
+                    "AOT executable failed at dispatch (%s); retracing", exc
+                )
+                self._cache.discard_aot(
+                    self._aot_key(group, m, rows), reason="execute_error"
+                )
+        return np.asarray(group["apply"](params, jnp.asarray(batch)))
+
     def _predict_entries(
         self, group: dict, entries: List[Tuple[int, str, np.ndarray]]
     ) -> List[np.ndarray]:
@@ -248,7 +476,9 @@ class FleetScorer:
                 )
                 for i, name in enumerate(names):
                     full[row_index[name]] = batch[i]
-                outputs = np.asarray(group["apply"](params, jnp.asarray(full)))
+                outputs = self._dispatch(
+                    group, params, full, group_size, max_rows
+                )
                 return [
                     outputs[row_index[name], : n_rows[i]]
                     for i, name in enumerate(names)
@@ -294,17 +524,19 @@ class FleetScorer:
             batch = np.pad(
                 batch, [(0, m_bucket - len(batch))] + [(0, 0)] * (batch.ndim - 1)
             )
-        outputs = np.asarray(group["apply"](params, jnp.asarray(batch)))
+        outputs = self._dispatch(group, params, batch, m_bucket, max_rows)
         return [outputs[i, : n_rows[i]] for i in range(len(names))]
 
 
-def fleet_scorer_from_models(models: Dict[str, Any]) -> Tuple[
-    Optional[FleetScorer], Dict[str, List], Dict[str, Any]
-]:
+def fleet_scorer_from_models(
+    models: Dict[str, Any], store=None
+) -> Tuple[Optional[FleetScorer], Dict[str, List], Dict[str, Any]]:
     """
     Build a FleetScorer from full (possibly wrapped) models as the server
     loads them: returns (scorer, host prefix-transformers per machine,
     non-batchable models that must fall back to per-model predict).
+    ``store`` attaches the collection's AOT program store so dispatches
+    prefer build-time serialized executables over a fresh trace.
     """
     from gordo_tpu.builder.fleet_build import _find_jax_estimator, _prefix_transformers
 
@@ -318,5 +550,5 @@ def fleet_scorer_from_models(models: Dict[str, Any]) -> Tuple[
         else:
             estimators[name] = est
             prefixes[name] = _prefix_transformers(model)
-    scorer = FleetScorer(estimators) if estimators else None
+    scorer = FleetScorer(estimators, store=store) if estimators else None
     return scorer, prefixes, fallback

@@ -4,6 +4,8 @@ Small shared utilities (reference parity: gordo/util/__init__.py:1-3).
 
 from .utils import (
     capture_args,
+    compile_cache_dir,
+    compile_cache_dir_bytes,
     enable_compile_cache,
     honor_jax_platforms_env,
     replace_all_non_ascii_chars_with_default,
@@ -13,6 +15,8 @@ from .compat import normalize_frequency
 
 __all__ = [
     "capture_args",
+    "compile_cache_dir",
+    "compile_cache_dir_bytes",
     "enable_compile_cache",
     "honor_jax_platforms_env",
     "replace_all_non_ascii_chars_with_default",

@@ -24,6 +24,32 @@ import typing
 from pathlib import Path
 
 
+def atomic_write_bytes(
+    path: typing.Union[str, os.PathLike], payload: bytes
+) -> Path:
+    """
+    Publish raw bytes at ``path`` atomically (write-temp-then-replace;
+    the binary sibling of :func:`atomic_write_json` — e.g. the program
+    cache's serialized executables). Readers see the previous content
+    or the new content, never a torn write. Parent directories are
+    created as needed.
+    """
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=f".{path.name}.tmp-")
+    try:
+        with os.fdopen(fd, "wb") as fh:
+            fh.write(payload)
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
 def atomic_write_json(
     path: typing.Union[str, os.PathLike],
     payload: typing.Any,

@@ -171,3 +171,54 @@ def enable_compile_cache(
         )
     except Exception as exc:  # noqa: BLE001 - cache is best-effort
         logger.warning("Persistent XLA compile cache unavailable: %s", exc)
+        return
+    global _active_compile_cache_dir
+    _active_compile_cache_dir = directory
+    try:
+        from gordo_tpu.observability import emit_event
+
+        # the cache used to be configured silently; the event makes the
+        # resolved directory (and thereby which runs shared it) visible
+        # in telemetry reports (docs/observability.md)
+        emit_event(
+            "compile_cache_enabled",
+            directory=directory,
+            min_compile_seconds=float(min_compile_seconds),
+        )
+    except Exception:  # noqa: BLE001 - telemetry never gates the cache
+        logger.debug("compile_cache_enabled event not emitted", exc_info=True)
+
+
+#: the directory the last successful enable_compile_cache pointed JAX at
+_active_compile_cache_dir: "str | None" = None
+
+
+def compile_cache_dir() -> "str | None":
+    """The active persistent compile-cache directory (None = never
+    enabled in this process, or disabled)."""
+    return _active_compile_cache_dir
+
+
+def compile_cache_dir_bytes(directory: "str | None" = None) -> "int | None":
+    """
+    Total on-disk bytes under the persistent compile cache (the
+    ``gordo_compile_cache_dir_bytes`` gauge the builder samples at build
+    start/end), or None when no cache is enabled/readable — the
+    CPU-test-friendly null, like the HBM watermark fields.
+    """
+    import os
+
+    directory = directory if directory is not None else _active_compile_cache_dir
+    if not directory:
+        return None
+    total = 0
+    try:
+        for root, _, files in os.walk(directory):
+            for fname in files:
+                try:
+                    total += os.path.getsize(os.path.join(root, fname))
+                except OSError:
+                    continue
+    except OSError:
+        return None
+    return total

@@ -561,8 +561,14 @@ def test_run_server_cli_passes_concurrency_knobs(runner, monkeypatch):
         "host": "127.0.0.1", "port": 5001, "workers": 3, "threads": 5,
         "worker_connections": 17,
         # batching defaults ride the config: 0 = disabled (the strict
-        # pass-through path, docs/serving.md#dynamic-batching)
-        "config": {"BATCH_WAIT_MS": 0.0, "BATCH_QUEUE_LIMIT": 64},
+        # pass-through path, docs/serving.md#dynamic-batching); scorer
+        # cache + AOT defaults likewise (docs/performance.md)
+        "config": {
+            "BATCH_WAIT_MS": 0.0,
+            "BATCH_QUEUE_LIMIT": 64,
+            "SCORER_CACHE_SIZE": 16,
+            "AOT_CACHE": True,
+        },
     }
 
 
@@ -585,6 +591,8 @@ def test_run_server_cli_passes_batching_knobs(runner, monkeypatch):
     assert captured["config"] == {
         "BATCH_WAIT_MS": 7.5,
         "BATCH_QUEUE_LIMIT": 32,
+        "SCORER_CACHE_SIZE": 16,
+        "AOT_CACHE": True,
     }
 
 

@@ -170,13 +170,19 @@ def test_fleet_predict_chunked_matches_direct():
     direct = trainer.predict(params, data.X)  # 56 windows <= default chunk
     chunked = trainer.predict(params, data.X, batch_size=9)  # 7 chunks, padded
     np.testing.assert_allclose(chunked, direct, rtol=1e-6, atol=1e-7)
-    # compiled programs are cached per geometry, not rebuilt per call
-    assert len(trainer._predict_fn_cache) == 2
+    # compiled programs are cached per geometry (in the trainer's
+    # ProgramCache under the "predict" namespace), not rebuilt per call
+    def predict_programs():
+        return [
+            k for k in trainer._programs._entries if k[0] == "predict"
+        ]
+
+    assert len(predict_programs()) == 2
     trainer.predict(params, data.X, batch_size=9)
-    assert len(trainer._predict_fn_cache) == 2
+    assert len(predict_programs()) == 2
     # direct-path programs don't depend on batch_size: one shared entry
     trainer.predict(params, data.X, batch_size=4096)
-    assert len(trainer._predict_fn_cache) == 2
+    assert len(predict_programs()) == 2
     with pytest.raises(ValueError, match="batch_size"):
         trainer.predict(params, data.X, batch_size=0)
 
