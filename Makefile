@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test lint bench bench-cold-start build-multiworker images push
+.PHONY: all test lint bench bench-cold-start bench-hetero build-multiworker images push
 
 all: lint test
 
@@ -32,6 +32,9 @@ bench:
 # the build-time AOT executable cache (docs/performance.md)
 bench-cold-start:
 	python benchmarks/cold_start.py --machines 6 --model lstm --repeats 2
+
+bench-hetero:
+	python benchmarks/hetero_fleet.py --output benchmarks/results_hetero_cpu_r10.json
 
 # 2-worker crash-tolerant ledger build of the example fleet config
 # (docs/robustness.md "Multi-worker builds") — the smoke proof that N

@@ -1,0 +1,198 @@
+"""
+Heterogeneous-fleet bucketing-compiler benchmark (docs/parallelism.md
+"Bucketing compiler"): what ``--bucket-policy padded`` buys on a mixed
+collection, and what it costs in model quality.
+
+The matrix is the paper's realistic fleet shape — several architecture
+families side by side (dense autoencoder, LSTM, GRU, TCN; the r05
+multichip dryrun already ran such mixes), each at several feature
+widths (ragged tag lists). Under the exact policy every (family, width)
+is its own XLA compile; under the padded policy same-family widths fuse
+into power-of-two-padded programs.
+
+Measures, on one JSON line (the bench-output contract):
+
+1. **Compile count, exact vs padded** — planned programs per policy
+   (the acceptance bar is padded <= exact / 2 on this matrix).
+2. **Models/hour at fixed MAE** — whole-build wall time and rate per
+   policy, plus per-machine window-aligned reconstruction MAE under
+   both policies and the worst relative MAE delta (the documented
+   parity tolerance; pad columns are masked out of training, so the
+   remaining delta is the padded family's derived layer widths).
+3. **Padding waste** — the planned feature-axis waste fraction, the
+   bound the power-of-two rounding promises (<50% per axis).
+
+CPU-runnable end to end (JAX_PLATFORMS=cpu); on a TPU host the same
+script measures real compile overlap. ``make bench-hetero`` writes
+``benchmarks/results_hetero_cpu_r10.json``.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+#: (label, model definition factory) — one entry per architecture
+#: family; every family takes the machine's tag count as its width
+ARCHITECTURES = (
+    (
+        "feedforward",
+        lambda epochs: {
+            "gordo_tpu.models.AutoEncoder": {
+                "kind": "feedforward_hourglass",
+                "epochs": epochs,
+                "batch_size": 32,
+            }
+        },
+    ),
+    (
+        "lstm",
+        lambda epochs: {
+            "gordo_tpu.models.LSTMAutoEncoder": {
+                "kind": "lstm_hourglass",
+                "lookback_window": 4,
+                "epochs": epochs,
+                "batch_size": 32,
+            }
+        },
+    ),
+    (
+        "gru",
+        lambda epochs: {
+            "gordo_tpu.models.GRUAutoEncoder": {
+                "kind": "gru_hourglass",
+                "lookback_window": 4,
+                "epochs": epochs,
+                "batch_size": 32,
+            }
+        },
+    ),
+    (
+        "tcn",
+        lambda epochs: {
+            "gordo_tpu.models.TCNAutoEncoder": {
+                "kind": "tcn_model",
+                "lookback_window": 4,
+                "channels": [8, 8],
+                "epochs": epochs,
+                "batch_size": 32,
+            }
+        },
+    ),
+)
+
+#: ragged widths per family: 3 and 4 round to ONE padded program
+#: (bucket 4), so padded compiles exactly half the exact policy's
+#: programs on this matrix — kept small enough that the full exact
+#: sweep (one XLA compile per cell) stays CPU-runnable
+WIDTHS = (3, 4)
+
+
+def _machines(epochs: int):
+    from gordo_tpu.machine import Machine
+
+    machines = []
+    for label, model_fn in ARCHITECTURES:
+        for width in WIDTHS:
+            machines.append(
+                Machine(
+                    name=f"hb-{label}-w{width}",
+                    project_name="hetero-bench",
+                    model=model_fn(epochs),
+                    dataset={
+                        "type": "RandomDataset",
+                        "train_start_date": "2017-12-25 06:00:00Z",
+                        "train_end_date": "2017-12-27 06:00:00Z",
+                        "tags": [[f"Tag {t}", None] for t in range(width)],
+                    },
+                )
+            )
+    return machines
+
+
+def _reconstruction_mae(model, machine) -> float:
+    """Window-aligned MAE of a built model on its own training data."""
+    from gordo_tpu.data import _get_dataset
+
+    X, y = _get_dataset(machine.dataset.to_dict()).get_data()
+    predicted = np.asarray(model.predict(np.asarray(X, dtype="float32")))
+    target = np.asarray(y)[-len(predicted):]
+    return float(np.abs(predicted - target).mean())
+
+
+def _run_policy(policy: str, epochs: int) -> dict:
+    from gordo_tpu.builder import FleetModelBuilder
+    from gordo_tpu.parallel.bucketing import plan_padding_waste
+
+    machines = _machines(epochs)
+    builder = FleetModelBuilder(machines, bucket_policy=policy)
+    start = time.perf_counter()
+    results = builder.build()
+    wall = time.perf_counter() - start
+    mae = {
+        machine.name: _reconstruction_mae(model, machine)
+        for model, machine in results
+    }
+    report = builder.telemetry_report_ or {}
+    return {
+        "policy": policy,
+        "n_machines": len(machines),
+        "n_programs": len(builder.plan_ or []),
+        "padding_waste_ratio": plan_padding_waste(builder.plan_ or []),
+        "build_wall_s": round(wall, 3),
+        "models_per_hour": report.get("models_per_hour"),
+        "mae": mae,
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[1])
+    parser.add_argument("--epochs", type=int, default=10)
+    parser.add_argument(
+        "--output", default=None, help="Also write the JSON result here"
+    )
+    args = parser.parse_args()
+
+    exact = _run_policy("exact", args.epochs)
+    padded = _run_policy("padded", args.epochs)
+
+    # per-machine parity: relative MAE delta padded vs exact — the
+    # number the documented tolerance (docs/parallelism.md) is about
+    deltas = {
+        name: abs(padded["mae"][name] - exact["mae"][name])
+        / max(exact["mae"][name], 1e-9)
+        for name in exact["mae"]
+    }
+    result = {
+        "bench": "hetero_fleet",
+        "backend": os.environ.get("JAX_PLATFORMS") or "default",
+        "matrix": {
+            "families": [label for label, _ in ARCHITECTURES],
+            "widths": list(WIDTHS),
+            "epochs": args.epochs,
+        },
+        "exact": exact,
+        "padded": padded,
+        "compile_reduction": (
+            exact["n_programs"] / padded["n_programs"]
+            if padded["n_programs"]
+            else None
+        ),
+        "mae_rel_delta_max": max(deltas.values()),
+        "mae_rel_delta_mean": sum(deltas.values()) / len(deltas),
+    }
+    line = json.dumps(result, sort_keys=True)
+    print(line)
+    if args.output:
+        with open(args.output, "w") as fh:
+            fh.write(line + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -76,6 +76,10 @@ HOT_PATH_PATTERNS = (
     # fleet-scoring requests): a host sync in a lookup loop would stall
     # the very cold-start path the subsystem exists to remove
     "gordo_tpu/programs/",
+    # the bucketing compiler's planning CLI walks the whole fleet per
+    # invocation (and its planning code is shared with the builder's
+    # hot path) — keep the new module under the same discipline
+    "gordo_tpu/cli/buckets.py",
 )
 
 

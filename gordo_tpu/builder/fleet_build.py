@@ -61,7 +61,12 @@ from gordo_tpu.observability import (
     tracing,
     write_telemetry_report,
 )
-from gordo_tpu.parallel.bucketing import bucket_machines, timestep_bucket
+from gordo_tpu.parallel.bucketing import (
+    BucketPlan,
+    get_policy,
+    plan_padding_waste,
+    timestep_bucket,
+)
 from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
 from gordo_tpu.parallel.mesh import auto_device_mesh
 from gordo_tpu.robustness import faults
@@ -166,6 +171,16 @@ class FleetModelBuilder:
         ordinary ``("train",)``; lifecycle refits pass
         ``("train", "refit")`` so ``refit:nan:<machine>`` targets refit
         builds without touching unrelated training.
+    bucket_policy
+        The bucketing-compiler grouping policy (``"exact"`` |
+        ``"padded"`` | a ready :class:`~gordo_tpu.parallel.bucketing.
+        BucketPolicy`; docs/parallelism.md "Bucketing compiler").
+        ``"exact"`` — the default — is the historical one-program-per-
+        exact-geometry grouping, pinned bit-identical. ``"padded"``
+        coalesces same-architecture-family machines with ragged feature
+        widths into one program at power-of-two padded dims; pad
+        columns are masked out of loss/metrics/early-stopping during
+        training and stripped from predictions at serving.
     """
 
     def __init__(
@@ -182,6 +197,7 @@ class FleetModelBuilder:
         initial_params: Optional[Dict[str, Any]] = None,
         fault_sites: Tuple[str, ...] = ("train",),
         aot_cache: bool = False,
+        bucket_policy: Any = "exact",
     ):
         self.machines = machines
         if mesh is None and auto_mesh:
@@ -199,6 +215,11 @@ class FleetModelBuilder:
         self.fetch_backoff = fetch_backoff
         self.initial_params = initial_params
         self.fault_sites = tuple(fault_sites)
+        #: the bucketing compiler's grouping policy (exact|padded); the
+        #: ledger's work plan derives from the same object, so a build's
+        #: grouping and its plan fingerprint can never disagree
+        self._policy = get_policy(bucket_policy)
+        self.bucket_policy = self._policy.name
         #: AOT-compile + serialize the built collection's SERVING
         #: programs beside the artifacts (<output>/.programs/), so a
         #: fresh server's cold start is a deserialize instead of a
@@ -206,6 +227,9 @@ class FleetModelBuilder:
         #: default at the API layer (tests build thousands of tiny
         #: fleets); the build-fleet CLI defaults it ON.
         self.aot_cache = bool(aot_cache)
+        #: the last build's bucket plan (set by _build_all; the
+        #: benchmark and tests read program counts from it)
+        self.plan_: Optional[List[BucketPlan]] = None
         #: per-bucket telemetry accumulated by _build_bucket, assembled
         #: into telemetry_report_ (and persisted next to artifacts) by
         #: build()
@@ -466,14 +490,19 @@ class FleetModelBuilder:
                 )
             to_build = remaining
 
-        buckets = bucket_machines(to_build)
+        with tracing.start_span(
+            "build.plan", policy=self.bucket_policy, n_machines=len(to_build)
+        ):
+            plans = self._policy.plan(to_build)
+        self._emit_plan_telemetry(plans, n_machines=len(to_build))
         logger.info(
-            "Fleet build: %d machines in %d buckets", len(to_build), len(buckets)
+            "Fleet build: %d machines in %d buckets (policy=%s)",
+            len(to_build), len(plans), self.bucket_policy,
         )
 
         try:
-            for bucket in buckets.values():
-                results.update(self._build_bucket_entry(bucket, base))
+            for plan in plans:
+                results.update(self._build_bucket_entry(plan.machines, base))
         except BaseException as exc:
             # the crash context the round-5 worker deaths never left
             # behind: what was in flight and how memory looked at death
@@ -495,9 +524,36 @@ class FleetModelBuilder:
             started_iso=started_iso,
             n_built=len(results) - n_resumed,
             n_resumed=n_resumed,
-            n_buckets=len(buckets),
+            n_buckets=len(plans),
         )
         return [results[m.name] for m in self.machines if m.name in results]
+
+    def _emit_plan_telemetry(
+        self, plans: List[BucketPlan], n_machines: int
+    ) -> None:
+        """
+        Publish the bucketing compiler's plan: one ``bucket_planned``
+        event (programs that will compile, machines per program, the
+        planned padding-waste fraction across the feature axes) and the
+        ``gordo_build_padding_waste_ratio`` gauge. The same numbers back
+        the ``gordo-tpu buckets plan`` dry-run, so what an operator
+        previews is what a build reports.
+        """
+        waste = plan_padding_waste(plans)
+        self.plan_ = plans
+        emit_event(
+            "bucket_planned",
+            policy=self.bucket_policy,
+            n_programs=len(plans),
+            n_machines=n_machines,
+            machines_per_program=[len(p.machines) for p in plans],
+            padding_waste_ratio=round(waste, 6),
+        )
+        get_registry().gauge(
+            "gordo_build_padding_waste_ratio",
+            "Planned fraction of padded (inert) feature cells across the "
+            "last build's programs (0 = exact geometry)",
+        ).set(waste)
 
     def _scan_resumable(
         self, machines: List[Machine], base: Path
@@ -764,6 +820,7 @@ class FleetModelBuilder:
             "n_built": n_built,
             "n_resumed": n_resumed,
             "n_buckets": n_buckets,
+            "bucket_policy": self.bucket_policy,
             "models_per_hour": rate,
             "device_memory": memory_watermarks(),
             "buckets": self._bucket_reports,
@@ -897,11 +954,19 @@ class FleetModelBuilder:
             Xs_t.append(X_t)
             ys_np.append(np.asarray(item["y"], dtype=np.float32))
 
-        # Architecture spec from the first estimator (identical across the
-        # bucket by construction).
+        # Architecture spec from the first estimator (identical family
+        # across the bucket by construction). The program's tensor dims
+        # come from the bucketing policy: the exact policy returns the
+        # bucket's (uniform) real widths unchanged; the padded policy
+        # rounds the post-transform maxima up to power-of-two buckets so
+        # ragged-width machines share this one compiled program
+        # (docs/parallelism.md "Bucketing compiler").
+        in_widths = [X_t.shape[1] for X_t in Xs_t]
+        out_widths = [y_np.shape[1] for y_np in ys_np]
+        f_prog, f_out_prog = self._policy.program_dims(in_widths, out_widths)
         proto_est = estimators[0]
         proto_est.kwargs.update(
-            {"n_features": Xs_t[0].shape[1], "n_features_out": ys_np[0].shape[1]}
+            {"n_features": f_prog, "n_features_out": f_out_prog}
         )
         spec = proto_est._build_spec()
         lookahead = proto_est.lookahead if spec.windowed else 0
@@ -956,15 +1021,31 @@ class FleetModelBuilder:
         )
 
         # Stack to a common power-of-two grid (so ragged buckets share one
-        # compiled program geometry), pad fleet to mesh multiple.
+        # compiled program geometry), pad fleet to mesh multiple. Feature
+        # axes pad to the program dims; ragged output widths produce the
+        # feature_out_weight mask that keeps pad columns out of
+        # loss/metrics/early-stopping (parallel/fleet.py).
         n_grid = timestep_bucket(max(len(x) for x in Xs_t))
         m_padded = FleetTrainer.pad_fleet_size(len(bucket), self.mesh)
         Xs_grid = Xs_t
         ys_grid = ys_np
         data = StackedData.from_ragged(
-            Xs_grid, ys_grid, n_machines_padded=m_padded, n_timesteps=n_grid
+            Xs_grid,
+            ys_grid,
+            n_machines_padded=m_padded,
+            n_timesteps=n_grid,
+            n_features=f_prog,
+            n_features_out=f_out_prog,
         )
 
+        # one compiled fleet program per bucket geometry from here on —
+        # the count the padded policy exists to shrink
+        get_registry().counter(
+            "gordo_build_programs_compiled_total",
+            "Compiled fleet programs (one per bucket geometry) built by "
+            "fleet builds",
+            ("kind",),
+        ).inc(kind=self.bucket_policy)
         fit_args = proto_est.extract_supported_fit_args(proto_est.kwargs)
         epochs = int(fit_args.get("epochs", 1))
         batch_size = int(fit_args.get("batch_size", 32))
@@ -1059,8 +1140,16 @@ class FleetModelBuilder:
             machine: Machine = item["machine"]
             est.spec_ = spec
             est.params_ = host_params[i]
-            est.n_features_ = Xs_grid[i].shape[1]
-            est.n_features_out_ = ys_grid[i].shape[1]
+            # the PROGRAM dims are the model's true tensor widths (its
+            # module was built with them); a padded machine additionally
+            # records its real (active) widths so predict/serving pad
+            # inputs and strip pad columns from responses
+            # (docs/serving.md "Padded programs")
+            est.n_features_ = f_prog
+            est.n_features_out_ = f_out_prog
+            if in_widths[i] != f_prog or out_widths[i] != f_out_prog:
+                est.n_active_features_ = in_widths[i]
+                est.n_active_features_out_ = out_widths[i]
             val_series = getattr(trainer, "val_losses_", None)
             # a NaN column marks a machine too small for any validation
             # samples — it has no val_loss history, like the solo path
@@ -1134,7 +1223,17 @@ class FleetModelBuilder:
                 "n_machines": len(bucket),
                 "n_machines_padded": int(m_padded),
                 "n_timesteps_grid": int(n_grid),
-                "n_features": int(Xs_grid[0].shape[1]),
+                "n_features": int(f_prog),
+                "n_features_out": int(f_out_prog),
+                "bucket_policy": self.bucket_policy,
+                # measured (post-transform) feature-axis padding of this
+                # program's stack — the build-time counterpart of the
+                # plan's estimate
+                "padding_waste_ratio": (
+                    1.0
+                    - (sum(in_widths) + sum(out_widths))
+                    / (len(bucket) * (f_prog + f_out_prog))
+                ),
                 "epochs": epochs,
                 "batch_size": batch_size,
                 "cv_duration_s": cv_duration,
@@ -1352,7 +1451,10 @@ class FleetModelBuilder:
                 valid = test_out_rows >= 0
                 test_out_rows = test_out_rows[valid]
                 rows_in = test_idx[valid]
-                y_pred = preds[i][test_out_rows]
+                # predictions carry the PROGRAM's (possibly padded)
+                # output width; scores and thresholds are computed on
+                # the machine's real columns only (ys_grid is unpadded)
+                y_pred = preds[i][test_out_rows][:, : ys_grid[i].shape[1]]
                 y_true = ys_grid[i][rows_in]
 
                 for metric_name, func in metric_funcs.items():

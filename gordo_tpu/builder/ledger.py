@@ -70,7 +70,7 @@ from pathlib import Path
 
 from gordo_tpu.machine import Machine
 from gordo_tpu.observability import emit_event, get_registry, tracing
-from gordo_tpu.parallel.bucketing import bucket_machines
+from gordo_tpu.parallel.bucketing import get_policy
 from gordo_tpu.robustness import faults
 from gordo_tpu.utils import atomic
 
@@ -117,21 +117,29 @@ class ClaimedUnit(typing.NamedTuple):
     stolen: bool
 
 
-def plan_units(machines: typing.List[Machine]) -> typing.List[WorkUnit]:
+def plan_units(
+    machines: typing.List[Machine], policy=None
+) -> typing.List[WorkUnit]:
     """
     The deterministic work plan: one unit per bucket, identified by a
-    digest of the bucket key AND its machine names — every worker
+    digest of the COMPILED-PROGRAM key (parallel/bucketing.py:
+    ``ProgramKey.digest_payload``) and its machine names — every worker
     derives the identical list from the identical config, which is what
-    lets N processes coordinate through lease files alone.
+    lets N processes coordinate through lease files alone. ``policy``
+    is the bucketing-compiler grouping policy: units follow the
+    programs a policy would compile, so a padded build plans FEWER,
+    larger units than an exact one. The default exact policy's digests
+    are byte-identical to the historical ``bucket_machines`` plan; any
+    other policy's payload carries the policy name, so flipping the
+    policy always changes the plan fingerprint and a mismatched worker
+    refuses to join a live ledger.
     """
     digests = []
-    for (model_key, n_feat, n_feat_out), bucket in bucket_machines(
-        machines
-    ).items():
-        names = tuple(m.name for m in bucket)
+    for plan in get_policy(policy).plan(machines):
+        names = tuple(m.name for m in plan.machines)
         digest = hashlib.sha1(
             json.dumps(
-                [model_key, n_feat, n_feat_out, list(names)], sort_keys=True
+                [*plan.key.digest_payload(), list(names)], sort_keys=True
             ).encode()
         ).hexdigest()
         digests.append((digest, names))
@@ -218,11 +226,18 @@ class Ledger:
 
     # -- plan -------------------------------------------------------------
 
-    def ensure_plan(self, units: typing.List[WorkUnit]) -> None:
+    def ensure_plan(
+        self, units: typing.List[WorkUnit], bucket_policy: str = "exact"
+    ) -> None:
         """
         Publish the work plan, or join the one already on disk — which
         must fingerprint-match this worker's (building a DIFFERENT
-        config against a live ledger would corrupt both builds).
+        config against a live ledger would corrupt both builds). The
+        bucketing policy is part of the plan identity: a worker running
+        ``--bucket-policy padded`` against an exact ledger (or vice
+        versa) would build different program geometries into the same
+        artifact tree, so it refuses to join exactly like a config
+        mismatch — with the policy named in the error.
         """
         self.units_dir.mkdir(parents=True, exist_ok=True)
         self.workers_dir.mkdir(parents=True, exist_ok=True)
@@ -232,6 +247,7 @@ class Ledger:
             "created": _utcnow_iso(),
             "created_by": self.worker_id,
             "plan_hash": fingerprint,
+            "bucket_policy": bucket_policy,
             "n_units": len(units),
             "n_machines": sum(len(u.machines) for u in units),
             "units": [
@@ -244,6 +260,15 @@ class Ledger:
             )
         except FileExistsError:
             existing = self.read_plan()
+            existing_policy = existing.get("bucket_policy", "exact")
+            if existing_policy != bucket_policy:
+                raise LedgerPlanMismatch(
+                    f"Ledger at {self.base} was planned with "
+                    f"--bucket-policy {existing_policy} but this worker "
+                    f"runs --bucket-policy {bucket_policy}; every worker "
+                    "of a build must group machines identically — remove "
+                    "the ledger directory to start a fresh build"
+                )
             if existing.get("plan_hash") != fingerprint:
                 raise LedgerPlanMismatch(
                     f"Ledger at {self.base} was planned from a different "
@@ -1002,14 +1027,18 @@ def run_worker(
     os.environ[faults.WORKER_ID_ENV_VAR] = str(worker_id)
     machines = builder.machines
     by_name = {m.name: m for m in machines}
-    units = plan_units(machines)
+    # the plan derives from the BUILDER's policy object, so a worker's
+    # grouping and its published plan can never disagree
+    units = plan_units(machines, policy=getattr(builder, "_policy", None))
     ledger = Ledger(
         output_dir,
         worker_id,
         lease_ttl=lease_ttl,
         max_attempts=max_attempts,
     )
-    ledger.ensure_plan(units)
+    ledger.ensure_plan(
+        units, bucket_policy=getattr(builder, "bucket_policy", "exact")
+    )
     poll = (
         poll_interval
         if poll_interval is not None

@@ -1,0 +1,205 @@
+"""
+``gordo-tpu buckets``: inspect the bucketing compiler's grouping
+(docs/parallelism.md "Bucketing compiler") without burning a build.
+
+``buckets plan`` is the dry run: it runs the SAME planning code the
+builder and the multi-worker ledger run (``parallel.bucketing.
+plan_buckets``), then prints the programs that would compile, the
+machines each one fuses, and the planned padding-waste fraction per
+feature axis — the numbers an operator needs to judge ``--bucket-policy
+padded`` against ``exact`` before committing hardware time.
+"""
+
+import json
+import sys
+import typing
+
+import click
+import yaml
+
+from gordo_tpu import serializer
+from gordo_tpu.cli.custom_types import key_value_par
+from gordo_tpu.machine import Machine
+from gordo_tpu.parallel.bucketing import (
+    BUCKET_POLICIES,
+    plan_buckets,
+    plan_padding_waste,
+)
+
+
+@click.group("buckets")
+def buckets_cli():
+    """The bucketing compiler (docs/parallelism.md): preview how a
+    grouping policy fuses machines into compiled programs."""
+
+
+def _load_machines(
+    machines_config: typing.Optional[list],
+    model_parameter: typing.Sequence[typing.Tuple[str, typing.Any]] = (),
+) -> typing.List[Machine]:
+    """Machine objects from a build-fleet style config list, normalized
+    exactly like ``build-fleet`` does (jinja ``--model-parameter``
+    expansion, then a serializer round-trip) — the plan must group on
+    the same canonical configs the build will."""
+    # late import: cli.cli imports this module at load time
+    from gordo_tpu.cli.cli import expand_model
+
+    if not machines_config:
+        raise click.UsageError(
+            "MACHINES-CONFIG is required (argument, MACHINES env var, or "
+            "--machines-from)"
+        )
+    machines = []
+    for machine_config in machines_config:
+        if model_parameter and isinstance(machine_config["model"], str):
+            machine_config["model"] = expand_model(
+                machine_config["model"], dict(model_parameter)
+            )
+        machine = Machine.from_config(
+            machine_config, project_name=machine_config["project_name"]
+        )
+        machine.model = serializer.into_definition(
+            serializer.from_definition(machine.model)
+        )
+        machines.append(machine)
+    return machines
+
+
+def _model_label(machine: Machine) -> str:
+    """A short human label for a machine's architecture family: the
+    innermost estimator class + its ``kind`` when present."""
+
+    def walk(node):
+        if not isinstance(node, dict):
+            return None
+        for key, value in node.items():
+            if isinstance(value, dict):
+                kind = value.get("kind")
+                if kind:
+                    return f"{key.rsplit('.', 1)[-1]}[{kind}]"
+                found = walk(value)
+                if found:
+                    return found
+            if isinstance(value, list):
+                for item in value:
+                    found = walk(item)
+                    if found:
+                        return found
+        return next(iter(node), None)
+
+    return walk(machine.model) or "?"
+
+
+@buckets_cli.command("plan")
+@click.argument(
+    "machines-config",
+    envvar="MACHINES",
+    type=yaml.safe_load,
+    required=False,
+    default=None,
+)
+@click.option(
+    "--bucket-policy",
+    type=click.Choice(list(BUCKET_POLICIES)),
+    default="exact",
+    envvar="GORDO_BUCKET_POLICY",
+    show_default=True,
+    help="Grouping policy to preview (the build-fleet flag of the same "
+    "name).",
+)
+@click.option(
+    "--machines-from",
+    type=click.Path(exists=True, dir_okay=False),
+    default=None,
+    help="Read MACHINES-CONFIG from this JSON/YAML file (same escape "
+    "hatch as build-fleet for configs past the exec-string cap).",
+)
+@click.option(
+    "--model-parameter",
+    type=key_value_par,
+    multiple=True,
+    default=(),
+    help="key,value pair injected into jinja variables of a string "
+    "model config (same as build-fleet's flag — the preview must "
+    "expand configs identically); repeatable.",
+)
+@click.option(
+    "--as-json",
+    is_flag=True,
+    help="Emit the plan as JSON instead of the human table.",
+)
+def buckets_plan(
+    machines_config: list,
+    bucket_policy: str,
+    machines_from: str,
+    model_parameter: typing.List[typing.Tuple[str, typing.Any]],
+    as_json: bool,
+):
+    """
+    Dry-run the bucketing compiler over MACHINES-CONFIG: the programs
+    that would compile under --bucket-policy, machines per program, and
+    the planned padding-waste %% per feature axis. Compares against the
+    exact policy's program count so the compile-count win is explicit.
+    """
+    if machines_from is not None:
+        with open(machines_from) as fh:
+            machines_config = yaml.safe_load(fh)
+    machines = _load_machines(machines_config, model_parameter)
+    plans = plan_buckets(machines, bucket_policy)
+    exact_count = (
+        len(plan_buckets(machines, "exact"))
+        if bucket_policy != "exact"
+        else len(plans)
+    )
+    payload = {
+        "policy": bucket_policy,
+        "n_machines": len(machines),
+        "n_programs": len(plans),
+        "n_programs_exact": exact_count,
+        "padding_waste_ratio": plan_padding_waste(plans),
+        "programs": [
+            {
+                "model": _model_label(plan.machines[0]),
+                "n_features": plan.key.n_features,
+                "n_features_out": plan.key.n_features_out,
+                "n_machines": plan.n_machines,
+                "machines": [m.name for m in plan.machines],
+                "padding_waste": plan.padding_waste(),
+            }
+            for plan in plans
+        ],
+    }
+    if as_json:
+        click.echo(json.dumps(payload, indent=2, sort_keys=True))
+        return 0
+    click.echo(
+        f"Bucketing plan (policy={bucket_policy}): {len(machines)} "
+        f"machine(s) -> {len(plans)} compiled program(s)"
+        + (
+            f" (exact policy would compile {exact_count})"
+            if bucket_policy != "exact"
+            else ""
+        )
+    )
+    for index, (plan, entry) in enumerate(zip(plans, payload["programs"])):
+        waste = entry["padding_waste"]
+        click.echo(
+            f"  program {index}: {entry['model']}  "
+            f"f={entry['n_features']} f_out={entry['n_features_out']}  "
+            f"{entry['n_machines']} machine(s)  "
+            f"waste features={waste['features']:.1%} "
+            f"features_out={waste['features_out']:.1%}"
+        )
+        names = entry["machines"]
+        shown = ", ".join(names[:8]) + (" …" if len(names) > 8 else "")
+        click.echo(f"    machines: {shown}")
+    click.echo(
+        f"Planned padding waste (feature axes, all programs): "
+        f"{payload['padding_waste_ratio']:.1%} — timestep-axis padding "
+        "is data-dependent and not known at plan time"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(buckets_cli())

@@ -28,6 +28,7 @@ import yaml
 from gordo_tpu import __version__, serializer, utils
 from gordo_tpu.builder import FleetModelBuilder, ModelBuilder
 from gordo_tpu.builder import ledger as fleet_ledger
+from gordo_tpu.cli.buckets import buckets_cli
 from gordo_tpu.cli.client import client as gordo_client
 from gordo_tpu.cli.custom_types import HostIP, key_value_par
 from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
@@ -327,6 +328,21 @@ def build(
     "cold start deserializes instead of re-tracing "
     "(docs/performance.md 'AOT executable cache').",
 )
+@click.option(
+    "--bucket-policy",
+    type=click.Choice(["exact", "padded"]),
+    default="exact",
+    envvar="GORDO_BUCKET_POLICY",
+    show_default=True,
+    help="Bucketing-compiler grouping policy (docs/parallelism.md "
+    "'Bucketing compiler'): 'exact' compiles one program per exact "
+    "(config, n_features, n_features_out) geometry — the historical "
+    "grouping, bit-identical; 'padded' fuses same-architecture-family "
+    "machines with ragged feature widths into one program at "
+    "power-of-two padded dims (fewer compiles; pad columns are masked "
+    "out of training and stripped from responses). Preview with "
+    "`gordo-tpu buckets plan`.",
+)
 @_with_build_options
 def build_fleet(
     machines_config: list,
@@ -334,6 +350,7 @@ def build_fleet(
     resume: bool,
     epoch_chunk: int,
     on_error: str,
+    bucket_policy: str,
     fetch_retries: int,
     fetch_timeout: float,
     aot_cache: bool,
@@ -393,6 +410,7 @@ def build_fleet(
                 "--epoch-chunk", str(epoch_chunk),
                 "--on-error", on_error,
                 "--fetch-retries", str(fetch_retries),
+                "--bucket-policy", bucket_policy,
             ]
             if fetch_timeout is not None:
                 worker_args += ["--fetch-timeout", str(fetch_timeout)]
@@ -453,6 +471,7 @@ def build_fleet(
             on_error=on_error,
             fetch_retries=fetch_retries,
             fetch_timeout=fetch_timeout,
+            bucket_policy=bucket_policy,
             # worker processes skip the export: serving groups span
             # units, so the orchestrator exports over the finalized
             # collection instead
@@ -988,6 +1007,7 @@ gordo.add_command(build_fleet)
 gordo.add_command(sweep_cli)
 gordo.add_command(run_server_cli)
 gordo.add_command(gordo_client)
+gordo.add_command(buckets_cli)
 gordo.add_command(programs_cli)
 gordo.add_command(telemetry_cli)
 gordo.add_command(trace_cli)

@@ -448,6 +448,36 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
             self._device_params = jax.device_put(self.params_)
         return self._apply_fn
 
+    def _pad_active_input(self, X: np.ndarray) -> np.ndarray:
+        """
+        Widen a real-width input up to the model's program width with
+        zero pad COLUMNS — the serving half of the padded bucket policy
+        (docs/parallelism.md "Bucketing compiler"): an artifact built
+        into a padded program records its real width as
+        ``n_active_features_`` and its module expects ``n_features_``
+        columns. Exact-bucket artifacts (no active attrs) pass through
+        untouched.
+        """
+        n_active = getattr(self, "n_active_features_", None)
+        f_prog = getattr(self, "n_features_", None)
+        if (
+            n_active is None
+            or f_prog is None
+            or X.shape[-1] != n_active
+            or n_active >= f_prog
+        ):
+            return X
+        pad = [(0, 0)] * (X.ndim - 1) + [(0, f_prog - n_active)]
+        return np.pad(np.asarray(X), pad)
+
+    def _strip_pad_output(self, out: np.ndarray) -> np.ndarray:
+        """Drop inert pad columns from a padded program's output, so
+        responses carry exactly the machine's real target width."""
+        n_active_out = getattr(self, "n_active_features_out_", None)
+        if n_active_out is None or out.shape[-1] <= n_active_out:
+            return out
+        return out[..., :n_active_out]
+
     def _forward(self, X: np.ndarray, batch_size: int = 10000) -> np.ndarray:
         """
         Apply the model to prepared model-inputs (already windowed if
@@ -459,8 +489,11 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         apply_fn = self._ensure_apply_fn()
         params = getattr(self, "_device_params", self.params_)
         if len(X) == 0:
-            n_out = getattr(self, "n_features_out_", 0)
+            n_out = getattr(self, "n_active_features_out_", None) or getattr(
+                self, "n_features_out_", 0
+            )
             return np.empty((0, n_out), dtype=np.float32)
+        X = self._pad_active_input(X)
         outs = []
         for start in range(0, len(X), batch_size):
             xb_host = np.asarray(X[start : start + batch_size], dtype=np.float32)
@@ -470,7 +503,7 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
                 pad_width = ((0, bucket - n),) + ((0, 0),) * (xb_host.ndim - 1)
                 xb_host = np.pad(xb_host, pad_width)
             out = apply_fn(params, jnp.asarray(xb_host))
-            outs.append(np.asarray(out[:n]))
+            outs.append(self._strip_pad_output(np.asarray(out[:n])))
         return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
     def predict(self, X: np.ndarray, **kwargs) -> np.ndarray:

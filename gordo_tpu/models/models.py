@@ -121,6 +121,9 @@ class LSTMBaseEstimator(BaseJaxEstimator, TransformerMixin):
         """
         X = X.values if hasattr(X, "values") else np.asarray(X)
         X = self._validate_and_fix_size_of_X(X).astype(np.float32, copy=False)
+        # padded-bucket artifacts take real-width inputs; the program
+        # wants its padded width (pad columns are inert — core.py)
+        X = self._pad_active_input(X)
         n_out = num_windows(len(X), self.lookback_window, self.lookahead)
         if n_out <= 0:
             # same loud contract as ops.windowing's index builder
@@ -140,7 +143,7 @@ class LSTMBaseEstimator(BaseJaxEstimator, TransformerMixin):
             params = jax.tree.map(lambda a: a[None], jax.device_put(self.params_))
             self._device_params_stacked = params
         out = trainer.predict(params, X[None])[0]
-        return np.asarray(out[:n_out])
+        return self._strip_pad_output(np.asarray(out[:n_out]))
 
     def _spec_serving_trainer(self):
         """

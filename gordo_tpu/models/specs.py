@@ -108,6 +108,30 @@ def per_sample_loss(loss: str, y_pred: jnp.ndarray, y_true: jnp.ndarray) -> jnp.
     return jnp.mean(elementwise(y_pred - y_true), axis=-1)
 
 
+def masked_per_sample_loss(
+    loss: str,
+    y_pred: jnp.ndarray,
+    y_true: jnp.ndarray,
+    feature_weight: jnp.ndarray,
+) -> jnp.ndarray:
+    """
+    :func:`per_sample_loss` with a {0,1} feature mask: the mean runs
+    over the REAL output columns only, so a padded-bucket machine's
+    loss (and the gradients, early stopping and quarantine decisions
+    derived from it) ignores inert pad columns entirely. Zeroing the
+    error before the elementwise loss is exact for every registered
+    loss (they all map 0 -> 0), and with an all-ones mask this reduces
+    to :func:`per_sample_loss` exactly.
+    """
+    try:
+        elementwise = _LOSSES[loss]
+    except KeyError:
+        raise ValueError(f"Unknown loss {loss!r}; available: {sorted(_LOSSES)}") from None
+    err = (y_pred - y_true) * feature_weight
+    n_real = jnp.maximum(jnp.sum(feature_weight), 1.0)
+    return jnp.sum(elementwise(err), axis=-1) / n_real
+
+
 @dataclasses.dataclass
 class ModelSpec:
     """What a factory returns: architecture + training configuration."""

@@ -19,7 +19,15 @@ problem, and one compiled program serves the whole bucket.
 
 from .mesh import auto_device_mesh, fleet_sharding, get_device_mesh, replicated_sharding
 from .fleet import FleetTrainer, StackedData
-from .bucketing import bucket_machines
+from .bucketing import (
+    BucketPlan,
+    ProgramKey,
+    bucket_machines,
+    dimension_bucket,
+    get_policy,
+    plan_buckets,
+    timestep_bucket,
+)
 from .sequence import (
     ring_attention,
     sequence_sharded_attention,
@@ -38,7 +46,13 @@ __all__ = [
     "replicated_sharding",
     "FleetTrainer",
     "StackedData",
+    "BucketPlan",
+    "ProgramKey",
     "bucket_machines",
+    "dimension_bucket",
+    "get_policy",
+    "plan_buckets",
+    "timestep_bucket",
     "ring_attention",
     "ulysses_attention",
     "sequence_sharded_attention",

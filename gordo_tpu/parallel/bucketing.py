@@ -1,49 +1,342 @@
 """
-Bucketing: group Machines into fleets that can share one compiled program.
+The bucketing compiler: decide which Machines share one compiled program.
 
 XLA compiles one program per (architecture, tensor-geometry); a thousand
 tiny models must not mean a thousand compiles (SURVEY.md §7 "hard parts").
-Machines bucket by:
+This module separates the two halves of that decision:
 
-- canonical model config (minus name-level noise) — same architecture,
-- n_features / n_features_out — same parameter shapes,
-- a padded-timestep bucket — data lengths round up to powers of two so a
-  fleet with slightly ragged histories still shares one program.
+- a Machine's **spec** — what the config says it is: canonical model
+  definition, n_features / n_features_out from its tag lists;
+- the **compiled-program key** a grouping *policy* assigns it — the
+  identity the builder compiles, the ledger plans and the AOT store
+  ships (docs/parallelism.md "Bucketing compiler").
+
+Two policies exist:
+
+- ``exact`` (the default): one program per exact (canonical config,
+  n_features, n_features_out) — bit-identical to the historical
+  ``bucket_machines`` grouping, pinned by test.
+- ``padded``: same-architecture-family machines with ragged feature
+  widths fuse into one program at power-of-two padded dims (the
+  ``timestep_bucket`` idea applied to the feature/width axes, so waste
+  is bounded at <2x per axis); inert pad columns are masked out of
+  loss/metrics/early-stopping by the fleet trainer, and stripped from
+  responses by the scorer.
+
+Data-length (timestep) bucketing happens later, once data is fetched —
+lengths aren't known at config time.
 """
 
+import dataclasses
 import json
 from collections import defaultdict
-from typing import Dict, List, Tuple
+from typing import Dict, List, Sequence, Tuple, Union
 
 from gordo_tpu.machine import Machine
+
+#: the largest bucket any axis may round up to — a guard against a
+#: corrupt length (an off-by-miles n would otherwise spin the doubling
+#: loop toward overflow and allocate a grid nobody meant to ask for)
+MAX_BUCKET = 1 << 30
 
 
 def _canonical_model_key(model_config: dict) -> str:
     return json.dumps(model_config, sort_keys=True, default=str)
 
 
+def _check_bucket_args(n: int, min_bucket: int, axis: str) -> None:
+    """Shared degenerate-input guard for the bucket helpers: a silent
+    round-up of n=0 to ``min_bucket`` is indistinguishable from a real
+    length and has shipped empty grids before — fail loudly instead."""
+    if int(n) != n or int(min_bucket) != min_bucket:
+        raise ValueError(
+            f"{axis} bucket arguments must be integers, got n={n!r}, "
+            f"min_bucket={min_bucket!r}"
+        )
+    if n <= 0:
+        raise ValueError(
+            f"{axis} length must be >= 1 to bucket, got {n} (an empty "
+            "axis has no bucket; padding it up would hide the bug)"
+        )
+    if min_bucket < 1 or (min_bucket & (min_bucket - 1)) != 0:
+        raise ValueError(
+            f"min_bucket must be a power of two >= 1, got {min_bucket} "
+            "(a non-power-of-two floor would break the shared-geometry "
+            "guarantee: two lengths could round to buckets that are not "
+            "supersets of each other)"
+        )
+    if n > MAX_BUCKET:
+        raise ValueError(
+            f"{axis} length {n} exceeds the largest supported bucket "
+            f"({MAX_BUCKET}); refusing to round it up"
+        )
+
+
 def timestep_bucket(n: int, min_bucket: int = 256) -> int:
-    """Round a data length up to the next power-of-two bucket."""
+    """
+    Round a data length up to the next power-of-two bucket (>= the
+    ``min_bucket`` floor). Raises :class:`ValueError` on degenerate
+    inputs — ``n <= 0``, a non-power-of-two ``min_bucket``, or an ``n``
+    past :data:`MAX_BUCKET` — instead of returning a bucket that cannot
+    be told from a real one.
+    """
+    _check_bucket_args(n, min_bucket, axis="timestep")
     bucket = min_bucket
     while bucket < n:
         bucket *= 2
     return bucket
 
 
+def dimension_bucket(n: int, min_bucket: int = 1) -> int:
+    """
+    The feature/width-axis twin of :func:`timestep_bucket`: smallest
+    power of two >= ``max(n, min_bucket)``. The padded bucket policy
+    rounds n_features / n_features_out through this, so ragged widths
+    share one program with <2x padded compute per axis. Same
+    degenerate-input discipline as :func:`timestep_bucket`.
+    """
+    _check_bucket_args(n, min_bucket, axis="dimension")
+    bucket = min_bucket
+    while bucket < n:
+        bucket *= 2
+    return bucket
+
+
+def machine_dims(machine: Machine) -> Tuple[int, int]:
+    """Config-time (n_features, n_features_out) — tag-list widths. The
+    build-time dims may differ when a prefix transformer changes the
+    column count; the plan is a config-time estimate."""
+    return (
+        len(machine.dataset.tag_list),
+        len(machine.dataset.target_tag_list),
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class ProgramKey:
+    """
+    The identity of one compiled program: architecture family (the
+    canonical model-config JSON) plus the tensor widths the program
+    compiles at, stamped with the policy that assigned them. This — not
+    the raw machine config — is what the ledger's work plan and the AOT
+    export key on.
+    """
+
+    model_key: str
+    n_features: int
+    n_features_out: int
+    policy: str = "exact"
+
+    def digest_payload(self) -> list:
+        """
+        The JSON-able payload ledger unit digests hash. The exact
+        policy's payload is the HISTORICAL triple — byte-identical to
+        the pre-policy ledger digests, so ``--bucket-policy exact`` (the
+        default) joins and resumes old ledgers unchanged. Any other
+        policy appends its name, so a policy flip always changes the
+        plan fingerprint and a mismatched worker refuses to join.
+        """
+        payload: list = [self.model_key, self.n_features, self.n_features_out]
+        if self.policy != "exact":
+            payload.append(self.policy)
+        return payload
+
+
+@dataclasses.dataclass
+class BucketPlan:
+    """
+    One planned program: the machines that will share it, their
+    config-time dims, and the dims the program compiles at.
+    """
+
+    key: ProgramKey
+    machines: List[Machine]
+    dims: List[Tuple[int, int]]  # per-machine (n_features, n_features_out)
+
+    @property
+    def n_machines(self) -> int:
+        return len(self.machines)
+
+    def padding_waste(self) -> Dict[str, float]:
+        """
+        Planned fraction of padded (inert) cells per axis, in [0, 1):
+        ``features`` = share of the stacked (M, f_program) input-width
+        cells that are pad columns; ``features_out`` likewise for the
+        output axis. 0.0 = the program is exactly its machines' shape.
+        The timestep axis is data-dependent and not known at plan time.
+        """
+        m = max(1, len(self.dims))
+        f_prog = max(1, self.key.n_features)
+        fo_prog = max(1, self.key.n_features_out)
+        f_real = sum(f for f, _ in self.dims)
+        fo_real = sum(fo for _, fo in self.dims)
+        return {
+            "features": 1.0 - f_real / (m * f_prog),
+            "features_out": 1.0 - fo_real / (m * fo_prog),
+        }
+
+
+class BucketPolicy:
+    """
+    A grouping policy: Machines -> planned programs. Subclasses define
+    the program key a machine maps to and the dims a program compiles
+    at; planning itself (stable grouping in first-seen machine order)
+    is shared, so every policy is deterministic from the config alone —
+    the property the multi-worker ledger's coordination rests on.
+    """
+
+    name: str = "abstract"
+
+    def machine_key(self, machine: Machine) -> ProgramKey:
+        raise NotImplementedError
+
+    def plan(self, machines: Sequence[Machine]) -> List[BucketPlan]:
+        """Group ``machines`` into planned programs, preserving the
+        first-seen order of both programs and machines (the historical
+        ``bucket_machines`` iteration order)."""
+        plans: Dict[ProgramKey, BucketPlan] = {}
+        for machine in machines:
+            key = self.machine_key(machine)
+            plan = plans.get(key)
+            if plan is None:
+                plan = plans[key] = BucketPlan(key=key, machines=[], dims=[])
+            plan.machines.append(machine)
+            plan.dims.append(machine_dims(machine))
+        return list(plans.values())
+
+    def program_dims(
+        self, widths: Sequence[int], out_widths: Sequence[int]
+    ) -> Tuple[int, int]:
+        """
+        The (n_features, n_features_out) one program compiles at for a
+        bucket whose machines measured these POST-TRANSFORM widths —
+        the build-time counterpart of the plan's config-time dims (a
+        prefix transformer may have changed the column count).
+        """
+        raise NotImplementedError
+
+
+class ExactBucketPolicy(BucketPolicy):
+    """One program per exact (canonical config, n_features,
+    n_features_out) — the historical grouping, pinned bit-identical."""
+
+    name = "exact"
+
+    def machine_key(self, machine: Machine) -> ProgramKey:
+        f, f_out = machine_dims(machine)
+        return ProgramKey(
+            model_key=_canonical_model_key(machine.model),
+            n_features=f,
+            n_features_out=f_out,
+            policy=self.name,
+        )
+
+    def program_dims(self, widths, out_widths):
+        f, f_out = set(widths), set(out_widths)
+        if len(f) != 1 or len(f_out) != 1:
+            # exact buckets are uniform by construction; ragged widths
+            # here mean a data-dependent transformer broke the contract
+            raise ValueError(
+                "exact bucket has ragged post-transform widths "
+                f"(n_features {sorted(f)}, n_features_out {sorted(f_out)})"
+            )
+        return f.pop(), f_out.pop()
+
+
+class PaddedBucketPolicy(BucketPolicy):
+    """
+    Same-architecture-family machines whose feature widths round to the
+    same power-of-two buckets fuse into ONE program at the padded dims.
+    Pad columns are zero on input (their first-layer weights see zero
+    activations and zero gradients) and masked out of loss/metrics/
+    early-stopping on output (``StackedData.feature_out_weight``), so a
+    machine's learning trajectory tracks its exact-bucket build within
+    the documented tolerance (docs/parallelism.md); the <2x-per-axis
+    waste bound is the power-of-two rounding itself.
+    """
+
+    name = "padded"
+
+    def __init__(self, min_bucket: int = 1):
+        self.min_bucket = int(min_bucket)
+        # fail at construction, not first use
+        _check_bucket_args(1, self.min_bucket, axis="dimension")
+
+    def machine_key(self, machine: Machine) -> ProgramKey:
+        f, f_out = machine_dims(machine)
+        return ProgramKey(
+            model_key=_canonical_model_key(machine.model),
+            n_features=dimension_bucket(f, self.min_bucket),
+            n_features_out=dimension_bucket(f_out, self.min_bucket),
+            policy=self.name,
+        )
+
+    def program_dims(self, widths, out_widths):
+        return (
+            dimension_bucket(max(widths), self.min_bucket),
+            dimension_bucket(max(out_widths), self.min_bucket),
+        )
+
+
+#: the --bucket-policy vocabulary (CLI + FleetModelBuilder)
+BUCKET_POLICIES = ("exact", "padded")
+
+
+def get_policy(policy: Union[str, BucketPolicy, None]) -> BucketPolicy:
+    """Resolve a ``--bucket-policy`` value (or a ready policy object;
+    None means the default exact policy)."""
+    if policy is None:
+        return ExactBucketPolicy()
+    if isinstance(policy, BucketPolicy):
+        return policy
+    if policy == "exact":
+        return ExactBucketPolicy()
+    if policy == "padded":
+        return PaddedBucketPolicy()
+    raise ValueError(
+        f"Unknown bucket policy {policy!r}; available: {BUCKET_POLICIES}"
+    )
+
+
+def plan_buckets(
+    machines: Sequence[Machine], policy: Union[str, BucketPolicy, None] = None
+) -> List[BucketPlan]:
+    """The planning entry point: machines -> planned programs under
+    ``policy`` (used by the builder, the ledger's work plan and the
+    ``gordo-tpu buckets plan`` dry-run alike)."""
+    return get_policy(policy).plan(machines)
+
+
+def plan_padding_waste(plans: Sequence[BucketPlan]) -> float:
+    """
+    Aggregate planned padding waste of a whole plan, in [0, 1): the
+    fraction of padded (inert) cells summed over both feature axes of
+    every program's (machines x width) stack. 0.0 for any exact plan;
+    bounded below 0.5 per axis for padded plans by the power-of-two
+    rounding (docs/parallelism.md "Bucketing compiler").
+    """
+    total = 0
+    pad = 0
+    for plan in plans:
+        m = len(plan.dims)
+        total += m * (plan.key.n_features + plan.key.n_features_out)
+        pad += sum(
+            (plan.key.n_features - f) + (plan.key.n_features_out - fo)
+            for f, fo in plan.dims
+        )
+    return pad / total if total else 0.0
+
+
 def bucket_machines(
     machines: List[Machine],
 ) -> Dict[Tuple[str, int, int], List[Machine]]:
     """
-    Group machines by (canonical model config, n_features, n_features_out).
-    Data-length bucketing happens later, once data is fetched (lengths
-    aren't known at config time).
+    The historical exact grouping: machines by (canonical model config,
+    n_features, n_features_out). Kept as the compatibility surface —
+    it IS the exact policy's plan, reshaped.
     """
     buckets: Dict[Tuple[str, int, int], List[Machine]] = defaultdict(list)
-    for machine in machines:
-        key = (
-            _canonical_model_key(machine.model),
-            len(machine.dataset.tag_list),
-            len(machine.dataset.target_tag_list),
-        )
-        buckets[key].append(machine)
+    for plan in ExactBucketPolicy().plan(machines):
+        key = (plan.key.model_key, plan.key.n_features, plan.key.n_features_out)
+        buckets[key].extend(plan.machines)
     return dict(buckets)

@@ -34,7 +34,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh
 
-from gordo_tpu.models.specs import ModelSpec, per_sample_loss
+from gordo_tpu.models.specs import (
+    ModelSpec,
+    masked_per_sample_loss,
+    per_sample_loss,
+)
 from gordo_tpu.observability import annotate, emit_event, get_registry, tracing
 from gordo_tpu.parallel.mesh import fleet_sharding, pad_to_multiple, replicated_sharding
 from gordo_tpu.programs import ProgramCache
@@ -95,12 +99,18 @@ class StackedData:
     A fleet bucket's training data, stacked and padded to a common grid.
 
     X: (M, n, f) float32; y: (M, n, f_out); sample_weight: (M, n) in {0,1}
-    marking real (vs padding) rows per machine.
+    marking real (vs padding) rows per machine. ``feature_out_weight``
+    ((M, f_out) in {0,1}) marks real (vs pad) OUTPUT columns per machine
+    — set only by padded-policy buckets whose machines have ragged
+    feature widths (docs/parallelism.md "Bucketing compiler"); None
+    means every column is real and training takes the historical
+    unmasked path bit-identically.
     """
 
     X: jnp.ndarray
     y: jnp.ndarray
     sample_weight: jnp.ndarray
+    feature_out_weight: Optional[jnp.ndarray] = None
 
     @classmethod
     def from_ragged(
@@ -109,26 +119,48 @@ class StackedData:
         ys: List[np.ndarray],
         n_machines_padded: Optional[int] = None,
         n_timesteps: Optional[int] = None,
+        n_features: Optional[int] = None,
+        n_features_out: Optional[int] = None,
     ) -> "StackedData":
         """
-        Stack per-machine (n_i, f) arrays, zero-padding rows up to the
+        Stack per-machine (n_i, f_i) arrays, zero-padding rows up to the
         longest machine (or an explicit ``n_timesteps`` grid, so slightly
         ragged buckets share one compiled program geometry) and optionally
         padding the fleet axis with dummy machines (all-zero weights).
+
+        ``n_features`` / ``n_features_out`` widen the feature axes to a
+        padded program width (the padded bucket policy): narrower
+        machines get zero pad COLUMNS — inert on input (zero activations,
+        zero gradients) and masked out of the loss via the returned
+        ``feature_out_weight`` on output. Defaults keep the historical
+        contract: machine 0's widths, every column real, no mask.
         """
         assert len(Xs) == len(ys) and len(Xs) > 0
-        f = Xs[0].shape[1]
-        f_out = ys[0].shape[1]
+        f = max(n_features or 0, max(x.shape[1] for x in Xs))
+        f_out = max(n_features_out or 0, max(y_.shape[1] for y_ in ys))
         n_max = max(max(len(x) for x in Xs), n_timesteps or 0)
         m_total = n_machines_padded or len(Xs)
         X = np.zeros((m_total, n_max, f), dtype=np.float32)
         y = np.zeros((m_total, n_max, f_out), dtype=np.float32)
         w = np.zeros((m_total, n_max), dtype=np.float32)
+        fw = np.zeros((m_total, f_out), dtype=np.float32)
+        ragged_out = False
         for i, (xi, yi) in enumerate(zip(Xs, ys)):
-            X[i, : len(xi)] = xi
-            y[i, : len(yi)] = yi
+            X[i, : len(xi), : xi.shape[1]] = xi
+            y[i, : len(yi), : yi.shape[1]] = yi
             w[i, : len(xi)] = 1.0
-        return cls(jnp.asarray(X), jnp.asarray(y), jnp.asarray(w))
+            fw[i, : yi.shape[1]] = 1.0
+            ragged_out = ragged_out or yi.shape[1] != f_out
+        # pad machines on the fleet axis carry an all-real column mask:
+        # their sample weights are already zero, and a zero fw row would
+        # needlessly special-case the masked loss's normalizer
+        fw[len(Xs):] = 1.0
+        return cls(
+            jnp.asarray(X),
+            jnp.asarray(y),
+            jnp.asarray(w),
+            feature_out_weight=jnp.asarray(fw) if ragged_out else None,
+        )
 
     @property
     def n_machines(self) -> int:
@@ -261,6 +293,11 @@ class FleetTrainer:
             X=jax.device_put(data.X, sharding),
             y=jax.device_put(data.y, sharding),
             sample_weight=jax.device_put(data.sample_weight, sharding),
+            feature_out_weight=(
+                jax.device_put(data.feature_out_weight, fleet_sharding(self.mesh))
+                if data.feature_out_weight is not None
+                else None
+            ),
         )
 
     def _n_samples(self, n: int) -> int:
@@ -316,6 +353,7 @@ class FleetTrainer:
         sample_cap: Optional[int] = None,
         quarantine: bool = False,
         inject: bool = False,
+        masked: bool = False,
     ):
         """
         Build (and cache) the jitted fleet-epoch function for a given
@@ -351,16 +389,25 @@ class FleetTrainer:
         takes. Real samples are packed into the leading batches per
         machine (masked argsort), and a step whose batch holds no real
         samples leaves params and optimizer state untouched.
+
+        ``masked`` variants take a per-machine (f_out,) feature-column
+        weight (padded-policy buckets with ragged widths): the loss
+        means over REAL output columns only, so pad columns never move
+        params or stopping decisions. Unmasked programs carry no trace
+        of the feature, keeping exact-policy fits bit-identical.
         """
         n_batches = self._n_batches(n, batch_size, sample_cap)
-        cache_key = (n, batch_size, shuffle, gated, n_batches, quarantine, inject)
+        cache_key = (
+            n, batch_size, shuffle, gated, n_batches, quarantine, inject,
+            masked,
+        )
 
         def build():
             fleet_epoch = self._epoch_callable(
                 n, batch_size, shuffle, gated, n_batches,
-                quarantine=quarantine, inject=inject,
+                quarantine=quarantine, inject=inject, masked=masked,
             )
-            n_args = 6 + int(gated) + int(quarantine) + int(inject)
+            n_args = 6 + int(gated) + int(quarantine) + int(inject) + int(masked)
             jit_kwargs: dict = {}
             if self.mesh is not None:
                 fs = fleet_sharding(self.mesh)
@@ -385,6 +432,7 @@ class FleetTrainer:
         n_batches: int,
         quarantine: bool = False,
         inject: bool = False,
+        masked: bool = False,
     ):
         """
         The RAW (un-jitted) vmapped fleet-epoch callable for a geometry,
@@ -394,19 +442,20 @@ class FleetTrainer:
         numerics change.
 
         Per-machine extras ride after the data args in a fixed order:
-        ``active`` (``gated``), ``healthy`` (``quarantine``), and the
-        NaN-poison flag (``inject``); quarantine variants return the
-        updated ``healthy`` as a fourth output.
+        ``active`` (``gated``), ``healthy`` (``quarantine``), the
+        NaN-poison flag (``inject``), and the (f_out,) feature-column
+        weight (``masked``); quarantine variants return the updated
+        ``healthy`` as a fourth output.
         """
         cache_key = (
             "epoch_raw", n, batch_size, shuffle, gated, n_batches,
-            quarantine, inject,
+            quarantine, inject, masked,
         )
         return self._programs.get_or_build(
             cache_key,
             lambda: self._build_epoch_callable(
                 n, batch_size, shuffle, gated, n_batches,
-                quarantine=quarantine, inject=inject,
+                quarantine=quarantine, inject=inject, masked=masked,
             ),
         )
 
@@ -419,6 +468,7 @@ class FleetTrainer:
         n_batches: int,
         quarantine: bool = False,
         inject: bool = False,
+        masked: bool = False,
     ):
         """The uncached body of :meth:`_epoch_callable`."""
         n_samples = self._n_samples(n)
@@ -483,6 +533,7 @@ class FleetTrainer:
             active = _extras.pop(0) if gated else None
             healthy = _extras.pop(0) if quarantine else None
             inj_flag = _extras.pop(0) if inject else None
+            fm = _extras.pop(0) if masked else None  # (f_out,) column mask
             wb_all = sample_weights(wi)            # (n_samples,)
             real = wb_all > 0
             if shuffle:
@@ -506,7 +557,11 @@ class FleetTrainer:
                 out, penalty = module.apply(
                     p, xb, deterministic=False, rngs={"dropout": dropout_key}
                 )
-                per = per_sample_loss(loss_name, out, yb)
+                per = (
+                    masked_per_sample_loss(loss_name, out, yb, fm)
+                    if masked
+                    else per_sample_loss(loss_name, out, yb)
+                )
                 total_w = jnp.maximum(jnp.sum(wb), 1.0)
                 return jnp.sum(per * wb) / total_w + penalty, jnp.sum(per * wb)
 
@@ -571,7 +626,7 @@ class FleetTrainer:
                 return params, opt_state, epoch_loss, healthy_out
             return params, opt_state, epoch_loss
 
-        n_args = 6 + int(gated) + int(quarantine) + int(inject)
+        n_args = 6 + int(gated) + int(quarantine) + int(inject) + int(masked)
         if self.broadcast_data:
             # one shared dataset; only params/opt/keys (and the
             # per-machine flags) carry the fleet axis
@@ -582,27 +637,34 @@ class FleetTrainer:
 
         return fleet_epoch
 
-    def _val_fn(self, n: int, batch_size: int, lo: int = 0):
+    def _val_fn(
+        self, n: int, batch_size: int, lo: int = 0, masked: bool = False
+    ):
         """
         Jitted per-machine validation loss over the fleet (the raw
         callable, ``_val_callable``, is shared with the chunk program).
         """
-        cache_key = ("val", n, batch_size, lo)
+        cache_key = ("val", n, batch_size, lo, masked)
 
         def build():
-            fleet_val = self._val_callable(n, batch_size, lo)
+            fleet_val = self._val_callable(n, batch_size, lo, masked)
             jit_kwargs: dict = {}
             if self.mesh is not None:
                 fs = fleet_sharding(self.mesh)
                 rs = replicated_sharding(self.mesh)
                 data_sh = rs if self.broadcast_data else fs
-                jit_kwargs["in_shardings"] = (fs, data_sh, data_sh, data_sh)
+                shardings = (fs, data_sh, data_sh, data_sh)
+                if masked:
+                    shardings = shardings + (fs,)
+                jit_kwargs["in_shardings"] = shardings
                 jit_kwargs["out_shardings"] = fs
             return jax.jit(fleet_val, **jit_kwargs)
 
         return self._programs.get_or_build(cache_key, build)
 
-    def _val_callable(self, n: int, batch_size: int, lo: int = 0):
+    def _val_callable(
+        self, n: int, batch_size: int, lo: int = 0, masked: bool = False
+    ):
         """
         The raw vmapped per-machine validation loss: deterministic
         forward, per-sample loss weighted by a (M, n) validation mask —
@@ -612,14 +674,19 @@ class FleetTrainer:
 
         ``lo`` skips samples below the fleet-wide first validation index:
         the eval walks only the holdout tail instead of zero-weighting the
-        whole training prefix every epoch.
+        whole training prefix every epoch. ``masked`` variants take the
+        same per-machine (f_out,) feature-column weight the training
+        epoch does, so a padded machine's val loss ignores pad columns.
         """
-        cache_key = ("val_raw", n, batch_size, lo)
+        cache_key = ("val_raw", n, batch_size, lo, masked)
         return self._programs.get_or_build(
-            cache_key, lambda: self._build_val_callable(n, batch_size, lo)
+            cache_key,
+            lambda: self._build_val_callable(n, batch_size, lo, masked),
         )
 
-    def _build_val_callable(self, n: int, batch_size: int, lo: int = 0):
+    def _build_val_callable(
+        self, n: int, batch_size: int, lo: int = 0, masked: bool = False
+    ):
         """The uncached body of :meth:`_val_callable`."""
         spec = self.spec
         lb = spec.lookback_window if spec.windowed else 1
@@ -639,7 +706,9 @@ class FleetTrainer:
         module = spec.module
         windowed = spec.windowed
 
-        def machine_val(params, Xi, yi, vi):
+        def machine_val(params, Xi, yi, vi, *extras):
+            fm = extras[0] if masked else None  # (f_out,) column mask
+
             def one_chunk(args):
                 sel, pm = args
                 if windowed:
@@ -654,18 +723,23 @@ class FleetTrainer:
                     wb = vi[sel]
                 wb = wb * pm
                 out, _ = module.apply(params, xb)
-                per = per_sample_loss(loss_name, out, yb)
+                per = (
+                    masked_per_sample_loss(loss_name, out, yb, fm)
+                    if masked
+                    else per_sample_loss(loss_name, out, yb)
+                )
                 return jnp.sum(per * wb), jnp.sum(wb)
 
             sums, ws = jax.lax.map(one_chunk, (sel_all, pm_all))
             return jnp.sum(sums) / jnp.maximum(jnp.sum(ws), 1.0)
 
         if self.broadcast_data:
-            fleet_val = jax.vmap(machine_val, in_axes=(0, None, None, None))
+            in_axes: tuple = (0, None, None, None)
         else:
-            fleet_val = jax.vmap(machine_val, in_axes=(0, 0, 0, 0))
-
-        return fleet_val
+            in_axes = (0, 0, 0, 0)
+        if masked:
+            in_axes = in_axes + (0,)
+        return jax.vmap(machine_val, in_axes=in_axes)
 
     def _chunk_fn(
         self,
@@ -685,6 +759,7 @@ class FleetTrainer:
         es_start_from: int = 0,
         quarantine: bool = False,
         inject: bool = False,
+        masked: bool = False,
     ):
         """
         Build (and cache) the fused multi-epoch program: an outer
@@ -705,7 +780,7 @@ class FleetTrainer:
             "chunk", n, batch_size, shuffle, chunk_len, n_batches, with_val,
             val_lo, gated, track_best, monitor_val,
             float(es_delta), int(es_stop_at), int(es_start_from),
-            quarantine, inject,
+            quarantine, inject, masked,
         )
         return self._programs.get_or_build(
             cache_key,
@@ -715,7 +790,7 @@ class FleetTrainer:
                 val_lo=val_lo, gated=gated, track_best=track_best,
                 monitor_val=monitor_val, es_delta=es_delta,
                 es_stop_at=es_stop_at, es_start_from=es_start_from,
-                quarantine=quarantine, inject=inject,
+                quarantine=quarantine, inject=inject, masked=masked,
             ),
         )
 
@@ -737,17 +812,23 @@ class FleetTrainer:
         es_start_from: int,
         quarantine: bool,
         inject: bool,
+        masked: bool,
     ):
         """The uncached body of :meth:`_chunk_fn`."""
         fleet_epoch = self._epoch_callable(
             n, batch_size, shuffle, gated, n_batches,
-            quarantine=quarantine, inject=inject,
+            quarantine=quarantine, inject=inject, masked=masked,
         )
-        fleet_val = self._val_callable(n, batch_size, val_lo) if with_val else None
+        fleet_val = (
+            self._val_callable(n, batch_size, val_lo, masked)
+            if with_val
+            else None
+        )
 
         def chunk_program(params, opt_state, keys, X, y, w, epoch_ids, *rest):
             rest = list(rest)
             val_w = rest.pop(0) if with_val else None
+            fm_all = rest.pop(0) if masked else None  # (M, f_out)
             carry = {"params": params, "opt": opt_state}
             has_val = None
             if quarantine:
@@ -788,6 +869,8 @@ class FleetTrainer:
                     # same per-machine flag the per-epoch loop computes
                     # on host: poison only at the configured epoch
                     extras.append(inj_mask & (epoch_id == inj_epoch))
+                if masked:
+                    extras.append(fm_all)
                 result = fleet_epoch(
                     carry["params"], carry["opt"], epoch_keys,
                     X, y, w, *extras,
@@ -801,7 +884,11 @@ class FleetTrainer:
                 new["params"], new["opt"] = p, o
                 vloss = None
                 if with_val:
-                    vloss = fleet_val(p, X, y, val_w)
+                    vloss = (
+                        fleet_val(p, X, y, val_w, fm_all)
+                        if masked
+                        else fleet_val(p, X, y, val_w)
+                    )
                     outs["val"] = vloss
                 if gated:
                     # a stopped machine's computed loss reflects a
@@ -862,6 +949,7 @@ class FleetTrainer:
                 donate.append(
                     7
                     + (1 if with_val else 0)
+                    + (1 if masked else 0)
                     + (1 if quarantine else 0)
                     + 4  # track_best implies gated (the ES state args)
                     + (1 if monitor_val else 0)
@@ -1023,6 +1111,16 @@ class FleetTrainer:
             )
         data = self.shard_data(data)
         w = data.sample_weight
+        # padded-policy buckets carry a per-machine output-column mask;
+        # None (every exact-policy fit) keeps the historical unmasked
+        # programs bit-identically
+        fmask = data.feature_out_weight
+        masked = fmask is not None
+        if masked and self.broadcast_data:
+            raise ValueError(
+                "broadcast_data fleets share one dataset and cannot take "
+                "per-machine feature_out_weight masks"
+            )
         if extra_weight is not None:
             w = w * self._shard(jnp.asarray(extra_weight))
         # the ONE device->host weight transfer per fit: the validation
@@ -1169,7 +1267,7 @@ class FleetTrainer:
                 checkpoint_every=checkpoint_every, start_epoch=start_epoch,
                 m=m, rows_per_machine=rows_per_machine, fit_start=fit_start,
                 quarantine=quarantine, inj=inj, healthy_np=healthy_np,
-                machine_names=machine_names,
+                machine_names=machine_names, fmask=fmask,
             )
 
         epoch_fn = self._epoch_fn(
@@ -1180,9 +1278,10 @@ class FleetTrainer:
             sample_cap=sample_cap,
             quarantine=quarantine,
             inject=inj is not None,
+            masked=masked,
         )
         val_fn = (
-            self._val_fn(data.n_timesteps, batch_size, lo=val_lo)
+            self._val_fn(data.n_timesteps, batch_size, lo=val_lo, masked=masked)
             if val_w is not None
             else None
         )
@@ -1224,6 +1323,8 @@ class FleetTrainer:
                 extras.append(
                     _put_fleet_arr(inj[0] & (epoch == inj[1]), self.mesh)
                 )
+            if masked:
+                extras.append(fmask)
             # span + profiler annotation: the same dispatch shows up in
             # the distributed trace AND (when a jax.profiler trace is
             # active) on the XLA device timeline
@@ -1254,7 +1355,11 @@ class FleetTrainer:
                 jax.block_until_ready(epoch_loss)  # lint: disable=host-sync
                 first_epoch_s = time.perf_counter() - epoch_start
             if val_fn is not None:
-                val_losses.append(val_fn(params, X_arg, y_arg, val_arg))
+                val_losses.append(
+                    val_fn(params, X_arg, y_arg, val_arg, fmask)
+                    if masked
+                    else val_fn(params, X_arg, y_arg, val_arg)
+                )
             # keep the loss on device: a host fetch here would sync every
             # epoch and stall the dispatch pipeline (costly over DCN/tunnel
             # links); all losses are pulled in one transfer after the loop
@@ -1478,6 +1583,7 @@ class FleetTrainer:
         inj: Optional[Tuple[np.ndarray, int]] = None,
         healthy_np: Optional[np.ndarray] = None,
         machine_names: Optional[List[str]] = None,
+        fmask: Optional[jnp.ndarray] = None,
     ) -> Tuple[Any, np.ndarray]:
         """
         The ``epoch_chunk > 1`` fit loop: dispatch ONE fused program per
@@ -1498,6 +1604,7 @@ class FleetTrainer:
         epochs ran gated — all machines inactive — and changed nothing).
         """
         with_val = val_arg is not None
+        masked = fmask is not None
         # the monitored-metric select only exists inside the gated (ES)
         # program; normalizing here keeps a plain fit-with-validation from
         # minting a distinct (but identical) compiled chunk program
@@ -1571,6 +1678,7 @@ class FleetTrainer:
                 monitor_val=monitor_val, es_delta=es_delta,
                 es_stop_at=es_stop_at, es_start_from=es_start_from,
                 quarantine=quarantine, inject=inj is not None,
+                masked=masked,
             )
             args = [
                 params, opt_state, keys, X_arg, y_arg, w_arg,
@@ -1578,6 +1686,8 @@ class FleetTrainer:
             ]
             if with_val:
                 args.append(val_arg)
+            if masked:
+                args.append(fmask)
             if quarantine:
                 args.append(healthy_dev)
             if early_stopping:

@@ -168,6 +168,22 @@ class FleetScorer:
                     "lookahead": group_ests[0].lookahead if spec.windowed else 0,
                     "n_features": group_ests[0].n_features_,
                     "n_features_out": group_ests[0].n_features_out_,
+                    # per-machine REAL widths (padded-bucket artifacts —
+                    # docs/serving.md "Padded programs"): inputs pad up
+                    # to the program width before dispatch, outputs strip
+                    # back down before the response. Exact artifacts
+                    # record their program widths here, making both a
+                    # no-op.
+                    "in_cols": {
+                        n: getattr(e, "n_active_features_", None)
+                        or e.n_features_
+                        for n, e in zip(names, group_ests)
+                    },
+                    "out_cols": {
+                        n: getattr(e, "n_active_features_out_", None)
+                        or e.n_features_out_
+                        for n, e in zip(names, group_ests)
+                    },
                 }
             )
         # digest-collision guard: two DISTINCT groups whose identities
@@ -431,7 +447,26 @@ class FleetScorer:
         X), ...] of one group; returns outputs aligned with entries."""
         names = [name for _, name, _ in entries]
         lb, la = group["lookback"], group["lookahead"]
-        prepared = [np.asarray(X, dtype=np.float32) for _, _, X in entries]
+        f_prog = group["n_features"]
+        prepared = []
+        for _, name, X in entries:
+            x = np.asarray(X, dtype=np.float32)
+            # inputs must carry the machine's REAL width (its tag list);
+            # zero-filling an arbitrary short frame up to the program
+            # width would feed untrained (or wrong) input units and
+            # return confident garbage — only the pad from real width to
+            # program width is inert by the training-side invariant
+            n_real = group["in_cols"][name]
+            if x.shape[-1] != n_real:
+                raise ValueError(
+                    f"Machine {name!r} expects {n_real} feature "
+                    f"column(s), got {x.shape[-1]}"
+                )
+            if n_real < f_prog:
+                # padded-bucket machine: widen to the program width with
+                # inert zero columns
+                x = np.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, f_prog - n_real)])
+            prepared.append(x)
         max_len = max(len(x) for x in prepared)
         if group["windowed"]:
             # raw rows go to the device; the compiled program gathers the
@@ -480,7 +515,7 @@ class FleetScorer:
                     group, params, full, group_size, max_rows
                 )
                 return [
-                    outputs[row_index[name], : n_rows[i]]
+                    outputs[row_index[name], : n_rows[i], : group["out_cols"][name]]
                     for i, name in enumerate(names)
                 ]
         else:
@@ -525,7 +560,10 @@ class FleetScorer:
                 batch, [(0, m_bucket - len(batch))] + [(0, 0)] * (batch.ndim - 1)
             )
         outputs = self._dispatch(group, params, batch, m_bucket, max_rows)
-        return [outputs[i, : n_rows[i]] for i in range(len(names))]
+        return [
+            outputs[i, : n_rows[i], : group["out_cols"][name]]
+            for i, name in enumerate(names)
+        ]
 
 
 def fleet_scorer_from_models(

@@ -1,0 +1,161 @@
+"""
+The bucketing compiler's planning layer (docs/parallelism.md "Bucketing
+compiler"): bucket-helper hardening against degenerate inputs, the
+exact policy pinned to the historical grouping, and the padded policy's
+fusion / waste-bound / subset-stability properties.
+"""
+
+import pytest
+
+from gordo_tpu.machine import Machine
+from gordo_tpu.parallel.bucketing import (
+    MAX_BUCKET,
+    BUCKET_POLICIES,
+    bucket_machines,
+    dimension_bucket,
+    get_policy,
+    plan_buckets,
+    plan_padding_waste,
+    timestep_bucket,
+)
+
+
+def make_machine(name, ntags=2, epochs=1, kind="feedforward_hourglass"):
+    return Machine(
+        name=name,
+        project_name="bucket-test",
+        model={
+            "gordo_tpu.models.AutoEncoder": {"kind": kind, "epochs": epochs}
+        },
+        dataset={
+            "type": "RandomDataset",
+            "train_start_date": "2017-12-25 06:00:00Z",
+            "train_end_date": "2017-12-26 06:00:00Z",
+            "tags": [[f"Tag {t}", None] for t in range(ntags)],
+        },
+    )
+
+
+# -- bucket helpers: degenerate inputs ------------------------------------
+
+
+def test_timestep_bucket_rounds_up_powers_of_two():
+    assert timestep_bucket(100) == 256  # min_bucket floor
+    assert timestep_bucket(256) == 256
+    assert timestep_bucket(257) == 512
+    assert timestep_bucket(5, min_bucket=4) == 8
+
+
+def test_dimension_bucket_rounds_up_powers_of_two():
+    assert dimension_bucket(1) == 1
+    assert dimension_bucket(3) == 4
+    assert dimension_bucket(4) == 4
+    assert dimension_bucket(5) == 8
+    assert dimension_bucket(3, min_bucket=8) == 8
+
+
+@pytest.mark.parametrize("helper", [timestep_bucket, dimension_bucket])
+def test_bucket_helpers_reject_degenerate_lengths(helper):
+    """n=0 used to silently return min_bucket — indistinguishable from a
+    real capped value; degenerate axes must fail loudly instead."""
+    with pytest.raises(ValueError, match=">= 1"):
+        helper(0)
+    with pytest.raises(ValueError, match=">= 1"):
+        helper(-3)
+    with pytest.raises(ValueError, match="largest supported bucket"):
+        helper(MAX_BUCKET + 1)
+    with pytest.raises(ValueError, match="integers"):
+        helper(2.5)
+
+
+@pytest.mark.parametrize("helper", [timestep_bucket, dimension_bucket])
+@pytest.mark.parametrize("min_bucket", [0, -1, 3, 6, 100])
+def test_bucket_helpers_reject_non_power_of_two_floor(helper, min_bucket):
+    with pytest.raises(ValueError, match="power of two"):
+        helper(10, min_bucket=min_bucket)
+
+
+# -- policies -------------------------------------------------------------
+
+
+def test_get_policy_vocabulary():
+    assert get_policy(None).name == "exact"
+    assert get_policy("exact").name == "exact"
+    assert get_policy("padded").name == "padded"
+    padded = get_policy("padded")
+    assert get_policy(padded) is padded  # ready objects pass through
+    with pytest.raises(ValueError, match="Unknown bucket policy"):
+        get_policy("fuzzy")
+    assert set(BUCKET_POLICIES) == {"exact", "padded"}
+
+
+def test_exact_plan_matches_legacy_bucket_machines():
+    """The exact policy IS the historical grouping: same programs, same
+    machine rosters, same iteration order."""
+    machines = [
+        make_machine("a", ntags=2),
+        make_machine("b", ntags=3),
+        make_machine("c", ntags=2),
+        make_machine("d", ntags=2, epochs=5),
+    ]
+    plans = plan_buckets(machines, "exact")
+    legacy = bucket_machines(machines)
+    assert len(plans) == len(legacy) == 3
+    for plan in plans:
+        key = (plan.key.model_key, plan.key.n_features, plan.key.n_features_out)
+        assert [m.name for m in legacy[key]] == [m.name for m in plan.machines]
+        # exact programs compile at the machines' real dims: zero waste
+        assert plan.padding_waste() == {"features": 0.0, "features_out": 0.0}
+    assert plan_padding_waste(plans) == 0.0
+
+
+def test_padded_plan_fuses_ragged_widths_within_family():
+    machines = [
+        make_machine("w3", ntags=3),
+        make_machine("w4", ntags=4),
+        make_machine("w5", ntags=5),
+        make_machine("w6", ntags=6),
+        make_machine("other", ntags=3, epochs=9),  # different family
+    ]
+    plans = plan_buckets(machines, "padded")
+    assert len(plan_buckets(machines, "exact")) == 5
+    # 3,4 -> bucket 4; 5,6 -> bucket 8; the different config stays apart
+    rosters = {
+        (p.key.n_features, tuple(m.name for m in p.machines)) for p in plans
+    }
+    assert rosters == {
+        (4, ("w3", "w4")),
+        (8, ("w5", "w6")),
+        (4, ("other",)),
+    }
+    for plan in plans:
+        assert plan.key.policy == "padded"
+        waste = plan.padding_waste()
+        # the power-of-two bound: strictly under half per axis
+        assert 0.0 <= waste["features"] < 0.5
+        assert 0.0 <= waste["features_out"] < 0.5
+    assert 0.0 < plan_padding_waste(plans) < 0.5
+
+
+def test_padded_plan_stable_under_subsetting():
+    """Any subset of a padded bucket re-plans to the SAME program key —
+    the property that keeps resume/ledger-unit builds on the program
+    the full plan promised."""
+    machines = [make_machine(f"m{i}", ntags=n) for i, n in enumerate((3, 4, 4))]
+    (plan,) = plan_buckets(machines, "padded")
+    for machine in machines:
+        (sub,) = plan_buckets([machine], "padded")
+        assert sub.key == plan.key
+
+
+def test_padded_program_dims_from_measured_widths():
+    policy = get_policy("padded")
+    assert policy.program_dims([3, 4], [3, 4]) == (4, 4)
+    assert policy.program_dims([5], [2]) == (8, 2)
+
+
+def test_exact_program_dims_require_uniform_widths():
+    policy = get_policy("exact")
+    assert policy.program_dims([4, 4], [4, 4]) == (4, 4)
+    with pytest.raises(ValueError, match="ragged"):
+        policy.program_dims([3, 4], [3, 3])

@@ -196,6 +196,49 @@ def test_build_fleet(runner, tmp_path):
         assert meta["name"] == f"fleet-m-{i}"
 
 
+def test_buckets_plan_cli(runner):
+    """`gordo-tpu buckets plan` dry-runs the bucketing compiler: program
+    counts, machines per program, and padding-waste %% per axis, without
+    building anything (docs/parallelism.md "Bucketing compiler")."""
+    base = yaml.safe_load(MACHINE_YAML)
+    machines = []
+    for i, ntags in enumerate((3, 4)):
+        cfg = json.loads(json.dumps(base))
+        cfg["name"] = f"plan-m-{i}"
+        cfg["dataset"]["tags"] = [f"tag-{t}" for t in range(ntags)]
+        cfg["dataset"]["target_tag_list"] = cfg["dataset"]["tags"]
+        machines.append(cfg)
+
+    result = runner.invoke(
+        gordo,
+        ["buckets", "plan", json.dumps(machines), "--bucket-policy", "padded"],
+    )
+    assert result.exit_code == 0, result.output
+    assert "2 machine(s) -> 1 compiled program(s)" in result.output
+    assert "exact policy would compile 2" in result.output
+    assert "waste" in result.output
+
+    as_json = runner.invoke(
+        gordo,
+        [
+            "buckets", "plan", json.dumps(machines),
+            "--bucket-policy", "padded", "--as-json",
+        ],
+    )
+    assert as_json.exit_code == 0, as_json.output
+    payload = json.loads(as_json.output)
+    assert payload["n_programs"] == 1
+    assert payload["n_programs_exact"] == 2
+    assert payload["programs"][0]["n_features"] == 4
+    assert payload["programs"][0]["machines"] == ["plan-m-0", "plan-m-1"]
+
+    exact = runner.invoke(
+        gordo, ["buckets", "plan", json.dumps(machines), "--as-json"]
+    )
+    assert exact.exit_code == 0, exact.output
+    assert json.loads(exact.output)["n_programs"] == 2
+
+
 def test_expand_model():
     expanded = expand_model(
         "gordo_tpu.models.AutoEncoder: {kind: feedforward_hourglass, "

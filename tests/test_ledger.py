@@ -97,6 +97,94 @@ def test_plan_units_deterministic_and_config_sensitive():
     assert {u.uid for u in changed} != {u.uid for u in units}
 
 
+def test_plan_units_exact_digests_pinned_to_legacy():
+    """The default (exact) policy's unit digests are byte-identical to
+    the historical bucket_machines-based plan, so existing ledgers and
+    resumes keep working across the bucketing-compiler refactor."""
+    import hashlib
+
+    from gordo_tpu.parallel.bucketing import bucket_machines
+
+    machines = [make_machine("a"), make_machine("b"), make_machine("c", epochs=2)]
+    digests = []
+    for (model_key, n_feat, n_feat_out), bucket in bucket_machines(
+        machines
+    ).items():
+        names = tuple(m.name for m in bucket)
+        digest = hashlib.sha1(
+            json.dumps(
+                [model_key, n_feat, n_feat_out, list(names)], sort_keys=True
+            ).encode()
+        ).hexdigest()
+        digests.append((digest, names))
+    digests.sort()
+    legacy = [
+        WorkUnit(uid=f"u{index:03d}-{digest[:10]}", machines=names)
+        for index, (digest, names) in enumerate(digests)
+    ]
+    assert plan_units(machines) == legacy
+    assert plan_units(machines, policy="exact") == legacy
+
+
+def test_plan_units_policy_changes_fingerprint():
+    """Flipping --bucket-policy must change the plan fingerprint even
+    when the GROUPING happens to coincide (uniform-width fleets), so a
+    mismatched worker can never join a live ledger silently."""
+    machines = [make_machine("a"), make_machine("b")]
+    exact_units = plan_units(machines)
+    padded_units = plan_units(machines, policy="padded")
+    # same rosters (uniform widths: nothing to fuse) ...
+    assert sorted(u.machines for u in exact_units) == sorted(
+        u.machines for u in padded_units
+    )
+    # ... but distinct identities
+    assert {u.uid for u in exact_units} != {u.uid for u in padded_units}
+    assert ledger_mod.plan_fingerprint(exact_units) != ledger_mod.plan_fingerprint(
+        padded_units
+    )
+
+
+def test_plan_units_padded_fuses_ragged_buckets():
+    """The padded policy plans FEWER, larger units: one per fused
+    program rather than one per exact geometry."""
+    machines = [make_machine("a"), make_machine("b")]
+    cfg = machines[0].to_dict()
+    cfg["name"] = "c3"
+    cfg["dataset"] = dict(cfg["dataset"])
+    cfg["dataset"]["tags"] = [["Tag 1", None], ["Tag 2", None], ["Tag 3", None]]
+    machines.append(Machine.from_dict(cfg))
+    assert len(plan_units(machines)) == 2  # widths 2 and 3
+    padded = plan_units(machines, policy="padded")
+    assert len(padded) == 2  # buckets 2 and 4: 3 rounds up alone
+    cfg4 = dict(cfg)
+    cfg4["name"] = "c4"
+    cfg4["dataset"] = dict(cfg4["dataset"])
+    cfg4["dataset"]["tags"] = [[f"Tag {t}", None] for t in range(1, 5)]
+    machines.append(Machine.from_dict(cfg4))
+    assert len(plan_units(machines)) == 3
+    fused = plan_units(machines, policy="padded")
+    assert len(fused) == 2  # 3- and 4-wide fuse at bucket 4
+    assert sorted(u.machines for u in fused) == [("a", "b"), ("c3", "c4")]
+
+
+def test_ensure_plan_policy_mismatch_refuses_to_join(tmp_path):
+    """A worker running a different --bucket-policy against a live
+    ledger must refuse, like a config mismatch — same artifact tree,
+    different program geometries."""
+    machines = [make_machine("a"), make_machine("b")]
+    first = Ledger(tmp_path, "w0")
+    first.ensure_plan(plan_units(machines), bucket_policy="exact")
+    second = Ledger(tmp_path, "w1")
+    with pytest.raises(
+        ledger_mod.LedgerPlanMismatch, match="--bucket-policy exact"
+    ):
+        second.ensure_plan(
+            plan_units(machines, policy="padded"), bucket_policy="padded"
+        )
+    # the same policy + same config still joins fine
+    second.ensure_plan(plan_units(machines), bucket_policy="exact")
+
+
 def test_resolve_workers():
     assert ledger_mod.resolve_workers("1") == 1
     assert ledger_mod.resolve_workers(3) == 3
