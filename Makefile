@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test lint bench bench-cold-start bench-hetero build-multiworker images push
+.PHONY: all test lint bench bench-cold-start bench-hetero bench-sharded build-multiworker images push
 
 all: lint test
 
@@ -35,6 +35,18 @@ bench-cold-start:
 
 bench-hetero:
 	python benchmarks/hetero_fleet.py --output benchmarks/results_hetero_cpu_r10.json
+
+# sharded serving plane (docs/serving.md): open-loop goodput + p99 at
+# 1/2/4 replicas behind the router, plus goodput retained across a
+# mid-run replica kill
+# NB: the whole plane shares one Python process (and one CPU) here, so
+# offered load must sit below single-process capacity — past it the
+# arms melt into queueing collapse, which measures the box, not the
+# router. On real hardware each replica is its own process/host.
+bench-sharded:
+	python benchmarks/load_test.py --self-serve --open-loop --fleet 6 \
+		--replicas 1,2,4 --rps 4 --duration 15 --kill-replica-at 5 \
+		--output benchmarks/results_sharded_cpu_r11.json
 
 # 2-worker crash-tolerant ledger build of the example fleet config
 # (docs/robustness.md "Multi-worker builds") — the smoke proof that N

@@ -219,7 +219,7 @@ def main():
 
         # warm the serving path, then the quiescent baseline
         open_loop(url, body, rps=5.0, duration=2.0, seed=args.seed)
-        quiet, quiet_err, _, quiet_elapsed = open_loop(
+        quiet, quiet_err, _, _, quiet_elapsed = open_loop(
             url, body, rps=args.rps, duration=args.duration, seed=args.seed
         )
 
@@ -232,7 +232,7 @@ def main():
 
         refit_thread = threading.Thread(target=background_tick)
         refit_thread.start()
-        busy, busy_err, _, busy_elapsed = open_loop(
+        busy, busy_err, _, _, busy_elapsed = open_loop(
             url, body, rps=args.rps, duration=args.duration,
             seed=args.seed + 1,
         )

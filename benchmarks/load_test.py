@@ -30,6 +30,17 @@ Two arrival modes:
 
     python benchmarks/load_test.py --self-serve --open-loop --rps 80 \\
         --duration 20 --fleet 2 --batch-wait-ms 10 --queue-limit 32
+
+Sharded serving plane (docs/serving.md): ``--replicas 1,2,4`` runs the
+open-loop arm against an in-process router + N shard replicas per count
+and reports aggregate goodput (machine-scores/s) + p99 per replica
+count; ``--kill-replica-at S`` additionally SIGKILL-shapes one replica
+(its server stops accepting) S seconds into a final run at the highest
+count, reporting ``goodput_retained`` vs the same-count healthy arm —
+the PR-8 crash-tolerance number, now for serving:
+
+    python benchmarks/load_test.py --self-serve --open-loop --rps 40 \\
+        --duration 12 --fleet 6 --replicas 1,2,4 --kill-replica-at 5
 """
 
 import argparse
@@ -74,6 +85,64 @@ def self_serve(
     return f"http://127.0.0.1:{port}"
 
 
+def serve_sharded_plane(
+    collection: str,
+    base_port: int,
+    n_replicas: int,
+    batch_wait_ms: float = 0.0,
+    queue_limit: int = 64,
+):
+    """
+    One in-process sharded serving plane: N shard replicas (each a full
+    GordoApp with its slice of the shard manifest) + a router fronting
+    them, every one on its own localhost port. Returns
+    (router_url, replica_servers, router_app) — shutting down a replica
+    server is the bench's SIGKILL shape (connections refuse, the router
+    ejects and fails the shard over).
+    """
+    from werkzeug.serving import make_server
+
+    from gordo_tpu.router.app import build_router_app
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server.catalog import write_shard_manifest
+
+    os.environ["MODEL_COLLECTION_DIR"] = collection
+    replica_ids = [f"r{i}" for i in range(n_replicas)]
+    manifest = write_shard_manifest(
+        os.path.join(
+            os.path.dirname(collection), f"shard_manifest_{n_replicas}.json"
+        ),
+        replica_ids,
+    )
+    servers = {}
+    replica_urls = {}
+    for i, rid in enumerate(replica_ids):
+        app = build_app(
+            {
+                "SHARD_MANIFEST": manifest,
+                "REPLICA_ID": rid,
+                "BATCH_WAIT_MS": batch_wait_ms,
+                "BATCH_QUEUE_LIMIT": queue_limit,
+            }
+        )
+        server = make_server("127.0.0.1", base_port + 1 + i, app, threaded=True)
+        threading.Thread(target=server.serve_forever, daemon=True).start()
+        servers[rid] = server
+        replica_urls[rid] = f"http://127.0.0.1:{base_port + 1 + i}"
+    router = build_router_app(
+        {
+            "REPLICAS": replica_urls,
+            "PROBE_INTERVAL_S": 0.25,
+            "BACKOFF_SCALE": 0.05,  # sub-second ejection windows
+            "MAX_INFLIGHT": 256,
+        }
+    )
+    router_server = make_server("127.0.0.1", base_port, router, threaded=True)
+    threading.Thread(target=router_server.serve_forever, daemon=True).start()
+    servers["__router__"] = router_server
+    return f"http://127.0.0.1:{base_port}", servers, router
+
+
 def worker(url: str, body: bytes, stop_at: float, latencies, errors):
     while time.perf_counter() < stop_at:
         request = urllib.request.Request(
@@ -96,11 +165,14 @@ def open_loop(url: str, body: bytes, rps: float, duration: float, seed: int):
     """
     Poisson arrivals at target ``rps`` for ``duration`` seconds, one
     thread per in-flight request (arrivals never wait for responses).
-    Returns (latencies_ms, errors, sheds, elapsed_s) — a shed is a 503
-    carrying Retry-After (batching admission control); other failures
-    are errors. ``elapsed_s`` runs from the first arrival to the LAST
-    COMPLETION (not the thread-join return): achieved-throughput math
-    must not be diluted by one straggler's urlopen timeout.
+    Returns (latencies_ms, errors, sheds, partials, elapsed_s) — a shed
+    is a 503 carrying Retry-After (admission control, server or
+    router); a partial is a structured 409 naming per-machine
+    casualties (the sharded plane's failover-window shape); other
+    failures are errors. ``elapsed_s`` runs from the first arrival to
+    the LAST COMPLETION (not the thread-join return): achieved-
+    throughput math must not be diluted by one straggler's urlopen
+    timeout.
     """
     import random
 
@@ -108,6 +180,7 @@ def open_loop(url: str, body: bytes, rps: float, duration: float, seed: int):
     latencies: list = []
     errors: list = []
     sheds: list = []
+    partials: list = []
     done_at: list = []
 
     def one_request():
@@ -120,10 +193,16 @@ def open_loop(url: str, body: bytes, rps: float, duration: float, seed: int):
                 with urllib.request.urlopen(request, timeout=60) as resp:
                     resp.read()
             except urllib.error.HTTPError as err:
-                err.read()
+                detail = err.read()
                 retry_after = err.headers.get("Retry-After")
                 if err.code == 503 and retry_after is not None:
                     sheds.append(float(retry_after))
+                elif err.code == 409:
+                    try:
+                        named = json.loads(detail).get("unavailable") or {}
+                    except ValueError:
+                        named = {}
+                    partials.append(len(named))
                 else:
                     errors.append(err.code)
                 return
@@ -148,7 +227,120 @@ def open_loop(url: str, body: bytes, rps: float, duration: float, seed: int):
     for thread in threads:
         thread.join()
     elapsed = (max(done_at) if done_at else time.perf_counter()) - start
-    return latencies, errors, sheds, elapsed
+    return latencies, errors, sheds, partials, elapsed
+
+
+def run_sharded_bench(args, tmp: str) -> dict:
+    """
+    The ``--replicas`` arms: per replica count, an open-loop run against
+    a fresh in-process plane (router + N shard replicas), reporting
+    aggregate goodput (machine-scores/s) and latency percentiles; then
+    (``--kill-replica-at``) one more run at the highest count with a
+    replica killed mid-run, reporting ``goodput_retained`` vs the
+    same-count healthy arm.
+    """
+    import numpy as np
+
+    from benchmarks.server_latency import build_collection, summarize_ms
+    from gordo_tpu.router.ring import HashRing
+
+    counts = sorted({int(x) for x in str(args.replicas).split(",") if x})
+    fleet = max(1, args.fleet)
+    names = [f"bench-m{i}" for i in range(fleet)]
+    collection = build_collection(fleet, tmp, args.model)
+    rows = np.random.default_rng(0).random(
+        (args.samples, args.features)
+    ).tolist()
+    body = json.dumps({"machines": {n: rows for n in names}}).encode()
+    path = f"/gordo/v0/{args.project}/prediction/fleet"
+
+    def run_plane(n_replicas, kill_at=0.0):
+        port = run_plane.next_port
+        run_plane.next_port += n_replicas + 2
+        url, servers, router = serve_sharded_plane(
+            collection,
+            port,
+            n_replicas,
+            batch_wait_ms=args.batch_wait_ms,
+            queue_limit=args.queue_limit,
+        )
+        target = url + path
+        urllib.request.urlopen(
+            urllib.request.Request(
+                target, data=body, headers={"Content-Type": "application/json"}
+            ),
+            timeout=120,
+        ).read()
+        victim = None
+        killer = None
+        if kill_at > 0:
+            ring = HashRing([f"r{i}" for i in range(n_replicas)])
+            partition = ring.partition(names)
+            # kill the replica owning the most machines: the worst case
+            victim = max(partition, key=lambda r: len(partition[r]))
+
+            def kill():
+                servers[victim].shutdown()
+                servers[victim].server_close()
+
+            killer = threading.Timer(kill_at, kill)
+            killer.start()
+        try:
+            latencies, errors, sheds, partials, elapsed = open_loop(
+                target, body, args.rps, args.duration, args.seed
+            )
+        finally:
+            if killer is not None:
+                killer.join()
+            router.close()
+            for name, server in servers.items():
+                if name != victim:
+                    server.shutdown()
+                    server.server_close()
+        goodput = fleet * len(latencies) / elapsed if elapsed else 0.0
+        arm = {
+            "replicas": n_replicas,
+            "requests": len(latencies),
+            "errors": len(errors),
+            "sheds": len(sheds),
+            "partials": len(partials),
+            "machines_named_in_partials": sum(partials),
+            "achieved_rps": round(len(latencies) / elapsed, 1) if elapsed else 0,
+            "goodput_machine_scores_per_s": round(goodput, 1),
+            **summarize_ms(latencies),
+        }
+        if victim is not None:
+            arm["killed_replica"] = victim
+            arm["killed_at_s"] = kill_at
+        return arm, goodput
+
+    run_plane.next_port = args.port
+    arms = []
+    goodput_by_count = {}
+    for n in counts:
+        arm, goodput = run_plane(n)
+        arms.append(arm)
+        goodput_by_count[n] = goodput
+    kill_run = None
+    if args.kill_replica_at > 0 and max(counts) >= 2:
+        kill_run, kill_goodput = run_plane(
+            max(counts), kill_at=args.kill_replica_at
+        )
+        healthy = goodput_by_count[max(counts)]
+        kill_run["goodput_retained"] = (
+            round(kill_goodput / healthy, 3) if healthy else 0.0
+        )
+    return {
+        "mode": "sharded-open-loop",
+        "offered_rps": args.rps,
+        "duration_s": args.duration,
+        "fleet_size": fleet,
+        "model": args.model,
+        "batch_wait_ms": args.batch_wait_ms,
+        "queue_limit": args.queue_limit,
+        "arms": arms,
+        "kill_run": kill_run,
+    }
 
 
 def batching_registry_stats():
@@ -259,11 +451,45 @@ def main():
         help="Self-serve estimator family (lstm exercises the windowed "
         "serving path: on-device window gather + chunked predict)",
     )
+    parser.add_argument(
+        "--replicas",
+        default=None,
+        metavar="N[,N...]",
+        help="Sharded serving plane (docs/serving.md): run the open-loop "
+        "fleet arm against an in-process router + N shard replicas for "
+        "each count (e.g. 1,2,4), reporting aggregate goodput + p99 per "
+        "count. Implies --self-serve --open-loop --fleet.",
+    )
+    parser.add_argument(
+        "--kill-replica-at",
+        type=float,
+        default=0.0,
+        metavar="S",
+        help="With --replicas: one more run at the highest count where "
+        "the busiest replica stops accepting S seconds in; reports "
+        "goodput_retained vs the healthy same-count arm.",
+    )
+    parser.add_argument(
+        "--output",
+        default=None,
+        help="Also write the result JSON to this path.",
+    )
     args = parser.parse_args()
 
     import numpy as np
 
     tmp_ctx = tempfile.TemporaryDirectory()
+
+    if args.replicas:
+        if not args.fleet:
+            parser.error("--replicas requires --fleet N")
+        out = run_sharded_bench(args, tmp_ctx.name)
+        payload = json.dumps(out, indent=2)
+        print(payload)
+        if args.output:
+            with open(args.output, "w") as fh:
+                fh.write(payload + "\n")
+        return
     base_url = args.base_url
     served_locally = False
     if base_url is None:
@@ -316,9 +542,10 @@ def main():
         sys.exit(f"cannot reach {url}: {err.reason}")
 
     sheds: list = []
+    partials: list = []
     start = time.perf_counter()
     if args.open_loop:
-        latencies, errors, sheds, elapsed = open_loop(
+        latencies, errors, sheds, partials, elapsed = open_loop(
             url, body, args.rps, args.duration, args.seed
         )
     else:
@@ -362,9 +589,14 @@ def main():
         "tracing_overhead": measure_overhead(samples=1000),
     }
     if args.open_loop:
-        attempts = len(latencies) + len(errors) + len(sheds)
+        attempts = len(latencies) + len(errors) + len(sheds) + len(partials)
         out["sheds"] = len(sheds)
         out["shed_rate"] = round(len(sheds) / attempts, 4) if attempts else 0.0
+        # structured 409s (named per-machine casualties: build-report
+        # 409s, or the router's transient failover-window partials) —
+        # reported in their own bucket, not silently dropped and not
+        # conflated with raw errors
+        out["partials"] = len(partials)
         if sheds:
             out["shed_retry_after_s_max"] = max(sheds)
     if served_locally:
@@ -381,6 +613,9 @@ def main():
             args.fleet * len(latencies) / elapsed, 1
         )
     print(json.dumps(out))
+    if args.output:
+        with open(args.output, "w") as fh:
+            fh.write(json.dumps(out, indent=2) + "\n")
 
 
 if __name__ == "__main__":

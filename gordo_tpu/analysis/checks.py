@@ -813,7 +813,13 @@ METRIC_FACTORY_METHODS = frozenset({"counter", "gauge", "histogram"})
 #: label is usually a high-cardinality one (a raw path or machine name)
 #: about to blow up the time-series count.
 ALLOWED_METRIC_LABELS = frozenset(
-    {"path", "phase", "endpoint", "method", "outcome", "windowed", "kind", "status"}
+    {
+        "path", "phase", "endpoint", "method", "outcome", "windowed",
+        "kind", "status",
+        # replica ids are a config-bounded handful per deployment (the
+        # router's shard manifest names them all), not a cardinality risk
+        "replica",
+    }
 )
 
 METRIC_NAME_RE = re.compile(r"^gordo_[a-z][a-z0-9_]*$")

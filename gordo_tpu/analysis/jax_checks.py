@@ -80,6 +80,11 @@ HOT_PATH_PATTERNS = (
     # invocation (and its planning code is shared with the builder's
     # hot path) — keep the new module under the same discipline
     "gordo_tpu/cli/buckets.py",
+    # the routing tier sits in front of EVERY serving request: it must
+    # stay pure host-side HTTP — an accidental device sync (or any JAX
+    # use at all) in its fanout/health loops would stall the whole
+    # serving plane
+    "gordo_tpu/router/",
 )
 
 

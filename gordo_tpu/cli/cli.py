@@ -954,6 +954,26 @@ def telemetry_summarize(directory: str, as_json: bool):
     "falls back to a retrace.",
 )
 @click.option(
+    "--shard-manifest",
+    type=click.Path(exists=True, dir_okay=False),
+    default=None,
+    envvar="GORDO_SHARD_MANIFEST",
+    help="Sharded serving plane (docs/serving.md): JSON manifest naming "
+    "the replica set ({'replicas': [...], 'vnodes': N, optional "
+    "'replica_id'}). This replica then serves only its consistent-hash "
+    "share of the collection and answers a structured 421 for machines "
+    "the ring assigns elsewhere (the router's failover requests carry "
+    "an adopt header that bypasses it). Omit for the historical "
+    "whole-collection replica.",
+)
+@click.option(
+    "--replica-id",
+    default=None,
+    envvar="GORDO_REPLICA_ID",
+    help="This replica's id on the ring; overrides the manifest's own, "
+    "so one shared manifest file can serve every replica.",
+)
+@click.option(
     "--log-level",
     type=click.Choice(["debug", "info", "warning", "error", "critical"]),
     default="debug",
@@ -976,6 +996,8 @@ def run_server_cli(
     queue_limit,
     scorer_cache_size,
     aot_cache,
+    shard_manifest,
+    replica_id,
     log_level,
     with_prometheus,
 ):
@@ -987,6 +1009,8 @@ def run_server_cli(
         "BATCH_QUEUE_LIMIT": queue_limit,
         "SCORER_CACHE_SIZE": scorer_cache_size,
         "AOT_CACHE": aot_cache,
+        "SHARD_MANIFEST": shard_manifest,
+        "REPLICA_ID": replica_id,
     }
     if with_prometheus:
         config["ENABLE_PROMETHEUS"] = True
@@ -1001,11 +1025,166 @@ def run_server_cli(
     )
 
 
+@click.command("run-router")
+@click.option(
+    "--host",
+    type=HostIP(),
+    default="0.0.0.0",
+    envvar="GORDO_ROUTER_HOST",
+    show_default=True,
+    help="The host to run the router on.",
+)
+@click.option(
+    "--port",
+    type=click.IntRange(1, 65535),
+    default=5556,
+    envvar="GORDO_ROUTER_PORT",
+    show_default=True,
+    help="The port to run the router on.",
+)
+@click.option(
+    "--replica",
+    "replicas",
+    multiple=True,
+    metavar="ID=URL",
+    envvar="GORDO_ROUTER_REPLICAS",
+    help="One shard replica as id=base-url (repeatable), e.g. "
+    "--replica r0=http://10.0.0.4:5555. The ids must match the "
+    "replicas' shard manifest; membership can be changed at runtime "
+    "via POST /router/replicas.",
+)
+@click.option(
+    "--vnodes",
+    type=click.IntRange(min=1),
+    default=64,
+    envvar="GORDO_ROUTER_VNODES",
+    show_default=True,
+    help="Virtual nodes per replica on the consistent-hash ring; must "
+    "match the replicas' shard manifest.",
+)
+@click.option(
+    "--eject-after",
+    type=click.IntRange(min=1),
+    default=3,
+    envvar="GORDO_ROUTER_EJECT_AFTER",
+    show_default=True,
+    help="Consecutive failures before a replica is ejected and its "
+    "shard fails over to ring successors.",
+)
+@click.option(
+    "--backoff-scale",
+    type=click.FloatRange(min=0.001),
+    default=0.25,
+    envvar="GORDO_ROUTER_BACKOFF_SCALE",
+    show_default=True,
+    help="Scale on the house 8/16/32s backoff schedule for ejection "
+    "windows (0.25 -> 2/4/8s).",
+)
+@click.option(
+    "--probe-interval",
+    type=click.FloatRange(min=0),
+    default=1.0,
+    envvar="GORDO_ROUTER_PROBE_INTERVAL_S",
+    show_default=True,
+    help="Seconds between /healthz probes of ejected replicas (half-open "
+    "re-adoption); 0 disables active probing.",
+)
+@click.option(
+    "--hedge-ms",
+    type=click.FloatRange(min=0),
+    default=0.0,
+    envvar="GORDO_ROUTER_HEDGE_MS",
+    show_default=True,
+    help="Straggler hedging: a shard call silent for this long gets ONE "
+    "duplicate sent to the next routable successor, first completion "
+    "wins. 0 disables.",
+)
+@click.option(
+    "--replica-timeout",
+    type=click.FloatRange(min=0.1),
+    default=30.0,
+    envvar="GORDO_ROUTER_REPLICA_TIMEOUT_S",
+    show_default=True,
+    help="Per-call timeout against replicas, seconds.",
+)
+@click.option(
+    "--max-inflight",
+    type=click.IntRange(min=1),
+    default=64,
+    envvar="GORDO_ROUTER_MAX_INFLIGHT",
+    show_default=True,
+    help="Router admission control: concurrent prediction requests past "
+    "this shed with a structured 503 + Retry-After.",
+)
+@click.option(
+    "--threads",
+    type=int,
+    default=32,
+    envvar="GORDO_ROUTER_THREADS",
+    show_default=True,
+    help="Bound on concurrently handled requests (each fleet request "
+    "fans out on its own worker pool).",
+)
+@click.option(
+    "--log-level",
+    type=click.Choice(["debug", "info", "warning", "error", "critical"]),
+    default="info",
+    envvar="GORDO_ROUTER_LOG_LEVEL",
+    show_default=True,
+    help="The log level for the router.",
+)
+def run_router_cli(
+    host,
+    port,
+    replicas,
+    vnodes,
+    eject_after,
+    backoff_scale,
+    probe_interval,
+    hedge_ms,
+    replica_timeout,
+    max_inflight,
+    threads,
+    log_level,
+):
+    """
+    Run the sharded-serving router (docs/serving.md "Sharded serving
+    plane"): fronts N run-server shard replicas over one collection,
+    fanning fleet requests out by consistent hash and surviving any one
+    replica's death via ejection + failover to ring successors.
+    """
+    from gordo_tpu.router.app import parse_replica_entries, run_router
+
+    # the envvar arrives as one comma-separated string; the repeated
+    # flag arrives as a tuple of id=url entries — one shared parser
+    try:
+        replica_map = parse_replica_entries(replicas)
+    except ValueError as exc:
+        raise click.UsageError(str(exc))
+    if not replica_map:
+        raise click.UsageError(
+            "At least one --replica id=url is required "
+            "(or GORDO_ROUTER_REPLICAS)"
+        )
+    config = {
+        "REPLICAS": replica_map,
+        "VNODES": vnodes,
+        "EJECT_AFTER": eject_after,
+        "BACKOFF_SCALE": backoff_scale,
+        "PROBE_INTERVAL_S": probe_interval,
+        "HEDGE_MS": hedge_ms,
+        "REPLICA_TIMEOUT_S": replica_timeout,
+        "MAX_INFLIGHT": max_inflight,
+    }
+    run_router(host, port, log_level, config=config, threads=threads)
+
+
 gordo.add_command(workflow_cli)
 gordo.add_command(build)
 gordo.add_command(build_fleet)
 gordo.add_command(sweep_cli)
 gordo.add_command(run_server_cli)
+gordo.add_command(run_router_cli)
 gordo.add_command(gordo_client)
 gordo.add_command(buckets_cli)
 gordo.add_command(programs_cli)

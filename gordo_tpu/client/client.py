@@ -30,6 +30,7 @@ from gordo_tpu.client.io import (
     HttpUnprocessableEntity,
     MachineUnavailable,
     NotFound,
+    ReplicaUnavailable,
     ResourceGone,
     handle_response,
 )
@@ -120,7 +121,17 @@ class Client:
     session
         Optional pre-configured ``requests.Session`` (the loopback test
         harness injects one that routes into an in-process WSGI app).
+    metadata_timeout
+        Seconds before a metadata-path GET (revisions/models listings,
+        machine metadata, model download) gives up. Finite by default:
+        a blackholed server must fail the discovery call, not wedge the
+        client forever — the same hang-proofing the data-path POSTs
+        already have.
     """
+
+    #: default (connect+read) timeout on metadata GETs — generous for a
+    #: healthy server, finite for a dead one
+    DEFAULT_METADATA_TIMEOUT_S = 30.0
 
     def __init__(
         self,
@@ -139,6 +150,7 @@ class Client:
         n_retries: int = 5,
         use_parquet: bool = False,
         session: Optional[requests.Session] = None,
+        metadata_timeout: Optional[float] = DEFAULT_METADATA_TIMEOUT_S,
     ):
         self.base_url = f"{scheme}://{host}:{port}"
         self.server_endpoint = f"{self.base_url}/gordo/v0/{project}"
@@ -160,6 +172,7 @@ class Client:
         self.n_retries = n_retries
         self.format = "parquet" if use_parquet else "json"
         self.session = session or requests.Session()
+        self.metadata_timeout = metadata_timeout
 
     # -- discovery ---------------------------------------------------------
 
@@ -169,7 +182,10 @@ class Client:
         ``{"latest": ..., "available-revisions": [...]}`` from the server
         (reference: client.py:115-135).
         """
-        resp = self.session.get(f"{self.server_endpoint}/revisions")
+        resp = self.session.get(
+            f"{self.server_endpoint}/revisions",
+            timeout=self.metadata_timeout,
+        )
         return handle_response(
             resp, resource_name="List of available revisions from server"
         )
@@ -180,7 +196,9 @@ class Client:
     @cached_method(maxsize=64, ttl=30)
     def _get_available_machines(self, revision: str) -> dict:
         resp = self.session.get(
-            f"{self.server_endpoint}/models", params={"revision": revision}
+            f"{self.server_endpoint}/models",
+            params={"revision": revision},
+            timeout=self.metadata_timeout,
         )
         model_response = handle_response(
             resp, resource_name=f"Model name listing for revision {revision}"
@@ -228,6 +246,7 @@ class Client:
         resp = self.session.get(
             f"{self.server_endpoint}/{name}/metadata",
             params={"revision": revision},
+            timeout=self.metadata_timeout,
         )
         metadata = handle_response(
             resp, resource_name=f"Machine metadata for {name}"
@@ -246,9 +265,15 @@ class Client:
         (reference: client.py:226-252).
         """
         models = dict()
-        for machine_name in targets or self.get_machine_names(revision=revision):
+        # resolve like the sibling metadata path: the requested revision
+        # must ride the download too, or a caller asking for a pinned
+        # older revision silently gets `latest`
+        _revision = revision or self._get_latest_revision()
+        for machine_name in targets or self.get_machine_names(revision=_revision):
             resp = self.session.get(
-                f"{self.server_endpoint}/{machine_name}/download-model"
+                f"{self.server_endpoint}/{machine_name}/download-model",
+                params={"revision": _revision},
+                timeout=self.metadata_timeout,
             )
             content = handle_response(
                 resp, resource_name=f"Model download for model {machine_name}"
@@ -511,13 +536,25 @@ class Client:
                         )
                     status = "skipped"
                     break
+                transient = isinstance(resp, ReplicaUnavailable)
                 for name in sorted(bad):
                     info = (resp.unavailable or {}).get(name) or {}
-                    errors[name].append(
-                        f"Machine '{name}' is unavailable on the server "
-                        f"({info.get('reason', 'unknown')}): permanent for "
-                        "this revision; recorded, not retried"
-                    )
+                    if transient:
+                        # the router's replica-outage 409: the machine
+                        # is fine, its shard is failing over — recorded
+                        # for THIS run, worth retrying later
+                        errors[name].append(
+                            f"Machine '{name}' is temporarily unroutable "
+                            f"({info.get('reason', 'replica_unavailable')}"
+                            f", replica {info.get('replica', 'unknown')}): "
+                            "transient; recorded for this run, retry later"
+                        )
+                    else:
+                        errors[name].append(
+                            f"Machine '{name}' is unavailable on the server "
+                            f"({info.get('reason', 'unknown')}): permanent for "
+                            "this revision; recorded, not retried"
+                        )
                 excluded |= bad
                 payload, chunk_names = build_payload(k)
                 if not payload:
@@ -904,14 +941,23 @@ class Client:
             except MachineUnavailable as exc:
                 # 409: the build recorded this machine as failed or
                 # quarantined — permanent for the revision, so no retry
-                # and no fallback path; one recorded per-machine failure
+                # and no fallback path; one recorded per-machine failure.
+                # (ReplicaUnavailable — the router's transient flavor —
+                # is likewise recorded, with wording that says so.)
                 _observe_request(
                     "single", "unavailable", monotonic() - attempt_start
                 )
-                msg = (
-                    f"Machine '{machine.name}' is unavailable on the "
-                    f"server for dates {start} -> {end}: {exc}"
-                )
+                if isinstance(exc, ReplicaUnavailable):
+                    msg = (
+                        f"Machine '{machine.name}' is temporarily "
+                        f"unroutable (replica outage) for dates {start} -> "
+                        f"{end}: {exc}; transient — retry later"
+                    )
+                else:
+                    msg = (
+                        f"Machine '{machine.name}' is unavailable on the "
+                        f"server for dates {start} -> {end}: {exc}"
+                    )
                 logger.error(msg)
                 return PredictionResult(
                     name=machine.name, predictions=None, error_messages=[msg],

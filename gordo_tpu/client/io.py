@@ -82,6 +82,35 @@ class MachineUnavailable(Exception):
         self.trace_id = trace_id
 
 
+class ReplicaUnavailable(MachineUnavailable):
+    """
+    A 409 whose body is marked ``"transient": true`` — the ROUTER
+    (docs/serving.md "Sharded serving plane") naming machines whose
+    every candidate replica is currently ejected. Unlike its parent this
+    is NOT permanent for the revision: the machines are fine, their
+    shard is between homes — retryable-elsewhere (the router already
+    failed over where it could) and retryable-later (``retry_after``
+    hints when the ejection window ends). Within one prediction run the
+    handling matches the parent — record the named casualties
+    per-machine and continue with the healthy remainder — but the
+    recorded error says "transient", so operators re-run instead of
+    writing the machines off for the revision.
+
+    Subclasses :class:`MachineUnavailable` so every existing 409 code
+    path handles it unchanged.
+    """
+
+    def __init__(
+        self,
+        msg: str,
+        unavailable: Optional[dict] = None,
+        trace_id: Optional[str] = None,
+        retry_after: Optional[float] = None,
+    ):
+        super().__init__(msg, unavailable, trace_id=trace_id)
+        self.retry_after = retry_after
+
+
 def handle_response(
     resp: requests.Response, resource_name: Optional[str] = None
 ) -> Union[dict, bytes]:
@@ -127,9 +156,22 @@ def handle_response(
         raise NotFound(msg)
     if resp.status_code == 409:
         try:
-            detail = resp.json().get("unavailable") or {}
+            body = resp.json()
         except ValueError:
-            detail = {}
+            body = {}
+        detail = body.get("unavailable") or {}
+        if body.get("transient"):
+            # the router's replica-outage 409: same discipline, but the
+            # condition is a failover window, not the revision's build
+            raise ReplicaUnavailable(
+                msg,
+                detail,
+                trace_id=trace_id,
+                retry_after=_parse_retry_after(
+                    resp.headers.get("Retry-After")
+                    or body.get("retry_after_s")
+                ),
+            )
         raise MachineUnavailable(msg, detail, trace_id=trace_id)
     if 400 <= resp.status_code <= 499:
         raise BadGordoRequest(msg)

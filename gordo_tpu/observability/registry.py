@@ -75,6 +75,13 @@ class _Metric:
     def _series_value(self, value) -> dict:
         return {"value": value}
 
+    def remove(self, **labels) -> None:
+        """Drop one labeled series outright — for label values that
+        leave the world entirely (a decommissioned replica id), where
+        continuing to export the last value would report a ghost."""
+        with self._lock:
+            self._series.pop(self._key(labels), None)
+
 
 class Counter(_Metric):
     """Monotonically increasing count."""

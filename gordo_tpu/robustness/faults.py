@@ -63,7 +63,7 @@ FAULT_INJECT_ENV_VAR = "GORDO_FAULT_INJECT"
 _KNOWN_SITES = frozenset(
     {
         "fetch", "train", "ckpt", "serve", "batch", "drift", "refit",
-        "promote", "worker", "lease", "program",
+        "promote", "worker", "lease", "program", "replica",
     }
 )
 
@@ -493,6 +493,77 @@ def corrupt_program_payload(
     for i in range(len(mangled) // 3, min(len(mangled), len(mangled) // 3 + 64)):
         mangled[i] ^= 0xFF
     return bytes(mangled)
+
+
+def replica_fault_action(
+    replica_id: str,
+) -> typing.Optional[typing.Tuple[str, float]]:
+    """
+    The routing-tier seam (site ``replica``, docs/serving.md "Sharded
+    serving plane"): consulted by the router immediately before every
+    call to a replica. Returns what the call should suffer, or None:
+
+    - ``replica:die:<id>`` -> ``("die", 0)``: the router must treat the
+      call as connection-refused — from the router's seat,
+      indistinguishable from the replica process being SIGKILL'd.
+      ``@attempts:N`` bounds it to the first N calls (after which the
+      replica "restarted" — the re-adoption exercise).
+    - ``replica:slow:<id>@ms:<m>`` -> ``("slow", seconds)``: the router
+      sleeps that long before sending — the straggling-shard shape
+      bounded hedged retries exist for. Default 1000 ms; ``@attempts:N``
+      bounds it.
+    - ``replica:flap:<id>[@burst:<k>]`` -> ``("die", 0)`` for ``k``
+      consecutive calls, then None for ``k``, repeating (default k=3,
+      the ejection threshold) — sustained-enough failure to eject
+      followed by recovery, over and over: the half-open probing
+      exercise.
+
+    Every suffered call fires a ``fault_injected`` event (flap: only
+    the failing legs). Env unset is the strict one-lookup no-op.
+    """
+    registry = active_registry()
+    if registry is None:
+        return None
+    for mode in ("die", "slow", "flap"):
+        spec = _find_mode(registry, "replica", mode, str(replica_id))
+        if spec is None:
+            continue
+        if mode == "flap":
+            burst = max(1, spec.param_int("burst", 3))
+            # count every call through the spec so the fail/pass
+            # cadence advances; only failing legs emit the event
+            with registry._lock:
+                spec.fires += 1
+                leg = (spec.fires - 1) // burst
+            if leg % 2 == 1:
+                return None
+            from gordo_tpu.observability import emit_event
+
+            emit_event(
+                "fault_injected",
+                site="replica",
+                mode="flap",
+                target=spec.target,
+                fire_count=spec.fires,
+                replica=replica_id,
+            )
+            return ("die", 0.0)
+        attempts = spec.param_int("attempts", 0)
+        if attempts and spec.fires >= attempts:
+            continue
+        if mode == "slow":
+            try:
+                ms = float(spec.params.get("ms", 1000.0))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "Fault spec parameter @ms must be a number, got "
+                    f"{spec.params.get('ms')!r}"
+                )
+            registry.fire(spec, replica=replica_id, ms=ms)
+            return ("slow", ms / 1000.0)
+        registry.fire(spec, replica=replica_id)
+        return ("die", 0.0)
+    return None
 
 
 def tear_checkpoint_files(step_dir: typing.Union[str, os.PathLike]) -> bool:

@@ -1,0 +1,36 @@
+"""
+The routing tier (docs/serving.md "Sharded serving plane"): one
+collection's machines partitioned across N ``run-server`` replicas by a
+consistent hash ring, with fleet requests fanned out to the owning
+replicas and re-joined — and any ONE replica's death absorbed as a
+routine event (ejection, failover to ring successors, re-adoption)
+instead of an outage.
+
+The router is pure host-side HTTP plumbing: it never touches JAX or the
+models. It derives everything it knows — machine list, build-report
+casualties — from the same artifact directory every replica already maps
+in, so adding a replica is "start run-server with a shard manifest" and
+adding a router is "point run-router at the same volume".
+"""
+
+from gordo_tpu.router.health import ReplicaHealthTracker
+from gordo_tpu.router.ring import HashRing
+
+__all__ = [
+    "HashRing",
+    "ReplicaHealthTracker",
+    "RouterApp",
+    "build_router_app",
+]
+
+
+def __getattr__(name):
+    # router.app pulls in the server stack (it reuses the serving
+    # catalog), and the serving catalog pulls in router.ring — importing
+    # app eagerly here would close that loop into a cycle, so the two
+    # WSGI-facing names load lazily
+    if name in ("RouterApp", "build_router_app"):
+        from gordo_tpu.router import app
+
+        return getattr(app, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

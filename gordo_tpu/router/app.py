@@ -1,0 +1,1342 @@
+"""
+The fault-tolerant routing tier (docs/serving.md "Sharded serving
+plane"): a WSGI app that presents the SAME surface as one ``run-server``
+process while the collection's machines actually live sharded across N
+replicas.
+
+Per request:
+
+- single-machine routes proxy to the machine's ring owner, failing over
+  to ring successors (with the adopt header, server/catalog.py) when the
+  owner is ejected;
+- fleet routes partition the posted machines by owner, fan the sub-
+  requests out concurrently, and re-join the per-machine frames into one
+  response — with bounded hedged retries for straggling shards;
+- replica health is a per-replica circuit breaker (router/health.py)
+  fed by passive request outcomes and the replicas' own ``/healthz``
+  probes; a dead replica costs only its shard, only until failover.
+
+Failure is structured all the way down (docs/robustness.md): build
+casualties 409 exactly as they would from a single server (the router
+reads the same ``build_report.json``); machines whose every candidate
+replica is ejected come back as a 409 whose body is marked
+``transient`` — the client's :class:`gordo_tpu.client.io.ReplicaUnavailable`
+— naming each casualty; melting replicas' 503 + Retry-After propagates
+through, and the router sheds at its own door past ``--max-inflight``.
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+import timeit
+import traceback
+import typing
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
+from concurrent.futures import wait as futures_wait
+
+import requests
+from werkzeug.exceptions import HTTPException
+from werkzeug.routing import Map, Rule
+from werkzeug.wrappers import Request, Response
+
+from gordo_tpu import __version__
+from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.robustness import faults
+from gordo_tpu.router.health import ReplicaHealthTracker
+from gordo_tpu.router.ring import DEFAULT_VNODES, HashRing
+from gordo_tpu.server.app import GordoApp, adapt_proxy_deployment
+from gordo_tpu.server.catalog import (
+    ADOPT_HEADER,
+    ServingCatalog,
+    resolve_sibling_revision,
+)
+from gordo_tpu.server.utils import ApiError
+
+logger = logging.getLogger(__name__)
+
+
+class RouterConfig:
+    """Default router config (mirrors server/app.py's Config idiom)."""
+
+    MODEL_COLLECTION_DIR_ENV_VAR = "MODEL_COLLECTION_DIR"
+    #: replica id -> base URL (e.g. {"r0": "http://10.0.0.4:5555"})
+    REPLICAS: typing.Dict[str, str] = {}
+    VNODES = DEFAULT_VNODES
+    #: consecutive failures before a replica is ejected
+    EJECT_AFTER = 3
+    #: scale on the house 8/16/32s backoff schedule for ejection windows
+    BACKOFF_SCALE = 0.25
+    #: active /healthz probing of ejected replicas; 0 disables the
+    #: prober thread (half-open then happens lazily on window expiry)
+    PROBE_INTERVAL_S = 1.0
+    #: straggler hedging: a shard call silent for this long gets one
+    #: hedge to the next routable successor; 0 disables (default — turn
+    #: it on where tail latency matters more than duplicate work)
+    HEDGE_MS = 0.0
+    #: per-call (connect, read) timeout against replicas
+    REPLICA_TIMEOUT_S = 30.0
+    #: admission control: concurrent requests in flight past this shed
+    #: with 503 + Retry-After at the router's own door
+    MAX_INFLIGHT = 64
+    #: test seam: a pre-built requests.Session (the loopback harness
+    #: injects one routing straight into in-process replica apps)
+    SESSION: typing.Optional[typing.Any] = None
+
+    def to_dict(self) -> dict:
+        return {k: getattr(self, k) for k in dir(self) if k.isupper()}
+
+
+def _json_response(payload: dict, status: int = 200) -> Response:
+    return Response(
+        json.dumps(payload, default=str),
+        status=status,
+        mimetype="application/json",
+    )
+
+
+class _RequestCtx:
+    def __init__(self):
+        self.start_time = timeit.default_timer()
+        self.collection_dir = ""
+        self.current_revision = ""
+        self.revision = ""
+        #: the revision the CALLER pinned (param or header), or "" —
+        #: must ride every forwarded replica call, or a header-pinned
+        #: request would be served from `latest` while stamped with the
+        #: pinned name
+        self.requested_revision = ""
+        self.trace_id = ""
+
+    def forward_params(self, request: Request) -> dict:
+        """Query params for a replica call, with the pinned revision
+        injected when it arrived as a header rather than a param."""
+        params = request.args.to_dict()
+        if self.requested_revision and "revision" not in params:
+            params["revision"] = self.requested_revision
+        return params
+
+
+class _ShardResult:
+    """One shard call's terminal outcome."""
+
+    __slots__ = ("kind", "replica", "payload", "status", "retry_after")
+
+    def __init__(self, kind, replica, payload=None, status=None, retry_after=None):
+        self.kind = kind  # ok | unavailable | overloaded | refused | error
+        self.replica = replica
+        self.payload = payload
+        self.status = status
+        self.retry_after = retry_after
+
+
+class RouterApp:
+    """WSGI router fronting N ``run-server`` shard replicas."""
+
+    _TRACE_EXEMPT_PATHS = frozenset({"/healthcheck", "/healthz"})
+
+    def __init__(self, config: typing.Optional[dict] = None):
+        self.config = RouterConfig().to_dict()
+        if config:
+            self.config.update(config)
+        replicas = dict(self.config.get("REPLICAS") or {})
+        if not replicas:
+            raise ValueError(
+                "RouterApp needs at least one replica (REPLICAS config / "
+                "run-router --replica id=url)"
+            )
+        self.vnodes = int(self.config.get("VNODES") or DEFAULT_VNODES)
+        self._membership_lock = threading.Lock()
+        self._replicas = replicas
+        self._ring = HashRing(sorted(replicas), self.vnodes)
+        probe_interval = float(self.config.get("PROBE_INTERVAL_S") or 0.0)
+        self.health = ReplicaHealthTracker(
+            sorted(replicas),
+            eject_after=int(self.config.get("EJECT_AFTER") or 3),
+            backoff_scale=float(self.config.get("BACKOFF_SCALE") or 0.25),
+            # with a prober, the PROBE re-admits a dead replica — live
+            # traffic never pays a casualty per expired window
+            lazy_half_open=probe_interval <= 0,
+        )
+        # the same catalog layer the replicas use, for the same
+        # artifacts: build-report casualties (409 source of truth) and
+        # the collection's machine list. No shard, no batching, no AOT.
+        self.catalog = ServingCatalog(aot_cache=False)
+        self.hedge_s = float(self.config.get("HEDGE_MS") or 0.0) / 1000.0
+        self.replica_timeout_s = float(
+            self.config.get("REPLICA_TIMEOUT_S") or 30.0
+        )
+        self.max_inflight = int(self.config.get("MAX_INFLIGHT") or 64)
+        self._inflight = threading.BoundedSemaphore(self.max_inflight)
+        self.session = self.config.get("SESSION") or requests.Session()
+        # EMA of fanout wall time: the Retry-After estimate for sheds
+        self._ema_lock = threading.Lock()
+        self._ema_request_s = 0.25
+        self._stopping = threading.Event()
+        self._prober: typing.Optional[threading.Thread] = None
+        if probe_interval > 0:
+            self._prober = threading.Thread(
+                target=self._probe_loop,
+                args=(probe_interval,),
+                name="gordo-router-prober",
+                daemon=True,
+            )
+            self._prober.start()
+
+        self.url_map = Map(
+            [
+                Rule("/healthcheck", endpoint="healthcheck", methods=["GET"]),
+                Rule("/healthz", endpoint="healthz", methods=["GET"]),
+                Rule(
+                    "/server-version", endpoint="server_version", methods=["GET"]
+                ),
+                Rule("/router/replicas", endpoint="replicas", methods=["GET"]),
+                Rule(
+                    "/router/replicas",
+                    endpoint="set_replicas",
+                    methods=["POST"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/models",
+                    endpoint="models",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/revisions",
+                    endpoint="revisions",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/<gordo_name>/metadata",
+                    endpoint="metadata",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/<gordo_name>/healthcheck",
+                    endpoint="metadata",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/<gordo_name>/download-model",
+                    endpoint="proxy_get",
+                    methods=["GET"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/<gordo_name>/prediction",
+                    endpoint="single_prediction",
+                    methods=["POST"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/<gordo_name>/anomaly/prediction",
+                    endpoint="single_prediction",
+                    methods=["POST"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/prediction/fleet",
+                    endpoint="fleet_prediction",
+                    methods=["POST"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/anomaly/prediction/fleet",
+                    endpoint="fleet_prediction",
+                    methods=["POST"],
+                ),
+            ],
+            strict_slashes=False,
+        )
+
+    # -- membership (drain/adopt) ------------------------------------------
+
+    def routing_view(self) -> typing.Tuple[typing.Dict[str, str], HashRing]:
+        """The (replicas, ring) pair a request routes against — captured
+        ONCE at request start, so a concurrent membership change never
+        re-partitions an in-flight fanout (drain without drops)."""
+        with self._membership_lock:
+            return self._replicas, self._ring
+
+    def set_replicas(self, replicas: typing.Dict[str, str]) -> None:
+        """Swap the membership: the ring is immutable, so this builds a
+        new one and publishes it atomically. Removed replicas drain (new
+        requests no longer route to them; in-flight ones finish); added
+        replicas adopt their ring share on the next request."""
+        if not replicas:
+            raise ValueError("Replica set cannot be empty")
+        ring = HashRing(sorted(replicas), self.vnodes)
+        # track health BEFORE publishing the ring: a concurrent request
+        # capturing the new ring must not see a freshly added (unknown)
+        # replica as ejected and spuriously fail its shard over
+        self.health.ensure(replicas)
+        with self._membership_lock:
+            previous = set(self._replicas)
+            self._replicas = dict(replicas)
+            self._ring = ring
+        removed = sorted(previous - set(replicas))
+        for rid in removed:
+            self.health.forget(rid)
+        emit_event(
+            "router_membership_changed",
+            added=sorted(set(replicas) - previous),
+            removed=removed,
+            n_replicas=len(replicas),
+        )
+
+    def close(self) -> None:
+        self._stopping.set()
+        if self._prober is not None:
+            self._prober.join(timeout=5.0)
+            self._prober = None
+
+    # -- health probing ----------------------------------------------------
+
+    def _probe_loop(self, interval: float) -> None:
+        while not self._stopping.wait(interval):
+            self.probe_ejected()
+
+    def probe_ejected(self) -> None:
+        """Ask /healthz of every ejected replica whose window expired;
+        success moves it to half-open (router/health.py)."""
+        replicas, _ = self.routing_view()
+        for rid, base_url in replicas.items():
+            if not self.health.probe_due(rid):
+                continue
+            self.health.note_probe(rid, self._probe_replica(rid, base_url))
+
+    def _probe_replica(self, rid: str, base_url: str) -> bool:
+        action = faults.replica_fault_action(rid)
+        if action is not None and action[0] == "die":
+            return False
+        try:
+            # probes run serially per cycle: a blackholed replica must
+            # not hold the full request timeout and stall every OTHER
+            # ejected replica's re-adoption behind it — a healthy
+            # /healthz answers in milliseconds
+            resp = self.session.get(
+                f"{base_url}/healthz",
+                timeout=min(3.0, self.replica_timeout_s),
+            )
+        except Exception:
+            return False
+        # 503 here is the replica saying "alive but melting": it stays
+        # ejected/probing until it reports ready
+        return 200 <= resp.status_code < 300
+
+    # -- WSGI plumbing -----------------------------------------------------
+
+    def __call__(self, environ, start_response):
+        adapt_proxy_deployment(environ)
+        request = Request(environ)
+        response = self.dispatch(request)
+        return response(environ, start_response)
+
+    def dispatch(self, request: Request) -> Response:
+        ctx = _RequestCtx()
+        incoming = tracing.parse_traceparent(
+            request.headers.get(tracing.TRACEPARENT_HEADER)
+        )
+        adapter = self.url_map.bind_to_environ(request.environ)
+        if request.path in self._TRACE_EXEMPT_PATHS:
+            ctx.trace_id = incoming.trace_id if incoming is not None else ""
+            return self._dispatch_traced(
+                ctx, request, adapter, tracing.NOOP_SPAN
+            )
+        with tracing.start_span(
+            "router.request",
+            parent=incoming,
+            method=request.method,
+            path=request.path,
+        ) as span:
+            ctx.trace_id = span.trace_id or (
+                incoming.trace_id if incoming is not None else ""
+            )
+            return self._dispatch_traced(ctx, request, adapter, span)
+
+    def _dispatch_traced(self, ctx, request, adapter, span) -> Response:
+        endpoint = None
+        try:
+            endpoint, url_args = adapter.match()
+            resolution = self._resolve_revision(ctx, request)
+            if resolution is not None:
+                response = resolution  # 410: revision gone
+            else:
+                handler = getattr(self, f"view_{endpoint}")
+                response = handler(ctx, request, **url_args)
+        except ApiError as exc:
+            response = _json_response(exc.payload, exc.status)
+            retry_after = exc.payload.get("retry_after_s")
+            if retry_after is not None:
+                response.headers["Retry-After"] = str(retry_after)
+        except HTTPException as exc:
+            response = exc.get_response(request.environ)
+        except Exception:
+            logger.error(
+                "Unhandled router error:\n%s", traceback.format_exc()
+            )
+            response = _json_response(
+                {"error": "Something unexpected happened in the router"},
+                500,
+            )
+        span.set_attribute("endpoint", endpoint or "unmatched")
+        span.set_attribute("status_code", response.status_code)
+        if response.status_code >= 500:
+            span.set_status("error")
+        return self._finalize(ctx, request, response, endpoint)
+
+    def _resolve_revision(
+        self, ctx: _RequestCtx, request: Request
+    ) -> typing.Optional[Response]:
+        """The server's revision semantics, against the same artifacts:
+        the env pointer (symlink-resolved, so a lifecycle promotion rolls
+        the router's casualty view too), ``?revision=`` validated by the
+        shared name policy (catalog.resolve_sibling_revision)."""
+        pointer = os.environ[self.config["MODEL_COLLECTION_DIR_ENV_VAR"]]
+        ctx.collection_dir = pointer
+        if os.path.islink(pointer.rstrip(os.sep) or os.sep):
+            ctx.collection_dir = os.path.realpath(pointer)
+        ctx.current_revision = os.path.basename(ctx.collection_dir)
+        requested = request.args.get("revision") or request.headers.get(
+            "revision"
+        )
+        if requested:
+            resolved = resolve_sibling_revision(ctx.collection_dir, requested)
+            if resolved is None:
+                return _json_response(
+                    {"error": f"Revision '{requested}' not found."}, 410
+                )
+            ctx.revision = requested
+            ctx.requested_revision = requested
+            ctx.collection_dir = resolved
+        else:
+            ctx.revision = ctx.current_revision
+        return None
+
+    def _finalize(self, ctx, request, response, endpoint) -> Response:
+        if ctx.revision:
+            if response.mimetype == "application/json":
+                # same body stamp as the server's responses, so clients
+                # can't tell a router from a single replica
+                try:
+                    data = json.loads(response.get_data())
+                    if isinstance(data, dict) and "revision" not in data:
+                        data["revision"] = (
+                            response.headers.get("revision") or ctx.revision
+                        )
+                        response.set_data(json.dumps(data).encode())
+                except ValueError:
+                    pass
+            if "revision" not in response.headers:
+                response.headers["revision"] = ctx.revision
+        runtime_s = timeit.default_timer() - ctx.start_time
+        # append to any Server-Timing the proxied replica already
+        # stamped, so its model_load/predict phases survive the hop
+        entry = f"router_total;dur={runtime_s * 1000.0:.2f}"
+        existing = response.headers.get("Server-Timing")
+        response.headers["Server-Timing"] = (
+            f"{existing}, {entry}" if existing else entry
+        )
+        if ctx.trace_id:
+            response.headers[tracing.TRACE_ID_RESPONSE_HEADER] = ctx.trace_id
+        return response
+
+    # -- admission control -------------------------------------------------
+
+    def _admit(self) -> None:
+        if not self._inflight.acquire(blocking=False):
+            get_registry().counter(
+                "gordo_router_sheds_total",
+                "Requests shed at the router's own admission door",
+            ).inc()
+            retry_after = round(max(0.1, 2.0 * self._ema_request_s), 2)
+            raise ApiError(
+                {
+                    "error": "Router at max in-flight requests; retry later",
+                    "max_inflight": self.max_inflight,
+                    "retry_after_s": retry_after,
+                },
+                503,
+            )
+
+    def _release(self, started: float) -> None:
+        self._inflight.release()
+        elapsed = timeit.default_timer() - started
+        with self._ema_lock:
+            self._ema_request_s += 0.2 * (elapsed - self._ema_request_s)
+
+    def _count_request(self, outcome: str) -> None:
+        get_registry().counter(
+            "gordo_router_requests_total",
+            "Routed prediction requests by outcome "
+            "(ok/partial/shed/refused/error)",
+            ("outcome",),
+        ).inc(outcome=outcome)
+
+    # -- routing -----------------------------------------------------------
+
+    def _candidates(
+        self,
+        name: str,
+        ring: HashRing,
+        replicas: typing.Dict[str, str],
+    ) -> typing.Tuple[typing.List[str], str]:
+        """(routable candidate replicas in ring preference order, true
+        owner). Empty list = every candidate is ejected."""
+        preference = [r for r in ring.preference(name) if r in replicas]
+        owner = preference[0] if preference else ""
+        return [r for r in preference if self.health.routable(r)], owner
+
+    def _refuse_unavailable(self, ctx, names) -> None:
+        """Build-report casualties 409 from the router EXACTLY as from a
+        single server — same body shape, same reasons — before any
+        replica is touched (docs/robustness.md)."""
+        unavailable = self.catalog.unavailable_machines(ctx.collection_dir)
+        bad = {n: unavailable[n] for n in names if n in unavailable}
+        if bad:
+            raise ApiError(
+                {
+                    "error": "Machine(s) unavailable in this revision: "
+                    + ", ".join(
+                        f"{name} ({info['reason']})"
+                        for name, info in sorted(bad.items())
+                    ),
+                    "unavailable": bad,
+                },
+                409,
+            )
+
+    def _replica_call(
+        self,
+        rid: str,
+        base_url: str,
+        method: str,
+        path: str,
+        *,
+        params=None,
+        json_body=None,
+        files=None,
+        data=None,
+        headers=None,
+        span_name: str = "router.fanout",
+        span_attrs: typing.Optional[dict] = None,
+        parent_ctx=None,
+    ) -> requests.Response:
+        """One HTTP call to a replica under its span, through the chaos
+        seam, with passive health recording. Raises on transport errors
+        (recorded as failures); HTTP status handling is the caller's."""
+        with tracing.start_span(
+            span_name, parent=parent_ctx, replica=rid, **(span_attrs or {})
+        ) as span:
+            action = faults.replica_fault_action(rid)
+            if action is not None:
+                if action[0] == "die":
+                    self.health.record_failure(rid)
+                    span.set_status("error")
+                    raise requests.ConnectionError(
+                        f"injected replica death: {rid}"
+                    )
+                if action[0] == "slow":
+                    time.sleep(action[1])
+            send_headers = dict(headers or {})
+            send_headers.update(tracing.propagation_headers(span))
+            try:
+                resp = self.session.request(
+                    method,
+                    f"{base_url}{path}",
+                    params=params,
+                    json=json_body,
+                    files=files,
+                    data=data,
+                    headers=send_headers,
+                    timeout=self.replica_timeout_s,
+                )
+            except Exception:
+                self.health.record_failure(rid)
+                span.set_status("error")
+                raise
+            if resp.status_code >= 500 and resp.status_code != 503:
+                # 5xx (not a structured shed) counts against health too
+                self.health.record_failure(rid)
+            else:
+                self.health.record_success(rid)
+            span.set_attribute("status_code", resp.status_code)
+            return resp
+
+    # -- views: local (artifact-derived) -----------------------------------
+
+    def view_healthcheck(self, ctx, request) -> Response:
+        return Response("", 200)
+
+    def view_server_version(self, ctx, request) -> Response:
+        return _json_response({"version": __version__, "role": "router"})
+
+    def view_replicas(self, ctx, request) -> Response:
+        replicas, ring = self.routing_view()
+        return _json_response(
+            {
+                "replicas": replicas,
+                "vnodes": ring.vnodes,
+                "health": self.health.snapshot(),
+            }
+        )
+
+    def view_set_replicas(self, ctx, request) -> Response:
+        body = request.get_json(silent=True) or {}
+        replicas = body.get("replicas")
+        if not isinstance(replicas, dict) or not replicas:
+            return _json_response(
+                {"error": "Body must carry a non-empty 'replicas' mapping "
+                 "of id -> base URL."},
+                400,
+            )
+        self.set_replicas({str(k): str(v) for k, v in replicas.items()})
+        return self.view_replicas(ctx, request)
+
+    def view_healthz(self, ctx, request) -> Response:
+        """Router readiness: 503 + Retry-After while NO replica is
+        routable (nothing can be served) — partial fleets stay ready,
+        they just answer structured partials."""
+        replicas, _ = self.routing_view()
+        snapshot = self.health.snapshot()
+        routable = [r for r in replicas if self.health.routable(r)]
+        payload = {
+            "status": "ok" if routable else "no_replicas",
+            "replicas": snapshot,
+            "routable": len(routable),
+            "max_inflight": self.max_inflight,
+        }
+        if routable:
+            return _json_response(payload)
+        response = _json_response(payload, 503)
+        retry_in = [
+            s["retry_in_s"] for s in snapshot.values() if s["retry_in_s"] > 0
+        ]
+        response.headers["Retry-After"] = str(
+            round(min(retry_in), 2) if retry_in else 1.0
+        )
+        return response
+
+    def view_models(self, ctx, request, gordo_project: str) -> Response:
+        """The WHOLE collection's /models, derived from the shared
+        artifacts — what a client sees through the router is the union
+        of every replica's shard, regardless of which replicas are up."""
+        available = self.catalog.list_machines(ctx.collection_dir)
+        unavailable = self.catalog.unavailable_machines(ctx.collection_dir)
+        payload: typing.Dict[str, typing.Any] = {
+            "models": [m for m in available if m not in unavailable],
+        }
+        if unavailable:
+            payload["unavailable"] = unavailable
+        return _json_response(payload)
+
+    def view_revisions(self, ctx, request, gordo_project: str) -> Response:
+        parent = os.path.join(ctx.collection_dir, "..")
+        try:
+            available = [
+                name
+                for name in os.listdir(parent)
+                if not name.startswith(".")
+                and os.path.isdir(os.path.join(parent, name))
+                and not os.path.islink(os.path.join(parent, name))
+            ]
+        except FileNotFoundError:
+            available = [ctx.current_revision]
+        return _json_response(
+            {"latest": ctx.current_revision, "available-revisions": available}
+        )
+
+    def view_metadata(
+        self, ctx, request, gordo_project: str, gordo_name: str
+    ) -> Response:
+        """Metadata straight from the shared artifacts — it's a host-side
+        file read, so discovery keeps working for a shard whose every
+        replica is down (predictions are what failover is for). Stays
+        served for build casualties, the PR-4 discipline."""
+        from gordo_tpu.server import utils as server_utils
+
+        try:
+            metadata = server_utils.load_metadata(
+                ctx.collection_dir, gordo_name
+            )
+        except FileNotFoundError:
+            return _json_response(
+                {"error": f"Metadata for '{gordo_name}' not found"}, 404
+            )
+        env_var = self.config["MODEL_COLLECTION_DIR_ENV_VAR"]
+        return _json_response(
+            {
+                "gordo-server-version": __version__,
+                "metadata": metadata,
+                "env": {env_var: os.environ.get(env_var)},
+            }
+        )
+
+    # -- views: proxied ----------------------------------------------------
+
+    def view_proxy_get(
+        self, ctx, request, gordo_project: str, gordo_name: str
+    ) -> Response:
+        """Metadata/download-model: routed to the owner with failover.
+        Metadata stays served for build casualties (PR-4 discipline), so
+        no 409 pre-check here."""
+        replicas, ring = self.routing_view()
+        candidates, owner = self._candidates(gordo_name, ring, replicas)
+        if not candidates:
+            raise ApiError(
+                {
+                    "error": f"No replica available for machine "
+                    f"'{gordo_name}' (owner {owner or 'unknown'} and all "
+                    "successors ejected)",
+                    "retry_after_s": self._shard_retry_after([owner]),
+                },
+                503,
+            )
+        rid = candidates[0]
+        adopted = rid != owner
+        if adopted:
+            self._note_failover(owner, gordo_name, 1)
+        try:
+            resp = self._replica_call(
+                rid,
+                replicas[rid],
+                "GET",
+                request.path,
+                params=ctx.forward_params(request),
+                headers={ADOPT_HEADER: "failover"} if adopted else None,
+                span_name="router.failover" if adopted else "router.fanout",
+                span_attrs=(
+                    {"from_replica": owner, "machine": gordo_name}
+                    if adopted
+                    else {"machine": gordo_name}
+                ),
+                parent_ctx=tracing.current_context(),
+            )
+        except Exception as exc:
+            raise ApiError(
+                {
+                    "error": f"Replica {rid} failed for machine "
+                    f"'{gordo_name}': {exc}",
+                    "retry_after_s": self._shard_retry_after([rid]),
+                },
+                503,
+            )
+        return self._passthrough(resp)
+
+    @staticmethod
+    def _passthrough(resp: requests.Response) -> Response:
+        """A replica response forwarded verbatim (body + the headers
+        that matter; _finalize appends the router's own timing)."""
+        out = Response(
+            resp.content,
+            status=resp.status_code,
+            mimetype=(
+                resp.headers.get("Content-Type", "application/json").split(";")[0]
+            ),
+        )
+        for header in (
+            "revision",
+            "Retry-After",
+            "Server-Timing",
+            "Content-Disposition",
+        ):
+            value = resp.headers.get(header)
+            if value:
+                out.headers[header] = value
+        return out
+
+    def _note_failover(
+        self, from_replica: str, to_target: str, n_machines: int
+    ) -> None:
+        get_registry().counter(
+            "gordo_router_failovers_total",
+            "Shard calls re-routed off their ring owner",
+        ).inc()
+        emit_event(
+            "shard_failover",
+            from_replica=from_replica,
+            target=to_target,
+            n_machines=n_machines,
+        )
+
+    def _shard_retry_after(self, replicas: typing.List[str]) -> float:
+        """When the named replicas' ejection windows end — the honest
+        Retry-After for their shard's casualties."""
+        waits = [self.health.retry_after_s(r) for r in replicas if r]
+        return round(max(waits), 2) if any(waits) else 1.0
+
+    # -- views: single-machine prediction ----------------------------------
+
+    def view_single_prediction(
+        self, ctx, request, gordo_project: str, gordo_name: str
+    ) -> Response:
+        self._refuse_unavailable(ctx, [gordo_name])
+        self._admit()
+        started = timeit.default_timer()
+        try:
+            return self._single_prediction(ctx, request, gordo_name)
+        finally:
+            self._release(started)
+
+    def _single_prediction(self, ctx, request, gordo_name: str) -> Response:
+        replicas, ring = self.routing_view()
+        candidates, owner = self._candidates(gordo_name, ring, replicas)
+        if not candidates:
+            self._count_request("partial")
+            raise ApiError(
+                self._transient_unavailable_payload(
+                    {gordo_name: owner}, "every candidate replica is ejected"
+                ),
+                409,
+            )
+        rid = candidates[0]
+        adopted = rid != owner
+        if adopted:
+            self._note_failover(owner, gordo_name, 1)
+        headers = {}
+        if request.content_type:
+            headers["Content-Type"] = request.content_type
+        if adopted:
+            headers[ADOPT_HEADER] = "failover"
+        try:
+            resp = self._replica_call(
+                rid,
+                replicas[rid],
+                "POST",
+                request.path,
+                params=ctx.forward_params(request),
+                data=request.get_data(),
+                headers=headers,
+                span_name="router.failover" if adopted else "router.fanout",
+                span_attrs=(
+                    {"from_replica": owner, "machine": gordo_name}
+                    if adopted
+                    else {"machine": gordo_name}
+                ),
+                parent_ctx=tracing.current_context(),
+            )
+        except Exception as exc:
+            # the failure feeds the breaker; the machine comes back as a
+            # NAMED transient casualty, not an anonymous 500
+            self._count_request("partial")
+            raise ApiError(
+                self._transient_unavailable_payload(
+                    {gordo_name: owner},
+                    f"routed replica {rid} failed ({exc})",
+                ),
+                409,
+            )
+        if resp.status_code == 421:
+            # router/replica manifest drift (a membership change one
+            # side hasn't seen yet): one adopt retry against the same
+            # replica, exactly like the fleet path — drift must
+            # self-heal, not hard-fail single predictions
+            try:
+                resp = self._replica_call(
+                    rid,
+                    replicas[rid],
+                    "POST",
+                    request.path,
+                    params=ctx.forward_params(request),
+                    data=request.get_data(),
+                    headers={**headers, ADOPT_HEADER: "failover"},
+                    span_name="router.fanout",
+                    span_attrs={"machine": gordo_name, "adopt_retry": True},
+                    parent_ctx=tracing.current_context(),
+                )
+            except Exception as exc:
+                self._count_request("partial")
+                raise ApiError(
+                    self._transient_unavailable_payload(
+                        {gordo_name: owner},
+                        f"routed replica {rid} failed ({exc})",
+                    ),
+                    409,
+                )
+        # melting replica: propagate its structured 503 + Retry-After
+        # untouched (docs/serving.md#dynamic-batching) — no failover,
+        # the shed herd must not be sprayed onto its peers
+        if resp.status_code < 400:
+            self._count_request("ok")
+        elif resp.status_code == 503:
+            self._count_request("shed")
+        else:
+            self._count_request("refused")
+        return self._passthrough(resp)
+
+    def _transient_unavailable_payload(
+        self, machines_to_owner: typing.Dict[str, str], why: str
+    ) -> dict:
+        unavailable = {
+            name: {
+                "reason": "replica_unavailable",
+                "replica": owner,
+                "retry_after_s": self._shard_retry_after([owner]),
+            }
+            for name, owner in machines_to_owner.items()
+        }
+        return {
+            "error": "Machine(s) temporarily unroutable: "
+            + ", ".join(sorted(machines_to_owner))
+            + f" ({why})",
+            "unavailable": unavailable,
+            # the client maps a transient 409 to ReplicaUnavailable:
+            # recorded per machine, NOT permanent for the revision
+            "transient": True,
+            "retry_after_s": max(
+                info["retry_after_s"] for info in unavailable.values()
+            ),
+        }
+
+    # -- views: fleet fan-out ----------------------------------------------
+
+    def view_fleet_prediction(
+        self, ctx, request, gordo_project: str
+    ) -> Response:
+        anomaly = "/anomaly/" in request.path
+        machines = GordoApp._fleet_request_machines(request, anomaly=anomaly)
+        if machines is None:
+            return _json_response(
+                {"error": "Body must contain a non-empty 'machines' mapping."},
+                400,
+            )
+        names = tuple(sorted(machines))
+        self._refuse_unavailable(ctx, names)
+        self._admit()
+        started = timeit.default_timer()
+        try:
+            return self._fleet_fanout(ctx, request, machines, anomaly)
+        finally:
+            self._release(started)
+
+    def _fleet_fanout(
+        self, ctx, request, machines: dict, anomaly: bool
+    ) -> Response:
+        replicas, ring = self.routing_view()
+        # route every machine BEFORE any network call: machines with no
+        # routable candidate 409 immediately (transient, named), so the
+        # client re-POSTs the healthy remainder without any shard's work
+        # being computed and thrown away. The routable set is computed
+        # ONCE (one health-lock pass over N replicas) — the per-machine
+        # work is a single ring bisect in the all-healthy common case,
+        # with the full preference walk only for orphaned machines.
+        routable = {r for r in replicas if self.health.routable(r)}
+        shards: typing.Dict[str, typing.List[str]] = {}
+        owners: typing.Dict[str, str] = {}
+        dead: typing.Dict[str, str] = {}
+        for name in sorted(machines):
+            owner = ring.owner(name)
+            owners[name] = owner
+            if owner in routable:
+                shards.setdefault(owner, []).append(name)
+                continue
+            successor = next(
+                (r for r in ring.preference(name) if r in routable), None
+            )
+            if successor is None:
+                dead[name] = owner
+            else:
+                shards.setdefault(successor, []).append(name)
+        if dead:
+            self._count_request("partial")
+            raise ApiError(
+                self._transient_unavailable_payload(
+                    dead, "every candidate replica is ejected"
+                ),
+                409,
+            )
+        # routing off an ejected owner IS the failover — record it even
+        # though no call to the dead owner is ever attempted, per TRUE
+        # owner (one successor may absorb machines from several ejected
+        # owners; each outage must show its own losses)
+        for rid, group in sorted(shards.items()):
+            moved_by_owner: typing.Dict[str, int] = {}
+            for m in group:
+                if owners[m] != rid:
+                    moved_by_owner[owners[m]] = (
+                        moved_by_owner.get(owners[m], 0) + 1
+                    )
+            for owner, n_moved in sorted(moved_by_owner.items()):
+                self._note_failover(owner, rid, n_moved)
+
+        parent_ctx = tracing.current_context()
+        params = ctx.forward_params(request)
+        ordered = sorted(shards.items())
+        results: typing.List[_ShardResult] = []
+        if len(ordered) == 1:
+            rid, group = ordered[0]
+            results.append(
+                self._call_shard(
+                    rid, group, owners, machines, anomaly, request, params,
+                    replicas, ring, parent_ctx,
+                )
+            )
+        elif ordered:
+            with ThreadPoolExecutor(max_workers=len(ordered)) as pool:
+                futures = [
+                    pool.submit(
+                        self._call_shard,
+                        rid, group, owners, machines, anomaly, request,
+                        params, replicas, ring, parent_ctx,
+                    )
+                    for rid, group in ordered
+                ]
+                results = [f.result() for f in futures]
+        return self._join_fleet_results(ctx, ordered, owners, results)
+
+    def _shard_body(
+        self,
+        group: typing.List[str],
+        machines: dict,
+        anomaly: bool,
+        request: Request,
+    ) -> typing.Tuple[typing.Optional[dict], typing.Optional[dict]]:
+        """(json_body, files) for the sub-request carrying ``group``'s
+        payloads — same JSON/multipart duality as the server surface."""
+        if request.files:
+            files: typing.Dict[str, bytes] = {}
+            for name in group:
+                raw = machines[name]
+                if anomaly:
+                    files[f"{name}.X"] = raw["X"]
+                    files[f"{name}.y"] = raw["y"]
+                else:
+                    files[name] = raw
+            return None, files
+        return {"machines": {name: machines[name] for name in group}}, None
+
+    def _call_shard(
+        self,
+        rid: str,
+        group: typing.List[str],
+        owners: typing.Dict[str, str],
+        machines: dict,
+        anomaly: bool,
+        request: Request,
+        params: dict,
+        replicas: typing.Dict[str, str],
+        ring: HashRing,
+        parent_ctx,
+    ) -> _ShardResult:
+        """
+        One shard's sub-request to its routed replica (with bounded
+        hedging to the next routable successor for stragglers). A
+        transport failure here is NOT retried elsewhere mid-request: it
+        feeds the circuit breaker (driving ejection, after which routing
+        re-partitions the shard pre-fanout) and the shard's machines
+        come back as NAMED transient casualties — the structured partial
+        the client's per-machine error channel absorbs. One failed
+        request costs one named partial; it never cascades into
+        doubled load on the survivors.
+        """
+        json_body, files = self._shard_body(group, machines, anomaly, request)
+        # the adopt header tells a sharded replica these machines are
+        # routed to it ON PURPOSE (failover off an ejected owner, or a
+        # hedge): needed whenever any machine isn't ring-owned by the
+        # callee
+        failover_from = next(
+            (owners[m] for m in group if owners[m] != rid), None
+        )
+
+        def attempt(replica: str, adopted: bool, hedge: bool = False):
+            from_owner = failover_from if replica == rid else rid
+            span_name = (
+                "router.failover"
+                if (adopted and not hedge)
+                else "router.fanout"
+            )
+            attrs: typing.Dict[str, typing.Any] = {"n_machines": len(group)}
+            if adopted and not hedge and from_owner:
+                attrs["from_replica"] = from_owner
+            if hedge:
+                attrs["hedge"] = True
+            resp = self._replica_call(
+                replica,
+                replicas[replica],
+                "POST",
+                request.path,
+                params=params,
+                json_body=json_body,
+                files=files,
+                headers={ADOPT_HEADER: "failover"} if adopted else None,
+                span_name=span_name,
+                span_attrs=attrs,
+                parent_ctx=parent_ctx,
+            )
+            return self._classify_shard_response(replica, resp)
+
+        adopted = failover_from is not None
+        # the successor walk costs a ring scan + health-lock hits: only
+        # pay it when hedging can actually use the candidate
+        hedge_candidate = (
+            next(
+                (
+                    r
+                    for r in ring.preference(group[0])
+                    if r in replicas and r != rid and self.health.routable(r)
+                ),
+                None,
+            )
+            if self.hedge_s > 0
+            else None
+        )
+        try:
+            if self.hedge_s > 0 and hedge_candidate is not None:
+                result = self._hedged_attempt(
+                    attempt, rid, hedge_candidate, adopted
+                )
+            else:
+                result = attempt(rid, adopted)
+        except Exception as exc:
+            return _ShardResult("error", rid, payload=str(exc))
+        if result.kind == "wrong_shard":
+            # membership drift between router and replica manifest:
+            # one adopt retry against the same replica
+            try:
+                result = attempt(rid, True)
+            except Exception as exc:
+                return _ShardResult("error", rid, payload=str(exc))
+            if result.kind == "wrong_shard":
+                return _ShardResult(
+                    "error", rid, payload="replica refuses shard even "
+                    "with adopt header (manifest drift)"
+                )
+        return result
+
+    def _hedged_attempt(
+        self, attempt, primary: str, backup: str, adopted: bool
+    ) -> _ShardResult:
+        """Bounded hedging: ONE extra copy of a straggling shard call to
+        the next routable successor; first completion wins, the loser is
+        discarded (predictions are idempotent). The pool is shut down
+        without waiting — the straggler finishes in the background
+        instead of holding the response hostage."""
+        pool = ThreadPoolExecutor(max_workers=2)
+        try:
+            first = pool.submit(attempt, primary, adopted)
+            try:
+                return first.result(timeout=self.hedge_s)
+            except FutureTimeout:
+                pass
+            get_registry().counter(
+                "gordo_router_hedges_total",
+                "Hedge requests fired for straggling shard calls",
+            ).inc()
+            second = pool.submit(attempt, backup, True, True)
+            pending = {first, second}
+            last_exc: typing.Optional[BaseException] = None
+            last_result: typing.Optional[_ShardResult] = None
+            while pending:
+                done, pending = futures_wait(
+                    pending, return_when=FIRST_COMPLETED
+                )
+                # both copies may land in ONE round: scan the whole done
+                # set for a success before settling for a non-ok result
+                for future in done:
+                    exc = future.exception()
+                    if exc is not None:
+                        last_exc = exc
+                        continue
+                    result = future.result()
+                    if result.kind == "ok":
+                        return result
+                    # non-ok (shed, refused): prefer waiting for the
+                    # other copy — it may still succeed
+                    last_result = result
+            if last_result is not None:
+                return last_result
+            if last_exc is not None:
+                raise last_exc
+            raise RuntimeError("hedged attempt yielded no result")
+        finally:
+            pool.shutdown(wait=False)
+
+    def _classify_shard_response(
+        self, rid: str, resp: requests.Response
+    ) -> _ShardResult:
+        if 200 <= resp.status_code < 300:
+            try:
+                payload = resp.json()
+            except ValueError:
+                return _ShardResult(
+                    "error", rid, payload="unparseable replica response"
+                )
+            return _ShardResult("ok", rid, payload=payload)
+        if resp.status_code == 503:
+            retry_after = resp.headers.get("Retry-After")
+            try:
+                retry_after_s = float(retry_after) if retry_after else 1.0
+            except ValueError:
+                retry_after_s = 1.0
+            return _ShardResult(
+                "overloaded", rid, retry_after=retry_after_s
+            )
+        if resp.status_code == 421:
+            return _ShardResult("wrong_shard", rid)
+        if resp.status_code == 409:
+            try:
+                detail = resp.json().get("unavailable") or {}
+            except ValueError:
+                detail = {}
+            return _ShardResult("unavailable", rid, payload=detail)
+        body: typing.Any
+        try:
+            body = resp.json()
+        except ValueError:
+            body = {"error": resp.text[:500]}
+        return _ShardResult(
+            "refused", rid, payload=body, status=resp.status_code
+        )
+
+    def _join_fleet_results(
+        self,
+        ctx,
+        ordered: typing.List[typing.Tuple[str, typing.List[str]]],
+        owners: typing.Dict[str, str],
+        results: typing.List[_ShardResult],
+    ) -> Response:
+        """Re-join the shard outcomes into ONE response with the single-
+        server contract: 200 merged data, or the most actionable
+        structured error (503 shed > hard 4xx > named 409 casualties).
+        ``ordered`` is the exact (replica, group) submission list the
+        ``results`` were produced from — positional, so result-to-shard
+        attribution cannot drift with scheduling changes."""
+        overloaded = [r for r in results if r.kind == "overloaded"]
+        if overloaded:
+            # a melting shard: propagate the shed — the client's backoff
+            # (jittered Retry-After) already knows what to do with it,
+            # and answering partial data instead would hide the pressure
+            self._count_request("shed")
+            response = _json_response(
+                {
+                    "error": "Replica(s) shedding load: "
+                    + ", ".join(sorted(r.replica for r in overloaded)),
+                    "retry_after_s": max(r.retry_after for r in overloaded),
+                },
+                503,
+            )
+            response.headers["Retry-After"] = str(
+                max(r.retry_after for r in overloaded)
+            )
+            return response
+        refused = [r for r in results if r.kind == "refused"]
+        if refused:
+            # a deterministic 4xx (422 mixed group, bad input): repeatable,
+            # so propagate the first — the client's fallback logic applies
+            first = sorted(refused, key=lambda r: r.replica)[0]
+            self._count_request("refused")
+            return _json_response(first.payload, first.status)
+
+        merged_data: typing.Dict[str, typing.Any] = {}
+        casualties: typing.Dict[str, dict] = {}
+        all_transient = True
+        for result, (rid, group) in zip(results, ordered):
+            if result.kind == "ok":
+                merged_data.update(result.payload.get("data") or {})
+            elif result.kind == "unavailable":
+                # replica-level 409 (its build-report view named
+                # casualties the router's didn't): preserve reasons
+                for name, info in (result.payload or {}).items():
+                    casualties[name] = info
+                    all_transient = False
+            else:  # error: the whole shard is a transient casualty
+                for name in group:
+                    casualties[name] = {
+                        "reason": "replica_unavailable",
+                        "replica": owners.get(name, rid),
+                        "retry_after_s": self._shard_retry_after(
+                            [owners.get(name, rid)]
+                        ),
+                    }
+        if casualties:
+            payload: typing.Dict[str, typing.Any] = {
+                "error": "Machine(s) unavailable: "
+                + ", ".join(sorted(casualties)),
+                "unavailable": casualties,
+            }
+            if all_transient:
+                payload["transient"] = True
+                payload["retry_after_s"] = max(
+                    info.get("retry_after_s", 1.0)
+                    for info in casualties.values()
+                )
+            self._count_request("partial")
+            raise ApiError(payload, 409)
+        self._count_request("ok")
+        return _json_response(
+            {
+                "data": merged_data,
+                "time-seconds": (
+                    f"{timeit.default_timer() - ctx.start_time:.4f}"
+                ),
+            }
+        )
+
+
+def parse_replica_entries(
+    entries: typing.Iterable[str],
+) -> typing.Dict[str, str]:
+    """
+    The ONE parser for ``id=url`` replica entries (each entry may itself
+    be a comma-separated list — the env-var form). Shared by the CLI and
+    the env fallback so both reject the same malformed input at startup
+    instead of hashing machines onto an empty-string replica at request
+    time.
+    """
+    replicas: typing.Dict[str, str] = {}
+    flat: typing.List[str] = []
+    for item in entries:
+        flat.extend(p for p in str(item).split(",") if p.strip())
+    for entry in flat:
+        rid, sep, url = entry.strip().partition("=")
+        rid, url = rid.strip(), url.strip().rstrip("/")
+        if not sep or not rid or not url:
+            raise ValueError(
+                f"Replica entries must be id=url, got {entry!r}"
+            )
+        replicas[rid] = url
+    return replicas
+
+
+def build_router_app(config: typing.Optional[dict] = None) -> RouterApp:
+    """Build the router WSGI app (env fallbacks mirror build_app)."""
+    config = dict(config or {})
+    if "REPLICAS" not in config and os.environ.get("GORDO_ROUTER_REPLICAS"):
+        # "r0=http://h0:5555,r1=http://h1:5555"
+        config["REPLICAS"] = parse_replica_entries(
+            [os.environ["GORDO_ROUTER_REPLICAS"]]
+        )
+    for key, env, cast in (
+        ("VNODES", "GORDO_ROUTER_VNODES", int),
+        ("EJECT_AFTER", "GORDO_ROUTER_EJECT_AFTER", int),
+        ("BACKOFF_SCALE", "GORDO_ROUTER_BACKOFF_SCALE", float),
+        ("PROBE_INTERVAL_S", "GORDO_ROUTER_PROBE_INTERVAL_S", float),
+        ("HEDGE_MS", "GORDO_ROUTER_HEDGE_MS", float),
+        ("REPLICA_TIMEOUT_S", "GORDO_ROUTER_REPLICA_TIMEOUT_S", float),
+        ("MAX_INFLIGHT", "GORDO_ROUTER_MAX_INFLIGHT", int),
+    ):
+        if key not in config and os.environ.get(env):
+            config[key] = cast(os.environ[env])
+    return RouterApp(config)
+
+
+def run_router(
+    host: str,
+    port: int,
+    log_level: str = "info",
+    config: typing.Optional[dict] = None,
+    threads: typing.Optional[int] = None,
+):
+    """Serve the router under the native runner (one process — the
+    router holds no device, so scale-out is more routers behind a plain
+    L4 balancer; see docs/serving.md)."""
+    import logging as _logging
+
+    from gordo_tpu.server.runner import ServerRunner
+
+    _logging.getLogger("werkzeug").setLevel(log_level.upper())
+    ServerRunner(
+        app_factory=lambda: build_router_app(config),
+        host=host,
+        port=port,
+        workers=1,
+        threads=threads if threads is not None else 32,
+    ).serve_forever()

@@ -1,0 +1,273 @@
+"""
+Per-replica health: the circuit breaker the router routes through.
+
+State machine (docs/serving.md "Sharded serving plane"):
+
+- ``healthy`` — routable. ``eject_after`` CONSECUTIVE failures (passive:
+  request outcomes; active: failed ``/healthz`` probes) eject it.
+- ``ejected`` — not routable; its shard re-routes to ring successors.
+  The ejection window is the house retry policy
+  (:func:`gordo_tpu.client.utils.backoff_seconds`, jittered so N routers
+  watching one dead replica don't re-probe in lockstep), scaled by
+  ``backoff_scale`` and escalating with consecutive ejections.
+- ``probation`` — half-open: the window expired (and, when active
+  probing is on, a ``/healthz`` probe succeeded), so the replica is
+  routable again but on thin ice — the FIRST failure re-ejects with an
+  escalated window, the first success closes the breaker back to
+  ``healthy`` and emits ``replica_recovered``.
+
+Passive outcomes drive everything; the active prober (router/app.py's
+probe loop) only shortens the ejected->probation leg, so the tracker
+works identically with probing disabled (tests, single-shot tools).
+"""
+
+import threading
+import time
+import typing
+
+from gordo_tpu.client.utils import DEFAULT_RETRY_JITTER, backoff_seconds
+from gordo_tpu.observability import emit_event, get_registry
+
+HEALTHY = "healthy"
+EJECTED = "ejected"
+PROBATION = "probation"
+
+
+def _healthy_gauge():
+    return get_registry().gauge(
+        "gordo_router_replica_healthy",
+        "1 while the router considers the replica routable "
+        "(healthy/probation), 0 while ejected",
+        ("replica",),
+    )
+
+
+class _ReplicaState:
+    __slots__ = (
+        "state", "consecutive_failures", "ejections", "eject_until",
+    )
+
+    def __init__(self):
+        self.state = HEALTHY
+        self.consecutive_failures = 0
+        #: consecutive ejections without an intervening recovery — the
+        #: backoff escalation counter, reset on recovery
+        self.ejections = 0
+        self.eject_until = 0.0
+
+
+class ReplicaHealthTracker:
+    """
+    Thread-safe health state for a fixed set of replica ids.
+
+    ``backoff_scale`` maps the house 8/16/32s… schedule onto serving
+    failover timescales (scale 0.25 -> 2/4/8s); ``now`` is injectable
+    for deterministic tests.
+    """
+
+    def __init__(
+        self,
+        replicas: typing.Iterable[str],
+        eject_after: int = 3,
+        backoff_scale: float = 0.25,
+        lazy_half_open: bool = True,
+        now: typing.Callable[[], float] = time.monotonic,
+    ):
+        self.eject_after = max(1, int(eject_after))
+        self.backoff_scale = float(backoff_scale)
+        #: with an ACTIVE prober (router/app.py), window expiry alone
+        #: must not re-admit a dead replica to live traffic — the probe
+        #: owns the ejected->probation leg, so one dead replica costs
+        #: probes, not a user-visible casualty per window. Without a
+        #: prober (lazy_half_open=True), expiry IS the half-open
+        #: mechanism and live traffic takes the probe's role.
+        self.lazy_half_open = bool(lazy_half_open)
+        self._now = now
+        self._lock = threading.Lock()
+        self._states: typing.Dict[str, _ReplicaState] = {}
+        gauge = _healthy_gauge()
+        for replica in replicas:
+            self._states[replica] = _ReplicaState()
+            gauge.set(1, replica=replica)
+
+    # -- membership --------------------------------------------------------
+
+    def ensure(self, replicas: typing.Iterable[str]) -> None:
+        """Track any new replica ids (membership change: adopt). Known
+        ids keep their current state — re-adding a live replica must not
+        amnesty an open breaker."""
+        with self._lock:
+            fresh = [r for r in replicas if r not in self._states]
+            for replica in fresh:
+                self._states[replica] = _ReplicaState()
+        for replica in fresh:
+            _healthy_gauge().set(1, replica=replica)
+
+    def forget(self, replica: str) -> None:
+        """Drop a replica removed from membership (drain): its state and
+        its gauge series go away — a decommissioned replica must not
+        haunt /healthz snapshots and dashboards as permanently unhealthy.
+        In-flight requests still finishing against it no-op harmlessly
+        (record_* tolerate unknown ids)."""
+        with self._lock:
+            self._states.pop(replica, None)
+        _healthy_gauge().remove(replica=replica)
+
+    # -- queries -----------------------------------------------------------
+
+    def state(self, replica: str) -> str:
+        with self._lock:
+            entry = self._states.get(replica)
+            if entry is None:
+                return EJECTED
+            flipped = self._maybe_expire(replica, entry)
+            state = entry.state
+        if flipped:
+            _healthy_gauge().set(1, replica=replica)
+        return state
+
+    def routable(self, replica: str) -> bool:
+        """Healthy or half-open — the router may send it real traffic."""
+        return self.state(replica) != EJECTED
+
+    def probe_due(self, replica: str) -> bool:
+        """Ejected AND past its window: the active prober should ask
+        ``/healthz`` now (with probing disabled, :meth:`state` flips the
+        same replicas straight to probation lazily)."""
+        with self._lock:
+            entry = self._states.get(replica)
+            return (
+                entry is not None
+                and entry.state == EJECTED
+                and self._now() >= entry.eject_until
+            )
+
+    def snapshot(self) -> typing.Dict[str, dict]:
+        """Per-replica state for /healthz bodies and --status output."""
+        out: typing.Dict[str, dict] = {}
+        flipped: typing.List[str] = []
+        with self._lock:
+            for replica, entry in self._states.items():
+                if self._maybe_expire(replica, entry):
+                    flipped.append(replica)
+                out[replica] = {
+                    "state": entry.state,
+                    "consecutive_failures": entry.consecutive_failures,
+                    "ejections": entry.ejections,
+                    "retry_in_s": (
+                        round(max(0.0, entry.eject_until - self._now()), 3)
+                        if entry.state == EJECTED
+                        else 0.0
+                    ),
+                }
+        for replica in flipped:
+            _healthy_gauge().set(1, replica=replica)
+        return out
+
+    def retry_after_s(self, replica: str) -> float:
+        """Seconds until the replica's ejection window expires (0 when
+        routable) — the Retry-After hint for its shard's casualties."""
+        with self._lock:
+            entry = self._states.get(replica)
+            if entry is None or entry.state != EJECTED:
+                return 0.0
+            return max(0.0, entry.eject_until - self._now())
+
+    # -- transitions -------------------------------------------------------
+
+    def record_success(self, replica: str, via: str = "request") -> None:
+        recovered = False
+        with self._lock:
+            entry = self._states.get(replica)
+            if entry is None:
+                return
+            self._maybe_expire(replica, entry)
+            entry.consecutive_failures = 0
+            if entry.state == PROBATION:
+                entry.state = HEALTHY
+                entry.ejections = 0
+                recovered = True
+            elif entry.state == EJECTED:
+                # a success against an ejected replica (a probe racing
+                # the window, or a hedge that landed): close it directly
+                entry.state = HEALTHY
+                entry.ejections = 0
+                recovered = True
+        if recovered:
+            _healthy_gauge().set(1, replica=replica)
+            emit_event("replica_recovered", replica=replica, via=via)
+
+    def record_failure(self, replica: str, via: str = "request") -> bool:
+        """One failed call/probe; returns True when this one ejected."""
+        ejected_now = False
+        backoff = 0.0
+        failures = 0
+        with self._lock:
+            entry = self._states.get(replica)
+            if entry is None:
+                return False
+            self._maybe_expire(replica, entry)
+            entry.consecutive_failures += 1
+            failures = entry.consecutive_failures
+            should_eject = (
+                entry.state == PROBATION  # half-open: one strike
+                or failures >= self.eject_after
+            )
+            if should_eject and entry.state != EJECTED:
+                entry.state = EJECTED
+                entry.ejections += 1
+                backoff = (
+                    backoff_seconds(
+                        entry.ejections, jitter=DEFAULT_RETRY_JITTER
+                    )
+                    * self.backoff_scale
+                )
+                entry.eject_until = self._now() + backoff
+                ejected_now = True
+        if ejected_now:
+            _healthy_gauge().set(0, replica=replica)
+            emit_event(
+                "replica_ejected",
+                replica=replica,
+                via=via,
+                consecutive_failures=failures,
+                backoff_s=round(backoff, 3),
+            )
+        return ejected_now
+
+    def note_probe(self, replica: str, ok: bool) -> None:
+        """An active /healthz probe outcome. Success moves an expired
+        ejection to probation (half-open) rather than straight to
+        healthy: real traffic gets the final vote."""
+        if not ok:
+            self.record_failure(replica, via="probe")
+            return
+        with self._lock:
+            entry = self._states.get(replica)
+            if entry is None:
+                return
+            if entry.state == EJECTED and self._now() >= entry.eject_until:
+                entry.state = PROBATION
+                entry.consecutive_failures = 0
+        # probation is routable: reflect it on the gauge (recovery event
+        # waits for the first real-traffic success)
+        if self.state(replica) == PROBATION:
+            _healthy_gauge().set(1, replica=replica)
+
+    # -- internals ---------------------------------------------------------
+
+    def _maybe_expire(self, replica: str, entry: _ReplicaState) -> bool:
+        """Lazy ejected->probation flip once the window passed (caller
+        holds the lock; returns True on flip so the caller can refresh
+        the gauge outside it). Disabled under active probing — the probe
+        owns this transition there; without one it IS the half-open
+        mechanism."""
+        if (
+            self.lazy_half_open
+            and entry.state == EJECTED
+            and self._now() >= entry.eject_until
+        ):
+            entry.state = PROBATION
+            entry.consecutive_failures = 0
+            return True
+        return False

@@ -1,0 +1,113 @@
+"""
+Consistent hashing of machine names onto replica ids.
+
+Why a ring and not ``hash(name) % N``: membership changes. When a
+replica is added or removed, modulo hashing reassigns ~all machines —
+every replica's preloaded param stacks and AOT-warmed programs
+(docs/performance.md) are invalidated at once. On the ring, a one-replica
+change moves only ~1/N of the machines (pinned by
+tests/test_router.py's stability property test), so N-1 replicas keep
+serving exactly what they already have resident.
+
+Determinism: points come from md5 (stable across processes, platforms
+and PYTHONHASHSEED), so a router and every replica — given the same
+``(replicas, vnodes)`` shard manifest — independently compute the SAME
+owner for every machine. There is no shard-assignment state to
+distribute; the manifest IS the shard map.
+"""
+
+import bisect
+import hashlib
+import typing
+
+#: virtual nodes per replica: enough that machine counts per replica
+#: concentrate near fair share (spread shrinks ~1/sqrt(vnodes)) while a
+#: whole ring for tens of replicas still builds in microseconds
+DEFAULT_VNODES = 64
+
+
+def _hash64(value: str) -> int:
+    """First 8 bytes of md5 as an int — the ring's point space."""
+    return int.from_bytes(
+        hashlib.md5(value.encode("utf-8")).digest()[:8], "big"
+    )
+
+
+class HashRing:
+    """
+    An immutable consistent-hash ring over replica ids.
+
+    Each replica owns ``vnodes`` points at ``md5("<replica>#<i>")``; a
+    machine name hashes to a point and is owned by the first replica
+    point at or after it (wrapping). Immutability is deliberate:
+    membership changes swap in a NEW ring (router/app.py holds the
+    reference), so an in-flight fanout keeps routing against the ring it
+    started with — drain/adopt without dropping requests.
+
+    >>> ring = HashRing(["r0", "r1", "r2"])
+    >>> ring.owner("some-machine") in {"r0", "r1", "r2"}
+    True
+    >>> ring.owner("some-machine") == HashRing(["r2", "r1", "r0"]).owner(
+    ...     "some-machine")  # membership order is irrelevant
+    True
+    """
+
+    def __init__(
+        self,
+        replicas: typing.Sequence[str],
+        vnodes: int = DEFAULT_VNODES,
+    ):
+        if not replicas:
+            raise ValueError("HashRing needs at least one replica id")
+        if len(set(replicas)) != len(replicas):
+            raise ValueError(f"Duplicate replica ids: {sorted(replicas)}")
+        if vnodes < 1:
+            raise ValueError(f"vnodes must be >= 1, got {vnodes}")
+        self.replicas: typing.Tuple[str, ...] = tuple(sorted(replicas))
+        self.vnodes = int(vnodes)
+        points: typing.List[typing.Tuple[int, str]] = []
+        for replica in self.replicas:
+            for i in range(self.vnodes):
+                points.append((_hash64(f"{replica}#{i}"), replica))
+        points.sort()
+        self._points = [p for p, _ in points]
+        self._owners = [r for _, r in points]
+
+    def owner(self, machine_name: str) -> str:
+        """The replica owning ``machine_name``."""
+        index = bisect.bisect_right(self._points, _hash64(machine_name))
+        return self._owners[index % len(self._owners)]
+
+    def preference(self, machine_name: str) -> typing.List[str]:
+        """
+        Every replica in ring order from the machine's point: element 0
+        is the owner, the rest are its failover successors — the order
+        an ejected owner's shard re-routes in (docs/serving.md).
+        """
+        start = bisect.bisect_right(self._points, _hash64(machine_name))
+        seen: typing.Set[str] = set()
+        ordered: typing.List[str] = []
+        n = len(self._owners)
+        for step in range(n):
+            replica = self._owners[(start + step) % n]
+            if replica not in seen:
+                seen.add(replica)
+                ordered.append(replica)
+                if len(ordered) == len(self.replicas):
+                    break
+        return ordered
+
+    def shard(
+        self, machine_names: typing.Iterable[str], replica: str
+    ) -> typing.Set[str]:
+        """The subset of ``machine_names`` owned by ``replica``."""
+        return {m for m in machine_names if self.owner(m) == replica}
+
+    def partition(
+        self, machine_names: typing.Iterable[str]
+    ) -> typing.Dict[str, typing.List[str]]:
+        """owner replica -> sorted machines, only non-empty shards."""
+        shards: typing.Dict[str, typing.List[str]] = {}
+        for name in machine_names:
+            shards.setdefault(self.owner(name), []).append(name)
+        return {r: sorted(ms) for r, ms in shards.items()}

@@ -46,19 +46,17 @@ from gordo_tpu import __version__, serializer
 from gordo_tpu.data.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
 from gordo_tpu.observability import emit_event, get_registry, tracing
-from gordo_tpu.programs import evict_lru, open_store, serving_program_cache
-from gordo_tpu.programs import store as programs_store
-from gordo_tpu.programs import hbm_headroom as programs_headroom
 from gordo_tpu.robustness import faults
 from gordo_tpu.server import batching, model_io
 from gordo_tpu.server import utils as server_utils
+from gordo_tpu.server.catalog import (
+    ADOPT_HEADER,
+    ServingCatalog,
+    ShardSpec,
+    resolve_sibling_revision,
+)
 from gordo_tpu.server.utils import ApiError
 from gordo_tpu.utils.compat import normalize_frequency
-
-#: casualty record the fleet builder persists next to the artifacts
-#: (gordo_tpu.builder.fleet_build.BUILD_REPORT_FILENAME — name duplicated
-#: here so the server never imports the builder stack)
-BUILD_REPORT_FILENAME = "build_report.json"
 
 logger = logging.getLogger(__name__)
 
@@ -98,6 +96,15 @@ class Config:
     #: everything — the cold-start benchmark's control arm
     #: (GORDO_AOT_CACHE).
     AOT_CACHE = True
+    #: sharded serving plane (docs/serving.md): path of the shard
+    #: manifest naming the replica set this process serves a shard of;
+    #: None (default) = the historical whole-collection replica.
+    #: Env fallback (GORDO_SHARD_MANIFEST) applied in build_app; CLI:
+    #: run-server --shard-manifest.
+    SHARD_MANIFEST: typing.Optional[str] = None
+    #: this replica's id on the ring; overrides the manifest's own
+    #: (GORDO_REPLICA_ID / run-server --replica-id)
+    REPLICA_ID: typing.Optional[str] = None
 
     def to_dict(self) -> dict:
         return {
@@ -219,28 +226,33 @@ class GordoApp:
             ],
             strict_slashes=False,
         )
-        # (collection_dir, machine-name tuple) -> (FleetScorer, prefixes, fallback)
-        self._fleet_scorers: typing.Dict[tuple, tuple] = {}
-        self._fleet_scorers_lock = threading.Lock()
-        # dynamic batching (docs/serving.md#dynamic-batching): one
-        # RequestBatcher per fleet-scorer key, created lazily and ONLY
-        # when BATCH_WAIT_MS > 0 — the disabled path never touches this
+        # the serving catalog owns the collection-resolution caches —
+        # fleet scorers, batchers, AOT program stores, build reports —
+        # and (when a shard manifest is configured) the subset of
+        # machines this replica serves (docs/serving.md "Sharded
+        # serving plane")
         self.batch_wait_s = float(self.config.get("BATCH_WAIT_MS") or 0.0) / 1000.0
         self.batch_queue_limit = int(self.config.get("BATCH_QUEUE_LIMIT") or 64)
-        self._batchers: typing.Dict[tuple, batching.RequestBatcher] = {}
-        self._batchers_lock = threading.Lock()
-        #: CPU/null-device count bound for the scorer/batcher LRUs; on
-        #: devices with memory stats the HBM headroom governs instead
         self.scorer_cache_size = int(self.config.get("SCORER_CACHE_SIZE") or 16)
         self.aot_cache_enabled = bool(self.config.get("AOT_CACHE", True))
-        # realpath(collection dir) -> opened ProgramStore (or None:
-        # absent/incompatible — retrace); opened once per revision dir
-        self._program_stores: typing.Dict[str, typing.Any] = {}
-        self._program_stores_lock = threading.Lock()
-        # build_report.json path -> (mtime, parsed report): the degraded-
-        # serving source of truth (which machines to 409)
-        self._build_reports: typing.Dict[str, tuple] = {}
-        self._build_reports_lock = threading.Lock()
+        shard = None
+        if self.config.get("SHARD_MANIFEST"):
+            shard = ShardSpec.load(
+                self.config["SHARD_MANIFEST"],
+                replica_id=self.config.get("REPLICA_ID") or None,
+            )
+            logger.info(
+                "Serving shard %s of replica set %s",
+                shard.replica_id,
+                list(shard.ring.replicas),
+            )
+        self.catalog = ServingCatalog(
+            scorer_cache_size=self.scorer_cache_size,
+            aot_cache=self.aot_cache_enabled,
+            batch_wait_s=self.batch_wait_s,
+            batch_queue_limit=self.batch_queue_limit,
+            shard=shard,
+        )
         # hot promotion (docs/lifecycle.md): the real path last served as
         # "latest". When MODEL_COLLECTION_DIR is a `latest` symlink and a
         # lifecycle promotion re-points it, the first request after the
@@ -385,36 +397,17 @@ class GordoApp:
         ctx.current_revision = os.path.basename(ctx.collection_dir)
         requested = request.args.get("revision") or request.headers.get("revision")
         if requested:
-            # dot entries are NOT revisions: in-flight/torn promotion
-            # staging dirs and lifecycle state live there, and serving a
-            # half-copied staging dir would break the torn-promotion
-            # invariant (lifecycle/promote.py). Same 410 as a gone
-            # revision — the name is never servable. "." and ".." would
-            # otherwise alias the live revision / the parent itself.
-            if requested.startswith(".") or "/" in requested or "\\" in requested:
+            # the shared name policy (catalog.resolve_sibling_revision):
+            # dot staging dirs, traversal names, the `latest` symlink
+            # alias and loose sibling files all answer the same 410 a
+            # gone revision does — the name is never servable
+            resolved = resolve_sibling_revision(ctx.collection_dir, requested)
+            if resolved is None:
                 return _json_response(
                     {"error": f"Revision '{requested}' not found."}, 410
                 )
             ctx.revision = requested
-            ctx.collection_dir = os.path.join(ctx.collection_dir, "..", requested)
-            # a symlink sibling (the `latest` pointer) is an ALIAS, not
-            # a revision: serving it would key the model caches on the
-            # constant alias path, so routes would keep serving the old
-            # target after a promotion re-points it while stamping a
-            # meaningless "latest" revision header
-            if os.path.islink(ctx.collection_dir):
-                return _json_response(
-                    {"error": f"Revision '{requested}' not found."}, 410
-                )
-            try:
-                os.listdir(ctx.collection_dir)
-            except (FileNotFoundError, NotADirectoryError):
-                # NotADirectoryError: a loose sibling FILE (a report)
-                # named as ?revision= is no more a revision than a
-                # missing name is
-                return _json_response(
-                    {"error": f"Revision '{requested}' not found."}, 410
-                )
+            ctx.collection_dir = resolved
         else:
             ctx.revision = ctx.current_revision
         return None
@@ -446,12 +439,7 @@ class GordoApp:
             self._served_latest = latest_real
         if previous is None:
             return  # first request of the process: nothing rolled
-        stale: typing.List[batching.RequestBatcher] = []
-        with self._batchers_lock:
-            for key in [k for k in self._batchers if k[0] != latest_real]:
-                stale.append(self._batchers.pop(key))
-        for batcher in stale:
-            batcher.stop()
+        n_stopped = self.catalog.stop_stale_batchers(latest_real)
         get_registry().counter(
             "gordo_server_revision_rolls_total",
             "Hot promotions observed by this server (latest symlink flips)",
@@ -460,12 +448,12 @@ class GordoApp:
             "revision_rolled",
             previous=os.path.basename(previous),
             current=os.path.basename(latest_real),
-            n_batchers_stopped=len(stale),
+            n_batchers_stopped=n_stopped,
         )
         logger.info(
             "Revision rolled: now serving %s as latest (was %s); "
             "%d stale batcher(s) stopped",
-            latest_real, previous, len(stale),
+            latest_real, previous, n_stopped,
         )
 
     def _finalize(
@@ -526,56 +514,8 @@ class GordoApp:
 
     # -- degraded serving (docs/robustness.md) -----------------------------
 
-    def _build_report(self, ctx: RequestContext) -> dict:
-        """
-        The served revision's ``build_report.json`` ({} when absent),
-        cached by mtime so request paths pay one stat, not a parse.
-        """
-        path = os.path.join(ctx.collection_dir, BUILD_REPORT_FILENAME)
-        try:
-            mtime = os.path.getmtime(path)
-        except OSError:
-            return {}
-        key = os.path.realpath(path)
-        with self._build_reports_lock:
-            cached = self._build_reports.get(key)
-        if cached is not None and cached[0] == mtime:
-            return cached[1]
-        try:
-            with open(path) as fh:
-                report = json.load(fh)
-        except (OSError, ValueError):
-            logger.warning("Unreadable build report at %s; ignoring", path)
-            report = {}
-        with self._build_reports_lock:
-            self._build_reports[key] = (mtime, report)
-        return report
-
     def _unavailable_machines(self, ctx: RequestContext) -> typing.Dict[str, dict]:
-        """
-        Machines the build recorded as casualties: fetch/build-failed
-        (no usable artifact) or quarantined by the non-finite guard
-        (artifact holds frozen last-good params). Predictions against
-        them answer a structured 409 rather than garbage.
-        """
-        report = self._build_report(ctx)
-        out: typing.Dict[str, dict] = {}
-        for record in report.get("failed") or []:
-            name = record.get("machine")
-            if name:
-                out[name] = {
-                    "reason": f"{record.get('phase', 'build')}_failed",
-                    "error": record.get("error"),
-                    "attempts": record.get("attempts"),
-                }
-        for record in report.get("quarantined") or []:
-            name = record.get("machine")
-            if name:
-                out[name] = {
-                    "reason": "quarantined",
-                    "epoch": record.get("epoch"),
-                }
-        return out
+        return self.catalog.unavailable_machines(ctx.collection_dir)
 
     def _refuse_unavailable(
         self, ctx: RequestContext, names: typing.Iterable[str]
@@ -595,6 +535,17 @@ class GordoApp:
                 },
                 409,
             )
+
+    def _refuse_wrong_shard(
+        self, request: Request, names: typing.Iterable[str]
+    ) -> None:
+        """Sharded replicas (docs/serving.md "Sharded serving plane"):
+        421 for machines the ring assigns to another replica, unless the
+        router's adopt header says failover/hedging routed them here on
+        purpose. Unsharded serving: no-op."""
+        self.catalog.refuse_wrong_shard(
+            names, adopt=bool(request.headers.get(ADOPT_HEADER))
+        )
 
     # -- model/metadata loading --------------------------------------------
 
@@ -724,21 +675,18 @@ class GordoApp:
         return _json_response({"version": __version__})
 
     def view_models(self, ctx, request, gordo_project: str) -> Response:
-        try:
-            # artifact DIRECTORIES only: fleet builds persist their
-            # telemetry_report.json / build_report.json next to the
-            # artifacts, and loose files in the collection dir are not
-            # models
-            # dot-prefixed entries are in-flight atomic-flush temp dirs
-            # (serializer.dump), never servable artifacts
-            available = [
-                name
-                for name in os.listdir(ctx.collection_dir)
-                if not name.startswith(".")
-                and os.path.isdir(os.path.join(ctx.collection_dir, name))
-            ]
-        except FileNotFoundError:
-            available = []
+        # artifact DIRECTORIES only (loose files are reports, dot
+        # entries are in-flight temp/staging dirs). A sharded replica
+        # lists only ITS shard — a client asking a replica directly sees
+        # exactly what that replica will serve, and the shard block
+        # names where the rest lives (the router's /models merges the
+        # whole collection back together).
+        owned = self.catalog.owned_machines(ctx.collection_dir)
+        available = (
+            owned
+            if owned is not None
+            else self.catalog.list_machines(ctx.collection_dir)
+        )
         # degraded serving: casualties leave the servable list (so
         # clients never fan predictions onto them) and are surfaced with
         # their reasons instead of silently vanishing
@@ -746,8 +694,17 @@ class GordoApp:
         payload: typing.Dict[str, typing.Any] = {
             "models": [name for name in available if name not in unavailable]
         }
-        if unavailable:
-            payload["unavailable"] = unavailable
+        shard_unavailable = {
+            name: info
+            for name, info in unavailable.items()
+            # ring ownership, not disk presence: a fetch-failed casualty
+            # has no artifact dir but still belongs to exactly one shard
+            if owned is None or self.catalog.shard.owns(name)
+        }
+        if shard_unavailable:
+            payload["unavailable"] = shard_unavailable
+        if self.catalog.shard is not None:
+            payload["shard"] = self.catalog.shard.to_dict()
         return _json_response(payload)
 
     def view_revisions(self, ctx, request, gordo_project: str) -> Response:
@@ -813,6 +770,7 @@ class GordoApp:
     ) -> Response:
         """Reference: views/base.py:107-187."""
         self._refuse_unavailable(ctx, [gordo_name])
+        self._refuse_wrong_shard(request, [gordo_name])
         faults.inject("serve", gordo_name)
         model = self._get_model(ctx, gordo_name)
         metadata = self._get_metadata(ctx, gordo_name)
@@ -866,70 +824,18 @@ class GordoApp:
         }
         return _json_response(context, 200)
 
-    def _insert_lru(
-        self,
-        cache: typing.Dict,
-        key,
-        value,
-        on_evict: typing.Optional[typing.Callable] = None,
-        device_resident: bool = True,
-    ) -> None:
-        """
-        Insert into one of the serving LRU caches and bound it through
-        the ONE shared eviction policy (``gordo_tpu.programs.evict_lru``).
-        ``device_resident=True`` (scorers — stacked param trees in
-        device memory): the HBM watermark's headroom governs growth on
-        devices that report memory, with ``--scorer-cache-size`` as the
-        CPU/null-device count bound. ``device_resident=False``
-        (batchers — each owns a drainer THREAD — and program stores):
-        host-side objects the HBM signal never measures, so the count
-        bound applies on every backend. Caller holds the cache's lock.
-        """
-        cache.pop(key, None)
-        cache[key] = value
-        evict_lru(
-            cache,
-            self.scorer_cache_size,
-            on_evict=on_evict,
-            headroom=programs_headroom if device_resident else None,
-        )
+    @property
+    def _fleet_scorers(self) -> typing.Dict[tuple, tuple]:
+        # compatibility window onto the catalog's cache (tests and the
+        # preload path peek at it)
+        return self.catalog._fleet_scorers
+
+    @property
+    def _batchers(self) -> typing.Dict[tuple, batching.RequestBatcher]:
+        return self.catalog._batchers
 
     def _program_store(self, collection_dir: str):
-        """
-        The collection's AOT program store, opened (and compatibility-
-        verified) once per revision directory; None — absent store,
-        manifest mismatch, or ``AOT_CACHE`` off — means every dispatch
-        retraces. The "missing cache" rung of the fallback ladder is
-        accounted here, once per directory, not per request.
-        """
-        if not self.aot_cache_enabled:
-            return None
-        key = os.path.realpath(collection_dir)
-        with self._program_stores_lock:
-            if key in self._program_stores:
-                return self._program_stores[key]
-        store = open_store(key)
-        if store is None:
-            store_dir = os.path.join(key, programs_store.PROGRAMS_DIRNAME)
-            if not os.path.isdir(store_dir):
-                # truly absent (pre-AOT build)
-                serving_program_cache().report_fallback(key, "missing")
-            elif not os.path.isfile(
-                os.path.join(store_dir, programs_store.MANIFEST_FILENAME)
-            ):
-                # a .programs dir WITHOUT a manifest: the torn-export
-                # shape (killed between save() and write_manifest()) —
-                # must not degrade silently
-                serving_program_cache().report_fallback(
-                    key, "manifest_error"
-                )
-            # else: open_store already accounted its own
-            # manifest_mismatch / manifest_error rung — don't double-count
-        with self._program_stores_lock:
-            self._insert_lru(
-                self._program_stores, key, store, device_resident=False
-            )
-        return store
+        return self.catalog.program_store(collection_dir)
 
     def _get_fleet_scorer(
         self,
@@ -937,67 +843,19 @@ class GordoApp:
         names: typing.Tuple[str, ...],
         models: typing.Optional[typing.Dict[str, typing.Any]] = None,
     ):
-        key = (os.path.realpath(ctx.collection_dir), names)
-        # requests are handled by concurrent threads (ServerRunner's
-        # ThreadedWSGIServer, server/runner.py): hold the
-        # lock only for dict reads/writes so warm lookups never stall
-        # behind another key's build; two concurrent first requests for the
-        # same key may both build (harmless — last insert wins)
-        with self._fleet_scorers_lock:
-            cached = self._fleet_scorers.get(key)
-            if cached is not None:
-                # true LRU: refresh on hit, or the startup-preloaded
-                # whole-collection entry (inserted first) would be the
-                # first eviction victim under mixed subset traffic
-                self._fleet_scorers.pop(key)
-                self._fleet_scorers[key] = cached
-        if cached is not None:
-            return cached
-        from gordo_tpu.server.fleet_serving import fleet_scorer_from_models
-
-        if models is None:
-            models = {name: self._get_model(ctx, name) for name in names}
-        built = fleet_scorer_from_models(
-            models, store=self._program_store(ctx.collection_dir)
+        return self.catalog.fleet_scorer(
+            ctx.collection_dir,
+            names,
+            load_model=lambda name: self._get_model(ctx, name),
+            models=models,
         )
-        with self._fleet_scorers_lock:
-            self._insert_lru(self._fleet_scorers, key, built)
-        return built
 
     # -- dynamic batching (docs/serving.md#dynamic-batching) ---------------
 
     def _get_batcher(
         self, key: tuple, scorer
     ) -> batching.RequestBatcher:
-        """The RequestBatcher owning ``key``'s queue, rebuilt when the
-        revision's scorer changed; LRU-bounded like the scorer cache."""
-        with self._batchers_lock:
-            existing = self._batchers.get(key)
-            if (
-                existing is not None
-                and existing.scorer is scorer
-                and not existing.stopped
-            ):
-                self._batchers.pop(key)
-                self._batchers[key] = existing  # LRU refresh
-                return existing
-            if existing is not None:
-                existing.stop()  # stale scorer (new revision/rebuild)
-                self._batchers.pop(key)
-            batcher = batching.RequestBatcher(
-                scorer, self.batch_wait_s, self.batch_queue_limit
-            )
-            # same count bound as the scorers' CPU bound, on EVERY
-            # backend (device_resident=False): a batcher owns a drainer
-            # thread — host capacity the HBM signal never measures, so
-            # headroom must not let the population grow unbounded.
-            # Evicted batchers stop.
-            self._insert_lru(
-                self._batchers, key, batcher,
-                on_evict=lambda _key, evicted: evicted.stop(),
-                device_resident=False,
-            )
-            return batcher
+        return self.catalog.batcher(key, scorer)
 
     def _fleet_predict(
         self,
@@ -1063,9 +921,7 @@ class GordoApp:
         balancer drains a melting replica instead of piling onto it.
         Queue depth and shed counters ride the body either way.
         """
-        with self._batchers_lock:
-            batchers = list(self._batchers.values())
-        stats = [b.stats() for b in batchers]
+        stats = self.catalog.batcher_stats()
         overloaded = [s for s in stats if s["saturated"] or s["shedding"]]
         payload = {
             "status": "overloaded" if overloaded else "ok",
@@ -1109,6 +965,7 @@ class GordoApp:
 
         names = tuple(sorted(machines))
         self._refuse_unavailable(ctx, names)
+        self._refuse_wrong_shard(request, names)
         for name in names:
             faults.inject("serve", name)
         scorer, prefixes, fallback = self._get_fleet_scorer(ctx, names)
@@ -1246,6 +1103,7 @@ class GordoApp:
 
         names = tuple(sorted(machines))
         self._refuse_unavailable(ctx, names)
+        self._refuse_wrong_shard(request, names)
         for name in names:
             faults.inject("serve", name)
         models = {name: self._get_model(ctx, name) for name in names}
@@ -1349,6 +1207,7 @@ class GordoApp:
     ) -> Response:
         """Reference: views/anomaly.py:99-147."""
         self._refuse_unavailable(ctx, [gordo_name])
+        self._refuse_wrong_shard(request, [gordo_name])
         faults.inject("serve", gordo_name)
         model = self._get_model(ctx, gordo_name)
         metadata = self._get_metadata(ctx, gordo_name)
@@ -1435,6 +1294,10 @@ def build_app(
         )
     if "AOT_CACHE" not in config:
         config["AOT_CACHE"] = _env_bool("GORDO_AOT_CACHE", True)
+    if "SHARD_MANIFEST" not in config:
+        config["SHARD_MANIFEST"] = os.environ.get("GORDO_SHARD_MANIFEST") or None
+    if "REPLICA_ID" not in config:
+        config["REPLICA_ID"] = os.environ.get("GORDO_REPLICA_ID") or None
     if prometheus_registry is not None:
         if config.get("ENABLE_PROMETHEUS"):
             config["PROMETHEUS_REGISTRY"] = prometheus_registry
@@ -1460,11 +1323,13 @@ def _preload_models(app: "GordoApp") -> None:
     if not collection_dir or not os.path.isdir(collection_dir):
         logger.warning("PRELOAD_MODELS set but %s is not a directory", env_var)
         return
-    names = sorted(
-        n
-        for n in os.listdir(collection_dir)
-        if not n.startswith(".")
-        and os.path.isdir(os.path.join(collection_dir, n))
+    # a sharded replica preloads only ITS machines — that 1/N footprint
+    # is the point of sharding (docs/serving.md "Sharded serving plane")
+    owned = app.catalog.owned_machines(collection_dir)
+    names = (
+        owned
+        if owned is not None
+        else app.catalog.list_machines(collection_dir)
     )
     # preloading past the model-cache capacity would only churn the LRU
     capacity = server_utils.load_model.cache_info().maxsize
@@ -1579,11 +1444,8 @@ def _preload_fleet_scorer(
             sorted(set(names) - set(stacked_names)),
         )
     key = (os.path.realpath(collection_dir), tuple(stacked_names))
-    with app._fleet_scorers_lock:
-        # same shared bound as the lazy path
-        app._insert_lru(
-            app._fleet_scorers, key, (scorer, prefixes, fallback)
-        )
+    # same shared bound as the lazy path
+    app.catalog.insert_fleet_scorer(key, (scorer, prefixes, fallback))
     logger.info(
         "Preloaded fleet scorer: %d machines in %d groups (%d fallback)",
         len(scorer.names),

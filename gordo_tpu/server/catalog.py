@@ -1,0 +1,482 @@
+"""
+The serving catalog: collection resolution + the model/scorer/batcher
+cache layer, extracted from the WSGI app so a replica can own a machine
+SUBSET without any request-path code knowing about whole collections
+(docs/serving.md "Sharded serving plane").
+
+One :class:`ServingCatalog` owns every piece of per-collection serving
+state the app used to hold inline:
+
+- the fleet-scorer LRU (stacked param trees, HBM-headroom governed),
+- the request batchers (one drainer thread each, count-bounded),
+- the opened AOT program stores (docs/performance.md),
+- the mtime-cached ``build_report.json`` casualty records
+  (docs/robustness.md), and
+- the replica's SHARD: which machines of the collection this process
+  owns, derived from the same consistent-hash ring the router uses
+  (router/ring.py) over a shard manifest — a tiny JSON file naming the
+  replica set. Router and replicas compute identical shard maps from it
+  independently; there is no assignment protocol.
+
+A replica with no shard configured serves the whole collection — the
+historical single-process deployment, byte-identical behavior. With a
+shard, prediction routes for machines the ring gives to a different
+replica answer a structured 421 "wrong shard" naming the true owner
+(instead of a confusing 404), UNLESS the request carries the
+``X-Gordo-Shard-Adopt`` header — the router's failover/hedge signal that
+this replica should adopt the machines anyway (PR 9's AOT store makes
+adoption ~free: the executables are on the shared volume).
+"""
+
+import json
+import logging
+import os
+import threading
+import typing
+
+from gordo_tpu.programs import evict_lru, open_store, serving_program_cache
+from gordo_tpu.programs import hbm_headroom as programs_headroom
+from gordo_tpu.programs import store as programs_store
+from gordo_tpu.router.ring import DEFAULT_VNODES, HashRing
+from gordo_tpu.server import batching
+from gordo_tpu.server.utils import ApiError
+
+#: casualty record the fleet builder persists next to the artifacts
+#: (gordo_tpu.builder.fleet_build.BUILD_REPORT_FILENAME — duplicated so
+#: the serving stack never imports the builder stack)
+BUILD_REPORT_FILENAME = "build_report.json"
+
+#: request header by which the ROUTER tells a sharded replica to serve
+#: machines outside its shard (failover / hedging / drain): adoption is
+#: deliberate there, not a misrouting
+ADOPT_HEADER = "X-Gordo-Shard-Adopt"
+
+logger = logging.getLogger(__name__)
+
+
+class ShardSpec:
+    """
+    This replica's identity on the ring: ``(replica_id, replicas,
+    vnodes)``. The manifest file carries ``replicas`` + ``vnodes`` (and
+    optionally ``replica_id``); every process pointed at the same
+    manifest computes the same machine->replica map.
+    """
+
+    def __init__(
+        self,
+        replica_id: str,
+        replicas: typing.Sequence[str],
+        vnodes: int = DEFAULT_VNODES,
+    ):
+        if replica_id not in replicas:
+            raise ValueError(
+                f"replica_id {replica_id!r} is not in the replica set "
+                f"{sorted(replicas)}"
+            )
+        self.replica_id = replica_id
+        self.ring = HashRing(replicas, vnodes)
+
+    @classmethod
+    def load(
+        cls, path: str, replica_id: typing.Optional[str] = None
+    ) -> "ShardSpec":
+        """Parse a shard-manifest JSON file. ``replica_id`` (the
+        ``--replica-id`` flag / GORDO_REPLICA_ID env) overrides the
+        manifest's own, so one shared manifest on the volume can serve
+        every replica."""
+        with open(path) as fh:
+            manifest = json.load(fh)
+        rid = replica_id or manifest.get("replica_id")
+        if not rid:
+            raise ValueError(
+                f"Shard manifest {path} names no replica_id and none was "
+                "given (--replica-id / GORDO_REPLICA_ID)"
+            )
+        replicas = manifest.get("replicas")
+        if not replicas or not isinstance(replicas, list):
+            raise ValueError(
+                f"Shard manifest {path} must carry a non-empty 'replicas' "
+                "list"
+            )
+        return cls(
+            str(rid),
+            [str(r) for r in replicas],
+            int(manifest.get("vnodes") or DEFAULT_VNODES),
+        )
+
+    def owner(self, machine_name: str) -> str:
+        return self.ring.owner(machine_name)
+
+    def owns(self, machine_name: str) -> bool:
+        return self.ring.owner(machine_name) == self.replica_id
+
+    def to_dict(self) -> dict:
+        return {
+            "replica_id": self.replica_id,
+            "replicas": list(self.ring.replicas),
+            "vnodes": self.ring.vnodes,
+        }
+
+
+def write_shard_manifest(
+    path: str,
+    replicas: typing.Sequence[str],
+    vnodes: int = DEFAULT_VNODES,
+    replica_id: typing.Optional[str] = None,
+) -> str:
+    """Write a shard manifest (helper for benches/tests/deploy tooling;
+    the format is three JSON keys — see :class:`ShardSpec`)."""
+    from gordo_tpu.utils.atomic import atomic_write_json
+
+    manifest: typing.Dict[str, typing.Any] = {
+        "replicas": list(replicas),
+        "vnodes": int(vnodes),
+    }
+    if replica_id is not None:
+        manifest["replica_id"] = replica_id
+    # atomic: the manifest lives on the shared artifact volume and every
+    # replica parses it at startup — a torn write must never be readable
+    atomic_write_json(path, manifest, indent=2, sort_keys=True)
+    return path
+
+
+def resolve_sibling_revision(
+    latest_dir: str, requested: str
+) -> typing.Optional[str]:
+    """
+    The one revision-name policy (shared by the server middleware and
+    the router): the path of ``requested`` as a sibling of
+    ``latest_dir``, or None when the name is not servable — dot entries
+    are in-flight/torn promotion staging dirs and lifecycle state,
+    separator characters would traverse, a symlink sibling (the
+    ``latest`` pointer) is an ALIAS whose constant path would split-brain
+    the path-keyed caches across a promotion, and loose files/missing
+    names are not revisions. Callers answer 410 for None — the name is
+    never servable (docs/lifecycle.md).
+    """
+    if requested.startswith(".") or "/" in requested or "\\" in requested:
+        return None
+    candidate = os.path.join(latest_dir, "..", requested)
+    if os.path.islink(candidate):
+        return None
+    try:
+        os.listdir(candidate)
+    except (FileNotFoundError, NotADirectoryError):
+        return None
+    return candidate
+
+
+class ServingCatalog:
+    """
+    Per-process serving state for any number of collection directories
+    (revisions), shared by every request thread. All methods are
+    thread-safe; locks are held only for dict reads/writes, never across
+    model builds or network calls.
+    """
+
+    def __init__(
+        self,
+        scorer_cache_size: int = 16,
+        aot_cache: bool = True,
+        batch_wait_s: float = 0.0,
+        batch_queue_limit: int = 64,
+        shard: typing.Optional[ShardSpec] = None,
+    ):
+        self.scorer_cache_size = int(scorer_cache_size)
+        self.aot_cache_enabled = bool(aot_cache)
+        self.batch_wait_s = float(batch_wait_s)
+        self.batch_queue_limit = int(batch_queue_limit)
+        self.shard = shard
+        # (realpath(collection_dir), names tuple) -> (scorer, prefixes, fallback)
+        self._fleet_scorers: typing.Dict[tuple, tuple] = {}
+        self._fleet_scorers_lock = threading.Lock()
+        self._batchers: typing.Dict[tuple, batching.RequestBatcher] = {}
+        self._batchers_lock = threading.Lock()
+        # realpath(collection dir) -> opened ProgramStore (or None)
+        self._program_stores: typing.Dict[str, typing.Any] = {}
+        self._program_stores_lock = threading.Lock()
+        # build_report.json path -> (mtime, parsed report)
+        self._build_reports: typing.Dict[str, tuple] = {}
+        self._build_reports_lock = threading.Lock()
+
+    # -- LRU plumbing ------------------------------------------------------
+
+    def _insert_lru(
+        self,
+        cache: typing.Dict,
+        key,
+        value,
+        on_evict: typing.Optional[typing.Callable] = None,
+        device_resident: bool = True,
+    ) -> None:
+        """
+        Insert into one of the serving LRU caches and bound it through
+        the ONE shared eviction policy (``gordo_tpu.programs.evict_lru``).
+        ``device_resident=True`` (scorers — stacked param trees in
+        device memory): the HBM watermark's headroom governs growth on
+        devices that report memory, with ``--scorer-cache-size`` as the
+        CPU/null-device count bound. ``device_resident=False``
+        (batchers — each owns a drainer THREAD — and program stores):
+        host-side objects the HBM signal never measures, so the count
+        bound applies on every backend. Caller holds the cache's lock.
+        """
+        cache.pop(key, None)
+        cache[key] = value
+        evict_lru(
+            cache,
+            self.scorer_cache_size,
+            on_evict=on_evict,
+            headroom=programs_headroom if device_resident else None,
+        )
+
+    # -- degraded serving (docs/robustness.md) -----------------------------
+
+    def build_report(self, collection_dir: str) -> dict:
+        """
+        The revision's ``build_report.json`` ({} when absent), cached by
+        mtime so request paths pay one stat, not a parse.
+        """
+        path = os.path.join(collection_dir, BUILD_REPORT_FILENAME)
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return {}
+        key = os.path.realpath(path)
+        with self._build_reports_lock:
+            cached = self._build_reports.get(key)
+        if cached is not None and cached[0] == mtime:
+            return cached[1]
+        try:
+            with open(path) as fh:
+                report = json.load(fh)
+        except (OSError, ValueError):
+            logger.warning("Unreadable build report at %s; ignoring", path)
+            report = {}
+        with self._build_reports_lock:
+            self._build_reports[key] = (mtime, report)
+        return report
+
+    def unavailable_machines(
+        self, collection_dir: str
+    ) -> typing.Dict[str, dict]:
+        """
+        Machines the build recorded as casualties: fetch/build-failed
+        (no usable artifact) or quarantined by the non-finite guard
+        (artifact holds frozen last-good params). Predictions against
+        them answer a structured 409 rather than garbage.
+        """
+        report = self.build_report(collection_dir)
+        out: typing.Dict[str, dict] = {}
+        for record in report.get("failed") or []:
+            name = record.get("machine")
+            if name:
+                out[name] = {
+                    "reason": f"{record.get('phase', 'build')}_failed",
+                    "error": record.get("error"),
+                    "attempts": record.get("attempts"),
+                }
+        for record in report.get("quarantined") or []:
+            name = record.get("machine")
+            if name:
+                out[name] = {
+                    "reason": "quarantined",
+                    "epoch": record.get("epoch"),
+                }
+        return out
+
+    # -- collection listing + shard ----------------------------------------
+
+    @staticmethod
+    def list_machines(collection_dir: str) -> typing.List[str]:
+        """Artifact DIRECTORY names in the collection (loose files are
+        reports, dot entries are in-flight temp/staging dirs — neither
+        is a machine)."""
+        try:
+            return sorted(
+                name
+                for name in os.listdir(collection_dir)
+                if not name.startswith(".")
+                and os.path.isdir(os.path.join(collection_dir, name))
+            )
+        except FileNotFoundError:
+            return []
+
+    def owned_machines(
+        self, collection_dir: str
+    ) -> typing.Optional[typing.List[str]]:
+        """The machines THIS replica owns under its shard, or None when
+        unsharded (= the whole collection)."""
+        if self.shard is None:
+            return None
+        return sorted(
+            name
+            for name in self.list_machines(collection_dir)
+            if self.shard.owns(name)
+        )
+
+    def refuse_wrong_shard(
+        self, names: typing.Iterable[str], adopt: bool
+    ) -> None:
+        """
+        The structured not-mine redirect: a sharded replica asked for
+        machines the ring assigns elsewhere answers 421 (Misdirected
+        Request) naming each machine's true owner — unless ``adopt`` is
+        set (the router's failover/hedge header), in which case it
+        serves them from the shared artifacts like any of its own.
+        """
+        if self.shard is None or adopt:
+            return
+        not_mine = {
+            name: {"owner": self.shard.owner(name)}
+            for name in names
+            if not self.shard.owns(name)
+        }
+        if not_mine:
+            raise ApiError(
+                {
+                    "error": "Machine(s) not in this replica's shard: "
+                    + ", ".join(
+                        f"{name} (owner {info['owner']})"
+                        for name, info in sorted(not_mine.items())
+                    ),
+                    "wrong_shard": not_mine,
+                    "replica_id": self.shard.replica_id,
+                },
+                421,
+            )
+
+    # -- AOT program stores (docs/performance.md) --------------------------
+
+    def program_store(self, collection_dir: str):
+        """
+        The collection's AOT program store, opened (and compatibility-
+        verified) once per revision directory; None — absent store,
+        manifest mismatch, or AOT off — means every dispatch retraces.
+        The "missing cache" rung of the fallback ladder is accounted
+        here, once per directory, not per request.
+        """
+        if not self.aot_cache_enabled:
+            return None
+        key = os.path.realpath(collection_dir)
+        with self._program_stores_lock:
+            if key in self._program_stores:
+                return self._program_stores[key]
+        store = open_store(key)
+        if store is None:
+            store_dir = os.path.join(key, programs_store.PROGRAMS_DIRNAME)
+            if not os.path.isdir(store_dir):
+                # truly absent (pre-AOT build)
+                serving_program_cache().report_fallback(key, "missing")
+            elif not os.path.isfile(
+                os.path.join(store_dir, programs_store.MANIFEST_FILENAME)
+            ):
+                # a .programs dir WITHOUT a manifest: the torn-export
+                # shape (killed between save() and write_manifest()) —
+                # must not degrade silently
+                serving_program_cache().report_fallback(
+                    key, "manifest_error"
+                )
+            # else: open_store already accounted its own
+            # manifest_mismatch / manifest_error rung — don't double-count
+        with self._program_stores_lock:
+            self._insert_lru(
+                self._program_stores, key, store, device_resident=False
+            )
+        return store
+
+    # -- fleet scorers -----------------------------------------------------
+
+    def fleet_scorer(
+        self,
+        collection_dir: str,
+        names: typing.Tuple[str, ...],
+        load_model: typing.Callable[[str], typing.Any],
+        models: typing.Optional[typing.Dict[str, typing.Any]] = None,
+    ) -> tuple:
+        """
+        The (scorer, prefixes, fallback) triple for ``names`` in this
+        revision, built on miss from ``models`` (or by calling
+        ``load_model`` per name). Requests are handled by concurrent
+        threads: the lock is held only for dict reads/writes so warm
+        lookups never stall behind another key's build; two concurrent
+        first requests for the same key may both build (harmless — last
+        insert wins).
+        """
+        key = (os.path.realpath(collection_dir), names)
+        with self._fleet_scorers_lock:
+            cached = self._fleet_scorers.get(key)
+            if cached is not None:
+                # true LRU: refresh on hit, or the startup-preloaded
+                # whole-collection entry (inserted first) would be the
+                # first eviction victim under mixed subset traffic
+                self._fleet_scorers.pop(key)
+                self._fleet_scorers[key] = cached
+        if cached is not None:
+            return cached
+        from gordo_tpu.server.fleet_serving import fleet_scorer_from_models
+
+        if models is None:
+            models = {name: load_model(name) for name in names}
+        built = fleet_scorer_from_models(
+            models, store=self.program_store(collection_dir)
+        )
+        with self._fleet_scorers_lock:
+            self._insert_lru(self._fleet_scorers, key, built)
+        return built
+
+    def insert_fleet_scorer(self, key: tuple, value: tuple) -> None:
+        """Preload path: install a ready-built scorer triple under the
+        same shared bound as the lazy path."""
+        with self._fleet_scorers_lock:
+            self._insert_lru(self._fleet_scorers, key, value)
+
+    # -- batchers (docs/serving.md#dynamic-batching) -----------------------
+
+    def batcher(self, key: tuple, scorer) -> batching.RequestBatcher:
+        """The RequestBatcher owning ``key``'s queue, rebuilt when the
+        revision's scorer changed; LRU-bounded like the scorer cache."""
+        with self._batchers_lock:
+            existing = self._batchers.get(key)
+            if (
+                existing is not None
+                and existing.scorer is scorer
+                and not existing.stopped
+            ):
+                self._batchers.pop(key)
+                self._batchers[key] = existing  # LRU refresh
+                return existing
+            if existing is not None:
+                existing.stop()  # stale scorer (new revision/rebuild)
+                self._batchers.pop(key)
+            batcher = batching.RequestBatcher(
+                scorer, self.batch_wait_s, self.batch_queue_limit
+            )
+            # same count bound as the scorers' CPU bound, on EVERY
+            # backend (device_resident=False): a batcher owns a drainer
+            # thread — host capacity the HBM signal never measures, so
+            # headroom must not let the population grow unbounded.
+            # Evicted batchers stop.
+            self._insert_lru(
+                self._batchers, key, batcher,
+                on_evict=lambda _key, evicted: evicted.stop(),
+                device_resident=False,
+            )
+            return batcher
+
+    def batcher_stats(self) -> typing.List[dict]:
+        with self._batchers_lock:
+            batchers = list(self._batchers.values())
+        return [b.stats() for b in batchers]
+
+    def stop_stale_batchers(self, keep_collection_dir: str) -> int:
+        """Stop + drop every batcher keyed to another revision (hot
+        promotion rolled ``latest``); returns how many."""
+        stale: typing.List[batching.RequestBatcher] = []
+        with self._batchers_lock:
+            for key in [
+                k for k in self._batchers if k[0] != keep_collection_dir
+            ]:
+                stale.append(self._batchers.pop(key))
+        for batcher in stale:
+            batcher.stop()
+        return len(stale)

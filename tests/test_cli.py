@@ -611,6 +611,10 @@ def test_run_server_cli_passes_concurrency_knobs(runner, monkeypatch):
             "BATCH_QUEUE_LIMIT": 64,
             "SCORER_CACHE_SIZE": 16,
             "AOT_CACHE": True,
+            # unsharded by default: the historical whole-collection
+            # replica (docs/serving.md#sharded-serving-plane)
+            "SHARD_MANIFEST": None,
+            "REPLICA_ID": None,
         },
     }
 
@@ -636,7 +640,44 @@ def test_run_server_cli_passes_batching_knobs(runner, monkeypatch):
         "BATCH_QUEUE_LIMIT": 32,
         "SCORER_CACHE_SIZE": 16,
         "AOT_CACHE": True,
+        "SHARD_MANIFEST": None,
+        "REPLICA_ID": None,
     }
+
+
+def test_run_router_cli_passes_knobs(runner, monkeypatch):
+    """run-router parses --replica id=url entries and hands every knob
+    to the router config intact (docs/serving.md#sharded-serving-plane)."""
+    captured = {}
+
+    def fake_run_router(host, port, log_level, config=None, threads=None):
+        captured.update(
+            host=host, port=port, config=config, threads=threads
+        )
+
+    from gordo_tpu.router import app as router_app
+
+    monkeypatch.setattr(router_app, "run_router", fake_run_router)
+    result = runner.invoke(
+        gordo,
+        ["run-router", "--host", "127.0.0.1", "--port", "5556",
+         "--replica", "r0=http://h0:5555", "--replica", "r1=http://h1:5555/",
+         "--hedge-ms", "25", "--eject-after", "2", "--max-inflight", "8",
+         "--threads", "12"],
+    )
+    assert result.exit_code == 0, result.output
+    assert captured["threads"] == 12
+    assert captured["config"]["REPLICAS"] == {
+        "r0": "http://h0:5555",
+        "r1": "http://h1:5555",  # trailing slash normalized
+    }
+    assert captured["config"]["HEDGE_MS"] == 25
+    assert captured["config"]["EJECT_AFTER"] == 2
+    assert captured["config"]["MAX_INFLIGHT"] == 8
+    # no replicas is a usage error, not a crash at serve time
+    result = runner.invoke(gordo, ["run-router"])
+    assert result.exit_code != 0
+    assert "replica" in result.output.lower()
 
 
 def test_client_cli_help(runner):

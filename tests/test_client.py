@@ -419,3 +419,110 @@ def test_predict_surfaces_served_revision(client):
     )
     for result in fleet_results:
         assert result.revision == GORDO_REVISION, result.name
+
+
+# -- metadata-path hang-proofing (ISSUE 11 satellite) ------------------------
+
+
+@pytest.fixture
+def blackholed_server():
+    """A real socket that ACCEPTS connections (kernel backlog) and never
+    responds — the shape of a wedged/blackholed server that used to hang
+    every metadata GET forever."""
+    import socket
+
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    sock.listen(8)
+    try:
+        yield sock.getsockname()[1]
+    finally:
+        sock.close()
+
+
+def test_metadata_gets_time_out_against_blackholed_server(blackholed_server):
+    """Every metadata-path GET — revisions, models listing, machine
+    metadata, model download — must give up after metadata_timeout
+    instead of wedging the client forever (the PR-4/PR-7 hang-proofing,
+    now on the discovery path too)."""
+    import time as _time
+
+    import requests as _requests
+
+    client = Client(
+        project=GORDO_PROJECT,
+        host="127.0.0.1",
+        port=blackholed_server,
+        scheme="http",
+        metadata_timeout=0.4,
+    )
+    calls = [
+        lambda: client.get_revisions(),
+        lambda: client._get_available_machines("some-rev"),
+        lambda: client._machine_from_server("some-machine", "some-rev"),
+        lambda: client.download_model(revision="some-rev", targets=["m"]),
+    ]
+    for call in calls:
+        start = _time.monotonic()
+        with pytest.raises((_requests.exceptions.Timeout, IOError)):
+            call()
+        # finite and prompt: the 0.4s timeout, not a 60s+ socket default
+        assert _time.monotonic() - start < 5.0
+
+
+def test_metadata_timeout_default_is_finite():
+    assert Client.DEFAULT_METADATA_TIMEOUT_S is not None
+    assert Client("p").metadata_timeout == Client.DEFAULT_METADATA_TIMEOUT_S
+
+
+# -- download_model revision pin (ISSUE 11 satellite) ------------------------
+
+
+@pytest.fixture
+def two_revision_server(trained_model_collection, tmp_path, monkeypatch):
+    """Two sibling revisions whose GORDO_SINGLE_TARGET artifacts hold
+    DIFFERENT model types, served with rev-new as latest."""
+    import shutil
+
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    parent = tmp_path / "revisions"
+    new = parent / "rev-new"
+    old = parent / "rev-old"
+    shutil.copytree(trained_model_collection, new)
+    old.mkdir(parents=True)
+    # rev-old serves the BASE (plain AutoEncoder) artifact under the
+    # anomaly machine's name: the two revisions are type-distinguishable
+    shutil.copytree(
+        trained_model_collection / GORDO_BASE_TARGETS[0],
+        old / GORDO_SINGLE_TARGET,
+    )
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(new))
+    server_utils.clear_caches()
+    return build_app()
+
+
+def test_download_model_honors_requested_revision(two_revision_server):
+    """download_model used to drop the revision param and silently pull
+    `latest` — pinned: two revisions, distinguishable artifacts, the
+    one asked for is the one received."""
+    client = Client(
+        project=GORDO_PROJECT,
+        session=loopback_session(two_revision_server),
+        scheme="http",
+        port=80,
+    )
+    new_model = client.download_model(
+        revision="rev-new", targets=[GORDO_SINGLE_TARGET]
+    )[GORDO_SINGLE_TARGET]
+    old_model = client.download_model(
+        revision="rev-old", targets=[GORDO_SINGLE_TARGET]
+    )[GORDO_SINGLE_TARGET]
+    assert type(new_model).__name__ == "DiffBasedAnomalyDetector"
+    assert type(old_model).__name__ != "DiffBasedAnomalyDetector"
+    # default (no revision) resolves to latest = rev-new
+    default_model = client.download_model(targets=[GORDO_SINGLE_TARGET])[
+        GORDO_SINGLE_TARGET
+    ]
+    assert type(default_model).__name__ == "DiffBasedAnomalyDetector"

@@ -1,0 +1,1013 @@
+"""
+Sharded serving plane tests (docs/serving.md): the consistent-hash ring,
+the replica health circuit breaker, shard-aware replicas (421 not-mine /
+adopt), and the router's fan-out/re-join — including the chaos
+acceptance: 3 replicas, one killed mid-run, zero non-structured errors,
+failover to steady-state goodput, and re-adoption without a router
+restart.
+"""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+from urllib.parse import urlsplit
+
+import numpy as np
+import pytest
+import requests
+from requests.adapters import BaseAdapter
+from werkzeug.test import Client as WerkzeugClient
+
+from gordo_tpu import serializer
+from gordo_tpu.machine import Machine
+from gordo_tpu.models import AutoEncoder
+from gordo_tpu.observability import get_registry, read_events
+from gordo_tpu.robustness import faults
+from gordo_tpu.router.health import (
+    EJECTED,
+    HEALTHY,
+    PROBATION,
+    ReplicaHealthTracker,
+)
+from gordo_tpu.router.ring import HashRing
+from gordo_tpu.server.catalog import (
+    ADOPT_HEADER,
+    ShardSpec,
+    write_shard_manifest,
+)
+from tests.utils import WSGIAdapter
+
+PROJECT = "shard-proj"
+TAGS = [f"tag-{i}" for i in range(4)]
+N_MACHINES = 6
+MACHINES = [f"shard-m{i}" for i in range(N_MACHINES)]
+RNG = np.random.default_rng(7)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_INJECT_ENV_VAR, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+#: routers built by make_plane during the current test — closed after
+#: it, so a leaked prober thread can never consume a later test's chaos
+#: specs or probe a later test's replicas
+_LIVE_ROUTERS: list = []
+
+
+@pytest.fixture(autouse=True)
+def _close_planes():
+    yield
+    while _LIVE_ROUTERS:
+        _LIVE_ROUTERS.pop().close()
+
+
+# -- the ring --------------------------------------------------------------
+
+
+def _names(n):
+    return [f"machine-{i:03d}" for i in range(n)]
+
+
+def test_ring_owner_deterministic_across_processes():
+    """The shard map is derived, not distributed: a separate interpreter
+    must compute byte-identical ownership from the same manifest."""
+    replicas = ["r0", "r1", "r2"]
+    names = _names(24)
+    script = (
+        "import json, sys; sys.path.insert(0, %r); "
+        "from gordo_tpu.router.ring import HashRing; "
+        "ring = HashRing(%r, 64); "
+        "print(json.dumps({n: ring.owner(n) for n in %r}))"
+        % (str(__import__("pathlib").Path(__file__).parent.parent), replicas, names)
+    )
+    out = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        check=True,
+        env={"PYTHONHASHSEED": "12345", "PATH": "/usr/bin:/bin"},
+    )
+    ring = HashRing(replicas, 64)
+    assert json.loads(out.stdout) == {n: ring.owner(n) for n in names}
+
+
+def test_ring_stability_on_remove_and_add():
+    """The consistent-hashing contract, pinned: removing one of N
+    replicas moves ONLY the removed replica's machines; adding an
+    (N+1)th moves at most ~1/(N+1) of them (plus concentration slack)."""
+    names = _names(400)
+    before = HashRing(["r0", "r1", "r2", "r3"], 64)
+    owners_before = {n: before.owner(n) for n in names}
+
+    removed = HashRing(["r0", "r1", "r3"], 64)
+    for name in names:
+        if owners_before[name] != "r2":
+            # a surviving replica's machine must not move at all
+            assert removed.owner(name) == owners_before[name]
+        else:
+            assert removed.owner(name) != "r2"
+
+    grown = HashRing(["r0", "r1", "r2", "r3", "r4"], 64)
+    moved = [n for n in names if grown.owner(n) != owners_before[n]]
+    # every moved machine moved TO the new replica, never between
+    # survivors
+    assert all(grown.owner(n) == "r4" for n in moved)
+    # expectation 1/5; generous slack for vnode concentration at 400
+    # samples x 64 vnodes
+    assert len(moved) / len(names) <= 1 / 5 + 0.10
+
+
+def test_ring_preference_is_owner_then_distinct_successors():
+    ring = HashRing(["a", "b", "c", "d"])
+    for name in _names(20):
+        pref = ring.preference(name)
+        assert pref[0] == ring.owner(name)
+        assert sorted(pref) == ["a", "b", "c", "d"]
+
+
+def test_ring_rejects_degenerate_input():
+    with pytest.raises(ValueError):
+        HashRing([])
+    with pytest.raises(ValueError):
+        HashRing(["a", "a"])
+    with pytest.raises(ValueError):
+        HashRing(["a"], vnodes=0)
+
+
+def test_shard_spec_partition_agrees_with_replica_view(tmp_path):
+    """Router-side partition() and each replica's ShardSpec.owns() are
+    the SAME map — the no-assignment-protocol invariant."""
+    manifest = write_shard_manifest(
+        str(tmp_path / "m.json"), ["r0", "r1", "r2"]
+    )
+    names = _names(60)
+    ring = HashRing(["r0", "r1", "r2"])
+    partition = ring.partition(names)
+    for rid in ("r0", "r1", "r2"):
+        spec = ShardSpec.load(manifest, replica_id=rid)
+        assert sorted(spec.ring.shard(names, rid)) == partition.get(rid, [])
+
+
+# -- replica health --------------------------------------------------------
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_health_ejects_after_consecutive_failures_and_recovers():
+    clock = _Clock()
+    tracker = ReplicaHealthTracker(
+        ["r0", "r1"], eject_after=3, backoff_scale=1.0, now=clock
+    )
+    assert not tracker.record_failure("r0")
+    assert not tracker.record_failure("r0")
+    assert tracker.routable("r0")
+    assert tracker.record_failure("r0")  # third strike ejects
+    assert tracker.state("r0") == EJECTED
+    assert not tracker.routable("r0")
+    assert tracker.retry_after_s("r0") > 0
+    # the peer is untouched
+    assert tracker.state("r1") == HEALTHY
+    # window expiry -> half-open, routable again
+    clock.t += 60
+    assert tracker.state("r0") == PROBATION
+    assert tracker.routable("r0")
+    # first real-traffic success closes the breaker
+    tracker.record_success("r0")
+    assert tracker.state("r0") == HEALTHY
+
+
+def test_health_probation_failure_re_ejects_immediately():
+    clock = _Clock()
+    tracker = ReplicaHealthTracker(
+        ["r0"], eject_after=3, backoff_scale=1.0, now=clock
+    )
+    for _ in range(3):
+        tracker.record_failure("r0")
+    first_window = tracker.retry_after_s("r0")
+    clock.t += 60
+    assert tracker.state("r0") == PROBATION
+    # one strike in probation: straight back out, escalated window
+    assert tracker.record_failure("r0")
+    assert tracker.state("r0") == EJECTED
+    assert tracker.retry_after_s("r0") >= first_window
+
+
+def test_health_success_resets_consecutive_count():
+    tracker = ReplicaHealthTracker(["r0"], eject_after=3)
+    tracker.record_failure("r0")
+    tracker.record_failure("r0")
+    tracker.record_success("r0")
+    tracker.record_failure("r0")
+    tracker.record_failure("r0")
+    assert tracker.state("r0") == HEALTHY  # never reached 3 in a row
+
+
+def test_health_probe_moves_expired_ejection_to_probation():
+    clock = _Clock()
+    tracker = ReplicaHealthTracker(
+        ["r0"], eject_after=1, backoff_scale=1.0, now=clock
+    )
+    tracker.record_failure("r0")
+    assert not tracker.probe_due("r0")  # window still open
+    clock.t += 60
+    assert tracker.probe_due("r0")
+    tracker.note_probe("r0", ok=False)  # failed probe re-ejects
+    assert tracker.state("r0") == EJECTED
+    clock.t += 600
+    tracker.note_probe("r0", ok=True)
+    assert tracker.state("r0") == PROBATION
+
+
+# -- the serving plane harness ---------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def shard_collection(tmp_path_factory):
+    """Six small trained machines laid out as one served collection
+    (metadata included, so the real server and the real client both
+    work against it)."""
+    root = tmp_path_factory.mktemp("shard-collection")
+    collection = root / PROJECT / "models" / "rev-1"
+    for i, name in enumerate(MACHINES):
+        X = RNG.random((80, len(TAGS))).astype("float32")
+        model = AutoEncoder(kind="feedforward_hourglass", epochs=1, seed=i)
+        model.fit(X, X.copy())
+        machine = Machine(
+            name=name,
+            project_name=PROJECT,
+            model={
+                "gordo_tpu.models.AutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "epochs": 1,
+                }
+            },
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": "2019-01-01T00:00:00+00:00",
+                "train_end_date": "2019-01-02T00:00:00+00:00",
+                "tags": [[t, None] for t in TAGS],
+            },
+        )
+        serializer.dump(model, collection / name, metadata=machine.to_dict())
+    return collection
+
+
+class MultiReplicaAdapter(BaseAdapter):
+    """Routes requests to in-process replica WSGI apps by netloc, with a
+    per-replica kill switch (connection-refused shape) and per-replica
+    request counters."""
+
+    def __init__(self, apps):
+        super().__init__()
+        self.adapters = {netloc: WSGIAdapter(app) for netloc, app in apps.items()}
+        self.killed = set()
+        self.calls = {netloc: 0 for netloc in apps}
+        self.urls: list = []
+        self._lock = threading.Lock()
+
+    def send(self, request, **kwargs):
+        netloc = urlsplit(request.url).netloc
+        with self._lock:
+            self.calls[netloc] = self.calls.get(netloc, 0) + 1
+            self.urls.append(request.url)
+            if netloc in self.killed:
+                raise requests.ConnectionError(f"{netloc} is down")
+        adapter = self.adapters.get(netloc)
+        if adapter is None:
+            raise requests.ConnectionError(f"no such replica {netloc}")
+        return adapter.send(request, **kwargs)
+
+    def close(self):
+        pass
+
+
+class Plane:
+    """One sharded serving plane: N shard replicas + a router, all
+    in-process."""
+
+    def __init__(self, router, apps, adapter, replica_ids):
+        self.router = router
+        self.apps = apps
+        self.adapter = adapter
+        self.replica_ids = replica_ids
+        self.client = WerkzeugClient(router)
+
+    def calls_to(self, rid):
+        return self.adapter.calls[f"{rid}.test"]
+
+    def kill(self, rid):
+        self.adapter.killed.add(f"{rid}.test")
+
+    def revive(self, rid):
+        self.adapter.killed.discard(f"{rid}.test")
+
+
+def make_plane(
+    collection, monkeypatch, tmp_path, n_replicas=3, **router_config
+):
+    from gordo_tpu.router.app import RouterApp
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(collection))
+    server_utils.clear_caches()
+    replica_ids = [f"r{i}" for i in range(n_replicas)]
+    manifest = write_shard_manifest(
+        str(tmp_path / f"shard_manifest_{n_replicas}.json"), replica_ids
+    )
+    apps = {
+        f"{rid}.test": build_app(
+            {"SHARD_MANIFEST": manifest, "REPLICA_ID": rid}
+        )
+        for rid in replica_ids
+    }
+    adapter = MultiReplicaAdapter(apps)
+    session = requests.Session()
+    session.mount("http://", adapter)
+    config = {
+        "REPLICAS": {rid: f"http://{rid}.test" for rid in replica_ids},
+        "SESSION": session,
+        "PROBE_INTERVAL_S": 0.05,  # real prober, test-paced
+        "BACKOFF_SCALE": 0.002,  # ~16-64ms ejection windows
+        **router_config,
+    }
+    router = RouterApp(config)
+    _LIVE_ROUTERS.append(router)
+    return Plane(router, apps, adapter, replica_ids)
+
+
+def _rows(n=10, seed=3):
+    return np.random.default_rng(seed).random((n, len(TAGS))).tolist()
+
+
+def _fleet_body(names, n=10):
+    return json.dumps({"machines": {name: _rows(n) for name in names}}).encode()
+
+
+def _post_fleet(client, names, n=10):
+    return client.post(
+        f"/gordo/v0/{PROJECT}/prediction/fleet",
+        data=_fleet_body(names, n),
+        content_type="application/json",
+    )
+
+
+def _shard_map(n_replicas=3):
+    ring = HashRing([f"r{i}" for i in range(n_replicas)])
+    return ring.partition(MACHINES)
+
+
+# -- sharded replicas (catalog) --------------------------------------------
+
+
+def test_sharded_replicas_partition_models_listing(
+    shard_collection, monkeypatch, tmp_path
+):
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    seen = []
+    for rid in plane.replica_ids:
+        client = WerkzeugClient(plane.apps[f"{rid}.test"])
+        payload = json.loads(
+            client.get(f"/gordo/v0/{PROJECT}/models").get_data()
+        )
+        assert payload["shard"]["replica_id"] == rid
+        assert payload["shard"]["replicas"] == plane.replica_ids
+        seen.extend(payload["models"])
+    # disjoint cover of the whole collection
+    assert sorted(seen) == sorted(MACHINES)
+
+
+def test_misrouted_machine_answers_structured_not_mine(
+    shard_collection, monkeypatch, tmp_path
+):
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    shard_map = _shard_map()
+    # pick a machine and a replica that does NOT own it
+    machine = MACHINES[0]
+    owner = HashRing(plane.replica_ids).owner(machine)
+    wrong = next(r for r in plane.replica_ids if r != owner)
+    client = WerkzeugClient(plane.apps[f"{wrong}.test"])
+    body = json.dumps({"X": _rows()}).encode()
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/{machine}/prediction",
+        data=body,
+        content_type="application/json",
+    )
+    assert resp.status_code == 421
+    payload = json.loads(resp.get_data())
+    assert payload["replica_id"] == wrong
+    assert payload["wrong_shard"][machine]["owner"] == owner
+    # the router's failover signal bypasses the refusal: adoption serves
+    adopted = client.post(
+        f"/gordo/v0/{PROJECT}/{machine}/prediction",
+        data=body,
+        content_type="application/json",
+        headers={ADOPT_HEADER: "failover"},
+    )
+    assert adopted.status_code == 200
+    assert shard_map  # sanity: partition non-empty
+
+
+# -- the router ------------------------------------------------------------
+
+
+def _unsharded_app(collection, monkeypatch):
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(collection))
+    server_utils.clear_caches()
+    return build_app()
+
+
+def test_router_models_lists_whole_collection(
+    shard_collection, monkeypatch, tmp_path
+):
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    payload = json.loads(
+        plane.client.get(f"/gordo/v0/{PROJECT}/models").get_data()
+    )
+    assert sorted(payload["models"]) == sorted(MACHINES)
+    assert payload["revision"] == "rev-1"
+
+
+def test_routed_fleet_bit_identical_to_single_process_server(
+    shard_collection, monkeypatch, tmp_path
+):
+    """THE correctness pin: the same fleet request answered through the
+    sharded plane and by one whole-collection run-server must carry
+    byte-identical per-machine frames."""
+    single = WerkzeugClient(_unsharded_app(shard_collection, monkeypatch))
+    want = json.loads(_post_fleet(single, MACHINES).get_data())["data"]
+
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    resp = _post_fleet(plane.client, MACHINES)
+    assert resp.status_code == 200
+    got = json.loads(resp.get_data())["data"]
+    assert got == want
+
+
+def test_routed_single_machine_bit_identical(
+    shard_collection, monkeypatch, tmp_path
+):
+    single = WerkzeugClient(_unsharded_app(shard_collection, monkeypatch))
+    body = json.dumps({"X": _rows()}).encode()
+    wants = {}
+    for name in MACHINES:
+        resp = single.post(
+            f"/gordo/v0/{PROJECT}/{name}/prediction",
+            data=body,
+            content_type="application/json",
+        )
+        assert resp.status_code == 200
+        wants[name] = json.loads(resp.get_data())["data"]
+
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    for name in MACHINES:
+        resp = plane.client.post(
+            f"/gordo/v0/{PROJECT}/{name}/prediction",
+            data=body,
+            content_type="application/json",
+        )
+        assert resp.status_code == 200
+        assert json.loads(resp.get_data())["data"] == wants[name]
+
+
+def test_router_proxies_metadata_and_download(
+    shard_collection, monkeypatch, tmp_path
+):
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    meta = plane.client.get(f"/gordo/v0/{PROJECT}/{MACHINES[0]}/metadata")
+    assert meta.status_code == 200
+    payload = json.loads(meta.get_data())
+    assert payload["metadata"]["name"] == MACHINES[0]
+    blob = plane.client.get(
+        f"/gordo/v0/{PROJECT}/{MACHINES[0]}/download-model"
+    )
+    assert blob.status_code == 200
+    model = serializer.loads(blob.get_data())
+    assert model is not None
+
+
+def test_quarantined_machine_409s_through_router_unchanged(
+    shard_collection, monkeypatch, tmp_path
+):
+    """Router x PR-4 fault domains: a build-report casualty answers the
+    SAME structured 409 through the router as from a single server —
+    and it never reaches any replica."""
+    report = {
+        "version": 1,
+        "quarantined": [{"machine": MACHINES[2], "epoch": 1}],
+    }
+    report_path = shard_collection / "build_report.json"
+    report_path.write_text(json.dumps(report))
+    try:
+        single = WerkzeugClient(
+            _unsharded_app(shard_collection, monkeypatch)
+        )
+        direct = _post_fleet(single, MACHINES)
+        assert direct.status_code == 409
+
+        plane = make_plane(shard_collection, monkeypatch, tmp_path)
+        calls_before = sum(plane.adapter.calls.values())
+        routed = _post_fleet(plane.client, MACHINES)
+        assert routed.status_code == 409
+        assert sum(plane.adapter.calls.values()) == calls_before
+        direct_payload = json.loads(direct.get_data())
+        routed_payload = json.loads(routed.get_data())
+        assert routed_payload["unavailable"] == direct_payload["unavailable"]
+        assert "transient" not in routed_payload
+        # single-machine path too
+        resp = plane.client.post(
+            f"/gordo/v0/{PROJECT}/{MACHINES[2]}/prediction",
+            data=json.dumps({"X": _rows()}).encode(),
+            content_type="application/json",
+        )
+        assert resp.status_code == 409
+    finally:
+        report_path.unlink()
+
+
+def test_replica_death_names_exactly_its_shard_then_fails_over(
+    shard_collection, monkeypatch, tmp_path
+):
+    """Whole-replica ejection: during the window, partial results name
+    exactly the dead shard's machines (transient 409); after ejection,
+    failover to ring successors restores full responses with zero
+    casualties."""
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    shard_map = _shard_map()
+    victim = "r1"
+    victim_shard = set(shard_map[victim])
+    assert victim_shard, "fixture must give r1 a non-empty shard"
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR, f"replica:die:{victim}"
+    )
+    faults.reset()
+
+    # ejection window: each failing call names exactly the dead shard
+    statuses = []
+    for _ in range(3):  # EJECT_AFTER default 3
+        resp = _post_fleet(plane.client, MACHINES)
+        statuses.append(resp.status_code)
+        payload = json.loads(resp.get_data())
+        if resp.status_code == 409:
+            assert payload.get("transient") is True
+            assert set(payload["unavailable"]) == victim_shard
+            for info in payload["unavailable"].values():
+                assert info["reason"] == "replica_unavailable"
+        else:
+            break
+    assert statuses[0] == 409
+    assert plane.router.health.state(victim) == EJECTED
+
+    # steady state after failover: full data, zero casualties
+    failovers = get_registry().counter(
+        "gordo_router_failovers_total",
+        "Shard calls re-routed off their ring owner",
+    )
+    resp = _post_fleet(plane.client, MACHINES)
+    assert resp.status_code == 200
+    assert set(json.loads(resp.get_data())["data"]) == set(MACHINES)
+    assert failovers.value() > 0
+
+
+def test_dead_replica_readopted_without_router_restart(
+    shard_collection, monkeypatch, tmp_path
+):
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    victim = "r2"
+    victim_shard = set(_shard_map()[victim])
+    assert victim_shard
+    plane.kill(victim)
+    # drive to ejection
+    while plane.router.health.state(victim) != EJECTED:
+        _post_fleet(plane.client, MACHINES)
+    # replica restarts; the breaker is still open
+    plane.revive(victim)
+    resp = _post_fleet(plane.client, MACHINES)
+    assert resp.status_code == 200
+    calls_at_revival = plane.calls_to(victim)
+    # wait out the (tiny) ejection window; the active probe (the
+    # plane's prober thread, or our manual nudge) flips the breaker
+    # half-open
+    deadline = time.monotonic() + 5.0
+    while plane.router.health.state(victim) == EJECTED:
+        assert time.monotonic() < deadline, "replica never left ejection"
+        plane.router.probe_ejected()
+        time.sleep(0.01)
+    resp = _post_fleet(plane.client, MACHINES)
+    assert resp.status_code == 200
+    assert plane.router.health.state(victim) == HEALTHY
+    assert plane.calls_to(victim) > calls_at_revival  # traffic is back
+
+
+def test_slow_replica_hedges_to_successor(
+    shard_collection, monkeypatch, tmp_path
+):
+    plane = make_plane(
+        shard_collection, monkeypatch, tmp_path, HEDGE_MS=40.0
+    )
+    shard_map = _shard_map()
+    victim = "r0"
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR, f"replica:slow:{victim}@ms:1500"
+    )
+    faults.reset()
+    hedges = get_registry().counter(
+        "gordo_router_hedges_total",
+        "Hedge requests fired for straggling shard calls",
+    )
+    before = hedges.value()
+    start = time.monotonic()
+    resp = _post_fleet(plane.client, shard_map[victim])
+    elapsed = time.monotonic() - start
+    assert resp.status_code == 200
+    assert set(json.loads(resp.get_data())["data"]) == set(shard_map[victim])
+    assert hedges.value() == before + 1
+    # the hedge answered: nowhere near the 1.5s straggler
+    assert elapsed < 1.2
+
+
+def test_flapping_replica_ejects_and_recovers(
+    shard_collection, monkeypatch, tmp_path
+):
+    """replica:flap chaos: bursts of failure eject; the recovery legs
+    close the breaker through half-open — repeatedly, without operator
+    action. Pinned via the emitted events (the ejection window is
+    milliseconds here — sampling states would race the prober)."""
+    event_log = tmp_path / "flap-events.jsonl"
+    monkeypatch.setenv("GORDO_TPU_EVENT_LOG", str(event_log))
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    victim = "r1"
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR, f"replica:flap:{victim}@burst:3"
+    )
+    faults.reset()
+    for _ in range(12):
+        resp = _post_fleet(plane.client, MACHINES)
+        assert resp.status_code in (200, 409)
+        if resp.status_code == 409:
+            payload = json.loads(resp.get_data())
+            assert payload.get("transient") is True
+        time.sleep(0.02)
+        plane.router.probe_ejected()
+    events = read_events(str(event_log))
+    ejections = [
+        e for e in events
+        if e["event"] == "replica_ejected" and e["replica"] == victim
+    ]
+    recoveries = [
+        e for e in events
+        if e["event"] == "replica_recovered" and e["replica"] == victim
+    ]
+    assert ejections, "flap never ejected the replica"
+    assert recoveries, "flap pass legs never recovered the replica"
+
+
+def test_router_admission_control_sheds_structured_503(
+    shard_collection, monkeypatch, tmp_path
+):
+    plane = make_plane(shard_collection, monkeypatch, tmp_path, MAX_INFLIGHT=1)
+    # occupy the only slot
+    plane.router._inflight.acquire()
+    try:
+        resp = _post_fleet(plane.client, MACHINES[:2])
+        assert resp.status_code == 503
+        assert float(resp.headers["Retry-After"]) > 0
+        assert "max_inflight" in json.loads(resp.get_data())
+    finally:
+        plane.router._inflight.release()
+    assert _post_fleet(plane.client, MACHINES[:2]).status_code == 200
+
+
+def test_replica_shed_503_propagates_with_retry_after(
+    shard_collection, monkeypatch, tmp_path
+):
+    """A melting replica's structured shed passes through the router
+    untouched — Retry-After included — instead of being failover-sprayed
+    onto its peers."""
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    victim_netloc = "r0.test"
+
+    class Shedding:
+        def __call__(self, environ, start_response):
+            start_response(
+                "503 SERVICE UNAVAILABLE",
+                [("Content-Type", "application/json"), ("Retry-After", "2.5")],
+            )
+            return [json.dumps({"error": "queue full"}).encode()]
+
+    plane.adapter.adapters[victim_netloc] = WSGIAdapter(Shedding())
+    resp = _post_fleet(plane.client, MACHINES)
+    assert resp.status_code == 503
+    assert resp.headers["Retry-After"] == "2.5"
+    # shedding is NOT a health failure: the replica stays routable
+    assert plane.router.health.state("r0") == HEALTHY
+
+
+def test_membership_change_drains_and_adopts(
+    shard_collection, monkeypatch, tmp_path
+):
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    # drop r2 from membership: its shard redistributes, requests stay whole
+    resp = plane.client.post(
+        "/router/replicas",
+        data=json.dumps(
+            {"replicas": {"r0": "http://r0.test", "r1": "http://r1.test"}}
+        ).encode(),
+        content_type="application/json",
+    )
+    assert resp.status_code == 200
+    calls_r2 = plane.calls_to("r2")
+    resp = _post_fleet(plane.client, MACHINES)
+    assert resp.status_code == 200
+    assert set(json.loads(resp.get_data())["data"]) == set(MACHINES)
+    assert plane.calls_to("r2") == calls_r2  # drained: no new traffic
+    payload = json.loads(plane.client.get("/router/replicas").get_data())
+    assert sorted(payload["replicas"]) == ["r0", "r1"]
+
+
+def test_router_healthz_degrades_only_when_nothing_routable(
+    shard_collection, monkeypatch, tmp_path
+):
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    assert plane.client.get("/healthz").status_code == 200
+    for rid in plane.replica_ids:
+        plane.kill(rid)
+    while any(
+        plane.router.health.state(r) != EJECTED for r in plane.replica_ids
+    ):
+        _post_fleet(plane.client, MACHINES)
+    resp = plane.client.get("/healthz")
+    assert resp.status_code == 503
+    assert float(resp.headers["Retry-After"]) >= 0
+
+
+def test_membership_removal_forgets_replica_health(
+    shard_collection, monkeypatch, tmp_path
+):
+    """A drained replica must not haunt snapshots/gauges as a permanent
+    ghost after it leaves membership."""
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    plane.kill("r2")
+    while plane.router.health.state("r2") != EJECTED:
+        _post_fleet(plane.client, MACHINES)
+    plane.router.set_replicas(
+        {"r0": "http://r0.test", "r1": "http://r1.test"}
+    )
+    payload = json.loads(plane.client.get("/router/replicas").get_data())
+    assert sorted(payload["health"]) == ["r0", "r1"]
+    healthy = get_registry().gauge(
+        "gordo_router_replica_healthy",
+        "1 while the router considers the replica routable "
+        "(healthy/probation), 0 while ejected",
+        ("replica",),
+    )
+    series = healthy.snapshot()["series"]
+    assert all(s["labels"]["replica"] != "r2" for s in series)
+
+
+def test_manifest_drift_self_heals_via_adopt_retry(
+    shard_collection, monkeypatch, tmp_path
+):
+    """Router and replicas disagreeing on the ring (a membership change
+    one side hasn't seen): a replica's 421 is retried with the adopt
+    header on BOTH the single-machine and fleet paths — drift degrades
+    to an extra hop, never a hard failure."""
+    # same replica ids, different vnodes: the two rings disagree on some
+    # machines' owners while every id stays valid
+    plane = make_plane(shard_collection, monkeypatch, tmp_path, VNODES=8)
+    router_ring = HashRing([f"r{i}" for i in range(3)], 8)
+    replica_ring = HashRing([f"r{i}" for i in range(3)], 64)
+    drifted = [
+        m for m in MACHINES
+        if router_ring.owner(m) != replica_ring.owner(m)
+    ]
+    assert drifted, "vnode skew must produce at least one disagreement"
+    body = json.dumps({"X": _rows()}).encode()
+    for name in MACHINES:
+        resp = plane.client.post(
+            f"/gordo/v0/{PROJECT}/{name}/prediction",
+            data=body,
+            content_type="application/json",
+        )
+        assert resp.status_code == 200, (name, resp.get_data())
+    resp = _post_fleet(plane.client, MACHINES)
+    assert resp.status_code == 200
+    assert set(json.loads(resp.get_data())["data"]) == set(MACHINES)
+
+
+def test_header_pinned_revision_forwarded_to_replicas(
+    shard_collection, monkeypatch, tmp_path
+):
+    """A revision pinned via the `revision` HEADER (a form the server
+    surface supports) must ride the forwarded replica calls as a param —
+    otherwise replicas serve `latest` while the router stamps the pinned
+    name on the response."""
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    before = len(plane.adapter.urls)
+    resp = plane.client.post(
+        f"/gordo/v0/{PROJECT}/prediction/fleet",
+        data=_fleet_body(MACHINES[:2]),
+        content_type="application/json",
+        headers={"revision": "rev-1"},
+    )
+    assert resp.status_code == 200
+    assert resp.headers["revision"] == "rev-1"
+    forwarded = plane.adapter.urls[before:]
+    assert forwarded and all("revision=rev-1" in u for u in forwarded)
+
+
+def test_parse_replica_entries_shared_validation():
+    from gordo_tpu.router.app import parse_replica_entries
+
+    assert parse_replica_entries(
+        ["r0=http://h0:5555,r1=http://h1:5555/", "r2=http://h2:5555"]
+    ) == {
+        "r0": "http://h0:5555",
+        "r1": "http://h1:5555",
+        "r2": "http://h2:5555",
+    }
+    for bad in ("=http://h0:5555", "r0=", "r0"):
+        with pytest.raises(ValueError):
+            parse_replica_entries([bad])
+
+
+def test_fault_spec_replica_grammar_and_strict_noop(monkeypatch):
+    specs = faults.parse_spec(
+        "replica:die:r1@attempts:2;replica:slow:r0@ms:250;replica:flap:r2"
+    )
+    assert [s.mode for s in specs] == ["die", "slow", "flap"]
+    monkeypatch.delenv(faults.FAULT_INJECT_ENV_VAR, raising=False)
+    assert faults.replica_fault_action("r1") is None
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR, "replica:die:r1@attempts:2"
+    )
+    faults.reset()
+    assert faults.replica_fault_action("r0") is None  # other replica
+    assert faults.replica_fault_action("r1") == ("die", 0.0)
+    assert faults.replica_fault_action("r1") == ("die", 0.0)
+    assert faults.replica_fault_action("r1") is None  # attempts exhausted
+
+
+# -- the client through the router -----------------------------------------
+
+
+def test_client_fleet_partial_results_name_transient_casualties(
+    shard_collection, monkeypatch, tmp_path
+):
+    """The re-join contract end to end: the REAL client, one replica
+    dead, gets frames for every live shard and per-machine TRANSIENT
+    errors for the dead one — no exception, no silent loss."""
+    import dateutil.parser
+
+    from gordo_tpu.client import Client
+    from gordo_tpu.data.providers import RandomDataProvider
+    from tests.utils import loopback_session
+
+    plane = make_plane(shard_collection, monkeypatch, tmp_path)
+    victim = "r1"
+    victim_shard = set(_shard_map()[victim])
+    plane.kill(victim)
+
+    client = Client(
+        project=PROJECT,
+        host="router.test",
+        scheme="http",
+        data_provider=RandomDataProvider(),
+        session=loopback_session(plane.router),
+        parallelism=2,
+        n_retries=0,
+    )
+    # route groups to the BASE fleet endpoint (the machines are plain
+    # AutoEncoders): exercises the fleet-path transient-409 handling —
+    # drop the named casualties, re-POST the healthy remainder
+    client._fallback_machines.update(MACHINES)
+    start = dateutil.parser.isoparse("2019-01-01T00:00:00+00:00")
+    end = dateutil.parser.isoparse("2019-01-01T04:00:00+00:00")
+    results = client.predict_fleet(start, end, targets=MACHINES)
+    assert {r.name for r in results} == set(MACHINES)
+    for result in results:
+        if result.name in victim_shard:
+            assert result.error_messages, result.name
+            assert any(
+                "transient" in msg for msg in result.error_messages
+            ), result.error_messages
+        else:
+            assert not result.error_messages, (
+                result.name,
+                result.error_messages,
+            )
+            assert len(result.predictions) > 0
+
+
+# -- the chaos acceptance --------------------------------------------------
+
+
+def test_acceptance_three_replicas_survive_one_death(
+    shard_collection, monkeypatch, tmp_path
+):
+    """ISSUE 11 acceptance: 3 replicas under load, replica:die kills one
+    mid-run => zero non-structured errors (only named transient
+    casualties / 503+Retry-After during the ejection window), post-
+    failover goodput >= the healthy 2-replica baseline, the restarted
+    replica is re-adopted without restarting the router, and routed
+    predictions stay bit-identical to a single-process server."""
+    event_log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("GORDO_TPU_EVENT_LOG", str(event_log))
+
+    # baseline A: single-process whole-collection truth
+    single = WerkzeugClient(_unsharded_app(shard_collection, monkeypatch))
+    want = json.loads(_post_fleet(single, MACHINES).get_data())["data"]
+
+    # baseline B: healthy 2-replica plane goodput (machine-scores
+    # delivered / requested)
+    plane2 = make_plane(
+        shard_collection, monkeypatch, tmp_path, n_replicas=2
+    )
+    delivered = requested = 0
+    for _ in range(4):
+        resp = _post_fleet(plane2.client, MACHINES)
+        requested += len(MACHINES)
+        if resp.status_code == 200:
+            delivered += len(json.loads(resp.get_data())["data"])
+    goodput_2replica = delivered / requested
+    assert goodput_2replica == 1.0
+
+    plane = make_plane(shard_collection, monkeypatch, tmp_path, n_replicas=3)
+    victim = "r1"
+    victim_shard = set(_shard_map(3)[victim])
+
+    # phase 1 — healthy: bit-identity through the sharded plane
+    resp = _post_fleet(plane.client, MACHINES)
+    assert resp.status_code == 200
+    assert json.loads(resp.get_data())["data"] == want
+
+    # phase 2 — kill r1 mid-run; drive open-loop-ish load through the
+    # window. EVERY response must be structured: 200, a transient 409
+    # naming only dead-shard machines, or 503 with Retry-After.
+    monkeypatch.setenv(faults.FAULT_INJECT_ENV_VAR, f"replica:die:{victim}")
+    faults.reset()
+    window_statuses = []
+    for _ in range(6):
+        resp = _post_fleet(plane.client, MACHINES)
+        window_statuses.append(resp.status_code)
+        payload = json.loads(resp.get_data())
+        if resp.status_code == 409:
+            assert payload.get("transient") is True
+            assert set(payload["unavailable"]) <= victim_shard
+        elif resp.status_code == 503:
+            assert resp.headers.get("Retry-After")
+        else:
+            assert resp.status_code == 200, payload
+    assert 409 in window_statuses  # the window was actually exercised
+    assert plane.router.health.state(victim) == EJECTED
+
+    # phase 3 — steady state after failover: goodput >= the 2-replica
+    # baseline, responses bit-identical to the single-process truth
+    delivered = requested = 0
+    for _ in range(4):
+        resp = _post_fleet(plane.client, MACHINES)
+        requested += len(MACHINES)
+        assert resp.status_code == 200
+        data = json.loads(resp.get_data())["data"]
+        delivered += len(data)
+        assert data == want
+    assert delivered / requested >= goodput_2replica
+
+    # phase 4 — the replica restarts: chaos off, window expires, the
+    # active probe half-opens, traffic closes the breaker. No router
+    # restart.
+    monkeypatch.delenv(faults.FAULT_INJECT_ENV_VAR)
+    faults.reset()
+    deadline = time.monotonic() + 5.0
+    while plane.router.health.state(victim) == EJECTED:
+        assert time.monotonic() < deadline
+        plane.router.probe_ejected()
+        time.sleep(0.01)
+    calls_before = plane.calls_to(victim)
+    resp = _post_fleet(plane.client, MACHINES)
+    assert resp.status_code == 200
+    assert json.loads(resp.get_data())["data"] == want
+    assert plane.router.health.state(victim) == HEALTHY
+    assert plane.calls_to(victim) > calls_before
+
+    # the run left a structured audit trail
+    events = [e["event"] for e in read_events(str(event_log))]
+    assert "replica_ejected" in events
+    assert "shard_failover" in events
+    assert "replica_recovered" in events
+    assert "fault_injected" in events
