@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test lint bench bench-cold-start bench-hetero bench-sharded build-multiworker images push
+.PHONY: all test lint bench bench-cold-start bench-hetero bench-sharded bench-streaming build-multiworker images push
 
 all: lint test
 
@@ -47,6 +47,15 @@ bench-sharded:
 	python benchmarks/load_test.py --self-serve --open-loop --fleet 6 \
 		--replicas 1,2,4 --rps 4 --duration 15 --kill-replica-at 5 \
 		--output benchmarks/results_sharded_cpu_r11.json
+
+# streaming scoring plane (docs/serving.md "Streaming scoring"):
+# per-update p50/p99 and sustained updates/s at N concurrent streams,
+# mixed with the existing open-loop one-shot POST load — the one-shot
+# arm's p99 is what device-resident windows beat
+bench-streaming:
+	python benchmarks/stream_load.py --streams 1,4,16 --duration 10 \
+		--update-rows 5 --window-rows 256 --mixed-rps 2 \
+		--output benchmarks/results_stream_cpu_r12.json
 
 # 2-worker crash-tolerant ledger build of the example fleet config
 # (docs/robustness.md "Multi-worker builds") — the smoke proof that N

@@ -85,6 +85,11 @@ HOT_PATH_PATTERNS = (
     # use at all) in its fanout/health loops would stall the whole
     # serving plane
     "gordo_tpu/router/",
+    # the streaming plane scores thousands of updates per second from
+    # device-resident windows: an accidental per-update host sync in
+    # the session/window layer would forfeit exactly the O(update)
+    # transfer bound the subsystem exists to provide
+    "gordo_tpu/streaming/",
 )
 
 

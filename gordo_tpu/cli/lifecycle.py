@@ -32,6 +32,7 @@ def _config_from_params(params: dict):
         epoch_chunk=params["epoch_chunk"],
         fetch_retries=params["fetch_retries"],
         fetch_timeout=params["fetch_timeout"],
+        stream_observations=params["stream_observations"],
         promote=params["promote"],
         repoint=params["repoint"],
     )
@@ -109,6 +110,16 @@ _tick_options = [
         help="Per-machine cap (seconds) on drift-scan and refit data "
         "fetches; a hung data source is recorded on its machine "
         "instead of wedging the tick. Default: wait indefinitely.",
+    ),
+    click.option(
+        "--stream-observations",
+        default=None,
+        envvar="GORDO_TPU_EVENT_LOG",
+        help="JSONL event log whose accumulated stream_observation "
+        "events feed drift detection for streamed machines — those "
+        "machines skip the window-fetch scan entirely "
+        "(docs/lifecycle.md 'Scan-free ticks'). Default: the "
+        "GORDO_TPU_EVENT_LOG pipeline the serving plane emits into.",
     ),
     click.option(
         "--promote/--no-promote",

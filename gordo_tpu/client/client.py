@@ -995,6 +995,51 @@ class Client:
                     error_messages=[], revision=served_revision,
                 )
 
+    # -- streaming (docs/serving.md "Streaming scoring") -------------------
+
+    def stream_machine(
+        self,
+        machines: typing.Union[str, typing.Sequence[str]],
+        revision: Optional[str] = None,
+        backoff_scale: float = 1.0,
+    ):
+        """
+        Open a push-based scoring stream for one machine (or a sensor
+        group) — the continuous-monitoring counterpart of
+        :meth:`predict`::
+
+            with client.stream_machine("tag-farm-07") as stream:
+                for rows in live_feed:
+                    scores = stream.send(rows)
+
+        The returned :class:`~gordo_tpu.client.streaming.StreamPublisher`
+        keeps each machine's window tail for replay and reconnects
+        transparently (jittered backoff; 503 Retry-After honored on
+        open and update) when the session is shed, evicted, hot-rolled
+        to a new revision, or its replica fails over behind the router.
+        ``revision`` pins the stream to one revision (it then rides
+        every call); default follows the server's ``latest``, so a
+        lifecycle promotion mid-stream re-establishes the stream
+        against the new revision automatically.
+        """
+        from gordo_tpu.client.streaming import StreamPublisher
+
+        names = [machines] if isinstance(machines, str) else list(machines)
+        return StreamPublisher(
+            session=self.session,
+            server_endpoint=self.server_endpoint,
+            machines=names,
+            revision=revision,
+            n_retries=self.n_retries,
+            # connect timeout only: updates are SCORING calls, and the
+            # prediction path deliberately has no read timeout — a slow
+            # coalesced dispatch must not churn the session (the server
+            # would commit + emit the observation, then the resumed
+            # session would score those rows again)
+            timeout=(self.metadata_timeout, None),
+            backoff_scale=backoff_scale,
+        )
+
     # -- data --------------------------------------------------------------
 
     def _raw_data(

@@ -169,9 +169,37 @@ class DriftMonitor:
             raise ValueError(
                 f"Machine {machine!r}: no finite anomaly ratios to observe"
             )
-        ratio = float(ratios.mean())
-        exceedance = float((ratios > 1.0).mean())
+        return self.observe_stats(
+            machine,
+            ratio=float(ratios.mean()),
+            exceedance=float((ratios > 1.0).mean()),
+            revision=revision,
+        )
 
+    def observe_stats(
+        self,
+        machine: str,
+        ratio: float,
+        exceedance: float,
+        revision: str = "",
+    ) -> DriftAssessment:
+        """
+        Core state update from one observation's precomputed statistics
+        (mean anomaly/threshold ratio + exceedance fraction). This is
+        how accumulated ``stream_observation`` events feed the monitor:
+        the streaming plane computes the per-update statistics at score
+        time, the tick aggregates them per machine (weighted by row
+        count — exactly the statistic one scan window would have
+        produced) and lands here, window-fetch-free
+        (docs/lifecycle.md "Scan-free ticks").
+        """
+        ratio = float(ratio)
+        exceedance = float(exceedance)
+        if not (np.isfinite(ratio) and np.isfinite(exceedance)):
+            raise ValueError(
+                f"Machine {machine!r}: non-finite drift statistics "
+                f"(ratio={ratio}, exceedance={exceedance})"
+            )
         state = self._state.get(machine)
         if state is None:
             state = MachineDriftState()

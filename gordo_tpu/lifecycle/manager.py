@@ -75,6 +75,14 @@ class LifecycleConfig:
     #: must not wedge the tick (or the watch daemon) forever. None =
     #: wait indefinitely.
     fetch_timeout: typing.Optional[float] = None
+    #: streaming observation feed (docs/lifecycle.md "Scan-free
+    #: ticks"): path of the JSONL event log whose accumulated
+    #: ``stream_observation`` events feed the drift monitor for
+    #: streamed machines — those machines skip the window-fetch scan
+    #: entirely (the tick pays a fetch only if one of them actually
+    #: drifts and must refit). None = the ``GORDO_TPU_EVENT_LOG`` env
+    #: var at tick time (the same pipeline the server emits into).
+    stream_observations: typing.Optional[str] = None
     #: assemble + publish the new revision; False stops after the
     #: shadow verdicts (a dry run: report only, no revision)
     promote: bool = True
@@ -189,7 +197,40 @@ class LifecycleManager:
         monitored: typing.List[str] = []
 
         fetched: typing.Dict[str, tuple] = {}
+        # the streaming feed first (docs/lifecycle.md "Scan-free
+        # ticks"): machines whose accumulated stream_observation events
+        # cover this revision are assessed from those statistics and
+        # SKIP the window-fetch scan — the serving plane already scored
+        # their live data continuously
+        streamed_stats = self._consume_stream_observations(base_revision)
+        streamed: typing.Set[str] = set()
         with tracing.start_span("lifecycle.drift", n_machines=len(names)):
+            for name in sorted(streamed_stats):
+                if name not in names:
+                    continue
+                stats = streamed_stats[name]
+                try:
+                    assessment = self.monitor.observe_stats(
+                        name,
+                        ratio=stats["ratio"],
+                        exceedance=stats["exceedance"],
+                        revision=base_revision,
+                    )
+                except ValueError as exc:
+                    logger.warning(
+                        "Lifecycle: stream observations for %s unusable "
+                        "(%s); machine falls back to the scan",
+                        name, exc,
+                    )
+                    continue
+                streamed.add(name)
+                monitored.append(name)
+                decisions[name] = {
+                    "decision": "retained",
+                    "reason": "no_drift",
+                    "source": "stream",
+                    "drift": assessment.to_dict(),
+                }
             # serial metadata loads (local disk, cheap), then window
             # fetches POOLED in bounded chunks (per-machine network I/O
             # — the builder's fetch-pool shape), each machine scored on
@@ -204,6 +245,8 @@ class LifecycleManager:
             scan_windows: typing.Dict[str, dict] = {}
             scan_failures: typing.Dict[str, str] = {}
             for name in names:
+                if name in streamed:
+                    continue  # scan-free: the stream already scored it
                 meta = self._load_metadata(live_dir, name)
                 # the monitorability check loads the model and DROPS it
                 # (scoring reloads later): a second local deserialize is
@@ -263,6 +306,7 @@ class LifecycleManager:
                 }
             monitored.sort()
         self.monitor.save()
+        self._commit_stream_cursor()
         drifted = [n for n in monitored if self.monitor.state(n).drifted]
         get_registry().gauge(
             "gordo_lifecycle_drifted_machines",
@@ -280,8 +324,56 @@ class LifecycleManager:
             len(drifted), len(monitored), drifted,
         )
 
-        # every drifted machine was scanned, so its window is already
-        # computed — reuse the exact values the scan used
+        # streamed machines drifted without any scan fetch; refit and
+        # shadow still need the live model and a data window, so pay
+        # that I/O NOW, for exactly the drifted streamed subset — the
+        # scan-free tick's only window fetches, O(drifted) by
+        # construction (docs/lifecycle.md "Scan-free ticks")
+        stream_failures: typing.Dict[str, str] = {}
+        to_fetch: typing.Dict[str, dict] = {}
+        for name in [n for n in drifted if n in streamed]:
+            meta = machines_meta.get(name) or self._load_metadata(
+                live_dir, name
+            )
+            model = self._load_monitorable(live_dir, name)
+            if meta is None or model is None:
+                stream_failures[name] = "model or metadata not loadable"
+                continue
+            machines_meta[name] = meta
+            try:
+                scan_windows[name] = self._machine_window(meta)
+            except Exception as exc:  # noqa: BLE001 - fault domain
+                stream_failures[name] = str(exc)
+                continue
+            to_fetch[name] = scan_windows[name]
+            live_models[name] = model
+        if to_fetch:
+            for name, data in self._iter_windows(
+                to_fetch, machines_meta, stream_failures
+            ):
+                fetched[name] = data
+        for name in sorted(stream_failures):
+            logger.warning(
+                "Lifecycle: refit window for streamed machine %s "
+                "unavailable (%s); machine retained this tick",
+                name, stream_failures[name],
+            )
+            decisions[name].update(
+                decision="retained",
+                reason="refit_data_unavailable",
+                error=stream_failures[name],
+            )
+            drifted.remove(name)
+            live_models.pop(name, None)
+        if not drifted:
+            return self._finish(
+                start, base_revision, names, monitored, drifted,
+                decisions=decisions, promoted=[], rejected=[],
+                quarantined=[], revision_dir=None,
+            )
+
+        # every drifted machine's window is now computed (scan, or the
+        # refit-time fetch above) — reuse those exact values
         window = {name: scan_windows[name] for name in drifted}
         with tracing.start_span("lifecycle.refit", n_machines=len(drifted)):
             candidates, quarantine_records, refit_failures = self._refit(
@@ -692,6 +784,122 @@ class LifecycleManager:
             report_path=report_path,
             wall_time_s=wall,
         )
+
+    def _consume_stream_observations(
+        self, base_revision: str
+    ) -> typing.Dict[str, dict]:
+        """
+        Drain accumulated ``stream_observation`` events from the event
+        log (config ``stream_observations``, default the
+        ``GORDO_TPU_EVENT_LOG`` pipeline the serving plane emits into)
+        and aggregate them per machine, weighted by row count — exactly
+        the statistic one scan window over the same rows would produce.
+        A byte cursor under ``.lifecycle/`` makes consumption
+        incremental across ticks (each observation feeds the monitor
+        once); a truncated/rotated log resets it, and a torn trailing
+        line is left for the next tick. Observations stamped by a
+        DIFFERENT revision are dropped (counted) — the tick assesses
+        ``base_revision``, and the monitor's revision binding must not
+        be reset backwards by a pre-roll straggler.
+        """
+        from gordo_tpu.observability.events import EVENT_LOG_ENV_VAR
+
+        self._pending_stream_cursor = None
+        path = self.config.stream_observations or os.environ.get(
+            EVENT_LOG_ENV_VAR, ""
+        )
+        if not path or not os.path.isfile(path):
+            return {}
+        path = os.path.abspath(path)
+        cursor_path = os.path.join(self.state_dir, "stream_cursor.json")
+        offset = 0
+        try:
+            with open(cursor_path) as fh:
+                cursor = json.load(fh)
+            if cursor.get("path") == path:
+                offset = int(cursor.get("offset", 0))
+        except (OSError, ValueError, TypeError):
+            offset = 0
+        try:
+            if os.path.getsize(path) < offset:
+                offset = 0  # rotated/truncated: start over
+        except OSError:
+            return {}
+        totals: typing.Dict[str, typing.List[float]] = {}
+        consumed = offset
+        dropped_revisions = 0
+        try:
+            with open(path, "rb") as fh:
+                fh.seek(offset)
+                for raw in fh:
+                    if not raw.endswith(b"\n"):
+                        break  # torn trailing line: next tick's problem
+                    consumed += len(raw)
+                    try:
+                        record = json.loads(raw)
+                    except ValueError:
+                        continue
+                    if record.get("event") != "stream_observation":
+                        continue
+                    machine = record.get("machine")
+                    try:
+                        n = int(record.get("n") or 0)
+                        ratio = float(record.get("ratio_mean"))
+                        exceedance = float(record.get("exceedance"))
+                    except (TypeError, ValueError):
+                        continue
+                    if not machine or n <= 0:
+                        continue
+                    if record.get("revision") != base_revision:
+                        dropped_revisions += 1
+                        continue
+                    bucket = totals.setdefault(machine, [0.0, 0.0, 0.0])
+                    bucket[0] += n
+                    bucket[1] += n * ratio
+                    bucket[2] += n * exceedance
+        except OSError as exc:
+            logger.warning(
+                "Lifecycle: stream observation log %s unreadable (%s); "
+                "falling back to the scan", path, exc,
+            )
+            return {}
+        if consumed != offset:
+            # NOT persisted here: the cursor only advances once the
+            # drained statistics are safe in the monitor's saved state
+            # (_commit_stream_cursor, after monitor.save()) — a tick
+            # that dies in between must re-drain, not silently discard
+            # the consumed drift evidence
+            self._pending_stream_cursor = (
+                cursor_path,
+                {"path": path, "offset": consumed},
+            )
+        if dropped_revisions:
+            logger.info(
+                "Lifecycle: dropped %d stream observation(s) stamped by "
+                "other revisions than %s",
+                dropped_revisions, base_revision,
+            )
+        return {
+            machine: {
+                "n": int(n),
+                "ratio": ratio_sum / n,
+                "exceedance": exceedance_sum / n,
+            }
+            for machine, (n, ratio_sum, exceedance_sum) in totals.items()
+        }
+
+    def _commit_stream_cursor(self) -> None:
+        """Persist the advanced stream-observation cursor — called only
+        after ``monitor.save()`` so consumption is at-least-once: a
+        crash between drain and save re-drains the same bytes (the
+        monitor's windowed state makes the re-feed idempotent enough;
+        losing the evidence is the failure that matters)."""
+        from gordo_tpu.utils.atomic import atomic_write_json
+
+        pending = getattr(self, "_pending_stream_cursor", None)
+        if pending:
+            atomic_write_json(*pending)
+            self._pending_stream_cursor = None
 
     def _machine_window(self, meta: dict) -> dict:
         """The machine's drift/refit window and its holdout split point

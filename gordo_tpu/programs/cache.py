@@ -75,6 +75,14 @@ def hbm_headroom() -> typing.Optional[float]:
     return max(0.0, (limit - in_use) / limit)
 
 
+def min_headroom_fraction() -> float:
+    """The configured headroom floor (``GORDO_PROGRAM_MIN_HEADROOM``,
+    default :data:`DEFAULT_MIN_HEADROOM`) — public so other
+    device-resident caches (the streaming session table) can apply the
+    exact growth policy :func:`evict_lru` uses."""
+    return _env_float("GORDO_PROGRAM_MIN_HEADROOM", DEFAULT_MIN_HEADROOM)
+
+
 def evict_lru(
     cache: typing.Dict[typing.Any, typing.Any],
     bound: int,

@@ -63,7 +63,7 @@ FAULT_INJECT_ENV_VAR = "GORDO_FAULT_INJECT"
 _KNOWN_SITES = frozenset(
     {
         "fetch", "train", "ckpt", "serve", "batch", "drift", "refit",
-        "promote", "worker", "lease", "program", "replica",
+        "promote", "worker", "lease", "program", "replica", "stream",
     }
 )
 
@@ -563,6 +563,80 @@ def replica_fault_action(
             return ("slow", ms / 1000.0)
         registry.fire(spec, replica=replica_id)
         return ("die", 0.0)
+    return None
+
+
+def stream_fault_action(
+    machine_names: typing.Iterable[str],
+) -> typing.Optional[typing.Tuple[str, float]]:
+    """
+    The streaming-plane seam (site ``stream``, docs/serving.md
+    "Streaming scoring"): consulted by the server at the top of every
+    stream update, matched against the session's machine names (a spec
+    with no target hits every session). Returns what the update should
+    suffer, or None:
+
+    - ``stream:drop:<machine>`` -> ``("drop", 0)``: the server FORGETS
+      the session before processing — the update answers the structured
+      resume 409 and the client must reconnect + replay its window tail
+      (the reconnect-contract exercise). ``@attempts:N`` bounds it so
+      the replayed session survives.
+    - ``stream:stall:<machine>@ms:<m>`` -> ``("stall", seconds)``: the
+      handler sleeps that long before scoring — the straggling-stream
+      shape per-update p99 and backlog admission exist for. Default
+      250 ms; ``@attempts:N`` bounds it.
+    - ``stream:burst:<machine>@rate:<r>`` -> ``("burst", r)``: the
+      update is accounted as ``r`` simultaneous arrivals against the
+      session's backlog bound — a synthetic burst that drives the
+      admission shed (503 + Retry-After) and the /healthz not-ready
+      flip without needing a melting client. Default 8; ``@attempts:N``
+      bounds it.
+
+    Every suffered update fires a ``fault_injected`` event. Env unset
+    is the strict one-lookup no-op.
+    """
+    registry = active_registry()
+    if registry is None:
+        return None
+    names = list(machine_names)
+    for mode, default in (("drop", 0.0), ("stall", 250.0), ("burst", 8.0)):
+        spec = next(
+            (
+                s
+                for s in registry.specs
+                if s.site == "stream"
+                and s.mode == mode
+                and (s.target is None or s.target in names)
+            ),
+            None,
+        )
+        if spec is None:
+            continue
+        attempts = spec.param_int("attempts", 0)
+        if attempts and spec.fires >= attempts:
+            continue
+        if mode == "stall":
+            try:
+                value = float(spec.params.get("ms", default)) / 1000.0
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "Fault spec parameter @ms must be a number, got "
+                    f"{spec.params.get('ms')!r}"
+                )
+            registry.fire(spec, machines=names, ms=value * 1000.0)
+        elif mode == "burst":
+            try:
+                value = float(spec.params.get("rate", default))
+            except (TypeError, ValueError):
+                raise ValueError(
+                    "Fault spec parameter @rate must be a number, got "
+                    f"{spec.params.get('rate')!r}"
+                )
+            registry.fire(spec, machines=names, rate=value)
+        else:
+            value = 0.0
+            registry.fire(spec, machines=names)
+        return (mode, value)
     return None
 
 

@@ -33,6 +33,7 @@ import time
 import timeit
 import traceback
 import typing
+import uuid
 from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeout
 from concurrent.futures import wait as futures_wait
@@ -117,6 +118,53 @@ class _RequestCtx:
         if self.requested_revision and "revision" not in params:
             params["revision"] = self.requested_revision
         return params
+
+
+class _StreamProxy:
+    """One router-held stream session: the client sees ONE session id;
+    behind it live per-replica sub-sessions covering the machines each
+    replica's shard (or failover successor) owns. ``stale`` marks it
+    for the resume contract — set on replica failure mid-update and on
+    every membership change (drain: the next update answers the
+    structured resume 409 and the client re-establishes on the current
+    ring)."""
+
+    __slots__ = (
+        "sid", "machines", "subs", "stale", "last_active",
+        "project", "params",
+    )
+
+    def __init__(
+        self,
+        sid: str,
+        machines: typing.List[str],
+        subs: list,
+        project: str = "",
+        params=None,
+    ):
+        self.sid = sid
+        self.machines = machines
+        #: [{"rid", "url", "sid", "machines"}]
+        self.subs = subs
+        self.stale = False
+        self.last_active = time.monotonic()
+        #: the project + forwarded params this proxy was OPENED under —
+        #: hygiene purges close its sub-sessions with these, not with
+        #: whatever project/revision the purging request happens to
+        #: carry (a mismatch would refuse at the replica and leak the
+        #: device-resident windows the purge exists to free)
+        self.project = project
+        self.params = params
+
+
+#: bounds on the router's held-stream table: a publisher that crashes
+#: without closing leaves a proxy nobody will ever update, so opens
+#: opportunistically purge proxies idle past the window, and the table
+#: never grows past the count bound (oldest evicted — safe: an evicted
+#: session's next update answers the resume contract). The replicas'
+#: own session tables are bounded separately (GORDO_STREAM_MAX_SESSIONS).
+STREAM_PROXY_BOUND = 4096
+STREAM_PROXY_IDLE_S = 900.0
 
 
 class _ShardResult:
@@ -243,9 +291,30 @@ class RouterApp:
                     endpoint="fleet_prediction",
                     methods=["POST"],
                 ),
+                # streaming scoring plane (docs/serving.md "Streaming
+                # scoring"): the router presents ONE session over N
+                # shard replicas' sub-sessions
+                Rule(
+                    "/gordo/v0/<gordo_project>/stream/open",
+                    endpoint="stream_open",
+                    methods=["POST"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/stream/<stream_id>/update",
+                    endpoint="stream_update",
+                    methods=["POST"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/stream/<stream_id>/close",
+                    endpoint="stream_close",
+                    methods=["POST"],
+                ),
             ],
             strict_slashes=False,
         )
+        #: router-held stream sessions (docs/serving.md)
+        self._streams: typing.Dict[str, _StreamProxy] = {}
+        self._streams_lock = threading.Lock()
 
     # -- membership (drain/adopt) ------------------------------------------
 
@@ -275,11 +344,22 @@ class RouterApp:
         removed = sorted(previous - set(replicas))
         for rid in removed:
             self.health.forget(rid)
+        # drain the stream plane: every held session's machine->replica
+        # partition may have moved, so the next update per session
+        # answers the resume contract and the client re-establishes
+        # against the NEW ring (docs/serving.md "Streaming scoring")
+        with self._streams_lock:
+            n_streams = 0
+            for proxy in self._streams.values():
+                if not proxy.stale:
+                    proxy.stale = True
+                    n_streams += 1
         emit_event(
             "router_membership_changed",
             added=sorted(set(replicas) - previous),
             removed=removed,
             n_replicas=len(replicas),
+            n_streams_drained=n_streams,
         )
 
     def close(self) -> None:
@@ -1268,6 +1348,376 @@ class RouterApp:
                     f"{timeit.default_timer() - ctx.start_time:.4f}"
                 ),
             }
+        )
+
+
+    # -- views: streaming (docs/serving.md "Streaming scoring") ------------
+
+    def _stream_resume_error(
+        self,
+        reason: str,
+        machines: typing.Sequence[str],
+        replicas: typing.Sequence[str] = (),
+    ) -> ApiError:
+        """The structured resume 409 — same body shape as a replica's
+        own, so the client publisher cannot tell the router from a
+        single server: it reconnects (through the router) and replays,
+        landing on whatever the CURRENT ring routes to."""
+        return ApiError(
+            {
+                "error": f"Stream session gone ({reason})",
+                "stream_resume": {
+                    "reason": reason,
+                    "machines": sorted(machines),
+                },
+                "transient": True,
+                "retry_after_s": self._shard_retry_after(list(replicas)),
+            },
+            409,
+        )
+
+    def view_stream_open(
+        self, ctx, request, gordo_project: str
+    ) -> Response:
+        # the SERVER's parser, shared verbatim (like
+        # GordoApp._fleet_request_machines on the fleet path): the
+        # router forwards the normalized form, so the wire contract
+        # cannot drift between the two sides
+        machines_spec = GordoApp._stream_machines_spec(
+            request.get_json(silent=True) or {}
+        )
+        if machines_spec is None:
+            return _json_response(
+                {
+                    "error": "Body must carry a non-empty 'machines' list "
+                    "or mapping."
+                },
+                400,
+            )
+        names = sorted(machines_spec)
+        self._refuse_unavailable(ctx, names)
+        self._admit()
+        started = timeit.default_timer()
+        try:
+            return self._stream_open(
+                ctx, request, gordo_project, machines_spec, names
+            )
+        finally:
+            self._release(started)
+
+    def _stream_open(
+        self, ctx, request, gordo_project, machines_spec, names
+    ) -> Response:
+        replicas, ring = self.routing_view()
+        routable = {r for r in replicas if self.health.routable(r)}
+        shards: typing.Dict[str, typing.List[str]] = {}
+        owners: typing.Dict[str, str] = {}
+        dead: typing.Dict[str, str] = {}
+        for name in names:
+            owner = ring.owner(name)
+            owners[name] = owner
+            target = (
+                owner
+                if owner in routable
+                else next(
+                    (r for r in ring.preference(name) if r in routable), None
+                )
+            )
+            if target is None:
+                dead[name] = owner
+            else:
+                shards.setdefault(target, []).append(name)
+        if dead:
+            self._count_request("partial")
+            raise self._stream_resume_error(
+                "every candidate replica is ejected", dead, dead.values()
+            )
+        parent_ctx = tracing.current_context()
+        params = ctx.forward_params(request)
+        subs: typing.List[dict] = []
+        merged: typing.Dict[str, dict] = {}
+        try:
+            for rid, group in sorted(shards.items()):
+                adopted = any(owners[m] != rid for m in group)
+                for owner in sorted(
+                    {owners[m] for m in group if owners[m] != rid}
+                ):
+                    self._note_failover(
+                        owner, rid, sum(1 for m in group if owners[m] == owner)
+                    )
+                resp = self._replica_call(
+                    rid,
+                    replicas[rid],
+                    "POST",
+                    f"/gordo/v0/{gordo_project}/stream/open",
+                    params=params,
+                    json_body={
+                        "machines": {m: machines_spec[m] for m in group}
+                    },
+                    headers={ADOPT_HEADER: "failover"} if adopted else None,
+                    span_name="router.failover" if adopted else "router.fanout",
+                    span_attrs={"n_machines": len(group), "stream": True},
+                    parent_ctx=parent_ctx,
+                )
+                if resp.status_code == 503:
+                    out = self._passthrough(resp)
+                    self._count_request("shed")
+                    self._close_subs(subs, gordo_project, params)
+                    return out
+                if resp.status_code in (400, 404, 410, 422) or (
+                    resp.status_code == 409
+                    and not (self._body_of(resp) or {}).get("transient")
+                ):
+                    # a deterministic refusal (bad spec, non-streamable
+                    # or quarantined machine): repeatable, so it passes
+                    # through VERBATIM — wrapping it as a transient
+                    # resume would make the client retry a permanent
+                    # condition and bury the real message
+                    out = self._passthrough(resp)
+                    self._count_request("refused")
+                    self._close_subs(subs, gordo_project, params)
+                    return out
+                if resp.status_code >= 300:
+                    raise IOError(
+                        f"replica {rid} refused stream open "
+                        f"({resp.status_code}): {resp.text[:300]}"
+                    )
+                payload = resp.json()
+                subs.append(
+                    {
+                        "rid": rid,
+                        "url": replicas[rid],
+                        "sid": payload["session"],
+                        "machines": list(group),
+                    }
+                )
+                merged.update(payload.get("machines") or {})
+        except Exception as exc:
+            self._close_subs(subs, gordo_project, params)
+            self._count_request("partial")
+            raise self._stream_resume_error(
+                f"stream open failed ({exc})", names, shards.keys()
+            )
+        proxy = _StreamProxy(
+            uuid.uuid4().hex[:16], list(names), subs,
+            project=gordo_project, params=params,
+        )
+        evicted: typing.List[_StreamProxy] = []
+        with self._streams_lock:
+            # opportunistic hygiene: purge abandoned proxies (a crashed
+            # publisher never closes), and bound the table — an evicted
+            # session costs its client one resume round-trip, never an
+            # unbounded router footprint
+            now = time.monotonic()
+            for sid in [
+                s
+                for s, p in self._streams.items()
+                if p.stale or now - p.last_active > STREAM_PROXY_IDLE_S
+            ]:
+                evicted.append(self._streams.pop(sid))
+            while len(self._streams) >= STREAM_PROXY_BOUND:
+                evicted.append(self._streams.pop(next(iter(self._streams))))
+            self._streams[proxy.sid] = proxy
+        for old in evicted:
+            # free the replicas' device-resident windows now instead of
+            # letting them idle to each replica's own eviction bound —
+            # under the project/params the EVICTED proxy was opened with
+            self._close_subs(
+                old.subs, old.project or gordo_project, old.params
+            )
+        self._count_request("ok")
+        return _json_response(
+            {"session": proxy.sid, "machines": merged}, 201
+        )
+
+    def _close_subs(self, subs: typing.List[dict], project: str, params):
+        """Best-effort close of downstream sub-sessions (their windows
+        free now instead of idling to eviction)."""
+        for sub in subs:
+            try:
+                self._replica_call(
+                    sub["rid"],
+                    sub["url"],
+                    "POST",
+                    f"/gordo/v0/{project}/stream/{sub['sid']}/close",
+                    params=params,
+                    span_attrs={"stream": True},
+                )
+            except Exception:  # noqa: BLE001 - cleanup only
+                pass
+
+    def view_stream_update(
+        self, ctx, request, gordo_project: str, stream_id: str
+    ) -> Response:
+        with self._streams_lock:
+            proxy = self._streams.get(stream_id)
+            if proxy is not None and proxy.stale:
+                self._streams.pop(stream_id, None)
+        if proxy is None:
+            raise self._stream_resume_error("unknown_session", [])
+        if proxy.stale:
+            self._close_subs(
+                proxy.subs, proxy.project or gordo_project, proxy.params
+            )
+            raise self._stream_resume_error(
+                "membership_changed", proxy.machines
+            )
+        proxy.last_active = time.monotonic()
+        body = request.get_json(silent=True) or {}
+        updates = body.get("updates")
+        if not isinstance(updates, dict) or not updates:
+            return _json_response(
+                {"error": "Body must carry a non-empty 'updates' mapping."},
+                400,
+            )
+        unknown = sorted(set(updates) - set(proxy.machines))
+        if unknown:
+            return _json_response(
+                {"error": f"Machine(s) not in stream session: {unknown}"},
+                400,
+            )
+        self._admit()
+        started = timeit.default_timer()
+        try:
+            return self._stream_fanout(
+                ctx, request, gordo_project, proxy, updates
+            )
+        finally:
+            self._release(started)
+
+    def _stream_fanout(
+        self, ctx, request, gordo_project, proxy, updates
+    ) -> Response:
+        params = ctx.forward_params(request)
+        parent_ctx = tracing.current_context()
+        jobs = [
+            (sub, {m: updates[m] for m in sub["machines"] if m in updates})
+            for sub in proxy.subs
+        ]
+        jobs = [(sub, payload) for sub, payload in jobs if payload]
+
+        def call(sub, payload):
+            return self._replica_call(
+                sub["rid"],
+                sub["url"],
+                "POST",
+                f"/gordo/v0/{gordo_project}/stream/{sub['sid']}/update",
+                params=params,
+                json_body={"updates": payload},
+                span_attrs={"n_machines": len(payload), "stream": True},
+                parent_ctx=parent_ctx,
+            )
+
+        results: typing.List[typing.Tuple[dict, typing.Any]] = []
+        try:
+            if len(jobs) == 1:
+                results = [(jobs[0][0], call(*jobs[0]))]
+            elif jobs:
+                with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
+                    futures = [
+                        (sub, pool.submit(call, sub, payload))
+                        for sub, payload in jobs
+                    ]
+                    results = [(sub, f.result()) for sub, f in futures]
+        except Exception as exc:
+            # a dead replica mid-stream: the breaker is already fed (it
+            # drives ejection, so the client's re-open lands on the
+            # successor); this session answers the resume contract
+            proxy.stale = True
+            self._count_request("partial")
+            raise self._stream_resume_error(
+                f"replica failed mid-stream ({exc})",
+                proxy.machines,
+                [sub["rid"] for sub, _ in jobs],
+            )
+        # classify ALL sub-outcomes before answering: a sub that
+        # answered 200 already COMMITTED its machines' rows, so once
+        # any sub succeeded the only safe non-200 answer is the resume
+        # contract (the client's replayed tail re-anchors every
+        # sub-session and the rows re-score) — passing a peer's 503
+        # through would make the client retry the same seqs against the
+        # committed sub, which trims them as overlap and their scores
+        # would be lost for good
+        scores: typing.Dict[str, dict] = {}
+        ok = []
+        shed = []
+        refused = []
+        lost = []
+        for sub, resp in results:
+            if 200 <= resp.status_code < 300:
+                try:
+                    scores.update(resp.json().get("scores") or {})
+                    ok.append(sub)
+                    continue
+                except ValueError:
+                    lost.append((sub, "unparseable response"))
+            elif resp.status_code == 503:
+                shed.append((sub, resp))
+            elif resp.status_code in (400, 404, 422) or (
+                resp.status_code == 409
+                and "stream_resume" not in (self._body_of(resp) or {})
+            ):
+                # deterministic client-side 4xx (bad rows, quarantined
+                # machine): repeatable, so surface it VERBATIM — a
+                # resume/replay loop would re-send the same bad input
+                # forever and bury the real message
+                refused.append((sub, resp))
+            else:
+                # downstream resume 409 (replica evicted/rolled its own
+                # session), 421 manifest drift, 5xx: session-loss shapes
+                lost.append((sub, f"answered {resp.status_code}"))
+        if refused:
+            # another sub may have COMMITTED (ok) or broken (lost) while
+            # this one refused: the 4xx still surfaces verbatim NOW, but
+            # the proxy goes stale so the NEXT update answers the resume
+            # contract and re-anchors every sub-session's seq — without
+            # this, the committed sub is ahead of the client's cursor
+            # and would trim the next update's fresh rows as overlap
+            if ok or lost:
+                proxy.stale = True
+            self._count_request("refused")
+            return self._passthrough(sorted(
+                refused, key=lambda pair: pair[0]["rid"]
+            )[0][1])
+        if shed and not ok and not lost:
+            # nothing committed anywhere: the shed propagates untouched
+            # and the client's Retry-After retry is exact
+            out = self._passthrough(shed[0][1])
+            self._count_request("shed")
+            return out
+        if lost or shed:
+            proxy.stale = True
+            self._count_request("partial")
+            raise self._stream_resume_error(
+                "; ".join(
+                    [f"replica {sub['rid']} {why}" for sub, why in lost]
+                    + [f"replica {sub['rid']} shed mid-update" for sub, _ in shed]
+                ),
+                proxy.machines,
+                [sub["rid"] for sub, _ in lost + shed],
+            )
+        self._count_request("ok")
+        return _json_response({"session": proxy.sid, "scores": scores})
+
+    @staticmethod
+    def _body_of(resp) -> typing.Optional[dict]:
+        try:
+            body = resp.json()
+        except ValueError:
+            return None
+        return body if isinstance(body, dict) else None
+
+    def view_stream_close(
+        self, ctx, request, gordo_project: str, stream_id: str
+    ) -> Response:
+        with self._streams_lock:
+            proxy = self._streams.pop(stream_id, None)
+        if proxy is not None:
+            self._close_subs(
+                proxy.subs, gordo_project, ctx.forward_params(request)
+            )
+        return _json_response(
+            {"session": stream_id, "closed": proxy is not None}
         )
 
 

@@ -32,6 +32,7 @@ import logging
 import os
 import re
 import threading
+import time
 import timeit
 import traceback
 import typing
@@ -56,6 +57,7 @@ from gordo_tpu.server.catalog import (
     resolve_sibling_revision,
 )
 from gordo_tpu.server.utils import ApiError
+from gordo_tpu.streaming import session as stream_session
 from gordo_tpu.utils.compat import normalize_frequency
 
 logger = logging.getLogger(__name__)
@@ -96,6 +98,20 @@ class Config:
     #: everything — the cold-start benchmark's control arm
     #: (GORDO_AOT_CACHE).
     AOT_CACHE = True
+    #: streaming scoring plane (docs/serving.md "Streaming scoring"):
+    #: count bound on live stream sessions — device-resident windows
+    #: are device memory, so on real accelerators the HBM headroom
+    #: signal governs growth past it (the PR-9 ProgramCache
+    #: discipline). Env fallback (GORDO_STREAM_MAX_SESSIONS).
+    STREAM_MAX_SESSIONS = stream_session.DEFAULT_MAX_SESSIONS
+    #: per-session update backlog bound: concurrent updates past this
+    #: shed with 503 + Retry-After, and /healthz reads not-ready while
+    #: any session is saturated (GORDO_STREAM_MAX_BACKLOG)
+    STREAM_MAX_BACKLOG = stream_session.DEFAULT_MAX_BACKLOG
+    #: a stream untouched this long counts idle: open-admission may
+    #: evict it for a new stream instead of shedding
+    #: (GORDO_STREAM_IDLE_S)
+    STREAM_IDLE_S = stream_session.DEFAULT_IDLE_AFTER_S
     #: sharded serving plane (docs/serving.md): path of the shard
     #: manifest naming the replica set this process serves a shard of;
     #: None (default) = the historical whole-collection replica.
@@ -223,6 +239,25 @@ class GordoApp:
                     endpoint="fleet_anomaly_prediction",
                     methods=["POST"],
                 ),
+                # streaming scoring plane (docs/serving.md "Streaming
+                # scoring"): a long-lived session per sensor group with
+                # device-resident sliding windows; incremental updates
+                # ride the same stacked dispatch as one-shot POSTs
+                Rule(
+                    "/gordo/v0/<gordo_project>/stream/open",
+                    endpoint="stream_open",
+                    methods=["POST"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/stream/<stream_id>/update",
+                    endpoint="stream_update",
+                    methods=["POST"],
+                ),
+                Rule(
+                    "/gordo/v0/<gordo_project>/stream/<stream_id>/close",
+                    endpoint="stream_close",
+                    methods=["POST"],
+                ),
             ],
             strict_slashes=False,
         )
@@ -235,6 +270,14 @@ class GordoApp:
         self.batch_queue_limit = int(self.config.get("BATCH_QUEUE_LIMIT") or 64)
         self.scorer_cache_size = int(self.config.get("SCORER_CACHE_SIZE") or 16)
         self.aot_cache_enabled = bool(self.config.get("AOT_CACHE", True))
+        self.stream_max_sessions = int(
+            self.config.get("STREAM_MAX_SESSIONS")
+            or stream_session.DEFAULT_MAX_SESSIONS
+        )
+        self.stream_max_backlog = int(
+            self.config.get("STREAM_MAX_BACKLOG")
+            or stream_session.DEFAULT_MAX_BACKLOG
+        )
         shard = None
         if self.config.get("SHARD_MANIFEST"):
             shard = ShardSpec.load(
@@ -252,6 +295,15 @@ class GordoApp:
             batch_wait_s=self.batch_wait_s,
             batch_queue_limit=self.batch_queue_limit,
             shard=shard,
+            stream_max_sessions=self.stream_max_sessions,
+            stream_max_backlog=self.stream_max_backlog,
+            # explicit None check: an idle window of 0 ("every stream
+            # is always evictable") is a valid setting, not an unset one
+            stream_idle_after_s=float(
+                stream_session.DEFAULT_IDLE_AFTER_S
+                if self.config.get("STREAM_IDLE_S") is None
+                else self.config["STREAM_IDLE_S"]
+            ),
         )
         # hot promotion (docs/lifecycle.md): the real path last served as
         # "latest". When MODEL_COLLECTION_DIR is a `latest` symlink and a
@@ -346,6 +398,33 @@ class GordoApp:
                 503,
             )
             response.headers["Retry-After"] = str(exc.retry_after_s)
+        except stream_session.StreamShed as exc:
+            # streaming admission control: same 503 + Retry-After
+            # contract as the batching shed (docs/serving.md
+            # "Streaming scoring")
+            stream_session.count_update("shed")
+            emit_event("stream_update_shed", retry_after_s=exc.retry_after_s)
+            response = _json_response(
+                {"error": str(exc), "retry_after_s": exc.retry_after_s}, 503
+            )
+            response.headers["Retry-After"] = str(exc.retry_after_s)
+        except stream_session.StreamGone as exc:
+            # the reconnect contract: a structured, transient 409 naming
+            # the reason — the client publisher re-opens with a
+            # window-tail replay (docs/serving.md "Streaming scoring")
+            stream_session.count_update("resume_required")
+            response = _json_response(
+                {
+                    "error": str(exc),
+                    "stream_resume": {
+                        "reason": exc.reason,
+                        "machines": exc.machines,
+                    },
+                    "transient": True,
+                    "retry_after_s": 1,
+                },
+                409,
+            )
         except faults.InjectedFault as exc:
             # the serve-site chaos seam: a distinguishable 503, so chaos
             # tests can tell an injected fault from a real server error
@@ -440,6 +519,11 @@ class GordoApp:
         if previous is None:
             return  # first request of the process: nothing rolled
         n_stopped = self.catalog.stop_stale_batchers(latest_real)
+        # stream sessions roll with the revision too: their resident
+        # windows (and anomaly thresholds) belong to the OLD params, so
+        # they expire and clients re-establish on the new revision via
+        # the resume contract (docs/serving.md "Streaming scoring")
+        n_streams = self.catalog.expire_stale_streams(latest_real)
         get_registry().counter(
             "gordo_server_revision_rolls_total",
             "Hot promotions observed by this server (latest symlink flips)",
@@ -449,11 +533,12 @@ class GordoApp:
             previous=os.path.basename(previous),
             current=os.path.basename(latest_real),
             n_batchers_stopped=n_stopped,
+            n_streams_expired=n_streams,
         )
         logger.info(
             "Revision rolled: now serving %s as latest (was %s); "
-            "%d stale batcher(s) stopped",
-            latest_real, previous, n_stopped,
+            "%d stale batcher(s) stopped, %d stream session(s) expired",
+            latest_real, previous, n_stopped, n_streams,
         )
 
     def _finalize(
@@ -611,6 +696,12 @@ class GordoApp:
         "fleet_anomaly_prediction": (
             "Batched multi-machine anomaly scoring (TPU extension)"
         ),
+        "stream_open": "Open a streaming scoring session (TPU extension)",
+        "stream_update": (
+            "Push incremental sensor rows to a stream session; scores "
+            "return inline"
+        ),
+        "stream_close": "Close a streaming scoring session",
     }
 
     def view_specs(self, ctx, request) -> Response:
@@ -917,14 +1008,19 @@ class GordoApp:
         """
         Readiness (``/healthcheck`` stays pure liveness): 200 while this
         replica can absorb work; 503 + Retry-After when the batching
-        queue is saturated or actively shedding, so an external load
-        balancer drains a melting replica instead of piling onto it.
-        Queue depth and shed counters ride the body either way.
+        queue is saturated or actively shedding, OR when any stream
+        session's update backlog is saturated — either way the
+        router/LB drains this replica before users see stalls. Queue
+        depths and shed counters ride the body either way.
         """
         stats = self.catalog.batcher_stats()
         overloaded = [s for s in stats if s["saturated"] or s["shedding"]]
+        stream_stats = self.catalog.stream_stats()
+        stream_overloaded = [s for s in stream_stats if s["saturated"]]
         payload = {
-            "status": "overloaded" if overloaded else "ok",
+            "status": (
+                "overloaded" if overloaded or stream_overloaded else "ok"
+            ),
             "batching": {
                 "enabled": self.batch_wait_s > 0,
                 "batch_wait_ms": self.batch_wait_s * 1000.0,
@@ -934,11 +1030,21 @@ class GordoApp:
                 "sheds_total": sum(s["sheds_total"] for s in stats),
                 "shedding": any(s["shedding"] for s in stats),
             },
+            "streaming": {
+                "sessions": len(stream_stats),
+                "max_sessions": self.stream_max_sessions,
+                "max_backlog": self.stream_max_backlog,
+                "backlog": sum(s["pending"] for s in stream_stats),
+                "saturated_sessions": len(stream_overloaded),
+            },
         }
-        if overloaded:
+        if overloaded or stream_overloaded:
             response = _json_response(payload, 503)
             response.headers["Retry-After"] = str(
-                max(s["retry_after_s"] for s in overloaded)
+                max(
+                    s["retry_after_s"]
+                    for s in overloaded + stream_overloaded
+                )
             )
             return response
         return _json_response(payload)
@@ -1202,6 +1308,278 @@ class GordoApp:
         }
         return _json_response(context, 200)
 
+    # -- streaming scoring (docs/serving.md "Streaming scoring") -----------
+
+    @staticmethod
+    def _stream_machines_spec(
+        body: dict,
+    ) -> typing.Optional[typing.Dict[str, dict]]:
+        """The open body's ``machines`` normalized to ``{name: spec}``
+        (a bare list means empty specs; the dict form carries per-
+        machine ``resume`` blocks), or None when absent/empty. ONE
+        parser — the router forwards the normalized form to replicas,
+        so the two sides cannot drift."""
+        spec = body.get("machines")
+        if isinstance(spec, list) and spec:
+            return {str(name): {} for name in spec}
+        if isinstance(spec, dict) and spec:
+            normalized = {}
+            for name, entry in spec.items():
+                if entry is not None and not isinstance(entry, dict):
+                    return None
+                entry = entry or {}
+                if entry.get("resume") is not None and not isinstance(
+                    entry["resume"], dict
+                ):
+                    return None
+                normalized[str(name)] = entry
+            return normalized
+        return None
+
+    @staticmethod
+    def _stream_transform(steps: typing.List) -> typing.Callable:
+        """The per-machine host prefix transform, matching the one-shot
+        fleet path bit for bit: raw rows as float64 (the parsed-frame
+        dtype), each sklearn prefix step applied, cast float32 last —
+        scalers transform row-wise, so transforming an update's k rows
+        alone equals transforming them inside a larger frame."""
+
+        def transform(rows: np.ndarray) -> np.ndarray:
+            out = np.asarray(rows, dtype="float64")
+            for step in steps:
+                out = step.transform(out)
+            return np.asarray(out, dtype="float32")
+
+        return transform
+
+    def view_stream_open(self, ctx, request, gordo_project: str) -> Response:
+        """
+        Open one stream session for a sensor group. Body::
+
+            {"machines": ["m1", "m2"]}
+            {"machines": {"m1": {"resume": {"rows": [[...]], "seq": 40}}}}
+
+        The ``resume`` form is the reconnect contract: ``rows`` are the
+        client's replayed window tail (raw, untransformed), ``seq`` the
+        index of the first replayed row; the server rebuilds the
+        device-resident context from them and never re-scores them.
+        Sheds 503 + Retry-After when the session table is full of
+        active streams (the client's open honors it like any POST).
+        """
+        machines_spec = self._stream_machines_spec(
+            request.get_json(silent=True) or {}
+        )
+        if machines_spec is None:
+            return _json_response(
+                {
+                    "error": "Body must carry a non-empty 'machines' list "
+                    "or mapping."
+                },
+                400,
+            )
+        names = tuple(sorted(machines_spec))
+        self._refuse_unavailable(ctx, names)
+        self._refuse_wrong_shard(request, names)
+        scorer, prefixes, fallback = self._get_fleet_scorer(ctx, names)
+        if fallback or scorer is None:
+            return _json_response(
+                {
+                    "message": "Machine(s) cannot stream (no stacked JAX "
+                    "estimator to keep a device-resident window for): "
+                    + ", ".join(sorted(fallback) or names)
+                },
+                422,
+            )
+        with tracing.start_span(
+            "stream.session", n_machines=len(names)
+        ) as span:
+            streams: typing.Dict[str, stream_session.MachineStream] = {}
+            resumed = []
+            for name in names:
+                geometry = scorer.machine_geometry(name)
+                transform = self._stream_transform(prefixes.get(name, []))
+                model = self._get_model(ctx, name)
+                stream = stream_session.MachineStream(
+                    name,
+                    lookback=geometry["lookback"],
+                    lookahead=geometry["lookahead"],
+                    n_features=geometry["n_features"],
+                    transform=transform,
+                    scaler=getattr(model, "scaler", None),
+                    threshold=getattr(model, "aggregate_threshold_", None),
+                )
+                resume = machines_spec[name].get("resume")
+                if resume:
+                    rows = np.asarray(
+                        resume.get("rows") or [], dtype="float64"
+                    )
+                    if len(rows) and rows.shape[-1] != geometry["n_features"]:
+                        return _json_response(
+                            {
+                                "error": f"Machine {name!r} resume rows "
+                                f"carry {rows.shape[-1]} feature column(s), "
+                                f"expected {geometry['n_features']}"
+                            },
+                            400,
+                        )
+                    stream.window.resume(
+                        transform(rows)
+                        if len(rows)
+                        else rows.reshape(0, geometry["n_features"]),
+                        int(resume.get("seq", 0)),
+                    )
+                    resumed.append(name)
+                streams[name] = stream
+            session = stream_session.StreamSession(
+                stream_session.StreamSession.new_id(),
+                os.path.realpath(ctx.collection_dir),
+                ctx.revision,
+                streams,
+                max_backlog=self.stream_max_backlog,
+            )
+            self.catalog.streams.open(session)  # StreamShed -> 503
+            span.set_attribute("session", session.id)
+            span.set_attribute("resumed", bool(resumed))
+        emit_event(
+            "stream_opened",
+            session=session.id,
+            machines=list(names),
+            revision=ctx.revision,
+            resumed=bool(resumed),
+        )
+        if resumed:
+            emit_event(
+                "stream_resumed",
+                session=session.id,
+                machines=resumed,
+                revision=ctx.revision,
+            )
+        return _json_response(
+            {
+                "session": session.id,
+                "machines": {
+                    name: {
+                        "seq": streams[name].window.seq,
+                        "tail_rows": streams[name].window.context_rows,
+                        "lookback": streams[name].window.lookback,
+                        "lookahead": streams[name].window.lookahead,
+                        "monitored": streams[name].monitorable,
+                    }
+                    for name in names
+                },
+            },
+            201,
+        )
+
+    def view_stream_update(
+        self, ctx, request, gordo_project: str, stream_id: str
+    ) -> Response:
+        """
+        Push one incremental update. Body::
+
+            {"updates": {"m1": {"rows": [[...]], "seq": 40[, "y": [[...]]]}}}
+
+        Scores for the new rows come back inline (the synchronous ack
+        IS the stream's backpressure); the per-row wire order follows
+        ``seq``. A session the server no longer holds (evicted, revision
+        rolled, chaos-dropped, sequence gap) answers the structured 409
+        resume contract; a saturated backlog sheds 503 + Retry-After.
+        """
+        session = self.catalog.streams.get(stream_id)
+        if session is None:
+            raise stream_session.StreamGone("unknown_session")
+        if session.collection_dir != os.path.realpath(ctx.collection_dir):
+            # the env pointer rolled under us between requests (or the
+            # client pinned a different revision): expire, don't serve
+            # stale windows against new params
+            self.catalog.streams.close(stream_id)
+            raise stream_session.StreamGone("revision_rolled", session.names)
+        burst_weight = 1
+        action = faults.stream_fault_action(session.names)
+        if action is not None:
+            mode, value = action
+            if mode == "drop":
+                self.catalog.streams.close(stream_id)
+                emit_event(
+                    "stream_closed",
+                    session=session.id,
+                    machines=list(session.names),
+                    reason="chaos_drop",
+                    updates_total=session.updates_total,
+                    rows_total=session.rows_total,
+                )
+                raise stream_session.StreamGone("dropped", session.names)
+            if mode == "stall":
+                time.sleep(value)
+            elif mode == "burst":
+                burst_weight = max(1, int(value))
+        body = request.get_json(silent=True) or {}
+        updates = body.get("updates")
+        if not isinstance(updates, dict) or not updates:
+            return _json_response(
+                {"error": "Body must carry a non-empty 'updates' mapping."},
+                400,
+            )
+        for name, payload in updates.items():
+            if not isinstance(payload, dict) or "rows" not in payload:
+                return _json_response(
+                    {"error": f"Update for machine {name!r} must carry 'rows'."},
+                    400,
+                )
+        session.admit(burst_weight)  # StreamShed -> 503 + Retry-After
+        try:
+            scorer, _, _ = self._get_fleet_scorer(ctx, session.names)
+            with tracing.start_span(
+                "stream.update",
+                session=stream_id,
+                n_machines=len(updates),
+            ) as span:
+                try:
+                    results = session.apply_update(
+                        updates,
+                        dispatch=lambda inputs: self._fleet_predict(
+                            ctx, session.names, scorer, inputs
+                        ),
+                    )
+                except (KeyError, ValueError) as err:
+                    return _json_response({"error": str(err)}, 400)
+                except stream_session.StreamGone:
+                    # a sequence gap is unrecoverable on THIS session —
+                    # the client rebuilds one via the resume contract;
+                    # evict the dead session NOW so it can't pin its
+                    # device-resident windows (it was just LRU-touched)
+                    # or shed the very reconnect that replaces it
+                    self.catalog.streams.close(stream_id)
+                    raise
+                span.set_attribute(
+                    "transferred_rows", session.last_transfer_rows
+                )
+                span.set_attribute(
+                    "resident_rows", session.last_resident_rows
+                )
+        finally:
+            session.release(burst_weight)
+        return _json_response({"session": session.id, "scores": results})
+
+    def view_stream_close(
+        self, ctx, request, gordo_project: str, stream_id: str
+    ) -> Response:
+        """Close a session (idempotent: closing an unknown/expired id
+        succeeds — the windows are already gone)."""
+        session = self.catalog.streams.close(stream_id)
+        if session is not None:
+            emit_event(
+                "stream_closed",
+                session=session.id,
+                machines=list(session.names),
+                reason="client",
+                updates_total=session.updates_total,
+                rows_total=session.rows_total,
+            )
+        return _json_response(
+            {"session": stream_id, "closed": session is not None}
+        )
+
     def view_anomaly_prediction(
         self, ctx, request, gordo_project: str, gordo_name: str
     ) -> Response:
@@ -1294,6 +1672,21 @@ def build_app(
         )
     if "AOT_CACHE" not in config:
         config["AOT_CACHE"] = _env_bool("GORDO_AOT_CACHE", True)
+    if "STREAM_MAX_SESSIONS" not in config:
+        config["STREAM_MAX_SESSIONS"] = int(
+            os.environ.get("GORDO_STREAM_MAX_SESSIONS")
+            or stream_session.DEFAULT_MAX_SESSIONS
+        )
+    if "STREAM_MAX_BACKLOG" not in config:
+        config["STREAM_MAX_BACKLOG"] = int(
+            os.environ.get("GORDO_STREAM_MAX_BACKLOG")
+            or stream_session.DEFAULT_MAX_BACKLOG
+        )
+    if "STREAM_IDLE_S" not in config:
+        config["STREAM_IDLE_S"] = float(
+            os.environ.get("GORDO_STREAM_IDLE_S")
+            or stream_session.DEFAULT_IDLE_AFTER_S
+        )
     if "SHARD_MANIFEST" not in config:
         config["SHARD_MANIFEST"] = os.environ.get("GORDO_SHARD_MANIFEST") or None
     if "REPLICA_ID" not in config:

@@ -40,6 +40,7 @@ from gordo_tpu.programs import store as programs_store
 from gordo_tpu.router.ring import DEFAULT_VNODES, HashRing
 from gordo_tpu.server import batching
 from gordo_tpu.server.utils import ApiError
+from gordo_tpu.streaming import session as streaming_session
 
 #: casualty record the fleet builder persists next to the artifacts
 #: (gordo_tpu.builder.fleet_build.BUILD_REPORT_FILENAME — duplicated so
@@ -181,12 +182,24 @@ class ServingCatalog:
         batch_wait_s: float = 0.0,
         batch_queue_limit: int = 64,
         shard: typing.Optional[ShardSpec] = None,
+        stream_max_sessions: int = streaming_session.DEFAULT_MAX_SESSIONS,
+        stream_max_backlog: int = streaming_session.DEFAULT_MAX_BACKLOG,
+        stream_idle_after_s: float = streaming_session.DEFAULT_IDLE_AFTER_S,
     ):
         self.scorer_cache_size = int(scorer_cache_size)
         self.aot_cache_enabled = bool(aot_cache)
         self.batch_wait_s = float(batch_wait_s)
         self.batch_queue_limit = int(batch_queue_limit)
         self.shard = shard
+        # streaming scoring (docs/serving.md "Streaming scoring"): the
+        # session table lives on the catalog so revision hot-rolls
+        # expire device-resident windows exactly like they roll the
+        # scorer/batcher caches
+        self.streams = streaming_session.SessionManager(
+            max_sessions=stream_max_sessions,
+            max_backlog=stream_max_backlog,
+            idle_after_s=stream_idle_after_s,
+        )
         # (realpath(collection_dir), names tuple) -> (scorer, prefixes, fallback)
         self._fleet_scorers: typing.Dict[tuple, tuple] = {}
         self._fleet_scorers_lock = threading.Lock()
@@ -467,6 +480,17 @@ class ServingCatalog:
         with self._batchers_lock:
             batchers = list(self._batchers.values())
         return [b.stats() for b in batchers]
+
+    # -- streaming sessions (docs/serving.md "Streaming scoring") ----------
+
+    def stream_stats(self) -> typing.List[dict]:
+        return self.streams.stats()
+
+    def expire_stale_streams(self, keep_collection_dir: str) -> int:
+        """Hot promotion rolled ``latest``: expire every stream session
+        keyed to another revision (their next update answers the resume
+        contract, and the client re-establishes on the new revision)."""
+        return self.streams.expire_stale(keep_collection_dir)
 
     def stop_stale_batchers(self, keep_collection_dir: str) -> int:
         """Stop + drop every batcher keyed to another revision (hot

@@ -217,6 +217,21 @@ class FleetScorer:
     def n_groups(self) -> int:
         return len(self._groups)
 
+    def machine_geometry(self, name: str) -> Dict[str, Any]:
+        """One machine's dispatch geometry — what the streaming session
+        layer needs to size its device-resident window and validate
+        update widths (docs/serving.md "Streaming scoring")."""
+        for group in self._groups:
+            if name in group["names"]:
+                return {
+                    "windowed": group["windowed"],
+                    "lookback": group["lookback"],
+                    "lookahead": group["lookahead"],
+                    "n_features": group["in_cols"][name],
+                    "n_features_out": group["out_cols"][name],
+                }
+        raise KeyError(f"No stacked params for machine {name!r}")
+
     def _aot_targets(
         self, row_buckets: Sequence[int]
     ) -> List[Tuple[dict, int, int]]:
@@ -412,8 +427,8 @@ class FleetScorer:
         }
 
     def _dispatch(
-        self, group: dict, params: Any, batch: np.ndarray, m: int, rows: int
-    ) -> np.ndarray:
+        self, group: dict, params: Any, batch, m: int, rows: int
+    ):
         """
         One device dispatch of ``m`` machine rows × ``rows`` padded
         timesteps: an exact-shape AOT executable when the program cache
@@ -422,6 +437,10 @@ class FleetScorer:
         cache failure lands on. An executable that LOADS but fails to
         execute (shape drift, runtime error) is evicted and the request
         retraces — degraded latency, never a serving error.
+
+        Returns the raw (device) result; the caller owns the
+        device->host conversion — the streaming path fetches only its
+        per-entry output slices, the one-shot path the whole array.
         """
         exe = (
             self._cache.aot_program(self._aot_key(group, m, rows), self._store)
@@ -430,7 +449,7 @@ class FleetScorer:
         )
         if exe is not None:
             try:
-                return np.asarray(exe(params, jnp.asarray(batch)))
+                return exe(params, jnp.asarray(batch))
             except Exception as exc:  # noqa: BLE001 - ANY failure retraces
                 logger.warning(
                     "AOT executable failed at dispatch (%s); retracing", exc
@@ -438,34 +457,61 @@ class FleetScorer:
                 self._cache.discard_aot(
                     self._aot_key(group, m, rows), reason="execute_error"
                 )
-        return np.asarray(group["apply"](params, jnp.asarray(batch)))
+        return group["apply"](params, jnp.asarray(batch))
 
     def _predict_entries(
         self, group: dict, entries: List[Tuple[int, str, np.ndarray]]
     ) -> List[np.ndarray]:
         """One stacked dispatch for ``entries`` = [(request_idx, name,
-        X), ...] of one group; returns outputs aligned with entries."""
+        X), ...] of one group; returns outputs aligned with entries.
+
+        An entry's X may be a host array (the one-shot POST path) or a
+        :class:`~gordo_tpu.streaming.window.WindowUpdate` (the streaming
+        path: device-resident context + freshly transferred new rows).
+        Both assemble into ONE stacked batch — on host when every entry
+        is host-side (the historical path, byte-identical), on device
+        when any stream entry is present (padding/stacking are pure
+        data movement, so the batch holds the same bits either way and
+        the dispatch program cannot tell the difference; pinned by
+        tests/test_streaming.py).
+        """
+        from gordo_tpu.streaming.window import WindowUpdate
+
         names = [name for _, name, _ in entries]
         lb, la = group["lookback"], group["lookahead"]
         f_prog = group["n_features"]
         prepared = []
+        on_device = False
         for _, name, X in entries:
-            x = np.asarray(X, dtype=np.float32)
             # inputs must carry the machine's REAL width (its tag list);
             # zero-filling an arbitrary short frame up to the program
             # width would feed untrained (or wrong) input units and
             # return confident garbage — only the pad from real width to
             # program width is inert by the training-side invariant
             n_real = group["in_cols"][name]
-            if x.shape[-1] != n_real:
-                raise ValueError(
-                    f"Machine {name!r} expects {n_real} feature "
-                    f"column(s), got {x.shape[-1]}"
-                )
-            if n_real < f_prog:
-                # padded-bucket machine: widen to the program width with
-                # inert zero columns
-                x = np.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, f_prog - n_real)])
+            if isinstance(X, WindowUpdate):
+                on_device = True
+                if X.width != n_real:
+                    raise ValueError(
+                        f"Machine {name!r} expects {n_real} feature "
+                        f"column(s), got {X.width}"
+                    )
+                x = X.materialize()  # the update's only host->device copy
+                if n_real < f_prog:
+                    x = jnp.pad(x, ((0, 0), (0, f_prog - n_real)))
+            else:
+                x = np.asarray(X, dtype=np.float32)
+                if x.shape[-1] != n_real:
+                    raise ValueError(
+                        f"Machine {name!r} expects {n_real} feature "
+                        f"column(s), got {x.shape[-1]}"
+                    )
+                if n_real < f_prog:
+                    # padded-bucket machine: widen to the program width
+                    # with inert zero columns
+                    x = np.pad(
+                        x, [(0, 0)] * (x.ndim - 1) + [(0, f_prog - n_real)]
+                    )
             prepared.append(x)
         max_len = max(len(x) for x in prepared)
         if group["windowed"]:
@@ -487,12 +533,32 @@ class FleetScorer:
         # rows to the next power of two (<=2x padded compute beats a
         # per-request XLA compile), machines likewise capped at group size
         max_rows = _pow2_bucket(max_len)
-        batch = np.stack(
-            [
-                np.pad(x, [(0, max_rows - len(x))] + [(0, 0)] * (x.ndim - 1))
-                for x in prepared
+        if on_device:
+            batch = jnp.stack(
+                [jnp.pad(x, ((0, max_rows - len(x)), (0, 0))) for x in prepared]
+            )
+        else:
+            batch = np.stack(
+                [
+                    np.pad(x, [(0, max_rows - len(x))] + [(0, 0)] * (x.ndim - 1))
+                    for x in prepared
+                ]
+            )
+
+        def slices(outputs, index_of):
+            """Per-entry output views, device->host. One-shot batches
+            fetch the whole array once (the historical transfer shape);
+            batches carrying stream entries slice ON device first, so a
+            streamed update's device->host traffic is its own outputs,
+            not the padded batch."""
+            if not on_device:
+                outputs = np.asarray(outputs)
+            return [
+                np.asarray(
+                    outputs[index_of(i), : n_rows[i], : group["out_cols"][name]]
+                )
+                for i, name in enumerate(names)
             ]
-        )
 
         group_size = len(group["names"])
         if len(set(names)) == len(names) and group_size >= 2:
@@ -506,18 +572,23 @@ class FleetScorer:
                 # leaves are copied
                 params = group["params"]
                 row_index = {n: i for i, n in enumerate(group["names"])}
-                full = np.zeros(
-                    (group_size,) + batch.shape[1:], dtype=batch.dtype
-                )
-                for i, name in enumerate(names):
-                    full[row_index[name]] = batch[i]
+                if on_device:
+                    scatter = jnp.asarray(
+                        [row_index[name] for name in names], dtype=jnp.int32
+                    )
+                    full = jnp.zeros(
+                        (group_size,) + batch.shape[1:], dtype=batch.dtype
+                    ).at[scatter].set(batch)
+                else:
+                    full = np.zeros(
+                        (group_size,) + batch.shape[1:], dtype=batch.dtype
+                    )
+                    for i, name in enumerate(names):
+                        full[row_index[name]] = batch[i]
                 outputs = self._dispatch(
                     group, params, full, group_size, max_rows
                 )
-                return [
-                    outputs[row_index[name], : n_rows[i], : group["out_cols"][name]]
-                    for i, name in enumerate(names)
-                ]
+                return slices(outputs, lambda i: row_index[names[i]])
         else:
             # coalesced requests may name one machine several times: the
             # machine axis holds one row per ENTRY, so the bucket is not
@@ -556,14 +627,12 @@ class FleetScorer:
                 lambda leaf: leaf[sel], group["params"]
             )
         if len(batch) < m_bucket:
-            batch = np.pad(
-                batch, [(0, m_bucket - len(batch))] + [(0, 0)] * (batch.ndim - 1)
+            pad_spec = [(0, m_bucket - len(batch))] + [(0, 0)] * (batch.ndim - 1)
+            batch = (
+                jnp.pad(batch, pad_spec) if on_device else np.pad(batch, pad_spec)
             )
         outputs = self._dispatch(group, params, batch, m_bucket, max_rows)
-        return [
-            outputs[i, : n_rows[i], : group["out_cols"][name]]
-            for i, name in enumerate(names)
-        ]
+        return slices(outputs, lambda i: i)
 
 
 def fleet_scorer_from_models(

@@ -1,0 +1,35 @@
+"""
+Streaming scoring plane (docs/serving.md "Streaming scoring"): the
+push-based continuous-monitoring workload — long-lived stream sessions
+with device-resident sliding windows, scored incrementally through the
+same dynamic-batching dispatch one-shot POSTs use, feeding the
+lifecycle drift monitor continuously (scan-free ticks).
+"""
+
+from .session import (
+    DEFAULT_IDLE_AFTER_S,
+    DEFAULT_MAX_BACKLOG,
+    DEFAULT_MAX_SESSIONS,
+    MachineStream,
+    SessionManager,
+    StreamGone,
+    StreamSession,
+    StreamShed,
+    count_update,
+)
+from .window import MachineWindow, SequenceGap, WindowUpdate
+
+__all__ = [
+    "DEFAULT_IDLE_AFTER_S",
+    "DEFAULT_MAX_BACKLOG",
+    "DEFAULT_MAX_SESSIONS",
+    "MachineStream",
+    "MachineWindow",
+    "SequenceGap",
+    "SessionManager",
+    "StreamGone",
+    "StreamSession",
+    "StreamShed",
+    "WindowUpdate",
+    "count_update",
+]

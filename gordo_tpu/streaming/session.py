@@ -1,0 +1,506 @@
+"""
+Stream sessions: the protocol + state layer of the streaming scoring
+plane (docs/serving.md "Streaming scoring").
+
+One :class:`StreamSession` per open stream (one sensor group — a set of
+machines scored together): it owns each machine's device-resident
+:class:`~gordo_tpu.streaming.window.MachineWindow`, serializes updates,
+enforces the per-session backlog bound (admission control: a saturated
+session sheds with Retry-After instead of melting into queue wait), and
+feeds every scored update's anomaly statistics into the event pipeline
+(``stream_observation`` — what makes ``lifecycle tick`` scan-free for
+streamed machines, docs/lifecycle.md).
+
+The :class:`SessionManager` is the table of live sessions, owned by the
+:class:`~gordo_tpu.server.catalog.ServingCatalog` (so revision hot-rolls
+expire sessions exactly like they roll scorers/batchers) and bounded by
+the PR-9 ProgramCache discipline — resident windows are device memory,
+so the HBM headroom signal governs growth on real accelerators and the
+count bound applies on CPU/null devices (``GORDO_STREAM_MAX_SESSIONS``).
+Every eviction/expiry is safe by construction: the reconnect contract
+(client replays its window tail) rebuilds any lost session.
+"""
+
+import logging
+import math
+import threading
+import time
+import typing
+import uuid
+
+import numpy as np
+
+from gordo_tpu.observability import emit_event, get_registry
+from gordo_tpu.programs import evict_lru
+from gordo_tpu.programs.cache import hbm_headroom, min_headroom_fraction
+from gordo_tpu.streaming.window import MachineWindow, SequenceGap, WindowUpdate
+
+logger = logging.getLogger(__name__)
+
+#: default count bound on live sessions (CPU/null devices; on a real
+#: accelerator the HBM watermark governs growth past it)
+DEFAULT_MAX_SESSIONS = 64
+#: default per-session backlog bound: updates in flight past this shed
+DEFAULT_MAX_BACKLOG = 8
+#: a session untouched this long is idle: open-admission may evict it
+#: to make room instead of shedding the new stream
+DEFAULT_IDLE_AFTER_S = 30.0
+
+
+class StreamShed(Exception):
+    """Streaming admission control: the session table is full of
+    actively-updating streams (open), or this session's update backlog
+    is saturated (update). Surfaced as a structured 503 + Retry-After —
+    the same contract the batching shed uses, which the client's
+    jittered backoff already honors."""
+
+    def __init__(self, message: str, retry_after_s: int):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class StreamGone(Exception):
+    """The session cannot continue (unknown/evicted id, revision
+    hot-rolled, chaos drop, sequence gap): the update answers the
+    structured resume 409 and the client replays its window tail into a
+    fresh session (docs/serving.md — the reconnect contract)."""
+
+    def __init__(self, reason: str, machines: typing.Sequence[str] = ()):
+        super().__init__(f"Stream session gone ({reason})")
+        self.reason = reason
+        self.machines = list(machines)
+
+
+class MachineStream:
+    """One machine's per-session state: window, prefix transform, and
+    the anomaly-ratio feed pieces (None when the machine's model is not
+    an anomaly detector with calibrated thresholds — it streams scores,
+    it just cannot feed drift)."""
+
+    def __init__(
+        self,
+        name: str,
+        lookback: int,
+        lookahead: int,
+        n_features: int,
+        transform: typing.Callable[[np.ndarray], np.ndarray],
+        scaler=None,
+        threshold: typing.Optional[float] = None,
+    ):
+        self.name = name
+        self.window = MachineWindow(lookback, lookahead, n_features)
+        self.transform = transform
+        self.scaler = scaler
+        self.threshold = (
+            float(threshold)
+            if threshold and np.isfinite(threshold) and threshold > 0
+            else None
+        )
+
+    @property
+    def monitorable(self) -> bool:
+        return self.threshold is not None and self.scaler is not None
+
+    def anomaly_ratio(
+        self, outputs: np.ndarray, y_tail: np.ndarray
+    ) -> typing.Optional[np.ndarray]:
+        """Per-output-row ``total-anomaly-scaled / aggregate_threshold_``
+        — the exact statistic the one-shot ``/anomaly/prediction`` frame
+        carries into :meth:`DriftMonitor.observe
+        <gordo_tpu.lifecycle.drift.DriftMonitor.observe>` (the scaled
+        squared-gap mean of models/anomaly/diff.py), computed on the
+        update's new rows only."""
+        if not self.monitorable or not len(outputs):
+            return None
+        try:
+            gap = np.abs(
+                self.scaler.transform(np.asarray(outputs))
+                - self.scaler.transform(np.asarray(y_tail))
+            )
+            total = np.square(gap).mean(axis=1)
+            return np.asarray(total, dtype=float) / self.threshold
+        except Exception as exc:  # noqa: BLE001 - telemetry, not serving
+            logger.warning(
+                "Stream anomaly feed failed for %s (%s); update still "
+                "served",
+                self.name, exc,
+            )
+            return None
+
+
+def _metrics():
+    """The streaming series of the process registry (idempotent)."""
+    reg = get_registry()
+    return {
+        "sessions": reg.gauge(
+            "gordo_stream_sessions",
+            "Live streaming sessions (device-resident windows)",
+        ),
+        "updates": reg.counter(
+            "gordo_stream_updates_total",
+            "Stream updates by outcome (ok/warming/shed/resume_required/error)",
+            ("outcome",),
+        ),
+        "update_seconds": reg.histogram(
+            "gordo_stream_update_seconds",
+            "One stream update end to end (parse + dispatch + feed)",
+        ),
+        "update_rows": reg.histogram(
+            "gordo_stream_update_rows",
+            "Rows per stream update by kind: transferred = rows shipped "
+            "host->device this update; resident = rows already on device",
+            ("kind",),
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024),
+        ),
+    }
+
+
+def count_update(outcome: str) -> None:
+    """Count one update outcome (shed/resume_required land here from
+    the route layer, before a session method ever runs)."""
+    _metrics()["updates"].inc(outcome=outcome)
+
+
+class StreamSession:
+    """One open stream: updates are serialized per session (the wire
+    contract is ordered anyway — seq numbers), concurrent excess counts
+    against the backlog bound."""
+
+    def __init__(
+        self,
+        session_id: str,
+        collection_dir: str,
+        revision: str,
+        machines: typing.Dict[str, MachineStream],
+        max_backlog: int = DEFAULT_MAX_BACKLOG,
+    ):
+        self.id = session_id
+        self.collection_dir = collection_dir
+        self.revision = revision
+        self.machines = machines
+        self.names: typing.Tuple[str, ...] = tuple(sorted(machines))
+        self.max_backlog = max(1, int(max_backlog))
+        self.lock = threading.Lock()
+        self._pending_lock = threading.Lock()
+        self.pending = 0
+        self.last_active = time.monotonic()
+        self.expired_reason: typing.Optional[str] = None
+        self.updates_total = 0
+        self.rows_total = 0
+        #: EMA of update wall time — the Retry-After estimate on sheds
+        self._ema_update_s = 0.0
+        #: the last update's transfer accounting (the O(update) pin)
+        self.last_transfer_rows = 0
+        self.last_resident_rows = 0
+
+    @classmethod
+    def new_id(cls) -> str:
+        return uuid.uuid4().hex[:16]
+
+    def retry_after_s(self) -> int:
+        """~two update EMAs, whole seconds per RFC 9110, >= 1."""
+        return max(1, int(math.ceil(2.0 * self._ema_update_s)))
+
+    # -- backlog admission -------------------------------------------------
+
+    def admit(self, weight: int = 1) -> None:
+        """Count an arriving update against the backlog bound; sheds
+        (without counting) when the session is saturated. ``weight`` is
+        normally 1 — the ``stream:burst`` chaos site inflates it."""
+        with self._pending_lock:
+            if self.pending + max(1, int(weight)) > self.max_backlog:
+                raise StreamShed(
+                    f"Stream session {self.id} backlog saturated "
+                    f"({self.pending}/{self.max_backlog} updates in flight)",
+                    self.retry_after_s(),
+                )
+            self.pending += max(1, int(weight))
+
+    def release(self, weight: int = 1) -> None:
+        with self._pending_lock:
+            self.pending = max(0, self.pending - max(1, int(weight)))
+
+    # -- the update --------------------------------------------------------
+
+    def apply_update(
+        self,
+        updates: typing.Dict[str, dict],
+        dispatch: typing.Callable[
+            [typing.Dict[str, WindowUpdate]],
+            typing.Dict[str, np.ndarray],
+        ],
+    ) -> typing.Dict[str, dict]:
+        """
+        Score one update against the resident windows. ``updates`` maps
+        machine name -> {"rows": (k, f) raw rows, "seq": int[, "y":
+        (k, f_out) target rows]}; ``dispatch`` is the server's fleet
+        dispatch (the dynamic-batching path, so streamed updates
+        coalesce with one-shot POSTs). Returns per-machine
+        ``{"rows": scores, "seq": acked, "warming": bool}``.
+
+        All-or-nothing: a failed dispatch commits NOTHING, so the
+        client's retry of the same seqs is exact (overlap trimming
+        makes retries idempotent). A sequence gap raises
+        :class:`StreamGone` — the resume contract.
+        """
+        unknown = sorted(set(updates) - set(self.machines))
+        if unknown:
+            raise KeyError(
+                f"Machine(s) not in stream session {self.id}: {unknown}"
+            )
+        start = time.perf_counter()
+        metrics = _metrics()
+        with self.lock:
+            self.last_active = time.monotonic()
+            pending_commits: typing.List[tuple] = []
+            inputs: typing.Dict[str, WindowUpdate] = {}
+            raw_tails: typing.Dict[str, np.ndarray] = {}
+            results: typing.Dict[str, dict] = {}
+            transferred = 0
+            resident = 0
+            for name in sorted(updates):
+                stream = self.machines[name]
+                payload = updates[name]
+                # float64 until the prefix transform, float32 after —
+                # the exact dtype walk the one-shot parsed frame takes,
+                # so streamed and POSTed rows carry the same bits into
+                # the dispatch
+                rows = np.asarray(payload["rows"], dtype="float64")
+                if rows.ndim != 2:
+                    raise ValueError(
+                        f"Machine {name!r}: update rows must be 2-D "
+                        f"(rows, features), got shape {rows.shape}"
+                    )
+                if payload.get("y") is not None and len(
+                    np.asarray(payload["y"])
+                ) != len(rows):
+                    # a short y would mis-slice the target tail and
+                    # silently drop the machine's drift feed
+                    raise ValueError(
+                        f"Machine {name!r}: 'y' must carry one target "
+                        f"row per input row ({len(rows)}), got "
+                        f"{len(np.asarray(payload['y']))}"
+                    )
+                seq = int(payload.get("seq", stream.window.seq))
+                already = stream.window.seq - seq
+                transformed = stream.transform(rows)
+                try:
+                    update, fresh = stream.window.begin(name, transformed, seq)
+                except SequenceGap as gap:
+                    raise StreamGone("sequence_gap", [name]) from gap
+                pending_commits.append((stream, update, fresh))
+                n_fresh = len(fresh)
+                if update is not None:
+                    inputs[name] = update
+                    transferred += update.n_new
+                    resident += update.n_context
+                    # targets for the new output rows: the trailing
+                    # n_outputs raw rows of this update (y defaults to
+                    # X — the same default the client's one-shot path
+                    # uses)
+                    y = payload.get("y")
+                    y_rows = (
+                        np.asarray(y, dtype="float64")[max(0, already):]
+                        if y is not None
+                        else rows[max(0, already):]
+                    )
+                    n_out = stream.window.n_outputs(update)
+                    raw_tails[name] = y_rows[len(y_rows) - n_out:]
+                results[name] = {
+                    "rows": [],
+                    "seq": stream.window.seq + n_fresh,
+                    "warming": update is None and n_fresh > 0,
+                }
+
+            outputs: typing.Dict[str, np.ndarray] = {}
+            if inputs:
+                try:
+                    outputs = dispatch(inputs)
+                except Exception:
+                    metrics["updates"].inc(outcome="error")
+                    raise  # windows untouched: the retry is exact
+            for stream, update, fresh in pending_commits:
+                stream.window.commit(update, fresh)
+            self.updates_total += 1
+            self.last_transfer_rows = transferred
+            self.last_resident_rows = resident
+            observations: typing.List[dict] = []
+            for name, out in outputs.items():
+                stream = self.machines[name]
+                out = np.asarray(out)
+                stream.window.n_scored += len(out)
+                self.rows_total += len(out)
+                results[name]["rows"] = out.tolist()
+                ratios = stream.anomaly_ratio(out, raw_tails[name])
+                if ratios is not None and len(ratios):
+                    finite = ratios[np.isfinite(ratios)]
+                    if len(finite):
+                        observations.append(
+                            {
+                                "machine": name,
+                                "n": int(len(finite)),
+                                "ratio_mean": float(finite.mean()),
+                                "exceedance": float((finite > 1.0).mean()),
+                            }
+                        )
+
+        # outside the session lock: telemetry/event-log I/O only
+        for obs in observations:
+            # the continuous lifecycle feed: one observation per scored
+            # update per machine, aggregated by the tick into the SAME
+            # statistic a drift scan computes (docs/lifecycle.md
+            # "Scan-free ticks")
+            emit_event(
+                "stream_observation",
+                revision=self.revision,
+                session=self.id,
+                **obs,
+            )
+        if transferred:
+            metrics["update_rows"].observe(transferred, kind="transferred")
+            metrics["update_rows"].observe(resident, kind="resident")
+        elapsed = time.perf_counter() - start
+        metrics["update_seconds"].observe(elapsed)
+        metrics["updates"].inc(outcome="ok" if inputs else "warming")
+        self._ema_update_s = (
+            elapsed
+            if self._ema_update_s == 0.0
+            else 0.8 * self._ema_update_s + 0.2 * elapsed
+        )
+        return results
+
+    def stats(self) -> dict:
+        with self._pending_lock:
+            pending = self.pending
+        return {
+            "session": self.id,
+            "machines": list(self.names),
+            "revision": self.revision,
+            "pending": pending,
+            "max_backlog": self.max_backlog,
+            "saturated": pending >= self.max_backlog,
+            "updates_total": self.updates_total,
+            "rows_total": self.rows_total,
+            "last_transfer_rows": self.last_transfer_rows,
+            "last_resident_rows": self.last_resident_rows,
+            "retry_after_s": self.retry_after_s(),
+            "windows": {
+                name: stream.window.stats()
+                for name, stream in self.machines.items()
+            },
+        }
+
+
+class SessionManager:
+    """
+    The live-session table. Insertion-ordered dict + the shared
+    :func:`~gordo_tpu.programs.evict_lru` policy (``get`` refreshes, so
+    iteration order is recency order): on devices that report memory
+    the HBM headroom governs growth past ``max_sessions``; on CPU the
+    count bound applies. Open-admission sheds (503 + Retry-After) when
+    making room would evict a session that is still actively updating —
+    evicting idle streams is safe (the resume contract), thrashing live
+    ones is not.
+    """
+
+    def __init__(
+        self,
+        max_sessions: int = DEFAULT_MAX_SESSIONS,
+        max_backlog: int = DEFAULT_MAX_BACKLOG,
+        idle_after_s: float = DEFAULT_IDLE_AFTER_S,
+    ):
+        self.max_sessions = max(1, int(max_sessions))
+        self.max_backlog = max(1, int(max_backlog))
+        self.idle_after_s = float(idle_after_s)
+        self._sessions: typing.Dict[str, StreamSession] = {}
+        self._lock = threading.Lock()
+
+    def _gauge(self) -> None:
+        _metrics()["sessions"].set(len(self._sessions))
+
+    def open(self, session: StreamSession) -> StreamSession:
+        evicted: typing.List[typing.Tuple[str, StreamSession]] = []
+        with self._lock:
+            if len(self._sessions) >= self.max_sessions:
+                free = hbm_headroom()
+                if free is None or free < min_headroom_fraction():
+                    # no headroom-governed growth: the LRU victim would
+                    # be evicted — shed instead when it is still live
+                    victim = next(iter(self._sessions.values()))
+                    if (
+                        time.monotonic() - victim.last_active
+                        < self.idle_after_s
+                    ):
+                        raise StreamShed(
+                            f"Session table full ({len(self._sessions)}/"
+                            f"{self.max_sessions}) and every stream is "
+                            "active",
+                            max(1, victim.retry_after_s()),
+                        )
+            self._sessions[session.id] = session
+            evicted = evict_lru(
+                self._sessions, self.max_sessions, headroom=hbm_headroom
+            )
+            self._gauge()
+        for _, old in evicted:
+            old.expired_reason = "evicted"
+            emit_event(
+                "stream_closed",
+                session=old.id,
+                machines=list(old.names),
+                reason="evicted",
+                updates_total=old.updates_total,
+                rows_total=old.rows_total,
+            )
+        return session
+
+    def get(self, session_id: str) -> typing.Optional[StreamSession]:
+        with self._lock:
+            session = self._sessions.get(session_id)
+            if session is not None:
+                # LRU refresh: recency order is eviction order
+                self._sessions.pop(session_id)
+                self._sessions[session_id] = session
+            return session
+
+    def close(self, session_id: str) -> typing.Optional[StreamSession]:
+        with self._lock:
+            session = self._sessions.pop(session_id, None)
+            self._gauge()
+        return session
+
+    def expire_stale(self, keep_collection_dir: str) -> int:
+        """Expire every session keyed to another revision (a hot
+        promotion rolled ``latest``): their next update answers the
+        resume contract and the client re-establishes against the new
+        revision — the stream-plane flavor of stopping stale batchers
+        (docs/lifecycle.md)."""
+        stale: typing.List[StreamSession] = []
+        with self._lock:
+            for sid in [
+                s
+                for s, sess in self._sessions.items()
+                if sess.collection_dir != keep_collection_dir
+            ]:
+                stale.append(self._sessions.pop(sid))
+            self._gauge()
+        for session in stale:
+            session.expired_reason = "revision_rolled"
+            emit_event(
+                "stream_closed",
+                session=session.id,
+                machines=list(session.names),
+                reason="revision_rolled",
+                updates_total=session.updates_total,
+                rows_total=session.rows_total,
+            )
+        return len(stale)
+
+    def stats(self) -> typing.List[dict]:
+        with self._lock:
+            sessions = list(self._sessions.values())
+        return [s.stats() for s in sessions]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._sessions)

@@ -1,0 +1,212 @@
+"""
+Device-resident sliding windows — the data-plane core of the streaming
+scoring plane (docs/serving.md "Streaming scoring").
+
+A one-shot windowed POST ships the WHOLE lookback window to the device
+on every request; an always-on monitoring stream re-scores the same
+window tail thousands of times. Here each streamed machine keeps its
+window context (the trailing ``lookback + lookahead - 1`` rows — the
+exact rows the next update's windows reach back into) ON the device
+between updates, so a k-row update transfers k rows host->device and
+nothing else: per-update cost is O(update), not O(window) — the
+transfer-and-overhead bound the Learned Performance Model paper
+(PAPERS.md, arXiv:2008.01040) puts on tiny-model serving is exactly
+what residency removes.
+
+:class:`WindowUpdate` is the value a stream enqueues through the
+dynamic batcher: :meth:`FleetScorer._predict_entries
+<gordo_tpu.server.fleet_serving.FleetScorer._predict_entries>`
+recognizes it and assembles the dispatch batch on device (resident
+context ++ freshly transferred new rows), so streamed updates coalesce
+with one-shot POSTs in the SAME stacked dispatch and return the same
+bits (pinned by tests/test_streaming.py).
+"""
+
+import typing
+
+import numpy as np
+
+__all__ = ["WindowUpdate", "MachineWindow", "SequenceGap"]
+
+
+class SequenceGap(ValueError):
+    """An update's ``seq`` skips past rows the window never saw — the
+    missing rows can never be scored, so the caller must answer the
+    resume contract (the client replays its window tail)."""
+
+    def __init__(self, machine: str, expected: int, got: int):
+        super().__init__(
+            f"Machine {machine!r}: update starts at row {got} but the "
+            f"window has only consumed {expected} rows — sequence gap; "
+            "resume with a window-tail replay"
+        )
+        self.machine = machine
+        self.expected = expected
+        self.got = got
+
+
+class WindowUpdate:
+    """
+    One machine's contribution to one streamed dispatch: the
+    device-resident context rows plus the update's new rows (host,
+    already prefix-transformed float32). ``materialize()`` is called by
+    the scorer at dispatch time — on the batcher's drainer thread — and
+    is the ONLY point where bytes cross to the device: the new rows.
+    """
+
+    __slots__ = ("context", "new_rows", "_device")
+
+    def __init__(self, context, new_rows: np.ndarray):
+        #: jax device array (c, f) or None — rows already on device
+        self.context = context
+        #: np.ndarray (k, f) float32 — this update's freshly arrived rows
+        self.new_rows = np.asarray(new_rows, dtype=np.float32)
+        self._device = None
+
+    @property
+    def width(self) -> int:
+        return int(self.new_rows.shape[-1])
+
+    @property
+    def n_new(self) -> int:
+        return int(len(self.new_rows))
+
+    @property
+    def n_context(self) -> int:
+        return 0 if self.context is None else int(self.context.shape[0])
+
+    def __len__(self) -> int:
+        # the scorer treats an entry's len() as its row count
+        return self.n_context + self.n_new
+
+    @property
+    def shape(self) -> typing.Tuple[int, int]:
+        return (len(self), self.width)
+
+    def materialize(self):
+        """Context ++ new rows as ONE device array. The new rows are
+        the only host->device transfer; the concat is a device op.
+        Cached so the batcher's per-request fallback re-dispatch reuses
+        the same array (same bits, no second transfer)."""
+        if self._device is None:
+            import jax.numpy as jnp
+
+            new_dev = jnp.asarray(self.new_rows)
+            if self.context is None:
+                self._device = new_dev
+            else:
+                self._device = jnp.concatenate([self.context, new_dev])
+        return self._device
+
+
+class MachineWindow:
+    """
+    One streamed machine's window state across updates. ``seq`` counts
+    rows consumed since the stream began (the client's replay cursor);
+    ``context`` holds the trailing ``lookback + lookahead - 1`` rows on
+    device. Not thread-safe on its own — the owning session serializes
+    updates.
+    """
+
+    def __init__(self, lookback: int, lookahead: int, n_features: int):
+        self.lookback = max(1, int(lookback))
+        self.lookahead = max(0, int(lookahead))
+        self.n_features = int(n_features)
+        #: rows the NEXT update's windows reach back into
+        self.context_rows = self.lookback + self.lookahead - 1
+        self.context = None  # device array (<= context_rows, f) or None
+        self.seq = 0  # total rows consumed (next expected row index)
+        self.n_scored = 0  # total output rows produced
+
+    # -- update assembly ---------------------------------------------------
+
+    def begin(
+        self, name: str, rows: np.ndarray, seq: int
+    ) -> typing.Tuple[typing.Optional[WindowUpdate], np.ndarray]:
+        """
+        Validate one update against the replay cursor and assemble its
+        :class:`WindowUpdate`. Returns ``(update, fresh_rows)`` where
+        ``fresh_rows`` are the not-yet-seen rows (overlap with already
+        consumed rows — a client retry after a lost ack — is trimmed,
+        making updates idempotent); ``update`` is None when every row
+        was already consumed OR the window cannot yet fill one window
+        (warming — the caller commits the rows without a dispatch).
+        Raises :class:`SequenceGap` when ``seq`` skips ahead.
+        """
+        rows = np.asarray(rows, dtype=np.float32)
+        seq = int(seq)
+        if seq > self.seq:
+            raise SequenceGap(name, expected=self.seq, got=seq)
+        already = self.seq - seq
+        fresh = rows[already:] if already else rows
+        if not len(fresh):
+            return None, fresh
+        update = WindowUpdate(self.context, fresh)
+        if self.n_outputs(update) <= 0:
+            return None, fresh  # warming: accumulate, nothing scorable yet
+        return update, fresh
+
+    def n_outputs(self, update: WindowUpdate) -> int:
+        """Output rows this update's dispatch would produce — always
+        the count of NEW scorable rows (the context never re-scores:
+        it is capped at ``context_rows``, one short of a window)."""
+        return len(update) - self.lookback + 1 - self.lookahead
+
+    # -- commit ------------------------------------------------------------
+
+    def commit(self, update: typing.Optional[WindowUpdate], fresh: np.ndarray):
+        """Advance the cursor and roll the device-resident context
+        forward. Called only after a successful dispatch (or for a
+        warming/overlap-only update) — a failed dispatch leaves the
+        window untouched, so the client's retry of the same ``seq`` is
+        exact."""
+        n_fresh = len(fresh)
+        if not n_fresh:
+            return
+        if self.context_rows <= 0:
+            self.context = None
+        elif update is not None:
+            # the dispatch already materialized context ++ fresh on
+            # device: the new context is its tail, a device slice
+            self.context = update.materialize()[-self.context_rows :]
+        else:
+            # warming: the rows still need to reach the device once —
+            # they are tomorrow's context
+            import jax.numpy as jnp
+
+            fresh_dev = jnp.asarray(fresh)
+            merged = (
+                fresh_dev
+                if self.context is None
+                else jnp.concatenate([self.context, fresh_dev])
+            )
+            self.context = merged[-self.context_rows :]
+        self.seq += n_fresh
+
+    # -- resume ------------------------------------------------------------
+
+    def resume(self, rows: np.ndarray, seq: int) -> None:
+        """
+        Rebuild the context from a client's replayed window tail
+        (already prefix-transformed): ``rows`` are the trailing rows of
+        the stream so far, ``seq`` the index of the first replayed row.
+        Replayed rows are context ONLY — they were scored and acked
+        before the old session died, so they are never re-scored.
+        """
+        import jax.numpy as jnp
+
+        rows = np.asarray(rows, dtype=np.float32)
+        if self.context_rows > 0 and len(rows):
+            self.context = jnp.asarray(rows[-self.context_rows :])
+        else:
+            self.context = None
+        self.seq = int(seq) + len(rows)
+
+    def stats(self) -> dict:
+        return {
+            "seq": self.seq,
+            "n_scored": self.n_scored,
+            "resident_rows": (
+                0 if self.context is None else int(self.context.shape[0])
+            ),
+        }

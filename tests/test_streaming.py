@@ -1,0 +1,1150 @@
+"""
+Streaming scoring plane tests (docs/serving.md "Streaming scoring"):
+device-resident sliding windows must make per-update transfer O(update)
+while staying BIT-IDENTICAL to one-shot windowed POSTs (solo, in mixed
+stream+POST coalesced batches, and across revision hot-rolls); the
+reconnect/replay contract must survive session eviction, chaos drops,
+and a replica death behind the router with zero unstructured errors;
+accumulated stream observations must drive a scan-free lifecycle tick
+that detects injected drift; and the chaos seam must stay a strict
+no-op when unset.
+"""
+
+import json
+import os
+import shutil
+import threading
+from urllib.parse import urlsplit
+
+import numpy as np
+import pandas as pd
+import pytest
+import requests
+from werkzeug.test import Client as WerkzeugClient
+
+from gordo_tpu import serializer
+from gordo_tpu.observability import read_events
+from gordo_tpu.robustness import faults
+from gordo_tpu.server import utils as server_utils
+from gordo_tpu.server.catalog import write_shard_manifest
+from gordo_tpu.server.utils import dataframe_from_dict, dataframe_to_dict
+from gordo_tpu.streaming.window import MachineWindow, SequenceGap
+from tests.utils import WSGIAdapter
+
+PROJECT = "stream-proj"
+TAGS = [f"tag-{i}" for i in range(4)]
+LOOKBACK = 4
+WINDOWED = ["stream-w0", "stream-w1"]
+DENSE = "stream-dense"
+MACHINES = [*WINDOWED, DENSE]
+RNG = np.random.default_rng(17)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_faults(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_INJECT_ENV_VAR, raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def _machine_cfg(name: str, windowed: bool) -> str:
+    inner = (
+        f"""gordo_tpu.models.LSTMAutoEncoder:
+                  kind: lstm_hourglass
+                  lookback_window: {LOOKBACK}
+                  epochs: 1"""
+        if windowed
+        else """gordo_tpu.models.AutoEncoder:
+                  kind: feedforward_hourglass
+                  epochs: 1"""
+    )
+    return f"""
+  - name: {name}
+    dataset:
+      type: RandomDataset
+      tags: {TAGS}
+      target_tag_list: {TAGS}
+      train_start_date: '2019-01-01T00:00:00+00:00'
+      train_end_date: '2019-01-02T00:00:00+00:00'
+      asset: gra
+    model:
+      gordo_tpu.models.anomaly.DiffBasedAnomalyDetector:
+        base_estimator:
+          sklearn.pipeline.Pipeline:
+            steps:
+              - sklearn.preprocessing.MinMaxScaler
+              - {inner}
+"""
+
+
+@pytest.fixture(scope="session")
+def stream_collection(tmp_path_factory):
+    """One real trained collection: two windowed LSTM anomaly machines
+    + one feedforward, laid out as a revision directory."""
+    from gordo_tpu.builder import local_build
+
+    config = "machines:" + "".join(
+        _machine_cfg(m, windowed=m in WINDOWED) for m in MACHINES
+    )
+    root = tmp_path_factory.mktemp("stream-collection")
+    collection = root / PROJECT / "models" / "rev-a"
+    for model, machine in local_build(config):
+        serializer.dump(
+            model, collection / machine.name, metadata=machine.to_dict()
+        )
+    return collection
+
+
+def _build_stream_app(collection, monkeypatch, **config):
+    from gordo_tpu.server import build_app
+
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(collection))
+    server_utils.clear_caches()
+    return build_app(config)
+
+
+def _rows(n, seed=0):
+    return np.random.default_rng(seed).random((n, len(TAGS)))
+
+
+def _one_shot_outputs(client, machine, data) -> np.ndarray:
+    """The machine's model-output block from a one-shot fleet POST of
+    the whole accumulated window — the bit-identity reference."""
+    index = pd.date_range(
+        "2019-01-01", periods=len(data), freq="10min", tz="UTC"
+    )
+    frame = pd.DataFrame(data, columns=TAGS, index=index)
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/prediction/fleet",
+        json={"machines": {machine: dataframe_to_dict(frame)}},
+    )
+    assert resp.status_code == 200, resp.get_data()
+    payload = json.loads(resp.get_data())["data"][machine]
+    return np.asarray(
+        dataframe_from_dict(payload)["model-output"].to_numpy(),
+        dtype="float32",
+    )
+
+
+def _stream_all(client, machine, data, chunks) -> tuple:
+    """Open a stream, push ``data`` in ``chunks``-sized pieces, return
+    (concatenated scores, session id, open payload, per-update
+    transferred row counts read back from the app's session stats)."""
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [machine]}
+    )
+    assert resp.status_code == 201, resp.get_data()
+    opened = json.loads(resp.get_data())
+    sid = opened["session"]
+    outs, transfers = [], []
+    i = seq = 0
+    for k in chunks:
+        rows = data[i : i + k]
+        i += k
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+            json={"updates": {machine: {"rows": rows.tolist(), "seq": seq}}},
+        )
+        assert resp.status_code == 200, resp.get_data()
+        payload = json.loads(resp.get_data())
+        result = payload["scores"][machine]
+        outs.extend(result["rows"])
+        seq = result["seq"]
+        transfers.append(len(rows))
+    return np.asarray(outs, dtype="float32"), sid, opened, transfers
+
+
+# -- window unit behavior --------------------------------------------------
+
+
+def test_window_overlap_trim_gap_and_warming():
+    win = MachineWindow(lookback=4, lookahead=0, n_features=3)
+    rows = np.arange(30, dtype="float32").reshape(10, 3)
+
+    # warming: 2 rows cannot fill one 4-row window
+    update, fresh = win.begin("m", rows[:2], seq=0)
+    assert update is None and len(fresh) == 2
+    win.commit(update, fresh)
+    assert win.seq == 2
+
+    # crossing the warmup line scores exactly the new scorable rows
+    update, fresh = win.begin("m", rows[2:6], seq=2)
+    assert update is not None
+    assert win.n_outputs(update) == 3  # 6 rows total - 4 + 1
+    win.commit(update, fresh)
+    assert win.seq == 6
+    assert int(update.materialize().shape[0]) == 6
+
+    # retry of already-acked rows is trimmed to idempotence
+    update, fresh = win.begin("m", rows[4:8], seq=4)
+    assert len(fresh) == 2  # rows 6..7 only
+    assert update.n_new == 2 and update.n_context == 3
+    win.commit(update, fresh)
+    assert win.seq == 8
+
+    # a gap can never be scored
+    with pytest.raises(SequenceGap):
+        win.begin("m", rows[9:], seq=9)
+
+    # resume replays context only, never re-scores
+    win2 = MachineWindow(lookback=4, lookahead=0, n_features=3)
+    win2.resume(rows[:8], seq=0)
+    assert win2.seq == 8
+    assert int(win2.context.shape[0]) == 3  # lookback - 1
+
+
+# -- bit-identity ----------------------------------------------------------
+
+
+def test_stream_bit_identical_to_one_shot_windowed(
+    stream_collection, monkeypatch
+):
+    """THE tentpole pin: a streamed machine's concatenated incremental
+    scores equal a one-shot windowed POST of the same rows, bit for
+    bit — while each update transfers only its own rows (O(update),
+    not O(window))."""
+    app = _build_stream_app(stream_collection, monkeypatch)
+    client = WerkzeugClient(app)
+    data = _rows(40, seed=1)
+    reference = _one_shot_outputs(client, WINDOWED[0], data)
+    streamed, sid, opened, transfers = _stream_all(
+        client, WINDOWED[0], data, chunks=(10, 4, 4, 4, 4, 4, 4, 3, 3)
+    )
+    np.testing.assert_array_equal(reference, streamed)
+    assert opened["machines"][WINDOWED[0]]["tail_rows"] == LOOKBACK - 1
+
+    # O(update): the LAST update shipped 3 rows host->device while the
+    # stream had accumulated 40 — the one-shot equivalent re-ships all
+    # 40 every time. Resident context stays at lookback-1 rows.
+    session = app.catalog.streams.get(sid)
+    assert session is not None
+    assert session.last_transfer_rows == 3
+    assert session.last_resident_rows == LOOKBACK - 1
+    assert session.last_transfer_rows < len(data)
+
+    # and the registry's transfer telemetry recorded the same bound
+    from gordo_tpu.streaming.session import _metrics
+
+    series = _metrics()["update_rows"].snapshot()["series"]
+    transferred = [
+        s for s in series if s["labels"].get("kind") == "transferred"
+    ]
+    assert transferred and transferred[0]["count"] >= 8
+
+    client.post(f"/gordo/v0/{PROJECT}/stream/{sid}/close")
+
+
+def test_stream_bit_identical_non_windowed(stream_collection, monkeypatch):
+    app = _build_stream_app(stream_collection, monkeypatch)
+    client = WerkzeugClient(app)
+    data = _rows(24, seed=2)
+    reference = _one_shot_outputs(client, DENSE, data)
+    streamed, _, opened, _ = _stream_all(
+        client, DENSE, data, chunks=(8, 8, 8)
+    )
+    np.testing.assert_array_equal(reference, streamed)
+    # non-windowed: nothing to keep resident, nothing to replay
+    assert opened["machines"][DENSE]["tail_rows"] == 0
+
+
+def test_mixed_stream_and_post_entries_coalesce_bit_identically():
+    """Scorer-level: a WindowUpdate entry and a host one-shot entry in
+    ONE coalesced predict_requests batch return the same bits as their
+    solo dispatches."""
+    from gordo_tpu.models import LSTMAutoEncoder
+    from gordo_tpu.server.fleet_serving import FleetScorer
+
+    rng = np.random.default_rng(3)
+    X = rng.random((60, 4)).astype("float32")
+    model = LSTMAutoEncoder(
+        kind="lstm_hourglass", lookback_window=LOOKBACK, epochs=1
+    )
+    model.fit(X, X.copy())
+    scorer = FleetScorer({"w": model})
+
+    data = rng.random((30, 4)).astype("float32")
+    one_shot = scorer.predict({"w": data})["w"]
+    post_rows = rng.random((20, 4)).astype("float32")
+    solo_post = scorer.predict({"w": post_rows})["w"]
+
+    win = MachineWindow(LOOKBACK, 0, 4)
+    outs = []
+    i = 0
+    for k in (8, 6, 6, 5, 5):
+        update, fresh = win.begin("w", data[i : i + k], seq=win.seq)
+        i += k
+        if update is not None:
+            got = scorer.predict_requests(
+                [{"w": update}, {"w": post_rows}]  # mixed coalesced batch
+            )
+            outs.append(got[0]["w"])
+            np.testing.assert_array_equal(got[1]["w"], solo_post)
+        win.commit(update, fresh)
+    np.testing.assert_array_equal(one_shot, np.concatenate(outs))
+
+
+def test_stream_and_post_coalesce_through_batching_server(
+    stream_collection, monkeypatch
+):
+    """HTTP-level: with dynamic batching ON, a concurrent stream update
+    and one-shot POST both serve bit-identically to their solo
+    results (they share one RequestBatcher queue)."""
+    app = _build_stream_app(
+        stream_collection, monkeypatch, BATCH_WAIT_MS=40.0,
+        BATCH_QUEUE_LIMIT=4,
+    )
+    client = WerkzeugClient(app)
+    data = _rows(30, seed=4)
+    reference = _one_shot_outputs(client, WINDOWED[0], data)
+
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open",
+        json={"machines": [WINDOWED[0]]},
+    )
+    sid = json.loads(resp.get_data())["session"]
+    post_data = _rows(12, seed=5)
+    post_reference = _one_shot_outputs(client, WINDOWED[0], post_data)
+
+    outs = []
+    errors = []
+
+    def one_shot_post():
+        try:
+            got = _one_shot_outputs(
+                WerkzeugClient(app), WINDOWED[0], post_data
+            )
+            np.testing.assert_array_equal(got, post_reference)
+        except Exception as exc:  # noqa: BLE001 - recorded for asserts
+            errors.append(exc)
+
+    i = seq = 0
+    for k in (10, 5, 5, 5, 5):
+        rows = data[i : i + k]
+        i += k
+        poster = threading.Thread(target=one_shot_post)
+        poster.start()
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+            json={
+                "updates": {WINDOWED[0]: {"rows": rows.tolist(), "seq": seq}}
+            },
+        )
+        assert resp.status_code == 200, resp.get_data()
+        result = json.loads(resp.get_data())["scores"][WINDOWED[0]]
+        outs.extend(result["rows"])
+        seq = result["seq"]
+        poster.join()
+    assert not errors
+    np.testing.assert_array_equal(reference, np.asarray(outs, "float32"))
+
+
+# -- the reconnect/replay contract -----------------------------------------
+
+
+def _loopback_client(app, n_retries=4):
+    from gordo_tpu.client.client import Client
+
+    session = requests.Session()
+    session.mount("http://", WSGIAdapter(app))
+    session.mount("https://", WSGIAdapter(app))
+    return Client(
+        project=PROJECT, host="stream.test", port=80, scheme="http",
+        session=session, n_retries=n_retries,
+    )
+
+
+def _stream_publisher(client, machines):
+    """The real publisher on a test-paced reconnect schedule (the house
+    8/16/32s backoff scaled to milliseconds, like the router tests'
+    --backoff-scale)."""
+    return client.stream_machine(machines, backoff_scale=0.002)
+
+
+def test_unknown_session_and_sequence_gap_answer_resume_contract(
+    stream_collection, monkeypatch
+):
+    app = _build_stream_app(stream_collection, monkeypatch)
+    client = WerkzeugClient(app)
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/nope/update",
+        json={"updates": {WINDOWED[0]: {"rows": [[0, 0, 0, 0]], "seq": 0}}},
+    )
+    assert resp.status_code == 409
+    body = json.loads(resp.get_data())
+    assert body["stream_resume"]["reason"] == "unknown_session"
+    assert body["transient"] is True
+
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [WINDOWED[0]]}
+    )
+    sid = json.loads(resp.get_data())["session"]
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+        json={
+            "updates": {
+                WINDOWED[0]: {"rows": _rows(3).tolist(), "seq": 7}
+            }
+        },
+    )
+    assert resp.status_code == 409
+    assert (
+        json.loads(resp.get_data())["stream_resume"]["reason"]
+        == "sequence_gap"
+    )
+    # the gap EVICTED the session (it can never serve again — left in
+    # the table it would pin device windows and, at the session bound,
+    # shed the very reconnect that replaces it)
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+        json={"updates": {WINDOWED[0]: {"rows": _rows(3).tolist(), "seq": 0}}},
+    )
+    assert (
+        json.loads(resp.get_data())["stream_resume"]["reason"]
+        == "unknown_session"
+    )
+    # close is idempotent, even for unknown ids
+    assert (
+        client.post(f"/gordo/v0/{PROJECT}/stream/zzz/close").status_code
+        == 200
+    )
+
+
+def test_publisher_resumes_after_chaos_drop_bit_identically(
+    stream_collection, monkeypatch, tmp_path
+):
+    """stream:drop chaos: the server forgets the session mid-stream;
+    the publisher reconnects, replays its window tail, and the user
+    sees an unbroken bit-identical score stream."""
+    event_log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("GORDO_TPU_EVENT_LOG", str(event_log))
+    app = _build_stream_app(stream_collection, monkeypatch)
+    reference = _one_shot_outputs(
+        WerkzeugClient(app), WINDOWED[0], _rows(32, seed=6)
+    )
+    client = _loopback_client(app)
+    data = _rows(32, seed=6)
+    outs = []
+    with _stream_publisher(client, WINDOWED[0]) as stream:
+        i = 0
+        for n, k in enumerate((8, 6, 6, 6, 6)):
+            if n == 2:
+                monkeypatch.setenv(
+                    faults.FAULT_INJECT_ENV_VAR,
+                    f"stream:drop:{WINDOWED[0]}@attempts:1",
+                )
+                faults.reset()
+            scores = stream.send(data[i : i + k])
+            i += k
+            if len(scores):
+                outs.append(scores)
+        assert stream.reconnects == 1
+    np.testing.assert_array_equal(reference, np.concatenate(outs))
+    events = [e["event"] for e in read_events(str(event_log))]
+    assert "fault_injected" in events
+    assert "stream_resumed" in events
+    assert events.count("stream_opened") == 2
+
+
+def test_revision_roll_mid_stream_reanchors(
+    stream_collection, monkeypatch, tmp_path
+):
+    """A lifecycle hot roll mid-stream: sessions keyed to the old
+    revision expire, the publisher re-establishes on the new one, and
+    scores keep flowing (stamped with the new revision)."""
+    revisions = tmp_path / "revisions"
+    revisions.mkdir()
+    rev_a = revisions / "rev-a"
+    rev_b = revisions / "rev-b"
+    shutil.copytree(stream_collection, rev_a)
+    shutil.copytree(stream_collection, rev_b)
+    latest = revisions / "latest"
+    latest.symlink_to(rev_a)
+    app = _build_stream_app(latest, monkeypatch)
+    client = _loopback_client(app)
+    data = _rows(32, seed=7)
+    reference = _one_shot_outputs(WerkzeugClient(app), WINDOWED[1], data)
+    outs = []
+    revisions_seen = set()
+    with _stream_publisher(client, WINDOWED[1]) as stream:
+        i = 0
+        for n, k in enumerate((8, 6, 6, 6, 6)):
+            if n == 2:
+                # the promotion's atomic re-point
+                tmp_link = revisions / ".latest-swap"
+                tmp_link.symlink_to(rev_b)
+                os.replace(tmp_link, latest)
+            scores = stream.send(data[i : i + k])
+            i += k
+            if len(scores):
+                outs.append(scores)
+        assert stream.reconnects == 1
+    # same artifact bits in both revisions -> the stream stayed
+    # bit-identical across the roll
+    np.testing.assert_array_equal(reference, np.concatenate(outs))
+    # the roll expired the old session (the event observability pin
+    # rides test_publisher_resumes_after_chaos_drop's log)
+    assert len(app.catalog.streams) <= 1
+
+
+class MultiReplicaAdapter(WSGIAdapter):
+    """Route by host onto per-replica in-process apps (the test_router
+    harness shape)."""
+
+    def __init__(self, apps):
+        self.adapters = {
+            host: WSGIAdapter(app) for host, app in apps.items()
+        }
+
+    def send(self, request, **kwargs):
+        host = urlsplit(request.url).netloc
+        return self.adapters[host].send(request, **kwargs)
+
+    def close(self):
+        pass
+
+
+def _make_stream_plane(collection, monkeypatch, tmp_path, rids=("r0", "r1")):
+    from gordo_tpu.router.app import RouterApp
+    from gordo_tpu.server import build_app
+
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(collection))
+    server_utils.clear_caches()
+    manifest = write_shard_manifest(
+        str(tmp_path / "stream_manifest.json"), list(rids)
+    )
+    apps = {
+        f"{rid}.test": build_app(
+            {"SHARD_MANIFEST": manifest, "REPLICA_ID": rid}
+        )
+        for rid in rids
+    }
+    session = requests.Session()
+    session.mount("http://", MultiReplicaAdapter(apps))
+    router = RouterApp(
+        {
+            "REPLICAS": {rid: f"http://{rid}.test" for rid in rids},
+            "SESSION": session,
+            "PROBE_INTERVAL_S": 0,  # lazy half-open: no prober thread
+            "BACKOFF_SCALE": 0.002,
+            # eject on the first failure: the resume re-open must land
+            # on the successor without waiting out consecutive-failure
+            # accumulation (test-paced, like BACKOFF_SCALE)
+            "EJECT_AFTER": 1,
+        }
+    )
+    return router, apps
+
+
+def test_router_stream_survives_replica_death(
+    stream_collection, monkeypatch, tmp_path
+):
+    """THE router acceptance: a multi-machine stream spans both shard
+    replicas; the owning replica dies mid-stream; the publisher resumes
+    on the successor (adopt header) with zero unstructured errors and
+    bit-identical scores."""
+    from gordo_tpu.router.ring import HashRing
+
+    router, apps = _make_stream_plane(
+        stream_collection, monkeypatch, tmp_path
+    )
+    try:
+        router_client = _loopback_client(router)
+        data = {m: _rows(26, seed=8 + i) for i, m in enumerate(WINDOWED)}
+        reference = {
+            m: _one_shot_outputs(WerkzeugClient(router), m, data[m])
+            for m in WINDOWED
+        }
+        # kill the replica that OWNS the first streamed machine — the
+        # death must hit a live sub-session
+        victim = HashRing(["r0", "r1"]).owner(WINDOWED[0])
+        outs = {m: [] for m in WINDOWED}
+        with _stream_publisher(router_client, WINDOWED) as stream:
+            i = 0
+            for n, k in enumerate((8, 6, 6, 6)):
+                if n == 2:
+                    monkeypatch.setenv(
+                        faults.FAULT_INJECT_ENV_VAR,
+                        f"replica:die:{victim}@attempts:4",
+                    )
+                    faults.reset()
+                scores = stream.send(
+                    {m: data[m][i : i + k] for m in WINDOWED}
+                )
+                i += k
+                for m in WINDOWED:
+                    if len(scores.get(m, [])):
+                        outs[m].append(scores[m])
+            assert stream.reconnects >= 1
+        for m in WINDOWED:
+            np.testing.assert_array_equal(
+                reference[m], np.concatenate(outs[m])
+            )
+    finally:
+        router.close()
+
+
+def test_router_membership_change_drains_streams(
+    stream_collection, monkeypatch, tmp_path
+):
+    router, apps = _make_stream_plane(
+        stream_collection, monkeypatch, tmp_path
+    )
+    try:
+        client = _loopback_client(router)
+        data = _rows(24, seed=11)
+        outs = []
+        with _stream_publisher(client, WINDOWED[0]) as stream:
+            outs.append(stream.send(data[:8]))
+            # a no-op membership swap still drains every held stream:
+            # the partition may have moved, only a re-open can tell
+            router.set_replicas(
+                {rid: f"http://{rid}.test" for rid in ("r0", "r1")}
+            )
+            outs.append(stream.send(data[8:16]))
+            assert stream.reconnects == 1
+            outs.append(stream.send(data[16:]))
+        reference = _one_shot_outputs(
+            WerkzeugClient(router), WINDOWED[0], data
+        )
+        np.testing.assert_array_equal(
+            reference, np.concatenate([o for o in outs if len(o)])
+        )
+    finally:
+        router.close()
+
+
+# -- admission control + healthz -------------------------------------------
+
+
+def test_open_sheds_503_when_table_full_of_active_streams(
+    stream_collection, monkeypatch
+):
+    app = _build_stream_app(
+        stream_collection, monkeypatch, STREAM_MAX_SESSIONS=1
+    )
+    client = WerkzeugClient(app)
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [WINDOWED[0]]}
+    )
+    assert resp.status_code == 201
+    sid = json.loads(resp.get_data())["session"]
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [DENSE]}
+    )
+    assert resp.status_code == 503
+    assert resp.headers.get("Retry-After")
+    # closing the live stream frees the slot
+    client.post(f"/gordo/v0/{PROJECT}/stream/{sid}/close")
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [DENSE]}
+    )
+    assert resp.status_code == 201
+
+
+def test_idle_session_evicted_for_new_stream(stream_collection, monkeypatch):
+    app = _build_stream_app(
+        stream_collection, monkeypatch, STREAM_MAX_SESSIONS=1,
+        STREAM_IDLE_S=0.0,
+    )
+    client = WerkzeugClient(app)
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [WINDOWED[0]]}
+    )
+    old_sid = json.loads(resp.get_data())["session"]
+    # idle window 0: the LRU victim is evictable immediately
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [DENSE]}
+    )
+    assert resp.status_code == 201
+    # the evicted session answers the resume contract
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/{old_sid}/update",
+        json={"updates": {WINDOWED[0]: {"rows": [[0, 0, 0, 0]], "seq": 0}}},
+    )
+    assert resp.status_code == 409
+    assert "stream_resume" in json.loads(resp.get_data())
+
+
+def test_burst_chaos_sheds_and_publisher_honors_retry_after(
+    stream_collection, monkeypatch
+):
+    app = _build_stream_app(
+        stream_collection, monkeypatch, STREAM_MAX_BACKLOG=4
+    )
+    client = _loopback_client(app)
+    data = _rows(16, seed=12)
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR,
+        f"stream:burst:{WINDOWED[0]}@rate:32@attempts:1",
+    )
+    faults.reset()
+    with _stream_publisher(client, WINDOWED[0]) as stream:
+        outs = [stream.send(data[:8]), stream.send(data[8:])]
+        assert stream.sheds_honored >= 1  # the burst update shed first
+    reference = _one_shot_outputs(WerkzeugClient(app), WINDOWED[0], data)
+    np.testing.assert_array_equal(
+        reference, np.concatenate([o for o in outs if len(o)])
+    )
+
+
+def test_stall_chaos_delays_but_serves(stream_collection, monkeypatch):
+    app = _build_stream_app(stream_collection, monkeypatch)
+    client = WerkzeugClient(app)
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [DENSE]}
+    )
+    sid = json.loads(resp.get_data())["session"]
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR, f"stream:stall:{DENSE}@ms:30@attempts:1"
+    )
+    faults.reset()
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+        json={"updates": {DENSE: {"rows": _rows(4).tolist(), "seq": 0}}},
+    )
+    assert resp.status_code == 200
+    registry = faults.active_registry()
+    assert registry is not None and registry.specs[0].fires == 1
+
+
+def test_healthz_reports_saturated_stream_backlog(
+    stream_collection, monkeypatch
+):
+    """The /healthz satellite: a replica whose per-session update queue
+    is saturated reads not-ready with Retry-After, so the router/LB
+    drains it."""
+    app = _build_stream_app(
+        stream_collection, monkeypatch, STREAM_MAX_BACKLOG=2
+    )
+    client = WerkzeugClient(app)
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [DENSE]}
+    )
+    sid = json.loads(resp.get_data())["session"]
+    assert client.get("/healthz").status_code == 200
+    session = app.catalog.streams.get(sid)
+    session.admit()
+    session.admit()  # backlog == bound: saturated
+    resp = client.get("/healthz")
+    assert resp.status_code == 503
+    assert resp.headers.get("Retry-After")
+    payload = json.loads(resp.get_data())
+    assert payload["status"] == "overloaded"
+    assert payload["streaming"]["saturated_sessions"] == 1
+    session.release()
+    session.release()
+    assert client.get("/healthz").status_code == 200
+
+
+# -- the continuous lifecycle feed -----------------------------------------
+
+
+def _stream_for_drift(app, machine, shift, event_log, n_updates=4):
+    client = WerkzeugClient(app)
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [machine]}
+    )
+    assert resp.status_code == 201, resp.get_data()
+    sid = json.loads(resp.get_data())["session"]
+    seq = 0
+    for n in range(n_updates):
+        rows = _rows(8, seed=100 + n) + shift
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+            json={"updates": {machine: {"rows": rows.tolist(), "seq": seq}}},
+        )
+        assert resp.status_code == 200, resp.get_data()
+        seq = json.loads(resp.get_data())["scores"][machine]["seq"]
+    client.post(f"/gordo/v0/{PROJECT}/stream/{sid}/close")
+
+
+def test_stream_observations_drive_scan_free_tick(
+    stream_collection, monkeypatch, tmp_path
+):
+    """THE lifecycle acceptance: accumulated stream observations feed
+    drift detection with ZERO window fetches for streamed machines; a
+    drifted streamed machine pays exactly one fetch, at refit time."""
+    from gordo_tpu.lifecycle import LifecycleConfig, LifecycleManager
+
+    # isolate lifecycle state from the shared session-scoped collection
+    revisions = tmp_path / "revisions"
+    revisions.mkdir()
+    collection = revisions / "rev-a"
+    shutil.copytree(stream_collection, collection)
+    event_log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("GORDO_TPU_EVENT_LOG", str(event_log))
+    app = _build_stream_app(collection, monkeypatch)
+
+    fetched_machines = []
+    from gordo_tpu.lifecycle.manager import LifecycleManager as LM
+
+    real_fetch = LM._fetch_window  # staticmethod -> plain function
+
+    def counting_fetch(meta, start, end):
+        fetched_machines.append(meta.get("name"))
+        return real_fetch(meta, start, end)
+
+    monkeypatch.setattr(
+        LM, "_fetch_window", staticmethod(counting_fetch)
+    )
+    # detection only: the refit/shadow cycle is test_lifecycle's job
+    monkeypatch.setattr(
+        LM,
+        "_refit",
+        lambda self, drifted, meta, window, live: (
+            {},
+            {},
+            {name: "refit stubbed out in this test" for name in drifted},
+        ),
+    )
+
+    def make_manager():
+        # thresholds sized for 1-epoch fixture models: healthy NEW data
+        # scores ratio ~1.6 on an underfit model, the +5 shift ~137 —
+        # ratio 10 splits them with a wide margin either way (the
+        # exceedance criterion saturates at 1.0 on underfit models, so
+        # it is parked out of reach)
+        return LifecycleManager(
+            str(collection),
+            LifecycleConfig(
+                ewma_alpha=1.0,
+                min_observations=1,
+                ratio_threshold=10.0,
+                exceedance_threshold=1.1,
+                promote=False,
+                stream_observations=str(event_log),
+            ),
+        )
+
+    # round 1: healthy streamed data -> monitored from observations,
+    # not drifted, ZERO fetches for the streamed machine (the other
+    # machines still scan)
+    _stream_for_drift(app, WINDOWED[0], shift=0.0, event_log=event_log)
+    result = make_manager().tick()
+    assert WINDOWED[0] in result.monitored
+    assert result.drifted == []
+    assert (
+        result.report["decisions"][WINDOWED[0]].get("source") == "stream"
+    )
+    assert WINDOWED[0] not in fetched_machines  # scan-free
+    assert DENSE in fetched_machines  # non-streamed machines still scan
+
+    # round 2: injected drift in the streamed data -> the tick detects
+    # it from observations alone; the only fetch for the machine is the
+    # refit-time one
+    fetched_machines.clear()
+    _stream_for_drift(app, WINDOWED[0], shift=5.0, event_log=event_log)
+    result = make_manager().tick()
+    assert WINDOWED[0] in result.drifted
+    assert fetched_machines.count(WINDOWED[0]) == 1  # refit data only
+
+    # round 3: the cursor advanced — a tick with no new observations
+    # falls back to scanning the machine (no stale double-feeding)
+    fetched_machines.clear()
+    result = make_manager().tick()
+    assert WINDOWED[0] in fetched_machines or WINDOWED[0] in result.drifted
+
+
+def test_stream_cursor_commits_only_after_monitor_save(
+    stream_collection, tmp_path
+):
+    """The byte cursor must advance only once the drained statistics
+    are safe in the monitor's saved state: a tick that dies between
+    drain and save re-drains the same observations instead of silently
+    discarding the consumed drift evidence."""
+    from gordo_tpu.lifecycle import LifecycleConfig, LifecycleManager
+
+    revisions = tmp_path / "revisions"
+    revisions.mkdir()
+    collection = revisions / "rev-a"
+    shutil.copytree(stream_collection, collection)
+    event_log = tmp_path / "events.jsonl"
+    record = {
+        "event": "stream_observation", "machine": WINDOWED[0],
+        "revision": "rev-a", "n": 8, "ratio_mean": 1.5, "exceedance": 1.0,
+    }
+    event_log.write_text(json.dumps(record) + "\n")
+    manager = LifecycleManager(
+        str(collection),
+        LifecycleConfig(stream_observations=str(event_log)),
+    )
+    cursor_path = os.path.join(manager.state_dir, "stream_cursor.json")
+    stats = manager._consume_stream_observations("rev-a")
+    assert stats[WINDOWED[0]]["n"] == 8
+    # drained but NOT yet persisted: a crash here re-drains next tick
+    assert not os.path.exists(cursor_path)
+    manager._commit_stream_cursor()
+    cursor = json.loads(open(cursor_path).read())
+    assert cursor["offset"] == event_log.stat().st_size
+    # committed: the next drain starts past the consumed bytes
+    assert manager._consume_stream_observations("rev-a") == {}
+
+
+# -- review-hardening pins -------------------------------------------------
+
+
+def test_update_rejects_mismatched_y_length(stream_collection, monkeypatch):
+    """A short y must 400 loudly, not mis-slice the target tail and
+    silently drop the machine's drift feed."""
+    app = _build_stream_app(stream_collection, monkeypatch)
+    client = WerkzeugClient(app)
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/open", json={"machines": [DENSE]}
+    )
+    sid = json.loads(resp.get_data())["session"]
+    resp = client.post(
+        f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+        json={
+            "updates": {
+                DENSE: {
+                    "rows": _rows(5).tolist(),
+                    "seq": 0,
+                    "y": _rows(2).tolist(),
+                }
+            }
+        },
+    )
+    assert resp.status_code == 400
+    assert "one target row per input row" in json.loads(resp.get_data())["error"]
+
+
+def test_publisher_surfaces_permanent_409_immediately(
+    stream_collection, monkeypatch, tmp_path
+):
+    """Opening a stream on a build-report casualty raises the typed
+    MachineUnavailable NOW — never a transient-retry loop ending in
+    StreamBroken."""
+    from gordo_tpu.client.io import MachineUnavailable
+
+    collection = tmp_path / "rev-a"
+    shutil.copytree(stream_collection, collection)
+    (collection / "build_report.json").write_text(
+        json.dumps(
+            {"failed": [{"machine": WINDOWED[0], "phase": "fetch"}]}
+        )
+    )
+    app = _build_stream_app(collection, monkeypatch)
+    client = _loopback_client(app)
+    publisher = _stream_publisher(client, WINDOWED[0])
+    with pytest.raises(MachineUnavailable):
+        publisher.open()
+    assert publisher.sheds_honored == 0
+
+
+def test_router_passes_deterministic_400_through_verbatim(
+    stream_collection, monkeypatch, tmp_path
+):
+    """A replica's 400 (bad rows) on a stream update is repeatable: the
+    router must surface it verbatim, not wrap it as a transient resume
+    and churn the client through replay loops."""
+    router, apps = _make_stream_plane(
+        stream_collection, monkeypatch, tmp_path
+    )
+    try:
+        client = WerkzeugClient(router)
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/stream/open",
+            json={"machines": [WINDOWED[0]]},
+        )
+        assert resp.status_code == 201
+        sid = json.loads(resp.get_data())["session"]
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+            json={
+                "updates": {
+                    WINDOWED[0]: {"rows": [[1.0, 2.0]], "seq": 0}  # wrong width
+                }
+            },
+        )
+        assert resp.status_code == 400
+        body = json.loads(resp.get_data())
+        assert "stream_resume" not in body
+        # the replica's own message, verbatim (here sklearn's width
+        # complaint from the host transform) — not a router rewrite
+        assert "feature" in body["error"]
+        # the session survived: a corrected update still serves
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+            json={
+                "updates": {
+                    WINDOWED[0]: {"rows": _rows(6).tolist(), "seq": 0}
+                }
+            },
+        )
+        assert resp.status_code == 200
+    finally:
+        router.close()
+
+
+def test_router_partial_shed_answers_resume_not_503(
+    stream_collection, monkeypatch, tmp_path
+):
+    """One replica sheds mid-update while another already committed its
+    machines' rows: passing the 503 through would make the client retry
+    seqs the committed replica then trims as overlap — those scores
+    would be lost for good. The router must answer the resume contract
+    instead, and the replayed stream must stay bitwise unbroken."""
+    from gordo_tpu.router.ring import HashRing
+
+    # r0/r2 split the fixture machines across both replicas (r0/r1 hash
+    # them all onto one, which would void the mixed-outcome scenario)
+    rids = ("r0", "r2")
+    partition = HashRing(list(rids)).partition(MACHINES)
+    assert partition.get(rids[0]) and partition.get(rids[1])
+    # one machine per replica, whichever they are
+    pair = [partition[rids[0]][0], partition[rids[1]][0]]
+    router, apps = _make_stream_plane(
+        stream_collection, monkeypatch, tmp_path, rids=rids
+    )
+    try:
+        client = _loopback_client(router)
+        data = {m: _rows(24, seed=30 + i) for i, m in enumerate(pair)}
+        reference = {
+            m: _one_shot_outputs(WerkzeugClient(router), m, data[m])
+            for m in pair
+        }
+        outs = {m: [] for m in pair}
+        with _stream_publisher(client, pair) as stream:
+            i = 0
+            for n, k in enumerate((8, 8, 8)):
+                if n == 1:
+                    # burst-shed ONLY the session holding pair[0]: its
+                    # replica sheds while the other commits — the mixed
+                    # outcome under test
+                    monkeypatch.setenv(
+                        faults.FAULT_INJECT_ENV_VAR,
+                        f"stream:burst:{pair[0]}@rate:64@attempts:1",
+                    )
+                    faults.reset()
+                scores = stream.send({m: data[m][i : i + k] for m in pair})
+                i += k
+                for m in pair:
+                    if len(scores.get(m, [])):
+                        outs[m].append(scores[m])
+        for m in pair:
+            np.testing.assert_array_equal(
+                reference[m], np.concatenate(outs[m])
+            )
+    finally:
+        router.close()
+
+
+def test_router_mixed_refusal_goes_stale_and_frees_replica_windows(
+    stream_collection, monkeypatch, tmp_path
+):
+    """One sub-session commits while another refuses (400): the 4xx
+    surfaces verbatim NOW, but the proxy goes stale so the next update
+    answers the resume contract (the committed sub is ahead of the
+    client's seq cursor — serving it more updates would trim fresh rows
+    as overlap). The stale pop must also CLOSE the downstream
+    sub-sessions, freeing their device-resident windows."""
+    from gordo_tpu.router.ring import HashRing
+
+    rids = ("r0", "r2")  # split the fixture machines (see partial-shed)
+    partition = HashRing(list(rids)).partition(MACHINES)
+    assert partition.get(rids[0]) and partition.get(rids[1])
+    good, bad = partition[rids[0]][0], partition[rids[1]][0]
+    router, apps = _make_stream_plane(
+        stream_collection, monkeypatch, tmp_path, rids=rids
+    )
+    try:
+        client = WerkzeugClient(router)
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/stream/open",
+            json={"machines": [good, bad]},
+        )
+        assert resp.status_code == 201
+        sid = json.loads(resp.get_data())["session"]
+        assert sum(len(app.catalog.streams) for app in apps.values()) == 2
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+            json={
+                "updates": {
+                    good: {"rows": _rows(6).tolist(), "seq": 0},
+                    bad: {"rows": [[1.0, 2.0]], "seq": 0},  # wrong width
+                }
+            },
+        )
+        assert resp.status_code == 400  # the refusal, verbatim
+        assert "stream_resume" not in json.loads(resp.get_data())
+        # ...but the proxy went stale: the next update re-anchors
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/stream/{sid}/update",
+            json={"updates": {good: {"rows": _rows(6).tolist(), "seq": 6}}},
+        )
+        assert resp.status_code == 409
+        assert json.loads(resp.get_data()).get("stream_resume")
+        # and the stale pop closed both replicas' sub-sessions
+        assert sum(len(app.catalog.streams) for app in apps.values()) == 0
+    finally:
+        router.close()
+
+
+def test_open_rejects_malformed_machine_entries_with_400(
+    stream_collection, monkeypatch
+):
+    """Non-dict per-machine entries (and non-dict resume blocks) must
+    400 at the parser, not 500 on an AttributeError deep in open — a
+    500 through the router reads as transient and gets retried."""
+    app = _build_stream_app(stream_collection, monkeypatch)
+    client = WerkzeugClient(app)
+    for machines in (
+        {WINDOWED[0]: "oops"},
+        {WINDOWED[0]: ["oops"]},
+        {WINDOWED[0]: {"resume": "nope"}},
+    ):
+        resp = client.post(
+            f"/gordo/v0/{PROJECT}/stream/open", json={"machines": machines}
+        )
+        assert resp.status_code == 400, resp.get_data()
+
+
+def test_stream_machine_update_posts_have_no_read_timeout():
+    """stream_machine's publisher must keep the prediction family's
+    no-read-timeout discipline: a coalesced dispatch slower than the
+    metadata timeout would otherwise churn the session mid-commit and
+    double-emit those rows' drift observations."""
+    from gordo_tpu.client.client import Client
+
+    client = Client(
+        project=PROJECT, host="stream.test", port=80, scheme="http",
+        session=requests.Session(),
+    )
+    publisher = client.stream_machine(WINDOWED[0])
+    connect, read = publisher.timeout
+    assert connect == client.metadata_timeout
+    assert read is None
+
+
+# -- chaos grammar + strict no-op ------------------------------------------
+
+
+def test_stream_fault_grammar_and_defaults(monkeypatch):
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR,
+        "stream:stall:m-1@ms:80;stream:burst:m-2@rate:16;stream:drop:m-3",
+    )
+    faults.reset()
+    assert faults.stream_fault_action(["m-1"]) == ("stall", 0.08)
+    assert faults.stream_fault_action(["m-2"]) == ("burst", 16.0)
+    assert faults.stream_fault_action(["m-3"]) == ("drop", 0.0)
+    assert faults.stream_fault_action(["unrelated"]) is None
+
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR, "stream:stall:m-1@ms:nope"
+    )
+    faults.reset()
+    with pytest.raises(ValueError, match="@ms"):
+        faults.stream_fault_action(["m-1"])
+
+
+def test_stream_seam_unset_env_is_strict_noop(monkeypatch):
+    monkeypatch.delenv(faults.FAULT_INJECT_ENV_VAR, raising=False)
+    faults.reset()
+
+    def explode(_):
+        raise AssertionError("parse_spec called with fault injection off")
+
+    monkeypatch.setattr(faults, "parse_spec", explode)
+    assert faults.stream_fault_action(["anything"]) is None
