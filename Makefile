@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test lint bench bench-cold-start bench-hetero bench-sharded bench-streaming build-multiworker images push
+.PHONY: all test lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming build-multiworker images push
 
 all: lint test
 
@@ -27,6 +27,12 @@ lint:
 
 bench:
 	python bench.py
+
+# fold every ad-hoc results_*.json into one benchmarks/trajectory.json
+# (bench name, revision, headline metric, knob settings) — the autotuner
+# corpus reader ingests it (docs/tuning.md)
+bench-summary:
+	python benchmarks/consolidate.py
 
 # time-to-first-prediction for a freshly exec'd server, cold trace vs
 # the build-time AOT executable cache (docs/performance.md)

@@ -234,6 +234,7 @@ def main() -> dict:
     import jax
 
     result = {
+        "bench_schema_version": 1,
         "benchmark": "cold_start",
         "platform": jax.default_backend(),
         "device_kind": getattr(jax.devices()[0], "device_kind", None),

@@ -362,6 +362,7 @@ def main():
     print(
         json.dumps(
             {
+                "bench_schema_version": 1,
                 **internal,
                 "machines": args.machines,
                 "buckets": args.buckets,

@@ -169,6 +169,7 @@ def main() -> int:
         for name in exact["mae"]
     }
     result = {
+        "bench_schema_version": 1,
         "bench": "hetero_fleet",
         "backend": os.environ.get("JAX_PLATFORMS") or "default",
         "matrix": {

@@ -173,6 +173,7 @@ def main():
     )
 
     out = {
+        "bench_schema_version": 1,
         "bench": "lifecycle_cycle",
         "n_machines": args.machines,
         "n_drifted": args.drifted,

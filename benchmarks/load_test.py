@@ -331,6 +331,7 @@ def run_sharded_bench(args, tmp: str) -> dict:
             round(kill_goodput / healthy, 3) if healthy else 0.0
         )
     return {
+        "bench_schema_version": 1,
         "mode": "sharded-open-loop",
         "offered_rps": args.rps,
         "duration_s": args.duration,
@@ -569,6 +570,7 @@ def main():
 
     summary = summarize_ms(latencies) if latencies else {}
     out = {
+        "bench_schema_version": 1,
         "mode": "open" if args.open_loop else "closed",
         **(
             {"offered_rps": args.rps}

@@ -154,6 +154,7 @@ def main():
             )
 
     out = {
+        "bench_schema_version": 1,
         "bench": "multi_worker_build",
         "n_machines": args.machines,
         "n_buckets": args.buckets,

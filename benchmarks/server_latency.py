@@ -242,7 +242,7 @@ def main():
         )
         X = dataframe_to_dict(frame)
 
-        results = {}
+        results = {"bench_schema_version": 1, "bench": "server_latency"}
         base_url = "/gordo/v0/proj"
         # warmup (first request pays model load + jit compile)
         client.post(f"{base_url}/bench-m0/prediction", json={"X": X})

@@ -199,6 +199,7 @@ def main():
 
     sweep = [int(n) for n in str(args.streams).split(",") if n.strip()]
     results = {
+        "bench_schema_version": 1,
         "bench": "stream_load",
         "model": args.model,
         "n_machines": args.machines,

@@ -18,6 +18,9 @@ Layout:
 - ``jax_checks.py``  the JAX-discipline family: retrace-risk,
                      host-sync, prng-reuse, prng-split-width,
                      traced-branch
+- ``knob_checks.py`` knob-discipline: every GORDO_* env read must be
+                     classified in the knob registry
+                     (gordo_tpu/tuning/knobs.py)
 - ``registry.py``    one CheckSpec per check (name, doc, severity,
                      fixer hint, scope)
 - ``engine.py``      file discovery, dispatch, suppressions, baseline
@@ -63,6 +66,10 @@ from gordo_tpu.analysis.jax_checks import (
     check_retrace_risk,
     check_traced_branching,
 )
+from gordo_tpu.analysis.knob_checks import (
+    check_knob_discipline,
+    collect_env_reads,
+)
 from gordo_tpu.analysis.registry import (
     CHECKS,
     CHECKS_BY_NAME,
@@ -87,6 +94,7 @@ __all__ = [
     "check_annotated_param_method_calls",
     "check_call_signatures",
     "check_host_sync",
+    "check_knob_discipline",
     "check_metric_registrations",
     "check_module_attributes",
     "check_module_shadowing",
@@ -99,6 +107,7 @@ __all__ = [
     "check_span_discipline",
     "check_traced_branching",
     "check_unused_imports",
+    "collect_env_reads",
     "collect_event_names",
     "collect_metric_names",
     "collect_span_names",

@@ -819,6 +819,9 @@ ALLOWED_METRIC_LABELS = frozenset(
         # replica ids are a config-bounded handful per deployment (the
         # router's shard manifest names them all), not a cardinality risk
         "replica",
+        # knob names are bounded by the knob registry
+        # (gordo_tpu/tuning/knobs.py), a fixed compile-time set
+        "knob",
     }
 )
 

@@ -1,0 +1,127 @@
+"""
+The ``knob-discipline`` check (docs/static_analysis.md, docs/tuning.md):
+every ``GORDO_*`` env var the tree READS — directly
+(``os.environ.get``/``os.environ[...]``/``os.getenv``, the ``_env_*``
+helper family) or through a ``click.option(envvar=...)`` declaration —
+must be classified in the knob registry (``gordo_tpu/tuning/knobs.py``):
+either as a :class:`~gordo_tpu.tuning.knobs.Knob`'s ``env_var`` or in
+``NON_KNOB_ENV_VARS`` with the other deliberate non-knobs.
+
+This is the docs-catalogue sync discipline (``collect_metric_names`` /
+``collect_event_names`` / ``collect_span_names``) applied to
+configuration: an unregistered knob is configuration the autotuner
+cannot tune, the docs knob table cannot list, and operators cannot
+discover — exactly how ~a dozen knobs accreted by hand across PRs 1-12.
+The registry side of the gate lives here; the docs side (every knob in
+docs/performance.md's knob table) is enforced by
+tests/test_static.py::test_knobs_documented.
+
+Like the metric check, only LITERAL env names are vouched for; reads
+through a named constant are out of scope. ``GORDO_TEST_*`` names are
+exempt: test-suite switches, not production configuration. Env WRITES
+(``os.environ[...] = ...``, ``monkeypatch.setenv``) never flag — the
+discipline is about configuration surface, not test setup.
+"""
+
+import ast
+import re
+import typing
+
+#: literal env names the check vouches for
+_ENV_NAME_RE = re.compile(r"^GORDO_[A-Z0-9_]+$")
+#: test-suite switches are not production configuration
+_EXEMPT_PREFIX = "GORDO_TEST_"
+#: env-reading helper callables (first positional arg = the name):
+#: the stdlib read, plus the tree's _env_bool/_env_int/_env_float family
+_ENV_HELPER_RE = re.compile(r"^(getenv|_env_[a-z0-9_]+)$")
+
+
+def _literal_env_name(node) -> typing.Optional[str]:
+    if (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and _ENV_NAME_RE.match(node.value)
+        and not node.value.startswith(_EXEMPT_PREFIX)
+    ):
+        return node.value
+    return None
+
+
+def _is_environ(node) -> bool:
+    """``environ`` / ``os.environ`` / ``<mod>.environ`` expressions."""
+    return (isinstance(node, ast.Name) and node.id == "environ") or (
+        isinstance(node, ast.Attribute) and node.attr == "environ"
+    )
+
+
+def collect_env_reads(
+    tree: ast.Module,
+) -> typing.List[typing.Tuple[str, int, str]]:
+    """Every literal GORDO_* env READ: ``(name, lineno, how)`` where
+    ``how`` is ``environ`` (get/subscript/getenv/helper) or ``envvar``
+    (a click option declaration)."""
+    out: typing.List[typing.Tuple[str, int, str]] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Subscript):
+            # loads only: os.environ["GORDO_X"] = ... is a write
+            if _is_environ(node.value) and isinstance(node.ctx, ast.Load):
+                name = _literal_env_name(node.slice)
+                if name:
+                    out.append((name, node.lineno, "environ"))
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        first = node.args[0] if node.args else None
+        if (
+            isinstance(func, ast.Attribute)
+            and func.attr == "get"
+            and _is_environ(func.value)
+        ):
+            name = _literal_env_name(first)
+            if name:
+                out.append((name, node.lineno, "environ"))
+        elif isinstance(func, (ast.Name, ast.Attribute)):
+            func_name = func.id if isinstance(func, ast.Name) else func.attr
+            if _ENV_HELPER_RE.match(func_name):
+                name = _literal_env_name(first)
+                if name:
+                    out.append((name, node.lineno, "environ"))
+        for keyword in node.keywords:
+            if keyword.arg != "envvar":
+                continue
+            candidates = (
+                keyword.value.elts
+                if isinstance(keyword.value, (ast.Tuple, ast.List))
+                else [keyword.value]
+            )
+            for candidate in candidates:
+                name = _literal_env_name(candidate)
+                if name:
+                    out.append((name, node.lineno, "envvar"))
+    return out
+
+
+def check_knob_discipline(tree: ast.Module) -> typing.List[str]:
+    """Flag every GORDO_* env read / click envvar declaration whose name
+    the knob registry does not classify."""
+    # lazy: the engine imports this module at registry load, and the
+    # registry must not drag the tuning subsystem in until a file is
+    # actually checked
+    from gordo_tpu.tuning.knobs import declared_env_vars
+
+    declared = declared_env_vars()
+    problems: typing.List[str] = []
+    for name, lineno, how in collect_env_reads(tree):
+        if name in declared:
+            continue
+        surface = (
+            "env read" if how == "environ" else "click option envvar"
+        )
+        problems.append(
+            f"line {lineno}: {surface} {name!r} is not classified in the "
+            f"knob registry — declare it as a Knob in "
+            f"gordo_tpu/tuning/knobs.py (performance knob) or add it to "
+            f"NON_KNOB_ENV_VARS (deliberate non-knob)"
+        )
+    return problems

@@ -23,7 +23,7 @@ style nit.
 import dataclasses
 import typing
 
-from gordo_tpu.analysis import checks, jax_checks
+from gordo_tpu.analysis import checks, jax_checks, knob_checks
 
 
 @dataclasses.dataclass(frozen=True)
@@ -142,6 +142,16 @@ CHECKS: typing.Tuple[CheckSpec, ...] = (
         "**trace_fields(span) or the ambient span",
         scope="syntactic",
         run=_syntactic(checks.check_span_discipline),
+    ),
+    CheckSpec(
+        name="knob-discipline",
+        doc="GORDO_* env reads / click envvar declarations absent from "
+        "the knob registry (gordo_tpu/tuning/knobs.py)",
+        severity="error",
+        fixer="declare the env var as a Knob (performance knob) or add "
+        "it to NON_KNOB_ENV_VARS (deliberate non-knob)",
+        scope="syntactic",
+        run=_syntactic(knob_checks.check_knob_discipline),
     ),
     # -- the JAX-discipline family (jax_checks.py) -----------------------
     CheckSpec(

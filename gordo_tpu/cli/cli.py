@@ -35,6 +35,7 @@ from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
 from gordo_tpu.cli.lifecycle import lifecycle_cli
 from gordo_tpu.cli.lint import lint_cli
 from gordo_tpu.cli.trace import trace_cli
+from gordo_tpu.cli.tune import tune_cli
 from gordo_tpu.cli.workflow_generator import workflow_cli
 from gordo_tpu.data.base import InsufficientDataError
 from gordo_tpu.data.datasets import InsufficientDataAfterRowFilteringError
@@ -393,6 +394,30 @@ def build_fleet(
             raise click.UsageError(
                 "MACHINES-CONFIG is required (argument or MACHINES env var)"
             )
+        # the collection's tuning profile (docs/tuning.md) fills in knobs
+        # still at their built-in defaults; anything set on the CLI or
+        # through its env var wins. No profile -> strict no-op.
+        from gordo_tpu.tuning import profile as tuning_profile
+
+        profile_overrides = tuning_profile.apply_to_click_params(
+            click.get_current_context(),
+            output_dir,
+            # the TUNABLE builder/ledger knobs only — non-tunable knobs
+            # (max_attempts, fetch retries/timeouts) never get profile
+            # recommendations, by registry declaration
+            {
+                "epoch_chunk": "epoch_chunk",
+                "bucket_policy": "bucket_policy",
+                "build_workers": "workers",
+                "lease_ttl": "lease_ttl",
+            },
+            subsystem="builder",
+        )
+        epoch_chunk = profile_overrides.get("epoch_chunk", epoch_chunk)
+        bucket_policy = profile_overrides.get("bucket_policy", bucket_policy)
+        lease_ttl = profile_overrides.get("lease_ttl", lease_ttl)
+        if "workers" in profile_overrides:
+            workers = str(profile_overrides["workers"])
         n_workers = 1
         if str(workers).strip().lower() != "1":
             n_workers = fleet_ledger.resolve_workers(workers)
@@ -855,17 +880,19 @@ def telemetry_summarize(directory: str, as_json: bool):
     Aggregate every ``telemetry_report*.json`` and ``*.jsonl`` event log
     under DIRECTORY (a build output dir, or a root holding many) into one
     human-readable fleet summary: machines built, models/hour, compile vs
-    steady-state epoch time, training throughput, peak device memory, and
-    any crash context the event logs captured.
+    steady-state epoch time, training throughput, peak device memory,
+    casualties, compile-cache growth, per-subsystem event sections
+    (batching, ledger, router, streaming, lifecycle, programs, tuning),
+    and any crash context the event logs captured. ``--as-json`` emits
+    the versioned machine-readable payload (``schema_version``) instead.
     """
-    from gordo_tpu.observability.report import load_reports, summarize_directory
+    from gordo_tpu.observability.report import (
+        summarize_directory,
+        summary_payload,
+    )
 
     if as_json:
-        payload = [
-            {"path": str(path), "report": report}
-            for path, report in load_reports(directory)
-        ]
-        click.echo(json.dumps(payload, indent=2, default=str))
+        click.echo(json.dumps(summary_payload(directory), indent=2, default=str))
     else:
         click.echo(summarize_directory(directory))
 
@@ -1002,16 +1029,28 @@ def run_server_cli(
     with_prometheus,
 ):
     """Run the model server (reference: cli.py:278-374)."""
+    from click.core import ParameterSource
+
     from gordo_tpu.server import app as server_app
 
     config = {
-        "BATCH_WAIT_MS": batch_wait_ms,
-        "BATCH_QUEUE_LIMIT": queue_limit,
-        "SCORER_CACHE_SIZE": scorer_cache_size,
         "AOT_CACHE": aot_cache,
         "SHARD_MANIFEST": shard_manifest,
         "REPLICA_ID": replica_id,
     }
+    # tuned knobs ride into config only when set explicitly (flag or env
+    # var); left at their built-in default they fall through build_app's
+    # env -> tuning-profile -> default resolution, so the collection's
+    # tuning_profile.json supplies measured defaults while explicit
+    # configuration always wins (docs/tuning.md "Precedence").
+    ctx = click.get_current_context()
+    for config_key, param_name, value in (
+        ("BATCH_WAIT_MS", "batch_wait_ms", batch_wait_ms),
+        ("BATCH_QUEUE_LIMIT", "queue_limit", queue_limit),
+        ("SCORER_CACHE_SIZE", "scorer_cache_size", scorer_cache_size),
+    ):
+        if ctx.get_parameter_source(param_name) != ParameterSource.DEFAULT:
+            config[config_key] = value
     if with_prometheus:
         config["ENABLE_PROMETHEUS"] = True
     server_app.run_server(
@@ -1190,6 +1229,7 @@ gordo.add_command(buckets_cli)
 gordo.add_command(programs_cli)
 gordo.add_command(telemetry_cli)
 gordo.add_command(trace_cli)
+gordo.add_command(tune_cli)
 gordo.add_command(lint_cli)
 gordo.add_command(lifecycle_cli)
 

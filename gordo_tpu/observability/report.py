@@ -15,6 +15,43 @@ logger = logging.getLogger(__name__)
 
 TELEMETRY_REPORT_FILENAME = "telemetry_report.json"
 TELEMETRY_REPORT_VERSION = 1
+#: schema of the ``telemetry summarize --as-json`` payload (v2: object
+#: with per-subsystem event sections; v1 was a bare report list)
+SUMMARY_SCHEMA_VERSION = 2
+
+#: event-type -> subsystem classification for the per-subsystem summary
+#: sections: ordered (prefix | exact-name set) rules, first match wins.
+#: Grown with the tree — PRs 6-12 added batching/ledger/router/streaming
+#: events the original flat summary predates.
+EVENT_SUBSYSTEM_RULES: typing.Tuple[
+    typing.Tuple[str, typing.Tuple[str, ...], typing.Tuple[str, ...]], ...
+] = (
+    ("batching", ("batch_",), ("request_shed",)),
+    ("ledger", ("lease_", "worker_", "ledger_"), ("unit_poisoned",)),
+    ("router", ("replica_", "router_"), ("shard_failover",)),
+    ("streaming", ("stream_",), ()),
+    (
+        "lifecycle",
+        ("drift_", "refit_", "revision_", "lifecycle_"),
+        ("machine_drifted", "checkpoint_fallback"),
+    ),
+    ("programs", ("program_cache_", "compile_cache_"), ()),
+    ("tuning", ("tuning_",), ()),
+    (
+        "robustness",
+        ("fault_",),
+        ("machine_quarantined", "build_machine_failed"),
+    ),
+)
+
+
+def classify_event(event: str) -> str:
+    """The summary subsystem an event type belongs to ('build' for the
+    original build/training family and anything unrecognized)."""
+    for subsystem, prefixes, names in EVENT_SUBSYSTEM_RULES:
+        if event in names or any(event.startswith(p) for p in prefixes):
+            return subsystem
+    return "build"
 
 
 def write_telemetry_report(
@@ -134,13 +171,87 @@ def summarize_report(path: Path, report: dict) -> typing.List[str]:
                 else "n/a (backend reports no memory stats)"
             )
         )
+    # post-PR-1 report fields, each optional (older reports lack them)
+    if report.get("bucket_policy"):
+        lines.append(f"  bucket policy: {report['bucket_policy']}")
+    cache = report.get("compile_cache") or {}
+    if cache.get("end_bytes") is not None:
+        grown = cache.get("grown_bytes")
+        lines.append(
+            "  compile cache: {e}{g}".format(
+                e=_fmt_bytes(cache.get("end_bytes")),
+                g=(
+                    f" (+{_fmt_bytes(grown)} this build)"
+                    if grown
+                    else ""
+                ),
+            )
+        )
+    failed = report.get("machines_failed") or []
+    quarantined = report.get("machines_quarantined") or []
+    if failed or quarantined:
+        lines.append(
+            f"  casualties: {len(failed)} failed, "
+            f"{len(quarantined)} quarantined"
+        )
+        for record in failed:
+            lines.append(
+                "    FAILED {m} ({p}): {e}".format(
+                    m=record.get("machine", "?"),
+                    p=record.get("phase", "?"),
+                    e=record.get("error", "?"),
+                )
+            )
+        for record in quarantined:
+            lines.append(
+                "    QUARANTINED {m} at epoch {e}".format(
+                    m=record.get("machine", "?"),
+                    e=record.get("epoch", "?"),
+                )
+            )
     return lines
+
+
+def group_events_by_subsystem(
+    event_files: typing.Sequence[typing.Tuple[Path, typing.List[dict]]]
+) -> typing.Dict[str, typing.Dict[str, int]]:
+    """``{subsystem: {event type: count}}`` across the event logs."""
+    out: typing.Dict[str, typing.Dict[str, int]] = {}
+    for _, records in event_files:
+        for record in records:
+            event = record["event"]
+            counts = out.setdefault(classify_event(event), {})
+            counts[event] = counts.get(event, 0) + 1
+    return out
+
+
+def summary_payload(directory: typing.Union[str, Path]) -> dict:
+    """
+    The ``telemetry summarize --as-json`` payload: versioned
+    (``schema_version``) object carrying every report plus the event
+    counts grouped per subsystem — the machine-readable sibling of
+    :func:`summarize_directory`.
+    """
+    directory = Path(directory)
+    reports = load_reports(directory)
+    event_files = load_event_files(directory)
+    return {
+        "schema_version": SUMMARY_SCHEMA_VERSION,
+        "directory": str(directory),
+        "reports": [
+            {"path": str(path), "report": report} for path, report in reports
+        ],
+        "n_events": sum(len(records) for _, records in event_files),
+        "events": group_events_by_subsystem(event_files),
+    }
 
 
 def summarize_directory(directory: typing.Union[str, Path]) -> str:
     """
     The ``gordo-tpu telemetry summarize`` body: every telemetry report
-    and event log under ``directory``, aggregated into one fleet view.
+    and event log under ``directory``, aggregated into one fleet view
+    with per-subsystem event sections (batching, ledger, router,
+    streaming, lifecycle, programs, tuning, robustness, build).
     """
     directory = Path(directory)
     reports = load_reports(directory)
@@ -184,12 +295,13 @@ def summarize_directory(directory: typing.Union[str, Path]) -> str:
 
     n_events = sum(len(records) for _, records in event_files)
     lines.append(f"Event logs: {len(event_files)} file(s), {n_events} event(s)")
-    counts: typing.Dict[str, int] = {}
-    for _, records in event_files:
-        for record in records:
-            counts[record["event"]] = counts.get(record["event"], 0) + 1
-    for event, count in sorted(counts.items()):
-        lines.append(f"  {event}: {count}")
+    for subsystem, counts in sorted(
+        group_events_by_subsystem(event_files).items()
+    ):
+        total = sum(counts.values())
+        lines.append(f"  [{subsystem}] {total} event(s)")
+        for event, count in sorted(counts.items()):
+            lines.append(f"    {event}: {count}")
     crashes = [
         record
         for _, records in event_files

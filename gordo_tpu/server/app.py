@@ -1650,6 +1650,82 @@ def adapt_proxy_deployment(environ: dict) -> None:
         environ["SCRIPT_NAME"] = original[: len(original) - len(path)]
 
 
+#: serving knobs the collection's tuning profile may default
+#: (docs/tuning.md): config key, env var, registry knob name, cast,
+#: built-in default. Precedence per knob: explicit config > env var >
+#: tuning_profile.json > built-in default.
+_TUNED_SERVER_KNOBS = (
+    ("BATCH_WAIT_MS", "GORDO_BATCH_WAIT_MS", "batch_wait_ms", float, 0.0),
+    (
+        "BATCH_QUEUE_LIMIT",
+        "GORDO_BATCH_QUEUE_LIMIT",
+        "batch_queue_limit",
+        int,
+        64,
+    ),
+    (
+        "SCORER_CACHE_SIZE",
+        "GORDO_SCORER_CACHE_SIZE",
+        "scorer_cache_size",
+        int,
+        16,
+    ),
+)
+
+
+def _apply_tuning_profile(config: dict) -> None:
+    """
+    Resolve the tuned serving knobs into ``config``: explicit config and
+    env vars win; knobs still unset take the collection's
+    ``tuning_profile.json`` recommendation (docs/tuning.md); the rest
+    get the built-in default. The profile is looked up lazily — with
+    every knob explicit, or no profile present, this is a strict no-op
+    beyond one env lookup + at most one stat — and every application is
+    recorded (``tuning_profile_loaded`` event +
+    ``gordo_tuning_profile_applied`` gauge) so the running config stays
+    attributable.
+    """
+    from gordo_tpu.tuning import profile as tuning_profile
+
+    loaded: typing.Any = None  # None = not looked up; False = absent
+    recommended: typing.Dict[str, typing.Any] = {}
+    applied: typing.Dict[str, typing.Any] = {}
+    for config_key, env_var, knob_name, cast, default in _TUNED_SERVER_KNOBS:
+        if config_key in config:
+            continue
+        raw = os.environ.get(env_var)
+        if raw:
+            config[config_key] = cast(raw)
+            continue
+        if loaded is None:
+            env_dir_var = config.get(
+                "MODEL_COLLECTION_DIR_ENV_VAR",
+                Config.MODEL_COLLECTION_DIR_ENV_VAR,
+            )
+            loaded = (
+                tuning_profile.load_collection_profile(
+                    os.environ.get(env_dir_var)
+                )
+                or False
+            )
+            if loaded:
+                recommended = tuning_profile.recommended_values(
+                    loaded[1], subsystems=("server",)
+                )
+        if loaded and knob_name in recommended:
+            config[config_key] = cast(recommended[knob_name])
+            applied[knob_name] = config[config_key]
+        else:
+            config[config_key] = default
+    if loaded and applied:
+        # attribution only when a knob actually took a profile value —
+        # a profile with nothing for this subsystem (or fully-explicit
+        # config) must not emit an empty event per server start
+        tuning_profile.record_applied(
+            loaded[0], loaded[1], applied, subsystem="server"
+        )
+
+
 def build_app(
     config: typing.Optional[dict] = None,
     prometheus_registry=None,
@@ -1658,18 +1734,7 @@ def build_app(
     config = dict(config or {})
     if "ENABLE_PROMETHEUS" not in config:
         config["ENABLE_PROMETHEUS"] = _env_bool("ENABLE_PROMETHEUS", False)
-    if "BATCH_WAIT_MS" not in config:
-        config["BATCH_WAIT_MS"] = float(
-            os.environ.get("GORDO_BATCH_WAIT_MS") or 0.0
-        )
-    if "BATCH_QUEUE_LIMIT" not in config:
-        config["BATCH_QUEUE_LIMIT"] = int(
-            os.environ.get("GORDO_BATCH_QUEUE_LIMIT") or 64
-        )
-    if "SCORER_CACHE_SIZE" not in config:
-        config["SCORER_CACHE_SIZE"] = int(
-            os.environ.get("GORDO_SCORER_CACHE_SIZE") or 16
-        )
+    _apply_tuning_profile(config)
     if "AOT_CACHE" not in config:
         config["AOT_CACHE"] = _env_bool("GORDO_AOT_CACHE", True)
     if "STREAM_MAX_SESSIONS" not in config:

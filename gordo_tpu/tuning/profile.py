@@ -1,0 +1,280 @@
+"""
+The tuning profile: a versioned, human-reviewable ``tuning_profile.json``
+holding the cost model's measured knob recommendations for ONE
+collection, written next to its artifacts (beside
+``telemetry_report.json`` / ``build_report.json``).
+
+``build-fleet`` and ``run-server`` load the collection's profile BY
+DEFAULT, with a precedence rule pinned by test: **explicit always wins**
+— a knob set on the CLI or through its env var keeps that value; only
+knobs left at their built-in default take the profile's. Every
+application emits a ``tuning_profile_loaded`` event and sets the
+``gordo_tuning_profile_applied`` gauge per applied knob, so a fleet's
+effective configuration is always attributable. With no profile present
+the load path is a strict no-op (one env lookup + one stat — the PR-4
+``GORDO_FAULT_INJECT`` discipline).
+
+Versioning: a profile stamped with an UNKNOWN FUTURE ``profile_version``
+refuses to load with a clear error instead of silently applying half-
+understood recommendations; ``gordo-tpu tune plan --check`` additionally
+fails CI when a committed profile drifts from the knob registry (knob
+renamed/removed, value outside its domain).
+
+``GORDO_TUNING_PROFILE`` overrides discovery: a path loads that file for
+every collection; ``off``/``0``/``false`` disables profile loading.
+"""
+
+import json
+import logging
+import os
+import typing
+from pathlib import Path
+
+from gordo_tpu.observability import emit_event, get_registry
+from gordo_tpu.tuning.knobs import KNOBS_BY_NAME
+from gordo_tpu.tuning.model import Recommendation
+from gordo_tpu.utils.atomic import atomic_write_json
+
+logger = logging.getLogger(__name__)
+
+PROFILE_VERSION = 1
+TUNING_PROFILE_FILENAME = "tuning_profile.json"
+PROFILE_ENV_VAR = "GORDO_TUNING_PROFILE"
+_DISABLE_TOKENS = frozenset({"off", "0", "false", "no"})
+
+
+class TuningProfileError(ValueError):
+    """A profile that must not be applied: unreadable, unversioned, or
+    stamped with a future ``profile_version`` this build predates."""
+
+
+def build_profile(
+    recommendations: typing.Mapping[str, Recommendation],
+    corpus_meta: typing.Optional[dict] = None,
+    generated: typing.Optional[str] = None,
+) -> dict:
+    """The serializable profile payload (see docs/tuning.md 'Profile
+    schema')."""
+    from datetime import datetime, timezone
+
+    return {
+        "profile_version": PROFILE_VERSION,
+        "generated": generated
+        or datetime.now(timezone.utc).isoformat(),
+        "corpus": dict(corpus_meta or {}),
+        "recommendations": {
+            name: rec.to_dict() for name, rec in recommendations.items()
+        },
+    }
+
+
+def write_profile(
+    target: typing.Union[str, Path],
+    recommendations: typing.Mapping[str, Recommendation],
+    corpus_meta: typing.Optional[dict] = None,
+) -> Path:
+    """Atomically publish the profile at ``target`` (a directory gets
+    ``tuning_profile.json`` inside it)."""
+    path = Path(target)
+    if path.is_dir():
+        path = path / TUNING_PROFILE_FILENAME
+    payload = build_profile(recommendations, corpus_meta)
+    return atomic_write_json(path, payload, indent=2, sort_keys=True)
+
+
+def load_profile(path: typing.Union[str, Path]) -> dict:
+    """Parse + version-gate a profile file. Raises
+    :class:`TuningProfileError` on anything that must not apply."""
+    path = Path(path)
+    try:
+        payload = json.loads(path.read_text())
+    except (OSError, ValueError) as exc:
+        raise TuningProfileError(f"{path}: unreadable profile: {exc}")
+    if not isinstance(payload, dict):
+        raise TuningProfileError(f"{path}: profile must be a JSON object")
+    version = payload.get("profile_version")
+    if not isinstance(version, int) or isinstance(version, bool):
+        raise TuningProfileError(
+            f"{path}: missing/invalid profile_version "
+            f"(got {version!r}; this build understands <= {PROFILE_VERSION})"
+        )
+    if version > PROFILE_VERSION:
+        raise TuningProfileError(
+            f"{path}: profile_version {version} is newer than this build "
+            f"understands ({PROFILE_VERSION}) — refusing to apply a "
+            f"half-understood profile; upgrade gordo-tpu or re-fit with "
+            f"`gordo-tpu tune fit`"
+        )
+    if not isinstance(payload.get("recommendations", {}), dict):
+        raise TuningProfileError(
+            f"{path}: 'recommendations' must be an object"
+        )
+    return payload
+
+
+def validate_profile(profile: dict) -> typing.List[str]:
+    """Drift problems between a loaded profile and the CURRENT knob
+    registry — the ``tune plan --check`` CI gate: a knob that was
+    renamed/removed since the profile was fitted, a value outside the
+    knob's domain, or a recommendation for a knob the tuner does not
+    own."""
+    problems: typing.List[str] = []
+    for name, entry in (profile.get("recommendations") or {}).items():
+        knob = KNOBS_BY_NAME.get(name)
+        if knob is None:
+            problems.append(
+                f"recommendation for unknown knob {name!r} (renamed or "
+                f"removed from the registry?)"
+            )
+            continue
+        if not knob.tunable:
+            problems.append(
+                f"recommendation for non-tunable knob {name!r}"
+            )
+        value = (entry or {}).get("value")
+        if not knob.domain.contains(value):
+            problems.append(
+                f"{name}: recommended value {value!r} outside domain "
+                f"({knob.domain.describe()})"
+            )
+    return problems
+
+
+def resolve_profile_path(
+    collection_dir: typing.Optional[typing.Union[str, Path]]
+) -> typing.Optional[Path]:
+    """The profile file to load for ``collection_dir``, or None
+    (disabled / absent). The absent path is deliberately minimal: one
+    env lookup and at most one stat."""
+    override = os.environ.get(PROFILE_ENV_VAR)
+    if override:
+        if override.strip().lower() in _DISABLE_TOKENS:
+            return None
+        return Path(override)
+    if not collection_dir:
+        return None
+    path = Path(collection_dir) / TUNING_PROFILE_FILENAME
+    return path if path.is_file() else None
+
+
+def recommended_values(
+    profile: dict,
+    subsystems: typing.Optional[typing.Sequence[str]] = None,
+) -> typing.Dict[str, typing.Any]:
+    """``{knob name: recommended value}`` for the registry-valid,
+    in-domain recommendations (optionally restricted to subsystems).
+    Invalid entries are skipped with a warning — serving must not fail
+    on a drifted profile; the CI check exists to fail loudly instead."""
+    wanted = set(subsystems) if subsystems else None
+    out: typing.Dict[str, typing.Any] = {}
+    for name, entry in (profile.get("recommendations") or {}).items():
+        knob = KNOBS_BY_NAME.get(name)
+        if knob is None or not knob.tunable:
+            logger.warning(
+                "Ignoring profile recommendation for unknown/non-tunable "
+                "knob %r",
+                name,
+            )
+            continue
+        if wanted is not None and knob.subsystem not in wanted:
+            continue
+        value = (entry or {}).get("value")
+        if not knob.domain.contains(value):
+            logger.warning(
+                "Ignoring profile recommendation %s=%r: outside domain (%s)",
+                name,
+                value,
+                knob.domain.describe(),
+            )
+            continue
+        out[name] = value
+    return out
+
+
+def load_collection_profile(
+    collection_dir: typing.Optional[typing.Union[str, Path]]
+) -> typing.Optional[typing.Tuple[Path, dict]]:
+    """(path, profile) for the collection, or None when disabled/absent.
+    A present-but-unloadable profile (torn write, future version) logs
+    and returns None — explicit/default configuration then stands."""
+    path = resolve_profile_path(collection_dir)
+    if path is None:
+        return None
+    try:
+        return path, load_profile(path)
+    except TuningProfileError as exc:
+        logger.warning("Not applying tuning profile: %s", exc)
+        return None
+
+
+def record_applied(
+    path: typing.Union[str, Path],
+    profile: dict,
+    applied: typing.Mapping[str, typing.Any],
+    subsystem: str,
+) -> None:
+    """The attribution trail every profile application leaves: ONE
+    ``tuning_profile_loaded`` event naming exactly which knobs took
+    profile values, plus the ``gordo_tuning_profile_applied`` gauge per
+    knob (1 = this process runs the profile's value)."""
+    emit_event(
+        "tuning_profile_loaded",
+        path=str(path),
+        profile_version=profile.get("profile_version"),
+        subsystem=subsystem,
+        applied={name: applied[name] for name in sorted(applied)},
+        n_applied=len(applied),
+    )
+    gauge = get_registry().gauge(
+        "gordo_tuning_profile_applied",
+        "1 per knob whose running value came from the collection's "
+        "tuning profile",
+        ("knob",),
+    )
+    for name in applied:
+        gauge.set(1, knob=name)
+
+
+def apply_to_click_params(
+    ctx,
+    collection_dir: typing.Optional[typing.Union[str, Path]],
+    param_by_knob: typing.Mapping[str, str],
+    subsystem: str,
+) -> typing.Dict[str, typing.Any]:
+    """
+    The CLI-side application: for each knob in ``param_by_knob`` (knob
+    name -> click parameter name), take the profile's recommendation iff
+    the parameter is still at its built-in default — a value given on
+    the command line or through its env var ALWAYS wins. Returns
+    ``{param name: value}`` for the caller to rebind (click has already
+    bound locals by the time the command body runs).
+    """
+    loaded = load_collection_profile(collection_dir)
+    if loaded is None:
+        return {}
+    path, profile = loaded
+    from click.core import ParameterSource
+
+    values = recommended_values(profile)
+    overrides: typing.Dict[str, typing.Any] = {}
+    applied: typing.Dict[str, typing.Any] = {}
+    for knob_name, param_name in param_by_knob.items():
+        if knob_name not in values:
+            continue
+        source = ctx.get_parameter_source(param_name)
+        if source is not None and source != ParameterSource.DEFAULT:
+            continue  # explicit CLI/env wins
+        overrides[param_name] = values[knob_name]
+        applied[knob_name] = values[knob_name]
+    if applied:
+        logger.info(
+            "Applying tuning profile %s: %s",
+            path,
+            ", ".join(f"{k}={v}" for k, v in sorted(applied.items())),
+        )
+        # attribution only when something was actually taken: with every
+        # knob explicit (e.g. each ledger worker child, handed resolved
+        # flags by the orchestrator) an empty event per process would
+        # drown the one real application
+        record_applied(path, profile, applied, subsystem)
+    return overrides

@@ -27,6 +27,19 @@ if [ "${GORDO_SKIP_LINT:-0}" != "1" ]; then
     }
 fi
 
+# Tuning-profile drift gate (docs/tuning.md): a committed
+# tuning_profile.json whose knobs were renamed/removed from the registry
+# or whose values fell out of domain must fail the build here, not be
+# silently ignored at load time. GORDO_SKIP_TUNE_CHECK=1 opts out.
+if [ "${GORDO_SKIP_TUNE_CHECK:-0}" != "1" ]; then
+    python -m gordo_tpu.cli tune plan --check "$MOUNT_ROOT" || {
+        echo "gordo-tpu tune plan --check found $? stale/invalid" \
+             "tuning profile(s); re-fit with 'gordo-tpu tune fit'," \
+             "delete the profile, or set GORDO_SKIP_TUNE_CHECK=1" >&2
+        exit 1
+    }
+fi
+
 if [ -n "${MACHINES:-}" ]; then
     exec python -m gordo_tpu.cli build-fleet
 else

@@ -1,0 +1,43 @@
+"""Positive fixture for knob-discipline: GORDO_* env reads and click
+envvar declarations missing from the knob registry. Every shape here
+must be flagged."""
+
+import os
+from os import environ, getenv
+
+import click
+
+
+def _env_float(name, default):
+    raw = os.environ.get(name)
+    return float(raw) if raw else default
+
+
+def unregistered_get():
+    return os.environ.get("GORDO_MYSTERY_KNOB")
+
+
+def unregistered_subscript():
+    return os.environ["GORDO_SECRET_LIMIT"]
+
+
+def unregistered_getenv():
+    return getenv("GORDO_SHADOW_TIMEOUT", "30")
+
+
+def unregistered_bare_environ():
+    return environ.get("GORDO_BARE_READ")
+
+
+def unregistered_helper():
+    return _env_float("GORDO_HELPER_KNOB", 0.5)
+
+
+@click.option(
+    "--mystery",
+    envvar="GORDO_UNDECLARED_FLAG",
+    default=1,
+    help="a knob nobody registered",
+)
+def command(mystery):
+    return mystery

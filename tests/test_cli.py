@@ -141,7 +141,9 @@ def test_telemetry_summarize_cli(runner, tmp_path):
     )
     assert as_json.exit_code == 0, as_json.output
     payload = json.loads(as_json.output)
-    assert payload[0]["report"]["n_machines"] == 4
+    assert payload["schema_version"] == 2
+    assert payload["reports"][0]["report"]["n_machines"] == 4
+    assert payload["events"]["build"]["build_started"] == 1
 
 
 def test_build_env_vars(runner, tmp_path):
@@ -603,13 +605,11 @@ def test_run_server_cli_passes_concurrency_knobs(runner, monkeypatch):
     assert captured == {
         "host": "127.0.0.1", "port": 5001, "workers": 3, "threads": 5,
         "worker_connections": 17,
-        # batching defaults ride the config: 0 = disabled (the strict
-        # pass-through path, docs/serving.md#dynamic-batching); scorer
-        # cache + AOT defaults likewise (docs/performance.md)
+        # tuned batching/cache knobs left at their defaults stay OUT of
+        # the config: build_app resolves them env -> tuning profile ->
+        # built-in default, so the collection's tuning_profile.json can
+        # supply measured defaults (docs/tuning.md)
         "config": {
-            "BATCH_WAIT_MS": 0.0,
-            "BATCH_QUEUE_LIMIT": 64,
-            "SCORER_CACHE_SIZE": 16,
             "AOT_CACHE": True,
             # unsharded by default: the historical whole-collection
             # replica (docs/serving.md#sharded-serving-plane)
@@ -636,9 +636,12 @@ def test_run_server_cli_passes_batching_knobs(runner, monkeypatch):
     )
     assert result.exit_code == 0, result.output
     assert captured["config"] == {
+        # explicitly-set knobs ride the config and win over any tuning
+        # profile; SCORER_CACHE_SIZE stayed at its default so it defers
+        # to build_app's env -> profile -> default resolution
+        # (docs/tuning.md)
         "BATCH_WAIT_MS": 7.5,
         "BATCH_QUEUE_LIMIT": 32,
-        "SCORER_CACHE_SIZE": 16,
         "AOT_CACHE": True,
         "SHARD_MANIFEST": None,
         "REPLICA_ID": None,

@@ -17,6 +17,7 @@ import pytest
 
 from gordo_tpu.analysis import (
     check_host_sync,
+    check_knob_discipline,
     check_prng_key_reuse,
     check_prng_split_width,
     check_retrace_risk,
@@ -39,6 +40,7 @@ _CHECKS = {
     "prng-split-width": check_prng_split_width,
     "traced-branch": check_traced_branching,
     "span-discipline": check_span_discipline,
+    "knob-discipline": check_knob_discipline,
 }
 
 _FIXTURE_STEMS = {
@@ -48,6 +50,7 @@ _FIXTURE_STEMS = {
     "prng-split-width": "prng_split_width",
     "traced-branch": "traced_branch",
     "span-discipline": "span_discipline",
+    "knob-discipline": "knob_discipline",
 }
 
 

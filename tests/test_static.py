@@ -750,6 +750,51 @@ def test_span_name_collector_reads_open_and_record_surfaces():
     }
 
 
+def test_knobs_documented():
+    """Every knob in the registry must appear in docs/performance.md's
+    knob catalogue — the docs half of the knob-discipline gate
+    (docs/tuning.md): the lint check guarantees no GORDO_* read exists
+    outside the registry, and this guarantees no registry knob is
+    missing from the operator-facing table."""
+    from gordo_tpu.tuning.knobs import KNOBS
+
+    docs = (
+        Path(gordo_tpu.__file__).parent.parent / "docs" / "performance.md"
+    ).read_text()
+    undocumented = sorted(
+        k.name
+        for k in KNOBS
+        if f"`{k.name}`" not in docs or k.env_var not in docs
+    )
+    assert not undocumented, (
+        f"knobs registered in gordo_tpu/tuning/knobs.py but missing from "
+        f"docs/performance.md's knob catalogue: {undocumented}"
+    )
+
+
+def test_knob_registry_well_formed():
+    """Registry invariants the rest of the gate leans on: canonical
+    names and env vars are unique, every default that is not None sits
+    inside its own domain, and no env var is classified on BOTH sides
+    of the knob / non-knob line."""
+    from gordo_tpu.tuning.knobs import KNOBS, NON_KNOB_ENV_VARS
+
+    names = [k.name for k in KNOBS]
+    assert len(names) == len(set(names)), "duplicate knob names"
+    env_vars = [k.env_var for k in KNOBS]
+    assert len(env_vars) == len(set(env_vars)), "duplicate knob env vars"
+    both = set(env_vars) & NON_KNOB_ENV_VARS
+    assert not both, f"env vars classified as knob AND non-knob: {both}"
+    bad_defaults = [
+        k.name
+        for k in KNOBS
+        if k.default is not None and not k.domain.contains(k.default)
+    ]
+    assert not bad_defaults, (
+        f"knob defaults outside their own domain: {bad_defaults}"
+    )
+
+
 # --------------------------------------------------------------------------
 # the JAX-discipline family, package-wide (the tier-1 lint gate)
 # --------------------------------------------------------------------------
@@ -766,6 +811,7 @@ _LINT_ROOT = Path(gordo_tpu.__file__).parent.parent
         "prng-split-width",
         "traced-branch",
         "span-discipline",
+        "knob-discipline",
     ],
 )
 def test_jax_discipline_package_wide(check_name):
