@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming build-multiworker images push
+.PHONY: all test lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming bench-precision build-multiworker images push
 
 all: lint test
 
@@ -62,6 +62,17 @@ bench-streaming:
 	python benchmarks/stream_load.py --streams 1,4,16 --duration 10 \
 		--update-rows 5 --window-rows 256 --mixed-rps 2 \
 		--output benchmarks/results_stream_cpu_r12.json
+
+# per-machine mixed precision + transfer pipelining + donation arms
+# (docs/performance.md "Mixed precision, buffer donation, and transfer
+# pipelining"): bf16-vs-float32 build/dispatch arms with per-machine
+# MAE deltas, prefetch-depth overlap ratios, and the donate on/off
+# output-delta evidence
+bench-precision:
+	python benchmarks/fleet_throughput.py --machines 8 --epochs 3 \
+		--sequential-sample 2 --epoch-chunk-sweep "" \
+		--precision-sweep float32,bf16 --prefetch-sweep 0,2 \
+		--donation-arms > benchmarks/results_precision_cpu_r15.json
 
 # 2-worker crash-tolerant ledger build of the example fleet config
 # (docs/robustness.md "Multi-worker builds") — the smoke proof that N

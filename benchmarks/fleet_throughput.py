@@ -145,6 +145,256 @@ def epoch_chunk_sweep(chunks, n_machines=8, n_rows=512, n_features=4,
     return rows
 
 
+def _ms_summary(times):
+    """mean/p50/p99 of a list of millisecond latencies."""
+    ordered = sorted(times)
+    return {
+        "mean_ms": round(sum(ordered) / len(ordered), 3),
+        "p50_ms": round(ordered[len(ordered) // 2], 3),
+        "p99_ms": round(ordered[max(0, int(0.99 * len(ordered)) - 1)], 3),
+    }
+
+
+def precision_sweep(precisions, n_machines=8, epochs=5, rounds=30):
+    """
+    Build the SAME fleet once per precision mode (float32 always first —
+    it is the parity baseline every other arm compares against) and
+    report, per arm: build rate, the builder's own calibration decisions
+    (n_bf16 / fallbacks / worst per-machine calibration MAE delta, from
+    ``precision_decisions_`` — the numbers build_report.json persists),
+    warm serving-dispatch latency through a :class:`FleetScorer`, and the
+    worst per-machine SERVED MAE delta vs the float32 arm's outputs on a
+    fixed input. Served outputs must come back float32 regardless of the
+    arm (the in-program upcast contract); that is asserted, not assumed.
+
+    On CPU the bf16 arm measures the dispatch/keying overhead only — XLA
+    emulates bf16 math, so the wins this sweep exists to show (halved
+    resident params, halved HBM traffic) are TPU-expected, and the MAE
+    deltas are the honest number a CPU run CAN measure.
+    """
+    import numpy as np
+
+    from gordo_tpu.builder.fleet_build import (
+        FleetModelBuilder,
+        _find_jax_estimator,
+    )
+    from gordo_tpu.server.fleet_serving import FleetScorer
+
+    modes = [m for m in dict.fromkeys(precisions) if m != "float32"]
+    modes.insert(0, "float32")
+
+    machines = make_machines(n_machines, epochs)
+    rng = np.random.default_rng(7)
+    X = rng.random((64, 4)).astype("float32")
+
+    arms = []
+    baseline_outputs = None
+    for mode in modes:
+        start = time.perf_counter()
+        builder = FleetModelBuilder(machines, precision=mode)
+        results = builder.build()
+        build_s = time.perf_counter() - start
+
+        ests = {}
+        for model, machine in results:
+            est = _find_jax_estimator(model)
+            if est is not None:
+                ests[machine.name] = est
+        inputs = {name: X for name in ests}
+        scorer = FleetScorer(ests)
+        outputs = scorer.predict(inputs)  # warm: trace+compile once
+        times = []
+        for _ in range(rounds):
+            t0 = time.perf_counter()
+            outputs = scorer.predict(inputs)
+            times.append((time.perf_counter() - t0) * 1000)
+        assert all(
+            np.asarray(v).dtype == np.float32 for v in outputs.values()
+        ), "served outputs must be float32 (in-program upcast contract)"
+        if baseline_outputs is None:
+            baseline_outputs = outputs
+
+        decisions = builder.precision_decisions_
+        cal_deltas = [
+            rec["mae_delta"]
+            for rec in decisions.values()
+            if rec.get("mae_delta") is not None
+        ]
+        served_deltas = [
+            float(np.abs(np.asarray(v) - np.asarray(baseline_outputs[k])).mean())
+            for k, v in outputs.items()
+        ]
+        arms.append(
+            {
+                "precision": mode,
+                "fleet_build_s": round(build_s, 2),
+                "fleet_models_per_hour": round(n_machines / build_s * 3600, 1),
+                "n_machines_bf16": sum(
+                    1 for r in decisions.values() if r["precision"] == "bf16"
+                ),
+                "n_machines_float32_fallback": sum(
+                    1 for r in decisions.values() if r["precision"] == "float32"
+                ),
+                "calibration_worst_machine_mae_delta": (
+                    float(f"{max(cal_deltas):.3g}") if cal_deltas else None
+                ),
+                "dispatch": {**_ms_summary(times), "rounds": rounds},
+                "served_worst_machine_mae_delta_vs_float32": float(
+                    f"{max(served_deltas):.3g}"
+                ),
+            }
+        )
+    return arms
+
+
+def donation_arms(n_machines=8, epochs=5, rounds=50):
+    """
+    Warm serving-dispatch latency with buffer donation off (the pinned
+    default) vs on (``GORDO_DONATE=1``, read once at
+    :class:`FleetScorer` construction), through the SAME built fleet.
+    The arms' outputs are cross-checked: bit-equality AND max abs
+    delta. Donation is opt-in precisely because the alias annotation
+    alone shifts XLA's fusion — the measured delta here (~1e-7 on CPU,
+    where the donation itself is declined) is the documented reason the
+    default stays off; the HBM-reuse latency win is TPU-expected.
+    """
+    import numpy as np
+
+    from gordo_tpu.builder.fleet_build import (
+        FleetModelBuilder,
+        _find_jax_estimator,
+    )
+    from gordo_tpu.server.fleet_serving import FleetScorer
+
+    machines = make_machines(n_machines, epochs)
+    results = FleetModelBuilder(machines).build()
+    ests = {}
+    for model, machine in results:
+        est = _find_jax_estimator(model)
+        if est is not None:
+            ests[machine.name] = est
+    rng = np.random.default_rng(11)
+    X = rng.random((64, 4)).astype("float32")
+    inputs = {name: X for name in ests}
+
+    arms = []
+    baseline_outputs = None
+    saved = os.environ.get("GORDO_DONATE")
+    try:
+        for donate in (False, True):
+            os.environ["GORDO_DONATE"] = "1" if donate else "0"
+            scorer = FleetScorer(ests)
+            outputs = scorer.predict(inputs)  # warm
+            times = []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                outputs = scorer.predict(inputs)
+                times.append((time.perf_counter() - t0) * 1000)
+            if baseline_outputs is None:
+                baseline_outputs = outputs
+            delta = max(
+                float(
+                    np.abs(
+                        np.asarray(v) - np.asarray(baseline_outputs[k])
+                    ).max()
+                )
+                for k, v in outputs.items()
+            )
+            arms.append(
+                {
+                    "donate": donate,
+                    "dispatch": {**_ms_summary(times), "rounds": rounds},
+                    "outputs_bitequal_vs_donate_off": bool(
+                        all(
+                            np.array_equal(v, baseline_outputs[k])
+                            for k, v in outputs.items()
+                        )
+                    ),
+                    "outputs_max_abs_delta_vs_donate_off": float(
+                        f"{delta:.3g}"
+                    ),
+                }
+            )
+    finally:
+        if saved is None:
+            os.environ.pop("GORDO_DONATE", None)
+        else:
+            os.environ["GORDO_DONATE"] = saved
+    return arms
+
+
+def prefetch_sweep(depths, n_machines=8, n_rows=2048, n_features=8,
+                   epochs=12, batch_size=64):
+    """
+    Sweep ``prefetch_depth`` over a direct :class:`FleetTrainer` fit:
+    depth 0 is the historical single-``device_put`` baseline; depth K
+    slices the stacked tensors' host->device transfer
+    (``transfer.device_put_sliced``) and pre-issues the next epoch
+    chunk's batch-order vector. Prefetching moves bytes, never math, so
+    loss histories are cross-checked for bit-equality against depth 0.
+    ``transfer_overlap_ratio`` is the wall-time fraction the pipelining
+    recovered vs depth 0 (clamped at 0 — on CPU "transfer" is a memcpy
+    and the ratio is expected to hover near zero; the overlap win is
+    TPU-expected, where the slices stream over PCIe behind compute).
+    """
+    import numpy as np
+
+    from gordo_tpu.models.factories.feedforward import feedforward_hourglass
+    from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
+
+    rng = np.random.default_rng(3)
+    Xs = [rng.random((n_rows, n_features)).astype("float32")
+          for _ in range(n_machines)]
+    spec = feedforward_hourglass(n_features=n_features)
+
+    # warm the jit cache before timing: the first fit pays compilation,
+    # which would otherwise be billed to the depth-0 baseline and
+    # masquerade as transfer overlap in every later arm's ratio
+    warm_data = StackedData.from_ragged(Xs, [x.copy() for x in Xs])
+    warm_trainer = FleetTrainer(spec)
+    warm_trainer.fit(
+        warm_data,
+        warm_trainer.machine_keys(n_machines),
+        epochs=min(2, epochs),
+        batch_size=batch_size,
+    )
+
+    rows = []
+    baseline_losses = None
+    baseline_wall = None
+    # depth 0 runs first: every row's overlap ratio and bit-equality
+    # check compares against a real baseline
+    for depth in sorted(depths):
+        start = time.perf_counter()
+        data = StackedData.from_ragged(
+            Xs, [x.copy() for x in Xs], prefetch_depth=depth
+        )
+        trainer = FleetTrainer(spec, prefetch_depth=depth)
+        keys = trainer.machine_keys(n_machines)
+        _, losses = trainer.fit(data, keys, epochs=epochs,
+                                batch_size=batch_size)
+        wall = time.perf_counter() - start
+        if baseline_losses is None:
+            baseline_losses, baseline_wall = losses, wall
+        t = trainer.fit_telemetry_
+        rows.append(
+            {
+                "prefetch_depth": depth,
+                "wall_time_s": round(wall, 3),
+                "steady_state_sensor_timesteps_per_s": t[
+                    "steady_state_sensor_timesteps_per_s"
+                ],
+                "transfer_overlap_ratio": round(
+                    max(0.0, 1.0 - wall / baseline_wall), 4
+                ),
+                "losses_bitequal_vs_depth0": bool(
+                    np.array_equal(losses, baseline_losses)
+                ),
+            }
+        )
+    return rows
+
+
 MFU_NOTE = (
     "analytic estimate: FLOPs are counted from kernel sizes (2 x weight "
     "elements per sample, x lookback for windowed specs, training = 3 x fwd) "
@@ -273,6 +523,32 @@ def main():
         "FleetTrainer sweep reported from fit_telemetry_ "
         "('' disables it).",
     )
+    parser.add_argument(
+        "--precision-sweep",
+        default="",
+        metavar="MODE[,MODE...]",
+        help="Comma-separated precision modes (e.g. float32,bf16): build "
+        "the same fleet once per mode and report build rate, calibration "
+        "decisions, warm dispatch latency, and per-machine served MAE "
+        "delta vs the float32 arm ('' disables it).",
+    )
+    parser.add_argument(
+        "--prefetch-sweep",
+        default="",
+        metavar="K[,K...]",
+        help="Comma-separated prefetch_depth values (e.g. 0,2) for the "
+        "direct FleetTrainer transfer-pipelining sweep: wall time, "
+        "steady-state throughput, transfer_overlap_ratio vs depth 0, "
+        "and loss bit-equality ('' disables it).",
+    )
+    parser.add_argument(
+        "--donation-arms",
+        action="store_true",
+        help="Measure warm serving dispatch with GORDO_DONATE off vs on "
+        "through the same built fleet, cross-checking output "
+        "bit-equality (CPU pins the no-regression floor; the HBM-reuse "
+        "win is TPU-expected).",
+    )
     args = parser.parse_args()
 
     import jax
@@ -293,6 +569,18 @@ def main():
         chunk_sweep = epoch_chunk_sweep(
             [int(c) for c in args.epoch_chunk_sweep.split(",")]
         )
+
+    prec_sweep = None
+    if args.precision_sweep:
+        prec_sweep = precision_sweep(
+            [m.strip() for m in args.precision_sweep.split(",") if m.strip()]
+        )
+    pf_sweep = None
+    if args.prefetch_sweep:
+        pf_sweep = prefetch_sweep(
+            [int(d) for d in args.prefetch_sweep.split(",")]
+        )
+    donate_arms = donation_arms() if args.donation_arms else None
 
     seq_machines = make_machines(
         args.sequential_sample, args.epochs, args.buckets, args.kind
@@ -372,6 +660,13 @@ def main():
                 # per-chunk-size fit telemetry (steady epoch time, host
                 # dispatch overhead, epochs-per-sync) from fit_telemetry_
                 **({"epoch_chunk_sweep": chunk_sweep} if chunk_sweep else {}),
+                # per-precision-mode build/calibration/dispatch arms,
+                # float32 first (the parity baseline)
+                **({"precision_sweep": prec_sweep} if prec_sweep else {}),
+                # transfer-pipelining arms (prefetch_depth sweep) and the
+                # donation on/off bit-equality + latency arms
+                **({"prefetch_sweep": pf_sweep} if pf_sweep else {}),
+                **({"donation_arms": donate_arms} if donate_arms else {}),
                 "platform": device.platform,
                 "device_kind": device.device_kind,
                 "fleet_build_s": round(fleet_s, 2),

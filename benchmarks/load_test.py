@@ -68,6 +68,7 @@ def self_serve(
     model: str = "hourglass",
     batch_wait_ms: float = 0.0,
     queue_limit: int = 64,
+    precision: str = "float32",
 ) -> str:
     """Train machine(s) on random data and serve them; returns base URL."""
     from werkzeug.serving import make_server
@@ -75,7 +76,7 @@ def self_serve(
     from benchmarks.server_latency import build_collection
     from gordo_tpu.server import build_app
 
-    collection = build_collection(n_machines, tmp, model)
+    collection = build_collection(n_machines, tmp, model, precision=precision)
     os.environ["MODEL_COLLECTION_DIR"] = collection
     app = build_app(
         {"BATCH_WAIT_MS": batch_wait_ms, "BATCH_QUEUE_LIMIT": queue_limit}
@@ -471,6 +472,17 @@ def main():
         "goodput_retained vs the healthy same-count arm.",
     )
     parser.add_argument(
+        "--precision",
+        choices=["float32", "bf16", "auto"],
+        default="float32",
+        help="Self-serve build precision: bf16/auto route the build "
+        "through the fleet builder's calibration pass, and the output "
+        "gains per-machine precision decisions + the worst served MAE "
+        "delta the calibration measured (docs/performance.md). The "
+        "request wire format stays float32 either way — the cast is "
+        "in-program.",
+    )
+    parser.add_argument(
         "--output",
         default=None,
         help="Also write the result JSON to this path.",
@@ -503,6 +515,7 @@ def main():
             args.model,
             batch_wait_ms=args.batch_wait_ms,
             queue_limit=args.queue_limit,
+            precision=args.precision,
         )
         served_locally = True
 
@@ -601,12 +614,50 @@ def main():
         out["partials"] = len(partials)
         if sheds:
             out["shed_retry_after_s_max"] = max(sheds)
+    # each request scores --samples timesteps per machine: the serving
+    # analogue of the trainer's sensor-timesteps/s throughput axis
+    out["sensor_timesteps_per_s"] = (
+        round(args.samples * max(1, args.fleet) * len(latencies) / elapsed, 1)
+        if elapsed
+        else 0.0
+    )
+    # host->device bytes one machine's scoring update moves: the wire
+    # batch stays float32 even under bf16 (the cast is in-program), so
+    # this number is precision-invariant — bf16 halves the RESIDENT
+    # param bytes instead, a device-side (TPU HBM) saving
+    out["bytes_transferred_per_update"] = args.samples * args.features * 4
     if served_locally:
         out["batch_wait_ms"] = args.batch_wait_ms
         out["queue_limit"] = args.queue_limit
         # the server runs in-process: its dispatch batch sizes and queue
         # waits are readable straight off the shared registry
         out.update(batching_registry_stats())
+        out["precision"] = args.precision
+        if args.precision != "float32":
+            # the fleet builder persisted its calibration decisions next
+            # to the artifacts; report them beside the latencies so one
+            # JSON carries both the speed and the accuracy cost
+            report_path = os.path.join(
+                os.environ["MODEL_COLLECTION_DIR"], "build_report.json"
+            )
+            with open(report_path) as fh:
+                machines = (
+                    json.load(fh).get("precision") or {}
+                ).get("machines") or {}
+            deltas = [
+                r["mae_delta"]
+                for r in machines.values()
+                if r.get("mae_delta") is not None
+            ]
+            out["n_machines_bf16"] = sum(
+                1 for r in machines.values() if r.get("precision") == "bf16"
+            )
+            out["n_machines_float32_fallback"] = sum(
+                1 for r in machines.values() if r.get("precision") == "float32"
+            )
+            out["worst_machine_mae_delta"] = (
+                float(f"{max(deltas):.3g}") if deltas else None
+            )
     if args.fleet:
         # each request scores --fleet machines; the comparable per-machine
         # rate against the single-machine mode

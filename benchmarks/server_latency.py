@@ -42,7 +42,20 @@ ESTIMATOR_BLOCKS = {
 }
 
 
-def build_collection(n_machines: int, tmp: str, model: str = "hourglass") -> str:
+def build_collection(
+    n_machines: int,
+    tmp: str,
+    model: str = "hourglass",
+    precision: str = "float32",
+) -> str:
+    """Build a servable collection of random-data machines under ``tmp``.
+
+    ``precision`` != "float32" routes through the fleet builder (the
+    only path with a calibration pass), so the collection carries a
+    ``build_report.json`` with per-machine precision decisions and the
+    served models' ``precision_`` stamps — what the load test's
+    precision arm reads back.
+    """
     from gordo_tpu import serializer
     from gordo_tpu.builder import local_build
 
@@ -64,6 +77,19 @@ def build_collection(n_machines: int, tmp: str, model: str = "hourglass") -> str
         for i in range(n_machines)
     )
     collection = os.path.join(tmp, "proj", "models", "rev1")
+    if precision != "float32":
+        import yaml
+
+        from gordo_tpu.builder.fleet_build import FleetModelBuilder
+        from gordo_tpu.workflow.config_elements.normalized_config import (
+            NormalizedConfig,
+        )
+
+        machines = NormalizedConfig(
+            yaml.safe_load(config), project_name="proj"
+        ).machines
+        FleetModelBuilder(machines, precision=precision).build(collection)
+        return collection
     for fitted, machine in local_build(config):
         serializer.dump(
             fitted, os.path.join(collection, machine.name), metadata=machine.to_dict()

@@ -17,7 +17,7 @@ Layout:
                      (``collect_metric_names``/``collect_event_names``)
 - ``jax_checks.py``  the JAX-discipline family: retrace-risk,
                      host-sync, prng-reuse, prng-split-width,
-                     traced-branch
+                     traced-branch, donation-safety
 - ``knob_checks.py`` knob-discipline: every GORDO_* env read must be
                      classified in the knob registry
                      (gordo_tpu/tuning/knobs.py)
@@ -60,6 +60,7 @@ from gordo_tpu.analysis.engine import (
 )
 from gordo_tpu.analysis.jax_checks import (
     HOT_PATH_PATTERNS,
+    check_donation_safety,
     check_host_sync,
     check_prng_key_reuse,
     check_prng_split_width,
@@ -93,6 +94,7 @@ __all__ = [
     "check_annotated_attributes",
     "check_annotated_param_method_calls",
     "check_call_signatures",
+    "check_donation_safety",
     "check_host_sync",
     "check_knob_discipline",
     "check_metric_registrations",

@@ -822,6 +822,10 @@ ALLOWED_METRIC_LABELS = frozenset(
         # knob names are bounded by the knob registry
         # (gordo_tpu/tuning/knobs.py), a fixed compile-time set
         "knob",
+        # transfer accounting (parallel/transfer.py): plane is one of
+        # build/train/stream, mode is prefetched/direct — both fixed
+        # three-or-fewer-value vocabularies
+        "plane", "mode",
     }
 )
 

@@ -34,6 +34,10 @@ hand:
 - ``traced-branch``      Python ``if``/``while`` on a value derived from
                          a jitted function's (non-static) parameters —
                          raises TracerBoolConversionError under jit.
+- ``donation-safety``    a binding read again after being passed at a
+                         donated argnum of a jitted call: XLA may have
+                         reused the buffer (CPU declines donation, so
+                         the bug only fires on accelerators).
 
 All checks are purely syntactic (AST + source, no imports), so they run
 on any file — tests and benchmarks included — and transfer verbatim to
@@ -975,4 +979,147 @@ def check_traced_branching(tree: ast.Module) -> typing.List[str]:
                     f"in one path); use jnp.where / lax.cond / "
                     f"lax.while_loop"
                 )
+    return problems
+
+
+# --------------------------------------------------------------------------
+# donation-safety: reading a buffer after passing it at a donated argnum
+# --------------------------------------------------------------------------
+
+
+def _donated_handles(tree: ast.Module) -> typing.Dict[str, typing.FrozenSet[int]]:
+    """Names bound to donating jitted callables, mapped to their donated
+    positional indices: ``f = jax.jit(g, donate_argnums=(0, 1))``
+    assignments and ``@partial(jax.jit, donate_argnums=...)`` /
+    ``@jax.jit(...)``-style decorated defs. Only literal int argnums are
+    harvested — dynamic specs are invisible to a syntactic pass."""
+    jit_names = _jit_names(tree)
+
+    def donated_positions(call: ast.Call) -> typing.FrozenSet[int]:
+        pos: typing.Set[int] = set()
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                for node in ast.walk(kw.value):
+                    if isinstance(node, ast.Constant) and isinstance(
+                        node.value, int
+                    ):
+                        pos.add(node.value)
+        return frozenset(pos)
+
+    handles: typing.Dict[str, typing.FrozenSet[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and _is_jit_call(node.value, jit_names):
+            pos = donated_positions(node.value)
+            if pos:
+                for target in node.targets:
+                    if isinstance(target, ast.Name):
+                        handles[target.id] = pos
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call) and (
+                    _is_jit_func(dec.func, jit_names)
+                    or (
+                        _callee_tail(dec.func) == "partial"
+                        and dec.args
+                        and _is_jit_func(dec.args[0], jit_names)
+                    )
+                ):
+                    pos = donated_positions(dec)
+                    if pos:
+                        handles[node.name] = pos
+    return handles
+
+
+def check_donation_safety(tree: ast.Module) -> typing.List[str]:
+    """
+    A binding read again after being passed at a donated argnum of a
+    jitted call: ``donate_argnums`` hands the buffer to XLA, which may
+    reuse its memory for the output — on TPU the later read returns
+    garbage or raises (on CPU donation is declined, which is why the bug
+    survives local testing). Per scope, straight-line: a plain-name
+    positional argument at a donated index, loaded again after the call
+    with no intervening rebinding, is flagged. Names rebound by the
+    call's own statement (``params, opt = step(params, opt)`` — the
+    canonical donation shape) are clean, as are calls through ``*args``
+    (positions are invisible) and non-Name arguments (fresh temporaries
+    by construction).
+    """
+    handles = _donated_handles(tree)
+    if not handles:
+        return []
+    problems: typing.List[str] = []
+    for scope in (tree, *_scope_functions(tree)):
+        own = _own_scope_nodes(scope)
+        calls = [
+            n
+            for n in own
+            if isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Name)
+            and n.func.id in handles
+        ]
+        if not calls:
+            continue
+        stores: typing.Dict[str, typing.List[int]] = {}
+        loads: typing.Dict[str, typing.List[int]] = {}
+        for node in own:
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, (ast.Store, ast.Del)):
+                    stores.setdefault(node.id, []).append(node.lineno)
+                elif isinstance(node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append(node.lineno)
+        assign_stmts = [
+            n
+            for n in own
+            if isinstance(n, (ast.Assign, ast.AugAssign, ast.AnnAssign))
+        ]
+        for call in calls:
+            if any(isinstance(a, ast.Starred) for a in call.args):
+                continue  # positions are invisible through *args
+            # names rebound by the statement containing this call count
+            # as rebound AT the call — the canonical consume-and-replace
+            rebound_here: typing.Set[str] = set()
+            for stmt in assign_stmts:
+                if not any(n is call for n in ast.walk(stmt)):
+                    continue
+                targets = (
+                    stmt.targets
+                    if isinstance(stmt, ast.Assign)
+                    else [stmt.target]
+                )
+                for target in targets:
+                    elts = (
+                        target.elts
+                        if isinstance(target, (ast.Tuple, ast.List))
+                        else [target]
+                    )
+                    for elt in elts:
+                        if isinstance(elt, ast.Name):
+                            rebound_here.add(elt.id)
+            call_end = getattr(call, "end_lineno", call.lineno) or call.lineno
+            for idx in sorted(handles[call.func.id]):
+                if idx >= len(call.args):
+                    continue
+                arg = call.args[idx]
+                if not isinstance(arg, ast.Name) or arg.id in rebound_here:
+                    continue
+                name = arg.id
+                later_stores = [
+                    ln for ln in stores.get(name, []) if ln > call_end
+                ]
+                next_store = min(later_stores) if later_stores else None
+                for load_line in sorted(loads.get(name, [])):
+                    if load_line <= call_end:
+                        continue
+                    if next_store is not None and load_line > next_store:
+                        break  # rebound before this read: fresh buffer
+                    problems.append(
+                        f"line {load_line}: `{name}` is read after being "
+                        f"passed at donated argument {idx} of "
+                        f"`{call.func.id}` — the donated buffer may "
+                        f"already be reused by XLA (CPU declines "
+                        f"donation, so this only fails on accelerators); "
+                        f"rebind the name from the call's result or pass "
+                        f"a fresh array"
+                    )
+                    break
     return problems

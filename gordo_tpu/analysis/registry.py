@@ -203,6 +203,16 @@ CHECKS: typing.Tuple[CheckSpec, ...] = (
         scope="syntactic",
         run=_syntactic(jax_checks.check_traced_branching),
     ),
+    CheckSpec(
+        name="donation-safety",
+        doc="a binding read after being passed at a donated argnum of a "
+        "jitted call (use-after-donate; only fails on accelerators)",
+        severity="error",
+        fixer="rebind the name from the call's result (x, s = step(x, s)) "
+        "or pass a fresh array",
+        scope="syntactic",
+        run=_syntactic(jax_checks.check_donation_safety),
+    ),
 )
 
 CHECKS_BY_NAME: typing.Dict[str, CheckSpec] = {c.name: c for c in CHECKS}
@@ -216,6 +226,7 @@ JAX_CHECK_NAMES: typing.Tuple[str, ...] = (
     "prng-reuse",
     "prng-split-width",
     "traced-branch",
+    "donation-safety",
 )
 
 

@@ -69,6 +69,13 @@ from gordo_tpu.parallel.bucketing import (
 )
 from gordo_tpu.parallel.fleet import FleetTrainer, StackedData
 from gordo_tpu.parallel.mesh import auto_device_mesh
+from gordo_tpu.parallel.precision import (
+    DEFAULT_PRECISION_TOLERANCE,
+    cast_params,
+    mae,
+    mae_parity,
+    resolve_precision,
+)
 from gordo_tpu.robustness import faults
 from gordo_tpu.utils import atomic
 
@@ -181,6 +188,27 @@ class FleetModelBuilder:
         widths into one program at power-of-two padded dims; pad
         columns are masked out of loss/metrics/early-stopping during
         training and stripped from predictions at serving.
+    precision
+        Inference precision mode (``"float32"`` | ``"bf16"`` |
+        ``"auto"``; docs/performance.md "Mixed precision"). float32 —
+        the default — is the historical path, pinned bit-identical with
+        no calibration pass. ``"auto"`` calibrates every machine's bf16
+        predictions against its float32 build and serves bf16 only
+        where the reconstruction-MAE delta clears
+        ``precision_tolerance`` (the per-machine decision lands on
+        ``est.precision_`` and in ``build_report.json``). ``"bf16"``
+        is the operator override: every machine serves bf16, deltas
+        still measured and reported, tolerance breaches logged but not
+        enforced. Training is always float32 — precision is an
+        inference-time cast of the finished params.
+    precision_tolerance
+        Relative reconstruction-MAE tolerance for the bf16 calibration
+        (default 0.25, the padded-parity bound).
+    prefetch_depth
+        Host->device transfer pipelining depth (default 0 = off, the
+        historical bit-identical path). >0 double-buffers the builder's
+        per-bucket stacked-data transfer and the trainer's per-chunk
+        transfers (docs/performance.md "transfer pipelining").
     """
 
     def __init__(
@@ -198,6 +226,9 @@ class FleetModelBuilder:
         fault_sites: Tuple[str, ...] = ("train",),
         aot_cache: bool = False,
         bucket_policy: Any = "exact",
+        precision: str = "float32",
+        precision_tolerance: float = DEFAULT_PRECISION_TOLERANCE,
+        prefetch_depth: int = 0,
     ):
         self.machines = machines
         if mesh is None and auto_mesh:
@@ -220,6 +251,18 @@ class FleetModelBuilder:
         #: grouping and its plan fingerprint can never disagree
         self._policy = get_policy(bucket_policy)
         self.bucket_policy = self._policy.name
+        #: inference precision mode; stamped onto the policy so every
+        #: planned ProgramKey (and through it every ledger unit digest)
+        #: carries it — a worker built at one precision can never join
+        #: a ledger planned at another
+        self.precision = resolve_precision(precision)
+        self.precision_tolerance = float(precision_tolerance)
+        self._policy.precision = self.precision
+        self.prefetch_depth = max(0, int(prefetch_depth))
+        #: machine name -> calibration decision of the last build
+        #: ({"precision", "mae_delta", "forced"}); empty for float32
+        #: builds (no calibration pass runs)
+        self.precision_decisions_: Dict[str, dict] = {}
         #: AOT-compile + serialize the built collection's SERVING
         #: programs beside the artifacts (<output>/.programs/), so a
         #: fresh server's cold start is a deserialize instead of a
@@ -464,6 +507,7 @@ class FleetModelBuilder:
         self.build_failures_ = []
         self.quarantined_ = []
         self.build_report_ = None
+        self.precision_decisions_ = {}
         emit_event(
             "build_started",
             n_machines=len(self.machines),
@@ -627,6 +671,22 @@ class FleetModelBuilder:
                 remaining.append(machine)
                 continue
             reused[machine.name] = (model, restored_machine)
+            if self.precision != "float32":
+                # a reused artifact's calibration decision rides its
+                # pickle (est.precision_); surface it so a --resume
+                # build's report still names every machine's precision
+                est = _find_jax_estimator(model)
+                if est is not None:
+                    self.precision_decisions_[machine.name] = {
+                        "precision": getattr(
+                            est, "precision_", "float32"
+                        ),
+                        "mae_delta": getattr(
+                            est, "precision_mae_delta_", None
+                        ),
+                        "forced": False,
+                        "resumed": True,
+                    }
         return reused, remaining
 
     def _flush_pairs(self, pairs, base: Optional[Path]) -> None:
@@ -725,6 +785,7 @@ class FleetModelBuilder:
         self._bucket_reports = []
         self.build_failures_ = []
         self.quarantined_ = []
+        self.precision_decisions_ = {}
         reused: Dict[str, Tuple[BaseEstimator, Machine]] = {}
         to_build = list(unit_machines)
         if resume:
@@ -751,6 +812,10 @@ class FleetModelBuilder:
             "failed": [dict(r) for r in self.build_failures_],
             "quarantined": [dict(r) for r in self.quarantined_],
             "buckets": [dict(r) for r in self._bucket_reports],
+            "precision": {
+                name: dict(rec)
+                for name, rec in self.precision_decisions_.items()
+            },
         }
         return report, results
 
@@ -821,6 +886,7 @@ class FleetModelBuilder:
             "n_resumed": n_resumed,
             "n_buckets": n_buckets,
             "bucket_policy": self.bucket_policy,
+            "precision": self.precision,
             "models_per_hour": rate,
             "device_memory": memory_watermarks(),
             "buckets": self._bucket_reports,
@@ -842,6 +908,14 @@ class FleetModelBuilder:
             "n_quarantined": len(self.quarantined_),
             "failed": list(self.build_failures_),
             "quarantined": list(self.quarantined_),
+            "precision": {
+                "mode": self.precision,
+                "tolerance": self.precision_tolerance,
+                "machines": {
+                    name: dict(rec)
+                    for name, rec in self.precision_decisions_.items()
+                },
+            },
         }
         reg = get_registry()
         reg.counter(
@@ -1036,6 +1110,7 @@ class FleetModelBuilder:
             n_timesteps=n_grid,
             n_features=f_prog,
             n_features_out=f_out_prog,
+            prefetch_depth=self.prefetch_depth,
         )
 
         # one compiled fleet program per bucket geometry from here on —
@@ -1066,6 +1141,7 @@ class FleetModelBuilder:
             mesh=self.mesh,
             epoch_chunk=epoch_chunk,
             fault_sites=self.fault_sites,
+            prefetch_depth=self.prefetch_depth,
         )
         # Per-machine PRNG keys are the SOLO path's init key for the
         # machine's evaluation seed (models/core.py: solo_init_key) —
@@ -1129,6 +1205,30 @@ class FleetModelBuilder:
                     "Machine %s was quarantined at epoch %d; its artifact "
                     "holds the last finite params and serving will 409 it",
                     name, int(q_epochs[i]),
+                )
+
+        # -- bf16 calibration (precision != float32) ----------------------
+        # measure each machine's reconstruction-MAE delta between the
+        # float32 program and a bf16 cast of the SAME params/data — the
+        # parity statistic the padded policy is judged by — and decide
+        # per machine whether it may serve bf16. The float32 default
+        # skips this entirely (no calibration pass, bit-identical build).
+        precision_records: Dict[str, dict] = {}
+        if self.precision != "float32":
+            with tracing.start_span(
+                "build.calibrate",
+                n_machines=len(fetched),
+                mode=self.precision,
+            ):
+                precision_records = self._calibrate_precision(
+                    trainer, params, data,
+                    machine_names=machine_names,
+                    estimators=estimators,
+                    Xs_grid=Xs_grid,
+                    ys_grid=ys_grid,
+                    out_widths=out_widths,
+                    spec=spec,
+                    lookahead=lookahead,
                 )
 
         # -- unstack into per-machine models + metadata -------------------
@@ -1218,7 +1318,7 @@ class FleetModelBuilder:
         # -- bucket telemetry: rate, final-fit timings, HBM watermark ------
         bucket_wall = time.time() - bucket_start
         bucket_memory = memory_watermarks()
-        self._bucket_reports.append(
+        bucket_report = (
             {
                 "n_machines": len(bucket),
                 "n_machines_padded": int(m_padded),
@@ -1252,8 +1352,14 @@ class FleetModelBuilder:
                 # this attribute, the final fit runs last
                 "fit": getattr(trainer, "fit_telemetry_", None),
                 "device_memory": bucket_memory,
+                "precision": self.precision,
             }
         )
+        if precision_records:
+            bucket_report["precision_decisions"] = {
+                name: dict(rec) for name, rec in precision_records.items()
+            }
+        self._bucket_reports.append(bucket_report)
         get_registry().histogram(
             "gordo_build_bucket_seconds",
             "Per-bucket wall time (data fetch + CV + fit + unstack)",
@@ -1271,6 +1377,111 @@ class FleetModelBuilder:
             peak_bytes_in_use=peak,
         )
         return out
+
+    def _calibrate_precision(
+        self,
+        trainer: FleetTrainer,
+        params: Any,
+        data: StackedData,
+        *,
+        machine_names: List[str],
+        estimators: List[BaseJaxEstimator],
+        Xs_grid: List[np.ndarray],
+        ys_grid: List[np.ndarray],
+        out_widths: List[int],
+        spec: Any,
+        lookahead: int,
+    ) -> Dict[str, dict]:
+        """
+        The bf16 calibration pass (docs/performance.md "Mixed
+        precision"): predict the whole bucket once at float32 and once
+        with params/inputs cast to bfloat16 (exactly the cast serving
+        performs), then compare each machine's reconstruction MAE over
+        its REAL rows and ACTIVE output columns. A machine whose
+        relative MAE delta clears ``precision_tolerance`` may serve
+        bf16; one that doesn't stays float32 — under ``--precision
+        bf16`` the operator override serves bf16 anyway (breaches
+        logged, never silent), while a ``precision:degrade`` chaos spec
+        forces the float32 fallback in either mode. Decisions are
+        stamped on the estimators (``est.precision_`` — pickled with
+        the artifact, so they survive ``--resume`` and ride into
+        serving group keys) and recorded for ``build_report.json``.
+        """
+        import jax.numpy as jnp
+
+        preds32 = np.asarray(
+            trainer.predict(params, data.X), dtype=np.float32
+        )
+        params16 = cast_params(params, jnp.bfloat16)
+        X16 = jnp.asarray(data.X).astype(jnp.bfloat16)
+        preds16 = np.asarray(
+            trainer.predict(params16, X16), dtype=np.float32
+        )
+        offset = (
+            spec.lookback_window - 1 + lookahead if spec.windowed else 0
+        )
+        records: Dict[str, dict] = {}
+        n_bf16 = 0
+        worst = 0.0
+        hist = get_registry().histogram(
+            "gordo_build_precision_mae_delta",
+            "Per-machine relative reconstruction-MAE delta of the bf16 "
+            "cast vs the float32 build, measured at calibration",
+        )
+        for i, name in enumerate(machine_names):
+            est = estimators[i]
+            n_out = max(0, len(Xs_grid[i]) - offset)
+            cols = int(out_widths[i])
+            y_true = np.asarray(ys_grid[i], dtype=np.float32)[
+                offset : offset + n_out, :cols
+            ]
+            mae32 = mae(preds32[i, :n_out, :cols], y_true)
+            mae16 = mae(preds16[i, :n_out, :cols], y_true)
+            delta, within = mae_parity(
+                mae32, mae16, self.precision_tolerance
+            )
+            forced = faults.precision_degrade(name)
+            if forced:
+                decided = "float32"
+            elif self.precision == "bf16":
+                decided = "bf16"
+                if not within:
+                    logger.warning(
+                        "Machine %s: bf16 MAE delta %.4f exceeds "
+                        "tolerance %.4f but --precision bf16 overrides "
+                        "the fallback",
+                        name, delta, self.precision_tolerance,
+                    )
+            else:
+                decided = "bf16" if within else "float32"
+            est.precision_ = decided
+            est.precision_mae_delta_ = float(delta)
+            records[name] = {
+                "precision": decided,
+                "mae_delta": float(delta),
+                "forced": bool(forced),
+            }
+            hist.observe(float(delta))
+            worst = max(worst, float(delta))
+            n_bf16 += decided == "bf16"
+        n_fallback = len(machine_names) - n_bf16
+        if n_fallback:
+            get_registry().counter(
+                "gordo_build_precision_fallbacks_total",
+                "Machines whose bf16 calibration failed (or was "
+                "chaos-forced to fail) and stayed float32",
+            ).inc(n_fallback)
+        self.precision_decisions_.update(records)
+        emit_event(
+            "precision_calibrated",
+            mode=self.precision,
+            tolerance=self.precision_tolerance,
+            n_machines=len(machine_names),
+            n_bf16=n_bf16,
+            n_float32=n_fallback,
+            worst_mae_delta=round(worst, 6),
+        )
+        return records
 
     def _stack_warm_params(
         self, machine_names: List[str], m_padded: int

@@ -71,6 +71,7 @@ from pathlib import Path
 from gordo_tpu.machine import Machine
 from gordo_tpu.observability import emit_event, get_registry, tracing
 from gordo_tpu.parallel.bucketing import get_policy
+from gordo_tpu.parallel.precision import DEFAULT_PRECISION_TOLERANCE
 from gordo_tpu.robustness import faults
 from gordo_tpu.utils import atomic
 
@@ -227,7 +228,11 @@ class Ledger:
     # -- plan -------------------------------------------------------------
 
     def ensure_plan(
-        self, units: typing.List[WorkUnit], bucket_policy: str = "exact"
+        self,
+        units: typing.List[WorkUnit],
+        bucket_policy: str = "exact",
+        precision: str = "float32",
+        precision_tolerance: typing.Optional[float] = None,
     ) -> None:
         """
         Publish the work plan, or join the one already on disk — which
@@ -237,7 +242,10 @@ class Ledger:
         ``--bucket-policy padded`` against an exact ledger (or vice
         versa) would build different program geometries into the same
         artifact tree, so it refuses to join exactly like a config
-        mismatch — with the policy named in the error.
+        mismatch — with the policy named in the error. Precision is the
+        same kind of plan identity (the unit digests already carry any
+        non-float32 mode): a worker serving one precision must never
+        fill in units of a ledger planned at another.
         """
         self.units_dir.mkdir(parents=True, exist_ok=True)
         self.workers_dir.mkdir(parents=True, exist_ok=True)
@@ -248,6 +256,8 @@ class Ledger:
             "created_by": self.worker_id,
             "plan_hash": fingerprint,
             "bucket_policy": bucket_policy,
+            "precision": precision,
+            "precision_tolerance": precision_tolerance,
             "n_units": len(units),
             "n_machines": sum(len(u.machines) for u in units),
             "units": [
@@ -267,6 +277,15 @@ class Ledger:
                     f"--bucket-policy {existing_policy} but this worker "
                     f"runs --bucket-policy {bucket_policy}; every worker "
                     "of a build must group machines identically — remove "
+                    "the ledger directory to start a fresh build"
+                )
+            existing_precision = existing.get("precision", "float32")
+            if existing_precision != precision:
+                raise LedgerPlanMismatch(
+                    f"Ledger at {self.base} was planned with "
+                    f"--precision {existing_precision} but this worker "
+                    f"runs --precision {precision}; every worker of a "
+                    "build must compile at the same precision — remove "
                     "the ledger directory to start a fresh build"
                 )
             if existing.get("plan_hash") != fingerprint:
@@ -711,6 +730,7 @@ class Ledger:
         failed: typing.List[dict] = []
         quarantined: typing.List[dict] = []
         bucket_reports: typing.List[dict] = []
+        precision_machines: typing.Dict[str, dict] = {}
         attempts_total = 0
         steals = 0
         for unit in units:
@@ -722,6 +742,7 @@ class Ledger:
                 failed.extend(report.get("failed") or [])
                 quarantined.extend(report.get("quarantined") or [])
                 bucket_reports.extend(report.get("buckets") or [])
+                precision_machines.update(report.get("precision") or {})
                 attempt = int(done.get("attempt") or 1)
                 attempts_total += attempt
                 steals += max(0, attempt - 1)
@@ -762,6 +783,18 @@ class Ledger:
             "n_quarantined": len(quarantined),
             "failed": failed,
             "quarantined": quarantined,
+            "precision": {
+                "mode": plan.get("precision", "float32"),
+                "tolerance": (
+                    plan.get("precision_tolerance")
+                    if plan.get("precision_tolerance") is not None
+                    else DEFAULT_PRECISION_TOLERANCE
+                ),
+                "machines": {
+                    name: precision_machines[name]
+                    for name in sorted(precision_machines)
+                },
+            },
         }
         atomic.atomic_write_json(
             self.output_dir / "build_report.json",
@@ -1037,7 +1070,10 @@ def run_worker(
         max_attempts=max_attempts,
     )
     ledger.ensure_plan(
-        units, bucket_policy=getattr(builder, "bucket_policy", "exact")
+        units,
+        bucket_policy=getattr(builder, "bucket_policy", "exact"),
+        precision=getattr(builder, "precision", "float32"),
+        precision_tolerance=getattr(builder, "precision_tolerance", None),
     )
     poll = (
         poll_interval

@@ -344,6 +344,42 @@ def build(
     "out of training and stripped from responses). Preview with "
     "`gordo-tpu buckets plan`.",
 )
+@click.option(
+    "--precision",
+    type=click.Choice(["float32", "bf16", "auto"]),
+    default="float32",
+    envvar="GORDO_PRECISION",
+    show_default=True,
+    help="Inference precision mode (docs/performance.md 'Mixed "
+    "precision'): 'float32' is the historical bit-identical path (no "
+    "calibration pass); 'auto' calibrates every machine's bf16 "
+    "predictions against its float32 build and serves bf16 only where "
+    "the MAE delta clears --precision-tolerance (per-machine decision "
+    "in build_report.json); 'bf16' is the operator override — every "
+    "machine serves bf16, breaches logged but not enforced. Training "
+    "always runs float32.",
+)
+@click.option(
+    "--precision-tolerance",
+    type=click.FloatRange(min=0),
+    default=0.25,
+    envvar="GORDO_PRECISION_TOLERANCE",
+    show_default=True,
+    help="Relative reconstruction-MAE tolerance for the bf16 "
+    "calibration — the same bound padded-vs-exact parity is held to.",
+)
+@click.option(
+    "--prefetch-depth",
+    type=click.IntRange(min=0, max=8),
+    default=0,
+    envvar="GORDO_PREFETCH_DEPTH",
+    show_default=True,
+    help="Host->device transfer pipelining depth (docs/performance.md "
+    "'transfer pipelining'): 0 is the historical single-transfer path "
+    "(bit-identical); >0 double-buffers the builder's stacked-data "
+    "transfer and the trainer's per-chunk transfers so transfer k+1 "
+    "rides under dispatch k.",
+)
 @_with_build_options
 def build_fleet(
     machines_config: list,
@@ -352,6 +388,9 @@ def build_fleet(
     epoch_chunk: int,
     on_error: str,
     bucket_policy: str,
+    precision: str,
+    precision_tolerance: float,
+    prefetch_depth: int,
     fetch_retries: int,
     fetch_timeout: float,
     aot_cache: bool,
@@ -410,12 +449,18 @@ def build_fleet(
                 "bucket_policy": "bucket_policy",
                 "build_workers": "workers",
                 "lease_ttl": "lease_ttl",
+                "precision": "precision",
+                "prefetch_depth": "prefetch_depth",
             },
             subsystem="builder",
         )
         epoch_chunk = profile_overrides.get("epoch_chunk", epoch_chunk)
         bucket_policy = profile_overrides.get("bucket_policy", bucket_policy)
         lease_ttl = profile_overrides.get("lease_ttl", lease_ttl)
+        precision = profile_overrides.get("precision", precision)
+        prefetch_depth = profile_overrides.get(
+            "prefetch_depth", prefetch_depth
+        )
         if "workers" in profile_overrides:
             workers = str(profile_overrides["workers"])
         n_workers = 1
@@ -436,6 +481,9 @@ def build_fleet(
                 "--on-error", on_error,
                 "--fetch-retries", str(fetch_retries),
                 "--bucket-policy", bucket_policy,
+                "--precision", precision,
+                "--precision-tolerance", str(precision_tolerance),
+                "--prefetch-depth", str(prefetch_depth),
             ]
             if fetch_timeout is not None:
                 worker_args += ["--fetch-timeout", str(fetch_timeout)]
@@ -497,6 +545,9 @@ def build_fleet(
             fetch_retries=fetch_retries,
             fetch_timeout=fetch_timeout,
             bucket_policy=bucket_policy,
+            precision=precision,
+            precision_tolerance=precision_tolerance,
+            prefetch_depth=prefetch_depth,
             # worker processes skip the export: serving groups span
             # units, so the orchestrator exports over the finalized
             # collection instead

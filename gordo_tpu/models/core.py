@@ -437,15 +437,39 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         if getattr(self, "_apply_fn", None) is None:
             # the jitted apply is cached ON the spec: every estimator
             # sharing a spec (a whole fleet bucket) reuses one compiled
-            # program instead of tracing+compiling per estimator
+            # program instead of tracing+compiling per estimator.
+            # Precision keys the cache attribute — a calibration-fallback
+            # float32 machine must not reuse its bucket-mates' bf16
+            # program (docs/performance.md "Mixed precision")
             spec = self.spec_
-            shared = getattr(spec, "_shared_apply_fn", None)
+            precision = getattr(self, "precision_", "float32")
+            attr = (
+                "_shared_apply_fn"
+                if precision == "float32"
+                else f"_shared_apply_fn_{precision}"
+            )
+            shared = getattr(spec, attr, None)
             if shared is None:
                 module = spec.module
-                shared = jax.jit(lambda p, x: module.apply(p, x)[0])
-                spec._shared_apply_fn = shared
+                if precision == "bf16":
+                    # the same cast walk the fleet scorer compiles:
+                    # bf16 params + in-program input cast, output
+                    # upcast — responses keep their float32 dtype
+                    shared = jax.jit(
+                        lambda p, x: module.apply(p, x.astype(jnp.bfloat16))[
+                            0
+                        ].astype(jnp.float32)
+                    )
+                else:
+                    shared = jax.jit(lambda p, x: module.apply(p, x)[0])
+                setattr(spec, attr, shared)
             self._apply_fn = shared
-            self._device_params = jax.device_put(self.params_)
+            params = self.params_
+            if precision == "bf16":
+                from gordo_tpu.parallel.precision import cast_params
+
+                params = cast_params(params, jnp.bfloat16)
+            self._device_params = jax.device_put(params)
         return self._apply_fn
 
     def _pad_active_input(self, X: np.ndarray) -> np.ndarray:
@@ -534,14 +558,19 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
         for attr in _EPHEMERAL_ATTRS:
             state.pop(attr, None)
         spec = state.get("spec_")
-        if spec is not None and (
-            hasattr(spec, "_shared_apply_fn") or hasattr(spec, "_serving_trainers")
+        ephemeral_spec_attrs = (
+            "_shared_apply_fn",
+            "_shared_apply_fn_bf16",
+            "_serving_trainers",
+        )
+        if spec is not None and any(
+            hasattr(spec, attr) for attr in ephemeral_spec_attrs
         ):
             # jitted functions / compiled-program caches don't pickle;
             # shallow-copy so the live (possibly fleet-shared) spec keeps
             # its cached programs
             spec = copy.copy(spec)
-            for attr in ("_shared_apply_fn", "_serving_trainers"):
+            for attr in ephemeral_spec_attrs:
                 if hasattr(spec, attr):
                     delattr(spec, attr)
             state["spec_"] = spec

@@ -126,6 +126,7 @@ class ProgramKey:
     n_features: int
     n_features_out: int
     policy: str = "exact"
+    precision: str = "float32"
 
     def digest_payload(self) -> list:
         """
@@ -134,11 +135,17 @@ class ProgramKey:
         the pre-policy ledger digests, so ``--bucket-policy exact`` (the
         default) joins and resumes old ledgers unchanged. Any other
         policy appends its name, so a policy flip always changes the
-        plan fingerprint and a mismatched worker refuses to join.
+        plan fingerprint and a mismatched worker refuses to join. The
+        precision mode rides the same discipline: float32 (the default)
+        is digest-silent, any other mode appends a tagged entry — a
+        precision flip changes every plan fingerprint, so a worker built
+        for one precision can never join a ledger built for another.
         """
         payload: list = [self.model_key, self.n_features, self.n_features_out]
         if self.policy != "exact":
             payload.append(self.policy)
+        if self.precision != "float32":
+            payload.append(f"precision={self.precision}")
         return payload
 
 
@@ -186,6 +193,12 @@ class BucketPolicy:
     """
 
     name: str = "abstract"
+    #: precision mode stamped into every planned ProgramKey. The
+    #: builder sets this from --precision before planning; "auto" plans
+    #: as "auto" (the per-machine calibration outcome is a BUILD
+    #: result, not a plan input — the plan must be deterministic from
+    #: the config alone for the multi-worker ledger).
+    precision: str = "float32"
 
     def machine_key(self, machine: Machine) -> ProgramKey:
         raise NotImplementedError
@@ -229,6 +242,7 @@ class ExactBucketPolicy(BucketPolicy):
             n_features=f,
             n_features_out=f_out,
             policy=self.name,
+            precision=self.precision,
         )
 
     def program_dims(self, widths, out_widths):
@@ -269,6 +283,7 @@ class PaddedBucketPolicy(BucketPolicy):
             n_features=dimension_bucket(f, self.min_bucket),
             n_features_out=dimension_bucket(f_out, self.min_bucket),
             policy=self.name,
+            precision=self.precision,
         )
 
     def program_dims(self, widths, out_widths):

@@ -40,6 +40,7 @@ from gordo_tpu.models.specs import (
     per_sample_loss,
 )
 from gordo_tpu.observability import annotate, emit_event, get_registry, tracing
+from gordo_tpu.parallel import transfer
 from gordo_tpu.parallel.mesh import fleet_sharding, pad_to_multiple, replicated_sharding
 from gordo_tpu.programs import ProgramCache
 from gordo_tpu.robustness import faults as _faults
@@ -121,6 +122,7 @@ class StackedData:
         n_timesteps: Optional[int] = None,
         n_features: Optional[int] = None,
         n_features_out: Optional[int] = None,
+        prefetch_depth: int = 0,
     ) -> "StackedData":
         """
         Stack per-machine (n_i, f_i) arrays, zero-padding rows up to the
@@ -134,6 +136,13 @@ class StackedData:
         zero gradients) and masked out of the loss via the returned
         ``feature_out_weight`` on output. Defaults keep the historical
         contract: machine 0's widths, every column real, no mask.
+
+        ``prefetch_depth`` > 0 pipelines the host->device transfer of
+        the big stacked tensors as sliced ``device_put`` calls
+        (parallel/transfer.py) so later slices stream while the first
+        feeds the device; 0 (the default) is the historical single
+        ``jnp.asarray`` — same bits either way, the slicing moves
+        bytes, not math.
         """
         assert len(Xs) == len(ys) and len(Xs) > 0
         f = max(n_features or 0, max(x.shape[1] for x in Xs))
@@ -155,6 +164,17 @@ class StackedData:
         # their sample weights are already zero, and a zero fw row would
         # needlessly special-case the masked loss's normalizer
         fw[len(Xs):] = 1.0
+        if prefetch_depth > 0:
+            from gordo_tpu.parallel import transfer
+
+            return cls(
+                transfer.device_put_sliced(X, prefetch_depth, plane="build"),
+                transfer.device_put_sliced(y, prefetch_depth, plane="build"),
+                transfer.device_put_sliced(w, prefetch_depth, plane="build"),
+                feature_out_weight=(
+                    jnp.asarray(fw) if ragged_out else None
+                ),
+            )
         return cls(
             jnp.asarray(X),
             jnp.asarray(y),
@@ -198,6 +218,12 @@ class FleetTrainer:
         (hyperparameter sweeps): ``fit`` takes a single-machine
         StackedData and the epoch vmaps with ``in_axes=None`` for the
         data, so device memory holds one copy instead of M.
+    prefetch_depth
+        When > 0, a chunked fit issues chunk k+1's per-chunk
+        host->device transfer (the epoch-index vector) while chunk k's
+        program is still running (docs/performance.md "transfer
+        pipelining"). Scheduling only — bits are identical to the
+        default 0.
     epoch_chunk
         Number of epochs fused into ONE compiled program (an outer
         ``lax.scan`` over the per-epoch program). With the default 1,
@@ -234,6 +260,7 @@ class FleetTrainer:
         epoch_chunk: int = 1,
         quarantine_nonfinite: bool = True,
         fault_sites: Tuple[str, ...] = ("train",),
+        prefetch_depth: int = 0,
     ):
         self.spec = spec
         self.lookahead = int(lookahead) if spec.windowed else 0
@@ -243,6 +270,11 @@ class FleetTrainer:
         self.broadcast_data = broadcast_data
         self.epoch_chunk = max(1, int(epoch_chunk))
         self.quarantine_nonfinite = bool(quarantine_nonfinite)
+        #: double-buffer the per-chunk host->device transfers of a
+        #: chunked fit: chunk k+1's argument transfer is issued while
+        #: chunk k's program runs (parallel/transfer.py). 0 = off, the
+        #: historical (bit-identical) path.
+        self.prefetch_depth = max(0, int(prefetch_depth))
         #: GORDO_FAULT_INJECT sites whose nan-mode specs poison this
         #: trainer's fits ("train" everywhere; lifecycle warm-start
         #: refits add "refit" so refit:nan targets refit builds only)
@@ -1661,15 +1693,25 @@ class FleetTrainer:
         dispatch_times: list = []
         loop_start = time.perf_counter()
 
-        e = start_epoch
-        while e < epochs:
-            k = min(chunk, epochs - e)
+        def chunk_len(e0: int) -> int:
+            k0 = min(chunk, epochs - e0)
             if checkpointer is not None:
                 # the next epoch whose completion is a checkpoint: the
                 # chunk must not run past it (checkpoints happen at chunk
                 # boundaries only, so cadence survives chunking exactly)
-                next_cp = ((e + ce) // ce) * ce - 1
-                k = min(k, next_cp - e + 1)
+                next_cp = ((e0 + ce) // ce) * ce - 1
+                k0 = min(k0, next_cp - e0 + 1)
+            return k0
+
+        # chunk k+1's epoch-index transfer, issued while chunk k's
+        # program still runs (prefetch_depth > 0); keyed by (epoch,
+        # length) so a vector prefetched for a chunk that never runs
+        # (early stop) is simply dropped
+        prefetched_epochs: dict = {}
+
+        e = start_epoch
+        while e < epochs:
+            k = chunk_len(e)
             chunk_start = time.perf_counter()
             chunk_fn = self._chunk_fn(
                 n_timesteps, batch_size, shuffle,
@@ -1680,9 +1722,13 @@ class FleetTrainer:
                 quarantine=quarantine, inject=inj is not None,
                 masked=masked,
             )
+            epoch_vec = prefetched_epochs.pop((e, k), None)
+            if epoch_vec is None:
+                if self.prefetch_depth > 0:
+                    transfer.count_transfer("train", "direct")
+                epoch_vec = jnp.arange(e, e + k, dtype=jnp.int32)
             args = [
-                params, opt_state, keys, X_arg, y_arg, w_arg,
-                jnp.arange(e, e + k, dtype=jnp.int32),
+                params, opt_state, keys, X_arg, y_arg, w_arg, epoch_vec,
             ]
             if with_val:
                 args.append(val_arg)
@@ -1708,6 +1754,19 @@ class FleetTrainer:
                 "train.dispatch", epoch=e, n_epochs=k
             ), annotate("train-dispatch"):
                 final, outs = chunk_fn(*args)
+            if self.prefetch_depth > 0:
+                # the dispatch above is asynchronous: issue the NEXT
+                # chunk's argument transfer now so it rides under the
+                # running program instead of on the next iteration's
+                # critical path
+                e_next = e + k
+                if e_next < epochs:
+                    k_next = chunk_len(e_next)
+                    if (e_next, k_next) not in prefetched_epochs:
+                        prefetched_epochs[(e_next, k_next)] = jax.device_put(
+                            np.arange(e_next, e_next + k_next, dtype=np.int32)
+                        )
+                        transfer.count_transfer("train", "prefetched")
             params, opt_state = final["params"], final["opt"]
             if quarantine:
                 healthy_dev = final["healthy"]

@@ -1,0 +1,103 @@
+"""
+Per-machine inference precision — the precision axis of the compiled
+program key (docs/performance.md "Mixed precision, buffer donation, and
+transfer pipelining").
+
+PR 10 separated "machine spec" from "compiled-program key" so machines
+could share padded programs under an MAE-parity tolerance; precision is
+the next field in that key. ``--precision bf16``/``auto`` builds serve
+matmuls in bfloat16 — on TPU that halves params/input bandwidth and
+doubles MXU throughput for these tiny, bandwidth-bound models (the
+Learned Performance Model paper, PAPERS.md arXiv:2008.01040, puts tiny
+model serving squarely in the transfer-and-overhead-bound regime).
+
+The discipline mirrors padding:
+
+* **Calibrated, per machine.** At build time each machine's bf16
+  predictions are compared to its just-built float32 predictions on the
+  training data; a machine whose reconstruction-MAE delta exceeds the
+  tolerance stays float32. The decision (``est.precision_``) rides the
+  artifact, lands in ``build_report.json``, and splits serving groups —
+  a bf16 machine and a float32 machine never fuse into one program.
+* **Training is always float32.** bf16 is an inference-time cast of the
+  finished params; the learning trajectory is untouched.
+* **Outputs upcast in-program.** Served payloads and the anomaly
+  statistic stay float32/float64 exactly as today; only the matmul
+  interior narrows.
+* **float32 is digest-silent.** ProgramKey digests, AOT manifest keys
+  and serving group keys only grow a precision entry when the mode is
+  not float32, so default builds/ledgers/stores are byte-identical.
+"""
+
+import typing
+
+import numpy as np
+
+__all__ = [
+    "PRECISIONS",
+    "DEFAULT_PRECISION_TOLERANCE",
+    "resolve_precision",
+    "cast_params",
+    "mae",
+    "mae_parity",
+]
+
+#: the --precision vocabulary (CLI + FleetModelBuilder)
+PRECISIONS = ("float32", "bf16", "auto")
+
+#: default relative reconstruction-MAE tolerance for the bf16-vs-float32
+#: calibration — the same bound tests/test_padded_fleet.py pins for
+#: padded-vs-exact parity, reused deliberately so "close enough to pad"
+#: and "close enough to narrow" mean the same thing.
+DEFAULT_PRECISION_TOLERANCE = 0.25
+
+
+def resolve_precision(value: typing.Optional[str]) -> str:
+    """Validate a ``--precision`` value; None means the float32
+    default."""
+    if value is None:
+        return "float32"
+    mode = str(value).strip().lower()
+    if mode not in PRECISIONS:
+        raise ValueError(
+            f"unknown precision {value!r}; expected one of {PRECISIONS}"
+        )
+    return mode
+
+
+def cast_params(params, dtype):
+    """Cast the floating leaves of a param tree to ``dtype`` (integer
+    leaves — step counters and the like — pass through untouched)."""
+    import jax
+    import jax.numpy as jnp
+
+    def _cast(leaf):
+        arr = jnp.asarray(leaf)
+        if jnp.issubdtype(arr.dtype, jnp.floating):
+            return arr.astype(dtype)
+        return arr
+
+    return jax.tree_util.tree_map(_cast, params)
+
+
+def mae(preds: np.ndarray, y: np.ndarray) -> float:
+    """Mean absolute reconstruction error, upcast to float64 on host —
+    the parity statistic both the padding and precision calibrations
+    judge against."""
+    p = np.asarray(preds, dtype=np.float64)
+    t = np.asarray(y, dtype=np.float64)
+    if p.size == 0:
+        return 0.0
+    return float(np.mean(np.abs(p - t)))
+
+
+def mae_parity(
+    mae32: float, mae16: float, tolerance: float
+) -> typing.Tuple[float, bool]:
+    """Relative MAE delta of the bf16 build vs the float32 build and
+    whether it clears ``tolerance``. The delta is relative to the
+    float32 MAE (floored to dodge division by an exactly-zero
+    reconstruction error on degenerate data)."""
+    base = max(abs(float(mae32)), 1e-12)
+    delta = abs(float(mae16) - float(mae32)) / base
+    return delta, delta <= float(tolerance)

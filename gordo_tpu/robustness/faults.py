@@ -24,7 +24,10 @@ One spec is ``site:mode[:target][@key:value ...]``:
   live build), and ``program`` (``program:corrupt[:digest-prefix]`` —
   the AOT executable-cache load seam, docs/performance.md: the stored
   payload is mangled so deserialization fails and serving falls back
-  to a retrace).
+  to a retrace), and ``precision`` (``precision:degrade:<machine>`` —
+  the build-time bf16 calibration seam, docs/performance.md "Mixed
+  precision": the named machine's calibration is forced to fail, so it
+  falls back to float32 inside an otherwise-bf16 bucket).
 - ``mode`` — what happens there: ``raise`` (the seam raises
   :class:`InjectedFault`), ``nan`` (train/refit: the named machine's
   epoch loss goes NaN at ``@epoch:<e>``, driving the quarantine guard),
@@ -64,6 +67,7 @@ _KNOWN_SITES = frozenset(
     {
         "fetch", "train", "ckpt", "serve", "batch", "drift", "refit",
         "promote", "worker", "lease", "program", "replica", "stream",
+        "precision",
     }
 )
 
@@ -350,6 +354,31 @@ def refit_degrade_scale(name: typing.Optional[str]) -> typing.Optional[float]:
     (docs/lifecycle.md). None = candidate untouched.
     """
     return _scale_for("refit", "degrade", name, 10.0)
+
+
+def precision_degrade(name: typing.Optional[str]) -> bool:
+    """
+    The bf16-calibration seam (site ``precision``, mode ``degrade``):
+    when a matching ``precision:degrade:<machine>`` spec fires, the
+    builder treats the named machine's bf16 calibration as FAILED
+    regardless of its measured MAE delta, so the machine stays float32
+    inside an otherwise-bf16 bucket — the fallback path a chaos run
+    exercises without needing data engineered to lose precision
+    (docs/performance.md "Mixed precision"). ``@attempts:N`` limits the
+    forced failure to the first N calibrations (a rebuilt machine then
+    calibrates clean). Env unset is the strict one-lookup no-op.
+    """
+    registry = active_registry()
+    if registry is None:
+        return False
+    spec = _find_mode(registry, "precision", "degrade", name)
+    if spec is None:
+        return False
+    attempts = spec.param_int("attempts", 0)
+    if attempts and spec.fires >= attempts:
+        return False
+    registry.fire(spec, machine=name)
+    return True
 
 
 def worker_die(stage: str) -> None:

@@ -27,6 +27,8 @@ import numpy as np
 
 from gordo_tpu.models.core import BaseJaxEstimator, _batch_bucket
 from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.parallel import transfer
+from gordo_tpu.parallel.precision import cast_params
 from gordo_tpu.programs import ProgramCache, serving_program_cache
 
 logger = logging.getLogger(__name__)
@@ -49,9 +51,20 @@ def _pow2_bucket(n: int, cap: Optional[int] = None) -> int:
 
 
 def _group_key(est: BaseJaxEstimator) -> Tuple:
-    """Machines whose estimators share this key can be stacked and vmapped."""
+    """Machines whose estimators share this key can be stacked and vmapped.
+
+    Per-machine inference precision (``est.precision_``, stamped by the
+    builder's calibration pass — docs/performance.md "Mixed precision")
+    joins the key only when non-default, mirroring
+    :meth:`ProgramKey.digest_payload
+    <gordo_tpu.parallel.bucketing.ProgramKey.digest_payload>`: an
+    all-float32 fleet produces byte-identical keys (and so handle/AOT
+    identities) to every pre-precision build, and a calibration-fallback
+    machine splits into its own float32 group rather than silently
+    sharing a bf16 program.
+    """
     spec = est.spec_
-    return (
+    key = (
         repr(spec.module),
         spec.windowed,
         spec.lookback_window if spec.windowed else 1,
@@ -59,6 +72,10 @@ def _group_key(est: BaseJaxEstimator) -> Tuple:
         est.n_features_,
         est.n_features_out_,
     )
+    precision = getattr(est, "precision_", "float32")
+    if precision != "float32":
+        key = key + (f"precision={precision}",)
+    return key
 
 
 def _fn_digest(key: Tuple) -> str:
@@ -118,12 +135,20 @@ class FleetScorer:
         by_key: Dict[Tuple, List[str]] = {}
         for name, est in estimators.items():
             by_key.setdefault(_group_key(est), []).append(name)
+        donate = transfer.env_donate()
         for key, names in by_key.items():
             group_ests = [estimators[n] for n in names]
             stacked = jax.tree_util.tree_map(
                 lambda *leaves: jnp.stack(leaves), *[e.params_ for e in group_ests]
             )
             spec = group_ests[0].spec_
+            precision = getattr(group_ests[0], "precision_", "float32")
+            if precision == "bf16":
+                # the resident stack lives at the serving precision; the
+                # batch stays float32 on the wire and is cast IN-program
+                # (below), and outputs upcast IN-program — responses and
+                # the anomaly statistic keep their historical dtypes
+                stacked = cast_params(stacked, jnp.bfloat16)
             fn_digest = _fn_digest(key)
             if spec.windowed:
                 # windows are gathered IN the compiled program from raw
@@ -133,33 +158,68 @@ class FleetScorer:
                 lb = spec.lookback_window
                 la = group_ests[0].lookahead
 
-                def one(p, x, module=spec.module, lb=lb, la=la):
-                    starts = jnp.arange(
-                        x.shape[0] - lb + 1 - la, dtype=jnp.int32
-                    )
-                    rows = starts[:, None] + jnp.arange(lb, dtype=jnp.int32)
-                    return module.apply(p, x[rows])[0]
+                if precision == "bf16":
 
-                # the handle key is the RAW group key (repr unstripped):
-                # within a process, two modules share a handle only if
-                # they'd have grouped together anyway — the stripped
-                # fn_digest is for CROSS-process AOT identity only
-                apply_fn = self._cache.get_or_build(
-                    ("scorer_jit", key),
-                    lambda fn=one: jax.jit(jax.vmap(fn)),
-                )
+                    def one(p, x, module=spec.module, lb=lb, la=la):
+                        starts = jnp.arange(
+                            x.shape[0] - lb + 1 - la, dtype=jnp.int32
+                        )
+                        rows = starts[:, None] + jnp.arange(lb, dtype=jnp.int32)
+                        out = module.apply(p, x[rows].astype(jnp.bfloat16))[0]
+                        return out.astype(jnp.float32)
+
+                else:
+
+                    def one(p, x, module=spec.module, lb=lb, la=la):
+                        starts = jnp.arange(
+                            x.shape[0] - lb + 1 - la, dtype=jnp.int32
+                        )
+                        rows = starts[:, None] + jnp.arange(lb, dtype=jnp.int32)
+                        return module.apply(p, x[rows])[0]
+
+                fn = one
+            elif precision == "bf16":
+
+                def fn(p, x, module=spec.module):
+                    return module.apply(p, x.astype(jnp.bfloat16))[0].astype(
+                        jnp.float32
+                    )
+
             else:
-                apply_fn = self._cache.get_or_build(
-                    ("scorer_jit", key),
-                    lambda module=spec.module: jax.jit(
-                        jax.vmap(lambda p, x: module.apply(p, x)[0])
-                    ),
+
+                def fn(p, x, module=spec.module):
+                    return module.apply(p, x)[0]
+
+            # the handle key is the RAW group key (repr unstripped):
+            # within a process, two modules share a handle only if
+            # they'd have grouped together anyway — the stripped
+            # fn_digest is for CROSS-process AOT identity only
+            apply_fn = self._cache.get_or_build(
+                ("scorer_jit", key),
+                lambda fn=fn: jax.jit(jax.vmap(fn)),
+            )
+            # donating twin for the TRACED dispatch path only: the batch
+            # argument is always a buffer the caller never reads again
+            # (fresh jnp.asarray / stack / scatter result), so XLA may
+            # reuse its memory for the output. AOT exports lower from the
+            # NON-donating handle — a serialized executable must be
+            # replayable after an execute failure, and donation on a
+            # failed exe would leave the fallback reading a dead buffer.
+            apply_donate = (
+                self._cache.get_or_build(
+                    ("scorer_jit_donate", key),
+                    lambda fn=fn: jax.jit(jax.vmap(fn), donate_argnums=(1,)),
                 )
+                if donate
+                else None
+            )
             self._groups.append(
                 {
                     "names": names,
                     "params": stacked,
                     "apply": apply_fn,
+                    "apply_donate": apply_donate,
+                    "precision": precision,
                     "fn_digest": fn_digest,
                     "params_digest": _params_digest(stacked),
                     "aot_ok": True,
@@ -417,14 +477,24 @@ class FleetScorer:
     def _aot_key(self, group: dict, m: int, rows: int) -> Dict[str, Any]:
         """The cross-process shape key one compiled dispatch is stored
         under: program identity (function + per-machine param structure)
-        plus this dispatch's exact (machine-axis, row-bucket) shape."""
-        return {
+        plus this dispatch's exact (machine-axis, row-bucket) shape.
+
+        Non-default precision is an explicit manifest field (on top of
+        already splitting both digests): an executable compiled at one
+        precision must never be served for another, and the store's
+        manifest should say so in the open rather than only via opaque
+        hashes. float32 keys are byte-identical to every pre-precision
+        store, so existing AOT caches keep hitting."""
+        key = {
             "kind": "fleet_scorer",
             "fn": group["fn_digest"],
             "params": group["params_digest"],
             "m": int(m),
             "rows": int(rows),
         }
+        if group.get("precision", "float32") != "float32":
+            key["precision"] = group["precision"]
+        return key
 
     def _dispatch(
         self, group: dict, params: Any, batch, m: int, rows: int
@@ -457,7 +527,12 @@ class FleetScorer:
                 self._cache.discard_aot(
                     self._aot_key(group, m, rows), reason="execute_error"
                 )
-        return group["apply"](params, jnp.asarray(batch))
+        # traced path: prefer the donating twin when GORDO_DONATE opted
+        # in — the batch buffer is dispatch-local, so XLA may reuse it
+        # for the output. Safe after an exe failure too: stored
+        # executables never donate, so the batch is still live here.
+        apply_fn = group.get("apply_donate") or group["apply"]
+        return apply_fn(params, jnp.asarray(batch))
 
     def _predict_entries(
         self, group: dict, entries: List[Tuple[int, str, np.ndarray]]

@@ -31,6 +31,7 @@ import uuid
 import numpy as np
 
 from gordo_tpu.observability import emit_event, get_registry
+from gordo_tpu.parallel import transfer
 from gordo_tpu.programs import evict_lru
 from gordo_tpu.programs.cache import hbm_headroom, min_headroom_fraction
 from gordo_tpu.streaming.window import MachineWindow, SequenceGap, WindowUpdate
@@ -314,6 +315,20 @@ class StreamSession:
 
             outputs: typing.Dict[str, np.ndarray] = {}
             if inputs:
+                # GORDO_PREFETCH_DEPTH > 0: issue every machine's
+                # new-rows transfer before entering the (possibly
+                # queued/coalesced) dispatch, so the copies ride under
+                # batcher wait instead of the dispatch critical path.
+                # Depth 0 keeps the historical transfer-at-dispatch
+                # behavior exactly.
+                if transfer.env_prefetch_depth() > 0:
+                    for update in inputs.values():
+                        update.prefetch()
+                    transfer.count_transfer(
+                        "stream", "prefetched", n=len(inputs)
+                    )
+                else:
+                    transfer.count_transfer("stream", "direct", n=len(inputs))
                 try:
                     outputs = dispatch(inputs)
                 except Exception:

@@ -98,6 +98,17 @@ class WindowUpdate:
                 self._device = jnp.concatenate([self.context, new_dev])
         return self._device
 
+    def prefetch(self) -> "WindowUpdate":
+        """Issue the new-rows transfer NOW instead of at dispatch time
+        (the ``prefetch_depth`` knob's streaming arm): JAX transfers are
+        asynchronous, so a session that prefetches every machine's
+        update before entering the batcher overlaps those copies with
+        queue wait and the preceding dispatch. ``materialize()`` at
+        dispatch finds the cached device array — same bits, same single
+        transfer, earlier issue point."""
+        self.materialize()
+        return self
+
 
 class MachineWindow:
     """

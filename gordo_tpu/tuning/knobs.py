@@ -279,6 +279,86 @@ KNOBS: typing.Tuple[Knob, ...] = (
         doc="Per-machine cap on one data fetch, seconds (unset waits "
         "forever)",
     ),
+    Knob(
+        name="precision",
+        flag="--precision",
+        cli="build-fleet",
+        env_var="GORDO_PRECISION",
+        default="float32",
+        subsystem="builder",
+        domain=Choice(("float32", "bf16", "auto")),
+        doc="Per-machine inference precision: auto calibrates each "
+        "machine against the MAE-parity tolerance and falls back to "
+        "float32 where bf16 breaches it",
+        data_keys=("precision",),
+        signals=(
+            Signal(
+                "steady_state_sensor_timesteps_per_s",
+                "max",
+                ("steady_state_sensor_timesteps_per_s",),
+            ),
+            _P99,
+            Signal(
+                "worst_machine_mae_delta",
+                "min",
+                ("worst_machine_mae_delta", "max_mae_delta"),
+            ),
+        ),
+        tunable=True,
+    ),
+    Knob(
+        name="precision_tolerance",
+        flag="--precision-tolerance",
+        cli="build-fleet",
+        env_var="GORDO_PRECISION_TOLERANCE",
+        default=0.25,
+        subsystem="builder",
+        domain=FloatRange(0.0, 10.0),
+        doc="Relative per-machine MAE-parity bound a bf16 calibration "
+        "must stay within, else the machine serves float32",
+    ),
+    Knob(
+        name="prefetch_depth",
+        flag="--prefetch-depth",
+        cli="build-fleet",
+        env_var="GORDO_PREFETCH_DEPTH",
+        default=0,
+        subsystem="builder",
+        domain=IntRange(0, 8),
+        doc="Host->device transfers kept in flight ahead of the "
+        "consuming dispatch (builder data path, chunked fit, stream "
+        "updates); 0 = transfer on the critical path, bit-identical",
+        data_keys=("prefetch_depth",),
+        signals=(
+            Signal(
+                "transfer_overlap_ratio",
+                "max",
+                ("transfer_overlap_ratio",),
+            ),
+            Signal(
+                "steady_state_sensor_timesteps_per_s",
+                "max",
+                ("steady_state_sensor_timesteps_per_s",),
+            ),
+        ),
+        tunable=True,
+    ),
+    Knob(
+        name="donate",
+        flag="",
+        cli="",
+        env_var="GORDO_DONATE",
+        default=False,
+        subsystem="server",
+        domain=BOOL,
+        doc="Donate the serving dispatch's stacked input batch so XLA "
+        "reuses its memory for the output; off by default — the alias "
+        "annotation alone shifts fusion (~1-2 ulp measured on CPU) and "
+        "the default serving path is pinned bit-identical",
+        data_keys=("donate",),
+        signals=(_P99, _GOODPUT),
+        tunable=True,
+    ),
     # -- serving -----------------------------------------------------------
     Knob(
         name="batch_wait_ms",

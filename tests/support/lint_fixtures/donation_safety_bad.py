@@ -1,0 +1,32 @@
+"""POSITIVE fixture for donation-safety: buffers read again after being
+passed at a donated argnum — XLA may already have reused their memory
+(CPU declines donation, so these only fail on accelerators)."""
+
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+
+def loss_fn(params, x):
+    return ((params * x) ** 2).sum()
+
+
+step = jax.jit(lambda p, g: p - 0.1 * g, donate_argnums=(0,))
+
+
+def train_read_after_donate(params, grads):
+    new_params = step(params, grads)  # params' buffer donated here
+    drift = jnp.abs(params - new_params).max()  # use-after-donate
+    return new_params, drift
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def fused_update(params, opt_state, grads):
+    return params - opt_state * grads, opt_state
+
+
+def train_keeps_old_state(params, opt_state, grads):
+    new_params, new_state = fused_update(params, opt_state, grads)
+    # opt_state was donated at argnum 1 but is read again below
+    momentum = opt_state * 0.9  # use-after-donate
+    return new_params, new_state, momentum

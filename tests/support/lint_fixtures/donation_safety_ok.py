@@ -1,0 +1,40 @@
+"""NEGATIVE (near-miss) fixture for donation-safety: the canonical
+donation shapes the check must accept — rebinding the name from the
+call's own result, passing fresh temporaries, starred calls (positions
+invisible), reads before the donating call, and non-donating jits."""
+
+import jax
+import jax.numpy as jnp
+
+step = jax.jit(lambda p, g: p - 0.1 * g, donate_argnums=(0,))
+plain = jax.jit(lambda p, g: p - 0.1 * g)
+
+
+def train_rebinds(params, grads, epochs):
+    for _ in range(epochs):
+        # the canonical consume-and-replace: the call's own statement
+        # rebinds the donated name, so every later read sees the result
+        params = step(params, grads)
+    return params
+
+
+def train_fresh_temporary(params, grads):
+    out = step(params * 1.0, grads)  # donated arg is a fresh expression
+    return out, params  # params itself was never donated
+
+
+def train_starred(params, grads):
+    args = (params, grads)
+    out = step(*args)  # positions invisible through *args: not tracked
+    return out, params
+
+
+def train_reads_before(params, grads):
+    norm = jnp.abs(params).max()  # read BEFORE the donating call
+    params = step(params, grads)
+    return params, norm
+
+
+def train_non_donating(params, grads):
+    out = plain(params, grads)
+    return out + params  # plain jit: nothing was donated

@@ -16,6 +16,7 @@ from pathlib import Path
 import pytest
 
 from gordo_tpu.analysis import (
+    check_donation_safety,
     check_host_sync,
     check_knob_discipline,
     check_prng_key_reuse,
@@ -39,6 +40,7 @@ _CHECKS = {
     "prng-reuse": check_prng_key_reuse,
     "prng-split-width": check_prng_split_width,
     "traced-branch": check_traced_branching,
+    "donation-safety": check_donation_safety,
     "span-discipline": check_span_discipline,
     "knob-discipline": check_knob_discipline,
 }
@@ -49,6 +51,7 @@ _FIXTURE_STEMS = {
     "prng-reuse": "prng_reuse",
     "prng-split-width": "prng_split_width",
     "traced-branch": "traced_branch",
+    "donation-safety": "donation_safety",
     "span-discipline": "span_discipline",
     "knob-discipline": "knob_discipline",
 }

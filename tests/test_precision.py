@@ -1,0 +1,523 @@
+"""
+Per-machine mixed precision, buffer donation, and pipelined
+host->device transfer (docs/performance.md "Mixed precision, buffer
+donation, and transfer pipelining"): the float32 default is a strict
+bit-identical no-op that runs NO calibration pass, auto-calibration
+keeps every bf16 machine inside the documented MAE tolerance, the
+``precision:degrade`` chaos seam forces a fallback machine that splits
+serving groups and serves float32-build-identical outputs, decisions
+persist through build_report.json / ``--resume`` / multi-worker
+ledgers, and the transfer/donation helpers pin their depth-0 /
+donate-off defaults bit-identical.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gordo_tpu.builder import FleetModelBuilder
+from gordo_tpu.builder import ledger as ledger_mod
+from gordo_tpu.builder.fleet_build import _find_jax_estimator
+from gordo_tpu.builder.ledger import Ledger, plan_units
+from gordo_tpu.machine import Machine
+from gordo_tpu.observability import get_registry, read_events
+from gordo_tpu.parallel import transfer
+from gordo_tpu.parallel.precision import (
+    DEFAULT_PRECISION_TOLERANCE,
+    cast_params,
+    mae,
+    mae_parity,
+    resolve_precision,
+)
+from gordo_tpu.robustness import faults
+from gordo_tpu.server.fleet_serving import FleetScorer, _group_key
+from gordo_tpu.streaming.window import WindowUpdate
+
+
+@pytest.fixture(autouse=True)
+def _fresh_env(monkeypatch):
+    """Chaos and transfer knobs must never leak between tests — each
+    test opts into its own env."""
+    monkeypatch.delenv(faults.FAULT_INJECT_ENV_VAR, raising=False)
+    monkeypatch.delenv("GORDO_DONATE", raising=False)
+    monkeypatch.delenv("GORDO_PREFETCH_DEPTH", raising=False)
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def make_machine(name, ntags=3, epochs=2):
+    return Machine(
+        name=name,
+        project_name="precision-test",
+        model={
+            "gordo_tpu.models.AutoEncoder": {
+                "kind": "feedforward_hourglass",
+                "epochs": epochs,
+            }
+        },
+        dataset={
+            "type": "RandomDataset",
+            "train_start_date": "2017-12-25 06:00:00Z",
+            "train_end_date": "2017-12-27 06:00:00Z",
+            "tags": [[f"Tag {t}", None] for t in range(ntags)],
+        },
+    )
+
+
+def machine_data(machine):
+    from gordo_tpu.data import _get_dataset
+
+    X, y = _get_dataset(machine.dataset.to_dict()).get_data()
+    return np.asarray(X, dtype="float32"), np.asarray(y, dtype="float32")
+
+
+def ests_of(pairs):
+    return {m.name: _find_jax_estimator(model) for model, m in pairs}
+
+
+# -- the precision vocabulary ---------------------------------------------
+
+
+def test_resolve_precision_vocabulary():
+    assert resolve_precision(None) == "float32"
+    assert resolve_precision("Float32") == "float32"
+    assert resolve_precision("bf16") == "bf16"
+    assert resolve_precision(" auto ") == "auto"
+    with pytest.raises(ValueError, match="unknown precision"):
+        resolve_precision("fp8")
+
+
+def test_mae_parity_is_relative_and_zero_safe():
+    delta, within = mae_parity(1.0, 1.1, 0.25)
+    assert delta == pytest.approx(0.1)
+    assert within
+    _, within = mae_parity(1.0, 2.0, 0.25)
+    assert not within
+    # exactly-zero float32 MAE must not divide by zero
+    delta, _ = mae_parity(0.0, 0.0, 0.25)
+    assert delta == 0.0
+
+
+def test_cast_params_narrows_floats_and_spares_ints():
+    import jax.numpy as jnp
+
+    tree = {"w": np.ones((2, 2), dtype=np.float32), "step": np.int32(7)}
+    cast = cast_params(tree, jnp.bfloat16)
+    assert cast["w"].dtype == jnp.bfloat16
+    assert cast["step"].dtype == jnp.int32
+
+
+# -- the float32 default: strict no-op, no calibration pass ---------------
+
+
+def test_default_build_is_bit_identical_and_skips_calibration(
+    tmp_path, monkeypatch
+):
+    """--precision float32 (the default) must be indistinguishable from
+    a build predating the precision axis: same params bit for bit, no
+    calibration pass (no precision_calibrated event, no decisions, no
+    est.precision_ stamp), and a digest-silent serving group key."""
+    event_log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("GORDO_TPU_EVENT_LOG", str(event_log))
+
+    default_builder = FleetModelBuilder(
+        [make_machine("m-0"), make_machine("m-1")]
+    )
+    default_pairs = default_builder.build()
+    explicit_builder = FleetModelBuilder(
+        [make_machine("m-0"), make_machine("m-1")], precision="float32"
+    )
+    explicit_pairs = explicit_builder.build()
+
+    import jax
+
+    for (d_model, _), (e_model, _) in zip(default_pairs, explicit_pairs):
+        d_est = _find_jax_estimator(d_model)
+        e_est = _find_jax_estimator(e_model)
+        assert d_est.history_ == e_est.history_
+        for dl, el in zip(
+            jax.tree_util.tree_leaves(d_est.params_),
+            jax.tree_util.tree_leaves(e_est.params_),
+        ):
+            np.testing.assert_array_equal(np.asarray(dl), np.asarray(el))
+        # no calibration pass ran: no decision stamp on the artifact
+        assert not hasattr(e_est, "precision_")
+        # and the serving group key has no precision element (digest
+        # silence: float32 keys are byte-identical to pre-precision
+        # builds)
+        assert not any(
+            str(part).startswith("precision=") for part in _group_key(e_est)
+        )
+
+    assert default_builder.precision_decisions_ == {}
+    assert explicit_builder.precision_decisions_ == {}
+    report = explicit_builder.build_report_
+    assert report["precision"]["mode"] == "float32"
+    assert report["precision"]["machines"] == {}
+    events = [r["event"] for r in read_events(str(event_log))]
+    assert "precision_calibrated" not in events
+
+
+# -- auto calibration: every machine within tolerance or float32 ----------
+
+
+def test_auto_calibration_every_machine_within_tolerance_or_float32(
+    tmp_path, monkeypatch
+):
+    event_log = tmp_path / "events.jsonl"
+    monkeypatch.setenv("GORDO_TPU_EVENT_LOG", str(event_log))
+    machines = [
+        make_machine("a-0", ntags=3),
+        make_machine("a-1", ntags=4),
+        make_machine("a-2", ntags=3),
+    ]
+    builder = FleetModelBuilder(machines, precision="auto")
+    pairs = builder.build()
+
+    assert set(builder.precision_decisions_) == {"a-0", "a-1", "a-2"}
+    for name, est in ests_of(pairs).items():
+        rec = builder.precision_decisions_[name]
+        assert rec["precision"] in ("bf16", "float32")
+        assert not rec["forced"]
+        # the auto contract: a machine serves bf16 ONLY if its measured
+        # MAE delta cleared the tolerance
+        assert (
+            rec["precision"] == "float32"
+            or rec["mae_delta"] <= builder.precision_tolerance
+        )
+        assert est.precision_ == rec["precision"]
+        assert est.precision_mae_delta_ == pytest.approx(rec["mae_delta"])
+
+    report = builder.build_report_
+    assert report["precision"]["mode"] == "auto"
+    assert report["precision"]["tolerance"] == DEFAULT_PRECISION_TOLERANCE
+    assert set(report["precision"]["machines"]) == {"a-0", "a-1", "a-2"}
+    calibrated = [
+        r for r in read_events(str(event_log))
+        if r["event"] == "precision_calibrated"
+    ]
+    assert calibrated
+    assert calibrated[0]["mode"] == "auto"
+
+
+def test_bf16_serving_outputs_stay_float32_and_hold_mae_parity():
+    """A bf16 build serves float32 payloads (outputs upcast in-program)
+    whose per-machine MAE delta vs the float32 build stays inside the
+    calibration tolerance — the acceptance bound, asserted per
+    machine."""
+    machines = [make_machine("b-0"), make_machine("b-1")]
+    bf16_builder = FleetModelBuilder(machines, precision="bf16")
+    bf16_ests = ests_of(bf16_builder.build())
+    f32_ests = ests_of(
+        FleetModelBuilder(
+            [make_machine("b-0"), make_machine("b-1")]
+        ).build()
+    )
+
+    bf16_scorer = FleetScorer(bf16_ests)
+    f32_scorer = FleetScorer(f32_ests)
+    # same architecture + same precision: still ONE fused group
+    assert bf16_scorer.n_groups == 1
+
+    data = {name: machine_data(make_machine(name)) for name in bf16_ests}
+    inputs = {name: X for name, (X, _) in data.items()}
+    out16 = bf16_scorer.predict(inputs)
+    out32 = f32_scorer.predict(inputs)
+    for name in bf16_ests:
+        assert out16[name].dtype == np.float32
+        _, y = data[name]
+        y_tail = y[-len(out16[name]):]
+        delta, within = mae_parity(
+            mae(out32[name], y_tail),
+            mae(out16[name], y_tail),
+            bf16_builder.precision_tolerance,
+        )
+        assert within, (name, delta)
+
+
+# -- the chaos fallback: forced float32 splits groups ---------------------
+
+
+def test_chaos_degrade_forces_fallback_and_splits_serving_groups(
+    monkeypatch,
+):
+    monkeypatch.setenv(faults.FAULT_INJECT_ENV_VAR, "precision:degrade:c-1")
+    faults.reset()
+    machines = [make_machine("c-0"), make_machine("c-1")]
+    chaos_builder = FleetModelBuilder(machines, precision="bf16")
+    chaos_ests = ests_of(chaos_builder.build())
+
+    assert chaos_builder.precision_decisions_["c-0"] == {
+        "precision": "bf16",
+        "mae_delta": pytest.approx(
+            chaos_builder.precision_decisions_["c-0"]["mae_delta"]
+        ),
+        "forced": False,
+    }
+    fallback = chaos_builder.precision_decisions_["c-1"]
+    assert fallback["precision"] == "float32"
+    assert fallback["forced"] is True
+
+    # one architecture, two precisions: the scorer must NOT fuse them
+    scorer = FleetScorer(chaos_ests)
+    assert scorer.n_groups == 2
+    assert {g["precision"] for g in scorer._groups} == {"bf16", "float32"}
+
+    # the fallback machine's training was float32 all along, so its
+    # artifact — and its served output — must match a pure-float32
+    # build bit for bit
+    monkeypatch.delenv(faults.FAULT_INJECT_ENV_VAR)
+    faults.reset()
+    f32_ests = ests_of(
+        FleetModelBuilder(
+            [make_machine("c-0"), make_machine("c-1")]
+        ).build()
+    )
+    import jax
+
+    for cl, fl in zip(
+        jax.tree_util.tree_leaves(chaos_ests["c-1"].params_),
+        jax.tree_util.tree_leaves(f32_ests["c-1"].params_),
+    ):
+        np.testing.assert_array_equal(np.asarray(cl), np.asarray(fl))
+    X, _ = machine_data(make_machine("c-1"))
+    chaos_out = scorer.predict({"c-1": X})["c-1"]
+    f32_out = FleetScorer({"c-1": f32_ests["c-1"]}).predict({"c-1": X})[
+        "c-1"
+    ]
+    assert chaos_out.dtype == np.float32
+    np.testing.assert_array_equal(chaos_out, f32_out)
+
+
+# -- persistence: build_report.json, --resume, multi-worker ledgers -------
+
+
+def test_decisions_persist_to_report_and_survive_resume(tmp_path):
+    machines = [make_machine("r-0", epochs=1), make_machine("r-1", epochs=1)]
+    builder = FleetModelBuilder(machines, precision="auto")
+    builder.build(output_dir_base=tmp_path)
+    report = json.loads((tmp_path / "build_report.json").read_text())
+    assert report["precision"]["mode"] == "auto"
+    first = {
+        name: rec["precision"]
+        for name, rec in report["precision"]["machines"].items()
+    }
+    assert set(first) == {"r-0", "r-1"}
+
+    # a --resume rebuild reuses the artifacts and must still name every
+    # machine's decision (read back off the pickled est.precision_)
+    resumed_builder = FleetModelBuilder(
+        [make_machine("r-0", epochs=1), make_machine("r-1", epochs=1)],
+        precision="auto",
+    )
+    resumed_builder.build(output_dir_base=tmp_path, resume=True)
+    for name, rec in resumed_builder.precision_decisions_.items():
+        assert rec["resumed"] is True
+        assert rec["precision"] == first[name]
+    report2 = json.loads((tmp_path / "build_report.json").read_text())
+    assert {
+        name: rec["precision"]
+        for name, rec in report2["precision"]["machines"].items()
+    } == first
+
+
+def test_ledger_plan_refuses_precision_mismatch(tmp_path):
+    """Every worker of one build must compile at one precision — a
+    mismatched joiner is refused exactly like a bucket-policy
+    mismatch."""
+    machines = [make_machine("l-0", epochs=1), make_machine("l-1", epochs=1)]
+    first = Ledger(tmp_path, "w0")
+    first.ensure_plan(
+        plan_units(machines), bucket_policy="exact", precision="bf16"
+    )
+    second = Ledger(tmp_path, "w1")
+    with pytest.raises(
+        ledger_mod.LedgerPlanMismatch, match="--precision bf16"
+    ):
+        second.ensure_plan(
+            plan_units(machines), bucket_policy="exact", precision="float32"
+        )
+    # the same precision still joins fine
+    second.ensure_plan(
+        plan_units(machines), bucket_policy="exact", precision="bf16"
+    )
+
+
+def test_multiworker_report_carries_precision(tmp_path):
+    machines = [make_machine("w-0", epochs=1), make_machine("w-1", epochs=1)]
+    report = ledger_mod.run_worker(
+        FleetModelBuilder(machines, precision="bf16"),
+        tmp_path,
+        0,
+        lease_ttl=5.0,
+    )
+    assert report["n_built"] == 2
+    assert report["precision"]["mode"] == "bf16"
+    # same report shape as a single-worker build (the 2-worker
+    # acceptance pins whole-report equality)
+    assert report["precision"]["tolerance"] == DEFAULT_PRECISION_TOLERANCE
+    recs = report["precision"]["machines"]
+    assert set(recs) == {"w-0", "w-1"}
+    assert all(r["precision"] in ("bf16", "float32") for r in recs.values())
+
+
+# -- transfer helpers: env parsing, depth-0 bit-identity, pipelining ------
+
+
+def test_env_prefetch_depth_parsing(monkeypatch):
+    assert transfer.env_prefetch_depth() == 0
+    assert transfer.env_prefetch_depth(default=2) == 2
+    monkeypatch.setenv("GORDO_PREFETCH_DEPTH", "")
+    assert transfer.env_prefetch_depth() == 0
+    monkeypatch.setenv("GORDO_PREFETCH_DEPTH", "3")
+    assert transfer.env_prefetch_depth() == 3
+    monkeypatch.setenv("GORDO_PREFETCH_DEPTH", "junk")
+    assert transfer.env_prefetch_depth(default=1) == 1
+    monkeypatch.setenv("GORDO_PREFETCH_DEPTH", "99")
+    assert transfer.env_prefetch_depth() == transfer.MAX_PREFETCH_DEPTH
+    monkeypatch.setenv("GORDO_PREFETCH_DEPTH", "-2")
+    assert transfer.env_prefetch_depth() == 0
+
+
+def test_env_donate_parsing(monkeypatch):
+    # the serving default is OFF: the alias annotation alone shifts XLA
+    # fusion (~ulp drift), and the default path is pinned bit-identical
+    assert transfer.env_donate() is False
+    assert transfer.env_donate(default=True) is True
+    for off in ("0", "false", "No", " off "):
+        monkeypatch.setenv("GORDO_DONATE", off)
+        assert transfer.env_donate() is False
+    monkeypatch.setenv("GORDO_DONATE", "1")
+    assert transfer.env_donate() is True
+
+
+def test_device_put_sliced_bit_identical_and_counted():
+    rows = np.random.default_rng(3).normal(size=(37, 5)).astype(np.float32)
+    counter = get_registry().counter(
+        "gordo_transfer_chunks_total", labelnames=("plane", "mode")
+    )
+    direct_before = counter.value(plane="build", mode="direct")
+    prefetched_before = counter.value(plane="build", mode="prefetched")
+
+    plain = transfer.device_put_sliced(rows, depth=0)
+    sliced = transfer.device_put_sliced(rows, depth=3)
+    np.testing.assert_array_equal(np.asarray(plain), rows)
+    np.testing.assert_array_equal(np.asarray(sliced), np.asarray(plain))
+
+    assert counter.value(plane="build", mode="direct") == direct_before + 1
+    # depth 3 pipelines the transfer as depth + 1 slices
+    assert (
+        counter.value(plane="build", mode="prefetched")
+        == prefetched_before + 4
+    )
+    # degenerate shapes fall back to the direct path
+    scalar = transfer.device_put_sliced(np.float32(1.5), depth=3)
+    assert float(scalar) == 1.5
+
+
+def test_prefetch_iter_preserves_order_and_runs_ahead():
+    items = [np.full((2,), i, dtype=np.float32) for i in range(6)]
+    issued = []
+
+    def put(arr):
+        issued.append(int(arr[0]))
+        return arr * 2
+
+    # depth 0: a plain map, transfer k issued only when k is consumed
+    out = list(transfer.prefetch_iter(items, depth=0, put=put))
+    assert issued == list(range(6))
+    np.testing.assert_array_equal(np.stack(out), np.stack(items) * 2)
+
+    # depth 2: by the time the consumer holds item 0, items 1 and 2
+    # (and the +1 primed slot) are already in flight
+    issued.clear()
+    it = transfer.prefetch_iter(items, depth=2, put=put)
+    first = next(it)
+    assert issued[: 4] == [0, 1, 2, 3]
+    rest = [first] + list(it)
+    np.testing.assert_array_equal(np.stack(rest), np.stack(items) * 2)
+
+
+def test_count_transfer_ignores_non_positive():
+    counter = get_registry().counter(
+        "gordo_transfer_chunks_total", labelnames=("plane", "mode")
+    )
+    before = counter.value(plane="train", mode="direct")
+    transfer.count_transfer("train", "direct", n=0)
+    transfer.count_transfer("train", "direct", n=-3)
+    assert counter.value(plane="train", mode="direct") == before
+
+
+def test_from_ragged_prefetch_is_bit_identical():
+    from gordo_tpu.parallel.fleet import StackedData
+
+    rng = np.random.default_rng(11)
+    Xs = [rng.normal(size=(n, 4)).astype(np.float32) for n in (30, 50)]
+    ys = [x.copy() for x in Xs]
+    plain = StackedData.from_ragged([x.copy() for x in Xs], [y.copy() for y in ys])
+    piped = StackedData.from_ragged(Xs, ys, prefetch_depth=2)
+    np.testing.assert_array_equal(np.asarray(plain.X), np.asarray(piped.X))
+    np.testing.assert_array_equal(np.asarray(plain.y), np.asarray(piped.y))
+    np.testing.assert_array_equal(
+        np.asarray(plain.sample_weight), np.asarray(piped.sample_weight)
+    )
+
+
+def test_window_prefetch_caches_the_single_transfer():
+    import jax.numpy as jnp
+
+    rows = np.arange(12, dtype=np.float32).reshape(4, 3)
+    update = WindowUpdate(None, rows)
+    assert update._device is None
+    assert update.prefetch() is update
+    prefetched = update._device
+    assert prefetched is not None
+    # materialize at dispatch time reuses the SAME device array — one
+    # transfer, earlier issue point
+    assert update.materialize() is prefetched
+    np.testing.assert_array_equal(np.asarray(prefetched), rows)
+
+    context = jnp.asarray(rows[:2] * 10)
+    with_ctx = WindowUpdate(context, rows).prefetch()
+    np.testing.assert_array_equal(
+        np.asarray(with_ctx.materialize()),
+        np.concatenate([rows[:2] * 10, rows]),
+    )
+
+
+# -- serving donation: opt-in, pinned bit-identical when off --------------
+
+
+def test_serving_donation_is_opt_in(monkeypatch):
+    """GORDO_DONATE unset: no donating twin is built and repeated
+    scorers are bit-identical (the pinned default). GORDO_DONATE=1: the
+    twin exists and its outputs agree to the documented ulp-level
+    drift — the alias annotation alone shifts XLA fusion on CPU."""
+    ests = ests_of(
+        FleetModelBuilder(
+            [make_machine("d-0", epochs=1), make_machine("d-1", epochs=1)]
+        ).build()
+    )
+    inputs = {
+        name: machine_data(make_machine(name))[0] for name in ests
+    }
+
+    off_scorer = FleetScorer(ests)
+    assert all(g["apply_donate"] is None for g in off_scorer._groups)
+    off_out = off_scorer.predict(inputs)
+    again = FleetScorer(ests).predict(inputs)
+    for name in ests:
+        np.testing.assert_array_equal(off_out[name], again[name])
+
+    monkeypatch.setenv("GORDO_DONATE", "1")
+    on_scorer = FleetScorer(ests)
+    assert all(g["apply_donate"] is not None for g in on_scorer._groups)
+    on_out = on_scorer.predict(inputs)
+    for name in ests:
+        assert on_out[name].dtype == np.float32
+        assert on_out[name].shape == off_out[name].shape
+        np.testing.assert_allclose(
+            on_out[name], off_out[name], rtol=1e-4, atol=1e-5
+        )

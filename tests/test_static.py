@@ -810,6 +810,7 @@ _LINT_ROOT = Path(gordo_tpu.__file__).parent.parent
         "prng-reuse",
         "prng-split-width",
         "traced-branch",
+        "donation-safety",
         "span-discipline",
         "knob-discipline",
     ],
