@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming bench-precision build-multiworker images push
+.PHONY: all test lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming bench-precision bench-slo build-multiworker images push
 
 all: lint test
 
@@ -73,6 +73,18 @@ bench-precision:
 		--sequential-sample 2 --epoch-chunk-sweep "" \
 		--precision-sweep float32,bf16 --prefetch-sweep 0,2 \
 		--donation-arms > benchmarks/results_precision_cpu_r15.json
+
+# SLO-gated serving bench (docs/observability.md "Plane rollup and
+# control signals"): the open-loop load test evaluated against the
+# example error-budget spec — the result JSON (and trajectory.json via
+# bench-summary) carries pass/fail + per-objective burn rates, and the
+# target's exit code is the gate
+bench-slo:
+	python benchmarks/load_test.py --self-serve --open-loop --fleet 6 \
+		--rps 4 --duration 15 --slo examples/slo_serving.yaml \
+		--output benchmarks/results_load_test_slo_cpu_r16.json
+	python benchmarks/consolidate.py
+	python -c "import json,sys; slo=json.load(open('benchmarks/results_load_test_slo_cpu_r16.json')).get('slo') or {}; print('SLO', slo.get('spec'), 'ok' if slo.get('ok') else 'BUDGET EXHAUSTED', 'max_burn=%.2fx' % (slo.get('max_burn_rate') or 0)); sys.exit(0 if slo.get('ok') else 1)"
 
 # 2-worker crash-tolerant ledger build of the example fleet config
 # (docs/robustness.md "Multi-worker builds") — the smoke proof that N

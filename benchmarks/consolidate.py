@@ -141,6 +141,16 @@ def consolidate(directory: Path) -> dict:
             }
             if knobs:
                 entry.update(knobs)
+            # benches run with --slo stamp an error-budget verdict; the
+            # trajectory keeps the pass/fail + worst burn rate so a
+            # regression shows up in ONE file (docs/observability.md)
+            slo = document.get("slo")
+            if isinstance(slo, dict) and "ok" in slo:
+                entry["slo"] = {
+                    "spec": slo.get("spec"),
+                    "ok": slo.get("ok"),
+                    "max_burn_rate": slo.get("max_burn_rate"),
+                }
         entries.append(entry)
     return {
         "trajectory_schema_version": TRAJECTORY_SCHEMA_VERSION,

@@ -374,6 +374,30 @@ def batching_registry_stats():
     return out
 
 
+def stamp_slo(out: dict, slo_path: str) -> None:
+    """
+    Evaluate the SLO spec at slo_path against this run's measured
+    signals and stamp the report into out["slo"]. The bench's own
+    numbers map onto the plane control signals (docs/observability.md):
+    p99_ms -> predict_p99_ms, shed_rate -> shed_rate, and the raw error
+    fraction -> unstructured_error_rate. Objectives over signals the
+    bench cannot measure evaluate with zero samples (never exhausted).
+    """
+    from gordo_tpu.observability.slo import evaluate_values, load_slo_spec
+
+    spec = load_slo_spec(slo_path)
+    attempts = (out.get("requests") or 0) + (out.get("errors") or 0)
+    signals = {
+        "predict_p99_ms": out.get("p99_ms"),
+        "shed_rate": out.get("shed_rate"),
+        "unstructured_error_rate": (
+            round((out.get("errors") or 0) / attempts, 4) if attempts else None
+        ),
+    }
+    report = evaluate_values(spec, signals)
+    out["slo"] = report.to_dict()
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--base-url", default=None)
@@ -487,6 +511,14 @@ def main():
         default=None,
         help="Also write the result JSON to this path.",
     )
+    parser.add_argument(
+        "--slo",
+        default=None,
+        help="SLO spec (YAML/JSON, docs/observability.md) evaluated "
+        "against this run's measured signals; the result JSON gains an "
+        "'slo' block with pass/fail + per-objective burn rates, and "
+        "consolidate folds it into trajectory.json.",
+    )
     args = parser.parse_args()
 
     import numpy as np
@@ -497,6 +529,8 @@ def main():
         if not args.fleet:
             parser.error("--replicas requires --fleet N")
         out = run_sharded_bench(args, tmp_ctx.name)
+        if args.slo:
+            stamp_slo(out, args.slo)
         payload = json.dumps(out, indent=2)
         print(payload)
         if args.output:
@@ -665,6 +699,8 @@ def main():
         out["machine_scores_per_s"] = round(
             args.fleet * len(latencies) / elapsed, 1
         )
+    if args.slo:
+        stamp_slo(out, args.slo)
     print(json.dumps(out))
     if args.output:
         with open(args.output, "w") as fh:

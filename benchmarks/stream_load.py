@@ -195,6 +195,14 @@ def main():
     parser.add_argument("--port", type=int, default=5613)
     parser.add_argument("--batch-wait-ms", type=float, default=5.0)
     parser.add_argument("--output", default=None)
+    parser.add_argument(
+        "--slo",
+        default=None,
+        help="SLO spec (YAML/JSON, docs/observability.md) evaluated "
+        "against the sweep's measured signals (worst arm p99, "
+        "aggregate resume/error rates); the result JSON gains an "
+        "'slo' block with pass/fail + per-objective burn rates.",
+    )
     args = parser.parse_args()
 
     sweep = [int(n) for n in str(args.streams).split(",") if n.strip()]
@@ -255,6 +263,34 @@ def main():
             "one_shot_window_p99_ms": min(one_shot),
             "speedup": round(min(one_shot) / max(min(per_update), 1e-9), 2),
         }
+    if args.slo:
+        # the sweep's worst numbers, so the gate holds at the highest
+        # concurrency tried — the plane signal names the spec uses are
+        # the same ones the rollup computes (docs/observability.md)
+        from gordo_tpu.observability.slo import evaluate_values, load_slo_spec
+
+        spec = load_slo_spec(args.slo)
+        arms = results["arms"]
+        updates = sum(a["updates_total"] for a in arms)
+        errors = sum(a["errors"] for a in arms)
+        reconnects = sum(a["reconnects"] for a in arms)
+        p99s = [
+            a["update_latency"]["p99_ms"]
+            for a in arms
+            if a.get("update_latency")
+        ]
+        signals = {
+            "predict_p99_ms": max(p99s) if p99s else None,
+            "stream_resume_rate": (
+                round(reconnects / updates, 4) if updates else None
+            ),
+            "unstructured_error_rate": (
+                round(errors / (updates + errors), 4)
+                if updates + errors
+                else None
+            ),
+        }
+        results["slo"] = evaluate_values(spec, signals).to_dict()
     print(json.dumps(results, indent=2))
     if args.output:
         with open(args.output, "w") as fh:

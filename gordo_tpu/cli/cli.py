@@ -34,6 +34,7 @@ from gordo_tpu.cli.custom_types import HostIP, key_value_par
 from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
 from gordo_tpu.cli.lifecycle import lifecycle_cli
 from gordo_tpu.cli.lint import lint_cli
+from gordo_tpu.cli.plane import rollup_cli, slo_cli, top_cli
 from gordo_tpu.cli.trace import trace_cli
 from gordo_tpu.cli.tune import tune_cli
 from gordo_tpu.cli.workflow_generator import workflow_cli
@@ -1216,6 +1217,35 @@ def run_server_cli(
     "fans out on its own worker pool).",
 )
 @click.option(
+    "--rollup-interval",
+    type=click.FloatRange(min=0),
+    default=0.0,
+    envvar="GORDO_ROLLUP_INTERVAL_S",
+    show_default=True,
+    help="Plane telemetry rollup: seconds between polls of every "
+    "replica's /telemetry/snapshot, merged into the router's /status "
+    "and /metrics. 0 keeps the strict no-op (no poller thread; /status "
+    "polls on demand).",
+)
+@click.option(
+    "--rollup-retention",
+    type=click.IntRange(min=1),
+    default=500,
+    envvar="GORDO_ROLLUP_RETENTION",
+    show_default=True,
+    help="Merged snapshots kept in the persisted rollup JSONL (oldest "
+    "trimmed).",
+)
+@click.option(
+    "--rollup-persist",
+    type=click.Path(dir_okay=False),
+    default=None,
+    envvar="GORDO_ROLLUP_PERSIST",
+    help="JSONL path periodic merged snapshots persist to (next to the "
+    "artifacts, so `gordo-tpu tune` ingests them as observations). "
+    "Unset disables persistence.",
+)
+@click.option(
     "--log-level",
     type=click.Choice(["debug", "info", "warning", "error", "critical"]),
     default="info",
@@ -1236,6 +1266,9 @@ def run_router_cli(
     max_inflight,
     threads,
     log_level,
+    rollup_interval,
+    rollup_retention,
+    rollup_persist,
 ):
     """
     Run the sharded-serving router (docs/serving.md "Sharded serving
@@ -1265,6 +1298,9 @@ def run_router_cli(
         "HEDGE_MS": hedge_ms,
         "REPLICA_TIMEOUT_S": replica_timeout,
         "MAX_INFLIGHT": max_inflight,
+        "ROLLUP_INTERVAL_S": rollup_interval,
+        "ROLLUP_RETENTION": rollup_retention,
+        "ROLLUP_PERSIST_PATH": rollup_persist,
     }
     run_router(host, port, log_level, config=config, threads=threads)
 
@@ -1283,6 +1319,9 @@ gordo.add_command(trace_cli)
 gordo.add_command(tune_cli)
 gordo.add_command(lint_cli)
 gordo.add_command(lifecycle_cli)
+gordo.add_command(slo_cli)
+gordo.add_command(top_cli)
+gordo.add_command(rollup_cli)
 
 if __name__ == "__main__":
     gordo()

@@ -1,0 +1,287 @@
+"""
+Plane-level observability commands (docs/observability.md "Plane
+rollup and control signals"):
+
+- ``gordo-tpu slo check <spec> <snapshot-or-url>`` — evaluate a
+  declarative SLO spec against merged snapshots (a JSONL history, one
+  snapshot file, or a live /status | /telemetry/snapshot URL); exits
+  nonzero on error-budget exhaustion. The gate benches and gameday
+  scenarios assert.
+- ``gordo-tpu top <url>`` — live terminal view over a plane /status
+  (curses-free redraw loop; ``--once --as-json`` for scripting).
+- ``gordo-tpu rollup`` — the standalone poller for router-less
+  deployments: polls member /telemetry/snapshot endpoints, merges, and
+  serves plane /metrics + /status (or prints once with ``--once``).
+"""
+
+import json
+import sys
+import time
+import typing
+
+import click
+
+
+def _fetch_json(url: str, timeout: float = 10.0) -> dict:
+    import requests
+
+    response = requests.get(url, timeout=timeout)
+    response.raise_for_status()
+    return response.json()
+
+
+def _load_snapshots(target: str) -> typing.List[dict]:
+    """Snapshots from TARGET: a URL (live /status or
+    /telemetry/snapshot), a merged-snapshot JSONL history, or one JSON
+    snapshot file."""
+    if target.startswith(("http://", "https://")):
+        return [_fetch_json(target)]
+    snapshots: typing.List[dict] = []
+    if target.endswith(".jsonl"):
+        with open(target) as fh:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                except ValueError:
+                    continue  # torn last line — a crashed writer
+                if isinstance(record, dict):
+                    snapshots.append(record)
+    else:
+        with open(target) as fh:
+            snapshots.append(json.load(fh))
+    return snapshots
+
+
+@click.group("slo")
+def slo_cli():
+    """Error budgets as executable objects: declarative SLO specs
+    evaluated against merged plane snapshots."""
+
+
+@slo_cli.command("check")
+@click.argument("spec_path", metavar="SPEC")
+@click.argument("target", metavar="SNAPSHOT_OR_URL")
+@click.option(
+    "--as-json",
+    is_flag=True,
+    help="Emit the full report object instead of the human table.",
+)
+def slo_check(spec_path: str, target: str, as_json: bool):
+    """
+    Evaluate the SLO SPEC (YAML/JSON) against SNAPSHOT_OR_URL — a
+    merged-snapshot JSONL history (windowed evaluation), a single
+    snapshot JSON file, or a live ``/status`` /
+    ``/telemetry/snapshot`` URL — and exit nonzero when any
+    objective's error budget is exhausted.
+    """
+    from gordo_tpu.observability import emit_event
+    from gordo_tpu.observability.slo import (
+        SloSpecError,
+        evaluate,
+        load_slo_spec,
+        render_report,
+    )
+
+    try:
+        spec = load_slo_spec(spec_path)
+    except (OSError, SloSpecError) as exc:
+        raise click.UsageError(f"Cannot load SLO spec {spec_path}: {exc}")
+    try:
+        snapshots = _load_snapshots(target)
+    except (OSError, ValueError) as exc:
+        raise click.UsageError(f"Cannot load snapshots from {target}: {exc}")
+    if not snapshots:
+        raise click.UsageError(f"No snapshots found in {target}")
+    report = evaluate(spec, snapshots)
+    if as_json:
+        click.echo(json.dumps(report.to_dict(), indent=2, default=str))
+    else:
+        click.echo(render_report(report))
+    if not report.ok:
+        for result in report.results:
+            if result.exhausted:
+                emit_event(
+                    "slo_budget_exhausted",
+                    spec=spec.name,
+                    objective=result.objective.label(),
+                    signal=result.objective.signal,
+                    burn_rate=result.burn_rate,
+                    violating_fraction=result.violating_fraction,
+                )
+        sys.exit(1)
+
+
+def _render_top(status: dict) -> str:
+    signals = status.get("signals") or {}
+    lines = [
+        "gordo-tpu plane  {ts}  (snapshot v{v})".format(
+            ts=status.get("ts", "?"), v=status.get("snapshot_version", "?")
+        ),
+        "",
+        "control signals:",
+    ]
+    # the four documented autoscaling signals first, then the rest
+    ordered = [
+        "shed_rate",
+        "queue_depth",
+        "stream_backlog",
+        "replicas_healthy",
+    ]
+    rest = sorted(k for k in signals if k not in ordered)
+    for key in ordered + rest:
+        value = signals.get(key)
+        rendered = "n/a" if value is None else f"{value:.4g}"
+        lines.append(f"  {key:<26} {rendered}")
+    lines.append("")
+    replicas = status.get("replicas") or {}
+    lines.append(f"replicas ({len(replicas)}):")
+    for rid in sorted(replicas):
+        info = replicas[rid]
+        health = info.get("health") or {}
+        lines.append(
+            "  {rid:<12} {status:<12} breaker={state:<10} "
+            "queue={q} sheds={s} streams={st} backlog={b}".format(
+                rid=rid,
+                status=info.get("status") or "?",
+                state=health.get("state", "?"),
+                q=info.get("queue_depth", "?"),
+                s=info.get("sheds_total", "?"),
+                st=info.get("stream_sessions", "?"),
+                b=info.get("stream_backlog", "?"),
+            )
+        )
+    lifecycle = status.get("lifecycle") or {}
+    for mid, info in sorted(lifecycle.items()):
+        tick = (info.get("status") or {}).get("last_tick_unix_ms")
+        lines.append(f"lifecycle {mid}: last tick unix_ms={tick}")
+    errors = status.get("merge_errors") or []
+    for err in errors:
+        lines.append(
+            "MERGE REFUSED {m}: {e}".format(
+                m=err.get("metric", "?"), e=err.get("error", "?")
+            )
+        )
+    return "\n".join(lines)
+
+
+@click.command("top")
+@click.argument("url", metavar="STATUS_URL")
+@click.option(
+    "--interval",
+    type=click.FloatRange(min=0.1),
+    default=2.0,
+    show_default=True,
+    help="Seconds between redraws.",
+)
+@click.option("--once", is_flag=True, help="Render one frame and exit.")
+@click.option(
+    "--as-json",
+    is_flag=True,
+    help="Emit the raw /status JSON instead of the rendered view "
+    "(implies --once unless combined with a redraw loop consumer).",
+)
+def top_cli(url: str, interval: float, once: bool, as_json: bool):
+    """
+    Live terminal view over a plane STATUS_URL (the router's or
+    ``gordo-tpu rollup``'s ``/status``): replicas with breaker state,
+    SLO-relevant control signals, and the documented autoscaling
+    signals. Plain full-screen redraw (no curses); ``--once
+    --as-json`` round-trips the exact numbers for scripting.
+    """
+    if not url.rstrip("/").endswith("/status"):
+        url = url.rstrip("/") + "/status"
+    while True:
+        status = _fetch_json(url)
+        if as_json:
+            click.echo(json.dumps(status, indent=2, default=str))
+        else:
+            if not once:
+                # ANSI clear + home: the curses-free redraw
+                click.echo("\x1b[2J\x1b[H", nl=False)
+            click.echo(_render_top(status))
+        if once or as_json:
+            return
+        time.sleep(interval)
+
+
+@click.command("rollup")
+@click.option(
+    "--member",
+    "members",
+    multiple=True,
+    metavar="ID=URL_OR_PATH",
+    required=True,
+    help="One plane member as id=base-url (its /telemetry/snapshot is "
+    "polled) or id=path to a snapshot JSON file (e.g. the lifecycle "
+    "daemon's .lifecycle/last_tick.json). Repeatable.",
+)
+@click.option(
+    "--interval",
+    type=click.FloatRange(min=0.1),
+    default=10.0,
+    show_default=True,
+    help="Seconds between polls.",
+)
+@click.option(
+    "--persist",
+    type=click.Path(dir_okay=False),
+    default=None,
+    help="JSONL path merged snapshots persist to (corpus-ingestable).",
+)
+@click.option(
+    "--retention",
+    type=click.IntRange(min=1),
+    default=500,
+    show_default=True,
+    help="Merged snapshots kept in the persisted JSONL.",
+)
+@click.option(
+    "--host", type=str, default="0.0.0.0", show_default=True,
+    help="Host to serve the merged /metrics + /status on.",
+)
+@click.option(
+    "--port", type=int, default=5557, show_default=True,
+    help="Port to serve the merged /metrics + /status on.",
+)
+@click.option(
+    "--once",
+    is_flag=True,
+    help="Poll every member once, print the merged snapshot as JSON, "
+    "and exit (no server).",
+)
+def rollup_cli(members, interval, persist, retention, host, port, once):
+    """
+    Standalone plane rollup for router-less deployments: poll every
+    member's ``/telemetry/snapshot`` on an interval, merge the
+    registries (counters sum, gauges union under a ``replica`` label,
+    histograms bucket-wise), and serve the merged view at ``/metrics``
+    (Prometheus text) and ``/status`` (JSON).
+    """
+    from gordo_tpu.observability.rollup import RollupPoller, rollup_wsgi_app
+    from gordo_tpu.router.app import parse_replica_entries
+
+    try:
+        member_map = parse_replica_entries(members)
+    except ValueError as exc:
+        raise click.UsageError(str(exc))
+    poller = RollupPoller(
+        members=lambda: member_map,
+        interval_s=0.0 if once else interval,
+        persist_path=persist,
+        retention=retention,
+    )
+    if once:
+        merged = poller.poll_once()
+        click.echo(json.dumps(merged, indent=2, default=str))
+        return
+    poller.start()
+    app = rollup_wsgi_app(poller)
+    from werkzeug.serving import run_simple
+
+    try:
+        run_simple(host, port, app, threaded=True)
+    finally:
+        poller.stop()

@@ -173,7 +173,43 @@ class LifecycleManager:
     def tick(self) -> TickResult:
         """One full cycle; see the module docstring."""
         with tracing.start_span("lifecycle.tick", pointer=self.pointer):
-            return self._tick_traced()
+            result = self._tick_traced()
+        self._persist_last_tick(result)
+        return result
+
+    def _persist_last_tick(self, result: TickResult) -> None:
+        """The watch daemon's member snapshot for the plane rollup
+        (docs/observability.md "Plane rollup and control signals"):
+        ``.lifecycle/last_tick.json``, written atomically per tick, is
+        the file-shaped /telemetry/snapshot a poller reads to compute
+        ``drift_scan_staleness_s``. Telemetry only — a failed write
+        never fails the tick."""
+        from gordo_tpu.observability import rollup as rollup_mod
+
+        payload = rollup_mod.snapshot_payload(
+            role="lifecycle",
+            revision=result.revision or result.base_revision,
+            status={
+                "last_tick_unix_ms": int(time.time() * 1000),
+                "base_revision": result.base_revision,
+                "revision": result.revision,
+                "n_machines": result.n_machines,
+                "n_monitored": len(result.monitored),
+                "n_drifted": len(result.drifted),
+                "n_promoted": len(result.promoted),
+                "n_quarantined": len(result.quarantined),
+                "wall_time_s": round(result.wall_time_s, 4),
+            },
+        )
+        path = os.path.join(self.state_dir, "last_tick.json")
+        try:
+            os.makedirs(self.state_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as fh:
+                json.dump(payload, fh, default=str)
+            os.replace(tmp, path)
+        except OSError as exc:
+            logger.warning("Lifecycle last-tick snapshot not written: %s", exc)
 
     def _tick_traced(self) -> TickResult:
         start = time.perf_counter()

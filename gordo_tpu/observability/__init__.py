@@ -23,6 +23,11 @@ modeling PR stands on.
 - :mod:`report` — telemetry-report JSON persisted next to build
   artifacts, plus the aggregation behind ``gordo-tpu telemetry
   summarize``.
+- :mod:`rollup` — the plane-wide telemetry rollup: /telemetry/snapshot
+  contract, registry merge (counters sum, gauges union under a
+  ``replica`` label, histograms bucket-wise), poller, control signals.
+- :mod:`slo` — declarative SLO specs evaluated against merged
+  snapshots into error-budget + burn-rate objects.
 """
 
 from .device_memory import (
@@ -30,14 +35,49 @@ from .device_memory import (
     memory_watermarks,
     save_device_memory_profile,
 )
-from .events import EVENT_LOG_ENV_VAR, EventEmitter, emit_event, read_events
+from .events import (
+    EVENT_LOG_ENV_VAR,
+    EVENT_LOG_MAX_MB_ENV_VAR,
+    EventEmitter,
+    emit_event,
+    read_events,
+)
 from .profiler import PROFILE_DIR_ENV_VAR, annotate, maybe_trace, profile_dir
-from .registry import Counter, Gauge, Histogram, MetricsRegistry, get_registry
+from .registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    HistogramMergeError,
+    MetricsRegistry,
+    get_registry,
+    histogram_quantile,
+    histogram_stat,
+    histogram_state,
+    merge_histogram_states,
+)
 from .report import (
     TELEMETRY_REPORT_FILENAME,
     load_reports,
     summarize_directory,
     write_telemetry_report,
+)
+from .rollup import (
+    SNAPSHOT_VERSION,
+    RollupPoller,
+    compute_signals,
+    merge_snapshots,
+    plane_status,
+    render_prometheus_text,
+    snapshot_payload,
+)
+from .slo import (
+    SloObjective,
+    SloReport,
+    SloSpec,
+    evaluate,
+    evaluate_values,
+    load_slo_spec,
+    parse_slo_spec,
 )
 from .tracing import (
     TRACE_ID_RESPONSE_HEADER,
@@ -99,4 +139,24 @@ __all__ = [
     "write_telemetry_report",
     "load_reports",
     "summarize_directory",
+    "EVENT_LOG_MAX_MB_ENV_VAR",
+    "HistogramMergeError",
+    "histogram_quantile",
+    "histogram_stat",
+    "histogram_state",
+    "merge_histogram_states",
+    "SNAPSHOT_VERSION",
+    "RollupPoller",
+    "compute_signals",
+    "merge_snapshots",
+    "plane_status",
+    "render_prometheus_text",
+    "snapshot_payload",
+    "SloObjective",
+    "SloReport",
+    "SloSpec",
+    "evaluate",
+    "evaluate_values",
+    "load_slo_spec",
+    "parse_slo_spec",
 ]

@@ -25,9 +25,27 @@ logger = logging.getLogger(__name__)
 
 EVENT_LOG_ENV_VAR = "GORDO_TPU_EVENT_LOG"
 
+#: size-based rotation cap, in MB; unset/0 disables rotation (the
+#: always-on streaming plane grows the log unboundedly otherwise). At
+#: the cap the log is renamed to ``<path>.1`` (one generation kept) and
+#: a fresh file starts — readers tolerate this: the lifecycle byte
+#: cursor resets on shrink (lifecycle/manager.py), and the corpus
+#: reader re-reads whole files each run.
+EVENT_LOG_MAX_MB_ENV_VAR = "GORDO_TPU_EVENT_LOG_MAX_MB"
+
 
 def _utc_now_iso() -> str:
     return datetime.now(timezone.utc).isoformat()
+
+
+def _rotate_cap_bytes() -> int:
+    raw = os.environ.get(EVENT_LOG_MAX_MB_ENV_VAR, "")
+    if not raw:
+        return 0
+    try:
+        return int(float(raw) * 1024 * 1024)
+    except ValueError:
+        return 0
 
 
 class EventEmitter:
@@ -77,8 +95,16 @@ class EventEmitter:
         try:
             parent = os.path.dirname(os.path.abspath(path))
             os.makedirs(parent, exist_ok=True)
-            with self._lock, open(path, "a") as fh:
-                fh.write(line + "\n")
+            with self._lock:
+                cap = _rotate_cap_bytes()
+                if cap > 0:
+                    try:
+                        if os.path.getsize(path) >= cap:
+                            os.replace(path, path + ".1")
+                    except OSError:
+                        pass  # no file yet — nothing to rotate
+                with open(path, "a") as fh:
+                    fh.write(line + "\n")
         except OSError:
             logger.warning(
                 "Could not write telemetry event to %s", path, exc_info=True

@@ -242,3 +242,125 @@ _DEFAULT_REGISTRY = MetricsRegistry()
 def get_registry() -> MetricsRegistry:
     """The process-wide default registry."""
     return _DEFAULT_REGISTRY
+
+
+# --------------------------------------------------------------------------
+# shared histogram snapshot math
+#
+# The ONE home for deriving statistics from (and merging) the
+# ``{count, sum, buckets}`` snapshot state above. The tuning corpus
+# reader, the SLO engine, and the plane rollup merge all call these —
+# so their quantile and merge semantics can never disagree.
+# --------------------------------------------------------------------------
+
+
+class HistogramMergeError(ValueError):
+    """Two histogram states disagree on bucket boundaries.
+
+    Raised instead of guessing: summing counts across mismatched bucket
+    layouts silently corrupts every quantile derived downstream."""
+
+
+def _parse_bound(raw_bound) -> float:
+    if str(raw_bound) in ("+Inf", "inf", "Infinity"):
+        return math.inf
+    return float(raw_bound)
+
+
+def histogram_state(value) -> typing.Optional[dict]:
+    """The ``{count, sum, buckets}`` dict inside ``value``, or None.
+
+    Accepts a bare state, a snapshot wrapper (``{"type": "histogram",
+    "series": [...]}`` as :meth:`_Metric.snapshot` emits, or the older
+    ``"kind"`` spelling some persisted reports carry) whose first series
+    either nests the state under ``"value"`` or inlines it, and nothing
+    else.
+    """
+    if not isinstance(value, dict):
+        return None
+    if value.get("kind") == "histogram" or value.get("type") == "histogram":
+        series = value.get("series") or []
+        entry = series[0] if series else None
+        if not isinstance(entry, dict):
+            return None
+        nested = entry.get("value")
+        value = nested if isinstance(nested, dict) else entry
+        if not isinstance(value, dict):
+            return None
+    if not {"count", "sum", "buckets"} <= set(value):
+        return None
+    return value
+
+
+def histogram_quantile(state: dict, q: float) -> typing.Optional[float]:
+    """The ``q`` quantile (0 < q <= 1) of a ``{count, sum, buckets}``
+    state: the smallest bucket bound whose cumulative count covers
+    ``q * count``. When that bound is +Inf — everything past the largest
+    finite bucket — the mean is the honest (if coarse) stand-in."""
+    count = state.get("count") or 0
+    if not count:
+        return None
+    buckets = state.get("buckets")
+    if not isinstance(buckets, dict) or not buckets:
+        return None
+    bounds = [
+        (_parse_bound(raw_bound), float(cum))
+        for raw_bound, cum in buckets.items()
+    ]
+    bounds.sort(key=lambda pair: pair[0])
+    target = q * count
+    for bound, cum in bounds:
+        if cum >= target:
+            if math.isinf(bound):
+                return float(state["sum"]) / count
+            return bound
+    return None
+
+
+def histogram_stat(state: dict, stat: str) -> typing.Optional[float]:
+    """A named statistic of a ``{count, sum, buckets}`` state:
+    ``"mean"``, ``"count"``, ``"sum"``, or any ``"pNN"`` quantile
+    (``"p99"``, ``"p50"``, ``"p99.9"``)."""
+    count = state.get("count") or 0
+    if not count:
+        return None
+    if stat == "mean":
+        return float(state["sum"]) / count
+    if stat == "count":
+        return float(count)
+    if stat == "sum":
+        return float(state["sum"])
+    if stat.startswith("p"):
+        try:
+            q = float(stat[1:]) / 100.0
+        except ValueError:
+            return None
+        if not 0.0 < q <= 1.0:
+            return None
+        return histogram_quantile(state, q)
+    return None
+
+
+def merge_histogram_states(a: dict, b: dict) -> dict:
+    """Bucket-wise sum of two ``{count, sum, buckets}`` states.
+
+    Refuses loudly (:class:`HistogramMergeError`) when the bucket
+    boundaries differ — e.g. two replicas running different builds with
+    different bucket layouts — rather than silently mis-merging.
+    """
+    bounds_a = sorted(_parse_bound(k) for k in a.get("buckets", {}))
+    bounds_b = sorted(_parse_bound(k) for k in b.get("buckets", {}))
+    if bounds_a != bounds_b:
+        raise HistogramMergeError(
+            f"Histogram bucket boundaries differ: {bounds_a} vs {bounds_b}"
+        )
+    order = sorted(a["buckets"], key=_parse_bound)
+    by_bound_b = {_parse_bound(k): v for k, v in b["buckets"].items()}
+    return {
+        "count": int(a.get("count") or 0) + int(b.get("count") or 0),
+        "sum": float(a.get("sum") or 0.0) + float(b.get("sum") or 0.0),
+        "buckets": {
+            key: int(a["buckets"][key]) + int(by_bound_b[_parse_bound(key)])
+            for key in order
+        },
+    }

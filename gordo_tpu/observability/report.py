@@ -15,9 +15,11 @@ logger = logging.getLogger(__name__)
 
 TELEMETRY_REPORT_FILENAME = "telemetry_report.json"
 TELEMETRY_REPORT_VERSION = 1
-#: schema of the ``telemetry summarize --as-json`` payload (v2: object
-#: with per-subsystem event sections; v1 was a bare report list)
-SUMMARY_SCHEMA_VERSION = 2
+#: schema of the ``telemetry summarize --as-json`` payload (v3: adds
+#: the ``rollup`` section — merged plane-snapshot JSONL files with
+#: per-replica breakdown and last control signals; v2: object with
+#: per-subsystem event sections; v1 was a bare report list)
+SUMMARY_SCHEMA_VERSION = 3
 
 #: event-type -> subsystem classification for the per-subsystem summary
 #: sections: ordered (prefix | exact-name set) rules, first match wins.
@@ -37,6 +39,7 @@ EVENT_SUBSYSTEM_RULES: typing.Tuple[
     ),
     ("programs", ("program_cache_", "compile_cache_"), ()),
     ("tuning", ("tuning_",), ()),
+    ("rollup", ("rollup_", "slo_"), ()),
     (
         "robustness",
         ("fault_",),
@@ -97,6 +100,70 @@ def load_event_files(
             continue
         if records and all("event" in r for r in records):
             out.append((path, records))
+    return out
+
+
+def load_rollup_files(
+    directory: typing.Union[str, Path]
+) -> typing.List[typing.Tuple[Path, typing.List[dict]]]:
+    """Every JSONL file under ``directory`` holding persisted merged
+    plane snapshots (rollup.py): recognized by the ``snapshot_version``
+    + ``metrics`` keys every line carries. Disjoint from
+    :func:`load_event_files` — snapshot lines have no ``event`` key."""
+    out = []
+    for path in sorted(Path(directory).rglob("*.jsonl")):
+        records: typing.List[dict] = []
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        record = json.loads(line)
+                    except ValueError:
+                        continue  # torn last line — a crashed writer
+                    if isinstance(record, dict):
+                        records.append(record)
+        except OSError:
+            continue
+        if records and all(
+            "snapshot_version" in r and "metrics" in r for r in records
+        ):
+            out.append((path, records))
+    return out
+
+
+def summarize_rollups(
+    rollup_files: typing.Sequence[typing.Tuple[Path, typing.List[dict]]]
+) -> typing.List[dict]:
+    """One summary object per merged-snapshot file: snapshot count,
+    per-replica breakdown and the latest control signals — the
+    machine-readable ``rollup`` section of the summary payload."""
+    out = []
+    for path, records in rollup_files:
+        last = records[-1]
+        members = last.get("members") or {}
+        replicas = {
+            mid: {
+                "role": info.get("role"),
+                "revision": info.get("revision"),
+                "status": (info.get("status") or {}).get("status"),
+                "uptime_s": info.get("uptime_s"),
+            }
+            for mid, info in members.items()
+        }
+        out.append(
+            {
+                "path": str(path),
+                "n_snapshots": len(records),
+                "first_ts": records[0].get("ts"),
+                "last_ts": last.get("ts"),
+                "members": replicas,
+                "signals": last.get("signals") or {},
+                "merge_errors": last.get("merge_errors") or [],
+            }
+        )
     return out
 
 
@@ -235,6 +302,7 @@ def summary_payload(directory: typing.Union[str, Path]) -> dict:
     directory = Path(directory)
     reports = load_reports(directory)
     event_files = load_event_files(directory)
+    rollup_files = load_rollup_files(directory)
     return {
         "schema_version": SUMMARY_SCHEMA_VERSION,
         "directory": str(directory),
@@ -243,6 +311,7 @@ def summary_payload(directory: typing.Union[str, Path]) -> dict:
         ],
         "n_events": sum(len(records) for _, records in event_files),
         "events": group_events_by_subsystem(event_files),
+        "rollup": summarize_rollups(rollup_files),
     }
 
 
@@ -292,6 +361,44 @@ def summarize_directory(directory: typing.Union[str, Path]) -> str:
                 p=_fmt_bytes(max(peaks)) if peaks else "n/a",
             )
         )
+
+    rollups = summarize_rollups(load_rollup_files(directory))
+    if rollups:
+        lines.append(f"Plane rollups: {len(rollups)} file(s)")
+        for entry in rollups:
+            lines.append(
+                "  {p}: {n} merged snapshot(s), {f} .. {l}".format(
+                    p=entry["path"],
+                    n=entry["n_snapshots"],
+                    f=entry["first_ts"] or "?",
+                    l=entry["last_ts"] or "?",
+                )
+            )
+            for mid, info in sorted(entry["members"].items()):
+                lines.append(
+                    "    {m} [{r}] status={s} revision={rev}".format(
+                        m=mid,
+                        r=info.get("role") or "?",
+                        s=info.get("status") or "?",
+                        rev=info.get("revision") or "?",
+                    )
+                )
+            signals = {
+                k: v
+                for k, v in sorted(entry["signals"].items())
+                if v is not None
+            }
+            if signals:
+                lines.append(
+                    "    signals: "
+                    + ", ".join(f"{k}={v:.4g}" for k, v in signals.items())
+                )
+            for err in entry["merge_errors"]:
+                lines.append(
+                    "    MERGE REFUSED {m}: {e}".format(
+                        m=err.get("metric", "?"), e=err.get("error", "?")
+                    )
+                )
 
     n_events = sum(len(records) for _, records in event_files)
     lines.append(f"Event logs: {len(event_files)} file(s), {n_events} event(s)")

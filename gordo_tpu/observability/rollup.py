@@ -1,0 +1,901 @@
+"""
+Plane-wide telemetry rollup: one live view of the whole serving plane.
+
+Every serving process (replica, router, lifecycle watch daemon) exposes
+a versioned ``/telemetry/snapshot`` — its full metrics-registry dump
+plus process identity (:func:`snapshot_payload`). A poller
+(:class:`RollupPoller`, embedded in the router or run standalone via
+``gordo-tpu rollup``) fetches member snapshots on an interval and
+**merges** the registries into one plane-level view:
+
+- counters sum across members (after a per-member monotonic clamp, so
+  a replica restart never makes a plane counter go backwards);
+- gauges take the labeled union, each series gaining a ``replica``
+  label naming the member it came from;
+- histograms merge bucket-wise via the shared
+  :func:`~gordo_tpu.observability.registry.merge_histogram_states` —
+  mismatched bucket boundaries are refused loudly (the metric is
+  dropped from the merge and recorded under ``merge_errors``), never
+  silently mis-merged.
+
+The merged view serves at plane-level ``/metrics`` (Prometheus text
+exposition, :func:`render_prometheus_text`) and ``/status`` (JSON with
+per-replica health and the windowed control signals the autoscaler
+direction consumes, :func:`compute_signals`). Periodic merged
+snapshots persist as stamped JSONL (:meth:`RollupPoller.persist`) next
+to the artifacts, shaped so the schema-tolerant tuning-corpus reader
+(tuning/corpus.py) ingests them as observations for free.
+
+Everything here is poll-driven and stdlib-shaped: with no poller
+configured the plane pays nothing (no threads, no requests — the house
+strict-no-op rule, pinned by tests/test_rollup.py).
+"""
+
+import json
+import logging
+import os
+import threading
+import time
+import typing
+
+from gordo_tpu.observability.events import emit_event
+from gordo_tpu.observability.registry import (
+    HistogramMergeError,
+    get_registry,
+    histogram_state,
+    histogram_stat,
+    merge_histogram_states,
+)
+
+logger = logging.getLogger(__name__)
+
+#: bumped when the snapshot payload schema changes shape
+SNAPSHOT_VERSION = 1
+
+#: module import time — the uptime epoch for processes that don't pass
+#: their own ``started_at``
+_PROCESS_STARTED_AT = time.time()
+
+
+def _now_stamp(now: typing.Optional[float] = None) -> typing.Tuple[str, int]:
+    now = time.time() if now is None else now
+    ts = time.strftime("%Y-%m-%dT%H:%M:%S", time.gmtime(now)) + "Z"
+    return ts, int(now * 1000)
+
+
+def snapshot_payload(
+    role: str,
+    replica_id: typing.Optional[str] = None,
+    revision: typing.Optional[str] = None,
+    status: typing.Optional[dict] = None,
+    registry=None,
+    started_at: typing.Optional[float] = None,
+    now: typing.Optional[float] = None,
+) -> dict:
+    """The versioned ``/telemetry/snapshot`` body: full registry dump
+    plus process identity. The one shape every member of the plane
+    speaks (docs/observability.md "Plane rollup and control signals")."""
+    registry = registry if registry is not None else get_registry()
+    started = _PROCESS_STARTED_AT if started_at is None else started_at
+    ts, unix_ms = _now_stamp(now)
+    return {
+        "snapshot_version": SNAPSHOT_VERSION,
+        "role": role,
+        "replica_id": replica_id,
+        "revision": revision,
+        "pid": os.getpid(),
+        "uptime_s": max(0.0, (unix_ms / 1000.0) - started),
+        "ts": ts,
+        "unix_ms": unix_ms,
+        "metrics": registry.snapshot(),
+        "status": status or {},
+    }
+
+
+# --------------------------------------------------------------------------
+# registry merge
+# --------------------------------------------------------------------------
+
+
+def _series_key(labels: typing.Mapping[str, str]) -> typing.Tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _rollup_metrics():
+    reg = get_registry()
+    return {
+        "polls": reg.counter(
+            "gordo_rollup_polls_total",
+            "Rollup member polls by outcome (ok/error)",
+            ("outcome",),
+        ),
+        "refusals": reg.counter(
+            "gordo_rollup_merge_refusals_total",
+            "Metrics dropped from a rollup merge (shape/bucket mismatch)",
+        ),
+        "resets": reg.counter(
+            "gordo_rollup_counter_resets_total",
+            "Counter resets observed across polls (member restarts)",
+        ),
+    }
+
+
+def merge_metrics(
+    member_metrics: typing.Mapping[str, typing.Mapping[str, dict]],
+) -> typing.Tuple[typing.Dict[str, dict], typing.List[dict]]:
+    """Merge per-member registry snapshots into one plane registry dump.
+
+    Returns ``(merged, errors)``. A metric whose shape disagrees across
+    members (kind mismatch, histogram bucket-boundary mismatch) is
+    REFUSED: dropped from ``merged`` entirely and recorded in
+    ``errors`` — partial numbers would read as plane truth.
+    """
+    merged: typing.Dict[str, dict] = {}
+    errors: typing.List[dict] = []
+    refused: typing.Set[str] = set()
+    for member_id in sorted(member_metrics):
+        metrics = member_metrics[member_id] or {}
+        for name, dump in metrics.items():
+            if name in refused or not isinstance(dump, dict):
+                continue
+            kind = dump.get("type") or dump.get("kind")
+            try:
+                if name not in merged:
+                    merged[name] = _fresh_merge_target(member_id, dump, kind)
+                    continue
+                target = merged[name]
+                if target["type"] != kind:
+                    raise HistogramMergeError(
+                        f"kind mismatch: {target['type']} vs {kind}"
+                    )
+                _merge_into(member_id, target, dump, kind)
+            except (HistogramMergeError, KeyError, TypeError, ValueError) as exc:
+                refused.add(name)
+                merged.pop(name, None)
+                errors.append(
+                    {"metric": name, "member": member_id, "error": str(exc)}
+                )
+                _rollup_metrics()["refusals"].inc()
+                emit_event(
+                    "rollup_merge_refused",
+                    metric=name,
+                    member=member_id,
+                    error=str(exc),
+                )
+    return merged, errors
+
+
+def _fresh_merge_target(member_id: str, dump: dict, kind: str) -> dict:
+    target = {
+        "type": kind,
+        "description": dump.get("description", ""),
+        "labelnames": list(dump.get("labelnames") or []),
+        "series": [],
+    }
+    _merge_into(member_id, target, dump, kind)
+    return target
+
+
+def _merge_into(member_id: str, target: dict, dump: dict, kind: str) -> None:
+    if kind == "gauge":
+        # labeled union: each series names the member it came from. A
+        # series already carrying a replica label (e.g. the router's own
+        # per-replica health gauge) keeps it verbatim.
+        if "replica" not in target["labelnames"]:
+            target["labelnames"] = sorted(
+                set(target["labelnames"]) | {"replica"}
+            )
+        for series in dump.get("series") or []:
+            labels = dict(series.get("labels") or {})
+            labels.setdefault("replica", member_id)
+            target["series"].append(
+                {"labels": labels, "value": series.get("value")}
+            )
+        return
+    by_key = {
+        _series_key(s.get("labels") or {}): s for s in target["series"]
+    }
+    for series in dump.get("series") or []:
+        labels = dict(series.get("labels") or {})
+        key = _series_key(labels)
+        existing = by_key.get(key)
+        if kind == "histogram":
+            state = {
+                "count": series["count"],
+                "sum": series["sum"],
+                "buckets": dict(series["buckets"]),
+            }
+            if existing is None:
+                entry = {"labels": labels, **state}
+                target["series"].append(entry)
+                by_key[key] = entry
+            else:
+                prior = {
+                    "count": existing["count"],
+                    "sum": existing["sum"],
+                    "buckets": existing["buckets"],
+                }
+                existing.update(merge_histogram_states(prior, state))
+        else:  # counter: sum
+            value = float(series.get("value") or 0.0)
+            if existing is None:
+                entry = {"labels": labels, "value": value}
+                target["series"].append(entry)
+                by_key[key] = entry
+            else:
+                existing["value"] = float(existing["value"]) + value
+
+
+class CounterClamp:
+    """Per-member monotonic clamp for counters across polls.
+
+    A replica restart resets its in-process counters to zero; naively
+    re-summing would make plane counters go BACKWARDS. This tracks each
+    member series' last seen value — on a decrease the last value is
+    folded into a standing base (the pre-restart total is real traffic)
+    and a ``rollup_counter_reset`` event is emitted. Adjusted value =
+    base + current.
+    """
+
+    def __init__(self):
+        self._state: typing.Dict[typing.Tuple, typing.Dict[str, float]] = {}
+
+    def adjust(self, member_id: str, metrics: typing.Mapping[str, dict]) -> dict:
+        """A copy of ``metrics`` with every counter series clamped."""
+        out: typing.Dict[str, dict] = {}
+        for name, dump in (metrics or {}).items():
+            kind = isinstance(dump, dict) and (
+                dump.get("type") or dump.get("kind")
+            )
+            if kind != "counter":
+                out[name] = dump
+                continue
+            adjusted = dict(dump)
+            adjusted["series"] = [
+                self._adjust_series(member_id, name, series)
+                for series in dump.get("series") or []
+            ]
+            out[name] = adjusted
+        return out
+
+    def _adjust_series(self, member_id: str, name: str, series: dict) -> dict:
+        labels = series.get("labels") or {}
+        key = (member_id, name, _series_key(labels))
+        value = float(series.get("value") or 0.0)
+        state = self._state.setdefault(key, {"last": 0.0, "base": 0.0})
+        if value < state["last"]:
+            state["base"] += state["last"]
+            _rollup_metrics()["resets"].inc()
+            emit_event(
+                "rollup_counter_reset",
+                member=member_id,
+                metric=name,
+                labels=dict(labels),
+                last=state["last"],
+                current=value,
+            )
+        state["last"] = value
+        return {**series, "value": state["base"] + value}
+
+
+def merge_snapshots(
+    members: typing.Mapping[str, dict],
+    now: typing.Optional[float] = None,
+) -> dict:
+    """Merge member ``/telemetry/snapshot`` payloads into one
+    plane-level snapshot (same envelope shape, role ``plane``)."""
+    ts, unix_ms = _now_stamp(now)
+    merged_metrics, errors = merge_metrics(
+        {mid: snap.get("metrics") or {} for mid, snap in members.items()}
+    )
+    identities = {}
+    for mid in sorted(members):
+        snap = members[mid]
+        identities[mid] = {
+            "role": snap.get("role"),
+            "replica_id": snap.get("replica_id"),
+            "revision": snap.get("revision"),
+            "pid": snap.get("pid"),
+            "uptime_s": snap.get("uptime_s"),
+            "unix_ms": snap.get("unix_ms"),
+            "status": snap.get("status") or {},
+        }
+    return {
+        "snapshot_version": SNAPSHOT_VERSION,
+        "role": "plane",
+        "ts": ts,
+        "unix_ms": unix_ms,
+        "members": identities,
+        "metrics": merged_metrics,
+        "merge_errors": errors,
+    }
+
+
+# --------------------------------------------------------------------------
+# control signals (the windowed numbers the autoscaler direction reads)
+# --------------------------------------------------------------------------
+
+
+def _counter_values(
+    metrics: typing.Mapping[str, dict], name: str
+) -> typing.Dict[typing.Tuple, float]:
+    dump = metrics.get(name) or {}
+    return {
+        _series_key(s.get("labels") or {}): float(s.get("value") or 0.0)
+        for s in dump.get("series") or []
+    }
+
+
+def _counter_delta(
+    current: typing.Mapping[str, dict],
+    previous: typing.Optional[typing.Mapping[str, dict]],
+    name: str,
+) -> typing.Dict[typing.Tuple, float]:
+    cur = _counter_values(current, name)
+    prev = _counter_values(previous or {}, name)
+    return {
+        key: max(0.0, value - prev.get(key, 0.0))
+        for key, value in cur.items()
+    }
+
+
+def _gauge_sum(
+    metrics: typing.Mapping[str, dict], name: str
+) -> typing.Optional[float]:
+    dump = metrics.get(name)
+    if not dump:
+        return None
+    return sum(
+        float(s.get("value") or 0.0) for s in dump.get("series") or []
+    )
+
+
+def _histogram_window(
+    current: typing.Mapping[str, dict],
+    previous: typing.Optional[typing.Mapping[str, dict]],
+    name: str,
+    labels: typing.Optional[typing.Mapping[str, str]] = None,
+) -> typing.Optional[dict]:
+    """The windowed (this-poll-minus-last-poll) histogram state for one
+    series, falling back to the lifetime state on the first poll."""
+    dump = current.get(name)
+    if not dump:
+        return None
+    want = _series_key(labels) if labels else None
+    cur_state = None
+    for series in dump.get("series") or []:
+        if want is None or _series_key(series.get("labels") or {}) == want:
+            cur_state = histogram_state(series)
+            break
+    if cur_state is None:
+        return None
+    prev_dump = (previous or {}).get(name)
+    prev_state = None
+    for series in (prev_dump or {}).get("series") or []:
+        if want is None or _series_key(series.get("labels") or {}) == want:
+            prev_state = histogram_state(series)
+            break
+    if prev_state is None:
+        return cur_state
+    try:
+        delta_count = int(cur_state["count"]) - int(prev_state["count"])
+        if delta_count <= 0:
+            return None  # no new observations this window
+        return {
+            "count": delta_count,
+            "sum": float(cur_state["sum"]) - float(prev_state["sum"]),
+            "buckets": {
+                bound: int(cum) - int(prev_state["buckets"].get(bound, 0))
+                for bound, cum in cur_state["buckets"].items()
+            },
+        }
+    except (KeyError, TypeError, ValueError):
+        return cur_state
+
+
+def _rate(numerator: float, denominator: float) -> typing.Optional[float]:
+    if denominator <= 0:
+        return None
+    return numerator / denominator
+
+
+def compute_signals(
+    current: dict,
+    previous: typing.Optional[dict] = None,
+    now: typing.Optional[float] = None,
+) -> dict:
+    """The plane control signals, windowed between two merged snapshots
+    (lifetime totals on the first poll, when ``previous`` is None).
+
+    The four documented autoscaling signals — ``shed_rate``,
+    ``queue_depth``, ``stream_backlog``, ``replicas_healthy`` — plus
+    the SLO-objective signals (``predict_p99_ms``,
+    ``unstructured_error_rate``, ``stream_resume_rate``,
+    ``drift_scan_staleness_s``). A signal whose inputs are absent from
+    the merge is ``None``, never fabricated.
+    """
+    metrics = current.get("metrics") or {}
+    prev_metrics = (previous or {}).get("metrics") or {}
+
+    signals: typing.Dict[str, typing.Optional[float]] = {}
+
+    # -- shed + error rates (router outcome counters; batcher sheds as
+    #    the router-less fallback) ----------------------------------------
+    outcomes = _counter_delta(metrics, prev_metrics, "gordo_router_requests_total")
+    total = sum(outcomes.values())
+    if total > 0:
+        shed = sum(
+            v for k, v in outcomes.items() if dict(k).get("outcome") == "shed"
+        )
+        structured = {"ok", "partial", "shed", "refused"}
+        errors = sum(
+            v
+            for k, v in outcomes.items()
+            if dict(k).get("outcome") not in structured
+        )
+        signals["shed_rate"] = shed / total
+        signals["unstructured_error_rate"] = errors / total
+    else:
+        sheds = sum(
+            _counter_delta(
+                metrics, prev_metrics, "gordo_serve_batch_shed_total"
+            ).values()
+        )
+        batched = _histogram_window(
+            metrics, prev_metrics, "gordo_serve_batch_requests"
+        )
+        served = float(batched["sum"]) if batched else 0.0
+        signals["shed_rate"] = _rate(sheds, sheds + served)
+        signals["unstructured_error_rate"] = None if total == 0 else 0.0
+
+    # -- stream resume rate ------------------------------------------------
+    updates = _counter_delta(
+        metrics, prev_metrics, "gordo_stream_updates_total"
+    )
+    n_updates = sum(updates.values())
+    resumes = sum(
+        v
+        for k, v in updates.items()
+        if dict(k).get("outcome") == "resume_required"
+    )
+    signals["stream_resume_rate"] = _rate(resumes, n_updates)
+
+    # -- predict latency (windowed p99 of the replica predict phase) ------
+    predict = _histogram_window(
+        metrics,
+        prev_metrics,
+        "gordo_server_phase_seconds",
+        labels={"phase": "predict"},
+    )
+    p99 = histogram_stat(predict, "p99") if predict else None
+    signals["predict_p99_ms"] = None if p99 is None else p99 * 1000.0
+
+    # -- instantaneous gauges ---------------------------------------------
+    signals["queue_depth"] = _gauge_sum(metrics, "gordo_serve_batch_queue_depth")
+    signals["stream_sessions"] = _gauge_sum(metrics, "gordo_stream_sessions")
+
+    # -- per-member status rollups ----------------------------------------
+    members = current.get("members") or {}
+    backlog = None
+    healthy = n_replicas = 0
+    last_tick_ms: typing.Optional[int] = None
+    for info in members.values():
+        status = info.get("status") or {}
+        streaming = status.get("streaming") or {}
+        if "backlog" in streaming:
+            backlog = (backlog or 0) + float(streaming["backlog"] or 0)
+        if info.get("role") == "replica":
+            n_replicas += 1
+            if status.get("status") == "ok":
+                healthy += 1
+        if info.get("role") == "lifecycle":
+            tick_ms = status.get("last_tick_unix_ms") or info.get("unix_ms")
+            if tick_ms:
+                last_tick_ms = max(last_tick_ms or 0, int(tick_ms))
+    signals["stream_backlog"] = backlog
+    signals["replicas_healthy"] = float(healthy) if n_replicas else None
+    signals["replicas_total"] = float(n_replicas) if n_replicas else None
+
+    # -- drift-scan staleness (lifecycle member heartbeat) ----------------
+    if last_tick_ms is not None:
+        now = time.time() if now is None else now
+        signals["drift_scan_staleness_s"] = max(
+            0.0, now - last_tick_ms / 1000.0
+        )
+    else:
+        signals["drift_scan_staleness_s"] = None
+
+    # -- program cache hit rate -------------------------------------------
+    hits = sum(
+        _counter_delta(
+            metrics, prev_metrics, "gordo_program_cache_hits_total"
+        ).values()
+    )
+    misses = sum(
+        _counter_delta(
+            metrics, prev_metrics, "gordo_program_cache_misses_total"
+        ).values()
+    )
+    signals["program_cache_hit_rate"] = _rate(hits, hits + misses)
+
+    return signals
+
+
+# --------------------------------------------------------------------------
+# Prometheus text exposition of a merged snapshot
+# --------------------------------------------------------------------------
+
+
+def render_prometheus_text(metrics: typing.Mapping[str, dict]) -> str:
+    """Plain Prometheus text exposition of a (merged) registry dump —
+    dependency-free, so the plane ``/metrics`` needs no
+    ``prometheus_client`` in the router image."""
+    lines: typing.List[str] = []
+    for name in sorted(metrics):
+        dump = metrics[name]
+        kind = dump.get("type") or dump.get("kind") or "untyped"
+        description = str(dump.get("description") or "").replace("\n", " ")
+        lines.append(f"# HELP {name} {description}")
+        lines.append(f"# TYPE {name} {kind}")
+        for series in dump.get("series") or []:
+            labels = series.get("labels") or {}
+            if kind == "histogram":
+                state = histogram_state(series)
+                if state is None:
+                    continue
+                for bound, cum in sorted(
+                    state["buckets"].items(),
+                    key=lambda kv: float("inf")
+                    if kv[0] == "+Inf"
+                    else float(kv[0]),
+                ):
+                    lines.append(
+                        f"{name}_bucket"
+                        f"{_label_text({**labels, 'le': bound})} {cum}"
+                    )
+                lines.append(f"{name}_sum{_label_text(labels)} {state['sum']}")
+                lines.append(
+                    f"{name}_count{_label_text(labels)} {state['count']}"
+                )
+            else:
+                value = series.get("value")
+                if value is None:
+                    continue
+                lines.append(f"{name}{_label_text(labels)} {value}")
+    return "\n".join(lines) + "\n"
+
+
+def _label_text(labels: typing.Mapping[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def _escape_label(value) -> str:
+    return (
+        str(value)
+        .replace("\\", r"\\")
+        .replace('"', r"\"")
+        .replace("\n", r"\n")
+    )
+
+
+# --------------------------------------------------------------------------
+# the poller
+# --------------------------------------------------------------------------
+
+#: corpus-visible knob fields lifted to the top level of a persisted
+#: rollup line when every replica in the plane agrees on the value —
+#: the co-occurrence the schema-tolerant corpus walker needs to form a
+#: (knob arm, signal) observation from a snapshot line
+_PLANE_KNOB_FIELDS = (
+    ("batching", "batch_wait_ms"),
+    ("batching", "queue_limit"),
+)
+
+
+def default_fetch(url: str, timeout: float = 5.0) -> dict:
+    """Fetch one member snapshot. ``url`` is the member's base URL
+    (``/telemetry/snapshot`` appended unless already present) or a
+    filesystem path to a snapshot JSON file (the lifecycle watch
+    daemon's ``last_tick.json``)."""
+    if "://" not in url or url.startswith("file://"):
+        path = url[len("file://"):] if url.startswith("file://") else url
+        with open(path) as fh:
+            return json.load(fh)
+    import requests
+
+    if not url.rstrip("/").endswith("/telemetry/snapshot"):
+        url = url.rstrip("/") + "/telemetry/snapshot"
+    response = requests.get(url, timeout=timeout)
+    response.raise_for_status()
+    return response.json()
+
+
+class RollupPoller:
+    """Polls plane members' ``/telemetry/snapshot``, merges, computes
+    windowed signals, and (optionally) persists merged JSONL.
+
+    ``members`` is a callable returning ``{member_id: url}`` so a
+    router's dynamic replica set stays live; ``local_members`` maps
+    member ids to zero-arg callables producing snapshots in-process
+    (the router includes its own registry without HTTP). With
+    ``interval_s <= 0`` no thread exists — callers drive
+    :meth:`poll_once` on demand.
+    """
+
+    def __init__(
+        self,
+        members: typing.Callable[[], typing.Dict[str, str]],
+        interval_s: float = 0.0,
+        fetch: typing.Optional[typing.Callable[[str], dict]] = None,
+        local_members: typing.Optional[
+            typing.Dict[str, typing.Callable[[], dict]]
+        ] = None,
+        persist_path: typing.Optional[str] = None,
+        retention: int = 500,
+        name: str = "rollup",
+    ):
+        self.members = members
+        self.interval_s = float(interval_s)
+        self.fetch = fetch or default_fetch
+        self.local_members = dict(local_members or {})
+        self.persist_path = persist_path
+        self.retention = int(retention)
+        self.name = name
+        self.clamp = CounterClamp()
+        self._lock = threading.Lock()
+        self._merged: typing.Optional[dict] = None
+        self._previous: typing.Optional[dict] = None
+        self._signals: typing.Dict[str, typing.Any] = {}
+        self._poll_errors: typing.Dict[str, str] = {}
+        self._n_polls = 0
+        self._stopping = threading.Event()
+        self._thread: typing.Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        """Start the background poll loop (only when interval > 0)."""
+        if self.interval_s <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name=f"gordo-{self.name}-poller", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stopping.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stopping.wait(self.interval_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - the plane view must survive
+                logger.exception("Rollup poll failed")
+
+    # -- polling -----------------------------------------------------------
+
+    def poll_once(self, now: typing.Optional[float] = None) -> dict:
+        """One fan-out poll: fetch every member, clamp counters, merge,
+        compute windowed signals, persist. Returns the merged snapshot
+        (with ``signals`` and ``poll`` blocks embedded)."""
+        snapshots: typing.Dict[str, dict] = {}
+        errors: typing.Dict[str, str] = {}
+        rollup_counters = _rollup_metrics()
+        targets = dict(self.members() or {})
+        for member_id, url in targets.items():
+            try:
+                snapshots[member_id] = self.fetch(url)
+                rollup_counters["polls"].inc(outcome="ok")
+            except Exception as exc:  # noqa: BLE001 - a dead member is data
+                errors[member_id] = str(exc)
+                rollup_counters["polls"].inc(outcome="error")
+        for member_id, produce in self.local_members.items():
+            try:
+                snapshots[member_id] = produce()
+            except Exception as exc:  # noqa: BLE001
+                errors[member_id] = str(exc)
+        clamped = {
+            mid: {**snap, "metrics": self.clamp.adjust(mid, snap.get("metrics") or {})}
+            for mid, snap in snapshots.items()
+        }
+        merged = merge_snapshots(clamped, now=now)
+        with self._lock:
+            previous = self._merged
+            signals = compute_signals(merged, previous, now=now)
+            merged["signals"] = signals
+            merged["poll"] = {
+                "interval_s": self.interval_s,
+                "n_polls": self._n_polls + 1,
+                "members_polled": sorted(targets) + sorted(self.local_members),
+                "member_errors": errors,
+            }
+            self._previous = previous
+            self._merged = merged
+            self._signals = signals
+            self._poll_errors = errors
+            self._n_polls += 1
+        if self.persist_path:
+            try:
+                self.persist(merged)
+            except OSError as exc:
+                logger.warning("Rollup persist failed: %s", exc)
+        return merged
+
+    def merged(self) -> typing.Optional[dict]:
+        """The latest merged snapshot (None before the first poll)."""
+        with self._lock:
+            return self._merged
+
+    def status_payload(self, now: typing.Optional[float] = None) -> dict:
+        """The plane ``/status`` body derived from the latest merge."""
+        with self._lock:
+            merged = self._merged
+        if merged is None:
+            merged = self.poll_once(now=now)
+        return plane_status(merged)
+
+    # -- persistence -------------------------------------------------------
+
+    def persist(self, merged: dict) -> None:
+        """Append one stamped JSONL line; trim to ``retention`` lines.
+
+        The line lifts plane-uniform knob values (e.g. ``batch_wait_ms``)
+        to the top level so the corpus walker's context inheritance
+        pairs them with the histogram-derived signal fields nested in
+        ``metrics`` — merged snapshots become tuning observations with
+        no dedicated parser.
+        """
+        line = dict(merged)
+        line.update(_plane_uniform_knobs(merged))
+        parent = os.path.dirname(self.persist_path)
+        if parent:
+            os.makedirs(parent, exist_ok=True)
+        with open(self.persist_path, "a") as fh:
+            fh.write(json.dumps(line, default=str) + "\n")
+        self._trim()
+
+    def _trim(self) -> None:
+        if self.retention <= 0:
+            return
+        try:
+            with open(self.persist_path) as fh:
+                lines = fh.readlines()
+        except OSError:
+            return
+        if len(lines) <= self.retention:
+            return
+        tmp = self.persist_path + ".tmp"
+        with open(tmp, "w") as fh:
+            fh.writelines(lines[-self.retention:])
+        os.replace(tmp, self.persist_path)
+
+
+def _plane_uniform_knobs(merged: dict) -> dict:
+    """Knob values every replica in the merge agrees on, lifted for the
+    corpus reader. A plane with mixed settings lifts nothing — an
+    observation must not misattribute a mixed arm."""
+    out: typing.Dict[str, typing.Any] = {}
+    members = [
+        info
+        for info in (merged.get("members") or {}).values()
+        if info.get("role") == "replica"
+    ]
+    if not members:
+        return out
+    for section, field in _PLANE_KNOB_FIELDS:
+        values = set()
+        for info in members:
+            block = (info.get("status") or {}).get(section) or {}
+            if field not in block:
+                values = set()
+                break
+            values.add(block[field])
+        if len(values) == 1:
+            out[field] = values.pop()
+    return out
+
+
+def plane_status(merged: dict) -> dict:
+    """The ``/status`` JSON body: per-replica health, control signals,
+    SLO-relevant rollups — one page answering "is the plane healthy?"."""
+    members = merged.get("members") or {}
+    signals = merged.get("signals") or {}
+    replicas = {}
+    for mid, info in members.items():
+        status = info.get("status") or {}
+        if info.get("role") != "replica":
+            continue
+        replicas[mid] = {
+            "status": status.get("status"),
+            "revision": info.get("revision"),
+            "uptime_s": info.get("uptime_s"),
+            "queue_depth": (status.get("batching") or {}).get("queue_depth"),
+            "sheds_total": (status.get("batching") or {}).get("sheds_total"),
+            "stream_sessions": (status.get("streaming") or {}).get("sessions"),
+            "stream_backlog": (status.get("streaming") or {}).get("backlog"),
+        }
+    routers = {
+        mid: (info.get("status") or {})
+        for mid, info in members.items()
+        if info.get("role") == "router"
+    }
+    # breaker state from router/health.py rides each replica row when a
+    # router member is in the merge (member ids are ring replica ids)
+    for status in routers.values():
+        for rid, health in (status.get("replicas") or {}).items():
+            if rid in replicas:
+                replicas[rid]["health"] = health
+            else:
+                replicas[rid] = {"health": health}
+    lifecycle = {
+        mid: {
+            "unix_ms": info.get("unix_ms"),
+            "status": info.get("status") or {},
+        }
+        for mid, info in members.items()
+        if info.get("role") == "lifecycle"
+    }
+    return {
+        "snapshot_version": merged.get("snapshot_version"),
+        "role": "plane",
+        "ts": merged.get("ts"),
+        "unix_ms": merged.get("unix_ms"),
+        "signals": signals,
+        "replicas": replicas,
+        "routers": routers,
+        "lifecycle": lifecycle,
+        "merge_errors": merged.get("merge_errors") or [],
+        "poll": merged.get("poll") or {},
+    }
+
+
+# --------------------------------------------------------------------------
+# standalone WSGI app (router-less deployments: `gordo-tpu rollup`)
+# --------------------------------------------------------------------------
+
+
+def rollup_wsgi_app(poller: RollupPoller):
+    """A minimal WSGI app serving the merged view: ``/metrics``
+    (Prometheus text), ``/status`` (JSON), ``/telemetry/snapshot``
+    (the full merged snapshot), ``/healthcheck``."""
+
+    def app(environ, start_response):
+        path = environ.get("PATH_INFO", "/")
+        if path == "/healthcheck":
+            body = json.dumps({"gordo-tpu-rollup": True}).encode()
+            content_type = "application/json"
+        elif path == "/metrics":
+            merged = poller.merged() or poller.poll_once()
+            body = render_prometheus_text(merged.get("metrics") or {}).encode()
+            content_type = "text/plain; version=0.0.4"
+        elif path == "/status":
+            body = json.dumps(poller.status_payload(), default=str).encode()
+            content_type = "application/json"
+        elif path == "/telemetry/snapshot":
+            merged = poller.merged() or poller.poll_once()
+            body = json.dumps(merged, default=str).encode()
+            content_type = "application/json"
+        else:
+            body = json.dumps({"error": "Not found"}).encode()
+            start_response(
+                "404 NOT FOUND", [("Content-Type", "application/json")]
+            )
+            return [body]
+        start_response(
+            "200 OK",
+            [
+                ("Content-Type", content_type),
+                ("Content-Length", str(len(body))),
+            ],
+        )
+        return [body]
+
+    return app

@@ -1,0 +1,297 @@
+"""
+SLO engine: error budgets as executable objects.
+
+A declarative spec (YAML or JSON) names objectives over the plane
+control signals the rollup computes (rollup.py ``compute_signals``):
+
+.. code-block:: yaml
+
+    name: serving
+    objectives:
+      - signal: predict_p99_ms
+        threshold: 250          # violating when signal > threshold
+        window_s: 3600          # samples older than this are ignored
+        budget: 0.01            # allowed violating fraction of samples
+
+Evaluation (:func:`evaluate`) runs the spec against a chronological
+sequence of merged snapshots (one poll each, e.g. the rollup's
+persisted JSONL) and yields per-objective error-budget objects:
+
+- ``violating_fraction`` — fraction of in-window samples over threshold
+- ``burn_rate`` — ``violating_fraction / budget`` (1.0 = burning the
+  budget exactly as fast as the window allows; >1 = on track to
+  exhaust)
+- ``exhausted`` — the budget is spent (``violating_fraction >= budget``
+  with a non-trivial sample count)
+
+``gordo-tpu slo check <spec> <snapshot-or-url>`` exits nonzero on any
+exhausted objective — the gate benches and gameday scenarios assert.
+With no spec configured nothing here ever runs (the strict no-op the
+tests pin).
+"""
+
+import dataclasses
+import json
+import typing
+
+from gordo_tpu.observability import rollup as rollup_mod
+
+#: the signal names a spec may target — the rollup's control-signal
+#: vocabulary (docs/observability.md "Plane rollup and control signals")
+KNOWN_SIGNALS = (
+    "predict_p99_ms",
+    "shed_rate",
+    "unstructured_error_rate",
+    "stream_resume_rate",
+    "drift_scan_staleness_s",
+    "queue_depth",
+    "stream_backlog",
+    "program_cache_hit_rate",
+)
+
+DEFAULT_WINDOW_S = 3600.0
+DEFAULT_BUDGET = 0.01
+
+
+class SloSpecError(ValueError):
+    """A spec that cannot be evaluated (unknown signal, bad shape)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class SloObjective:
+    """One objective: ``signal`` must stay <= ``threshold`` for all but
+    a ``budget`` fraction of the samples in the trailing window."""
+
+    signal: str
+    threshold: float
+    window_s: float = DEFAULT_WINDOW_S
+    budget: float = DEFAULT_BUDGET
+    name: typing.Optional[str] = None
+
+    def label(self) -> str:
+        return self.name or self.signal
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.label(),
+            "signal": self.signal,
+            "threshold": self.threshold,
+            "window_s": self.window_s,
+            "budget": self.budget,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class SloSpec:
+    name: str
+    objectives: typing.Tuple[SloObjective, ...]
+
+    def to_dict(self) -> dict:
+        return {
+            "name": self.name,
+            "objectives": [o.to_dict() for o in self.objectives],
+        }
+
+
+@dataclasses.dataclass
+class ObjectiveResult:
+    """The error-budget object one objective evaluates to."""
+
+    objective: SloObjective
+    n_samples: int
+    n_violating: int
+    last_value: typing.Optional[float]
+    violating_fraction: float
+    burn_rate: float
+    exhausted: bool
+
+    def to_dict(self) -> dict:
+        return {
+            **self.objective.to_dict(),
+            "n_samples": self.n_samples,
+            "n_violating": self.n_violating,
+            "last_value": self.last_value,
+            "violating_fraction": self.violating_fraction,
+            "burn_rate": self.burn_rate,
+            "exhausted": self.exhausted,
+        }
+
+
+@dataclasses.dataclass
+class SloReport:
+    spec: SloSpec
+    results: typing.List[ObjectiveResult]
+
+    @property
+    def ok(self) -> bool:
+        return not any(r.exhausted for r in self.results)
+
+    @property
+    def max_burn_rate(self) -> float:
+        return max((r.burn_rate for r in self.results), default=0.0)
+
+    def to_dict(self) -> dict:
+        return {
+            "spec": self.spec.name,
+            "ok": self.ok,
+            "max_burn_rate": self.max_burn_rate,
+            "objectives": [r.to_dict() for r in self.results],
+        }
+
+
+# --------------------------------------------------------------------------
+# spec loading
+# --------------------------------------------------------------------------
+
+
+def parse_slo_spec(document: dict, name: str = "slo") -> SloSpec:
+    if not isinstance(document, dict):
+        raise SloSpecError("SLO spec must be a mapping")
+    raw_objectives = document.get("objectives")
+    if not isinstance(raw_objectives, list) or not raw_objectives:
+        raise SloSpecError("SLO spec needs a non-empty 'objectives' list")
+    objectives = []
+    for raw in raw_objectives:
+        if not isinstance(raw, dict):
+            raise SloSpecError(f"Objective must be a mapping, got {raw!r}")
+        signal = raw.get("signal") or raw.get("objective")
+        if signal not in KNOWN_SIGNALS:
+            raise SloSpecError(
+                f"Unknown SLO signal {signal!r}; known: {KNOWN_SIGNALS}"
+            )
+        if "threshold" not in raw:
+            raise SloSpecError(f"Objective {signal!r} needs a 'threshold'")
+        objectives.append(
+            SloObjective(
+                signal=signal,
+                threshold=float(raw["threshold"]),
+                window_s=float(raw.get("window_s", DEFAULT_WINDOW_S)),
+                budget=float(raw.get("budget", DEFAULT_BUDGET)),
+                name=raw.get("name"),
+            )
+        )
+    return SloSpec(
+        name=str(document.get("name") or name), objectives=tuple(objectives)
+    )
+
+
+def load_slo_spec(path: str) -> SloSpec:
+    """Load a spec from a YAML or JSON file."""
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        document = json.loads(text)
+    except ValueError:
+        import yaml
+
+        try:
+            document = yaml.safe_load(text)
+        except yaml.YAMLError as exc:
+            raise SloSpecError(f"Unparseable SLO spec {path}: {exc}")
+    import os
+
+    return parse_slo_spec(
+        document, name=os.path.splitext(os.path.basename(path))[0]
+    )
+
+
+# --------------------------------------------------------------------------
+# evaluation
+# --------------------------------------------------------------------------
+
+
+def _snapshot_signals(snapshot: dict) -> typing.Optional[dict]:
+    """The signal dict of one snapshot: embedded ``signals`` when the
+    rollup already computed the windowed numbers (preferred), else
+    lifetime-derived from the raw metrics dump."""
+    if not isinstance(snapshot, dict):
+        return None
+    signals = snapshot.get("signals")
+    if isinstance(signals, dict):
+        return signals
+    if isinstance(snapshot.get("metrics"), dict):
+        return rollup_mod.compute_signals(snapshot)
+    return None
+
+
+def evaluate(
+    spec: SloSpec,
+    snapshots: typing.Sequence[dict],
+    now: typing.Optional[float] = None,
+) -> SloReport:
+    """Evaluate ``spec`` over chronological merged snapshots.
+
+    Each snapshot contributes one sample per objective (its signal
+    value at that poll); snapshots older than an objective's window —
+    judged by their ``unix_ms`` stamp against the NEWEST snapshot (or
+    ``now``) — are ignored, as are snapshots where the signal is
+    absent/None (no traffic is not a violation).
+    """
+    stamped = [s for s in snapshots if isinstance(s, dict)]
+    if now is not None:
+        now_ms = now * 1000.0
+    else:
+        stamps = [s.get("unix_ms") for s in stamped if s.get("unix_ms")]
+        now_ms = max(stamps) if stamps else 0.0
+    results = []
+    for objective in spec.objectives:
+        n_samples = n_violating = 0
+        last_value: typing.Optional[float] = None
+        for snapshot in stamped:
+            unix_ms = snapshot.get("unix_ms") or now_ms
+            if now_ms and (now_ms - unix_ms) > objective.window_s * 1000.0:
+                continue
+            signals = _snapshot_signals(snapshot)
+            if not signals:
+                continue
+            value = signals.get(objective.signal)
+            if value is None:
+                continue
+            value = float(value)
+            n_samples += 1
+            last_value = value
+            if value > objective.threshold:
+                n_violating += 1
+        fraction = (n_violating / n_samples) if n_samples else 0.0
+        budget = max(objective.budget, 1e-12)
+        results.append(
+            ObjectiveResult(
+                objective=objective,
+                n_samples=n_samples,
+                n_violating=n_violating,
+                last_value=last_value,
+                violating_fraction=fraction,
+                burn_rate=fraction / budget,
+                exhausted=bool(n_samples) and fraction >= budget,
+            )
+        )
+    return SloReport(spec=spec, results=results)
+
+
+def evaluate_values(
+    spec: SloSpec, signals: typing.Mapping[str, typing.Optional[float]]
+) -> SloReport:
+    """Evaluate a spec against ONE signal dict (a bench run's measured
+    numbers, a single ``/status`` fetch): every objective gets exactly
+    one sample, so ``exhausted`` degenerates to "over threshold"."""
+    return evaluate(
+        spec, [{"signals": dict(signals), "unix_ms": 0}], now=0.0
+    )
+
+
+def render_report(report: SloReport) -> str:
+    """Human-readable report table (the ``slo check`` output)."""
+    lines = [
+        f"SLO spec: {report.spec.name} — "
+        + ("OK" if report.ok else "BUDGET EXHAUSTED")
+    ]
+    for r in report.results:
+        last = "n/a" if r.last_value is None else f"{r.last_value:.4g}"
+        verdict = "EXHAUSTED" if r.exhausted else "ok"
+        lines.append(
+            f"  {r.objective.label():<28} <= {r.objective.threshold:<10g} "
+            f"last={last:<10} samples={r.n_samples:<5} "
+            f"violating={r.violating_fraction:6.1%} "
+            f"burn={r.burn_rate:8.2f}x  {verdict}"
+        )
+    return "\n".join(lines)

@@ -45,6 +45,7 @@ from werkzeug.wrappers import Request, Response
 
 from gordo_tpu import __version__
 from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.observability import rollup as rollup_mod
 from gordo_tpu.robustness import faults
 from gordo_tpu.router.health import ReplicaHealthTracker
 from gordo_tpu.router.ring import DEFAULT_VNODES, HashRing
@@ -82,6 +83,18 @@ class RouterConfig:
     #: admission control: concurrent requests in flight past this shed
     #: with 503 + Retry-After at the router's own door
     MAX_INFLIGHT = 64
+    #: plane rollup (docs/observability.md "Plane rollup and control
+    #: signals"): poll interval for merging member /telemetry/snapshot
+    #: registries into the router's /status + /metrics view. 0 disables
+    #: the poller thread entirely (the house strict no-op); /status
+    #: then polls on demand, per request.
+    ROLLUP_INTERVAL_S = 0.0
+    #: merged snapshots kept in the persisted JSONL (oldest trimmed)
+    ROLLUP_RETENTION = 500
+    #: JSONL path periodic merged snapshots persist to (next to the
+    #: artifacts, so `gordo-tpu tune` ingests them as observations);
+    #: None disables persistence
+    ROLLUP_PERSIST_PATH: typing.Optional[str] = None
     #: test seam: a pre-built requests.Session (the loopback harness
     #: injects one routing straight into in-process replica apps)
     SESSION: typing.Optional[typing.Any] = None
@@ -183,7 +196,10 @@ class _ShardResult:
 class RouterApp:
     """WSGI router fronting N ``run-server`` shard replicas."""
 
-    _TRACE_EXEMPT_PATHS = frozenset({"/healthcheck", "/healthz"})
+    _TRACE_EXEMPT_PATHS = frozenset(
+        {"/healthcheck", "/healthz", "/metrics", "/status",
+         "/telemetry/snapshot"}
+    )
 
     def __init__(self, config: typing.Optional[dict] = None):
         self.config = RouterConfig().to_dict()
@@ -233,6 +249,20 @@ class RouterApp:
             )
             self._prober.start()
 
+        # plane rollup (docs/observability.md "Plane rollup and control
+        # signals"): with an interval the poller thread keeps the merged
+        # view warm; without one NOTHING runs — no thread, no member
+        # requests (the strict no-op) — and /status|/metrics poll the
+        # members synchronously, per request, via a lazy threadless
+        # poller.
+        self._started_at = time.time()
+        self._rollup_lock = threading.Lock()
+        self._rollup: typing.Optional["rollup_mod.RollupPoller"] = None
+        rollup_interval = float(self.config.get("ROLLUP_INTERVAL_S") or 0.0)
+        if rollup_interval > 0:
+            self._rollup = self._build_rollup(rollup_interval)
+            self._rollup.start()
+
         self.url_map = Map(
             [
                 Rule("/healthcheck", endpoint="healthcheck", methods=["GET"]),
@@ -240,6 +270,16 @@ class RouterApp:
                 Rule(
                     "/server-version", endpoint="server_version", methods=["GET"]
                 ),
+                # the plane rollup surface: this process's own snapshot,
+                # plus the merged plane view (docs/observability.md
+                # "Plane rollup and control signals")
+                Rule(
+                    "/telemetry/snapshot",
+                    endpoint="telemetry_snapshot",
+                    methods=["GET"],
+                ),
+                Rule("/status", endpoint="status", methods=["GET"]),
+                Rule("/metrics", endpoint="metrics", methods=["GET"]),
                 Rule("/router/replicas", endpoint="replicas", methods=["GET"]),
                 Rule(
                     "/router/replicas",
@@ -367,6 +407,70 @@ class RouterApp:
         if self._prober is not None:
             self._prober.join(timeout=5.0)
             self._prober = None
+        with self._rollup_lock:
+            rollup, self._rollup = self._rollup, None
+        if rollup is not None:
+            rollup.stop()
+
+    # -- plane rollup ------------------------------------------------------
+
+    def _build_rollup(self, interval_s: float) -> rollup_mod.RollupPoller:
+        def members() -> typing.Dict[str, str]:
+            replicas, _ = self.routing_view()
+            return dict(replicas)
+
+        def fetch(url: str) -> dict:
+            response = self.session.get(
+                url.rstrip("/") + "/telemetry/snapshot",
+                timeout=self.replica_timeout_s,
+            )
+            response.raise_for_status()
+            return response.json()
+
+        return rollup_mod.RollupPoller(
+            members=members,
+            interval_s=interval_s,
+            fetch=fetch,
+            local_members={"__router__": self._self_snapshot},
+            persist_path=self.config.get("ROLLUP_PERSIST_PATH") or None,
+            retention=int(self.config.get("ROLLUP_RETENTION") or 500),
+            name="router-rollup",
+        )
+
+    def _rollup_poller(self) -> rollup_mod.RollupPoller:
+        """The embedded poller, or — when no interval is configured — a
+        threadless one created lazily on the first /status|/metrics
+        request (so an unconfigured rollup costs nothing at all)."""
+        with self._rollup_lock:
+            if self._rollup is None:
+                self._rollup = self._build_rollup(0.0)
+            return self._rollup
+
+    def _self_snapshot(self) -> dict:
+        """This router process's own /telemetry/snapshot payload — also
+        the local member the merged plane view includes."""
+        replicas, _ = self.routing_view()
+        routable = [r for r in replicas if self.health.routable(r)]
+        return rollup_mod.snapshot_payload(
+            role="router",
+            status={
+                "status": "ok" if routable else "no_replicas",
+                "replicas": self.health.snapshot(),
+                "routable": len(routable),
+                "max_inflight": self.max_inflight,
+            },
+            registry=get_registry(),
+            started_at=self._started_at,
+        )
+
+    def _merged_snapshot(self) -> dict:
+        """The latest merged plane snapshot: cached when the poller
+        thread runs, polled synchronously otherwise."""
+        poller = self._rollup_poller()
+        merged = poller.merged()
+        if merged is None or poller.interval_s <= 0:
+            merged = poller.poll_once()
+        return merged
 
     # -- health probing ----------------------------------------------------
 
@@ -694,6 +798,29 @@ class RouterApp:
             round(min(retry_in), 2) if retry_in else 1.0
         )
         return response
+
+    def view_telemetry_snapshot(self, ctx, request) -> Response:
+        """The snapshot contract: this ROUTER process's own registry
+        dump + identity (the merged plane view lives at /status and
+        /metrics — a rollup polling a router must not re-merge an
+        already-merged registry)."""
+        return _json_response(self._self_snapshot())
+
+    def view_status(self, ctx, request) -> Response:
+        """The plane /status: per-replica health/breaker state, shed
+        rates, queue depths, stream backlogs, program-cache hit rate,
+        last lifecycle tick — the one page `gordo-tpu top` renders."""
+        return _json_response(rollup_mod.plane_status(self._merged_snapshot()))
+
+    def view_metrics(self, ctx, request) -> Response:
+        """Plane-level Prometheus exposition of the MERGED registries:
+        counters are plane sums, gauges carry a `replica` label,
+        histograms are bucket-wise merges."""
+        merged = self._merged_snapshot()
+        return Response(
+            rollup_mod.render_prometheus_text(merged.get("metrics") or {}),
+            mimetype="text/plain",
+        )
 
     def view_models(self, ctx, request, gordo_project: str) -> Response:
         """The WHOLE collection's /models, derived from the shared
@@ -1762,6 +1889,9 @@ def build_router_app(config: typing.Optional[dict] = None) -> RouterApp:
         ("HEDGE_MS", "GORDO_ROUTER_HEDGE_MS", float),
         ("REPLICA_TIMEOUT_S", "GORDO_ROUTER_REPLICA_TIMEOUT_S", float),
         ("MAX_INFLIGHT", "GORDO_ROUTER_MAX_INFLIGHT", int),
+        ("ROLLUP_INTERVAL_S", "GORDO_ROLLUP_INTERVAL_S", float),
+        ("ROLLUP_RETENTION", "GORDO_ROLLUP_RETENTION", int),
+        ("ROLLUP_PERSIST_PATH", "GORDO_ROLLUP_PERSIST", str),
     ):
         if key not in config and os.environ.get(env):
             config[key] = cast(os.environ[env])

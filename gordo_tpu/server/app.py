@@ -187,6 +187,14 @@ class GordoApp:
                 Rule("/healthz", endpoint="healthz", methods=["GET"]),
                 Rule("/server-version", endpoint="server_version", methods=["GET"]),
                 Rule("/metrics", endpoint="metrics", methods=["GET"]),
+                # the plane rollup's snapshot contract: full registry
+                # dump + process identity (docs/observability.md "Plane
+                # rollup and control signals")
+                Rule(
+                    "/telemetry/snapshot",
+                    endpoint="telemetry_snapshot",
+                    methods=["GET"],
+                ),
                 Rule(
                     "/gordo/v0/<gordo_project>/models",
                     endpoint="models",
@@ -311,6 +319,8 @@ class GordoApp:
         # flip observes the change here and rolls the stale batchers.
         self._served_latest: typing.Optional[str] = None
         self._served_latest_lock = threading.Lock()
+        #: process start — the uptime epoch /telemetry/snapshot reports
+        self._started_at = time.time()
         self.prometheus_metrics = None
         if self.config.get("ENABLE_PROMETHEUS"):
             from gordo_tpu.server.prometheus.metrics import (
@@ -342,7 +352,9 @@ class GordoApp:
     #: counting (a liveness probe + scrape would mint tens of thousands
     #: of junk single-span traces per worker per day). A probe carrying
     #: a traceparent still gets its id echoed; it just records nothing.
-    _TRACE_EXEMPT_PATHS = frozenset({"/healthcheck", "/healthz", "/metrics"})
+    _TRACE_EXEMPT_PATHS = frozenset(
+        {"/healthcheck", "/healthz", "/metrics", "/telemetry/snapshot"}
+    )
 
     def dispatch(self, request: Request) -> Response:
         ctx = RequestContext()
@@ -588,6 +600,7 @@ class GordoApp:
             "/healthcheck",
             "/healthz",  # probes are not traffic either
             "/metrics",  # don't count scrapes as server traffic
+            "/telemetry/snapshot",  # rollup polls are not traffic
         ):
             self.prometheus_metrics.observe(
                 request=request,
@@ -674,7 +687,7 @@ class GordoApp:
 
     #: endpoints whose JSON body must keep its exact schema — the revision
     #: stamp would add a foreign top-level key (it still rides the header)
-    _REVISION_BODY_EXEMPT = frozenset({"specs"})
+    _REVISION_BODY_EXEMPT = frozenset({"specs", "telemetry_snapshot"})
 
     #: endpoint -> public operation summary for the generated OpenAPI spec
     #: (docstrings are internal and may cite reference file:line — not
@@ -685,6 +698,9 @@ class GordoApp:
         "healthz": "Readiness check (reflects batching-queue saturation)",
         "server_version": "Server version",
         "metrics": "Prometheus metrics exposition",
+        "telemetry_snapshot": (
+            "Versioned registry dump + process identity (plane rollup)"
+        ),
         "models": "List models in the served revision",
         "revisions": "List available model revisions",
         "expected_models": "List models the deployment expects",
@@ -1013,6 +1029,18 @@ class GordoApp:
         router/LB drains this replica before users see stalls. Queue
         depths and shed counters ride the body either way.
         """
+        payload, retry_after = self._readiness_payload()
+        if retry_after is not None:
+            response = _json_response(payload, 503)
+            response.headers["Retry-After"] = str(retry_after)
+            return response
+        return _json_response(payload)
+
+    def _readiness_payload(
+        self,
+    ) -> typing.Tuple[dict, typing.Optional[float]]:
+        """The ``/healthz`` body + Retry-After (None while absorbing
+        work) — shared with ``/telemetry/snapshot``'s status block."""
         stats = self.catalog.batcher_stats()
         overloaded = [s for s in stats if s["saturated"] or s["shedding"]]
         stream_stats = self.catalog.stream_stats()
@@ -1038,15 +1066,35 @@ class GordoApp:
                 "saturated_sessions": len(stream_overloaded),
             },
         }
+        retry_after = None
         if overloaded or stream_overloaded:
-            response = _json_response(payload, 503)
-            response.headers["Retry-After"] = str(
-                max(
-                    s["retry_after_s"]
-                    for s in overloaded + stream_overloaded
-                )
+            retry_after = max(
+                s["retry_after_s"] for s in overloaded + stream_overloaded
             )
-            return response
+        return payload, retry_after
+
+    def view_telemetry_snapshot(self, ctx, request) -> Response:
+        """
+        The plane rollup's snapshot contract (docs/observability.md
+        "Plane rollup and control signals"): this replica's full metrics
+        registry plus process identity, versioned. Polled by the router
+        (or ``gordo-tpu rollup``) and merged into the plane view — the
+        one endpoint from which every plane-level number derives.
+        """
+        from gordo_tpu.observability import rollup as rollup_mod
+
+        status, _ = self._readiness_payload()
+        replica_id = self.config.get("REPLICA_ID")
+        if self.catalog.shard is not None:
+            replica_id = self.catalog.shard.replica_id
+        payload = rollup_mod.snapshot_payload(
+            role="replica",
+            replica_id=replica_id,
+            revision=ctx.revision or None,
+            status=status,
+            registry=get_registry(),
+            started_at=self._started_at,
+        )
         return _json_response(payload)
 
     def view_fleet_prediction(

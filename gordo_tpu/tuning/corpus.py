@@ -28,6 +28,7 @@ import math
 import typing
 from pathlib import Path
 
+from gordo_tpu.observability import registry as registry_mod
 from gordo_tpu.tuning.knobs import KNOBS, Knob, Signal
 
 logger = logging.getLogger(__name__)
@@ -139,50 +140,10 @@ def _is_scalar(value) -> bool:
     )
 
 
-def _histogram_state(value) -> typing.Optional[dict]:
-    """The ``{count, sum, buckets}`` dict inside ``value``, accepting
-    both a bare state and the registry-snapshot ``{"kind": "histogram",
-    "series": [{"value": state}]}`` wrapper (first series)."""
-    if not isinstance(value, dict):
-        return None
-    if value.get("kind") == "histogram":
-        series = value.get("series") or []
-        value = (series[0] or {}).get("value") if series else None
-        if not isinstance(value, dict):
-            return None
-    if not {"count", "sum", "buckets"} <= set(value):
-        return None
-    return value
-
-
-def _histogram_stat(state: dict, stat: str) -> typing.Optional[float]:
-    count = state.get("count") or 0
-    if not count:
-        return None
-    if stat == "mean":
-        return float(state["sum"]) / count
-    if stat == "p99":
-        buckets = state.get("buckets")
-        if not isinstance(buckets, dict) or not buckets:
-            return None
-        bounds = []
-        for raw_bound, cum in buckets.items():
-            bound = (
-                math.inf
-                if str(raw_bound) in ("+Inf", "inf", "Infinity")
-                else float(raw_bound)
-            )
-            bounds.append((bound, float(cum)))
-        bounds.sort(key=lambda pair: pair[0])
-        target = 0.99 * count
-        for bound, cum in bounds:
-            if cum >= target:
-                if math.isinf(bound):
-                    # everything past the largest finite bucket: the
-                    # mean is the honest (if coarse) stand-in
-                    return float(state["sum"]) / count
-                return bound
-    return None
+# Histogram-snapshot math lives in observability.registry so the corpus
+# reader, the SLO engine, and the rollup merge share one implementation.
+_histogram_state = registry_mod.histogram_state
+_histogram_stat = registry_mod.histogram_stat
 
 
 def _derived_fields(node: dict) -> typing.Dict[str, float]:

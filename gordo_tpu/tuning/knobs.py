@@ -627,6 +627,29 @@ KNOBS: typing.Tuple[Knob, ...] = (
         domain=IntRange(1, 1024),
         doc="Bound on concurrently handled router requests",
     ),
+    Knob(
+        name="rollup_interval_s",
+        flag="--rollup-interval",
+        cli="run-router",
+        env_var="GORDO_ROLLUP_INTERVAL_S",
+        default=0.0,
+        subsystem="router",
+        domain=FloatRange(0.0, 3600.0),
+        doc="Seconds between plane-rollup polls of member "
+        "/telemetry/snapshot endpoints (0 = no poller thread; /status "
+        "polls on demand)",
+    ),
+    Knob(
+        name="rollup_retention",
+        flag="--rollup-retention",
+        cli="run-router",
+        env_var="GORDO_ROLLUP_RETENTION",
+        default=500,
+        subsystem="router",
+        domain=IntRange(1, 1_000_000),
+        doc="Merged plane snapshots kept in the persisted rollup JSONL "
+        "(oldest trimmed)",
+    ),
 )
 
 KNOBS_BY_NAME: typing.Dict[str, Knob] = {k.name: k for k in KNOBS}
@@ -644,6 +667,8 @@ NON_KNOB_ENV_VARS: typing.FrozenSet[str] = frozenset(
         "GORDO_SKIP_TUNE_CHECK",
         # observability sinks + sampling (config, not tunables)
         "GORDO_TPU_EVENT_LOG",
+        "GORDO_TPU_EVENT_LOG_MAX_MB",
+        "GORDO_ROLLUP_PERSIST",
         "GORDO_TPU_TRACE_LOG",
         "GORDO_TPU_TRACE_SAMPLE",
         "GORDO_TPU_PROFILE_DIR",

@@ -1,0 +1,1096 @@
+"""
+Plane-wide telemetry rollup + SLO engine tests
+(docs/observability.md "Plane rollup and control signals"): the
+/telemetry/snapshot contract, the registry merge (counters sum, gauges
+union under a replica label, histograms bucket-wise — mismatches
+refused loudly), the counter-reset clamp, windowed control signals,
+the poller's persistence/corpus-ingestion path, the SLO engine's
+error-budget math and `slo check` exit codes, event-log size rotation,
+and the e2e acceptance: router + 2 replicas, a mid-run kill visible in
+/status within one poll, merged /metrics equal to the exact sum of the
+per-member counters, and the strict no-ops (no poller configured ⇒
+zero threads + zero snapshot requests).
+"""
+
+import json
+import os
+import re
+import shutil
+import threading
+
+import numpy as np
+import pytest
+import requests
+from click.testing import CliRunner
+from werkzeug.test import Client as WerkzeugClient
+
+from gordo_tpu import serializer
+from gordo_tpu.machine import Machine
+from gordo_tpu.models import AutoEncoder
+from gordo_tpu.observability import get_registry, read_events
+from gordo_tpu.observability.events import (
+    EVENT_LOG_ENV_VAR,
+    EVENT_LOG_MAX_MB_ENV_VAR,
+    emit_event,
+)
+from gordo_tpu.observability.registry import (
+    HistogramMergeError,
+    MetricsRegistry,
+    histogram_quantile,
+    histogram_stat,
+    histogram_state,
+    merge_histogram_states,
+)
+from gordo_tpu.observability.rollup import (
+    CounterClamp,
+    RollupPoller,
+    compute_signals,
+    merge_metrics,
+    merge_snapshots,
+    plane_status,
+    render_prometheus_text,
+    snapshot_payload,
+)
+from gordo_tpu.observability.slo import (
+    SloSpecError,
+    evaluate,
+    evaluate_values,
+    load_slo_spec,
+    parse_slo_spec,
+)
+from gordo_tpu.server.catalog import write_shard_manifest
+from tests.test_router import MultiReplicaAdapter, Plane
+
+PROJECT = "rollup-proj"
+TAGS = [f"tag-{i}" for i in range(3)]
+MACHINES = [f"ru-m{i}" for i in range(4)]
+
+#: routers built during the current test — closed after it
+_ROUTERS: list = []
+
+
+@pytest.fixture(autouse=True)
+def _close_routers():
+    yield
+    while _ROUTERS:
+        _ROUTERS.pop().close()
+
+
+# -- dump builders (hand-shaped registry snapshots) ------------------------
+
+
+def _counter(value, labels=None, labelnames=()):
+    return {
+        "type": "counter",
+        "description": "d",
+        "labelnames": list(labelnames),
+        "series": [{"labels": dict(labels or {}), "value": value}],
+    }
+
+
+def _gauge(value, labels=None, labelnames=()):
+    return {
+        "type": "gauge",
+        "description": "d",
+        "labelnames": list(labelnames),
+        "series": [{"labels": dict(labels or {}), "value": value}],
+    }
+
+
+def _histogram(count, total, buckets, labels=None, labelnames=()):
+    return {
+        "type": "histogram",
+        "description": "d",
+        "labelnames": list(labelnames),
+        "series": [
+            {
+                "labels": dict(labels or {}),
+                "count": count,
+                "sum": total,
+                "buckets": dict(buckets),
+            }
+        ],
+    }
+
+
+def _series_by_labels(dump, **labels):
+    for series in dump["series"]:
+        if series["labels"] == labels:
+            return series
+    raise AssertionError(f"no series with labels {labels} in {dump}")
+
+
+# -- S1: shared histogram math ---------------------------------------------
+
+
+def test_histogram_quantile_and_stat():
+    state = {"count": 100, "sum": 5.0, "buckets": {"0.05": 90, "0.1": 100, "+Inf": 100}}
+    assert histogram_quantile(state, 0.5) == 0.05
+    assert histogram_quantile(state, 0.99) == 0.1
+    assert histogram_stat(state, "p50") == 0.05
+    assert histogram_stat(state, "mean") == pytest.approx(0.05)
+    assert histogram_stat(state, "count") == 100
+
+
+def test_histogram_quantile_inf_bucket_falls_back_to_mean():
+    """A quantile landing in +Inf has no finite bound — the mean is the
+    honest scalar (the corpus reader's long-standing behavior, now
+    shared)."""
+    state = {"count": 4, "sum": 2.0, "buckets": {"0.1": 3, "+Inf": 4}}
+    assert histogram_stat(state, "p99") == pytest.approx(0.5)
+
+
+def test_histogram_state_accepts_wrapper_shapes():
+    """Bare states, registry series, and the corpus's legacy
+    ``kind``-keyed wrapper all normalize to one shape."""
+    bare = {"count": 2, "sum": 1.0, "buckets": {"+Inf": 2}}
+    assert histogram_state(bare) == bare
+    wrapped = {"kind": "histogram", "series": [{"value": bare}]}
+    assert histogram_state(wrapped) == bare
+    inline = {"type": "histogram", "series": [dict(bare, labels={})]}
+    assert histogram_state(inline)["count"] == 2
+
+
+def test_merge_histogram_states_sums_bucketwise():
+    a = {"count": 3, "sum": 1.0, "buckets": {"0.1": 2, "+Inf": 3}}
+    b = {"count": 5, "sum": 4.0, "buckets": {"0.1": 1, "+Inf": 5}}
+    merged = merge_histogram_states(a, b)
+    assert merged["count"] == 8
+    assert merged["sum"] == pytest.approx(5.0)
+    assert merged["buckets"] == {"0.1": 3, "+Inf": 8}
+
+
+def test_merge_histogram_states_refuses_mismatched_bounds():
+    a = {"count": 1, "sum": 0.1, "buckets": {"0.1": 1, "+Inf": 1}}
+    b = {"count": 1, "sum": 0.1, "buckets": {"0.2": 1, "+Inf": 1}}
+    with pytest.raises(HistogramMergeError):
+        merge_histogram_states(a, b)
+
+
+def test_corpus_reader_uses_shared_histogram_helpers():
+    """One quantile implementation everywhere: the tuning corpus reader
+    delegates to observability.registry, not a private copy."""
+    from gordo_tpu.observability import registry as registry_mod
+    from gordo_tpu.tuning import corpus
+
+    assert corpus._histogram_stat is registry_mod.histogram_stat
+    assert corpus._histogram_state is registry_mod.histogram_state
+
+
+# -- the /telemetry/snapshot contract --------------------------------------
+
+
+def test_snapshot_payload_shape():
+    reg = MetricsRegistry()
+    reg.counter("gordo_x_total", "x").inc(3)
+    snap = snapshot_payload(
+        role="replica",
+        replica_id="r0",
+        revision="rev-9",
+        status={"status": "ok"},
+        registry=reg,
+        started_at=0.0,
+        now=100.0,
+    )
+    assert snap["snapshot_version"] == 1
+    assert snap["role"] == "replica"
+    assert snap["replica_id"] == "r0"
+    assert snap["revision"] == "rev-9"
+    assert snap["pid"] == os.getpid()
+    assert snap["uptime_s"] == pytest.approx(100.0)
+    assert snap["unix_ms"] == 100_000
+    assert snap["metrics"]["gordo_x_total"]["series"][0]["value"] == 3
+    assert snap["status"] == {"status": "ok"}
+
+
+# -- merge semantics (S4 edge cases included) ------------------------------
+
+
+def test_merge_counters_sum_across_members():
+    merged, errors = merge_metrics(
+        {
+            "r0": {"gordo_req_total": _counter(5, {"outcome": "ok"}, ["outcome"])},
+            "r1": {"gordo_req_total": _counter(7, {"outcome": "ok"}, ["outcome"])},
+        }
+    )
+    assert errors == []
+    series = _series_by_labels(merged["gordo_req_total"], outcome="ok")
+    assert series["value"] == 12.0
+
+
+def test_merge_counters_disjoint_labels_union():
+    """Disjoint label sets across replicas (one replica shed, the other
+    never did) union — no series is lost, none fabricated."""
+    merged, errors = merge_metrics(
+        {
+            "r0": {"gordo_req_total": _counter(5, {"outcome": "ok"}, ["outcome"])},
+            "r1": {"gordo_req_total": _counter(2, {"outcome": "shed"}, ["outcome"])},
+        }
+    )
+    assert errors == []
+    assert _series_by_labels(merged["gordo_req_total"], outcome="ok")["value"] == 5.0
+    assert _series_by_labels(merged["gordo_req_total"], outcome="shed")["value"] == 2.0
+
+
+def test_merge_gauges_union_under_replica_label():
+    merged, errors = merge_metrics(
+        {
+            "r0": {"gordo_queue_depth": _gauge(3)},
+            "r1": {"gordo_queue_depth": _gauge(4)},
+        }
+    )
+    assert errors == []
+    dump = merged["gordo_queue_depth"]
+    assert "replica" in dump["labelnames"]
+    assert _series_by_labels(dump, replica="r0")["value"] == 3
+    assert _series_by_labels(dump, replica="r1")["value"] == 4
+
+
+def test_merge_gauge_preexisting_replica_label_kept():
+    """The router's own per-replica health gauge already carries a
+    replica label — the member id must not clobber it."""
+    merged, _ = merge_metrics(
+        {
+            "__router__": {
+                "gordo_router_replica_healthy": _gauge(
+                    1, {"replica": "r1"}, ["replica"]
+                )
+            }
+        }
+    )
+    dump = merged["gordo_router_replica_healthy"]
+    assert _series_by_labels(dump, replica="r1")["value"] == 1
+
+
+def test_merge_histograms_bucketwise():
+    merged, errors = merge_metrics(
+        {
+            "r0": {"gordo_lat": _histogram(3, 1.0, {"0.1": 2, "+Inf": 3})},
+            "r1": {"gordo_lat": _histogram(5, 4.0, {"0.1": 1, "+Inf": 5})},
+        }
+    )
+    assert errors == []
+    series = merged["gordo_lat"]["series"][0]
+    assert series["count"] == 8
+    assert series["buckets"] == {"0.1": 3, "+Inf": 8}
+
+
+def test_merge_refuses_bucket_mismatch(tmp_path, monkeypatch):
+    """Members disagreeing on bucket boundaries (mixed code versions)
+    must drop the metric loudly — event + counter + merge_errors — and
+    never mis-merge, while OTHER metrics still merge."""
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv(EVENT_LOG_ENV_VAR, str(log))
+    before = _refusals_total()
+    merged, errors = merge_metrics(
+        {
+            "r0": {
+                "gordo_lat": _histogram(1, 0.1, {"0.1": 1, "+Inf": 1}),
+                "gordo_ok_total": _counter(1),
+            },
+            "r1": {
+                "gordo_lat": _histogram(1, 0.1, {"0.2": 1, "+Inf": 1}),
+                "gordo_ok_total": _counter(2),
+            },
+        }
+    )
+    assert "gordo_lat" not in merged
+    assert merged["gordo_ok_total"]["series"][0]["value"] == 3.0
+    assert len(errors) == 1
+    assert errors[0]["metric"] == "gordo_lat"
+    assert errors[0]["member"] == "r1"
+    assert _refusals_total() == before + 1
+    events = [e for e in read_events(str(log)) if e["event"] == "rollup_merge_refused"]
+    assert events and events[0]["metric"] == "gordo_lat"
+
+
+def test_merge_refuses_kind_mismatch():
+    merged, errors = merge_metrics(
+        {
+            "r0": {"gordo_thing": _counter(1)},
+            "r1": {"gordo_thing": _gauge(1)},
+        }
+    )
+    assert "gordo_thing" not in merged
+    assert errors and "kind mismatch" in errors[0]["error"]
+
+
+def _refusals_total():
+    dump = get_registry().snapshot().get("gordo_rollup_merge_refusals_total")
+    if not dump or not dump["series"]:
+        return 0.0
+    return dump["series"][0]["value"]
+
+
+def test_counter_reset_clamp(tmp_path, monkeypatch):
+    """A member restart (counter drops to ~0) must re-base, not drag the
+    plane sum backwards — and leave a rollup_counter_reset record."""
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv(EVENT_LOG_ENV_VAR, str(log))
+    clamp = CounterClamp()
+    first = clamp.adjust("r0", {"gordo_req_total": _counter(100)})
+    assert first["gordo_req_total"]["series"][0]["value"] == 100.0
+    # restart: the counter came back at 5 — adjusted = 100 (base) + 5
+    second = clamp.adjust("r0", {"gordo_req_total": _counter(5)})
+    assert second["gordo_req_total"]["series"][0]["value"] == 105.0
+    events = [e for e in read_events(str(log)) if e["event"] == "rollup_counter_reset"]
+    assert events and events[0]["member"] == "r0"
+    assert events[0]["last"] == 100.0 and events[0]["current"] == 5.0
+    # a second member's identical metric has independent clamp state
+    other = clamp.adjust("r1", {"gordo_req_total": _counter(50)})
+    assert other["gordo_req_total"]["series"][0]["value"] == 50.0
+
+
+# -- control signals -------------------------------------------------------
+
+
+def _member(role, status=None, unix_ms=None, revision=None):
+    return {
+        "role": role,
+        "replica_id": None,
+        "revision": revision,
+        "pid": 1,
+        "uptime_s": 1.0,
+        "unix_ms": unix_ms,
+        "status": status or {},
+    }
+
+
+def test_signals_windowed_shed_and_error_rate():
+    outcomes = {
+        "type": "counter",
+        "description": "d",
+        "labelnames": ["outcome"],
+        "series": [
+            {"labels": {"outcome": "ok"}, "value": 90.0},
+            {"labels": {"outcome": "shed"}, "value": 10.0},
+            {"labels": {"outcome": "error"}, "value": 2.0},
+        ],
+    }
+    previous = {
+        "metrics": {
+            "gordo_router_requests_total": {
+                **outcomes,
+                "series": [{"labels": {"outcome": "ok"}, "value": 40.0}],
+            }
+        }
+    }
+    current = {"metrics": {"gordo_router_requests_total": outcomes}}
+    signals = compute_signals(current, previous)
+    # window: ok 50, shed 10, error 2 → shed 10/62, error 2/62
+    assert signals["shed_rate"] == pytest.approx(10 / 62)
+    assert signals["unstructured_error_rate"] == pytest.approx(2 / 62)
+    # lifetime fallback on the first poll
+    lifetime = compute_signals(current, None)
+    assert lifetime["shed_rate"] == pytest.approx(10 / 102)
+
+
+def test_signals_routerless_shed_fallback():
+    """Without a router, sheds judge against the batching counters."""
+    current = {
+        "metrics": {
+            "gordo_serve_batch_shed_total": _counter(5),
+            "gordo_serve_batch_requests": _histogram(10, 95.0, {"+Inf": 10}),
+        }
+    }
+    signals = compute_signals(current)
+    assert signals["shed_rate"] == pytest.approx(5 / 100)
+
+
+def test_signals_predict_p99_windowed():
+    phase = lambda count, total, b: {  # noqa: E731 - tiny local builder
+        "type": "histogram",
+        "description": "d",
+        "labelnames": ["phase"],
+        "series": [
+            {
+                "labels": {"phase": "predict"},
+                "count": count,
+                "sum": total,
+                "buckets": dict(b),
+            }
+        ],
+    }
+    previous = {
+        "metrics": {
+            "gordo_server_phase_seconds": phase(100, 1.0, {"0.01": 100, "0.5": 100, "+Inf": 100})
+        }
+    }
+    current = {
+        "metrics": {
+            "gordo_server_phase_seconds": phase(200, 51.0, {"0.01": 100, "0.5": 200, "+Inf": 200})
+        }
+    }
+    signals = compute_signals(current, previous)
+    # the 100 new observations all landed in the 0.5 bucket → p99 500ms;
+    # the lifetime p99 would have been dragged down by the fast prior 100
+    assert signals["predict_p99_ms"] == pytest.approx(500.0)
+
+
+def test_signals_membership_and_staleness():
+    current = {
+        "metrics": {},
+        "members": {
+            "r0": _member("replica", {"status": "ok", "streaming": {"backlog": 2}}),
+            "r1": _member("replica", {"status": "unavailable"}),
+            "lc": _member("lifecycle", {"last_tick_unix_ms": 880_000}),
+        },
+    }
+    signals = compute_signals(current, now=1000.0)
+    assert signals["replicas_healthy"] == 1.0
+    assert signals["replicas_total"] == 2.0
+    assert signals["stream_backlog"] == 2.0
+    assert signals["drift_scan_staleness_s"] == pytest.approx(120.0)
+
+
+def test_signals_absent_inputs_are_none():
+    signals = compute_signals({"metrics": {}, "members": {}})
+    assert signals["predict_p99_ms"] is None
+    assert signals["stream_resume_rate"] is None
+    assert signals["drift_scan_staleness_s"] is None
+    assert signals["replicas_healthy"] is None
+
+
+# -- Prometheus text exposition --------------------------------------------
+
+
+def test_render_prometheus_text():
+    metrics = {
+        "gordo_req_total": _counter(12, {"outcome": "ok"}, ["outcome"]),
+        "gordo_lat": _histogram(3, 1.5, {"0.1": 2, "+Inf": 3}),
+    }
+    text = render_prometheus_text(metrics)
+    assert "# TYPE gordo_req_total counter" in text
+    assert 'gordo_req_total{outcome="ok"} 12' in text
+    assert 'gordo_lat_bucket{le="0.1"} 2' in text
+    assert 'gordo_lat_bucket{le="+Inf"} 3' in text
+    assert "gordo_lat_sum 1.5" in text
+    assert "gordo_lat_count 3" in text
+
+
+# -- the poller ------------------------------------------------------------
+
+
+def _local_replica(batch_wait_ms=5.0, value=10.0):
+    reg = MetricsRegistry()
+    reg.counter("gordo_router_requests_total", "d", ("outcome",)).inc(
+        value, outcome="ok"
+    )
+    hist = reg.histogram("gordo_serve_batch_queue_wait_seconds", "d")
+    for v in (0.001, 0.002, 0.004):
+        hist.observe(v)
+    return snapshot_payload(
+        role="replica",
+        replica_id="r0",
+        status={
+            "status": "ok",
+            "batching": {"batch_wait_ms": batch_wait_ms, "queue_limit": 64},
+        },
+        registry=reg,
+    )
+
+
+def test_poller_interval_zero_is_threadless():
+    poller = RollupPoller(members=lambda: {}, interval_s=0.0)
+    before = threading.active_count()
+    poller.start()
+    assert threading.active_count() == before
+    assert poller._thread is None
+
+
+def test_poller_polls_files_and_locals_and_persists(tmp_path):
+    """File members (the lifecycle daemon's last_tick.json), local
+    callables, persistence with retention, and downstream ingestion by
+    the telemetry-report reader and the tuning corpus."""
+    lc_snap = snapshot_payload(
+        role="lifecycle",
+        status={"last_tick_unix_ms": 123},
+        registry=MetricsRegistry(),
+    )
+    lc_path = tmp_path / "last_tick.json"
+    lc_path.write_text(json.dumps(lc_snap))
+    persist = tmp_path / "rollups" / "plane.jsonl"
+    poller = RollupPoller(
+        members=lambda: {"lifecycle": str(lc_path)},
+        local_members={"r0": _local_replica},
+        persist_path=str(persist),
+        retention=2,
+    )
+    for _ in range(3):
+        merged = poller.poll_once()
+    assert set(merged["members"]) == {"lifecycle", "r0"}
+    assert merged["members"]["lifecycle"]["role"] == "lifecycle"
+    assert merged["poll"]["member_errors"] == {}
+    assert merged["signals"]["drift_scan_staleness_s"] is not None
+    # retention trimmed 3 polls to the last 2 lines
+    lines = persist.read_text().strip().splitlines()
+    assert len(lines) == 2
+    record = json.loads(lines[-1])
+    # plane-uniform knobs lifted for the corpus walker
+    assert record["batch_wait_ms"] == 5.0
+    assert record["queue_limit"] == 64
+
+    from gordo_tpu.observability.report import load_rollup_files, summarize_rollups
+
+    found = load_rollup_files(tmp_path)
+    assert len(found) == 1
+    summary = summarize_rollups(found)[0]
+    assert summary["n_snapshots"] == 2
+    assert summary["members"]["r0"]["role"] == "replica"
+
+    from gordo_tpu.tuning.corpus import read_corpus
+
+    corpus = read_corpus([str(persist)])
+    assert not any(note.error for note in corpus.files)
+    assert any(o.knob == "batch_wait_ms" for o in corpus.observations)
+
+
+def test_poller_dead_member_is_data_not_crash(tmp_path):
+    poller = RollupPoller(
+        members=lambda: {"gone": str(tmp_path / "missing.json")},
+        local_members={"r0": _local_replica},
+    )
+    merged = poller.poll_once()
+    assert "gone" in merged["poll"]["member_errors"]
+    assert set(merged["members"]) == {"r0"}
+    status = plane_status(merged)
+    assert status["poll"]["member_errors"]
+
+
+def test_merge_snapshots_and_plane_status_shape():
+    members = {
+        "r0": snapshot_payload(
+            role="replica",
+            replica_id="r0",
+            revision="rev-1",
+            status={"status": "ok", "batching": {"queue_depth": 0, "sheds_total": 0}},
+            registry=MetricsRegistry(),
+        ),
+        "__router__": snapshot_payload(
+            role="router",
+            status={"status": "ok", "replicas": {"r0": {"state": "healthy"}}},
+            registry=MetricsRegistry(),
+        ),
+    }
+    merged = merge_snapshots(members)
+    merged["signals"] = compute_signals(merged)
+    status = plane_status(merged)
+    assert status["role"] == "plane"
+    assert status["replicas"]["r0"]["status"] == "ok"
+    assert status["replicas"]["r0"]["revision"] == "rev-1"
+    # the router's breaker state rides the replica row
+    assert status["replicas"]["r0"]["health"] == {"state": "healthy"}
+    assert "__router__" in status["routers"]
+
+
+# -- the SLO engine --------------------------------------------------------
+
+SPEC_YAML = """\
+name: serving
+objectives:
+  - signal: shed_rate
+    threshold: 0.05
+    window_s: 3600
+    budget: 0.25
+"""
+
+
+def _snap(shed_rate, unix_ms):
+    return {"signals": {"shed_rate": shed_rate}, "unix_ms": unix_ms}
+
+
+def test_parse_spec_rejects_unknown_signal():
+    with pytest.raises(SloSpecError):
+        parse_slo_spec(
+            {"objectives": [{"signal": "not_a_signal", "threshold": 1}]}
+        )
+    with pytest.raises(SloSpecError):
+        parse_slo_spec({"objectives": []})
+
+
+def test_load_spec_yaml_and_json(tmp_path):
+    yml = tmp_path / "serving.yaml"
+    yml.write_text(SPEC_YAML)
+    spec = load_slo_spec(str(yml))
+    assert spec.name == "serving"
+    assert spec.objectives[0].signal == "shed_rate"
+    assert spec.objectives[0].budget == 0.25
+    jsn = tmp_path / "alt.json"
+    jsn.write_text(json.dumps({"objectives": [{"signal": "shed_rate", "threshold": 1}]}))
+    assert load_slo_spec(str(jsn)).name == "alt"
+
+
+def test_evaluate_burn_rate_and_exhaustion(tmp_path):
+    spec = parse_slo_spec(
+        {"objectives": [{"signal": "shed_rate", "threshold": 0.05, "budget": 0.25}]}
+    )
+    # 1 of 4 in-window samples violating → fraction 0.25 >= budget
+    snaps = [_snap(0.0, 1000), _snap(0.0, 2000), _snap(0.5, 3000), _snap(0.0, 4000)]
+    report = evaluate(spec, snaps)
+    result = report.results[0]
+    assert result.n_samples == 4 and result.n_violating == 1
+    assert result.burn_rate == pytest.approx(1.0)
+    assert result.exhausted and not report.ok
+    # half the violations → burn 0.5, budget intact
+    ok = evaluate(spec, snaps[:2] + snaps[2:] + [_snap(0.0, 5000)] * 4)
+    assert ok.ok and ok.max_burn_rate == pytest.approx(0.5)
+
+
+def test_evaluate_window_excludes_stale_samples():
+    spec = parse_slo_spec(
+        {"objectives": [{"signal": "shed_rate", "threshold": 0.05, "window_s": 60, "budget": 0.5}]}
+    )
+    old_violation = _snap(1.0, 1000)
+    fresh = [_snap(0.0, 1_000_000), _snap(0.0, 1_030_000)]
+    report = evaluate(spec, [old_violation] + fresh)
+    assert report.results[0].n_samples == 2
+    assert report.ok
+
+
+def test_evaluate_values_single_sample():
+    spec = parse_slo_spec(
+        {"objectives": [{"signal": "predict_p99_ms", "threshold": 250}]}
+    )
+    assert evaluate_values(spec, {"predict_p99_ms": 100.0}).ok
+    bad = evaluate_values(spec, {"predict_p99_ms": 900.0})
+    assert not bad.ok and bad.results[0].n_samples == 1
+    # a signal the source cannot measure contributes nothing — and
+    # cannot exhaust (the bench --slo no-op guarantee)
+    absent = evaluate_values(spec, {"predict_p99_ms": None})
+    assert absent.ok and absent.results[0].n_samples == 0
+
+
+def test_slo_check_cli_flips_pass_burn_pass(tmp_path, monkeypatch):
+    """The executable error budget: exit 0 → 1 (+ slo_budget_exhausted
+    event) → 0 as the plane degrades and recovers."""
+    from gordo_tpu.cli.plane import slo_cli
+
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv(EVENT_LOG_ENV_VAR, str(log))
+    spec_path = tmp_path / "serving.yaml"
+    spec_path.write_text(SPEC_YAML)
+    runner = CliRunner()
+
+    def check(shed_rate, as_json=False):
+        snap = tmp_path / "snap.json"
+        snap.write_text(json.dumps(_snap(shed_rate, 1000)))
+        args = ["check", str(spec_path), str(snap)]
+        if as_json:
+            args.append("--as-json")
+        return runner.invoke(slo_cli, args)
+
+    assert check(0.0).exit_code == 0
+    burned = check(0.9)
+    assert burned.exit_code == 1
+    assert "EXHAUSTED" in burned.output
+    events = [e for e in read_events(str(log)) if e["event"] == "slo_budget_exhausted"]
+    assert events and events[0]["spec"] == "serving"
+    assert events[0]["signal"] == "shed_rate"
+    recovered = check(0.0, as_json=True)
+    assert recovered.exit_code == 0
+    assert json.loads(recovered.output)["ok"] is True
+
+
+def test_bench_slo_stamp_and_trajectory_fold(tmp_path):
+    """`load_test.py --slo` stamps the verdict; consolidate folds it
+    into trajectory.json rows."""
+    from benchmarks.consolidate import consolidate
+    from benchmarks.load_test import stamp_slo
+
+    spec_path = tmp_path / "serving.yaml"
+    spec_path.write_text(
+        "name: serving\nobjectives:\n"
+        "  - signal: predict_p99_ms\n    threshold: 250\n"
+        "  - signal: shed_rate\n    threshold: 0.05\n"
+    )
+    out = {"requests": 99, "errors": 1, "p99_ms": 120.0, "shed_rate": 0.01}
+    stamp_slo(out, str(spec_path))
+    assert out["slo"]["ok"] is True
+    assert out["slo"]["spec"] == "serving"
+    assert {o["signal"] for o in out["slo"]["objectives"]} == {
+        "predict_p99_ms",
+        "shed_rate",
+    }
+    (tmp_path / "results_slo_cpu_r16.json").write_text(
+        json.dumps({"bench_schema_version": 1, "p99_ms": 120.0, **out})
+    )
+    trajectory = consolidate(tmp_path)
+    entry = trajectory["entries"][0]
+    assert entry["slo"]["ok"] is True
+    assert entry["slo"]["max_burn_rate"] == 0.0
+
+
+# -- S2: event-log size rotation -------------------------------------------
+
+
+def test_event_log_rotates_at_cap(tmp_path, monkeypatch):
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv(EVENT_LOG_ENV_VAR, str(log))
+    monkeypatch.setenv(EVENT_LOG_MAX_MB_ENV_VAR, "0.0005")  # ~524 bytes
+    for i in range(40):
+        emit_event("epoch", path="p", epoch=i)
+    rotated = tmp_path / "events.jsonl.1"
+    assert rotated.exists()
+    current = read_events(str(log))
+    previous = read_events(str(rotated))
+    assert current and previous
+    # nothing lost across the rename: the epochs partition cleanly
+    epochs = [e["epoch"] for e in previous] + [e["epoch"] for e in current]
+    assert epochs == sorted(epochs)
+    assert len(set(epochs)) == len(epochs)
+
+
+def test_event_log_rotation_disabled_by_default(tmp_path, monkeypatch):
+    log = tmp_path / "events.jsonl"
+    monkeypatch.setenv(EVENT_LOG_ENV_VAR, str(log))
+    monkeypatch.delenv(EVENT_LOG_MAX_MB_ENV_VAR, raising=False)
+    for i in range(40):
+        emit_event("epoch", path="p", epoch=i)
+    assert not (tmp_path / "events.jsonl.1").exists()
+    assert len(read_events(str(log))) == 40
+
+
+def test_rotation_mid_drain_resets_lifecycle_cursor(tmp_path, monkeypatch):
+    """The lifecycle stream-observation byte cursor must survive a
+    rotation between ticks: the shrunken file resets it to offset 0, so
+    the new generation's observations are consumed (not skipped past a
+    stale offset), and the drained pre-rotation ones are not re-read
+    from the live file."""
+    from gordo_tpu.lifecycle import LifecycleConfig, LifecycleManager
+
+    revisions = tmp_path / "revisions"
+    collection = revisions / "rev-a"
+    collection.mkdir(parents=True)
+    log = tmp_path / "events.jsonl"
+
+    def observation(machine):
+        return json.dumps(
+            {
+                "event": "stream_observation",
+                "machine": machine,
+                "revision": "rev-a",
+                "n": 8,
+                "ratio_mean": 1.5,
+                "exceedance": 1.0,
+            }
+        ) + "\n"
+
+    log.write_text(observation("m-a") + observation("m-a"))
+    manager = LifecycleManager(
+        str(collection), LifecycleConfig(stream_observations=str(log))
+    )
+    stats = manager._consume_stream_observations("rev-a")
+    assert stats["m-a"]["n"] == 16
+    manager._commit_stream_cursor()
+    # rotation mid-stream: the log rolls to .1 and a fresh (smaller)
+    # file starts with one new observation
+    os.replace(log, str(log) + ".1")
+    log.write_text(observation("m-b"))
+    stats = manager._consume_stream_observations("rev-a")
+    assert set(stats) == {"m-b"}
+    assert stats["m-b"]["n"] == 8
+
+
+# -- S3: telemetry summarize v3 --------------------------------------------
+
+
+def test_summarize_rollup_section_roundtrip(tmp_path):
+    from gordo_tpu.observability.report import (
+        SUMMARY_SCHEMA_VERSION,
+        summarize_directory,
+        summary_payload,
+    )
+
+    assert SUMMARY_SCHEMA_VERSION == 3
+    persist = tmp_path / "plane.jsonl"
+    poller = RollupPoller(
+        members=lambda: {},
+        local_members={"r0": _local_replica},
+        persist_path=str(persist),
+    )
+    poller.poll_once()
+    poller.poll_once()
+    (tmp_path / "events.jsonl").write_text(
+        json.dumps({"ts": "t", "event": "rollup_counter_reset"}) + "\n"
+        + json.dumps({"ts": "t", "event": "slo_budget_exhausted"}) + "\n"
+    )
+    payload = summary_payload(tmp_path)
+    assert payload["schema_version"] == 3
+    assert payload["rollup"][0]["n_snapshots"] == 2
+    assert payload["rollup"][0]["members"]["r0"]["role"] == "replica"
+    # rollup/slo events census under their own subsystem
+    assert payload["events"]["rollup"]["rollup_counter_reset"] == 1
+    assert payload["events"]["rollup"]["slo_budget_exhausted"] == 1
+    text = summarize_directory(tmp_path)
+    assert "Plane rollups: 1 file(s)" in text
+    assert "2 merged snapshot(s)" in text
+    # the persisted snapshot JSONL must NOT be mistaken for an event log
+    assert "plane.jsonl" not in json.dumps(payload["events"])
+
+
+# -- the plane (e2e) -------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def rollup_collection(tmp_path_factory):
+    """Four small trained machines laid out as one served collection."""
+    root = tmp_path_factory.mktemp("rollup-collection")
+    collection = root / PROJECT / "models" / "rev-r"
+    rng = np.random.default_rng(11)
+    for i, name in enumerate(MACHINES):
+        X = rng.random((40, len(TAGS))).astype("float32")
+        model = AutoEncoder(kind="feedforward_hourglass", epochs=1, seed=i)
+        model.fit(X, X.copy())
+        machine = Machine(
+            name=name,
+            project_name=PROJECT,
+            model={
+                "gordo_tpu.models.AutoEncoder": {
+                    "kind": "feedforward_hourglass",
+                    "epochs": 1,
+                }
+            },
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": "2019-01-01T00:00:00+00:00",
+                "train_end_date": "2019-01-02T00:00:00+00:00",
+                "tags": [[t, None] for t in TAGS],
+            },
+        )
+        serializer.dump(model, collection / name, metadata=machine.to_dict())
+    return collection
+
+
+def _make_plane(collection, monkeypatch, tmp_path, n_replicas=2, **router_config):
+    from gordo_tpu.router.app import RouterApp
+    from gordo_tpu.server import build_app
+    from gordo_tpu.server import utils as server_utils
+
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(collection))
+    server_utils.clear_caches()
+    replica_ids = [f"r{i}" for i in range(n_replicas)]
+    manifest = write_shard_manifest(
+        str(tmp_path / f"manifest_{n_replicas}.json"), replica_ids
+    )
+    apps = {
+        f"{rid}.test": build_app({"SHARD_MANIFEST": manifest, "REPLICA_ID": rid})
+        for rid in replica_ids
+    }
+    adapter = MultiReplicaAdapter(apps)
+    session = requests.Session()
+    session.mount("http://", adapter)
+    router = RouterApp(
+        {
+            "REPLICAS": {rid: f"http://{rid}.test" for rid in replica_ids},
+            "SESSION": session,
+            "PROBE_INTERVAL_S": 0,  # no prober thread: deterministic counts
+            "BACKOFF_SCALE": 0.002,
+            **router_config,
+        }
+    )
+    _ROUTERS.append(router)
+    return Plane(router, apps, adapter, replica_ids)
+
+
+def _post_fleet(client, names, n=8):
+    rows = np.random.default_rng(3).random((n, len(TAGS))).tolist()
+    return client.post(
+        f"/gordo/v0/{PROJECT}/prediction/fleet",
+        data=json.dumps({"machines": {name: rows for name in names}}).encode(),
+        content_type="application/json",
+    )
+
+
+def test_replica_serves_telemetry_snapshot(rollup_collection, monkeypatch, tmp_path):
+    plane = _make_plane(rollup_collection, monkeypatch, tmp_path)
+    client = WerkzeugClient(plane.apps["r0.test"])
+    resp = client.get("/telemetry/snapshot")
+    assert resp.status_code == 200
+    snap = json.loads(resp.get_data())
+    assert snap["snapshot_version"] == 1
+    assert snap["role"] == "replica"
+    assert snap["replica_id"] == "r0"
+    assert isinstance(snap["metrics"], dict)
+    assert snap["status"]["status"] == "ok"
+    assert "batching" in snap["status"]
+
+
+def test_router_strict_noop_without_rollup_config(
+    rollup_collection, monkeypatch, tmp_path
+):
+    """No poller configured ⇒ literally nothing: no thread, and zero
+    /telemetry/snapshot requests ever leave the router."""
+    before = threading.active_count()
+    plane = _make_plane(rollup_collection, monkeypatch, tmp_path)
+    assert threading.active_count() == before
+    assert plane.router._rollup is None
+    assert _post_fleet(plane.client, MACHINES).status_code == 200
+    assert not any("/telemetry/snapshot" in url for url in plane.adapter.urls)
+
+
+def test_router_rollup_interval_starts_poller_thread(
+    rollup_collection, monkeypatch, tmp_path
+):
+    before = threading.active_count()
+    plane = _make_plane(
+        rollup_collection, monkeypatch, tmp_path, ROLLUP_INTERVAL_S=30.0
+    )
+    assert plane.router._rollup is not None
+    assert threading.active_count() == before + 1
+    plane.router.close()
+    assert threading.active_count() == before
+
+
+def test_plane_e2e_status_metrics_kill_and_top(
+    rollup_collection, monkeypatch, tmp_path
+):
+    """The acceptance: live /status with per-replica health, merged
+    /metrics equal to the exact sum of the per-member counters, a
+    killed replica visible within ONE poll, and `top --once --as-json`
+    round-tripping the exact payload."""
+    plane = _make_plane(rollup_collection, monkeypatch, tmp_path)
+    for _ in range(3):
+        assert _post_fleet(plane.client, MACHINES).status_code == 200
+
+    # ---- /status: plane view with router breaker state per replica
+    status = json.loads(plane.client.get("/status").get_data())
+    assert status["role"] == "plane"
+    assert set(status["replicas"]) == {"r0", "r1"}
+    for rid in ("r0", "r1"):
+        assert status["replicas"][rid]["status"] == "ok"
+        assert status["replicas"][rid]["health"]["state"] == "healthy"
+    assert status["signals"]["shed_rate"] == 0.0
+    assert status["signals"]["replicas_healthy"] == 2.0
+    assert status["poll"]["member_errors"] == {}
+
+    # ---- merged /metrics = exact sum of the per-member counters
+    def member_ok_count(snap):
+        dump = snap["metrics"]["gordo_router_requests_total"]
+        return sum(
+            s["value"]
+            for s in dump["series"]
+            if s["labels"].get("outcome") == "ok"
+        )
+
+    members = [
+        json.loads(
+            WerkzeugClient(plane.apps[f"{rid}.test"])
+            .get("/telemetry/snapshot")
+            .get_data()
+        )
+        for rid in ("r0", "r1")
+    ]
+    members.append(
+        json.loads(plane.client.get("/telemetry/snapshot").get_data())
+    )
+    assert members[-1]["role"] == "router"
+    expected = sum(member_ok_count(s) for s in members)
+    text = plane.client.get("/metrics").get_data(as_text=True)
+    match = re.search(
+        r'^gordo_router_requests_total\{outcome="ok"\} (\S+)$', text, re.M
+    )
+    assert match, text
+    assert float(match.group(1)) == pytest.approx(expected)
+    # gauges union under the replica label in the exposition
+    assert 'replica="__router__"' in text or 'replica="r0"' in text
+
+    # ---- a killed replica is visible within one poll
+    plane.kill("r0")
+    status = json.loads(plane.client.get("/status").get_data())
+    assert "r0" in status["poll"]["member_errors"]
+    assert "r0" not in {
+        rid for rid, row in status["replicas"].items() if row.get("status")
+    }
+    plane.revive("r0")
+    status = json.loads(plane.client.get("/status").get_data())
+    assert status["poll"]["member_errors"] == {}
+    assert status["replicas"]["r0"]["status"] == "ok"
+
+    # ---- top --once --as-json round-trips the exact /status payload
+    from gordo_tpu.cli import plane as plane_cli
+
+    seen_urls = []
+
+    def fake_fetch(url, timeout=10.0):
+        seen_urls.append(url)
+        return json.loads(plane.client.get("/status").get_data())
+
+    monkeypatch.setattr(plane_cli, "_fetch_json", fake_fetch)
+    runner = CliRunner()
+    result = runner.invoke(
+        plane_cli.top_cli, ["http://router.test", "--once", "--as-json"]
+    )
+    assert result.exit_code == 0, result.output
+    assert seen_urls == ["http://router.test/status"]
+    payload = json.loads(result.output)
+    assert payload["replicas"]["r0"]["status"] == "ok"
+    # and the human frame renders without a terminal
+    frame = runner.invoke(plane_cli.top_cli, ["http://router.test", "--once"])
+    assert frame.exit_code == 0, frame.output
+    assert "control signals:" in frame.output
+    assert "r0" in frame.output
+
+    # ---- the live /status evaluates against an SLO spec
+    spec_path = tmp_path / "serving.yaml"
+    spec_path.write_text(SPEC_YAML)
+    snap_path = tmp_path / "status.json"
+    snap_path.write_text(json.dumps(status))
+    from gordo_tpu.cli.plane import slo_cli
+
+    ok = runner.invoke(slo_cli, ["check", str(spec_path), str(snap_path)])
+    assert ok.exit_code == 0, ok.output
+
+
+def test_lifecycle_last_tick_feeds_the_poller(trained_model_collection, tmp_path):
+    """`lifecycle tick` persists a file-shaped member snapshot the
+    poller ingests — drift_scan_staleness_s without an HTTP server."""
+    from gordo_tpu.lifecycle import LifecycleManager
+
+    revisions = tmp_path / "revisions"
+    revisions.mkdir()
+    collection = revisions / "rev-a"
+    shutil.copytree(trained_model_collection, collection)
+    manager = LifecycleManager(str(collection))
+    manager.tick()
+    last_tick = revisions / ".lifecycle" / "last_tick.json"
+    assert last_tick.exists()
+    snap = json.loads(last_tick.read_text())
+    assert snap["role"] == "lifecycle"
+    assert snap["status"]["last_tick_unix_ms"] > 0
+    poller = RollupPoller(members=lambda: {"lifecycle": str(last_tick)})
+    merged = poller.poll_once()
+    staleness = merged["signals"]["drift_scan_staleness_s"]
+    assert staleness is not None and staleness < 300.0
+
+
+def test_rollup_cli_once_merges_file_members(tmp_path):
+    from gordo_tpu.cli.plane import rollup_cli
+
+    snap = snapshot_payload(
+        role="replica", replica_id="r0", registry=MetricsRegistry()
+    )
+    path = tmp_path / "snap.json"
+    path.write_text(json.dumps(snap))
+    runner = CliRunner()
+    result = runner.invoke(
+        rollup_cli, ["--member", f"r0={path}", "--once"]
+    )
+    assert result.exit_code == 0, result.output
+    merged = json.loads(result.output)
+    assert merged["role"] == "plane"
+    assert set(merged["members"]) == {"r0"}
+
+
+def test_rollup_wsgi_app_serves_merged_views(tmp_path):
+    from gordo_tpu.observability.rollup import rollup_wsgi_app
+
+    poller = RollupPoller(
+        members=lambda: {}, local_members={"r0": _local_replica}
+    )
+    client = WerkzeugClient(rollup_wsgi_app(poller))
+    assert json.loads(client.get("/healthcheck").get_data())["gordo-tpu-rollup"]
+    status = json.loads(client.get("/status").get_data())
+    assert status["role"] == "plane"
+    text = client.get("/metrics").get_data(as_text=True)
+    assert "gordo_router_requests_total" in text
+    assert client.get("/nope").status_code == 404
