@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming bench-precision bench-slo build-multiworker images push
+.PHONY: all test test-sanitize lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming bench-precision bench-slo build-multiworker images push
 
 all: lint test
 
@@ -24,6 +24,16 @@ test:
 # finding count, so a dirty tree fails the target (docs/static_analysis.md)
 lint:
 	python -m gordo_tpu.cli lint gordo_tpu tests benchmarks
+
+# tier-1 under the runtime lock-order sanitizer: the threading
+# constructors are instrumented for the whole run, the observed lock
+# graph dumps to lock_graph_report.json, and `gordo-tpu lockgraph`
+# renders it — exit code == ordering inversions, so a new inversion
+# anywhere in the suite fails the target (docs/static_analysis.md)
+test-sanitize:
+	GORDO_LOCK_SANITIZE=1 GORDO_LOCK_SANITIZE_REPORT=lock_graph_report.json \
+		python -m pytest tests/ -q -m 'not slow'
+	python -m gordo_tpu.cli lockgraph lock_graph_report.json
 
 bench:
 	python bench.py

@@ -21,6 +21,13 @@ Layout:
 - ``knob_checks.py`` knob-discipline: every GORDO_* env read must be
                      classified in the knob registry
                      (gordo_tpu/tuning/knobs.py)
+- ``thread_checks.py`` the concurrency-discipline family:
+                     blocking-under-lock, lock-order,
+                     unguarded-shared-state, thread-leak,
+                     lock-held-across-yield
+- ``lock_sanitizer.py`` the runtime lock-order sanitizer
+                     (GORDO_LOCK_SANITIZE=1): instrumented threading
+                     primitives recording the observed lock graph
 - ``registry.py``    one CheckSpec per check (name, doc, severity,
                      fixer hint, scope)
 - ``engine.py``      file discovery, dispatch, suppressions, baseline
@@ -75,8 +82,16 @@ from gordo_tpu.analysis.registry import (
     CHECKS,
     CHECKS_BY_NAME,
     JAX_CHECK_NAMES,
+    THREAD_CHECK_NAMES,
     CheckSpec,
     get_check,
+)
+from gordo_tpu.analysis.thread_checks import (
+    check_blocking_under_lock,
+    check_lock_held_across_yield,
+    check_lock_order,
+    check_thread_leak,
+    check_unguarded_shared_state,
 )
 
 __all__ = [
@@ -91,12 +106,16 @@ __all__ = [
     "LintResult",
     "METRIC_FACTORY_METHODS",
     "METRIC_NAME_RE",
+    "THREAD_CHECK_NAMES",
     "check_annotated_attributes",
     "check_annotated_param_method_calls",
+    "check_blocking_under_lock",
     "check_call_signatures",
     "check_donation_safety",
     "check_host_sync",
     "check_knob_discipline",
+    "check_lock_held_across_yield",
+    "check_lock_order",
     "check_metric_registrations",
     "check_module_attributes",
     "check_module_shadowing",
@@ -107,7 +126,9 @@ __all__ = [
     "check_self_attributes",
     "check_self_method_calls",
     "check_span_discipline",
+    "check_thread_leak",
     "check_traced_branching",
+    "check_unguarded_shared_state",
     "check_unused_imports",
     "collect_env_reads",
     "collect_event_names",

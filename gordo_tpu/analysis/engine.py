@@ -35,6 +35,7 @@ entry).
 
 import ast
 import dataclasses
+import fnmatch
 import importlib
 import json
 import re
@@ -257,12 +258,36 @@ def _baselined(finding: Finding, entries: typing.List[dict]) -> bool:
 # --------------------------------------------------------------------------
 
 
+def _token_matches(spec: CheckSpec, token: str) -> bool:
+    """A select token matches a check by exact/glob name, or by glob
+    against ``<family>-<name>`` so ``thread-*`` selects the whole
+    concurrency family without every member being renamed after it."""
+    if fnmatch.fnmatchcase(spec.name, token):
+        return True
+    return bool(spec.family) and fnmatch.fnmatchcase(
+        f"{spec.family}-{spec.name}", token
+    )
+
+
 def _selected_checks(
     select: typing.Optional[typing.Sequence[str]],
 ) -> typing.List[CheckSpec]:
     if not select:
         return list(CHECKS)
-    return [get_check(name) for name in select]
+    out: typing.List[CheckSpec] = []
+    seen: typing.Set[str] = set()
+    for token in select:
+        matched = [spec for spec in CHECKS if _token_matches(spec, token)]
+        if not matched:
+            # exact names fall through to get_check for its "unknown
+            # check" error; a glob that matches nothing is the same bug
+            get_check(token)
+            raise KeyError(f"select pattern {token!r} matches no checks")
+        for spec in matched:
+            if spec.name not in seen:
+                seen.add(spec.name)
+                out.append(spec)
+    return out
 
 
 def lint_file(

@@ -1,0 +1,341 @@
+"""
+Runtime lock-order sanitizer — the dynamic complement to
+``analysis/thread_checks.py``.
+
+The static ``lock-order`` check sees one module at a time; a deadlock
+assembled ACROSS modules (the batcher takes its queue lock and calls
+into the ledger, the ledger's heartbeat takes its own lock and calls
+back) is invisible to per-file AST analysis. This module instruments the
+``threading`` lock constructors so a normal tier-1 run doubles as a
+lock-discipline fuzzer:
+
+- ``install()`` replaces ``threading.Lock`` / ``threading.RLock`` /
+  ``threading.Condition`` with factories returning tracked proxies.
+  Every proxy remembers its **creation site** (``file:line`` of the
+  constructor call) — instances from the same site aggregate into one
+  lock-graph node, which keeps the graph bounded no matter how many
+  batchers a test constructs.
+- Each acquisition records an edge ``held-site -> acquired-site`` in a
+  process-wide graph, with a short acquisition stack captured the first
+  time each edge appears. An **inversion** is an edge whose reverse has
+  also been observed (site A taken while holding B, after B was taken
+  while holding A somewhere else) — the two halves of a deadlock,
+  reported even when the fatal interleaving never happened.
+- ``time.sleep`` is wrapped too: a sleep while any tracked lock is held
+  is recorded as a runtime ``blocking-under-lock`` witness (the shape
+  the static check hunts, caught in vivo).
+- ``report()`` / ``dump_report()`` serialize the observed graph —
+  nodes, edges, inversions, blocking events — as JSON for the
+  ``gordo-tpu lockgraph`` renderer.
+
+Enabled for the test suite via ``GORDO_LOCK_SANITIZE=1`` (see
+tests/conftest.py and ``make test-sanitize``); the report lands at
+``GORDO_LOCK_SANITIZE_REPORT`` (default ``lock_graph_report.json``).
+
+Implementation notes, learned the hard way elsewhere:
+
+- The sanitizer's own bookkeeping is guarded by a RAW
+  ``_thread.allocate_lock()`` — never a tracked lock, never anything
+  that could re-enter the record path.
+- Proxies delegate unknown attributes to the real primitive, so
+  ``threading.Condition`` keeps working: with an RLock proxy its
+  ``_release_save`` / ``_acquire_restore`` / ``_is_owned`` resolve to
+  the REAL RLock's methods (the books then show the lock held across
+  ``wait()`` — harmless, since self-edges are ignored); with a plain
+  Lock proxy the Condition falls back to ``release()``/``acquire()``,
+  which route through the proxy and keep the books exact.
+- Locks created BEFORE ``install()`` (module-level locks of modules the
+  conftest import chain already pulled in) are untracked; the proxies
+  only see construction after install. Installing in
+  ``pytest_configure`` catches nearly everything because gordo_tpu's
+  locks are overwhelmingly instance attributes built at object
+  construction time, not import time.
+"""
+
+import _thread
+import json
+import os
+import sys
+import threading
+import time
+import traceback
+import typing
+from pathlib import Path
+
+#: enable switch and report destination — deliberate non-knobs
+#: (registered in tuning/knobs.py NON_KNOB_ENV_VARS): they gate a test
+#: instrument, not a performance trade-off
+ENV_VAR = "GORDO_LOCK_SANITIZE"
+REPORT_ENV_VAR = "GORDO_LOCK_SANITIZE_REPORT"
+DEFAULT_REPORT_PATH = "lock_graph_report.json"
+
+#: stack frames kept per first-seen edge / blocking witness
+_STACK_LIMIT = 8
+
+_THIS_FILE = __file__
+_THREADING_FILE = threading.__file__
+
+
+def _frame_site(skip_internal: bool = True) -> str:
+    """``file:line`` of the nearest caller frame outside this module
+    (and outside threading.py, whose internals construct locks too)."""
+    frame = sys._getframe(1)
+    while frame is not None:
+        filename = frame.f_code.co_filename
+        if not skip_internal or (
+            filename != _THIS_FILE and filename != _THREADING_FILE
+        ):
+            return f"{filename}:{frame.f_lineno}"
+        frame = frame.f_back
+    return "<unknown>:0"
+
+
+def _stack_summary() -> typing.List[str]:
+    """A short rendered acquisition stack, innermost last, sanitizer
+    frames dropped."""
+    frames = traceback.extract_stack(sys._getframe(1), limit=_STACK_LIMIT + 4)
+    return [
+        f"{f.filename}:{f.lineno} in {f.name}"
+        for f in frames
+        if f.filename != _THIS_FILE
+    ][-_STACK_LIMIT:]
+
+
+class _State:
+    """Process-wide observation state. All mutation happens under a raw
+    (untracked) guard; nothing inside the guard allocates tracked locks
+    or logs."""
+
+    def __init__(self) -> None:
+        self.guard = _thread.allocate_lock()
+        self.tls = threading.local()
+        #: site -> acquisition count
+        self.sites: typing.Dict[str, int] = {}
+        #: (held site, acquired site) -> {"count": int, "stack": [...]}
+        self.edges: typing.Dict[typing.Tuple[str, str], dict] = {}
+        #: unordered site pairs already reported as inverted
+        self.reported: typing.Set[typing.FrozenSet[str]] = set()
+        self.inversions: typing.List[dict] = []
+        self.blocking: typing.List[dict] = []
+
+    def held(self) -> typing.List[str]:
+        stack = getattr(self.tls, "held", None)
+        if stack is None:
+            stack = []
+            self.tls.held = stack
+        return stack
+
+    def note_acquire(self, site: str) -> None:
+        held = self.held()
+        # stacks are captured OUTSIDE the guard (they allocate), only
+        # attached under it if the edge is new
+        new_edges = [
+            (h, site) for h in dict.fromkeys(held) if h != site
+        ]
+        stack = _stack_summary() if new_edges else None
+        with self.guard:
+            self.sites[site] = self.sites.get(site, 0) + 1
+            for edge in new_edges:
+                entry = self.edges.get(edge)
+                if entry is None:
+                    self.edges[edge] = {"count": 1, "stack": stack}
+                else:
+                    entry["count"] += 1
+                reverse = (edge[1], edge[0])
+                pair = frozenset(edge)
+                if reverse in self.edges and pair not in self.reported:
+                    self.reported.add(pair)
+                    self.inversions.append(
+                        {
+                            "sites": sorted(pair),
+                            "forward": {
+                                "order": list(reverse),
+                                "stack": self.edges[reverse]["stack"],
+                            },
+                            "backward": {
+                                "order": list(edge),
+                                "stack": self.edges[edge]["stack"],
+                            },
+                            "thread": threading.current_thread().name,
+                        }
+                    )
+        held.append(site)
+
+    def note_release(self, site: str) -> None:
+        held = self.held()
+        # release the most recent matching acquisition; a Lock released
+        # from a different thread (legal, rare) just has no entry here
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == site:
+                del held[i]
+                return
+
+    def note_blocking(self, what: str) -> None:
+        held = self.held()
+        if not held:
+            return
+        stack = _stack_summary()
+        with self.guard:
+            self.blocking.append(
+                {
+                    "call": what,
+                    "held": list(dict.fromkeys(held)),
+                    "stack": stack,
+                    "thread": threading.current_thread().name,
+                }
+            )
+
+
+_state = _State()
+
+#: originals captured at install time; empty <=> not installed
+_orig: typing.Dict[str, typing.Any] = {}
+
+
+class _TrackedLock:
+    """Proxy around a real Lock/RLock. Records acquire/release against
+    the constructor's creation site; everything else delegates."""
+
+    def __init__(self, inner: typing.Any, site: str) -> None:
+        self._inner = inner
+        self._site = site
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        acquired = self._inner.acquire(blocking, timeout)
+        if acquired:
+            _state.note_acquire(self._site)
+        return acquired
+
+    def release(self) -> None:
+        self._inner.release()
+        _state.note_release(self._site)
+
+    def __enter__(self) -> "_TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: typing.Any) -> bool:
+        self.release()
+        return False
+
+    def __getattr__(self, name: str) -> typing.Any:
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<tracked {self._inner!r} from {self._site}>"
+
+
+class _ConstructorPatch:
+    """The callable installed over a ``threading`` constructor.
+
+    Deliberately a non-descriptor object, NOT a Python function: the
+    real ``threading.Lock`` is a C builtin, and builtins don't bind as
+    methods — code that stores one as a class attribute
+    (``lock_class = threading.Lock``; werkzeug's ``Map`` does exactly
+    this) calls ``self.lock_class()`` and the factory receives zero
+    arguments. A plain Python function in that slot WOULD bind and
+    receive ``self``. Instances of this class behave like the builtin.
+    """
+
+    __slots__ = ("_fn",)
+
+    def __init__(self, fn: typing.Callable[..., typing.Any]) -> None:
+        self._fn = fn
+
+    def __call__(self, *args: typing.Any, **kwargs: typing.Any) -> typing.Any:
+        return self._fn(*args, **kwargs)
+
+
+def _tracked_lock() -> _TrackedLock:
+    return _TrackedLock(_orig["Lock"](), _frame_site())
+
+
+def _tracked_rlock() -> _TrackedLock:
+    return _TrackedLock(_orig["RLock"](), _frame_site())
+
+
+def _tracked_condition(lock: typing.Any = None) -> typing.Any:
+    # a real Condition around a tracked lock: Condition's own machinery
+    # is untouched, only the lock underneath it reports
+    if lock is None:
+        lock = _TrackedLock(_orig["RLock"](), _frame_site())
+    return _orig["Condition"](lock)
+
+
+def _tracked_sleep(seconds: float) -> None:
+    _state.note_blocking(f"time.sleep({seconds!r})")
+    _orig["sleep"](seconds)
+
+
+def enabled() -> bool:
+    """Is the sanitizer switched on via the environment?"""
+    return os.environ.get(ENV_VAR, "") == "1"
+
+
+def installed() -> bool:
+    return bool(_orig)
+
+
+def install() -> None:
+    """Patch the threading constructors (idempotent)."""
+    if _orig:
+        return
+    _orig["Lock"] = threading.Lock
+    _orig["RLock"] = threading.RLock
+    _orig["Condition"] = threading.Condition
+    _orig["sleep"] = time.sleep
+    threading.Lock = _ConstructorPatch(_tracked_lock)
+    threading.RLock = _ConstructorPatch(_tracked_rlock)
+    threading.Condition = _ConstructorPatch(_tracked_condition)
+    time.sleep = _ConstructorPatch(_tracked_sleep)
+
+
+def uninstall() -> None:
+    """Restore the real constructors (idempotent). Existing proxies keep
+    working — they hold real primitives inside."""
+    if not _orig:
+        return
+    threading.Lock = _orig.pop("Lock")
+    threading.RLock = _orig.pop("RLock")
+    threading.Condition = _orig.pop("Condition")
+    time.sleep = _orig.pop("sleep")
+
+
+def reset() -> None:
+    """Drop all observations (the proxies stay installed)."""
+    global _state
+    _state = _State()
+
+
+def report() -> dict:
+    """The observed lock graph as a JSON-ready dict."""
+    with _state.guard:
+        return {
+            "version": 1,
+            "nodes": [
+                {"site": site, "acquisitions": count}
+                for site, count in sorted(_state.sites.items())
+            ],
+            "edges": [
+                {
+                    "from": a,
+                    "to": b,
+                    "count": entry["count"],
+                    "stack": entry["stack"],
+                }
+                for (a, b), entry in sorted(_state.edges.items())
+            ],
+            "inversions": list(_state.inversions),
+            "blocking": list(_state.blocking),
+        }
+
+
+def dump_report(path: typing.Union[str, Path, None] = None) -> Path:
+    """Serialize :func:`report` to ``path`` (default: the
+    ``GORDO_LOCK_SANITIZE_REPORT`` env var, then
+    ``lock_graph_report.json``) and return where it landed."""
+    if path is None:
+        path = os.environ.get(REPORT_ENV_VAR, DEFAULT_REPORT_PATH)
+    out = Path(path)
+    out.write_text(json.dumps(report(), indent=2) + "\n")
+    return out

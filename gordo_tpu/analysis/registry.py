@@ -23,7 +23,7 @@ style nit.
 import dataclasses
 import typing
 
-from gordo_tpu.analysis import checks, jax_checks, knob_checks
+from gordo_tpu.analysis import checks, jax_checks, knob_checks, thread_checks
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,6 +36,11 @@ class CheckSpec:
     run: typing.Callable  # (tree, source, module) -> List[str]
     hot_only: bool = False
     skip_init: bool = False  # __init__.py re-export surfaces exempt
+    #: family prefix for glob selection: ``--select thread-*`` matches a
+    #: check when the glob matches either its name or
+    #: ``<family>-<name>`` (so 'blocking-under-lock' answers to
+    #: 'thread-*' without renaming every check after its family)
+    family: str = ""
 
 
 def _syntactic(fn):
@@ -213,6 +218,60 @@ CHECKS: typing.Tuple[CheckSpec, ...] = (
         scope="syntactic",
         run=_syntactic(jax_checks.check_donation_safety),
     ),
+    # -- the concurrency-discipline family (thread_checks.py) ------------
+    CheckSpec(
+        name="blocking-under-lock",
+        doc="HTTP / sleep / subprocess / device-sync / event-log calls "
+        "inside a `with lock:` body (the PR-6 shed-path shape)",
+        severity="error",
+        fixer="collect what the call needs under the lock, release, "
+        "then block",
+        scope="syntactic",
+        run=_syntactic(thread_checks.check_blocking_under_lock),
+        family="thread",
+    ),
+    CheckSpec(
+        name="lock-order",
+        doc="a cycle in the module's lock-acquisition graph: two "
+        "`with a: ... with b:` nests in opposite orders",
+        severity="error",
+        fixer="pick one global acquisition order and re-nest both sites",
+        scope="syntactic",
+        run=_syntactic(thread_checks.check_lock_order),
+        family="thread",
+    ),
+    CheckSpec(
+        name="unguarded-shared-state",
+        doc="an attribute written from a Thread-target method without a "
+        "lock and read from other methods also without one",
+        severity="warning",
+        fixer="guard both sides with one lock, or make the update "
+        "atomic-by-construction (the queue-depth-gauge fix)",
+        scope="syntactic",
+        run=_syntactic(thread_checks.check_unguarded_shared_state),
+        family="thread",
+    ),
+    CheckSpec(
+        name="thread-leak",
+        doc="Thread(...) without daemon=True and with no reachable "
+        "join() in the module",
+        severity="warning",
+        fixer="pass daemon=True, or keep the handle and join it on "
+        "shutdown",
+        scope="syntactic",
+        run=_syntactic(thread_checks.check_thread_leak),
+        family="thread",
+    ),
+    CheckSpec(
+        name="lock-held-across-yield",
+        doc="a generator yield (or caller-supplied callback) inside a "
+        "`with lock:` body — the lock outlives the critical section",
+        severity="warning",
+        fixer="snapshot under the lock, release, then yield or call",
+        scope="syntactic",
+        run=_syntactic(thread_checks.check_lock_held_across_yield),
+        family="thread",
+    ),
 )
 
 CHECKS_BY_NAME: typing.Dict[str, CheckSpec] = {c.name: c for c in CHECKS}
@@ -227,6 +286,12 @@ JAX_CHECK_NAMES: typing.Tuple[str, ...] = (
     "prng-split-width",
     "traced-branch",
     "donation-safety",
+)
+
+#: the concurrency-discipline family, same role (tier-1 parametrization
+#: + the `--select thread-*` glob resolves to exactly this set)
+THREAD_CHECK_NAMES: typing.Tuple[str, ...] = tuple(
+    c.name for c in CHECKS if c.family == "thread"
 )
 
 

@@ -1,0 +1,706 @@
+"""
+Concurrency-discipline checks — the race shapes review keeps finding by
+hand.
+
+The serving plane is a deeply threaded system (batcher drainers, router
+fan-out pools, ledger heartbeats, rollup pollers, stream sessions,
+lifecycle daemons), and nearly every review-hardening round fixed a
+hand-found concurrency bug: the shed-path event-log write under the
+queue lock (PR 6), the batcher lookup-vs-stop race, the last-writer-wins
+queue-depth gauge, the wedged watch daemon. Races are exactly the
+combination failures CPU CI can't see, and the goodput framing
+(PAPERS.md, arXiv:2502.06982) counts every stall and wedged worker
+against fleet efficiency — so this family enforces at lint time what
+those reviews re-discovered at review time:
+
+- ``blocking-under-lock``      HTTP calls, ``time.sleep``,
+                               ``subprocess``, device syncs, and
+                               event-log writes inside a ``with lock:``
+                               body — every other thread contending for
+                               that lock queues behind the I/O (the
+                               PR-6 shed-path shape).
+- ``lock-order``               the AST-derived intra-module
+                               lock-acquisition graph: a cycle across
+                               two ``with a: ... with b:`` nests is a
+                               deadlock waiting for the right
+                               interleaving; both sites flag.
+- ``unguarded-shared-state``   an attribute mutated from a
+                               ``threading.Thread`` target (the
+                               drainer/poller side) without the lock,
+                               while other methods of the same class
+                               read it — torn reads and last-writer-wins
+                               (the PR-6 gauge shape).
+- ``thread-leak``              a ``Thread(...)`` started without
+                               ``daemon=True`` and without a reachable
+                               ``join`` — the wedged-watch-daemon shape
+                               that keeps processes alive after the work
+                               is done.
+- ``lock-held-across-yield``   a generator ``yield`` (or a callback
+                               invocation) inside a ``with lock:`` body:
+                               the lock stays held for as long as the
+                               consumer (or the callback) pleases.
+
+All checks are purely syntactic (AST + source, no imports), so they run
+on any file — tests and benchmarks included. They are heuristic by
+design: lock identity is derived from ``threading.Lock/RLock/Condition``
+construction sites plus lock-ish names (``*lock*``, ``*mutex*``,
+``*cond*``), which is exactly the precision a reviewer applies. The
+dynamic complement — cross-module lock ordering the AST cannot see —
+is the runtime sanitizer (``analysis/lock_sanitizer.py``).
+"""
+
+import ast
+import re
+import typing
+
+from gordo_tpu.analysis.checks import _own_scope_nodes
+from gordo_tpu.analysis.jax_checks import _callee_tail
+
+# --------------------------------------------------------------------------
+# shared: recognizing locks and lock-guarded regions
+# --------------------------------------------------------------------------
+
+#: threading (and multiprocessing) primitives whose construction marks a
+#: binding as a lock; Condition doubles as its own lock surface
+_LOCK_CONSTRUCTORS = frozenset({"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"})
+
+#: names that read as locks even without a visible construction site
+#: (the lock may be built in another module or passed in) — matched on
+#: the FULL variable/attribute name, conservatively
+_LOCKISH_NAME_RE = re.compile(r"(^|_)(lock|mutex|cond|condition)(_|$)|(^|_)(lock|cond)s?$", re.IGNORECASE)
+
+
+def _is_lock_constructor(node: ast.AST) -> bool:
+    """``threading.Lock()`` / ``Lock()`` / ``threading.Condition(...)``
+    — any spelling whose last segment is a known lock constructor."""
+    return (
+        isinstance(node, ast.Call)
+        and _callee_tail(node.func) in _LOCK_CONSTRUCTORS
+    )
+
+
+def _lock_id(node: ast.AST) -> typing.Optional[str]:
+    """A stable identifier for a lock expression: ``self._lock`` ->
+    ``_lock`` (instance attrs are module-unique enough for intra-module
+    analysis; class scoping happens at the call sites that need it),
+    ``LOCK`` -> ``LOCK``, anything else -> None."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _declared_locks(tree: ast.Module) -> typing.Set[str]:
+    """Every name/attribute the module binds to a lock constructor:
+    ``self._lock = threading.Lock()``, ``_depth_lock = Lock()``,
+    ``self._arrived = threading.Condition(self._lock)``."""
+    locks: typing.Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not _is_lock_constructor(node.value):
+            continue
+        for target in node.targets:
+            ident = _lock_id(target)
+            if ident:
+                locks.add(ident)
+    return locks
+
+
+def _is_lock_expr(node: ast.AST, declared: typing.Set[str]) -> bool:
+    """Is this with-item context expression a lock? Either a binding the
+    module demonstrably constructed as one, or a lock-ish name."""
+    ident = _lock_id(node)
+    if ident is None:
+        return False
+    return ident in declared or bool(_LOCKISH_NAME_RE.search(ident))
+
+
+def _with_lock_items(
+    stmt: ast.AST, declared: typing.Set[str]
+) -> typing.List[typing.Tuple[str, ast.AST]]:
+    """The (lock id, context expr) pairs of a With statement's items
+    that look like lock acquisitions (in acquisition order)."""
+    if not isinstance(stmt, (ast.With, ast.AsyncWith)):
+        return []
+    out: typing.List[typing.Tuple[str, ast.AST]] = []
+    for item in stmt.items:
+        expr = item.context_expr
+        # `with lock.acquire_timeout(...)` style wrappers: unwrap a call
+        # whose receiver is the lock
+        if isinstance(expr, ast.Call) and isinstance(expr.func, ast.Attribute):
+            if _is_lock_expr(expr.func.value, declared):
+                ident = _lock_id(expr.func.value)
+                if ident:
+                    out.append((ident, expr))
+                continue
+        if _is_lock_expr(expr, declared):
+            ident = _lock_id(expr)
+            if ident:
+                out.append((ident, expr))
+    return out
+
+
+def _body_nodes(stmt: ast.AST) -> typing.List[ast.AST]:
+    """Nodes lexically inside a statement's body, nested function/class
+    bodies excluded (code defined there runs on another stack, with its
+    own locking context)."""
+    out: typing.List[ast.AST] = []
+    stack: typing.List[ast.AST] = list(getattr(stmt, "body", []))
+    while stack:
+        node = stack.pop()
+        out.append(node)
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda, ast.ClassDef)
+        ):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+# --------------------------------------------------------------------------
+# blocking-under-lock
+# --------------------------------------------------------------------------
+
+#: module-qualified calls that block on the network / a subprocess
+_BLOCKING_MODULE_CALLS = {
+    "requests": frozenset(
+        {"get", "post", "put", "delete", "head", "patch", "request"}
+    ),
+    "subprocess": frozenset(
+        {"run", "call", "check_call", "check_output", "Popen"}
+    ),
+    "time": frozenset({"sleep"}),
+    "jax": frozenset({"block_until_ready", "device_get"}),
+}
+
+#: bare-name calls that block (sanctioned device sync included: under a
+#: lock its "accounted" cost is paid by every contending thread too)
+_BLOCKING_BARE_CALLS = frozenset({"sleep", "urlopen", "host_fetch"})
+
+#: the event-log write path (PR 6: a shed-storm's JSONL writes must not
+#: serialize the batcher's submit path)
+_EVENT_EMIT_CALLS = frozenset({"emit_event"})
+
+
+def _blocking_call_reason(node: ast.Call) -> typing.Optional[str]:
+    """Why this call blocks, or None if it doesn't (statically)."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        base_name = base.id if isinstance(base, ast.Name) else None
+        allowed = _BLOCKING_MODULE_CALLS.get(base_name or "")
+        if allowed and func.attr in allowed:
+            kind = {
+                "requests": "an HTTP round-trip",
+                "subprocess": "a subprocess",
+                "time": "a sleep",
+                "jax": "a device->host sync",
+            }[base_name]
+            return f"'{base_name}.{func.attr}(...)' ({kind})"
+        if func.attr == "block_until_ready":
+            return f"'{ast.unparse(func)}(...)' (a device->host sync)"
+        if func.attr == "item" and not node.args and base_name not in (
+            "d",
+            "dict",
+        ):
+            # x.item() is a device sync on arrays; dict.item misuse is
+            # .items() and never bare .item(), so the overlap is nil
+            return f"'{ast.unparse(func)}()' (a device->host sync)"
+        if func.attr in _EVENT_EMIT_CALLS:
+            return f"'{ast.unparse(func)}(...)' (an event-log write)"
+        return None
+    if isinstance(func, ast.Name):
+        if func.id in _BLOCKING_BARE_CALLS:
+            kind = (
+                "an HTTP round-trip"
+                if func.id == "urlopen"
+                else "a device->host sync"
+                if func.id == "host_fetch"
+                else "a sleep"
+            )
+            return f"'{func.id}(...)' ({kind})"
+        if func.id in _EVENT_EMIT_CALLS:
+            return f"'{func.id}(...)' (an event-log write)"
+    return None
+
+
+def check_blocking_under_lock(tree: ast.Module) -> typing.List[str]:
+    """
+    A blocking call inside a ``with lock:`` body: every thread
+    contending for that lock queues behind this thread's I/O — a shed
+    storm is exactly when the drainer and accepting submits must NOT
+    wait on an event-log write (the PR-6 bug shape: the shed path wrote
+    the JSONL event log while still holding the queue lock). Flagged
+    inside a lock-guarded region:
+
+    - ``requests.get/post/...``, ``urlopen`` (network round-trips)
+    - ``time.sleep`` / bare ``sleep`` (``condition.wait(timeout=...)``
+      is the lock-releasing way to wait and is NOT flagged)
+    - ``subprocess.run/call/Popen/...``
+    - ``jax.block_until_ready`` / ``jax.device_get`` / ``x.item()`` /
+      ``host_fetch`` (device syncs: the device queue drains at its own
+      pace while the lock is held)
+    - ``emit_event(...)`` (the JSONL event log is file I/O under the
+      emitter's own lock — collect under the lock, emit after release)
+
+    The fix is almost always mechanical: gather what the write needs
+    into locals under the lock, release, then do the I/O.
+    """
+    declared = _declared_locks(tree)
+    problems: typing.List[str] = []
+    seen: typing.Set[int] = set()
+    for stmt in ast.walk(tree):
+        items = _with_lock_items(stmt, declared)
+        if not items:
+            continue
+        lock_ids = ", ".join(ident for ident, _ in items)
+        for node in _body_nodes(stmt):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            reason = _blocking_call_reason(node)
+            if reason is None:
+                continue
+            seen.add(id(node))
+            problems.append(
+                f"line {node.lineno}: {reason} runs while holding "
+                f"{lock_ids!r} — every contending thread queues behind "
+                f"this I/O (the PR-6 shed-under-lock shape); collect "
+                f"under the lock, release, then block"
+            )
+    return problems
+
+
+# --------------------------------------------------------------------------
+# lock-order
+# --------------------------------------------------------------------------
+
+
+def check_lock_order(tree: ast.Module) -> typing.List[str]:
+    """
+    The intra-module lock-acquisition graph: every lexically nested
+    ``with a: ... with b:`` (and ``with a, b:``) adds an ordered edge
+    a -> b. A cycle in that graph is a deadlock that needs only the
+    right interleaving: thread 1 holds ``a`` and wants ``b`` while
+    thread 2 holds ``b`` and wants ``a``. Every acquisition site on a
+    cycle is flagged (both nests — fixing either breaks the cycle).
+
+    Lock identity is the attribute/variable name (``self._lock`` in two
+    methods is the same lock; two classes sharing an attribute name in
+    one module are scoped apart). Re-acquiring the SAME name is not an
+    ordering edge (that is re-entrancy, a different bug).
+    """
+    declared = _declared_locks(tree)
+
+    # class-scope lock attributes so `self._lock` in ClassA and ClassB
+    # don't collapse into one node
+    def scope_prefix(stack: typing.Tuple[str, ...]) -> str:
+        return (stack[-1] + ".") if stack else ""
+
+    #: edge (a, b) -> list of (lineno, source rendering) witnesses
+    edges: typing.Dict[
+        typing.Tuple[str, str], typing.List[typing.Tuple[int, str]]
+    ] = {}
+
+    def visit(
+        node: ast.AST,
+        held: typing.Tuple[str, ...],
+        classes: typing.Tuple[str, ...],
+    ) -> None:
+        if isinstance(node, ast.ClassDef):
+            for child in node.body:
+                visit(child, held, classes + (node.name,))
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            # a new stack frame: locks held lexically outside are still
+            # held at runtime ONLY if this function runs inline — it
+            # does not, so the held set resets (conservative: fewer
+            # edges, no false cycles through callbacks)
+            for child in ast.iter_child_nodes(node):
+                visit(child, (), classes)
+            return
+        items = _with_lock_items(node, declared)
+        if items:
+            prefix = scope_prefix(classes)
+            acquired = held
+            for ident, expr in items:
+                scoped = prefix + ident
+                for holder in acquired:
+                    if holder == scoped:
+                        continue
+                    edges.setdefault((holder, scoped), []).append(
+                        (expr.lineno, f"{holder} -> {scoped}")
+                    )
+                acquired = acquired + (scoped,)
+            for child in node.body:
+                visit(child, acquired, classes)
+            for child in getattr(node, "orelse", []) or []:
+                visit(child, held, classes)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, held, classes)
+
+    visit(tree, (), ())
+
+    if not edges:
+        return []
+
+    # cycle detection: a pair of nodes each reachable from the other
+    adjacency: typing.Dict[str, typing.Set[str]] = {}
+    for a, b in edges:
+        adjacency.setdefault(a, set()).add(b)
+
+    def reachable(start: str) -> typing.Set[str]:
+        seen: typing.Set[str] = set()
+        stack = [start]
+        while stack:
+            node = stack.pop()
+            for nxt in adjacency.get(node, ()):
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append(nxt)
+        return seen
+
+    reach = {node: reachable(node) for node in adjacency}
+    problems: typing.List[str] = []
+    for (a, b), witnesses in sorted(edges.items()):
+        if a in reach.get(b, ()):  # b -> ... -> a exists too: a cycle
+            for lineno, rendering in witnesses:
+                problems.append(
+                    f"line {lineno}: lock acquisition {rendering} "
+                    f"completes a cycle in the module's lock graph "
+                    f"({b} is also taken before {a} elsewhere) — two "
+                    f"threads interleaving these nests deadlock; pick "
+                    f"ONE global order and re-nest"
+                )
+    return problems
+
+
+# --------------------------------------------------------------------------
+# unguarded-shared-state
+# --------------------------------------------------------------------------
+
+
+def _thread_target_methods(cls: ast.ClassDef) -> typing.Set[str]:
+    """Method names passed as ``target=self.X`` to a Thread (or
+    executor-submitted: ``submit(self.X)``) anywhere in the class — the
+    code that runs on the background stack."""
+    targets: typing.Set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _callee_tail(node.func)
+        candidates: typing.List[ast.AST] = []
+        if tail == "Thread":
+            candidates.extend(
+                kw.value for kw in node.keywords if kw.arg == "target"
+            )
+        elif tail == "submit" and node.args:
+            candidates.append(node.args[0])
+        for cand in candidates:
+            if (
+                isinstance(cand, ast.Attribute)
+                and isinstance(cand.value, ast.Name)
+                and cand.value.id == "self"
+            ):
+                targets.add(cand.attr)
+    return targets
+
+
+def _guarded_node_ids(fn: ast.AST, declared: typing.Set[str]) -> typing.Set[int]:
+    """ids of nodes that sit inside any ``with lock:`` body of ``fn``."""
+    guarded: typing.Set[int] = set()
+    for stmt in _own_scope_nodes(fn):
+        if _with_lock_items(stmt, declared):
+            for node in _body_nodes(stmt):
+                guarded.add(id(node))
+    return guarded
+
+
+def _self_attr(node: ast.AST) -> typing.Optional[str]:
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+def check_unguarded_shared_state(tree: ast.Module) -> typing.List[str]:
+    """
+    Within one class: an instance attribute ASSIGNED from a thread-target
+    method (``Thread(target=self._drain_loop)`` — the background stack)
+    outside any ``with lock:`` region, while some OTHER method reads it,
+    also unguarded. That is the torn-read / last-writer-wins class of
+    bug (the queue-depth gauge read the last batcher's depth instead of
+    the sum until a shared lock+total fixed it).
+
+    Deliberate near-misses stay clean:
+
+    - writes and reads both under a ``with lock:`` (any lock — the
+      heuristic checks guardedness, not lock identity);
+    - ``threading.Event``/lock/queue attributes themselves (their
+      methods are the synchronization);
+    - attributes only the thread method itself reads (private progress
+      state needs no lock);
+    - simple monotonic flags named ``*stopped*``/``*running*``/
+      ``*alive*`` (a bool flip is atomic under the GIL and the idiom is
+      everywhere; tearing a bool is not the bug this check hunts).
+    """
+    declared = _declared_locks(tree)
+    problems: typing.List[str] = []
+    flag_re = re.compile(r"stop|running|alive|done|started", re.IGNORECASE)
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        thread_methods = _thread_target_methods(cls)
+        if not thread_methods:
+            continue
+        methods = {
+            node.name: node
+            for node in cls.body
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+        # attributes that ARE synchronization objects (or containers
+        # constructed once): assigning them isn't shared-state mutation
+        sync_attrs: typing.Set[str] = set()
+        init = methods.get("__init__")
+        if init is not None:
+            for node in _own_scope_nodes(init):
+                if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Call
+                ):
+                    for target in node.targets:
+                        attr = _self_attr(target)
+                        if attr:
+                            sync_attrs.add(attr)
+        # unguarded writes in thread-target methods
+        unguarded_writes: typing.Dict[str, int] = {}
+        for name in thread_methods:
+            fn = methods.get(name)
+            if fn is None:
+                continue
+            guarded = _guarded_node_ids(fn, declared)
+            for node in _own_scope_nodes(fn):
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                    targets = [node.target]
+                else:
+                    continue
+                if id(node) in guarded:
+                    continue
+                for target in targets:
+                    attr = _self_attr(target)
+                    if (
+                        attr
+                        and attr not in sync_attrs
+                        and not flag_re.search(attr)
+                    ):
+                        unguarded_writes.setdefault(attr, node.lineno)
+        if not unguarded_writes:
+            continue
+        # unguarded reads from OTHER methods
+        for name, fn in methods.items():
+            if name in thread_methods:
+                continue
+            guarded = _guarded_node_ids(fn, declared)
+            for node in _own_scope_nodes(fn):
+                attr = _self_attr(node)
+                if (
+                    attr in unguarded_writes
+                    and isinstance(node.ctx, ast.Load)
+                    and id(node) not in guarded
+                ):
+                    write_line = unguarded_writes.pop(attr)
+                    problems.append(
+                        f"line {write_line}: self.{attr} is written by "
+                        f"thread-target method(s) of {cls.name!r} without "
+                        f"a lock and read from {name!r} also without one "
+                        f"— torn reads / last-writer-wins (the "
+                        f"queue-depth-gauge shape); guard both sides "
+                        f"with one lock or make the update "
+                        f"atomic-by-construction"
+                    )
+    return problems
+
+
+# --------------------------------------------------------------------------
+# thread-leak
+# --------------------------------------------------------------------------
+
+
+def _supervised_containers(tree: ast.Module) -> typing.Set[str]:
+    """Container names C where the module iterates ``for t in C:`` (or
+    ``for t in self.C:``) and joins the loop variable — the
+    fan-out-then-join idiom supervising a whole list of workers."""
+    out: typing.Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.For, ast.AsyncFor)):
+            continue
+        if not isinstance(node.target, ast.Name):
+            continue
+        container = _lock_id(node.iter)
+        if not container:
+            continue
+        for sub in ast.walk(node):
+            if (
+                isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "join"
+                and isinstance(sub.func.value, ast.Name)
+                and sub.func.value.id == node.target.id
+            ):
+                out.add(container)
+                break
+    return out
+
+
+def check_thread_leak(tree: ast.Module) -> typing.List[str]:
+    """
+    A ``Thread(...)`` constructed without ``daemon=True`` and with no
+    reachable ``join`` of its binding anywhere in the module: when the
+    main thread finishes, a forgotten non-daemon thread keeps the
+    process alive — the wedged-watch-daemon shape fixed by hand in the
+    hot-roll reviews. Clean shapes:
+
+    - ``Thread(..., daemon=True)`` (or ``t.daemon = True`` before start);
+    - a binding (local or ``self.X``) that some code in the module
+      ``join()``s — a supervised worker;
+    - a thread collected into a list/comprehension (or ``.append()``ed
+      into one) that the module later drains with
+      ``for t in threads: t.join()`` — the fan-out-then-join idiom;
+    - Thread subclass instantiations are out of scope (their lifecycle
+      policy lives in the subclass).
+    """
+    declared_joins: typing.Set[str] = set()
+    daemon_assigned: typing.Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr == "join":
+                ident = _lock_id(node.func.value)
+                if ident:
+                    declared_joins.add(ident)
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if (
+                    isinstance(target, ast.Attribute)
+                    and target.attr == "daemon"
+                ):
+                    ident = _lock_id(target.value)
+                    if ident:
+                        daemon_assigned.add(ident)
+    supervised = _supervised_containers(tree)
+
+    problems: typing.List[str] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        tail = _callee_tail(node.func)
+        if tail != "Thread":
+            continue
+        # threading.Thread / Thread only; SomeClass.Thread-alikes with a
+        # non-threading base are skipped
+        if isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if not (isinstance(base, ast.Name) and base.id == "threading"):
+                continue
+        daemon_kw = next(
+            (kw.value for kw in node.keywords if kw.arg == "daemon"), None
+        )
+        if isinstance(daemon_kw, ast.Constant) and daemon_kw.value:
+            continue
+        if daemon_kw is not None and not isinstance(daemon_kw, ast.Constant):
+            continue  # dynamic daemon policy: trust the caller
+        # find where this construction lands: a direct binding, a
+        # container assignment (list literal / comprehension), or an
+        # append into a container
+        bound: typing.Optional[str] = None
+        container: typing.Optional[str] = None
+        for parent in ast.walk(tree):
+            if isinstance(parent, ast.Assign):
+                if parent.value is node:
+                    for target in parent.targets:
+                        ident = _lock_id(target)
+                        if ident:
+                            bound = ident
+                elif any(sub is node for sub in ast.walk(parent.value)):
+                    for target in parent.targets:
+                        ident = _lock_id(target)
+                        if ident:
+                            container = ident
+            elif (
+                isinstance(parent, ast.Call)
+                and isinstance(parent.func, ast.Attribute)
+                and parent.func.attr == "append"
+                and any(sub is node for arg in parent.args for sub in ast.walk(arg))
+            ):
+                ident = _lock_id(parent.func.value)
+                if ident:
+                    container = ident
+        if bound and (bound in declared_joins or bound in daemon_assigned):
+            continue
+        if container and container in supervised:
+            continue
+        problems.append(
+            f"line {node.lineno}: Thread(...) started without "
+            f"daemon=True and never join()ed in this module — a "
+            f"non-daemon thread with no supervisor keeps the process "
+            f"alive after the work is done (the wedged-watch-daemon "
+            f"shape); pass daemon=True or keep the handle and join it "
+            f"on shutdown"
+        )
+    return problems
+
+
+# --------------------------------------------------------------------------
+# lock-held-across-yield
+# --------------------------------------------------------------------------
+
+_CALLBACK_NAME_RE = re.compile(r"(^|_)(callback|callbacks|hook|hooks)(_|$)|(^on_[a-z0-9_]+$)")
+
+
+def check_lock_held_across_yield(tree: ast.Module) -> typing.List[str]:
+    """
+    A generator ``yield`` (or an invocation of a caller-supplied
+    callback) lexically inside a ``with lock:`` body: the lock stays
+    held while control is OUTSIDE this function — for as long as the
+    generator's consumer (or the callback) pleases, including forever.
+    The consumer iterating slowly, or the callback taking another lock,
+    turns a critical section into a cross-module stall the lock's owner
+    never wrote. Snapshot under the lock, release, then yield/call.
+    """
+    declared = _declared_locks(tree)
+    problems: typing.List[str] = []
+    seen: typing.Set[int] = set()
+    for stmt in ast.walk(tree):
+        items = _with_lock_items(stmt, declared)
+        if not items:
+            continue
+        lock_ids = ", ".join(ident for ident, _ in items)
+        for node in _body_nodes(stmt):
+            if id(node) in seen:
+                continue
+            if isinstance(node, (ast.Yield, ast.YieldFrom)):
+                seen.add(id(node))
+                problems.append(
+                    f"line {node.lineno}: yield while holding "
+                    f"{lock_ids!r} — the lock stays held until the "
+                    f"consumer resumes this generator (maybe never); "
+                    f"snapshot under the lock, release, then yield"
+                )
+            elif isinstance(node, ast.Call):
+                tail = _callee_tail(node.func)
+                if tail and _CALLBACK_NAME_RE.search(tail):
+                    seen.add(id(node))
+                    problems.append(
+                        f"line {node.lineno}: callback "
+                        f"'{ast.unparse(node.func)}(...)' invoked while "
+                        f"holding {lock_ids!r} — foreign code runs "
+                        f"inside the critical section (and may take "
+                        f"other locks: instant ordering cycle); snapshot "
+                        f"under the lock, release, then call"
+                    )
+    return problems

@@ -16,6 +16,7 @@ the snapshot and is deliberately not replicated.
 
 import json
 import logging
+import os
 import sys
 import traceback
 from typing import Any, List, Tuple, cast
@@ -33,7 +34,7 @@ from gordo_tpu.cli.client import client as gordo_client
 from gordo_tpu.cli.custom_types import HostIP, key_value_par
 from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
 from gordo_tpu.cli.lifecycle import lifecycle_cli
-from gordo_tpu.cli.lint import lint_cli
+from gordo_tpu.cli.lint import lint_cli, lockgraph_cli
 from gordo_tpu.cli.plane import rollup_cli, slo_cli, top_cli
 from gordo_tpu.cli.trace import trace_cli
 from gordo_tpu.cli.tune import tune_cli
@@ -1145,6 +1146,17 @@ def run_server_cli(
     "via POST /router/replicas.",
 )
 @click.option(
+    "--collection-dir",
+    "collection_dir",
+    type=click.Path(file_okay=False),
+    default=None,
+    envvar="MODEL_COLLECTION_DIR",
+    help="The served model collection's latest revision directory (or "
+    "its `latest` symlink) — same artifacts the replicas serve. Falls "
+    "back to the MODEL_COLLECTION_DIR env var; required one way or the "
+    "other, since every request's revision resolves against it.",
+)
+@click.option(
     "--vnodes",
     type=click.IntRange(min=1),
     default=64,
@@ -1257,6 +1269,7 @@ def run_router_cli(
     host,
     port,
     replicas,
+    collection_dir,
     vnodes,
     eject_after,
     backoff_scale,
@@ -1289,6 +1302,16 @@ def run_router_cli(
             "At least one --replica id=url is required "
             "(or GORDO_ROUTER_REPLICAS)"
         )
+    # fail the launch, not the first request: before this guard a router
+    # started without the env var died with a KeyError when the first
+    # prediction tried to resolve its revision
+    if not collection_dir:
+        raise click.UsageError(
+            "--collection-dir is required (or export "
+            "MODEL_COLLECTION_DIR): the router resolves every request's "
+            "revision against the served collection directory"
+        )
+    os.environ["MODEL_COLLECTION_DIR"] = collection_dir
     config = {
         "REPLICAS": replica_map,
         "VNODES": vnodes,
@@ -1318,6 +1341,7 @@ gordo.add_command(telemetry_cli)
 gordo.add_command(trace_cli)
 gordo.add_command(tune_cli)
 gordo.add_command(lint_cli)
+gordo.add_command(lockgraph_cli)
 gordo.add_command(lifecycle_cli)
 gordo.add_command(slo_cli)
 gordo.add_command(top_cli)

@@ -1,6 +1,7 @@
 """
 ``gordo-tpu lint`` — the JAX-discipline and static-health linter
-(gordo_tpu/analysis) as a CLI.
+(gordo_tpu/analysis) as a CLI — and ``gordo-tpu lockgraph``, the
+renderer for the runtime lock-sanitizer's JSON reports.
 
 Exit code is the FINDING COUNT (0 == clean; capped at 125 so shell
 conventions for signals/not-found stay unambiguous), which makes the
@@ -9,11 +10,16 @@ command directly usable as a gate::
     gordo-tpu lint gordo_tpu tests benchmarks
     gordo-tpu lint --format json gordo_tpu | jq '.counts'
     gordo-tpu lint --select retrace-risk --select host-sync gordo_tpu
+    gordo-tpu lint --select 'thread-*' gordo_tpu   # one family, by glob
 
 A committed ``lint_baseline.json`` (repo root, or ``--baseline PATH``)
 grandfathers old findings — each entry must carry a one-line
 justification. ``--write-baseline`` snapshots the current findings into
 a baseline skeleton to grandfather a legacy tree.
+
+``gordo-tpu lockgraph`` follows the same gate convention: exit code ==
+inversion count, so ``make test-sanitize`` can run tier-1 under
+``GORDO_LOCK_SANITIZE=1`` and gate on the rendered report directly.
 """
 
 import json
@@ -143,3 +149,67 @@ def lint_cli(
         )
         click.echo(tail)
     sys.exit(result.exit_code)
+
+
+@click.command("lockgraph")
+@click.argument(
+    "report_path", type=click.Path(exists=True, dir_okay=False)
+)
+@click.option(
+    "--edges",
+    "show_edges",
+    is_flag=True,
+    help="Also print every observed acquisition edge (the full graph, "
+    "not just the problems).",
+)
+def lockgraph_cli(report_path, show_edges):
+    """
+    Render a lock-sanitizer report (the JSON that a tier-1 run under
+    GORDO_LOCK_SANITIZE=1 dumps — see docs/static_analysis.md).
+
+    Shows the observed lock graph's size, every ordering INVERSION (two
+    lock sites acquired in both orders — the two halves of a deadlock)
+    with the acquisition stacks of both orders, and every runtime
+    blocking-under-lock witness. Exit code == inversion count (capped at
+    125), so the command gates like `gordo-tpu lint` does.
+    """
+    from pathlib import Path
+
+    try:
+        report = json.loads(Path(report_path).read_text())
+    except ValueError as exc:
+        raise click.ClickException(f"{report_path}: not JSON: {exc}")
+    nodes = report.get("nodes", [])
+    edges = report.get("edges", [])
+    inversions = report.get("inversions", [])
+    blocking = report.get("blocking", [])
+
+    click.echo(
+        f"lock graph: {len(nodes)} site(s), {len(edges)} edge(s), "
+        f"{len(inversions)} inversion(s), {len(blocking)} "
+        f"blocking-under-lock event(s)"
+    )
+    if show_edges:
+        for edge in edges:
+            click.echo(
+                f"  edge {edge['from']} -> {edge['to']} "
+                f"(x{edge.get('count', 1)})"
+            )
+    for i, inv in enumerate(inversions, start=1):
+        sites = " <-> ".join(inv.get("sites", []))
+        click.echo(f"\ninversion {i}: {sites}")
+        for half in ("forward", "backward"):
+            entry = inv.get(half) or {}
+            order = " -> ".join(entry.get("order", []))
+            click.echo(f"  {half}: {order}")
+            for line in entry.get("stack") or []:
+                click.echo(f"      {line}")
+    for i, event in enumerate(blocking, start=1):
+        held = ", ".join(event.get("held", []))
+        click.echo(
+            f"\nblocking {i}: {event.get('call', '?')} while holding {held}"
+            f" [thread {event.get('thread', '?')}]"
+        )
+        for line in event.get("stack") or []:
+            click.echo(f"      {line}")
+    sys.exit(min(len(inversions), 125))

@@ -574,7 +574,20 @@ class RouterApp:
         the env pointer (symlink-resolved, so a lifecycle promotion rolls
         the router's casualty view too), ``?revision=`` validated by the
         shared name policy (catalog.resolve_sibling_revision)."""
-        pointer = os.environ[self.config["MODEL_COLLECTION_DIR_ENV_VAR"]]
+        env_var = self.config["MODEL_COLLECTION_DIR_ENV_VAR"]
+        pointer = os.environ.get(env_var)
+        if not pointer:
+            # a misconfigured process must answer with a diagnosis, not
+            # a KeyError-shaped 500 on the first request (run-router now
+            # refuses to start without it; this guards embedded apps)
+            return _json_response(
+                {
+                    "error": f"{env_var} is not set on the router process"
+                    " — start it via `gordo-tpu run-router"
+                    " --collection-dir PATH` (or export the env var)"
+                },
+                503,
+            )
         ctx.collection_dir = pointer
         if os.path.islink(pointer.rstrip(os.sep) or os.sep):
             ctx.collection_dir = os.path.realpath(pointer)

@@ -665,6 +665,8 @@ NON_KNOB_ENV_VARS: typing.FrozenSet[str] = frozenset(
         "GORDO_FAULT_INJECT",
         "GORDO_SKIP_LINT",
         "GORDO_SKIP_TUNE_CHECK",
+        "GORDO_LOCK_SANITIZE",
+        "GORDO_LOCK_SANITIZE_REPORT",
         # observability sinks + sampling (config, not tunables)
         "GORDO_TPU_EVENT_LOG",
         "GORDO_TPU_EVENT_LOG_MAX_MB",

@@ -12,6 +12,7 @@ any backend initializes.
 """
 
 import os
+import sys
 
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
@@ -25,6 +26,32 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
+
+# --- the lock-order sanitizer (docs/static_analysis.md) ---------------------
+# GORDO_LOCK_SANITIZE=1 (`make test-sanitize`) instruments the threading
+# constructors for the WHOLE run, so every tier-1 test doubles as a
+# lock-discipline probe; the observed lock graph (edges, ordering
+# inversions, runtime blocking-under-lock witnesses) dumps as JSON at
+# session end for `gordo-tpu lockgraph`. Installed at import time —
+# before test modules (and the package modules they pull in) construct
+# their locks.
+
+from gordo_tpu.analysis import lock_sanitizer  # noqa: E402
+
+if lock_sanitizer.enabled():
+    lock_sanitizer.install()
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if lock_sanitizer.enabled() and lock_sanitizer.installed():
+        path = lock_sanitizer.dump_report()
+        report = lock_sanitizer.report()
+        sys.stdout.write(
+            f"\nlock sanitizer: {len(report['nodes'])} site(s), "
+            f"{len(report['edges'])} edge(s), "
+            f"{len(report['inversions'])} inversion(s), "
+            f"{len(report['blocking'])} blocking event(s) -> {path}\n"
+        )
 
 
 @pytest.fixture(scope="session")

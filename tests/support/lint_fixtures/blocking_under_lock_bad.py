@@ -1,0 +1,55 @@
+"""POSITIVE fixture for blocking-under-lock: the PR-6 shed-path bug,
+reconstructed. The original RequestBatcher.submit emitted the
+queue-full shed event (a JSONL write through the emitter's own lock)
+while STILL HOLDING the queue lock — during a shed storm, the drainer
+and every accepting submit queued behind event-log file I/O. Plus the
+other blocking shapes the family hunts: sleeps, HTTP, subprocesses,
+device syncs."""
+
+import subprocess
+import threading
+import time
+
+import jax
+import requests
+
+from gordo_tpu.observability.events import emit_event
+
+
+class SheddingBatcher:
+    """The pre-fix submit(): event I/O inside the queue lock."""
+
+    def __init__(self, limit):
+        self._lock = threading.Lock()
+        self._queue = []
+        self._limit = limit
+        self._shed_total = 0
+
+    def submit(self, payload):
+        with self._lock:
+            if len(self._queue) >= self._limit:
+                self._shed_total += 1
+                # the bug: the JSONL event log write happens while every
+                # other submit/drain contends for self._lock
+                emit_event(
+                    "server.batch.shed",
+                    queue_depth=len(self._queue),
+                    shed_total=self._shed_total,
+                )
+                raise RuntimeError("queue full")
+            self._queue.append(payload)
+
+    def drain_with_pacing(self):
+        with self._lock:
+            batch = list(self._queue)
+            time.sleep(0.01)  # pacing INSIDE the lock
+            return batch
+
+
+def refresh_under_lock(lock, url, handle):
+    with lock:
+        status = requests.get(url, timeout=5)  # HTTP round-trip held
+        subprocess.run(["sync"], check=True)  # subprocess held
+        jax.block_until_ready(handle)  # device sync held
+        value = handle.item()  # scalar sync held
+    return status, value

@@ -1,0 +1,68 @@
+"""NEAR-MISS fixture for blocking-under-lock: the PR-6 FIX shape and
+the other deliberately clean patterns — blocking calls collected under
+the lock but executed after release, a Condition.wait (which RELEASES
+the lock while blocking), and blocking code merely DEFINED (not run)
+inside a locked region."""
+
+import threading
+import time
+
+import requests
+
+from gordo_tpu.observability.events import emit_event
+
+
+class SheddingBatcher:
+    """The post-fix submit(): gather under the lock, emit after."""
+
+    def __init__(self, limit):
+        self._lock = threading.Lock()
+        self._arrived = threading.Condition(self._lock)
+        self._queue = []
+        self._limit = limit
+        self._shed_total = 0
+
+    def submit(self, payload):
+        shed_depth = None
+        with self._lock:
+            if len(self._queue) >= self._limit:
+                self._shed_total += 1
+                shed_depth = len(self._queue)
+            else:
+                self._queue.append(payload)
+        if shed_depth is not None:
+            # the fix: the lock is released before the event-log write
+            emit_event(
+                "server.batch.shed",
+                queue_depth=shed_depth,
+                shed_total=self._shed_total,
+            )
+            raise RuntimeError("queue full")
+
+    def wait_for_work(self):
+        with self._arrived:
+            # Condition.wait releases the lock for the duration — the
+            # lock-respecting way to pause, never a finding
+            self._arrived.wait(timeout=0.5)
+            return list(self._queue)
+
+    def make_prober(self, url):
+        with self._lock:
+            limit = self._limit
+
+            def probe():
+                # DEFINED under the lock, runs on another stack later:
+                # the blocking call holds nothing
+                return requests.get(url, timeout=limit)
+
+        return probe
+
+
+def paced_poll(lock, source):
+    while True:
+        with lock:
+            item = source.pop() if source else None
+        if item is None:
+            time.sleep(0.01)  # sleeping AFTER release: fine
+            continue
+        return item

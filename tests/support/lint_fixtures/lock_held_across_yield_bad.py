@@ -1,0 +1,25 @@
+"""POSITIVE fixture for lock-held-across-yield: a generator that yields
+while holding a lock (held until the CONSUMER resumes iteration — maybe
+never), and a caller-supplied callback invoked inside the critical
+section (foreign code running under our lock, free to take other locks
+and build an ordering cycle we never wrote)."""
+
+import threading
+
+
+class SessionTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions = {}
+        self.on_evict = None
+
+    def iter_sessions(self):
+        with self._lock:
+            for key, session in self._sessions.items():
+                yield key, session  # lock held across every consumer step
+
+    def evict(self, key):
+        with self._lock:
+            session = self._sessions.pop(key, None)
+            if session is not None and self.on_evict is not None:
+                self.on_evict(key, session)  # foreign code under our lock

@@ -1,0 +1,37 @@
+"""NEAR-MISS fixture for lock-held-across-yield: the snapshot idiom —
+copy under the lock, release, THEN yield / call the callback — and a
+generator merely DEFINED inside a locked region (its body runs on the
+consumer's stack, lock long released)."""
+
+import threading
+
+
+class SessionTable:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sessions = {}
+        self.on_evict = None
+
+    def iter_sessions(self):
+        with self._lock:
+            snapshot = list(self._sessions.items())
+        for key, session in snapshot:
+            yield key, session  # lock released before the first yield
+
+    def evict(self, key):
+        with self._lock:
+            session = self._sessions.pop(key, None)
+        if session is not None and self.on_evict is not None:
+            self.on_evict(key, session)  # callback after release
+
+    def make_reader(self):
+        with self._lock:
+            keys = list(self._sessions)
+
+            def reader():
+                # defined under the lock, generated later: each yield
+                # happens with nothing held
+                for key in keys:
+                    yield key
+
+        return reader

@@ -1,0 +1,26 @@
+"""POSITIVE fixture for lock-order: two code paths acquiring the same
+two locks in OPPOSITE orders — the textbook two-thread deadlock, needing
+only the interleaving where each thread holds its first lock. Both the
+nested-with form and the multi-item ``with a, b:`` form participate."""
+
+import threading
+
+_registry_lock = threading.Lock()
+_stats_lock = threading.Lock()
+
+_registry = {}
+_stats = {}
+
+
+def register(name, value):
+    # path 1: registry THEN stats
+    with _registry_lock:
+        _registry[name] = value
+        with _stats_lock:
+            _stats["registered"] = _stats.get("registered", 0) + 1
+
+
+def snapshot():
+    # path 2: stats THEN registry — the inversion
+    with _stats_lock, _registry_lock:
+        return dict(_stats), dict(_registry)

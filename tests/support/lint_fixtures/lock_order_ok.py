@@ -1,0 +1,57 @@
+"""NEAR-MISS fixture for lock-order: shapes that look like nesting but
+are NOT ordering cycles — a consistent global order used everywhere,
+re-entrant re-acquisition of the same lock, and two classes whose
+same-named lock attributes are different locks (scoped apart, so their
+opposite orders never meet)."""
+
+import threading
+
+_registry_lock = threading.Lock()
+_stats_lock = threading.Lock()
+
+_registry = {}
+_stats = {}
+
+
+def register(name, value):
+    with _registry_lock:
+        _registry[name] = value
+        with _stats_lock:
+            _stats["registered"] = _stats.get("registered", 0) + 1
+
+
+def snapshot():
+    # SAME order as register: registry then stats — no cycle
+    with _registry_lock:
+        with _stats_lock:
+            return dict(_stats), dict(_registry)
+
+
+def audit(rlock=threading.RLock()):
+    with rlock:
+        with rlock:  # re-entrancy, not an ordering edge
+            return len(_registry)
+
+
+class Batcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def push(self):
+        with self._lock:
+            with self._cond:
+                pass
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def scan(self):
+        # opposite order from Batcher.push, but on DIFFERENT locks:
+        # Ledger._cond is not Batcher._cond
+        with self._cond:
+            with self._lock:
+                pass

@@ -1,0 +1,21 @@
+"""POSITIVE fixture for thread-leak: the wedged-watch-daemon shape —
+a non-daemon Thread started with no join anywhere, keeping the process
+alive after main() returns. Both the bound form and the
+fire-and-forget inline form."""
+
+import threading
+
+
+def _watch_loop(path):
+    while True:
+        pass  # poll path forever
+
+
+def start_watcher(path):
+    watcher = threading.Thread(target=_watch_loop, args=(path,))
+    watcher.start()  # no daemon=True, never joined: process never exits
+    return watcher
+
+
+def fire_and_forget(fn):
+    threading.Thread(target=fn).start()  # not even a handle to join

@@ -1,0 +1,57 @@
+"""NEAR-MISS fixture for thread-leak: every supervised lifecycle shape
+— daemon=True, a joined handle (local and instance attr), the
+fan-out-then-join list idiom, daemon set post-construction, and a
+dynamic daemon policy (the caller decides)."""
+
+import threading
+
+
+def start_daemon(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    return t
+
+
+def run_and_wait(fn):
+    worker = threading.Thread(target=fn)
+    worker.start()
+    worker.join()
+
+
+def fan_out(fn, n):
+    threads = [threading.Thread(target=fn) for _ in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+def fan_out_append(fn, n):
+    workers = []
+    for _ in range(n):
+        workers.append(threading.Thread(target=fn))
+        workers[-1].start()
+    for w in workers:
+        w.join()
+
+
+def late_daemon(fn):
+    t = threading.Thread(target=fn)
+    t.daemon = True
+    t.start()
+    return t
+
+
+def policy_daemon(fn, daemonize):
+    t = threading.Thread(target=fn, daemon=daemonize)
+    t.start()
+    return t
+
+
+class Supervised:
+    def start(self, fn):
+        self._worker = threading.Thread(target=fn)
+        self._worker.start()
+
+    def stop(self):
+        self._worker.join()

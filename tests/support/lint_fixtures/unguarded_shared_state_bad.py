@@ -1,0 +1,30 @@
+"""POSITIVE fixture for unguarded-shared-state: the last-writer-wins
+queue-depth gauge bug, reconstructed. Each batcher's drainer thread
+wrote its OWN depth into a shared gauge attribute with no lock; the
+stats endpoint read whatever the last drainer happened to write, so the
+reported depth was one batcher's, not the fleet's — until a shared
+lock + running total fixed it."""
+
+import threading
+
+
+class GaugedBatcher:
+    def __init__(self):
+        self._queue = []
+        self.queue_depth = 0
+        self._drainer = threading.Thread(
+            target=self._drain_loop, daemon=True
+        )
+        self._drainer.start()
+
+    def _drain_loop(self):
+        while True:
+            # the bug: the gauge write happens with no lock — concurrent
+            # drainers race, last writer wins
+            self.queue_depth = len(self._queue)
+            if self._queue:
+                self._queue.pop(0)
+
+    def stats(self):
+        # ...and the request-handler read is unguarded too
+        return {"queue_depth": self.queue_depth}

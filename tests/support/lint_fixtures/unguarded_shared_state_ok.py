@@ -1,0 +1,38 @@
+"""NEAR-MISS fixture for unguarded-shared-state: the FIXED gauge shape
+(both sides under one lock), a monotonic stop flag (atomic bool flip —
+the everywhere idiom, not this bug), and drainer-private progress state
+no other method reads."""
+
+import threading
+
+
+class GaugedBatcher:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._queue = []
+        self.queue_depth = 0
+        self._stopped = False
+        self._drained_count = 0
+        self._drainer = threading.Thread(
+            target=self._drain_loop, daemon=True
+        )
+        self._drainer.start()
+
+    def _drain_loop(self):
+        while not self._stopped:
+            with self._lock:
+                # the fix: gauge write under the shared lock
+                self.queue_depth = len(self._queue)
+                if self._queue:
+                    self._queue.pop(0)
+            # drainer-private progress: nobody else reads it
+            self._drained_count = self._drained_count + 1
+
+    def stats(self):
+        with self._lock:
+            return {"queue_depth": self.queue_depth}
+
+    def stop(self):
+        # a monotonic bool flip is atomic under the GIL; flag attrs are
+        # exempt by design
+        self._stopped = True

@@ -648,7 +648,7 @@ def test_run_server_cli_passes_batching_knobs(runner, monkeypatch):
     }
 
 
-def test_run_router_cli_passes_knobs(runner, monkeypatch):
+def test_run_router_cli_passes_knobs(runner, monkeypatch, tmp_path):
     """run-router parses --replica id=url entries and hands every knob
     to the router config intact (docs/serving.md#sharded-serving-plane)."""
     captured = {}
@@ -660,11 +660,14 @@ def test_run_router_cli_passes_knobs(runner, monkeypatch):
 
     from gordo_tpu.router import app as router_app
 
+    # delenv also registers cleanup for the value run-router exports
+    monkeypatch.delenv("MODEL_COLLECTION_DIR", raising=False)
     monkeypatch.setattr(router_app, "run_router", fake_run_router)
     result = runner.invoke(
         gordo,
         ["run-router", "--host", "127.0.0.1", "--port", "5556",
          "--replica", "r0=http://h0:5555", "--replica", "r1=http://h1:5555/",
+         "--collection-dir", str(tmp_path),
          "--hedge-ms", "25", "--eject-after", "2", "--max-inflight", "8",
          "--threads", "12"],
     )
@@ -677,10 +680,60 @@ def test_run_router_cli_passes_knobs(runner, monkeypatch):
     assert captured["config"]["HEDGE_MS"] == 25
     assert captured["config"]["EJECT_AFTER"] == 2
     assert captured["config"]["MAX_INFLIGHT"] == 8
+    # the flag exports the env var the request path resolves against
+    assert os.environ["MODEL_COLLECTION_DIR"] == str(tmp_path)
     # no replicas is a usage error, not a crash at serve time
     result = runner.invoke(gordo, ["run-router"])
     assert result.exit_code != 0
     assert "replica" in result.output.lower()
+
+
+def test_run_router_cli_requires_collection_dir(runner, monkeypatch, tmp_path):
+    """A router launched without MODEL_COLLECTION_DIR used to die with a
+    KeyError on the FIRST REQUEST; now the launch itself is a clear
+    usage error, and the env var still works as the fallback."""
+    captured = {}
+
+    def fake_run_router(host, port, log_level, config=None, threads=None):
+        captured.update(config=config)
+
+    from gordo_tpu.router import app as router_app
+
+    monkeypatch.setattr(router_app, "run_router", fake_run_router)
+    monkeypatch.delenv("MODEL_COLLECTION_DIR", raising=False)
+    result = runner.invoke(
+        gordo, ["run-router", "--replica", "r0=http://h0:5555"]
+    )
+    assert result.exit_code != 0
+    assert "--collection-dir" in result.output
+    assert "MODEL_COLLECTION_DIR" in result.output
+    assert not captured  # never reached run_router
+    # env fallback: exporting the var is equivalent to the flag
+    monkeypatch.setenv("MODEL_COLLECTION_DIR", str(tmp_path))
+    result = runner.invoke(
+        gordo, ["run-router", "--replica", "r0=http://h0:5555"]
+    )
+    assert result.exit_code == 0, result.output
+    assert captured["config"]["REPLICAS"] == {"r0": "http://h0:5555"}
+
+
+def test_router_app_answers_503_not_keyerror_without_collection_dir(
+    monkeypatch,
+):
+    """Defense in depth for embedded apps: a router whose process lost
+    the env var answers requests with a structured 503 diagnosis, not a
+    KeyError-shaped 500."""
+    from werkzeug.test import Client as WerkzeugClient
+
+    from gordo_tpu.router.app import build_router_app
+
+    monkeypatch.delenv("MODEL_COLLECTION_DIR", raising=False)
+    app = build_router_app({"REPLICAS": {"r0": "http://h0:5555"}})
+    client = WerkzeugClient(app)
+    response = client.get("/gordo/v0/proj/machine/metadata")
+    assert response.status_code == 503
+    payload = json.loads(response.get_data())
+    assert "MODEL_COLLECTION_DIR" in payload["error"]
 
 
 def test_client_cli_help(runner):

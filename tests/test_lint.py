@@ -16,21 +16,31 @@ from pathlib import Path
 import pytest
 
 from gordo_tpu.analysis import (
+    check_blocking_under_lock,
     check_donation_safety,
     check_host_sync,
     check_knob_discipline,
+    check_lock_held_across_yield,
+    check_lock_order,
     check_prng_key_reuse,
     check_prng_split_width,
     check_retrace_risk,
     check_span_discipline,
+    check_thread_leak,
     check_traced_branching,
+    check_unguarded_shared_state,
     engine,
     lint_file,
     lint_paths,
     load_baseline,
     write_baseline,
 )
-from gordo_tpu.analysis.registry import CHECKS, JAX_CHECK_NAMES, get_check
+from gordo_tpu.analysis.registry import (
+    CHECKS,
+    JAX_CHECK_NAMES,
+    THREAD_CHECK_NAMES,
+    get_check,
+)
 
 FIXTURES = Path(__file__).parent / "support" / "lint_fixtures"
 
@@ -43,6 +53,11 @@ _CHECKS = {
     "donation-safety": check_donation_safety,
     "span-discipline": check_span_discipline,
     "knob-discipline": check_knob_discipline,
+    "blocking-under-lock": check_blocking_under_lock,
+    "lock-order": check_lock_order,
+    "unguarded-shared-state": check_unguarded_shared_state,
+    "thread-leak": check_thread_leak,
+    "lock-held-across-yield": check_lock_held_across_yield,
 }
 
 _FIXTURE_STEMS = {
@@ -54,6 +69,11 @@ _FIXTURE_STEMS = {
     "donation-safety": "donation_safety",
     "span-discipline": "span_discipline",
     "knob-discipline": "knob_discipline",
+    "blocking-under-lock": "blocking_under_lock",
+    "lock-order": "lock_order",
+    "unguarded-shared-state": "unguarded_shared_state",
+    "thread-leak": "thread_leak",
+    "lock-held-across-yield": "lock_held_across_yield",
 }
 
 
@@ -108,14 +128,57 @@ def test_host_sync_fixture_finds_every_primitive():
         assert needle in rendered, (needle, found)
 
 
+def test_blocking_check_catches_pr6_shed_under_lock_shape():
+    """The reconstruction of PR 6's headline bug: the shed-path
+    event-log write emitted while still holding the queue lock."""
+    found = check_blocking_under_lock(_parse_fixture("blocking_under_lock_bad"))
+    assert any("emit_event" in f and "_lock" in f for f in found), found
+    rendered = "\n".join(found)
+    # every blocking class is represented in the fixture
+    for needle in ("requests.get", "subprocess.run", "time.sleep", "item()"):
+        assert needle in rendered, (needle, found)
+
+
+def test_unguarded_check_catches_last_writer_wins_gauge_shape():
+    """The reconstruction of the queue-depth gauge bug: each drainer
+    wrote its own depth into a shared attr with no lock; the stats read
+    saw the last writer, not the fleet."""
+    found = check_unguarded_shared_state(
+        _parse_fixture("unguarded_shared_state_bad")
+    )
+    assert len(found) == 1, found
+    assert "queue_depth" in found[0] and "GaugedBatcher" in found[0], found
+
+
+def test_lock_order_flags_both_sites_of_the_cycle():
+    found = check_lock_order(_parse_fixture("lock_order_bad"))
+    assert len(found) == 2, found
+    rendered = "\n".join(found)
+    assert "_registry_lock -> _stats_lock" in rendered, found
+    assert "_stats_lock -> _registry_lock" in rendered, found
+
+
+def test_thread_check_messages_carry_no_extra_line_reference():
+    """Baseline `match` substrings must survive unrelated line shifts:
+    no thread-check message may reference a second line number beyond
+    the engine-parsed `line N:` prefix."""
+    for check_name in THREAD_CHECK_NAMES:
+        stem = _FIXTURE_STEMS[check_name]
+        for finding in _CHECKS[check_name](_parse_fixture(f"{stem}_bad")):
+            body = finding.split(":", 1)[1]
+            assert "line " not in body, (check_name, finding)
+
+
 # --------------------------------------------------------------------------
 # engine: hot-path gating, suppressions, baseline
 # --------------------------------------------------------------------------
 
 
 def test_host_sync_is_hot_gated(tmp_path):
-    """host-sync only fires on hot-tagged modules: the same source
-    lints clean elsewhere but is flagged under parallel/."""
+    """host-sync only fires on hot-tagged modules — which, since the
+    per-PR scope list collapsed, is ALL of gordo_tpu/ (new subsystems
+    are covered by default); the same source lints clean outside the
+    package (tests, benchmarks, scratch files)."""
     source = (FIXTURES / "host_sync_bad.py").read_text()
     cold = tmp_path / "somewhere.py"
     cold.write_text(source)
@@ -123,7 +186,10 @@ def test_host_sync_is_hot_gated(tmp_path):
     assert findings == []
     assert engine.is_hot_path("gordo_tpu/parallel/fleet.py")
     assert engine.is_hot_path("gordo_tpu/models/core.py")
-    assert not engine.is_hot_path("gordo_tpu/models/specs.py")
+    # the whole package is hot now — specs.py used to be the cold case
+    assert engine.is_hot_path("gordo_tpu/models/specs.py")
+    assert engine.is_hot_path("gordo_tpu/rollout/new_subsystem.py")
+    assert not engine.is_hot_path(str(cold))
 
 
 def test_inline_suppression_comment(tmp_path):
@@ -251,11 +317,35 @@ def test_fixture_corpus_is_excluded_from_discovery():
 def test_registry_is_complete_and_documented():
     names = {spec.name for spec in CHECKS}
     assert set(JAX_CHECK_NAMES) <= names
+    assert set(THREAD_CHECK_NAMES) <= names
+    assert set(THREAD_CHECK_NAMES) == {
+        "blocking-under-lock",
+        "lock-order",
+        "unguarded-shared-state",
+        "thread-leak",
+        "lock-held-across-yield",
+    }
     for spec in CHECKS:
         assert spec.doc and spec.fixer and spec.severity in ("error", "warning")
         assert spec.scope in ("syntactic", "semantic")
     with pytest.raises(KeyError, match="unknown check"):
         get_check("no-such-check")
+
+
+def test_select_glob_resolves_the_thread_family():
+    """`--select thread-*` picks exactly the concurrency family: the
+    glob matches each check's name or its family-qualified alias."""
+    selected = {s.name for s in engine._selected_checks(["thread-*"])}
+    assert selected == set(THREAD_CHECK_NAMES)
+    # exact names still select exactly one check
+    assert [s.name for s in engine._selected_checks(["lock-order"])] == [
+        "lock-order"
+    ]
+    # a duplicate-matching token list does not duplicate checks
+    both = engine._selected_checks(["thread-*", "thread-leak"])
+    assert len(both) == len({s.name for s in both})
+    with pytest.raises(KeyError, match="unknown check"):
+        engine._selected_checks(["nothread-*"])
 
 
 # --------------------------------------------------------------------------
@@ -364,3 +454,73 @@ def test_cli_write_baseline_round_trip(cli_runner, tmp_path):
         ["--select", "unused-import", "--baseline", str(baseline), str(bad)],
     )
     assert result.exit_code == 0, result.output
+
+
+def test_cli_select_thread_glob(cli_runner, tmp_path):
+    """`gordo-tpu lint --select thread-*` runs the whole family: the
+    PR-6 fixture trips blocking-under-lock through the CLI path.
+    (Fixtures are copied out of the corpus dir — `lint_fixtures` is in
+    DEFAULT_EXCLUDES, so in place the CLI would skip them.)"""
+    from gordo_tpu.cli.lint import lint_cli
+
+    bad = tmp_path / "shed.py"
+    bad.write_text((FIXTURES / "blocking_under_lock_bad.py").read_text())
+    result = cli_runner.invoke(
+        lint_cli, ["--select", "thread-*", "--no-baseline", str(bad)]
+    )
+    assert result.exit_code > 0, result.output
+    assert "blocking-under-lock" in result.output
+    # and the family passes its near-misses through the same path
+    ok = tmp_path / "shed_fixed.py"
+    ok.write_text((FIXTURES / "blocking_under_lock_ok.py").read_text())
+    result = cli_runner.invoke(
+        lint_cli, ["--select", "thread-*", "--no-baseline", str(ok)]
+    )
+    assert result.exit_code == 0, result.output
+
+
+def test_cli_lockgraph_renders_report_and_gates_on_inversions(
+    cli_runner, tmp_path
+):
+    from gordo_tpu.cli.lint import lockgraph_cli
+
+    report = {
+        "version": 1,
+        "nodes": [
+            {"site": "a.py:10", "acquisitions": 4},
+            {"site": "b.py:20", "acquisitions": 4},
+        ],
+        "edges": [
+            {"from": "a.py:10", "to": "b.py:20", "count": 2, "stack": []},
+            {"from": "b.py:20", "to": "a.py:10", "count": 1, "stack": []},
+        ],
+        "inversions": [
+            {
+                "sites": ["a.py:10", "b.py:20"],
+                "forward": {"order": ["a.py:10", "b.py:20"], "stack": ["x"]},
+                "backward": {"order": ["b.py:20", "a.py:10"], "stack": ["y"]},
+                "thread": "t1",
+            }
+        ],
+        "blocking": [
+            {
+                "call": "time.sleep(0.1)",
+                "held": ["a.py:10"],
+                "stack": ["z"],
+                "thread": "t2",
+            }
+        ],
+    }
+    path = tmp_path / "lockgraph.json"
+    path.write_text(json.dumps(report))
+    result = cli_runner.invoke(lockgraph_cli, [str(path)])
+    assert result.exit_code == 1, result.output  # one inversion
+    assert "1 inversion(s)" in result.output
+    assert "a.py:10 <-> b.py:20" in result.output
+    assert "time.sleep(0.1)" in result.output
+    # a clean report exits 0
+    clean = dict(report, inversions=[])
+    path.write_text(json.dumps(clean))
+    result = cli_runner.invoke(lockgraph_cli, [str(path), "--edges"])
+    assert result.exit_code == 0, result.output
+    assert "edge a.py:10 -> b.py:20 (x2)" in result.output

@@ -796,7 +796,8 @@ def test_knob_registry_well_formed():
 
 
 # --------------------------------------------------------------------------
-# the JAX-discipline family, package-wide (the tier-1 lint gate)
+# the JAX- and concurrency-discipline families, package-wide (the
+# tier-1 lint gate)
 # --------------------------------------------------------------------------
 
 _LINT_ROOT = Path(gordo_tpu.__file__).parent.parent
@@ -813,14 +814,20 @@ _LINT_ROOT = Path(gordo_tpu.__file__).parent.parent
         "donation-safety",
         "span-discipline",
         "knob-discipline",
+        "blocking-under-lock",
+        "lock-order",
+        "unguarded-shared-state",
+        "thread-leak",
+        "lock-held-across-yield",
     ],
 )
 def test_jax_discipline_package_wide(check_name):
-    """gordo_tpu + tests + benchmarks lint clean for every JAX check —
-    the mechanical enforcement of what PR 2 fixed by hand (re-traced
-    jitted closures; width-dependent PRNG streams). Intentional
-    violations carry inline `# lint: disable=` suppressions next to the
-    comment justifying them; there is nothing in the baseline."""
+    """gordo_tpu + tests + benchmarks lint clean for every JAX and
+    concurrency check — the mechanical enforcement of what PR 2 (jitted
+    closures, PRNG streams) and PR 6 (event I/O under the queue lock)
+    fixed by hand. Intentional violations carry inline
+    `# lint: disable=` suppressions next to the comment justifying
+    them; there is nothing in the baseline."""
     from gordo_tpu.analysis import lint_paths
 
     targets = [
