@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test test-sanitize lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming bench-precision bench-slo build-multiworker images push
+.PHONY: all test test-sanitize lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming bench-precision bench-slo bench-gameday build-multiworker images push
 
 all: lint test
 
@@ -95,6 +95,15 @@ bench-slo:
 		--output benchmarks/results_load_test_slo_cpu_r16.json
 	python benchmarks/consolidate.py
 	python -c "import json,sys; slo=json.load(open('benchmarks/results_load_test_slo_cpu_r16.json')).get('slo') or {}; print('SLO', slo.get('spec'), 'ok' if slo.get('ok') else 'BUDGET EXHAUSTED', 'max_burn=%.2fx' % (slo.get('max_burn_rate') or 0)); sys.exit(0 if slo.get('ok') else 1)"
+
+# the full game-day catalogue (docs/robustness.md "Game days"): six
+# composed-failure scenarios with fault timelines and SLO budgets run
+# against an in-process plane; exit code = number of failed scenarios,
+# and bench-summary folds the per-scenario verdicts into trajectory.json
+bench-gameday:
+	python benchmarks/gameday.py \
+		--output benchmarks/results_gameday_cpu_r19.json
+	python benchmarks/consolidate.py
 
 # 2-worker crash-tolerant ledger build of the example fleet config
 # (docs/robustness.md "Multi-worker builds") — the smoke proof that N

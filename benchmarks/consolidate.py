@@ -35,6 +35,7 @@ HEADLINE_METRICS = (
     ("models_per_hour", "models/hour"),
     ("goodput_retained", "fraction"),
     ("goodput_retained_after_kill", "fraction"),
+    ("scenarios_passed_fraction", "fraction"),
     ("first_predict_speedup", "x"),
     ("compile_reduction", "x"),
     ("speedup", "x"),
@@ -150,6 +151,28 @@ def consolidate(directory: Path) -> dict:
                     "spec": slo.get("spec"),
                     "ok": slo.get("ok"),
                     "max_burn_rate": slo.get("max_burn_rate"),
+                }
+            # game-day runs stamp the composed per-scenario verdict so
+            # a robustness regression (budget newly exhausted, a
+            # post-condition newly failed) shows up in the SAME file
+            # that trends perf (docs/robustness.md "Game days")
+            scenarios = document.get("scenarios")
+            if document.get("bench") == "gameday" and isinstance(
+                scenarios, list
+            ):
+                entry["gameday"] = {
+                    "ok": document.get("ok"),
+                    "n_failed": document.get("n_failed"),
+                    "scenarios": {
+                        s.get("scenario"): {
+                            "ok": s.get("ok"),
+                            "max_burn_rate": (s.get("slo") or {}).get(
+                                "max_burn_rate"
+                            ),
+                        }
+                        for s in scenarios
+                        if isinstance(s, dict)
+                    },
                 }
         entries.append(entry)
     return {

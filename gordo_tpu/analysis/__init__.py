@@ -51,6 +51,7 @@ from gordo_tpu.analysis.checks import (
     check_span_discipline,
     check_unused_imports,
     collect_event_names,
+    collect_fault_sites,
     collect_metric_names,
     collect_span_names,
     parse,
@@ -132,6 +133,7 @@ __all__ = [
     "check_unused_imports",
     "collect_env_reads",
     "collect_event_names",
+    "collect_fault_sites",
     "collect_metric_names",
     "collect_span_names",
     "get_check",

@@ -826,6 +826,9 @@ ALLOWED_METRIC_LABELS = frozenset(
         # build/train/stream, mode is prefetched/direct — both fixed
         # three-or-fewer-value vocabularies
         "plane", "mode",
+        # chaos injection sites are bounded by the _KNOWN_SITES
+        # frozenset (robustness/faults.py), a fixed compile-time set
+        "site",
     }
 )
 
@@ -1017,6 +1020,37 @@ def collect_span_names(tree: ast.Module) -> typing.Set[str]:
         ):
             names.add(name_node.value)
     return names
+
+
+#: the chaos-site vocabulary's one spelling (robustness/faults.py)
+FAULT_SITES_CONSTANT = "_KNOWN_SITES"
+
+
+def collect_fault_sites(tree: ast.Module) -> typing.Set[str]:
+    """
+    The literal chaos-site names bound to ``_KNOWN_SITES`` in this
+    module (robustness/faults.py's ``frozenset({...})``) — the
+    docs-catalogue sync sibling of :func:`collect_metric_names` /
+    :func:`collect_event_names` / :func:`collect_span_names` applied to
+    fault injection: a site ``parse_spec`` accepts but
+    docs/robustness.md's chaos table doesn't list is a seam no chaos
+    run will ever discover.
+    """
+    sites: typing.Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == FAULT_SITES_CONSTANT
+            for t in node.targets
+        ):
+            continue
+        for constant in ast.walk(node.value):
+            if isinstance(constant, ast.Constant) and isinstance(
+                constant.value, str
+            ):
+                sites.add(constant.value)
+    return sites
 
 
 def check_span_discipline(tree: ast.Module) -> typing.List[str]:

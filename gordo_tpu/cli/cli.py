@@ -33,6 +33,7 @@ from gordo_tpu.cli.buckets import buckets_cli
 from gordo_tpu.cli.client import client as gordo_client
 from gordo_tpu.cli.custom_types import HostIP, key_value_par
 from gordo_tpu.cli.exceptions_reporter import ExceptionsReporter, ReportLevel
+from gordo_tpu.cli.gameday import gameday_cli
 from gordo_tpu.cli.lifecycle import lifecycle_cli
 from gordo_tpu.cli.lint import lint_cli, lockgraph_cli
 from gordo_tpu.cli.plane import rollup_cli, slo_cli, top_cli
@@ -1346,6 +1347,7 @@ gordo.add_command(lifecycle_cli)
 gordo.add_command(slo_cli)
 gordo.add_command(top_cli)
 gordo.add_command(rollup_cli)
+gordo.add_command(gameday_cli)
 
 if __name__ == "__main__":
     gordo()

@@ -1,0 +1,174 @@
+"""
+Game-day commands (docs/robustness.md "Game days"):
+
+- ``gordo-tpu gameday list`` — the shipped scenario catalogue (name,
+  plane shape, timeline verbs, SLO objectives, expectations).
+- ``gordo-tpu gameday run [NAMES...]`` — execute scenarios (all of
+  them by default) against an in-process plane over a freshly trained
+  throwaway fleet; exits nonzero when any scenario fails its composed
+  verdict (SLO budget, zero unstructured errors, post-conditions,
+  bit-identity where promised). ``--output`` writes the full report
+  JSON, which ``benchmarks/consolidate.py`` stamps into
+  ``trajectory.json`` so robustness regressions trend like perf
+  regressions.
+"""
+
+import json
+import sys
+import time
+
+import click
+
+
+@click.group("gameday")
+def gameday_cli():
+    """Declarative game days: fault timelines with SLO budgets run
+    against an in-process serving plane."""
+
+
+@gameday_cli.command("list")
+@click.option(
+    "--as-json",
+    is_flag=True,
+    help="Emit the raw scenario documents instead of the table.",
+)
+def gameday_list(as_json: bool):
+    """The shipped scenario catalogue."""
+    from gordo_tpu.scenario import builtin_scenarios, scenario_documents
+
+    if as_json:
+        click.echo(json.dumps(scenario_documents(), indent=2))
+        return
+    for name, scenario in sorted(builtin_scenarios().items()):
+        verbs = ", ".join(
+            f"{e.at_s:g}s {e.action}" for e in scenario.timeline
+        )
+        objectives = ", ".join(
+            o.label() for o in scenario.slo.objectives
+        )
+        click.echo(f"{name}")
+        click.echo(f"  {scenario.description}")
+        click.echo(
+            f"  plane: {scenario.plane.replicas} replicas · "
+            f"{scenario.workload.streams} streams · "
+            f"{scenario.duration_s:g}s"
+        )
+        click.echo(f"  timeline: {verbs}")
+        click.echo(f"  slo: {objectives}")
+
+
+@gameday_cli.command("run")
+@click.argument("names", nargs=-1)
+@click.option(
+    "--scenario-file",
+    "scenario_files",
+    multiple=True,
+    type=click.Path(exists=True, dir_okay=False),
+    help="Run a scenario YAML/JSON file (repeatable) in addition to "
+    "(or instead of) named built-ins.",
+)
+@click.option(
+    "--collection",
+    "collection_models",
+    type=click.Path(exists=True, file_okay=False),
+    default=None,
+    help="A prebuilt gameday 'models' tree (from a prior run's "
+    "--keep-workdir); default trains a throwaway fleet.",
+)
+@click.option(
+    "--workdir",
+    type=click.Path(file_okay=False),
+    default=None,
+    help="Working directory (kept after the run); default is a "
+    "temporary directory removed on exit.",
+)
+@click.option(
+    "--output",
+    type=click.Path(dir_okay=False),
+    default=None,
+    help="Write the full report JSON here.",
+)
+@click.option(
+    "--as-json",
+    is_flag=True,
+    help="Emit the report JSON to stdout instead of the summary.",
+)
+def gameday_run(names, scenario_files, collection_models, workdir, output, as_json):
+    """Run game-day scenarios (all shipped scenarios by default) and
+    exit with the number of failures."""
+    import shutil
+    import tempfile
+
+    from gordo_tpu.observability import emit_event
+    from gordo_tpu.scenario import (
+        builtin_scenarios,
+        load_scenario,
+        run_scenario,
+        shared_gameday_collection,
+    )
+
+    shipped = builtin_scenarios()
+    unknown = sorted(set(names) - set(shipped))
+    if unknown:
+        raise click.UsageError(
+            f"Unknown scenario(s) {unknown}; shipped: {sorted(shipped)}"
+        )
+    scenarios = [shipped[n] for n in (names or sorted(shipped))]
+    for path in scenario_files:
+        scenarios.append(load_scenario(path))
+
+    cleanup = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="gordo-gameday-")
+    started = time.time()
+    reports = []
+    try:
+        if collection_models is None:
+            click.echo("Training the gameday fleet (one-time) ...")
+            collection_models = shared_gameday_collection(workdir)
+        for scenario in scenarios:
+            click.echo(f"▸ {scenario.name} ...", nl=False)
+            report = run_scenario(scenario, collection_models, workdir)
+            reports.append(report)
+            verdict = "pass" if report["ok"] else "FAIL"
+            click.echo(
+                f" {verdict} "
+                f"(slo burn {report['slo']['max_burn_rate']:.2f}x, "
+                f"{len(report['unstructured_errors'])} unstructured, "
+                f"{report['streams']['reconnects']} resumes, "
+                f"{report['wall_time_s']:.1f}s)"
+            )
+            for line in report["expect_failures"]:
+                click.echo(f"    expect: {line}")
+            for line in report["unstructured_errors"][:5]:
+                click.echo(f"    error: {line}")
+    finally:
+        if cleanup:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+    failures = [r for r in reports if not r["ok"]]
+    payload = {
+        "bench": "gameday",
+        "n_scenarios": len(reports),
+        "n_failed": len(failures),
+        "ok": not failures,
+        "wall_time_s": round(time.time() - started, 2),
+        "scenarios": reports,
+    }
+    if output:
+        with open(output, "w") as fh:
+            json.dump(payload, fh, indent=2, default=str)
+        click.echo(f"Report written to {output}")
+    if as_json:
+        click.echo(json.dumps(payload, indent=2, default=str))
+    if failures:
+        emit_event(
+            "gameday_failed",
+            scenarios=[r["scenario"] for r in failures],
+        )
+        click.echo(
+            f"{len(failures)}/{len(reports)} scenario(s) failed: "
+            + ", ".join(r["scenario"] for r in failures)
+        )
+    else:
+        click.echo(f"All {len(reports)} scenario(s) passed.")
+    sys.exit(len(failures))

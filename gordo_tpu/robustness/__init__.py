@@ -24,10 +24,14 @@ in :mod:`gordo_tpu.server`. See docs/robustness.md.
 
 from .faults import (
     FAULT_INJECT_ENV_VAR,
+    FAULT_INJECT_FILE_ENV_VAR,
     FaultSpec,
     InjectedFault,
     active_registry,
+    arm_file,
+    disarm_file,
     inject,
+    parse_spec,
     reset,
     tear_checkpoint_files,
     train_nan_injection,
@@ -35,10 +39,14 @@ from .faults import (
 
 __all__ = [
     "FAULT_INJECT_ENV_VAR",
+    "FAULT_INJECT_FILE_ENV_VAR",
     "FaultSpec",
     "InjectedFault",
     "active_registry",
+    "arm_file",
+    "disarm_file",
     "inject",
+    "parse_spec",
     "reset",
     "tear_checkpoint_files",
     "train_nan_injection",

@@ -44,13 +44,31 @@ One spec is ``site:mode[:target][@key:value ...]``:
   ``@attempts:N`` (fail only the first N attempts, then succeed — the
   retry-path exercise).
 
-Every firing emits a ``fault_injected`` event, so a chaos run's event
-log names exactly which faults actually triggered.
+Every firing emits a ``fault_injected`` event and bumps the
+``gordo_fault_fired_total{site}`` counter, so a chaos run's event log
+names exactly which faults actually triggered and a scenario report can
+count firings without parsing the log.
 
-Hot-path discipline: with the env var unset, every seam is a single
-``os.environ.get`` returning None — no parsing, no registry, no state.
-Parsed registries are cached per spec string (fire counts live on the
-cached specs); tests use :func:`reset` between scenarios.
+Runtime activation (docs/robustness.md "Game days"): beside the env
+grammar, ``GORDO_FAULT_INJECT_FILE`` names a file whose CONTENT is the
+same ``;``-separated spec string. The file is re-checked by mtime on
+every seam consultation, so a game-day runner can arm/disarm faults in
+already-running processes mid-scenario by rewriting the file
+(:func:`arm_file` / :func:`disarm_file`). ``GORDO_FAULT_INJECT`` (the
+explicit env grammar) always wins when both are set; with neither set,
+every seam stays the strict no-op below.
+
+Hot-path discipline: with both env vars unset, every seam is two
+``os.environ.get`` calls returning None — no parsing, no registry, no
+state, no filesystem access. Parsed env registries are cached per spec
+string (fire counts live on the cached specs). :func:`reset` is the
+PUBLIC scenario boundary: it drops every cached registry and its fire
+counts, so ``@attempts:N`` budgets start fresh — without it, a second
+scenario reusing the same spec string in one process inherits the first
+scenario's exhausted budgets (the cache is keyed by spec string and fire
+counts are process-global). The file channel re-arms fresh by itself: a
+rewrite bumps the mtime and builds a new registry, so re-arming the same
+spec string mid-scenario also restarts its budgets.
 """
 
 import dataclasses
@@ -62,6 +80,11 @@ import typing
 logger = logging.getLogger(__name__)
 
 FAULT_INJECT_ENV_VAR = "GORDO_FAULT_INJECT"
+
+#: runtime fault-activation channel: a PATH whose file content is the
+#: same spec grammar, re-checked by mtime at every seam consultation —
+#: how a game-day runner arms/disarms faults in running processes
+FAULT_INJECT_FILE_ENV_VAR = "GORDO_FAULT_INJECT_FILE"
 
 _KNOWN_SITES = frozenset(
     {
@@ -145,6 +168,19 @@ def parse_spec(spec_string: str) -> typing.List[FaultSpec]:
     return specs
 
 
+def _count_fired(site: str) -> None:
+    """Bump ``gordo_fault_fired_total{site}`` — the metric twin of the
+    ``fault_injected`` event (scenario reports read the counter delta;
+    forensics read the event log)."""
+    from gordo_tpu.observability import get_registry
+
+    get_registry().counter(
+        "gordo_fault_fired_total",
+        "Chaos fault firings by injection site (docs/robustness.md)",
+        ("site",),
+    ).inc(site=site)
+
+
 class FaultRegistry:
     """The parsed specs of one ``GORDO_FAULT_INJECT`` value."""
 
@@ -162,14 +198,16 @@ class FaultRegistry:
 
     def fire(self, spec: FaultSpec, **fields) -> int:
         """
-        Record one firing: bump the spec's count (thread-safe) and emit
-        the ``fault_injected`` event. Returns the 1-based attempt number.
+        Record one firing: bump the spec's count (thread-safe), bump
+        ``gordo_fault_fired_total{site}``, and emit the
+        ``fault_injected`` event. Returns the 1-based attempt number.
         """
         from gordo_tpu.observability import emit_event
 
         with self._lock:
             spec.fires += 1
             count = spec.fires
+        _count_fired(spec.site)
         emit_event(
             "fault_injected",
             site=spec.site,
@@ -184,30 +222,109 @@ class FaultRegistry:
 #: spec string -> parsed registry. Fire counts live on the cached specs,
 #: so a seam retried against the same env value sees its own history.
 _registries: typing.Dict[str, FaultRegistry] = {}
+#: fault file path -> (mtime_ns, size, registry-or-None): the mtime
+#: fingerprint the file channel re-checks per consultation. A rewrite
+#: builds a FRESH registry, so re-armed ``@attempts`` budgets restart.
+_file_registries: typing.Dict[
+    str, typing.Tuple[int, int, typing.Optional[FaultRegistry]]
+] = {}
 _registries_lock = threading.Lock()
 
 
 def reset() -> None:
-    """Drop cached registries (and their fire counts). Test seam."""
+    """
+    Public scenario boundary (docs/robustness.md "Game days"): drop
+    every cached registry — env-keyed and file-keyed — and with them
+    every spec's fire count, so ``@attempts:N`` budgets start fresh.
+
+    Registries are cached by spec string and fire counts live on the
+    cached specs, both process-global: without a reset, a second
+    scenario reusing the same ``GORDO_FAULT_INJECT`` value in one
+    process inherits the first scenario's exhausted budgets. Call this
+    between scenarios (the game-day runner does; test fixtures do).
+    """
     with _registries_lock:
         _registries.clear()
+        _file_registries.clear()
+
+
+def _file_registry(path: str) -> typing.Optional[FaultRegistry]:
+    """The registry for the fault file's CURRENT content, re-validated
+    whenever the (mtime_ns, size) fingerprint moves. Missing or empty
+    file = disarmed (None)."""
+    try:
+        stat = os.stat(path)
+        fingerprint = (stat.st_mtime_ns, stat.st_size)
+    except OSError:
+        fingerprint = (-1, -1)  # missing file = disarmed
+    with _registries_lock:
+        cached = _file_registries.get(path)
+        if cached is not None and (cached[0], cached[1]) == fingerprint:
+            return cached[2]
+        registry = None
+        if fingerprint != (-1, -1):
+            try:
+                with open(path) as fh:
+                    value = fh.read().strip()
+            except OSError:
+                value = ""
+            if value:
+                registry = FaultRegistry(parse_spec(value))
+        _file_registries[path] = (*fingerprint, registry)
+    return registry
+
+
+def arm_file(path: typing.Union[str, os.PathLike], spec_string: str) -> None:
+    """
+    Arm (or re-arm) the fault file at ``path`` with ``spec_string``,
+    validating through :func:`parse_spec` FIRST — a typo'd scenario
+    action fails at the runner, not silently in the target process.
+    The write is atomic (tmp + rename), so a seam mid-recheck reads
+    either the old spec or the new one, never a torn line. Re-arming
+    the same spec string still restarts its ``@attempts`` budgets (the
+    rewrite bumps the mtime fingerprint; the reader builds a fresh
+    registry).
+    """
+    parse_spec(spec_string)
+    path = os.fspath(path)
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        fh.write(spec_string)
+    os.replace(tmp, path)
+    # drop this process's cached registry outright: a same-content
+    # rewrite inside one mtime-granularity tick would otherwise keep
+    # the old fingerprint (and its exhausted budgets) alive here
+    with _registries_lock:
+        _file_registries.pop(path, None)
+
+
+def disarm_file(path: typing.Union[str, os.PathLike]) -> None:
+    """Disarm every spec in the fault file (atomically truncate it)."""
+    arm_file(path, "")
 
 
 def active_registry() -> typing.Optional[FaultRegistry]:
     """
     The registry for the CURRENT env value, or None when unset/empty —
-    the one check every seam starts with (a dict lookup; the strict
-    no-op guarantee when fault injection is off).
+    the one check every seam starts with (the strict no-op guarantee
+    when fault injection is off). ``GORDO_FAULT_INJECT`` (a spec
+    string, cached per value) wins; ``GORDO_FAULT_INJECT_FILE`` (a
+    path whose content is the spec string, re-checked by mtime) is the
+    runtime channel behind it; with neither set, this is two env
+    lookups and nothing else.
     """
     value = os.environ.get(FAULT_INJECT_ENV_VAR)
-    if not value:
+    if value:
+        with _registries_lock:
+            registry = _registries.get(value)
+            if registry is None:
+                registry = FaultRegistry(parse_spec(value))
+                _registries[value] = registry
+        return registry
+    path = os.environ.get(FAULT_INJECT_FILE_ENV_VAR)
+    if not path:
         return None
-    with _registries_lock:
-        registry = _registries.get(value)
-        if registry is None:
-            registry = FaultRegistry(parse_spec(value))
-            _registries[value] = registry
-    return registry
+    return _file_registry(path)
 
 
 # -- seams ---------------------------------------------------------------
@@ -568,6 +685,7 @@ def replica_fault_action(
                 return None
             from gordo_tpu.observability import emit_event
 
+            _count_fired("replica")
             emit_event(
                 "fault_injected",
                 site="replica",

@@ -1,0 +1,62 @@
+"""
+Declarative game days (docs/robustness.md "Game days"): YAML fault
+timelines executed against an in-process copy of the full serving
+plane, judged by SLO budgets over the telemetry rollup.
+
+- :mod:`~gordo_tpu.scenario.timeline` — the scenario grammar (strict
+  parse: unknown verbs, unknown fault sites, unknown SLO signals all
+  fail before anything runs)
+- :mod:`~gordo_tpu.scenario.plane` — the loopback plane (router +
+  sharded replicas + lifecycle + rollup poller)
+- :mod:`~gordo_tpu.scenario.runner` — the event-loop executor and the
+  composed verdict
+- :mod:`~gordo_tpu.scenario.library` — the shipped scenario catalogue
+  (mirrored as YAML in ``examples/scenarios/``)
+- :mod:`~gordo_tpu.scenario.synthetic` — thread-free synthetic
+  clients: the heap-scheduled event loop that scales the harness to
+  ~10⁶ concurrent simulated streams
+
+Entry points: ``gordo-tpu gameday run|list`` and ``make bench-gameday``.
+"""
+
+from gordo_tpu.scenario.library import (
+    builtin_scenarios,
+    get_scenario,
+    scenario_documents,
+)
+from gordo_tpu.scenario.plane import (
+    ScenarioPlane,
+    build_gameday_collection,
+    shared_gameday_collection,
+)
+from gordo_tpu.scenario.runner import run_scenario
+from gordo_tpu.scenario.synthetic import (
+    EventLoop,
+    StubPlane,
+    SyntheticStream,
+)
+from gordo_tpu.scenario.timeline import (
+    Scenario,
+    ScenarioError,
+    load_scenario,
+    parse_duration,
+    parse_scenario,
+)
+
+__all__ = [
+    "EventLoop",
+    "Scenario",
+    "ScenarioError",
+    "ScenarioPlane",
+    "StubPlane",
+    "SyntheticStream",
+    "build_gameday_collection",
+    "builtin_scenarios",
+    "get_scenario",
+    "load_scenario",
+    "parse_duration",
+    "parse_scenario",
+    "run_scenario",
+    "scenario_documents",
+    "shared_gameday_collection",
+]

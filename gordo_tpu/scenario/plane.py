@@ -1,0 +1,488 @@
+"""
+The game-day plane: one in-process copy of the full serving stack —
+sharded replicas behind the router, a lifecycle manager over the same
+revision tree, and a rollup poller computing the control signals the
+scenario's SLO budget is evaluated against (docs/robustness.md
+"Game days").
+
+Everything is loopback: replica apps mount behind a host-routing
+``requests`` adapter (the tests' fake-deployed-cluster shape, SURVEY.md
+§4), so the *real* router, *real* streaming publisher, and *real*
+lifecycle promotion run against each other with no network and no
+subprocesses. That buys the runner two superpowers a packet-level
+harness can't have cheaply: killing a replica is one set-membership
+change (the router sees connection-refused, exactly the SIGKILL shape),
+and the jaxlib manifest "upgrade" is one JSON edit followed by rolling
+replica restarts (fresh catalog → ``open_store`` re-verify →
+``manifest_mismatch`` fallback with zero request failures).
+
+Telemetry: all in-process members share ONE metrics registry, so the
+poller contributes it once (member ``process``) and adds status-only
+replica members (liveness from the plane's own kill set) plus the
+lifecycle manager's ``last_tick.json`` member — the same
+:func:`~gordo_tpu.observability.rollup.compute_signals` windowing the
+real deployment's rollup uses, with no double counting.
+"""
+
+import io
+import json
+import logging
+import os
+import shutil
+import threading
+import time
+import typing
+from urllib.parse import urlsplit
+
+import numpy as np
+import pandas as pd
+import requests
+from requests.adapters import BaseAdapter
+
+from gordo_tpu.observability import rollup as rollup_mod
+
+logger = logging.getLogger(__name__)
+
+#: the fleet the gameday collection builder trains (kept tiny: game
+#: days measure plane behavior, not model quality)
+GAMEDAY_TAGS = [f"gd-tag-{i}" for i in range(3)]
+GAMEDAY_MACHINES = [f"gd-m-{i}" for i in range(4)]
+GAMEDAY_BASE_REVISION = "1700000000000"
+GAMEDAY_PROJECT = "gameday"
+
+_WINDOW_START = "2019-01-01T00:00:00+00:00"
+_WINDOW_END = "2019-01-02T00:00:00+00:00"
+
+
+class _WSGIAdapter(BaseAdapter):
+    """Route prepared requests into a WSGI app (the tests/utils.py
+    loopback shape, duplicated here because the library must not import
+    the test suite)."""
+
+    def __init__(self, wsgi_app):
+        super().__init__()
+        self.wsgi_app = wsgi_app
+        self._lock = threading.Lock()
+
+    def send(
+        self, request, stream=False, timeout=None, verify=True, cert=None,
+        proxies=None,
+    ):
+        from werkzeug.test import EnvironBuilder, run_wsgi_app
+
+        parts = urlsplit(request.url)
+        body = request.body
+        if isinstance(body, str):
+            body = body.encode("utf-8")
+        builder = EnvironBuilder(
+            path=parts.path,
+            query_string=parts.query,
+            method=request.method,
+            headers=dict(request.headers),
+            input_stream=io.BytesIO(body) if body else None,
+        )
+        environ = builder.get_environ()
+        with self._lock:
+            app_iter, status, headers = run_wsgi_app(self.wsgi_app, environ)
+            content = b"".join(app_iter)
+            if hasattr(app_iter, "close"):
+                app_iter.close()
+        response = requests.Response()
+        response.status_code = int(status.split(" ", 1)[0])
+        response.headers = requests.structures.CaseInsensitiveDict(headers)
+        response.raw = io.BytesIO(content)
+        response._content = content
+        response.url = request.url
+        response.request = request
+        response.connection = self
+        return response
+
+    def close(self):
+        pass
+
+
+class PlaneAdapter(BaseAdapter):
+    """Host-routing adapter with a kill switch: requests to a host in
+    ``dead`` raise ``ConnectionError`` — from the router's seat a
+    killed replica is indistinguishable from a SIGKILL'd process."""
+
+    def __init__(self):
+        super().__init__()
+        self.adapters: typing.Dict[str, _WSGIAdapter] = {}
+        self.dead: typing.Set[str] = set()
+
+    def mount(self, host: str, wsgi_app) -> None:
+        self.adapters[host] = _WSGIAdapter(wsgi_app)
+
+    def send(self, request, **kwargs):
+        host = urlsplit(request.url).netloc
+        if host in self.dead:
+            raise requests.ConnectionError(
+                f"gameday: replica {host} is down"
+            )
+        return self.adapters[host].send(request, **kwargs)
+
+    def close(self):
+        pass
+
+
+class _EmptyRegistry:
+    """Stand-in registry for status-only members: every in-process
+    member shares the real process registry, which the ``process``
+    member already contributes — counting it again per replica would
+    triple plane counters."""
+
+    def snapshot(self) -> dict:
+        return {}
+
+
+_EMPTY_REGISTRY = _EmptyRegistry()
+
+
+def build_gameday_collection(
+    root: typing.Union[str, os.PathLike],
+    machines: typing.Optional[typing.Sequence[str]] = None,
+) -> str:
+    """Train the tiny gameday fleet once under ``root/models`` (the
+    lifecycle revision-tree shape: ``<rev>/`` + ``latest`` symlink) and
+    publish an empty-but-valid AOT program manifest so the rolling
+    jaxlib-upgrade scenario has something to invalidate. Returns the
+    ``models`` directory path."""
+    from gordo_tpu.builder.fleet_build import FleetModelBuilder
+    from gordo_tpu.machine import Machine
+    from gordo_tpu.programs.store import ProgramStore, store_directory
+
+    names = list(machines or GAMEDAY_MACHINES)
+    specs = [
+        Machine(
+            name=name,
+            project_name=GAMEDAY_PROJECT,
+            model={
+                "gordo_tpu.models.anomaly.DiffBasedAnomalyDetector": {
+                    "base_estimator": {
+                        "sklearn.pipeline.Pipeline": {
+                            "steps": [
+                                "sklearn.preprocessing.MinMaxScaler",
+                                {
+                                    "gordo_tpu.models.AutoEncoder": {
+                                        "kind": "feedforward_hourglass",
+                                        "epochs": 2,
+                                        "batch_size": 16,
+                                    }
+                                },
+                            ]
+                        }
+                    }
+                }
+            },
+            dataset={
+                "type": "RandomDataset",
+                "train_start_date": _WINDOW_START,
+                "train_end_date": _WINDOW_END,
+                "tags": GAMEDAY_TAGS,
+                "target_tag_list": GAMEDAY_TAGS,
+                "asset": "gra",
+            },
+        )
+        for name in names
+    ]
+    models = os.path.join(os.fspath(root), "models")
+    revision_dir = os.path.join(models, GAMEDAY_BASE_REVISION)
+    FleetModelBuilder(specs, fetch_backoff=lambda a: 0.0).build(
+        output_dir_base=revision_dir
+    )
+    os.symlink(GAMEDAY_BASE_REVISION, os.path.join(models, "latest"))
+    store = ProgramStore(store_directory(revision_dir))
+    os.makedirs(store.directory, exist_ok=True)
+    store.write_manifest()
+    return models
+
+
+class ScenarioPlane:
+    """One scenario's private plane over a shared trained collection.
+
+    ``collection_models`` is a ``models`` tree from
+    :func:`build_gameday_collection`; the plane COPIES it into
+    ``workdir`` (promotions and manifest bumps mutate the tree, and a
+    scenario must never see its predecessor's revisions)."""
+
+    def __init__(
+        self,
+        collection_models: typing.Union[str, os.PathLike],
+        workdir: typing.Union[str, os.PathLike],
+        replicas: int = 2,
+    ):
+        self.workdir = os.fspath(workdir)
+        self.models = os.path.join(self.workdir, "models")
+        shutil.copytree(
+            os.fspath(collection_models), self.models, symlinks=True
+        )
+        self.pointer = os.path.join(self.models, "latest")
+        self.fault_file = os.path.join(self.workdir, "faults.spec")
+        self.rids = [f"r{i}" for i in range(int(replicas))]
+        self.adapter = PlaneAdapter()
+        self.apps: typing.Dict[str, typing.Any] = {}
+        self.router = None
+        self._manager = None
+        self._saved_env: typing.Dict[str, typing.Optional[str]] = {}
+        self._lifecycle_member = False
+        self.poller: typing.Optional[rollup_mod.RollupPoller] = None
+
+    # -- lifecycle of the plane itself ------------------------------------
+
+    def start(self) -> None:
+        from gordo_tpu.robustness import faults
+        from gordo_tpu.router.app import RouterApp
+        from gordo_tpu.server import build_app, utils as server_utils
+        from gordo_tpu.server.catalog import write_shard_manifest
+
+        for var, value in (
+            ("MODEL_COLLECTION_DIR", self.pointer),
+            (faults.FAULT_INJECT_FILE_ENV_VAR, self.fault_file),
+        ):
+            self._saved_env[var] = os.environ.get(var)
+            os.environ[var] = value
+        server_utils.clear_caches()
+        faults.reset()
+        self.manifest = write_shard_manifest(
+            os.path.join(self.workdir, "shard_manifest.json"), self.rids
+        )
+        for rid in self.rids:
+            self.apps[rid] = build_app(
+                {"SHARD_MANIFEST": self.manifest, "REPLICA_ID": rid}
+            )
+            self.adapter.mount(f"{rid}.test", self.apps[rid])
+        session = requests.Session()
+        session.mount("http://", self.adapter)
+        self.router = RouterApp(
+            {
+                "REPLICAS": {rid: f"http://{rid}.test" for rid in self.rids},
+                "SESSION": session,
+                "PROBE_INTERVAL_S": 0,  # lazy half-open: no prober thread
+                "BACKOFF_SCALE": 0.002,
+                "EJECT_AFTER": 1,
+            }
+        )
+        local_members = {
+            "process": self._process_member,
+        }
+        for rid in self.rids:
+            local_members[rid] = (
+                lambda rid=rid: self._replica_member(rid)
+            )
+        self.poller = rollup_mod.RollupPoller(
+            members=lambda: {},
+            interval_s=0.0,
+            local_members=local_members,
+            name="gameday",
+        )
+
+    def close(self) -> None:
+        from gordo_tpu.robustness import faults
+
+        if self.router is not None:
+            self.router.close()
+            self.router = None
+        for var, value in self._saved_env.items():
+            if value is None:
+                os.environ.pop(var, None)
+            else:
+                os.environ[var] = value
+        self._saved_env.clear()
+        faults.reset()
+
+    # -- telemetry members -------------------------------------------------
+
+    def _process_member(self) -> dict:
+        return rollup_mod.snapshot_payload(
+            role="router", replica_id="process", revision=self.revision()
+        )
+
+    def _replica_member(self, rid: str) -> dict:
+        alive = f"{rid}.test" not in self.adapter.dead
+        return rollup_mod.snapshot_payload(
+            role="replica",
+            replica_id=rid,
+            revision=self.revision(),
+            status={"status": "ok" if alive else "down"},
+            registry=_EMPTY_REGISTRY,
+        )
+
+    def _lifecycle_member_snapshot(self) -> dict:
+        path = os.path.join(self.models, ".lifecycle", "last_tick.json")
+        with open(path) as fh:
+            return json.load(fh)
+
+    def enable_lifecycle_member(self) -> None:
+        """Register the lifecycle heartbeat member (scenarios whose
+        timeline ticks lifecycle); before the first tick writes
+        ``last_tick.json`` the member reads as a poll error, which is
+        data, not fabricated freshness."""
+        if not self._lifecycle_member and self.poller is not None:
+            self.poller.local_members["lifecycle"] = (
+                self._lifecycle_member_snapshot
+            )
+            self._lifecycle_member = True
+
+    def poll(self, now: typing.Optional[float] = None) -> dict:
+        """One rollup poll: the merged plane snapshot with windowed
+        ``signals`` embedded (what ``slo.evaluate`` consumes)."""
+        return self.poller.poll_once(now=now)
+
+    # -- plane state -------------------------------------------------------
+
+    def revision(self) -> str:
+        return os.path.basename(os.path.realpath(self.pointer))
+
+    def machine_names(self) -> typing.List[str]:
+        current = os.path.realpath(self.pointer)
+        return sorted(
+            name
+            for name in os.listdir(current)
+            if not name.startswith(".")
+            and os.path.isdir(os.path.join(current, name))
+        )
+
+    # -- timeline verbs ----------------------------------------------------
+
+    def kill_replica(self, rid: str) -> None:
+        if rid not in self.rids:
+            raise ValueError(f"Unknown replica {rid!r}; have {self.rids}")
+        self.adapter.dead.add(f"{rid}.test")
+
+    def restart_replica(self, rid: str) -> None:
+        """A fresh process image for one replica: new app, new catalog,
+        new ``open_store`` verification against the (possibly bumped)
+        AOT manifest. The shared model-artifact caches stay — artifacts
+        on disk are identical, which is the point of bit-identity."""
+        from gordo_tpu.server import build_app
+
+        if rid not in self.rids:
+            raise ValueError(f"Unknown replica {rid!r}; have {self.rids}")
+        self.apps[rid] = build_app(
+            {"SHARD_MANIFEST": self.manifest, "REPLICA_ID": rid}
+        )
+        self.adapter.mount(f"{rid}.test", self.apps[rid])
+        self.adapter.dead.discard(f"{rid}.test")
+
+    def bump_jaxlib_manifest(self) -> str:
+        """The rolling-upgrade injection: rewrite the live revision's
+        AOT program manifest as if it had been exported under a
+        different jaxlib. Replicas restarted after this see
+        ``manifest_mismatch`` and retrace — requests must not fail."""
+        from gordo_tpu.programs.store import MANIFEST_FILENAME, store_directory
+
+        path = os.path.join(
+            os.fspath(store_directory(os.path.realpath(self.pointer))),
+            MANIFEST_FILENAME,
+        )
+        with open(path) as fh:
+            manifest = json.load(fh)
+        manifest["jaxlib"] = f"{manifest.get('jaxlib')}+gameday"
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(manifest, fh, indent=2, sort_keys=True)
+        os.replace(tmp, path)
+        return manifest["jaxlib"]
+
+    def lifecycle_manager(self):
+        from gordo_tpu.lifecycle import LifecycleConfig, LifecycleManager
+
+        if self._manager is None:
+            self._manager = LifecycleManager(
+                self.pointer, config=LifecycleConfig()
+            )
+        return self._manager
+
+    # -- clients -----------------------------------------------------------
+
+    def client(self, n_retries: int = 4):
+        """The real gordo client, loopback-mounted on the router."""
+        from gordo_tpu.client.client import Client
+
+        session = requests.Session()
+        adapter = _WSGIAdapter(self.router)
+        session.mount("http://", adapter)
+        session.mount("https://", adapter)
+        return Client(
+            project=GAMEDAY_PROJECT,
+            host="plane.test",
+            port=80,
+            scheme="http",
+            session=session,
+            n_retries=n_retries,
+        )
+
+    def one_shot(self, machine: str, rows: np.ndarray) -> np.ndarray:
+        """The bit-identity reference: one fleet POST of the whole
+        accumulated window, straight through the router."""
+        from werkzeug.test import Client as WerkzeugClient
+
+        from gordo_tpu.server.utils import (
+            dataframe_from_dict,
+            dataframe_to_dict,
+        )
+
+        index = pd.date_range(
+            "2019-01-01", periods=len(rows), freq="10min", tz="UTC"
+        )
+        frame = pd.DataFrame(
+            np.asarray(rows), columns=GAMEDAY_TAGS, index=index
+        )
+        resp = WerkzeugClient(self.router).post(
+            f"/gordo/v0/{GAMEDAY_PROJECT}/prediction/fleet",
+            json={"machines": {machine: dataframe_to_dict(frame)}},
+        )
+        if resp.status_code != 200:
+            raise RuntimeError(
+                f"one-shot reference failed ({resp.status_code}): "
+                f"{resp.get_data()!r}"
+            )
+        payload = json.loads(resp.get_data())["data"][machine]
+        return np.asarray(
+            dataframe_from_dict(payload)["model-output"].to_numpy(),
+            dtype="float32",
+        )
+
+    def fleet_post(self, machine: str, rows: np.ndarray) -> int:
+        """One client one-shot request; returns the HTTP status (the
+        workload's request verb — 200/503 are structured outcomes)."""
+        from werkzeug.test import Client as WerkzeugClient
+
+        from gordo_tpu.server.utils import dataframe_to_dict
+
+        index = pd.date_range(
+            "2019-01-01", periods=len(rows), freq="10min", tz="UTC"
+        )
+        frame = pd.DataFrame(
+            np.asarray(rows), columns=GAMEDAY_TAGS, index=index
+        )
+        resp = WerkzeugClient(self.router).post(
+            f"/gordo/v0/{GAMEDAY_PROJECT}/prediction/fleet",
+            json={"machines": {machine: dataframe_to_dict(frame)}},
+        )
+        return resp.status_code
+
+
+_GAMEDAY_COLLECTION_CACHE: typing.Dict[str, str] = {}
+_GAMEDAY_COLLECTION_LOCK = threading.Lock()
+
+
+def shared_gameday_collection(root: typing.Union[str, os.PathLike]) -> str:
+    """Build (once per ``root``) and return the shared gameday
+    ``models`` tree scenario planes copy from — a CLI run of six
+    scenarios pays one training, not six."""
+    key = os.fspath(root)
+    with _GAMEDAY_COLLECTION_LOCK:
+        cached = _GAMEDAY_COLLECTION_CACHE.get(key)
+    if cached and os.path.isdir(cached):
+        return cached
+    started = time.time()
+    logger.info("Building gameday collection under %s", key)
+    models = build_gameday_collection(key)
+    logger.info(
+        "Gameday collection ready in %.1fs", time.time() - started
+    )
+    with _GAMEDAY_COLLECTION_LOCK:
+        _GAMEDAY_COLLECTION_CACHE[key] = models
+    return models

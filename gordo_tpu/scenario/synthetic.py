@@ -1,0 +1,168 @@
+"""
+Synthetic clients for game days (docs/robustness.md "Game days"): an
+event-loop harness that simulates streams and one-shot request arrivals
+WITHOUT a thread per client.
+
+The thread-per-stream shape of the test suite tops out around the
+thousands (stack + scheduler cost per client); a game day wants the
+paper's fleet shape — ~10⁶ concurrent monitoring streams against one
+plane. :class:`EventLoop` is a heap-scheduled cooperative scheduler:
+every synthetic client is a small ``__slots__`` object whose next fire
+time lives in the heap, so a million idle streams cost a million heap
+entries and zero threads. Two clocks:
+
+- **virtual time** (default) — ``run_until`` jumps the clock from event
+  to event, so harness-scale runs (the ≥100k-stream pin in
+  tests/test_scenario.py) finish in wall-milliseconds per simulated
+  minute;
+- **real time** (``real_time=True``) — the scenario runner's mode:
+  events fire against ``time.monotonic()`` so the in-process serving
+  plane under test experiences genuine arrival pacing.
+
+Transports are pluggable: :class:`StubPlane` is the in-memory
+million-stream target (seq bookkeeping only, the harness-scalability
+measurement); the scenario runner supplies transports that drive the
+real router/replica plane (scenario/runner.py).
+"""
+
+import heapq
+import time
+import typing
+
+
+class EventLoop:
+    """A heap of ``(due, tie, callback)``; no threads, no polling."""
+
+    __slots__ = ("_heap", "_tie", "_now", "real_time", "_stopped")
+
+    def __init__(self, real_time: bool = False, start: float = 0.0):
+        self._heap: typing.List[tuple] = []
+        self._tie = 0
+        self.real_time = bool(real_time)
+        self._now = time.monotonic() if self.real_time else float(start)
+        self._stopped = False
+
+    @property
+    def now(self) -> float:
+        return time.monotonic() if self.real_time else self._now
+
+    def call_at(self, when: float, callback, *args) -> None:
+        self._tie += 1
+        heapq.heappush(self._heap, (float(when), self._tie, callback, args))
+
+    def call_later(self, delay: float, callback, *args) -> None:
+        self.call_at(self.now + max(0.0, float(delay)), callback, *args)
+
+    def stop(self) -> None:
+        """Stop ``run_until`` after the currently-firing event."""
+        self._stopped = True
+
+    def run_until(self, deadline: float) -> int:
+        """Fire every event due up to ``deadline``; returns the number
+        fired. Virtual time jumps between events; real time sleeps the
+        gaps (events that overrun simply fire late — open-loop pacing,
+        the melting-client shape a shed must absorb)."""
+        fired = 0
+        self._stopped = False
+        while self._heap and not self._stopped:
+            due = self._heap[0][0]
+            if due > deadline:
+                break
+            if self.real_time:
+                gap = due - time.monotonic()
+                if gap > 0:
+                    time.sleep(gap)
+            else:
+                self._now = max(self._now, due)
+            _, _, callback, args = heapq.heappop(self._heap)
+            callback(*args)
+            fired += 1
+        if not self.real_time:
+            self._now = max(self._now, deadline)
+        return fired
+
+
+class SyntheticStream:
+    """One simulated monitoring stream: opens once, then pushes
+    ``rows_per_update`` rows every ``interval`` seconds through its
+    transport. State is deliberately tiny (``__slots__``, no buffers) —
+    the harness holds one of these per concurrent stream."""
+
+    __slots__ = (
+        "name", "machine", "interval", "rows_per_update", "transport",
+        "opened", "closed", "updates", "rows_sent", "seq", "session",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        machine: str,
+        interval: float,
+        rows_per_update: int,
+        transport: "StubPlane",
+    ):
+        self.name = name
+        self.machine = machine
+        self.interval = float(interval)
+        self.rows_per_update = int(rows_per_update)
+        self.transport = transport
+        self.opened = False
+        self.closed = False
+        self.updates = 0
+        self.rows_sent = 0
+        self.seq = 0
+        self.session: typing.Optional[str] = None
+
+    def start(self, loop: EventLoop, at: float) -> None:
+        loop.call_at(at, self._open, loop)
+
+    def _open(self, loop: EventLoop) -> None:
+        self.session = self.transport.open(self)
+        self.opened = True
+        loop.call_later(self.interval, self._update, loop)
+
+    def _update(self, loop: EventLoop) -> None:
+        if self.closed:
+            return
+        self.seq = self.transport.update(self)
+        self.updates += 1
+        self.rows_sent += self.rows_per_update
+        loop.call_later(self.interval, self._update, loop)
+
+    def close(self) -> None:
+        if self.opened and not self.closed:
+            self.transport.close(self)
+        self.closed = True
+
+
+class StubPlane:
+    """The in-memory transport for harness-scale runs: server-side
+    bookkeeping of one plane (sessions, per-stream seq acks) with no
+    scoring — what bounds the synthetic-client harness itself, which is
+    exactly the thing the ≥100k-stream pin measures."""
+
+    __slots__ = ("sessions", "live", "peak_live", "updates", "rows")
+
+    def __init__(self):
+        self.sessions: typing.Dict[str, int] = {}
+        self.live = 0
+        self.peak_live = 0
+        self.updates = 0
+        self.rows = 0
+
+    def open(self, stream: SyntheticStream) -> str:
+        sid = f"s{len(self.sessions)}"
+        self.sessions[sid] = 0
+        self.live += 1
+        self.peak_live = max(self.peak_live, self.live)
+        return sid
+
+    def update(self, stream: SyntheticStream) -> int:
+        acked = self.sessions[stream.session] + stream.rows_per_update
+        self.sessions[stream.session] = acked
+        self.updates += 1
+        self.rows += stream.rows_per_update
+        return acked
+
+    def close(self, stream: SyntheticStream) -> None:
+        self.live -= 1

@@ -663,6 +663,7 @@ NON_KNOB_ENV_VARS: typing.FrozenSet[str] = frozenset(
     {
         # chaos / CI switches
         "GORDO_FAULT_INJECT",
+        "GORDO_FAULT_INJECT_FILE",
         "GORDO_SKIP_LINT",
         "GORDO_SKIP_TUNE_CHECK",
         "GORDO_LOCK_SANITIZE",

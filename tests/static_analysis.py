@@ -33,6 +33,7 @@ from gordo_tpu.analysis.checks import (  # noqa: F401  # lint: disable=unused-im
     check_span_discipline,
     check_unused_imports,
     collect_event_names,
+    collect_fault_sites,
     collect_metric_names,
     collect_span_names,
     parse,

@@ -761,3 +761,127 @@ def test_backoff_jitter_is_seedable_and_bounded():
     other = [backoff_seconds(n, jitter=0.25) for n in range(1, 6)]
     assert other != first
     seed_backoff_jitter(None)
+
+
+# -- the runtime file channel (game days) --------------------------------
+
+
+def _series_value(snap, name, **labels):
+    for s in snap.get(name, {}).get("series", []):
+        if all(s["labels"].get(k) == v for k, v in labels.items()):
+            return s["value"]
+    return 0.0
+
+
+def test_fault_file_channel_arms_and_disarms_mid_process(
+    monkeypatch, tmp_path
+):
+    """GORDO_FAULT_INJECT_FILE is the runtime activation channel: a
+    game-day runner rewrites the file and an ALREADY-RUNNING process
+    changes behavior on its next seam consultation — no restart, no env
+    churn. Unset (or file missing/empty) stays the strict no-op."""
+    path = tmp_path / "faults.spec"
+    monkeypatch.delenv(faults.FAULT_INJECT_FILE_ENV_VAR, raising=False)
+    assert faults.active_registry() is None  # unset: strict no-op
+
+    monkeypatch.setenv(faults.FAULT_INJECT_FILE_ENV_VAR, str(path))
+    assert faults.active_registry() is None  # missing file: disarmed
+    faults.inject("fetch", "m-1")  # no raise
+
+    faults.arm_file(path, "fetch:raise:m-1")
+    with pytest.raises(InjectedFault):
+        faults.inject("fetch", "m-1")
+    faults.inject("fetch", "m-0")  # untargeted machines never fault
+
+    faults.disarm_file(path)
+    faults.inject("fetch", "m-1")  # disarmed mid-process
+    assert faults.active_registry() is None
+
+
+def test_fault_file_arm_validates_spec_first(tmp_path):
+    path = tmp_path / "faults.spec"
+    with pytest.raises(ValueError, match="unknown site"):
+        faults.arm_file(path, "fletch:raise")
+    assert not path.exists()  # a typo'd arm writes NOTHING
+
+
+def test_fault_env_grammar_wins_over_file(monkeypatch, tmp_path):
+    path = tmp_path / "faults.spec"
+    faults.arm_file(path, "fetch:raise:m-1")
+    monkeypatch.setenv(faults.FAULT_INJECT_FILE_ENV_VAR, str(path))
+    monkeypatch.setenv(faults.FAULT_INJECT_ENV_VAR, "ckpt:torn")
+    registry = faults.active_registry()
+    assert [s.site for s in registry.specs] == ["ckpt"]
+    faults.inject("fetch", "m-1")  # the file's spec is shadowed
+
+
+def test_fault_file_rearm_restarts_attempts_budget(monkeypatch, tmp_path):
+    """Re-arming the SAME spec string restarts its @attempts budget —
+    the file rewrite invalidates the cached registry, so scenario N+1
+    never inherits scenario N's exhausted budgets."""
+    path = tmp_path / "faults.spec"
+    monkeypatch.setenv(faults.FAULT_INJECT_FILE_ENV_VAR, str(path))
+    faults.arm_file(path, "fetch:raise:m-1@attempts:1")
+    with pytest.raises(InjectedFault):
+        faults.inject("fetch", "m-1")
+    faults.inject("fetch", "m-1")  # budget exhausted
+
+    faults.arm_file(path, "fetch:raise:m-1@attempts:1")
+    with pytest.raises(InjectedFault):
+        faults.inject("fetch", "m-1")  # fresh registry, fresh budget
+
+
+def test_reset_restarts_env_attempts_budget(monkeypatch):
+    """faults.reset() is the scenario boundary: registries are cached
+    per spec string process-globally, so without it a rerun of the same
+    spec inherits exhausted @attempts budgets."""
+    monkeypatch.setenv(
+        faults.FAULT_INJECT_ENV_VAR, "fetch:raise:m-1@attempts:1"
+    )
+    with pytest.raises(InjectedFault):
+        faults.inject("fetch", "m-1")
+    faults.inject("fetch", "m-1")  # exhausted
+
+    faults.reset()
+    with pytest.raises(InjectedFault):
+        faults.inject("fetch", "m-1")  # the rerun fires again
+
+
+def test_fault_firing_bumps_site_counter(monkeypatch):
+    """Every firing bumps gordo_fault_fired_total{site} — the metric
+    twin of the fault_injected event (scenario reports read the
+    delta)."""
+    from gordo_tpu.observability import get_registry
+
+    before = _series_value(
+        get_registry().snapshot(), "gordo_fault_fired_total", site="fetch"
+    )
+    monkeypatch.setenv(faults.FAULT_INJECT_ENV_VAR, "fetch:raise:m-1")
+    for _ in range(3):
+        with pytest.raises(InjectedFault):
+            faults.inject("fetch", "m-1")
+    after = _series_value(
+        get_registry().snapshot(), "gordo_fault_fired_total", site="fetch"
+    )
+    assert after == before + 3
+
+
+def test_every_known_site_exercised_by_suite():
+    """Inventory gate: every site parse_spec accepts must be FIRED by at
+    least one spec string somewhere in the test suite — a chaos seam no
+    test arms is a seam whose failure mode nobody has ever watched."""
+    import pathlib
+    import re
+
+    corpus = "".join(
+        p.read_text()
+        for p in pathlib.Path(__file__).parent.glob("*.py")
+    )
+    unexercised = sorted(
+        site
+        for site in faults._KNOWN_SITES
+        if not re.search(rf"{site}:[a-z]", corpus)
+    )
+    assert not unexercised, (
+        f"fault sites never armed by any test: {unexercised}"
+    )

@@ -1,0 +1,354 @@
+"""
+Game-day scenario engine suite (docs/robustness.md "Game days"):
+parse-time strictness of the timeline grammar, the synthetic-client
+event loop (virtual clock — including the ≥100k-concurrent-stream
+harness pin), the shipped-catalogue/YAML-mirror equivalence, the CLI
+surface, and one end-to-end scenario run against a real in-process
+plane.
+"""
+
+import os
+import threading
+
+import pytest
+
+from gordo_tpu.robustness import faults
+from gordo_tpu.scenario import (
+    EventLoop,
+    ScenarioError,
+    StubPlane,
+    SyntheticStream,
+    builtin_scenarios,
+    load_scenario,
+    parse_duration,
+    parse_scenario,
+    run_scenario,
+)
+
+EXAMPLES = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "examples",
+    "scenarios",
+)
+
+
+def minimal_doc(**overrides):
+    doc = {
+        "name": "mini",
+        "duration_s": 5,
+        "slo": {
+            "objectives": [
+                {
+                    "signal": "unstructured_error_rate",
+                    "threshold": 0.0,
+                    "budget": 0.001,
+                }
+            ]
+        },
+    }
+    doc.update(overrides)
+    return doc
+
+
+# -- the grammar ---------------------------------------------------------
+
+
+def test_parse_duration_units():
+    assert parse_duration(30) == 30.0
+    assert parse_duration("30s") == 30.0
+    assert parse_duration("450ms") == pytest.approx(0.45)
+    assert parse_duration("1.5m") == 90.0
+    assert parse_duration("2h") == 7200.0
+    assert parse_duration("7") == 7.0
+    for bad in ("", "abc", "3 weeks", "-4s", -1, True):
+        with pytest.raises(ScenarioError):
+            parse_duration(bad)
+
+
+def test_parse_scenario_minimal_defaults():
+    scenario = parse_scenario(minimal_doc())
+    assert scenario.name == "mini"
+    assert scenario.plane.replicas == 2
+    assert scenario.workload.streams == 4
+    assert scenario.duration_s == 5.0
+    assert scenario.timeline == ()
+    assert scenario.expect.min_stream_resumes == 0
+    assert scenario.to_dict()["name"] == "mini"
+
+
+def test_parse_scenario_rejects_unknown_keys():
+    with pytest.raises(ScenarioError, match="Unknown scenario key"):
+        parse_scenario(minimal_doc(surprise=1))
+    with pytest.raises(ScenarioError, match="Unknown plane key"):
+        parse_scenario(minimal_doc(plane={"replica": 3}))
+    with pytest.raises(ScenarioError, match="Unknown workload key"):
+        parse_scenario(minimal_doc(workload={"stream": 4}))
+    with pytest.raises(ScenarioError, match="Unknown expect key"):
+        parse_scenario(minimal_doc(expect={"resumes": 1}))
+
+
+def test_parse_scenario_rejects_bad_timeline():
+    with pytest.raises(ScenarioError, match="Unknown timeline action"):
+        parse_scenario(
+            minimal_doc(timeline=[{"at": "1s", "action": "explode"}])
+        )
+    with pytest.raises(ScenarioError, match="missing \\['replica'\\]"):
+        parse_scenario(
+            minimal_doc(timeline=[{"at": "1s", "action": "kill_replica"}])
+        )
+    with pytest.raises(ScenarioError, match="parameter key"):
+        parse_scenario(
+            minimal_doc(
+                timeline=[
+                    {
+                        "at": "1s",
+                        "action": "kill_replica",
+                        "replica": "r0",
+                        "blast_radius": "all",
+                    }
+                ]
+            )
+        )
+    with pytest.raises(ScenarioError, match="needs an 'at'"):
+        parse_scenario(minimal_doc(timeline=[{"action": "disarm_faults"}]))
+    with pytest.raises(ScenarioError, match="past the scenario duration"):
+        parse_scenario(
+            minimal_doc(
+                timeline=[
+                    {"at": "9s", "action": "disarm_faults"},
+                ]
+            )
+        )
+
+
+def test_parse_scenario_validates_embedded_grammars():
+    # a typo'd fault site fails at PARSE time, not mid-run
+    with pytest.raises(ScenarioError, match="unknown site"):
+        parse_scenario(
+            minimal_doc(
+                timeline=[
+                    {"at": "1s", "action": "arm_faults", "spec": "strem:drop"}
+                ]
+            )
+        )
+    with pytest.raises(ScenarioError, match="Bad slo block"):
+        parse_scenario(
+            minimal_doc(
+                slo={"objectives": [{"signal": "made_up_signal"}]}
+            )
+        )
+    with pytest.raises(ScenarioError, match="needs an 'slo' block"):
+        parse_scenario({"name": "x", "duration_s": 5})
+    with pytest.raises(ScenarioError, match="unknown site"):
+        parse_scenario(minimal_doc(expect={"fault_sites": ["strem"]}))
+
+
+def test_timeline_sorted_by_time():
+    scenario = parse_scenario(
+        minimal_doc(
+            timeline=[
+                {"at": "4s", "action": "disarm_faults"},
+                {"at": "1500ms", "action": "lifecycle_tick"},
+            ]
+        )
+    )
+    assert [e.at_s for e in scenario.timeline] == [1.5, 4.0]
+
+
+# -- the shipped catalogue ------------------------------------------------
+
+
+def test_builtin_scenarios_parse_and_cover_fault_sites():
+    scenarios = builtin_scenarios()
+    assert len(scenarios) >= 6
+    armed = " ".join(
+        str(event.params.get("spec", ""))
+        for s in scenarios.values()
+        for event in s.timeline
+        if event.action == "arm_faults"
+    )
+    for site in ("stream", "drift", "replica", "promote"):
+        assert f"{site}:" in armed, f"no shipped scenario arms {site}"
+
+
+def test_example_scenarios_match_library():
+    """examples/scenarios/*.yaml are the shipped built-ins, verbatim —
+    what users copy from is exactly what `gameday run` runs."""
+    scenarios = builtin_scenarios()
+    files = sorted(
+        f for f in os.listdir(EXAMPLES) if f.endswith((".yaml", ".yml"))
+    )
+    assert sorted(scenarios) == [os.path.splitext(f)[0] for f in files]
+    for filename in files:
+        loaded = load_scenario(os.path.join(EXAMPLES, filename))
+        assert loaded == scenarios[loaded.name], (
+            f"{filename} drifted from the built-in of the same name — "
+            "regenerate it from scenario/library.py"
+        )
+
+
+# -- the synthetic-client harness ----------------------------------------
+
+
+def test_event_loop_virtual_time_orders_and_counts():
+    loop = EventLoop()
+    fired = []
+    loop.call_at(2.0, fired.append, "b")
+    loop.call_at(1.0, fired.append, "a")
+    loop.call_later(3.0, fired.append, "c")
+    assert loop.run_until(2.5) == 2
+    assert fired == ["a", "b"]
+    assert loop.now == 2.5
+    assert loop.run_until(10.0) == 1
+    assert fired == ["a", "b", "c"]
+
+
+def test_event_loop_stop_halts_mid_run():
+    loop = EventLoop()
+    fired = []
+    loop.call_at(1.0, lambda: (fired.append("a"), loop.stop()))
+    loop.call_at(2.0, fired.append, "b")
+    assert loop.run_until(5.0) == 1
+    assert fired == ["a"]
+    assert loop.run_until(5.0) == 1  # resumable: the pending event fires
+    assert fired == ["a", "b"]
+
+
+def test_synthetic_streams_against_stub_plane():
+    loop = EventLoop()
+    plane = StubPlane()
+    streams = [
+        SyntheticStream(f"s{i}", f"m-{i % 3}", 0.5, 4, plane)
+        for i in range(10)
+    ]
+    for stream in streams:
+        stream.start(loop, at=0.0)
+    loop.run_until(2.0)
+    assert plane.peak_live == 10
+    # each stream: opened at 0, then updates at 0.5s intervals -> 4 by 2s
+    assert all(s.updates == 4 for s in streams)
+    assert plane.rows == 10 * 4 * 4
+    for stream in streams:
+        stream.close()
+    assert plane.live == 0
+
+
+@pytest.mark.slow
+def test_hundred_thousand_concurrent_streams_no_threads():
+    """The paper's fleet shape: ≥100k concurrent monitoring streams in
+    ONE process with ZERO client threads — the heap-scheduled harness
+    holds a __slots__ object per stream and nothing else."""
+    n = 100_000
+    threads_before = threading.active_count()
+    loop = EventLoop()
+    plane = StubPlane()
+    streams = [
+        SyntheticStream(f"s{i}", f"m-{i % 97}", 60.0, 4, plane)
+        for i in range(n)
+    ]
+    for i, stream in enumerate(streams):
+        stream.start(loop, at=(i % 1000) / 1000.0)
+    # one simulated minute: every stream opens AND pushes its first update
+    fired = loop.run_until(61.0)
+    assert plane.peak_live >= n
+    assert plane.updates >= n
+    assert fired >= 2 * n
+    assert threading.active_count() == threads_before
+
+
+# -- the runner, end to end ----------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def gameday_collection(tmp_path_factory):
+    from gordo_tpu.scenario import build_gameday_collection
+
+    root = tmp_path_factory.mktemp("gameday-collection")
+    return build_gameday_collection(root)
+
+
+def test_run_scenario_region_loss_mini(gameday_collection, tmp_path):
+    """A compressed region-loss game day against the REAL in-process
+    plane: kill the ring owner of a streamed machine mid-run, restart
+    it, and the composed verdict (SLO budget + zero unstructured +
+    resume + bit-identity) must hold."""
+    from gordo_tpu.router.ring import HashRing
+    from gordo_tpu.scenario.plane import GAMEDAY_MACHINES
+
+    victim = HashRing(["r0", "r1"]).owner(GAMEDAY_MACHINES[0])
+    scenario = parse_scenario(
+        {
+            "name": "mini-region-loss",
+            "plane": {"replicas": 2},
+            "workload": {
+                "streams": 2,
+                "stream_interval_s": "300ms",
+                "rows_per_update": 4,
+                "requests_per_s": 2,
+            },
+            "duration_s": "4s",
+            "timeline": [
+                {"at": "1s", "action": "kill_replica", "replica": victim},
+                {"at": "2s", "action": "restart_replica", "replica": victim},
+            ],
+            "slo": {
+                "objectives": [
+                    {
+                        "signal": "unstructured_error_rate",
+                        "threshold": 0.0,
+                        "budget": 0.001,
+                        "window_s": 300,
+                    },
+                    {
+                        "signal": "shed_rate",
+                        "threshold": 0.9,
+                        "budget": 0.5,
+                        "window_s": 300,
+                    },
+                ]
+            },
+            "expect": {"min_stream_resumes": 1, "bit_identity": True},
+        }
+    )
+    report = run_scenario(
+        scenario, gameday_collection, str(tmp_path), poll_interval_s=0.5
+    )
+    assert report["ok"], (
+        report["unstructured_errors"],
+        report["expect_failures"],
+        report["slo"],
+    )
+    assert report["streams"]["reconnects"] >= 1
+    assert report["streams"]["broken"] == 0
+    assert report["bit_identity"]["ok"], report["bit_identity"]
+    assert report["slo"]["ok"]
+    assert report["n_snapshots"] >= 2
+    # the runner leaves no armed faults and no env leakage behind
+    assert faults.active_registry() is None
+    assert os.environ.get(faults.FAULT_INJECT_FILE_ENV_VAR) is None
+
+
+# -- the CLI surface ------------------------------------------------------
+
+
+def test_gameday_list_cli():
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.gameday import gameday_cli
+
+    result = CliRunner().invoke(gameday_cli, ["list"])
+    assert result.exit_code == 0, result.output
+    for name in builtin_scenarios():
+        assert name in result.output
+    assert "timeline:" in result.output
+    assert "slo:" in result.output
+
+
+def test_gameday_run_rejects_unknown_scenario():
+    from click.testing import CliRunner
+
+    from gordo_tpu.cli.gameday import gameday_cli
+
+    result = CliRunner().invoke(gameday_cli, ["run", "not-a-scenario"])
+    assert result.exit_code != 0
+    assert "Unknown scenario" in result.output

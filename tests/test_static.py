@@ -841,3 +841,40 @@ def test_jax_discipline_package_wide(check_name):
         f"[{check_name}] lint regressions (fix them, suppress with a "
         f"justifying comment, or baseline with a justification):\n{rendered}"
     )
+
+
+def test_fault_sites_documented():
+    """Every chaos site ``parse_spec`` accepts (the ``_KNOWN_SITES``
+    vocabulary in robustness/faults.py) must appear in
+    docs/robustness.md — a seam the chaos catalogue doesn't list is a
+    seam no game day will ever arm."""
+    from static_analysis import collect_fault_sites
+
+    sites: set = set()
+    for name, module in _importable_modules():
+        sites |= collect_fault_sites(parse(module.__file__))
+    assert sites, "no _KNOWN_SITES literal found — collector broken?"
+    from gordo_tpu.robustness import faults
+
+    assert sites == set(faults._KNOWN_SITES)
+    docs = (
+        Path(gordo_tpu.__file__).parent.parent / "docs" / "robustness.md"
+    ).read_text()
+    undocumented = sorted(s for s in sites if f"`{s}" not in docs)
+    assert not undocumented, (
+        f"fault sites accepted by parse_spec but missing from "
+        f"docs/robustness.md: {undocumented}"
+    )
+
+
+def test_fault_site_collector_reads_literal_frozenset():
+    import ast as _ast
+
+    from static_analysis import collect_fault_sites
+
+    source = (
+        "_KNOWN_SITES = frozenset({'fetch', 'train'})\n"
+        "OTHER = frozenset({'not-a-site'})\n"
+    )
+    assert collect_fault_sites(_ast.parse(source)) == {"fetch", "train"}
+    assert collect_fault_sites(_ast.parse("x = 1\n")) == set()
