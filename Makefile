@@ -13,7 +13,7 @@ DOCKERFILE_deploy  = Dockerfile-Deploy
 
 # NB: image-%/push-% pattern targets must NOT be .PHONY — GNU make skips
 # implicit-rule search for .PHONY targets
-.PHONY: all test test-sanitize lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming bench-precision bench-slo bench-gameday build-multiworker images push
+.PHONY: all test test-sanitize lint bench bench-summary bench-cold-start bench-hetero bench-sharded bench-streaming bench-precision bench-slo bench-gameday bench-attribution build-multiworker images push
 
 all: lint test
 
@@ -103,6 +103,16 @@ bench-slo:
 bench-gameday:
 	python benchmarks/gameday.py \
 		--output benchmarks/results_gameday_cpu_r19.json
+	python benchmarks/consolidate.py
+
+# phase-ledger time attribution (docs/observability.md "Time
+# attribution"): drives a real server with the wall profiler sampling
+# in-process and reports per-request ledger coverage, the host/device
+# split, per-bracket overhead, and the sampled cost-seam ranking;
+# bench-summary folds host_fraction into trajectory.json
+bench-attribution:
+	python benchmarks/attribution.py --duration 8 \
+		--output benchmarks/results_attribution_cpu_r20.json
 	python benchmarks/consolidate.py
 
 # 2-worker crash-tolerant ledger build of the example fleet config

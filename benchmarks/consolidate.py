@@ -152,6 +152,19 @@ def consolidate(directory: Path) -> dict:
                     "ok": slo.get("ok"),
                     "max_burn_rate": slo.get("max_burn_rate"),
                 }
+            # phase-ledger benches stamp where the wall time went; the
+            # trajectory keeps the host/device split so a creeping host
+            # seam (e.g. transform/serialize growth) trends in the same
+            # file as the latencies (docs/observability.md "Time
+            # attribution")
+            attribution = document.get("phase_attribution")
+            if isinstance(attribution, dict) and attribution.get(
+                "host_fraction"
+            ) is not None:
+                entry["host_fraction"] = attribution["host_fraction"]
+                entry["device_fraction"] = attribution.get(
+                    "device_fraction"
+                )
             # game-day runs stamp the composed per-scenario verdict so
             # a robustness regression (budget newly exhausted, a
             # post-condition newly failed) shows up in the SAME file

@@ -605,6 +605,7 @@ def main():
     # (registry + telemetry report) throughput can be cross-checked in
     # the results JSON — a drift between them is itself a finding
     from gordo_tpu.observability import get_registry
+    from gordo_tpu.observability.attribution import phase_attribution_block
     from gordo_tpu.observability.tracing import measure_overhead
 
     snapshot = get_registry().snapshot()
@@ -686,6 +687,12 @@ def main():
                 # per-epoch tracing tax is one of these numbers — the
                 # justification for the sampling default
                 "tracing_overhead": measure_overhead(samples=1000),
+                # train-plane phase ledger: device dispatch vs transfer
+                # seconds for the whole build, host/device split
+                # included — the cost-seam view of the same run
+                "phase_attribution": phase_attribution_block(
+                    snapshot=snapshot
+                ),
             }
         )
     )

@@ -613,6 +613,7 @@ def main():
         elapsed = time.perf_counter() - start
 
     from benchmarks.server_latency import summarize_ms
+    from gordo_tpu.observability import attribution
     from gordo_tpu.observability.tracing import measure_overhead
 
     summary = summarize_ms(latencies) if latencies else {}
@@ -636,6 +637,9 @@ def main():
         # sampled-out / recording), so the tracing-sampling default is
         # justified against the request latencies above by a number
         "tracing_overhead": measure_overhead(samples=1000),
+        # the phase ledger's per-bracket cost in each regime (disabled /
+        # enabled), justified the same way
+        "ledger_overhead": attribution.measure_overhead(samples=1000),
     }
     if args.open_loop:
         attempts = len(latencies) + len(errors) + len(sheds) + len(partials)
@@ -666,6 +670,9 @@ def main():
         # the server runs in-process: its dispatch batch sizes and queue
         # waits are readable straight off the shared registry
         out.update(batching_registry_stats())
+        # ...and so is the phase ledger: where this run's request wall
+        # time went, by plane/phase, with the host/device split
+        out["phase_attribution"] = attribution.phase_attribution_block()
         out["precision"] = args.precision
         if args.precision != "float32":
             # the fleet builder persisted its calibration decisions next

@@ -246,6 +246,12 @@ def main():
             results["arms"].append(arm)
             print(json.dumps(arm))
 
+    # the server ran in-process: the phase ledger's stream/server-plane
+    # accounting for the whole sweep reads off the shared registry
+    from gordo_tpu.observability.attribution import phase_attribution_block
+
+    results["phase_attribution"] = phase_attribution_block()
+
     # the headline: per-update latency vs re-shipping the whole window
     per_update = [
         arm["update_latency"]["p99_ms"]

@@ -37,6 +37,7 @@ from gordo_tpu.cli.gameday import gameday_cli
 from gordo_tpu.cli.lifecycle import lifecycle_cli
 from gordo_tpu.cli.lint import lint_cli, lockgraph_cli
 from gordo_tpu.cli.plane import rollup_cli, slo_cli, top_cli
+from gordo_tpu.cli.profile import profile_cli
 from gordo_tpu.cli.trace import trace_cli
 from gordo_tpu.cli.tune import tune_cli
 from gordo_tpu.cli.workflow_generator import workflow_cli
@@ -1340,6 +1341,7 @@ gordo.add_command(buckets_cli)
 gordo.add_command(programs_cli)
 gordo.add_command(telemetry_cli)
 gordo.add_command(trace_cli)
+gordo.add_command(profile_cli)
 gordo.add_command(tune_cli)
 gordo.add_command(lint_cli)
 gordo.add_command(lockgraph_cli)

@@ -1,0 +1,149 @@
+"""
+``gordo-tpu profile`` — the cost-seam report (docs/observability.md
+"Time attribution").
+
+Input is the JSON the wall sampler flushes (``GORDO_PROFILE_OUT``,
+default ``gordo_profile.json``): folded stacks + per-phase/per-module
+sample counts, with the phase-ledger histograms
+(``gordo_phase_seconds``) embedded at flush time. Two views:
+
+- ``report``: the merged ledger + sampler picture — where each plane's
+  wall time went by phase (host vs device), and inside the host
+  phases, which Python modules the samples landed in. This is the
+  report that NAMES the seam (e.g. the pandas/sklearn transform stage)
+  instead of just pricing it.
+- ``flame``: the folded stacks in flamegraph.pl input format
+  (``stack count`` per line) — render with any flamegraph tool.
+"""
+
+import json
+import typing
+
+import click
+
+
+def _load_profile(path: str) -> dict:
+    with open(path) as fh:
+        payload = json.load(fh)
+    if not isinstance(payload, dict) or "profile_version" not in payload:
+        raise click.ClickException(
+            f"{path} is not a gordo profile dump (missing profile_version)"
+        )
+    return payload
+
+
+def _phase_rows(
+    payload: dict,
+) -> typing.List[typing.Tuple[str, str, int, float]]:
+    """(plane, phase, count, sum_s) rows from the embedded ledger
+    histograms, largest total first."""
+    rows = []
+    for key, state in (payload.get("phase_seconds") or {}).items():
+        plane, _, phase = key.partition("/")
+        rows.append(
+            (
+                plane,
+                phase,
+                int(state.get("count") or 0),
+                float(state.get("sum") or 0.0),
+            )
+        )
+    rows.sort(key=lambda r: -r[3])
+    return rows
+
+
+def render_report(payload: dict, top: int = 5) -> str:
+    """The cost-seam report text: ledger phase table with the
+    host/device split, then per-host-phase module rankings from the
+    sampler."""
+    from gordo_tpu.observability.attribution import DEVICE_PHASES
+
+    lines: typing.List[str] = []
+    n = payload.get("n_samples") or 0
+    dur = payload.get("duration_s")
+    lines.append(
+        f"profile: {n} samples @ {payload.get('hz')} Hz"
+        + (f" over {dur:.1f}s" if dur else "")
+    )
+    rows = _phase_rows(payload)
+    total_s = sum(r[3] for r in rows)
+    host_s = sum(r[3] for r in rows if r[1] not in DEVICE_PHASES)
+    device_s = total_s - host_s
+    lines.append("")
+    lines.append("phase ledger (gordo_phase_seconds):")
+    lines.append(
+        f"  {'plane/phase':<24} {'side':<7} {'count':>8} "
+        f"{'total_s':>10} {'share':>7}"
+    )
+    for plane, phase, count, sum_s in rows:
+        side = "device" if phase in DEVICE_PHASES else "host"
+        share = sum_s / total_s if total_s else 0.0
+        lines.append(
+            f"  {plane + '/' + phase:<24} {side:<7} {count:>8} "
+            f"{sum_s:>10.3f} {share:>6.1%}"
+        )
+    if total_s:
+        lines.append(
+            f"  host {host_s:.3f}s ({host_s / total_s:.1%})  "
+            f"device {device_s:.3f}s ({device_s / total_s:.1%})"
+        )
+    lines.append("")
+    lines.append("sampled host cost by phase (top modules):")
+    per_phase = payload.get("per_phase") or {}
+    modules_by_phase = payload.get("modules_by_phase") or {}
+    for key, count in sorted(per_phase.items(), key=lambda kv: -kv[1]):
+        phase = key.rpartition("/")[2]
+        if phase in DEVICE_PHASES:
+            continue
+        lines.append(f"  {key}: {count} samples")
+        modules = modules_by_phase.get(key) or {}
+        for mod, mod_count in sorted(
+            modules.items(), key=lambda kv: -kv[1]
+        )[:top]:
+            lines.append(f"    {mod}: {mod_count}")
+    return "\n".join(lines)
+
+
+@click.group("profile")
+def profile_cli():
+    """The cost-seam report: phase ledger + wall-profiler samples."""
+
+
+@profile_cli.command("report")
+@click.argument("path", type=click.Path(exists=True, dir_okay=False))
+@click.option(
+    "--top",
+    type=click.IntRange(min=1),
+    default=5,
+    show_default=True,
+    help="Modules to list per sampled phase.",
+)
+def profile_report(path: str, top: int):
+    """Render the cost-seam report from the profile dump at PATH
+    (``GORDO_PROFILE_OUT``): the ledger's host/device phase accounting
+    merged with the sampler's per-module attribution."""
+    click.echo(render_report(_load_profile(path), top=top))
+
+
+@profile_cli.command("flame")
+@click.argument("path", type=click.Path(exists=True, dir_okay=False))
+@click.option(
+    "--output",
+    "-o",
+    type=click.Path(dir_okay=False, writable=True),
+    default=None,
+    help="Write folded stacks here (default: stdout).",
+)
+def profile_flame(path: str, output: typing.Optional[str]):
+    """Emit the profile's folded stacks (flamegraph.pl input format:
+    one ``stack count`` line per unique stack, hottest first)."""
+    from gordo_tpu.observability.sampling import folded_lines
+
+    lines = folded_lines(_load_profile(path))
+    text = "\n".join(lines)
+    if output:
+        with open(output, "w") as fh:
+            fh.write(text + ("\n" if text else ""))
+        click.echo(f"wrote {len(lines)} folded stacks to {output}")
+    else:
+        click.echo(text)

@@ -20,6 +20,7 @@ metadata) with the engine swapped for Flax + optax under ``jax.jit``:
 import copy
 import logging
 import math
+import time
 from typing import Callable, Optional, Union
 
 import jax
@@ -34,6 +35,7 @@ from sklearn.metrics import explained_variance_score
 from gordo_tpu.models.base import GordoBase
 from gordo_tpu.models.register import register_model_builder
 from gordo_tpu.models.specs import ModelSpec, per_sample_loss
+from gordo_tpu.observability import attribution
 
 logger = logging.getLogger(__name__)
 
@@ -526,8 +528,16 @@ class BaseJaxEstimator(GordoBase, BaseEstimator):
             if bucket > n:
                 pad_width = ((0, bucket - n),) + ((0, 0),) * (xb_host.ndim - 1)
                 xb_host = np.pad(xb_host, pad_width)
-            out = apply_fn(params, jnp.asarray(xb_host))
+            # phase ledger: host->device staging is "transfer"; the
+            # apply + device->host output sync is "device" (np.asarray
+            # blocks until the computation delivers)
+            t0 = time.perf_counter()
+            xb_dev = jnp.asarray(xb_host)
+            t1 = time.perf_counter()
+            attribution.record_current("transfer", t1 - t0)
+            out = apply_fn(params, xb_dev)
             outs.append(self._strip_pad_output(np.asarray(out[:n])))
+            attribution.record_current("device", time.perf_counter() - t1)
         return np.concatenate(outs, axis=0) if len(outs) > 1 else outs[0]
 
     def predict(self, X: np.ndarray, **kwargs) -> np.ndarray:

@@ -28,7 +28,29 @@ modeling PR stands on.
   ``replica`` label, histograms bucket-wise), poller, control signals.
 - :mod:`slo` — declarative SLO specs evaluated against merged
   snapshots into error-budget + burn-rate objects.
+- :mod:`attribution` — the phase ledger: per-request host/device time
+  attribution into a closed phase vocabulary
+  (``gordo_phase_seconds{plane,phase}``), span attribute stamping, and
+  the ``host_fraction``/``device_fraction`` control-signal inputs.
+- :mod:`sampling` — the opt-in wall profiler (``GORDO_PROFILE_HZ``):
+  background stack sampling folded per-phase/per-module, flamegraph
+  output, merged with the ledger by ``gordo-tpu profile report``.
 """
+
+from .attribution import (
+    DEVICE_PHASES,
+    HOST_PHASES,
+    LEDGER_ENV_VAR,
+    PHASES,
+    PLANES,
+    PhaseLedger,
+    ledger_enabled,
+    ledger_for,
+    phase_attribution_block,
+    phase_totals,
+    record_current,
+    split_host_device,
+)
 
 from .device_memory import (
     device_memory_stats,
@@ -43,6 +65,15 @@ from .events import (
     read_events,
 )
 from .profiler import PROFILE_DIR_ENV_VAR, annotate, maybe_trace, profile_dir
+from .sampling import (
+    PROFILE_HZ_ENV_VAR,
+    PROFILE_OUT_ENV_VAR,
+    WallSampler,
+    active_sampler,
+    folded_lines,
+    maybe_start_from_env,
+    profiler_active,
+)
 from .registry import (
     Counter,
     Gauge,
@@ -159,4 +190,23 @@ __all__ = [
     "evaluate_values",
     "load_slo_spec",
     "parse_slo_spec",
+    "DEVICE_PHASES",
+    "HOST_PHASES",
+    "LEDGER_ENV_VAR",
+    "PHASES",
+    "PLANES",
+    "PhaseLedger",
+    "ledger_enabled",
+    "ledger_for",
+    "phase_attribution_block",
+    "phase_totals",
+    "record_current",
+    "split_host_device",
+    "PROFILE_HZ_ENV_VAR",
+    "PROFILE_OUT_ENV_VAR",
+    "WallSampler",
+    "active_sampler",
+    "folded_lines",
+    "maybe_start_from_env",
+    "profiler_active",
 ]

@@ -15,11 +15,13 @@ logger = logging.getLogger(__name__)
 
 TELEMETRY_REPORT_FILENAME = "telemetry_report.json"
 TELEMETRY_REPORT_VERSION = 1
-#: schema of the ``telemetry summarize --as-json`` payload (v3: adds
+#: schema of the ``telemetry summarize --as-json`` payload (v4: adds
+#: the ``phases`` section — the phase ledger's host/device time
+#: attribution aggregated from persisted rollup snapshots; v3: adds
 #: the ``rollup`` section — merged plane-snapshot JSONL files with
 #: per-replica breakdown and last control signals; v2: object with
 #: per-subsystem event sections; v1 was a bare report list)
-SUMMARY_SCHEMA_VERSION = 3
+SUMMARY_SCHEMA_VERSION = 4
 
 #: event-type -> subsystem classification for the per-subsystem summary
 #: sections: ordered (prefix | exact-name set) rules, first match wins.
@@ -167,6 +169,46 @@ def summarize_rollups(
     return out
 
 
+def summarize_phases(
+    rollup_files: typing.Sequence[typing.Tuple[Path, typing.List[dict]]]
+) -> dict:
+    """The ``phases`` section of the summary payload: the phase
+    ledger's ``gordo_phase_seconds`` accounting aggregated across the
+    LAST snapshot of every persisted rollup file (counters in a
+    snapshot are lifetime totals, so the last line is the file's
+    complete view). ``{}`` when no rollup carried ledger data."""
+    from gordo_tpu.observability.attribution import (
+        DEVICE_PHASES,
+        phase_totals,
+    )
+
+    merged: typing.Dict[str, typing.Dict[str, float]] = {}
+    for _, records in rollup_files:
+        metrics = records[-1].get("metrics") or {}
+        for (plane, phase), state in phase_totals(snapshot=metrics).items():
+            entry = merged.setdefault(
+                f"{plane}/{phase}", {"count": 0, "sum_s": 0.0}
+            )
+            entry["count"] += int(state["count"])
+            entry["sum_s"] += float(state["sum"])
+    if not merged:
+        return {}
+    host_s = sum(
+        e["sum_s"]
+        for key, e in merged.items()
+        if key.rpartition("/")[2] not in DEVICE_PHASES
+    )
+    total_s = sum(e["sum_s"] for e in merged.values())
+    device_s = total_s - host_s
+    return {
+        "phases": merged,
+        "host_s": host_s,
+        "device_s": device_s,
+        "host_fraction": host_s / total_s if total_s else None,
+        "device_fraction": device_s / total_s if total_s else None,
+    }
+
+
 def _fmt_rate(value: typing.Optional[float]) -> str:
     if value is None:
         return "n/a"
@@ -312,6 +354,7 @@ def summary_payload(directory: typing.Union[str, Path]) -> dict:
         "n_events": sum(len(records) for _, records in event_files),
         "events": group_events_by_subsystem(event_files),
         "rollup": summarize_rollups(rollup_files),
+        "phases": summarize_phases(rollup_files),
     }
 
 
@@ -362,7 +405,30 @@ def summarize_directory(directory: typing.Union[str, Path]) -> str:
             )
         )
 
-    rollups = summarize_rollups(load_rollup_files(directory))
+    rollup_files = load_rollup_files(directory)
+    phases = summarize_phases(rollup_files)
+    if phases:
+        lines.append("Time attribution (phase ledger):")
+        for key, entry in sorted(
+            phases["phases"].items(), key=lambda kv: -kv[1]["sum_s"]
+        ):
+            lines.append(
+                "  {k}: {s} over {c} bracket(s)".format(
+                    k=key,
+                    s=_fmt_seconds(entry["sum_s"]),
+                    c=entry["count"],
+                )
+            )
+        lines.append(
+            "  host {h} ({hf:.1%}) / device {d} ({df:.1%})".format(
+                h=_fmt_seconds(phases["host_s"]),
+                hf=phases["host_fraction"] or 0.0,
+                d=_fmt_seconds(phases["device_s"]),
+                df=phases["device_fraction"] or 0.0,
+            )
+        )
+
+    rollups = summarize_rollups(rollup_files)
     if rollups:
         lines.append(f"Plane rollups: {len(rollups)} file(s)")
         for entry in rollups:

@@ -38,6 +38,7 @@ import threading
 import time
 import typing
 
+from gordo_tpu.observability.attribution import DEVICE_PHASES
 from gordo_tpu.observability.events import emit_event
 from gordo_tpu.observability.registry import (
     HistogramMergeError,
@@ -517,6 +518,29 @@ def compute_signals(
         ).values()
     )
     signals["program_cache_hit_rate"] = _rate(hits, hits + misses)
+
+    # -- host/device attribution (the phase ledger) ------------------------
+    # windowed split of gordo_phase_seconds into host vs device time:
+    # the cost-seam control signals (docs/observability.md "Time
+    # attribution"). None until ledger data lands, like every rate here.
+    phase_series = (metrics.get("gordo_phase_seconds") or {}).get(
+        "series"
+    ) or []
+    host_s = device_s = 0.0
+    for series in phase_series:
+        labels = dict(series.get("labels") or {})
+        window = _histogram_window(
+            metrics, prev_metrics, "gordo_phase_seconds", labels=labels
+        )
+        if not window:
+            continue
+        if labels.get("phase") in DEVICE_PHASES:
+            device_s += float(window["sum"])
+        else:
+            host_s += float(window["sum"])
+    total_s = host_s + device_s
+    signals["host_fraction"] = _rate(host_s, total_s)
+    signals["device_fraction"] = _rate(device_s, total_s)
 
     return signals
 

@@ -47,6 +47,8 @@ KNOWN_SIGNALS = (
     "queue_depth",
     "stream_backlog",
     "program_cache_hit_rate",
+    "host_fraction",
+    "device_fraction",
 )
 
 DEFAULT_WINDOW_S = 3600.0

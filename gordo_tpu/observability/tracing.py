@@ -453,7 +453,17 @@ def spans_to_chrome_trace(records: typing.Sequence[dict]) -> dict:
     chrome://tracing: one complete ("X") event per span, microsecond
     timestamps, one synthetic tid per trace so each trace renders as its
     own row, with the gordo ids preserved under ``args``.
+
+    Phase-ledger spans (names from the closed phase vocabulary, emitted
+    by ``PhaseLedger.finish(record_spans=True)``) additionally land on
+    two dedicated per-process tracks — "host phases" and "device
+    phases" — so the host/device cost seam reads as two rows in
+    Perfetto instead of being buried inside each trace's row.
     """
+    from gordo_tpu.observability.attribution import DEVICE_PHASES, PHASES
+
+    # synthetic tids far above the per-trace counter: the phase tracks
+    host_tid, device_tid = 1_000_000, 1_000_001
     events: typing.List[dict] = []
     tids: typing.Dict[str, int] = {}
     # Chrome-trace tracks are keyed (pid, tid): a trace that crossed
@@ -461,13 +471,19 @@ def spans_to_chrome_trace(records: typing.Sequence[dict]) -> dict:
     # process, and each such row needs its own thread_name metadata or
     # the label attaches to nothing
     rows: typing.Set[typing.Tuple[int, int, str]] = set()
+    phase_rows: typing.Set[typing.Tuple[int, int]] = set()
     for record in records:
         if "duration_ms" not in record or "start_unix_ms" not in record:
             continue
         trace_id = record["trace_id"]
-        tid = tids.setdefault(trace_id, len(tids) + 1)
+        name = record.get("name", "span")
         pid = int(record.get("pid") or 0)
-        rows.add((pid, tid, trace_id))
+        if name in PHASES:
+            tid = device_tid if name in DEVICE_PHASES else host_tid
+            phase_rows.add((pid, tid))
+        else:
+            tid = tids.setdefault(trace_id, len(tids) + 1)
+            rows.add((pid, tid, trace_id))
         args = dict(record.get("attributes") or {})
         args.update(
             trace_id=trace_id,
@@ -477,14 +493,30 @@ def spans_to_chrome_trace(records: typing.Sequence[dict]) -> dict:
         )
         events.append(
             {
-                "name": record.get("name", "span"),
-                "cat": "gordo-tpu",
+                "name": name,
+                "cat": "gordo-phase" if name in PHASES else "gordo-tpu",
                 "ph": "X",
                 "ts": float(record["start_unix_ms"]) * 1000.0,
                 "dur": float(record["duration_ms"]) * 1000.0,
                 "pid": pid,
                 "tid": tid,
                 "args": args,
+            }
+        )
+    for pid, tid in sorted(phase_rows):
+        events.append(
+            {
+                "name": "thread_name",
+                "ph": "M",
+                "pid": pid,
+                "tid": tid,
+                "args": {
+                    "name": (
+                        "device phases"
+                        if tid == device_tid
+                        else "host phases"
+                    )
+                },
             }
         )
     for pid, tid, trace_id in sorted(rows):

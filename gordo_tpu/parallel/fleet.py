@@ -39,7 +39,13 @@ from gordo_tpu.models.specs import (
     masked_per_sample_loss,
     per_sample_loss,
 )
-from gordo_tpu.observability import annotate, emit_event, get_registry, tracing
+from gordo_tpu.observability import (
+    annotate,
+    attribution,
+    emit_event,
+    get_registry,
+    tracing,
+)
 from gordo_tpu.parallel import transfer
 from gordo_tpu.parallel.mesh import fleet_sharding, pad_to_multiple, replicated_sharding
 from gordo_tpu.programs import ProgramCache
@@ -1363,9 +1369,13 @@ class FleetTrainer:
             with tracing.start_span(
                 "train.dispatch", epoch=epoch, n_epochs=1
             ), annotate("train-dispatch"):
+                t_disp = time.perf_counter()
                 result = epoch_fn(
                     params, opt_state, epoch_keys, X_arg, y_arg, w_arg,
                     *extras
+                )
+                attribution.record(
+                    "train", "device", time.perf_counter() - t_disp
                 )
             if quarantine:
                 params, opt_state, epoch_loss, healthy_dev = result
@@ -1753,7 +1763,11 @@ class FleetTrainer:
             with tracing.start_span(
                 "train.dispatch", epoch=e, n_epochs=k
             ), annotate("train-dispatch"):
+                t_disp = time.perf_counter()
                 final, outs = chunk_fn(*args)
+                attribution.record(
+                    "train", "device", time.perf_counter() - t_disp
+                )
             if self.prefetch_depth > 0:
                 # the dispatch above is asynchronous: issue the NEXT
                 # chunk's argument transfer now so it rides under the
@@ -1763,8 +1777,13 @@ class FleetTrainer:
                 if e_next < epochs:
                     k_next = chunk_len(e_next)
                     if (e_next, k_next) not in prefetched_epochs:
+                        t_put = time.perf_counter()
                         prefetched_epochs[(e_next, k_next)] = jax.device_put(
                             np.arange(e_next, e_next + k_next, dtype=np.int32)
+                        )
+                        attribution.record(
+                            "train", "transfer",
+                            time.perf_counter() - t_put,
                         )
                         transfer.count_transfer("train", "prefetched")
             params, opt_state = final["params"], final["opt"]

@@ -44,7 +44,7 @@ from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
 from gordo_tpu import __version__
-from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.observability import attribution, emit_event, get_registry, tracing
 from gordo_tpu.observability import rollup as rollup_mod
 from gordo_tpu.robustness import faults
 from gordo_tpu.router.health import ReplicaHealthTracker
@@ -123,6 +123,9 @@ class _RequestCtx:
         #: pinned name
         self.requested_revision = ""
         self.trace_id = ""
+        #: the router-plane phase ledger: downstream replica wait is
+        #: "queue", response re-stamping is "serialize"
+        self.ledger = attribution.ledger_for("router")
 
     def forward_params(self, request: Request) -> dict:
         """Query params for a replica call, with the pinned revision
@@ -522,9 +525,10 @@ class RouterApp:
         adapter = self.url_map.bind_to_environ(request.environ)
         if request.path in self._TRACE_EXEMPT_PATHS:
             ctx.trace_id = incoming.trace_id if incoming is not None else ""
-            return self._dispatch_traced(
-                ctx, request, adapter, tracing.NOOP_SPAN
-            )
+            with ctx.ledger.activate():
+                return self._dispatch_traced(
+                    ctx, request, adapter, tracing.NOOP_SPAN
+                )
         with tracing.start_span(
             "router.request",
             parent=incoming,
@@ -534,7 +538,11 @@ class RouterApp:
             ctx.trace_id = span.trace_id or (
                 incoming.trace_id if incoming is not None else ""
             )
-            return self._dispatch_traced(ctx, request, adapter, span)
+            # the ledger activation makes this request's phase brackets
+            # (and record_current from replica calls on THIS thread)
+            # visible to the router-plane histograms
+            with ctx.ledger.activate():
+                return self._dispatch_traced(ctx, request, adapter, span)
 
     def _dispatch_traced(self, ctx, request, adapter, span) -> Response:
         endpoint = None
@@ -613,18 +621,21 @@ class RouterApp:
             if response.mimetype == "application/json":
                 # same body stamp as the server's responses, so clients
                 # can't tell a router from a single replica
-                try:
-                    data = json.loads(response.get_data())
-                    if isinstance(data, dict) and "revision" not in data:
-                        data["revision"] = (
-                            response.headers.get("revision") or ctx.revision
-                        )
-                        response.set_data(json.dumps(data).encode())
-                except ValueError:
-                    pass
+                with ctx.ledger.phase("serialize"):
+                    try:
+                        data = json.loads(response.get_data())
+                        if isinstance(data, dict) and "revision" not in data:
+                            data["revision"] = (
+                                response.headers.get("revision")
+                                or ctx.revision
+                            )
+                            response.set_data(json.dumps(data).encode())
+                    except ValueError:
+                        pass
             if "revision" not in response.headers:
                 response.headers["revision"] = ctx.revision
         runtime_s = timeit.default_timer() - ctx.start_time
+        ctx.ledger.finish(span=tracing.current_span(), wall_s=runtime_s)
         # append to any Server-Timing the proxied replica already
         # stamped, so its model_load/predict phases survive the hop
         entry = f"router_total;dur={runtime_s * 1000.0:.2f}"
@@ -735,6 +746,10 @@ class RouterApp:
                     time.sleep(action[1])
             send_headers = dict(headers or {})
             send_headers.update(tracing.propagation_headers(span))
+            # downstream replica wait is the router's "queue" phase; on
+            # fan-out/hedge worker threads there is no active ledger, so
+            # this no-ops and the caller's pool-wait bracket accounts it
+            t_wait = time.perf_counter()
             try:
                 resp = self.session.request(
                     method,
@@ -750,6 +765,10 @@ class RouterApp:
                 self.health.record_failure(rid)
                 span.set_status("error")
                 raise
+            finally:
+                attribution.record_current(
+                    "queue", time.perf_counter() - t_wait
+                )
             if resp.status_code >= 500 and resp.status_code != 503:
                 # 5xx (not a structured shed) counts against health too
                 self.health.record_failure(rid)
@@ -1190,6 +1209,10 @@ class RouterApp:
                 )
             )
         elif ordered:
+            # the whole fan-out wait is "queue" on the request thread
+            # (the per-call record_current inside _replica_call no-ops
+            # on the pool's worker threads)
+            t_wait = time.perf_counter()
             with ThreadPoolExecutor(max_workers=len(ordered)) as pool:
                 futures = [
                     pool.submit(
@@ -1200,6 +1223,9 @@ class RouterApp:
                     for rid, group in ordered
                 ]
                 results = [f.result() for f in futures]
+            attribution.record_current(
+                "queue", time.perf_counter() - t_wait
+            )
         return self._join_fleet_results(ctx, ordered, owners, results)
 
     def _shard_body(
@@ -1330,6 +1356,10 @@ class RouterApp:
         without waiting — the straggler finishes in the background
         instead of holding the response hostage."""
         pool = ThreadPoolExecutor(max_workers=2)
+        # both copies run on pool threads (no ledger sink there): the
+        # wait below is this thread's "queue" phase — a no-op in turn
+        # when _hedged_attempt itself runs on a fan-out worker
+        t_wait = time.perf_counter()
         try:
             first = pool.submit(attempt, primary, adopted)
             try:
@@ -1367,6 +1397,9 @@ class RouterApp:
                 raise last_exc
             raise RuntimeError("hedged attempt yielded no result")
         finally:
+            attribution.record_current(
+                "queue", time.perf_counter() - t_wait
+            )
             pool.shutdown(wait=False)
 
     def _classify_shard_response(
@@ -1753,12 +1786,16 @@ class RouterApp:
             if len(jobs) == 1:
                 results = [(jobs[0][0], call(*jobs[0]))]
             elif jobs:
+                t_wait = time.perf_counter()
                 with ThreadPoolExecutor(max_workers=len(jobs)) as pool:
                     futures = [
                         (sub, pool.submit(call, sub, payload))
                         for sub, payload in jobs
                     ]
                     results = [(sub, f.result()) for sub, f in futures]
+                attribution.record_current(
+                    "queue", time.perf_counter() - t_wait
+                )
         except Exception as exc:
             # a dead replica mid-stream: the breaker is already fed (it
             # drives ejection, so the client's re-open lands on the

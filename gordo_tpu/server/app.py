@@ -46,7 +46,13 @@ from werkzeug.wrappers import Request, Response
 from gordo_tpu import __version__, serializer
 from gordo_tpu.data.sensor_tag import normalize_sensor_tags
 from gordo_tpu.models import utils as model_utils
-from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.observability import (
+    attribution,
+    emit_event,
+    get_registry,
+    sampling,
+    tracing,
+)
 from gordo_tpu.robustness import faults
 from gordo_tpu.server import batching, model_io
 from gordo_tpu.server import utils as server_utils
@@ -146,6 +152,10 @@ class RequestContext:
         #: ``traceparent``, or minted by the request span) — echoed in
         #: the X-Gordo-Trace-Id response header; '' when neither exists
         self.trace_id: str = ""
+        #: the phase ledger (docs/observability.md "Time attribution"):
+        #: host/device phase accounting for this request; the no-op
+        #: singleton when GORDO_PHASE_LEDGER disables it
+        self.ledger = attribution.ledger_for("server")
 
     def record_phase(self, name: str, seconds: float) -> None:
         """One request phase: rides the Server-Timing header, the
@@ -368,6 +378,11 @@ class GordoApp:
         adapter = self.url_map.bind_to_environ(request.environ)
         if request.path in self._TRACE_EXEMPT_PATHS:
             ctx.trace_id = incoming.trace_id if incoming is not None else ""
+            # probes are not traffic for the phase ledger either: a
+            # liveness poll every few seconds would otherwise flood
+            # gordo_phase_seconds (and, via the Server-Timing hook,
+            # the span log) with sub-millisecond parse brackets
+            ctx.ledger = attribution.NOOP_LEDGER
             return self._dispatch_traced(
                 ctx, request, adapter, tracing.NOOP_SPAN
             )
@@ -380,19 +395,30 @@ class GordoApp:
             ctx.trace_id = span.trace_id or (
                 incoming.trace_id if incoming is not None else ""
             )
-            return self._dispatch_traced(ctx, request, adapter, span)
+            # the ledger is the thread's attribution sink for the whole
+            # handler: deeper layers (fleet scorer, estimator forward)
+            # attribute transfer/device time without knowing the request
+            with ctx.ledger.activate():
+                return self._dispatch_traced(ctx, request, adapter, span)
 
     def _dispatch_traced(
         self, ctx: RequestContext, request: Request, adapter, span
     ) -> Response:
         endpoint = None
         try:
-            endpoint, url_args = adapter.match()
-            resolution = self._resolve_revision(ctx, request)
+            # ledger: routing + revision resolution is request admission —
+            # "parse" time, same as the body decode the views bracket
+            with ctx.ledger.phase("parse"):
+                endpoint, url_args = adapter.match()
+                resolution = self._resolve_revision(ctx, request)
+                handler = (
+                    None
+                    if resolution is not None
+                    else getattr(self, f"view_{endpoint}")
+                )
             if resolution is not None:
                 response = resolution  # 410: revision gone
             else:
-                handler = getattr(self, f"view_{endpoint}")
                 response = handler(ctx, request, **url_args)
         except ApiError as exc:
             response = _json_response(exc.payload, exc.status)
@@ -566,15 +592,31 @@ class GordoApp:
                 response.mimetype == "application/json"
                 and endpoint not in self._REVISION_BODY_EXEMPT
             ):
-                try:
-                    data = json.loads(response.get_data())
-                    if isinstance(data, dict):
-                        data["revision"] = ctx.revision
-                        response.set_data(json.dumps(data).encode())
-                except ValueError:
-                    pass
+                # ledger: the revision stamp is a full decode + re-encode
+                # of the response body — real serialize cost that scales
+                # with the payload, not bookkeeping
+                with ctx.ledger.phase("serialize"):
+                    try:
+                        data = json.loads(response.get_data())
+                        if isinstance(data, dict):
+                            data["revision"] = ctx.revision
+                            response.set_data(json.dumps(data).encode())
+                    except ValueError:
+                        pass
             response.headers["revision"] = ctx.revision
         runtime_s = timeit.default_timer() - ctx.start_time
+        # close the phase ledger: observe gordo_phase_seconds{plane=
+        # "server"}, stamp the host/device split + coverage onto the
+        # request span, and grow Server-Timing with the ledger phases
+        # the coarse set does not already carry (queue rides its own
+        # record_phase at the batching seam — no double entry)
+        already_timed = {name for name, _ in ctx.timings}
+        ledger_summary = ctx.ledger.finish(
+            span=tracing.current_span(), wall_s=runtime_s
+        )
+        for name, seconds in (ledger_summary.get("phases") or {}).items():
+            if name not in already_timed:
+                ctx.record_phase(name, seconds)
         # Server-Timing dur is MILLISECONDS per the spec: the per-phase
         # entries (ctx.record_phase) and `total` are compliant. The
         # legacy `request_walltime_s` entry keeps its historical SECONDS
@@ -883,11 +925,18 @@ class GordoApp:
         metadata = self._get_metadata(ctx, gordo_name)
         tags = self._tags(metadata)
         target_tags = self._target_tags(metadata) or tags
-        ctx.X, ctx.y = server_utils.extract_X_y(
-            request, [t.name for t in tags], [t.name for t in target_tags]
-        )
+        with ctx.ledger.phase("parse"):
+            ctx.X, ctx.y = server_utils.extract_X_y(
+                request, [t.name for t in tags], [t.name for t in target_tags]
+            )
 
         start = timeit.default_timer()
+        # transform = the per-model predict's host remainder: elapsed
+        # minus whatever the estimator hot path attributed to
+        # transfer/device via record_current while we were inside it
+        inner_before = ctx.ledger.phases.get(
+            "transfer", 0.0
+        ) + ctx.ledger.phases.get("device", 0.0)
         try:
             output = model_io.get_model_output(model=model, X=ctx.X)
         except ValueError as err:
@@ -907,29 +956,43 @@ class GordoApp:
                 {"error": "Something unexpected happened; check your input data"},
                 400,
             )
-        ctx.record_phase("predict", timeit.default_timer() - start)
-        logger.debug(
-            "Calculating model output took %.4fs", timeit.default_timer() - start
+        elapsed = timeit.default_timer() - start
+        ctx.record_phase("predict", elapsed)
+        inner = (
+            ctx.ledger.phases.get("transfer", 0.0)
+            + ctx.ledger.phases.get("device", 0.0)
+            - inner_before
         )
+        ctx.ledger.add("transform", max(0.0, elapsed - inner))
+        logger.debug("Calculating model output took %.4fs", elapsed)
 
-        data = model_utils.make_base_dataframe(
-            tags=tags,
-            model_input=ctx.X.values if isinstance(ctx.X, pd.DataFrame) else ctx.X,
-            model_output=output,
-            target_tag_list=target_tags,
-            index=ctx.X.index,
-        )
-        if request.args.get("format") == "parquet":
-            return Response(
-                server_utils.dataframe_into_parquet_bytes(data),
-                200,
-                mimetype="application/octet-stream",
+        with ctx.ledger.phase("postprocess"):
+            data = model_utils.make_base_dataframe(
+                tags=tags,
+                model_input=(
+                    ctx.X.values if isinstance(ctx.X, pd.DataFrame) else ctx.X
+                ),
+                model_output=output,
+                target_tag_list=target_tags,
+                index=ctx.X.index,
             )
-        context = {
-            "data": server_utils.dataframe_to_dict(data),
-            "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
-        }
-        return _json_response(context, 200)
+        if request.args.get("format") == "parquet":
+            with ctx.ledger.phase("serialize"):
+                payload = server_utils.dataframe_into_parquet_bytes(data)
+            return Response(
+                payload, 200, mimetype="application/octet-stream"
+            )
+        with ctx.ledger.phase("serialize"):
+            response = _json_response(
+                {
+                    "data": server_utils.dataframe_to_dict(data),
+                    "time-seconds": (
+                        f"{timeit.default_timer() - ctx.start_time:.4f}"
+                    ),
+                },
+                200,
+            )
+        return response
 
     @property
     def _fleet_scorers(self) -> typing.Dict[tuple, tuple]:
@@ -984,6 +1047,7 @@ class GordoApp:
         if self.batch_wait_s <= 0:
             return scorer.predict(inputs)
         key = (os.path.realpath(ctx.collection_dir), names)
+        submit_t0 = timeit.default_timer()
         for _ in range(8):
             try:
                 pending = self._get_batcher(key, scorer).submit(
@@ -999,7 +1063,25 @@ class GordoApp:
             raise RuntimeError(
                 "Batcher for %r kept stopping under churn" % (names,)
             )
-        ctx.record_phase("queue", pending.queue_wait_s)
+        # queue = the FULL blocked wait on the batcher minus the shared
+        # dispatch phases stamped below — coalescing wait, dispatch
+        # machinery, and handler wake-up latency, with no hole between
+        # them (the batcher's own queue-wait histogram keeps the narrow
+        # enqueue-to-dispatch-start semantics)
+        shared_s = sum(pending.phase_seconds.values())
+        queue_s = max(
+            0.0, timeit.default_timer() - submit_t0 - shared_s
+        )
+        ctx.record_phase("queue", queue_s)
+        # ledger attribution: the queue wait lands on the innermost
+        # active ledger (the stream ledger for streamed updates, the
+        # request's otherwise), and the drainer's collected dispatch
+        # phases (transform/transfer/device) are stamped onto every
+        # coalesced request — the same shared-cost semantics as the
+        # batch's predict;dur Server-Timing entry
+        attribution.record_current("queue", queue_s)
+        for phase_name, phase_s in pending.phase_seconds.items():
+            attribution.record_current(phase_name, phase_s)
         span = tracing.current_span()
         if span is not None:
             span.set_attribute(
@@ -1111,7 +1193,11 @@ class GordoApp:
         model-output), computed by one vmapped dispatch per architecture
         group rather than one forward per machine.
         """
-        machines = self._fleet_request_machines(request, anomaly=False)
+        # the request-body decode (JSON or multipart parquet) is parse
+        # time too — without this bracket large fleet bodies leave a
+        # visible hole in the ledger's wall-time coverage
+        with ctx.ledger.phase("parse"):
+            machines = self._fleet_request_machines(request, anomaly=False)
         if machines is None:
             return _json_response(
                 {"error": "Body must contain a non-empty 'machines' mapping."}, 400
@@ -1133,7 +1219,8 @@ class GordoApp:
             tags = [t.name for t in self._tags(metadata)]
             raw = machines[name]
             try:
-                X = self._parse_fleet_frame(raw, tags)
+                with ctx.ledger.phase("parse"):
+                    X = self._parse_fleet_frame(raw, tags)
             except (ValueError, ApiError) as err:
                 return _json_response(
                     {"error": f"Bad input for machine {name!r}: {err}"}, 400
@@ -1141,10 +1228,13 @@ class GordoApp:
             frames[name] = X
             if name in fallback:
                 continue  # scored from the frame via its own predict below
-            transformed = X.values
-            for step in prefixes.get(name, []):
-                transformed = step.transform(transformed)
-            inputs[name] = np.asarray(transformed, dtype="float32")
+            # the float64-transform -> float32-cast host seam the dtype
+            # walk documented — now a named, measured phase
+            with ctx.ledger.phase("transform"):
+                transformed = X.values
+                for step in prefixes.get(name, []):
+                    transformed = step.transform(transformed)
+                inputs[name] = np.asarray(transformed, dtype="float32")
 
         outputs: typing.Dict[str, np.ndarray] = {}
         predict_start = timeit.default_timer()
@@ -1173,19 +1263,27 @@ class GordoApp:
         for name in names:
             tags = self._tags(meta[name])
             target_tags = self._target_tags(meta[name]) or tags
-            frame = model_utils.make_base_dataframe(
-                tags=tags,
-                model_input=frames[name].values,
-                model_output=outputs[name],
-                target_tag_list=target_tags,
-                index=frames[name].index,
+            with ctx.ledger.phase("postprocess"):
+                frame = model_utils.make_base_dataframe(
+                    tags=tags,
+                    model_input=frames[name].values,
+                    model_output=outputs[name],
+                    target_tag_list=target_tags,
+                    index=frames[name].index,
+                )
+            with ctx.ledger.phase("serialize"):
+                data[name] = server_utils.dataframe_to_dict(frame)
+        with ctx.ledger.phase("serialize"):
+            response = _json_response(
+                {
+                    "data": data,
+                    "time-seconds": (
+                        f"{timeit.default_timer() - ctx.start_time:.4f}"
+                    ),
+                },
+                200,
             )
-            data[name] = server_utils.dataframe_to_dict(frame)
-        context = {
-            "data": data,
-            "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
-        }
-        return _json_response(context, 200)
+        return response
 
     @staticmethod
     def _parse_fleet_frame(raw, columns: typing.List[str]) -> pd.DataFrame:
@@ -1249,7 +1347,8 @@ class GordoApp:
         """
         from gordo_tpu.models.anomaly.base import AnomalyDetectorBase
 
-        machines = self._fleet_request_machines(request, anomaly=True)
+        with ctx.ledger.phase("parse"):
+            machines = self._fleet_request_machines(request, anomaly=True)
         if machines is None:
             return _json_response(
                 {"error": "Body must contain a non-empty 'machines' mapping."}, 400
@@ -1301,8 +1400,9 @@ class GordoApp:
                     400,
                 )
             try:
-                X = self._parse_fleet_frame(raw["X"], tags)
-                y = self._parse_fleet_frame(raw["y"], target_tags)
+                with ctx.ledger.phase("parse"):
+                    X = self._parse_fleet_frame(raw["X"], tags)
+                    y = self._parse_fleet_frame(raw["y"], target_tags)
             except (ValueError, ApiError) as err:
                 return _json_response(
                     {"error": f"Bad input for machine {name!r}: {err}"}, 400
@@ -1310,10 +1410,11 @@ class GordoApp:
             frames[name], targets[name] = X, y
             if name in fallback:
                 continue  # scored via its own anomaly() below
-            transformed = X.values
-            for step in prefixes.get(name, []):
-                transformed = step.transform(transformed)
-            inputs[name] = np.asarray(transformed, dtype="float32")
+            with ctx.ledger.phase("transform"):
+                transformed = X.values
+                for step in prefixes.get(name, []):
+                    transformed = step.transform(transformed)
+                inputs[name] = np.asarray(transformed, dtype="float32")
 
         outputs: typing.Dict[str, np.ndarray] = {}
         data: typing.Dict[str, typing.Any] = {}
@@ -1333,10 +1434,17 @@ class GordoApp:
                 kwargs = (
                     {"model_output": outputs[name]} if name in outputs else {}
                 )
-                frame = models[name].anomaly(
-                    frames[name], targets[name], frequency=frequency, **kwargs
-                )
-                data[name] = server_utils.dataframe_to_dict(frame)
+                # anomaly statistic / threshold / smoothing assembly
+                # from the precomputed output: the postprocess seam
+                with ctx.ledger.phase("postprocess"):
+                    frame = models[name].anomaly(
+                        frames[name],
+                        targets[name],
+                        frequency=frequency,
+                        **kwargs,
+                    )
+                with ctx.ledger.phase("serialize"):
+                    data[name] = server_utils.dataframe_to_dict(frame)
         except (batching.BatchQueueFull, faults.InjectedFault):
             raise  # structured 503s, not input errors
         except ValueError as err:
@@ -1350,11 +1458,17 @@ class GordoApp:
                 400,
             )
         self._record_predict_phase(ctx, timeit.default_timer() - predict_start)
-        context = {
-            "data": data,
-            "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
-        }
-        return _json_response(context, 200)
+        with ctx.ledger.phase("serialize"):
+            response = _json_response(
+                {
+                    "data": data,
+                    "time-seconds": (
+                        f"{timeit.default_timer() - ctx.start_time:.4f}"
+                    ),
+                },
+                200,
+            )
+        return response
 
     # -- streaming scoring (docs/serving.md "Streaming scoring") -----------
 
@@ -1561,7 +1675,8 @@ class GordoApp:
                 time.sleep(value)
             elif mode == "burst":
                 burst_weight = max(1, int(value))
-        body = request.get_json(silent=True) or {}
+        with ctx.ledger.phase("parse"):
+            body = request.get_json(silent=True) or {}
         updates = body.get("updates")
         if not isinstance(updates, dict) or not updates:
             return _json_response(
@@ -1639,9 +1754,10 @@ class GordoApp:
         metadata = self._get_metadata(ctx, gordo_name)
         tags = self._tags(metadata)
         target_tags = self._target_tags(metadata) or tags
-        ctx.X, ctx.y = server_utils.extract_X_y(
-            request, [t.name for t in tags], [t.name for t in target_tags]
-        )
+        with ctx.ledger.phase("parse"):
+            ctx.X, ctx.y = server_utils.extract_X_y(
+                request, [t.name for t in tags], [t.name for t in target_tags]
+            )
 
         if ctx.y is None:
             return _json_response(
@@ -1653,6 +1769,12 @@ class GordoApp:
             normalize_frequency(metadata["dataset"].get("resolution", "10min"))
         )
         predict_start = timeit.default_timer()
+        # the anomaly call's host remainder (transform + statistic +
+        # threshold math around the device forward) lands on
+        # postprocess: the per-model path cannot see inside anomaly()
+        inner_before = ctx.ledger.phases.get(
+            "transfer", 0.0
+        ) + ctx.ledger.phases.get("device", 0.0)
         try:
             anomaly_df = model.anomaly(ctx.X, ctx.y, frequency=frequency)
         except AttributeError:
@@ -1668,19 +1790,32 @@ class GordoApp:
             # input trouble, not a server fault (the base-prediction and
             # fleet views report this as 400 too)
             return _json_response({"error": f"ValueError: {err}"}, 400)
-        ctx.record_phase("predict", timeit.default_timer() - predict_start)
+        elapsed = timeit.default_timer() - predict_start
+        ctx.record_phase("predict", elapsed)
+        inner = (
+            ctx.ledger.phases.get("transfer", 0.0)
+            + ctx.ledger.phases.get("device", 0.0)
+            - inner_before
+        )
+        ctx.ledger.add("postprocess", max(0.0, elapsed - inner))
 
         if request.args.get("format") == "parquet":
+            with ctx.ledger.phase("serialize"):
+                payload = server_utils.dataframe_into_parquet_bytes(anomaly_df)
             return Response(
-                server_utils.dataframe_into_parquet_bytes(anomaly_df),
-                200,
-                mimetype="application/octet-stream",
+                payload, 200, mimetype="application/octet-stream"
             )
-        context = {
-            "data": server_utils.dataframe_to_dict(anomaly_df),
-            "time-seconds": f"{timeit.default_timer() - ctx.start_time:.4f}",
-        }
-        return _json_response(context, 200)
+        with ctx.ledger.phase("serialize"):
+            response = _json_response(
+                {
+                    "data": server_utils.dataframe_to_dict(anomaly_df),
+                    "time-seconds": (
+                        f"{timeit.default_timer() - ctx.start_time:.4f}"
+                    ),
+                },
+                200,
+            )
+        return response
 
 
 def adapt_proxy_deployment(environ: dict) -> None:
@@ -1809,6 +1944,10 @@ def build_app(
             config["PROMETHEUS_REGISTRY"] = prometheus_registry
         else:
             logger.warning("Ignoring non empty prometheus_registry argument")
+    # the opt-in wall profiler (GORDO_PROFILE_HZ): ONE env lookup when
+    # unset; when set, the background sampler starts here so every
+    # worker profiles from its first request
+    sampling.maybe_start_from_env()
     app = GordoApp(config)
     if config.get("PRELOAD_MODELS", _env_bool("GORDO_SERVER_PRELOAD", False)):
         _preload_models(app)

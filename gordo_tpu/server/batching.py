@@ -42,7 +42,7 @@ import threading
 import time
 import typing
 
-from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.observability import attribution, emit_event, get_registry, tracing
 from gordo_tpu.robustness import faults
 
 logger = logging.getLogger(__name__)
@@ -94,6 +94,7 @@ class _Pending:
         "trace_id",
         "batch_trace_id",
         "batch_span_id",
+        "phase_seconds",
     )
 
     def __init__(self, inputs: typing.Dict[str, typing.Any], trace_id: str = ""):
@@ -104,6 +105,10 @@ class _Pending:
         self.enqueued_perf = time.perf_counter()
         self.queue_wait_s = 0.0
         self.n_coalesced = 1
+        #: the batch dispatch's phase attribution (transfer/device
+        #: seconds the drainer collected), stamped back so each
+        #: coalesced request's ledger carries the shared dispatch cost
+        self.phase_seconds: typing.Dict[str, float] = {}
         #: the request's own trace id (the server.request span's) — the
         #: fan-in link recorded on the batch span
         self.trace_id = trace_id
@@ -332,8 +337,31 @@ class RequestBatcher:
                 pending.error = exc
         if not live:
             return
+        # the drainer thread has no request ledger: collect the stacked
+        # dispatch's transfer/device attribution here and hand it back
+        # through the futures (handler threads fold it into their own
+        # ledgers — the shared-cost semantics of the batch predict;dur)
+        collector = attribution.ledger_for("server")
         try:
-            results = self.scorer.predict_requests([p.inputs for p in live])
+            dispatch_t0 = time.perf_counter()
+            with collector.activate():
+                results = self.scorer.predict_requests(
+                    [p.inputs for p in live]
+                )
+            # the dispatch's host remainder (request grouping, input
+            # stacking, output slicing) is transform time — same
+            # net-of-transfer/device accounting the single-machine view
+            # applies to its own predict call
+            inner = collector.phases.get(
+                "transfer", 0.0
+            ) + collector.phases.get("device", 0.0)
+            collector.add(
+                "transform",
+                max(0.0, time.perf_counter() - dispatch_t0 - inner),
+            )
+            if collector.phases:
+                for pending in live:
+                    pending.phase_seconds = dict(collector.phases)
         except BaseException:  # noqa: BLE001 - isolate, don't poison
             # no poisoned batch: one bad request (short windowed input,
             # a mid-batch fault) must not fail its batch-mates. Re-run

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from gordo_tpu.models.core import BaseJaxEstimator, _batch_bucket
-from gordo_tpu.observability import emit_event, get_registry, tracing
+from gordo_tpu.observability import attribution, emit_event, get_registry, tracing
 from gordo_tpu.parallel import transfer
 from gordo_tpu.parallel.precision import cast_params
 from gordo_tpu.programs import ProgramCache, serving_program_cache
@@ -552,6 +552,10 @@ class FleetScorer:
         """
         from gordo_tpu.streaming.window import WindowUpdate
 
+        # phase-ledger bookmark: everything up to the dispatch is host
+        # batch assembly + staging ("transfer"); the dispatch plus the
+        # device->host output sync in slices() is "device"
+        t_assemble = time.perf_counter()
         names = [name for _, name, _ in entries]
         lb, la = group["lookback"], group["lookahead"]
         f_prog = group["n_features"]
@@ -660,10 +664,18 @@ class FleetScorer:
                     )
                     for i, name in enumerate(names):
                         full[row_index[name]] = batch[i]
+                t_dispatch = time.perf_counter()
+                attribution.record_current(
+                    "transfer", t_dispatch - t_assemble
+                )
                 outputs = self._dispatch(
                     group, params, full, group_size, max_rows
                 )
-                return slices(outputs, lambda i: row_index[names[i]])
+                result = slices(outputs, lambda i: row_index[names[i]])
+                attribution.record_current(
+                    "device", time.perf_counter() - t_dispatch
+                )
+                return result
         else:
             # coalesced requests may name one machine several times: the
             # machine axis holds one row per ENTRY, so the bucket is not
@@ -706,8 +718,12 @@ class FleetScorer:
             batch = (
                 jnp.pad(batch, pad_spec) if on_device else np.pad(batch, pad_spec)
             )
+        t_dispatch = time.perf_counter()
+        attribution.record_current("transfer", t_dispatch - t_assemble)
         outputs = self._dispatch(group, params, batch, m_bucket, max_rows)
-        return slices(outputs, lambda i: i)
+        result = slices(outputs, lambda i: i)
+        attribution.record_current("device", time.perf_counter() - t_dispatch)
+        return result
 
 
 def fleet_scorer_from_models(

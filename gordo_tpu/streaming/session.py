@@ -30,7 +30,7 @@ import uuid
 
 import numpy as np
 
-from gordo_tpu.observability import emit_event, get_registry
+from gordo_tpu.observability import attribution, emit_event, get_registry, tracing
 from gordo_tpu.parallel import transfer
 from gordo_tpu.programs import evict_lru
 from gordo_tpu.programs.cache import hbm_headroom, min_headroom_fraction
@@ -251,7 +251,13 @@ class StreamSession:
             )
         start = time.perf_counter()
         metrics = _metrics()
-        with self.lock:
+        # the stream-plane phase ledger (docs/observability.md "Time
+        # attribution"): brackets below split the update into the
+        # closed phase vocabulary; dispatch-side transfer/device and
+        # batcher queue wait land here via record_current because this
+        # activation is innermost on the handler thread
+        led = attribution.ledger_for("stream")
+        with self.lock, led.activate():
             self.last_active = time.monotonic()
             pending_commits: typing.List[tuple] = []
             inputs: typing.Dict[str, WindowUpdate] = {}
@@ -266,25 +272,27 @@ class StreamSession:
                 # the exact dtype walk the one-shot parsed frame takes,
                 # so streamed and POSTed rows carry the same bits into
                 # the dispatch
-                rows = np.asarray(payload["rows"], dtype="float64")
-                if rows.ndim != 2:
-                    raise ValueError(
-                        f"Machine {name!r}: update rows must be 2-D "
-                        f"(rows, features), got shape {rows.shape}"
-                    )
-                if payload.get("y") is not None and len(
-                    np.asarray(payload["y"])
-                ) != len(rows):
-                    # a short y would mis-slice the target tail and
-                    # silently drop the machine's drift feed
-                    raise ValueError(
-                        f"Machine {name!r}: 'y' must carry one target "
-                        f"row per input row ({len(rows)}), got "
-                        f"{len(np.asarray(payload['y']))}"
-                    )
+                with led.phase("parse"):
+                    rows = np.asarray(payload["rows"], dtype="float64")
+                    if rows.ndim != 2:
+                        raise ValueError(
+                            f"Machine {name!r}: update rows must be 2-D "
+                            f"(rows, features), got shape {rows.shape}"
+                        )
+                    if payload.get("y") is not None and len(
+                        np.asarray(payload["y"])
+                    ) != len(rows):
+                        # a short y would mis-slice the target tail and
+                        # silently drop the machine's drift feed
+                        raise ValueError(
+                            f"Machine {name!r}: 'y' must carry one target "
+                            f"row per input row ({len(rows)}), got "
+                            f"{len(np.asarray(payload['y']))}"
+                        )
                 seq = int(payload.get("seq", stream.window.seq))
                 already = stream.window.seq - seq
-                transformed = stream.transform(rows)
+                with led.phase("transform"):
+                    transformed = stream.transform(rows)
                 try:
                     update, fresh = stream.window.begin(name, transformed, seq)
                 except SequenceGap as gap:
@@ -322,8 +330,9 @@ class StreamSession:
                 # Depth 0 keeps the historical transfer-at-dispatch
                 # behavior exactly.
                 if transfer.env_prefetch_depth() > 0:
-                    for update in inputs.values():
-                        update.prefetch()
+                    with led.phase("transfer"):
+                        for update in inputs.values():
+                            update.prefetch()
                     transfer.count_transfer(
                         "stream", "prefetched", n=len(inputs)
                     )
@@ -342,22 +351,26 @@ class StreamSession:
             observations: typing.List[dict] = []
             for name, out in outputs.items():
                 stream = self.machines[name]
-                out = np.asarray(out)
-                stream.window.n_scored += len(out)
-                self.rows_total += len(out)
-                results[name]["rows"] = out.tolist()
-                ratios = stream.anomaly_ratio(out, raw_tails[name])
-                if ratios is not None and len(ratios):
-                    finite = ratios[np.isfinite(ratios)]
-                    if len(finite):
-                        observations.append(
-                            {
-                                "machine": name,
-                                "n": int(len(finite)),
-                                "ratio_mean": float(finite.mean()),
-                                "exceedance": float((finite > 1.0).mean()),
-                            }
-                        )
+                with led.phase("postprocess"):
+                    out = np.asarray(out)
+                    stream.window.n_scored += len(out)
+                    self.rows_total += len(out)
+                    ratios = stream.anomaly_ratio(out, raw_tails[name])
+                    if ratios is not None and len(ratios):
+                        finite = ratios[np.isfinite(ratios)]
+                        if len(finite):
+                            observations.append(
+                                {
+                                    "machine": name,
+                                    "n": int(len(finite)),
+                                    "ratio_mean": float(finite.mean()),
+                                    "exceedance": float(
+                                        (finite > 1.0).mean()
+                                    ),
+                                }
+                            )
+                with led.phase("serialize"):
+                    results[name]["rows"] = out.tolist()
 
         # outside the session lock: telemetry/event-log I/O only
         for obs in observations:
@@ -375,6 +388,15 @@ class StreamSession:
             metrics["update_rows"].observe(transferred, kind="transferred")
             metrics["update_rows"].observe(resident, kind="resident")
         elapsed = time.perf_counter() - start
+        # finish the stream ledger outside the lock (histogram observes
+        # + optional span stamping), then fold its phases into the
+        # enclosing server-plane ledger so the HTTP request's coverage
+        # still accounts for the update's time
+        summary = led.finish(
+            span=tracing.current_span(), wall_s=elapsed, record_spans=True
+        )
+        for phase_name, phase_s in (summary.get("phases") or {}).items():
+            attribution.record_current(phase_name, phase_s)
         metrics["update_seconds"].observe(elapsed)
         metrics["updates"].inc(outcome="ok" if inputs else "warming")
         self._ema_update_s = (

@@ -675,6 +675,9 @@ NON_KNOB_ENV_VARS: typing.FrozenSet[str] = frozenset(
         "GORDO_TPU_TRACE_LOG",
         "GORDO_TPU_TRACE_SAMPLE",
         "GORDO_TPU_PROFILE_DIR",
+        "GORDO_PHASE_LEDGER",
+        "GORDO_PROFILE_HZ",
+        "GORDO_PROFILE_OUT",
         # paths and mounts
         "GORDO_TPU_LAKE_DIR",
         "GORDO_XLA_CACHE_DIR",

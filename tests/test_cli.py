@@ -141,7 +141,7 @@ def test_telemetry_summarize_cli(runner, tmp_path):
     )
     assert as_json.exit_code == 0, as_json.output
     payload = json.loads(as_json.output)
-    assert payload["schema_version"] == 3
+    assert payload["schema_version"] == 4
     assert payload["reports"][0]["report"]["n_machines"] == 4
     assert payload["events"]["build"]["build_started"] == 1
 

@@ -801,7 +801,7 @@ def test_summarize_rollup_section_roundtrip(tmp_path):
         summary_payload,
     )
 
-    assert SUMMARY_SCHEMA_VERSION == 3
+    assert SUMMARY_SCHEMA_VERSION == 4
     persist = tmp_path / "plane.jsonl"
     poller = RollupPoller(
         members=lambda: {},
@@ -815,7 +815,7 @@ def test_summarize_rollup_section_roundtrip(tmp_path):
         + json.dumps({"ts": "t", "event": "slo_budget_exhausted"}) + "\n"
     )
     payload = summary_payload(tmp_path)
-    assert payload["schema_version"] == 3
+    assert payload["schema_version"] == 4
     assert payload["rollup"][0]["n_snapshots"] == 2
     assert payload["rollup"][0]["members"]["r0"]["role"] == "replica"
     # rollup/slo events census under their own subsystem
